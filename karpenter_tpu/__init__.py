@@ -1,0 +1,22 @@
+"""karpenter-tpu: a TPU-native cluster-autoscaling framework.
+
+A brand-new framework with the capabilities of kubernetes-sigs/karpenter:
+node provisioning + disruption whose hot loops (pod -> instance-type
+bin-packing, requirements intersection, topology spread, multi-node
+consolidation search) run as a batched constraint-satisfaction solver on TPU
+via JAX/XLA, while a Python control plane keeps Karpenter's reconciler
+semantics (NodePool/NodeClaim objects, cluster-state mirror, lifecycle and
+disruption controllers, kwok-style simulated cloud provider).
+
+Layer map (mirrors reference layer map, SURVEY.md section 1):
+  models/         API object model (NodePool, NodeClaim, Pod, labels, taints)
+  scheduling/     host-side exact-semantics primitives (Requirements algebra)
+  cloudprovider/  SPI + InstanceType/Offering + fake/kwok providers
+  ops/            JAX tensor encoding + solver kernels (the TPU hot loop)
+  parallel/       device-mesh sharding of the solver
+  state/          in-memory cluster state mirror
+  controllers/    provisioning / disruption / lifecycle reconcilers
+  utils/          resource arithmetic, clocks, misc
+"""
+
+__version__ = "0.1.0"

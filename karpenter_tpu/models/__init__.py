@@ -1,0 +1,15 @@
+"""API object model: NodePool, NodeClaim, Pod, Node, labels, taints.
+
+Counterpart of the reference API layer (reference: pkg/apis/v1), re-designed
+as a lightweight Kubernetes-free Python object model. Durable state still
+follows the same shape — spec / status / conditions / finalizers / labels /
+annotations — so the reconciler semantics carry over unchanged.
+"""
+
+from karpenter_tpu.models.labels import *  # noqa: F401,F403
+from karpenter_tpu.models.objects import ObjectMeta, StatusCondition  # noqa: F401
+from karpenter_tpu.models.taints import Taint, Toleration  # noqa: F401
+from karpenter_tpu.models.pod import Pod, PodSpec, TopologySpreadConstraint  # noqa: F401
+from karpenter_tpu.models.nodepool import NodePool, NodePoolSpec, Budget, Disruption, Limits  # noqa: F401
+from karpenter_tpu.models.nodeclaim import NodeClaim, NodeClaimSpec, NodeClaimStatus  # noqa: F401
+from karpenter_tpu.models.node import Node  # noqa: F401

@@ -1,0 +1,33 @@
+"""Node: the machine object fabricated by the simulated cloud/kubelet."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from karpenter_tpu.models.objects import ObjectMeta
+from karpenter_tpu.models.taints import Taint
+
+
+@dataclass
+class NodeStatus:
+    capacity: dict[str, float] = field(default_factory=dict)
+    allocatable: dict[str, float] = field(default_factory=dict)
+    ready: bool = False
+
+
+@dataclass
+class NodeSpec:
+    provider_id: str = ""
+    taints: list[Taint] = field(default_factory=list)
+    unschedulable: bool = False
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=lambda: ObjectMeta(name="node"))
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name

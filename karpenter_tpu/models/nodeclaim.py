@@ -1,0 +1,77 @@
+"""NodeClaim: one requested machine.
+
+Counterpart of reference pkg/apis/v1/nodeclaim.go:27 (spec) and
+nodeclaim_status.go:25-72 (status + condition types).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from karpenter_tpu.models.objects import ConditionSet, ObjectMeta
+from karpenter_tpu.models.taints import Taint
+
+# Condition types (nodeclaim_status.go:25-37)
+COND_LAUNCHED = "Launched"
+COND_REGISTERED = "Registered"
+COND_INITIALIZED = "Initialized"
+COND_READY = "Ready"
+COND_CONSOLIDATABLE = "Consolidatable"
+COND_DRIFTED = "Drifted"
+COND_DRAINED = "Drained"
+COND_VOLUMES_DETACHED = "VolumesDetached"
+COND_INSTANCE_TERMINATING = "InstanceTerminating"
+COND_CONSISTENT_STATE_FOUND = "ConsistentStateFound"
+COND_DISRUPTION_REASON = "DisruptionReason"
+
+
+@dataclass
+class NodeClaimSpec:
+    taints: list[Taint] = field(default_factory=list)
+    startup_taints: list[Taint] = field(default_factory=list)
+    requirements: list[dict] = field(default_factory=list)  # {key, operator, values, minValues}
+    requests: dict[str, float] = field(default_factory=dict)
+    node_class_ref: Optional[dict] = None
+    termination_grace_period_seconds: Optional[float] = None
+    expire_after_seconds: Optional[float] = None
+
+
+@dataclass
+class NodeClaimStatus:
+    provider_id: str = ""
+    node_name: str = ""
+    image_id: str = ""
+    capacity: dict[str, float] = field(default_factory=dict)
+    allocatable: dict[str, float] = field(default_factory=dict)
+    last_pod_event_time: Optional[float] = None
+
+
+@dataclass
+class NodeClaim:
+    metadata: ObjectMeta = field(default_factory=lambda: ObjectMeta(name="nodeclaim"))
+    spec: NodeClaimSpec = field(default_factory=NodeClaimSpec)
+    status: NodeClaimStatus = field(default_factory=NodeClaimStatus)
+    conditions: ConditionSet = field(default_factory=ConditionSet)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def nodepool_name(self) -> Optional[str]:
+        from karpenter_tpu.models import labels as l
+
+        return self.metadata.labels.get(l.NODEPOOL_LABEL_KEY)
+
+    @property
+    def capacity_type(self) -> Optional[str]:
+        from karpenter_tpu.models import labels as l
+
+        return self.metadata.labels.get(l.CAPACITY_TYPE_LABEL_KEY)
+
+    @property
+    def instance_type_name(self) -> Optional[str]:
+        from karpenter_tpu.models import labels as l
+
+        return self.metadata.labels.get(l.LABEL_INSTANCE_TYPE)

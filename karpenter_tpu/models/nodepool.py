@@ -1,0 +1,165 @@
+"""NodePool: template + policy for a fleet of nodes.
+
+Counterpart of reference pkg/apis/v1/nodepool.go:42-171 (NodePoolSpec,
+Disruption, Budget, Limits) and nodepool.go:355 (MustGetAllowedDisruptions).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+from karpenter_tpu.models.objects import ConditionSet, ObjectMeta
+from karpenter_tpu.models.taints import Taint
+
+# Consolidation policies (nodepool.go:160-171)
+CONSOLIDATION_WHEN_EMPTY = "WhenEmpty"
+CONSOLIDATION_WHEN_EMPTY_OR_UNDERUTILIZED = "WhenEmptyOrUnderutilized"
+CONSOLIDATION_BALANCED = "Balanced"
+
+# Balanced policy approval constant k (nodepool.go:171): approve a
+# consolidation iff savingsRatio/disruptionRatio >= 1/k.
+BALANCED_K = 2
+
+# Disruption reasons (shared with disruption engine)
+REASON_UNDERUTILIZED = "Underutilized"
+REASON_EMPTY = "Empty"
+REASON_DRIFTED = "Drifted"
+REASON_ALL = "All"
+
+# NodePool status condition types
+CONDITION_VALIDATION_SUCCEEDED = "ValidationSucceeded"
+CONDITION_NODECLASS_READY = "NodeClassReady"
+CONDITION_NODE_REGISTRATION_HEALTHY = "NodeRegistrationHealthy"
+CONDITION_READY = "Ready"
+
+NODEPOOL_HASH_VERSION = "v1"
+
+
+@dataclass
+class Budget:
+    """Max simultaneous disruptions, optionally cron-windowed
+    (nodepool.go:119-158)."""
+
+    nodes: str = "10%"  # absolute int or percentage string
+    schedule: Optional[str] = None  # cron expression; active window start
+    duration_seconds: Optional[float] = None
+    reasons: list[str] = field(default_factory=list)  # empty = all reasons
+
+    def __post_init__(self) -> None:
+        # schedule and duration must be set together (CRD validation parity)
+        if (self.schedule is None) != (self.duration_seconds is None):
+            raise ValueError("budget schedule and duration must be specified together")
+
+    def allowed(self, total_nodes: int) -> int:
+        s = self.nodes.strip()
+        if s.endswith("%"):
+            return int(math.floor(total_nodes * float(s[:-1]) / 100.0))
+        return int(s)
+
+    def is_active(self, now: float) -> bool:
+        if self.schedule is None:
+            return True
+        from karpenter_tpu.utils.cron import in_window
+
+        return in_window(self.schedule, self.duration_seconds or 0.0, now)
+
+
+@dataclass
+class Disruption:
+    consolidate_after_seconds: Optional[float] = 0.0  # None = Never
+    consolidation_policy: str = CONSOLIDATION_WHEN_EMPTY_OR_UNDERUTILIZED
+    budgets: list[Budget] = field(default_factory=lambda: [Budget()])
+
+
+@dataclass
+class Limits:
+    """Resource caps incl. the synthetic 'nodes' resource (nodepool.go:~Limits)."""
+
+    resources: dict[str, float] = field(default_factory=dict)
+
+    def exceeded_by(self, usage: dict[str, float]) -> Optional[str]:
+        for k, limit in self.resources.items():
+            u = usage.get(k, 0.0)
+            if u > limit + 1e-9:
+                return f"resource {k} usage {u} exceeds limit {limit}"
+        return None
+
+
+@dataclass
+class NodeClaimTemplateSpec:
+    """The NodeClaim spec stamped out by this pool."""
+
+    taints: list[Taint] = field(default_factory=list)
+    startup_taints: list[Taint] = field(default_factory=list)
+    requirements: list[dict] = field(default_factory=list)  # {key, operator, values, minValues}
+    node_class_ref: Optional[dict] = None
+    expire_after_seconds: Optional[float] = None  # None = Never
+    termination_grace_period_seconds: Optional[float] = None
+
+
+@dataclass
+class NodeClaimTemplate:
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    spec: NodeClaimTemplateSpec = field(default_factory=NodeClaimTemplateSpec)
+
+
+@dataclass
+class NodePoolSpec:
+    template: NodeClaimTemplate = field(default_factory=NodeClaimTemplate)
+    disruption: Disruption = field(default_factory=Disruption)
+    limits: Optional[Limits] = None
+    weight: int = 0  # 1-100; higher = tried first (nodepool.go:~Weight)
+    replicas: Optional[int] = None  # static capacity pools
+
+
+@dataclass
+class NodePoolStatus:
+    resources: dict[str, float] = field(default_factory=dict)
+    node_count: int = 0
+
+
+@dataclass
+class NodePool:
+    metadata: ObjectMeta = field(default_factory=lambda: ObjectMeta(name="default"))
+    spec: NodePoolSpec = field(default_factory=NodePoolSpec)
+    status: NodePoolStatus = field(default_factory=NodePoolStatus)
+    conditions: ConditionSet = field(default_factory=ConditionSet)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def is_static(self) -> bool:
+        return self.spec.replicas is not None
+
+    def allowed_disruptions(self, reason: str, total_nodes: int, now: float) -> int:
+        """Min over active budgets matching the reason
+        (nodepool.go:355 MustGetAllowedDisruptions)."""
+        allowed = total_nodes  # no budget = unbounded by budgets
+        for budget in self.spec.disruption.budgets:
+            if budget.reasons and reason not in budget.reasons and REASON_ALL not in budget.reasons:
+                continue
+            if not budget.is_active(now):
+                continue
+            allowed = min(allowed, budget.allowed(total_nodes))
+        return allowed
+
+    def static_hash(self) -> str:
+        """Hash of drift-relevant static fields (nodepool.go:334-344)."""
+        import hashlib
+        import json
+
+        payload = {
+            "labels": self.spec.template.labels,
+            "annotations": self.spec.template.annotations,
+            "node_class_ref": self.spec.template.spec.node_class_ref,
+            "taints": [(t.key, t.value, t.effect) for t in self.spec.template.spec.taints],
+            "startup_taints": [(t.key, t.value, t.effect) for t in self.spec.template.spec.startup_taints],
+            "expire_after": self.spec.template.spec.expire_after_seconds,
+            "termination_grace_period": self.spec.template.spec.termination_grace_period_seconds,
+        }
+        return hashlib.sha256(json.dumps(payload, sort_keys=True).encode()).hexdigest()[:16]

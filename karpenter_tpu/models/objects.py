@@ -1,0 +1,107 @@
+"""Base object machinery: metadata, status conditions.
+
+Durable state keeps the Kubernetes shape (metadata / spec / status /
+conditions / finalizers) so reconciler semantics from the reference carry
+over, but objects are plain Python dataclasses stored in an in-memory API
+server model (karpenter_tpu/state) rather than CRDs in etcd.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+_uid_counter = itertools.count(1)
+
+
+def new_uid(prefix: str = "obj") -> str:
+    return f"{prefix}-{next(_uid_counter):08d}"
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = field(default_factory=lambda: new_uid())
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    finalizers: list[str] = field(default_factory=list)
+    creation_timestamp: float = field(default_factory=time.time)
+    deletion_timestamp: Optional[float] = None
+    resource_version: int = 0
+    owner_uid: Optional[str] = None
+
+    @property
+    def deleting(self) -> bool:
+        return self.deletion_timestamp is not None
+
+
+# Condition statuses
+CONDITION_TRUE = "True"
+CONDITION_FALSE = "False"
+CONDITION_UNKNOWN = "Unknown"
+
+
+@dataclass
+class StatusCondition:
+    type: str
+    status: str = CONDITION_UNKNOWN
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = field(default_factory=time.time)
+
+    @property
+    def is_true(self) -> bool:
+        return self.status == CONDITION_TRUE
+
+
+class ConditionSet:
+    """Helper over a list of StatusCondition with transition timestamps."""
+
+    def __init__(self) -> None:
+        self._conditions: dict[str, StatusCondition] = {}
+
+    def get(self, ctype: str) -> Optional[StatusCondition]:
+        return self._conditions.get(ctype)
+
+    def is_true(self, *ctypes: str) -> bool:
+        return all((c := self._conditions.get(t)) is not None and c.is_true for t in ctypes)
+
+    def has(self, ctype: str) -> bool:
+        return ctype in self._conditions
+
+    def set_true(self, ctype: str, reason: str = "", message: str = "", now: Optional[float] = None) -> bool:
+        return self._set(ctype, CONDITION_TRUE, reason, message, now)
+
+    def set_false(self, ctype: str, reason: str = "", message: str = "", now: Optional[float] = None) -> bool:
+        return self._set(ctype, CONDITION_FALSE, reason, message, now)
+
+    def set_unknown(self, ctype: str, reason: str = "", message: str = "", now: Optional[float] = None) -> bool:
+        return self._set(ctype, CONDITION_UNKNOWN, reason, message, now)
+
+    def clear(self, ctype: str) -> None:
+        self._conditions.pop(ctype, None)
+
+    def _set(self, ctype: str, status: str, reason: str, message: str, now: Optional[float]) -> bool:
+        """Returns True if the condition transitioned."""
+        existing = self._conditions.get(ctype)
+        if existing is not None and existing.status == status:
+            existing.reason, existing.message = reason, message
+            return False
+        self._conditions[ctype] = StatusCondition(
+            type=ctype,
+            status=status,
+            reason=reason,
+            message=message,
+            last_transition_time=now if now is not None else time.time(),
+        )
+        return True
+
+    def transition_time(self, ctype: str) -> Optional[float]:
+        c = self._conditions.get(ctype)
+        return c.last_transition_time if c else None
+
+    def all(self) -> list[StatusCondition]:
+        return list(self._conditions.values())

@@ -1,0 +1,13 @@
+"""Host-side scheduling primitives with exact reference semantics.
+
+These are the correctness oracles for the TPU solver: the tensor encoding in
+karpenter_tpu/ops is golden-tested against this package.
+"""
+
+from karpenter_tpu.scheduling.requirements import (  # noqa: F401
+    Operator,
+    Requirement,
+    Requirements,
+    node_selector_requirement,
+)
+from karpenter_tpu.scheduling.taints import tolerates, tolerates_all  # noqa: F401

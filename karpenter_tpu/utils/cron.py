@@ -1,0 +1,83 @@
+"""Tiny 5-field cron matcher for disruption-budget windows.
+
+The reference uses robfig/cron for Budget.Schedule (nodepool.go:119-158); we
+implement the standard minute/hour/dom/month/dow subset (*, lists, ranges,
+steps) which covers the documented budget examples.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def _parse_field(spec: str, lo_v: int, hi_v: int) -> set[int]:
+    out: set[int] = set()
+    for part in spec.split(","):
+        step = 1
+        if "/" in part:
+            part, step_s = part.split("/", 1)
+            step = int(step_s)
+        if part in ("*", ""):
+            start, end = lo_v, hi_v
+        elif "-" in part:
+            a, b = part.split("-", 1)
+            start, end = int(a), int(b)
+        else:
+            start = end = int(part)
+        out.update(range(start, end + 1, step))
+    return out
+
+
+_ALIASES = {
+    "@hourly": "0 * * * *",
+    "@daily": "0 0 * * *",
+    "@midnight": "0 0 * * *",
+    "@weekly": "0 0 * * 0",
+    "@monthly": "0 0 1 * *",
+    "@yearly": "0 0 1 1 *",
+    "@annually": "0 0 1 1 *",
+}
+
+
+def matches(schedule: str, t: float) -> bool:
+    """True if UTC time t falls on a cron firing minute."""
+    schedule = _ALIASES.get(schedule.strip(), schedule)
+    fields = schedule.split()
+    if len(fields) != 5:
+        raise ValueError(f"invalid cron schedule {schedule!r}")
+    minute, hour, dom, month, dow = fields
+    tm = time.gmtime(t)
+    if tm.tm_min not in _parse_field(minute, 0, 59):
+        return False
+    if tm.tm_hour not in _parse_field(hour, 0, 23):
+        return False
+    if tm.tm_mon not in _parse_field(month, 1, 12):
+        return False
+    # standard cron: dom OR dow when both restricted, AND when one is *
+    # dow parses 0-7 with both 0 and 7 meaning Sunday
+    dow_set = {d % 7 for d in _parse_field(dow, 0, 7)}
+    dom_set = _parse_field(dom, 1, 31)
+    cron_dow = (tm.tm_wday + 1) % 7  # python Mon=0 -> cron Sun=0
+    dom_star, dow_star = dom.strip() == "*", dow.strip() == "*"
+    if dom_star and dow_star:
+        return True
+    if dom_star:
+        return cron_dow in dow_set
+    if dow_star:
+        return tm.tm_mday in dom_set
+    return tm.tm_mday in dom_set or cron_dow in dow_set
+
+
+def in_window(schedule: str, duration_seconds: float, now: float) -> bool:
+    """True if `now` is within [firing, firing+duration] for some firing.
+
+    Scans back minute-by-minute over the duration (bounded; budget windows
+    are hours-scale in practice).
+    """
+    start_minute = int(now // 60) * 60
+    steps = int(duration_seconds // 60) + 1
+    for i in range(min(steps, 60 * 24 * 32)):
+        t = start_minute - i * 60
+        if matches(schedule, t):
+            return now - t <= duration_seconds
+    return False

@@ -1,0 +1,268 @@
+"""Requirement/Requirements set-algebra semantics.
+
+These mirror the behavioral contract of reference
+pkg/scheduling/requirement_test.go / requirements_test.go (cases re-derived
+from the documented semantics, not copied).
+"""
+
+import pytest
+
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.scheduling import Operator, Requirement, Requirements
+
+
+def req(key, op, *values, min_values=None):
+    return Requirement.new(key, op, *values, min_values=min_values)
+
+
+class TestConstruction:
+    def test_in(self):
+        r = req("key", Operator.IN, "a", "b")
+        assert not r.complement
+        assert r.values == {"a", "b"}
+        assert r.operator() is Operator.IN
+
+    def test_not_in(self):
+        r = req("key", Operator.NOT_IN, "a")
+        assert r.complement
+        assert r.operator() is Operator.NOT_IN
+
+    def test_exists(self):
+        r = req("key", Operator.EXISTS)
+        assert r.complement and not r.values
+        assert r.operator() is Operator.EXISTS
+
+    def test_does_not_exist(self):
+        r = req("key", Operator.DOES_NOT_EXIST)
+        assert not r.complement and not r.values
+        assert r.operator() is Operator.DOES_NOT_EXIST
+
+    def test_gt_canonicalized_to_gte(self):
+        r = req("key", Operator.GT, "5")
+        assert r.gte == 6 and r.lte is None and r.complement
+
+    def test_lt_canonicalized_to_lte(self):
+        r = req("key", Operator.LT, "5")
+        assert r.lte == 4 and r.gte is None and r.complement
+
+    def test_gte_lte(self):
+        assert req("key", Operator.GTE, "5").gte == 5
+        assert req("key", Operator.LTE, "5").lte == 5
+
+    def test_label_normalization(self):
+        r = req(l.LABEL_ZONE_BETA, Operator.IN, "us-west-2a")
+        assert r.key == l.LABEL_TOPOLOGY_ZONE
+
+
+class TestHas:
+    def test_in(self):
+        r = req("key", Operator.IN, "a")
+        assert r.has("a") and not r.has("b")
+
+    def test_not_in(self):
+        r = req("key", Operator.NOT_IN, "a")
+        assert not r.has("a") and r.has("b")
+
+    def test_exists(self):
+        assert req("key", Operator.EXISTS).has("anything")
+
+    def test_does_not_exist(self):
+        assert not req("key", Operator.DOES_NOT_EXIST).has("anything")
+
+    def test_bounds_admit_only_integers(self):
+        r = req("key", Operator.GT, "3")
+        assert r.has("4") and not r.has("3") and not r.has("abc")
+
+    def test_lt(self):
+        r = req("key", Operator.LT, "3")
+        assert r.has("2") and not r.has("3")
+
+
+class TestIntersection:
+    def test_in_in(self):
+        r = req("key", Operator.IN, "a", "b").intersection(req("key", Operator.IN, "b", "c"))
+        assert r.values == {"b"} and not r.complement
+
+    def test_in_in_disjoint_is_does_not_exist(self):
+        r = req("key", Operator.IN, "a").intersection(req("key", Operator.IN, "b"))
+        assert r.operator() is Operator.DOES_NOT_EXIST
+
+    def test_in_not_in(self):
+        r = req("key", Operator.IN, "a", "b").intersection(req("key", Operator.NOT_IN, "a"))
+        assert r.values == {"b"} and not r.complement
+
+    def test_not_in_not_in_unions_exclusions(self):
+        r = req("key", Operator.NOT_IN, "a").intersection(req("key", Operator.NOT_IN, "b"))
+        assert r.complement and r.values == {"a", "b"}
+
+    def test_exists_in(self):
+        r = req("key", Operator.EXISTS).intersection(req("key", Operator.IN, "a"))
+        assert not r.complement and r.values == {"a"}
+
+    def test_empty_bounds_is_does_not_exist(self):
+        r = req("key", Operator.GTE, "5").intersection(req("key", Operator.LTE, "3"))
+        assert r.operator() is Operator.DOES_NOT_EXIST
+
+    def test_bounds_filter_values(self):
+        r = req("key", Operator.IN, "1", "5", "9").intersection(req("key", Operator.LT, "6"))
+        assert r.values == {"1", "5"}
+        # concrete sets drop bounds
+        assert r.gte is None and r.lte is None
+
+    def test_bounds_merge_on_complements(self):
+        r = req("key", Operator.GT, "1").intersection(req("key", Operator.LT, "9"))
+        assert r.complement and r.gte == 2 and r.lte == 8
+
+    def test_min_values_max_wins(self):
+        a = req("key", Operator.IN, "a", "b", min_values=2)
+        b = req("key", Operator.IN, "a", "b", "c", min_values=3)
+        assert a.intersection(b).min_values == 3
+
+    def test_commutative_nonempty(self):
+        cases = [
+            req("k", Operator.IN, "a", "b"),
+            req("k", Operator.NOT_IN, "b", "c"),
+            req("k", Operator.EXISTS),
+            req("k", Operator.DOES_NOT_EXIST),
+            req("k", Operator.GT, "2"),
+            req("k", Operator.LT, "7"),
+            req("k", Operator.IN, "3", "5"),
+        ]
+        for a in cases:
+            for b in cases:
+                ab, ba = a.intersection(b), b.intersection(a)
+                assert ab.values == ba.values
+                assert ab.complement == ba.complement
+                assert ab.gte == ba.gte and ab.lte == ba.lte
+
+
+class TestHasIntersection:
+    CASES = [
+        req("k", Operator.IN, "a", "b"),
+        req("k", Operator.IN, "b"),
+        req("k", Operator.IN, "5"),
+        req("k", Operator.NOT_IN, "a"),
+        req("k", Operator.NOT_IN, "5"),
+        req("k", Operator.EXISTS),
+        req("k", Operator.DOES_NOT_EXIST),
+        req("k", Operator.GT, "3"),
+        req("k", Operator.LT, "3"),
+        req("k", Operator.GTE, "5"),
+        req("k", Operator.LTE, "5"),
+    ]
+
+    def test_matches_full_intersection_nonemptiness(self):
+        # has_intersection must agree with "intersection() is non-empty"
+        for a in self.CASES:
+            for b in self.CASES:
+                full = a.intersection(b)
+                # non-empty: any finite values, or complement (infinite set)
+                nonempty = bool(full.values) or full.complement
+                assert a.has_intersection(b) == nonempty, f"{a} vs {b}"
+
+    def test_symmetric(self):
+        for a in self.CASES:
+            for b in self.CASES:
+                assert a.has_intersection(b) == b.has_intersection(a)
+
+
+class TestRequirements:
+    def test_add_intersects_per_key(self):
+        rs = Requirements(req("k", Operator.IN, "a", "b"))
+        rs.add(req("k", Operator.IN, "b", "c"))
+        assert rs.get("k").values == {"b"}
+
+    def test_get_missing_is_exists(self):
+        rs = Requirements()
+        assert rs.get("missing").operator() is Operator.EXISTS
+
+    def test_compatible_well_known_undefined_allowed(self):
+        node = Requirements()  # defines nothing
+        pod = Requirements(req(l.LABEL_TOPOLOGY_ZONE, Operator.IN, "zone-1"))
+        assert node.compatible(pod, allow_undefined=l.WELL_KNOWN_LABELS) is None
+
+    def test_compatible_custom_undefined_denied(self):
+        node = Requirements()
+        pod = Requirements(req("custom", Operator.IN, "x"))
+        assert node.compatible(pod, allow_undefined=l.WELL_KNOWN_LABELS) is not None
+
+    def test_compatible_custom_undefined_lenient_ops_allowed(self):
+        node = Requirements()
+        for op in (Operator.NOT_IN, Operator.DOES_NOT_EXIST):
+            pod = Requirements(req("custom", op, "x") if op is Operator.NOT_IN else req("custom", op))
+            assert node.compatible(pod, allow_undefined=l.WELL_KNOWN_LABELS) is None
+
+    def test_intersects_shared_keys_only(self):
+        a = Requirements(req("a", Operator.IN, "1"), req("shared", Operator.IN, "x"))
+        b = Requirements(req("b", Operator.IN, "2"), req("shared", Operator.IN, "x", "y"))
+        assert a.intersects(b) is None
+
+    def test_intersects_conflict(self):
+        a = Requirements(req("shared", Operator.IN, "x"))
+        b = Requirements(req("shared", Operator.IN, "y"))
+        assert a.intersects(b) is not None
+
+    def test_intersects_double_lenient_forgiven(self):
+        # DoesNotExist vs NotIn: no value intersection but both lenient
+        a = Requirements(req("k", Operator.DOES_NOT_EXIST))
+        b = Requirements(req("k", Operator.NOT_IN, "x"))
+        assert a.intersects(b) is None
+
+    def test_intersects_does_not_exist_vs_in_fails(self):
+        a = Requirements(req("k", Operator.DOES_NOT_EXIST))
+        b = Requirements(req("k", Operator.IN, "x"))
+        assert a.intersects(b) is not None
+
+    def test_labels_roundtrip(self):
+        rs = Requirements.from_labels({"a": "1", "b": "2"})
+        assert rs.labels() == {"a": "1", "b": "2"}
+
+    def test_has_min_values(self):
+        assert not Requirements(req("k", Operator.IN, "a")).has_min_values()
+        assert Requirements(req("k", Operator.IN, "a", min_values=1)).has_min_values()
+
+
+class TestPodRequirements:
+    def test_node_selector_and_required_affinity(self):
+        from karpenter_tpu.models.pod import NodeAffinity, NodeSelectorTerm, make_pod
+
+        pod = make_pod("p", node_selector={"disk": "ssd"})
+        pod.spec.node_affinity = NodeAffinity(
+            required=[
+                NodeSelectorTerm([{"key": "zone", "operator": "In", "values": ["a", "b"]}]),
+                NodeSelectorTerm([{"key": "zone", "operator": "In", "values": ["c"]}]),  # OR'd; only first used
+            ]
+        )
+        rs = Requirements.from_pod(pod)
+        assert rs.get("disk").values == {"ssd"}
+        assert rs.get("zone").values == {"a", "b"}
+
+    def test_heaviest_preference_treated_as_required(self):
+        from karpenter_tpu.models.pod import NodeAffinity, PreferredSchedulingTerm, make_pod
+
+        pod = make_pod("p")
+        pod.spec.node_affinity = NodeAffinity(
+            preferred=[
+                PreferredSchedulingTerm(1, [{"key": "zone", "operator": "In", "values": ["a"]}]),
+                PreferredSchedulingTerm(10, [{"key": "zone", "operator": "In", "values": ["b"]}]),
+            ]
+        )
+        rs = Requirements.from_pod(pod)
+        assert rs.get("zone").values == {"b"}
+        strict = Requirements.from_pod(pod, include_preferred=False)
+        assert not strict.has("zone")
+
+
+class TestTaints:
+    def test_tolerates(self):
+        from karpenter_tpu.models.taints import NO_SCHEDULE, Taint, Toleration
+        from karpenter_tpu.scheduling import tolerates_all
+
+        taints = [Taint(key="team", value="a", effect=NO_SCHEDULE)]
+        assert tolerates_all(taints, []) is not None
+        assert tolerates_all(taints, [Toleration(key="team", operator="Equal", value="a")]) is None
+        assert tolerates_all(taints, [Toleration(key="team", operator="Exists")]) is None
+        assert tolerates_all(taints, [Toleration(operator="Exists")]) is None
+        assert tolerates_all(taints, [Toleration(key="team", operator="Equal", value="b")]) is not None
+        # effect-scoped toleration
+        assert tolerates_all(taints, [Toleration(key="team", operator="Exists", effect="NoExecute")]) is not None
