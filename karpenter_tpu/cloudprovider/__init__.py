@@ -1,0 +1,27 @@
+"""CloudProvider SPI.
+
+Counterpart of reference pkg/cloudprovider/types.go:73-101 (the 9-method
+interface) and types.go:601-732 (the typed error taxonomy that drives
+controller behavior).
+"""
+
+from karpenter_tpu.cloudprovider.errors import (  # noqa: F401
+    CloudProviderError,
+    CreateError,
+    InsufficientCapacityError,
+    NodeClaimNotFoundError,
+    NodeClassNotReadyError,
+    UnevaluatedNodePoolError,
+)
+from karpenter_tpu.cloudprovider.instancetype import (  # noqa: F401
+    InstanceType,
+    InstanceTypeOverhead,
+    Offering,
+    cheapest,
+    compatible_instance_types,
+    order_by_price,
+    satisfies_min_values,
+    truncate_instance_types,
+    worst_launch_price,
+)
+from karpenter_tpu.cloudprovider.spi import CloudProvider, RepairPolicy  # noqa: F401

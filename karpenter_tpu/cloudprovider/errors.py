@@ -1,0 +1,45 @@
+"""Typed cloud-provider errors (reference pkg/cloudprovider/types.go:601-732).
+
+The error type — not the message — drives controller behavior:
+  NodeClaimNotFoundError    delete retries until the instance is gone
+  InsufficientCapacityError launch fails fast; claim deleted; pods re-scheduled
+  NodeClassNotReadyError    launch requeues until the node class is ready
+  CreateError               carries a condition reason/message onto the claim
+  UnevaluatedNodePoolError  overlay store has not evaluated this pool yet
+"""
+
+from __future__ import annotations
+
+
+class CloudProviderError(Exception):
+    pass
+
+
+class NodeClaimNotFoundError(CloudProviderError):
+    pass
+
+
+class InsufficientCapacityError(CloudProviderError):
+    pass
+
+
+class NodeClassNotReadyError(CloudProviderError):
+    pass
+
+
+class CreateError(CloudProviderError):
+    def __init__(self, message: str, reason: str = "LaunchFailed"):
+        super().__init__(message)
+        self.reason = reason
+
+
+class UnevaluatedNodePoolError(CloudProviderError):
+    pass
+
+
+def is_insufficient_capacity(err: Exception) -> bool:
+    return isinstance(err, InsufficientCapacityError)
+
+
+def is_not_found(err: Exception) -> bool:
+    return isinstance(err, NodeClaimNotFoundError)

@@ -1,0 +1,202 @@
+"""In-memory test cloud provider.
+
+Counterpart of reference pkg/cloudprovider/fake (scripted errors, synthetic
+instance-type catalog) and the kwok catalog generator
+(kwok/tools/gen_instance_types.go:34-120): families × cpu sizes × archs ×
+zones × {spot, on-demand}, spot priced at 70% of on-demand. The catalog
+shape matches what the reference scheduler benchmark uses
+(fake.InstanceTypes(400), scheduling_benchmark_test.go:229) so our bench is
+apples-to-apples.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Callable, Optional
+
+from karpenter_tpu.cloudprovider import errors
+from karpenter_tpu.cloudprovider.instancetype import InstanceType, InstanceTypeOverhead, Offering
+from karpenter_tpu.cloudprovider.spi import CloudProvider, RepairPolicy
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodeclaim import NodeClaim
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.objects import new_uid
+from karpenter_tpu.scheduling import Operator, Requirement, Requirements
+from karpenter_tpu.utils import resources as res
+
+DEFAULT_ZONES = ("test-zone-1", "test-zone-2", "test-zone-3", "test-zone-4")
+GIB = 2**30
+
+# family -> (price multiplier, GiB memory per vCPU)
+FAMILIES = {
+    "c": (0.8, 2),   # compute optimized
+    "s": (1.0, 4),   # standard
+    "m": (1.2, 8),   # memory optimized
+    "e": (0.6, 1),   # economy
+}
+CPU_SIZES = (1, 2, 4, 8, 16, 32, 48, 64)
+ARCHS = (l.ARCH_AMD64, l.ARCH_ARM64)
+
+
+def price_of(family: str, cpu: int, arch: str) -> float:
+    mult, mem_ratio = FAMILIES[family]
+    base = cpu * 0.035 + cpu * mem_ratio * 0.004
+    if arch == l.ARCH_ARM64:
+        base *= 0.85
+    return round(base * mult, 5)
+
+
+def new_instance_type(
+    name: str,
+    family: str = "s",
+    cpu: int = 4,
+    arch: str = l.ARCH_AMD64,
+    os: str = "linux",
+    zones: tuple[str, ...] = DEFAULT_ZONES,
+    capacity_types: tuple[str, ...] = (l.CAPACITY_TYPE_SPOT, l.CAPACITY_TYPE_ON_DEMAND),
+    extra_resources: Optional[dict[str, float]] = None,
+    price_multiplier: float = 1.0,
+) -> InstanceType:
+    mem_ratio = FAMILIES[family][1]
+    memory = cpu * mem_ratio * GIB
+    capacity = {
+        res.CPU: float(cpu),
+        res.MEMORY: float(memory),
+        res.PODS: float(min(110, 16 + cpu * 8)),
+        res.EPHEMERAL_STORAGE: 100.0 * GIB,
+        **(extra_resources or {}),
+    }
+    od_price = price_of(family, cpu, arch) * price_multiplier
+    offerings = []
+    for zone, ct in itertools.product(zones, capacity_types):
+        price = od_price * (0.7 if ct == l.CAPACITY_TYPE_SPOT else 1.0)
+        offerings.append(
+            Offering(
+                requirements=Requirements(
+                    Requirement.new(l.LABEL_TOPOLOGY_ZONE, Operator.IN, zone),
+                    Requirement.new(l.CAPACITY_TYPE_LABEL_KEY, Operator.IN, ct),
+                ),
+                price=round(price, 5),
+                available=True,
+            )
+        )
+    requirements = Requirements(
+        Requirement.new(l.LABEL_INSTANCE_TYPE, Operator.IN, name),
+        Requirement.new("karpenter-tpu.sh/instance-family", Operator.IN, family),
+        Requirement.new("karpenter-tpu.sh/instance-cpu", Operator.IN, str(cpu)),
+        Requirement.new(l.LABEL_ARCH, Operator.IN, arch),
+        Requirement.new(l.LABEL_OS, Operator.IN, os),
+        Requirement.new(l.LABEL_TOPOLOGY_ZONE, Operator.IN, *zones),
+        Requirement.new(l.CAPACITY_TYPE_LABEL_KEY, Operator.IN, *capacity_types),
+    )
+    overhead = InstanceTypeOverhead(
+        kube_reserved={res.CPU: 0.080 + cpu * 0.002, res.MEMORY: 255.0 * 2**20 + memory * 0.01},
+        system_reserved={res.CPU: 0.0, res.MEMORY: 100.0 * 2**20},
+        eviction_threshold={res.MEMORY: 100.0 * 2**20},
+    )
+    return InstanceType(name, requirements, offerings, capacity, overhead)
+
+
+def instance_types(n: int = 400) -> list[InstanceType]:
+    """Generate n diverse instance types (fake/instancetype.go:99 analog)."""
+    out = []
+    combos = itertools.cycle(
+        (fam, cpu, arch)
+        for cpu in CPU_SIZES
+        for fam in FAMILIES
+        for arch in ARCHS
+    )
+    seen_multiplier = 0
+    for i in range(n):
+        fam, cpu, arch = next(combos)
+        if i and i % (len(CPU_SIZES) * len(FAMILIES) * len(ARCHS)) == 0:
+            seen_multiplier += 1
+        name = f"{fam}-{cpu}x-{arch}" + (f"-gen{seen_multiplier}" if seen_multiplier else "")
+        out.append(
+            new_instance_type(
+                name, family=fam, cpu=cpu, arch=arch, price_multiplier=1.0 + 0.07 * seen_multiplier
+            )
+        )
+    return out
+
+
+class FakeCloudProvider(CloudProvider):
+    """Scripted in-memory provider (fake/cloudprovider.go:51-72 analog)."""
+
+    def __init__(self, catalog: Optional[list[InstanceType]] = None):
+        self.catalog = catalog if catalog is not None else instance_types(16)
+        self.created: dict[str, NodeClaim] = {}  # provider_id -> claim
+        self.create_calls: list[NodeClaim] = []
+        self.delete_calls: list[NodeClaim] = []
+        # scripted failures
+        self.next_create_err: Optional[Exception] = None
+        self.create_hook: Optional[Callable[[NodeClaim], None]] = None
+        self.drifted: dict[str, str] = {}  # claim name -> reason
+        self._repair_policies: list[RepairPolicy] = []
+
+    @property
+    def name(self) -> str:
+        return "fake"
+
+    def get_instance_types(self, node_pool: NodePool) -> list[InstanceType]:
+        return list(self.catalog)
+
+    def create(self, node_claim: NodeClaim) -> NodeClaim:
+        self.create_calls.append(node_claim)
+        if self.next_create_err is not None:
+            err, self.next_create_err = self.next_create_err, None
+            raise err
+        if self.create_hook:
+            self.create_hook(node_claim)
+        reqs = Requirements.from_node_selector_requirements(node_claim.spec.requirements)
+        # resolve cheapest compatible (instance type, offering)
+        best: tuple[float, InstanceType, Offering] | None = None
+        for it in self.catalog:
+            if not it.requirements.is_compatible(reqs, l.WELL_KNOWN_LABELS):
+                continue
+            for o in it.available_offerings():
+                if not reqs.is_compatible(o.requirements, l.WELL_KNOWN_LABELS):
+                    continue
+                if best is None or o.price < best[0]:
+                    best = (o.price, it, o)
+        if best is None:
+            raise errors.InsufficientCapacityError(
+                f"no compatible instance types for claim {node_claim.name}"
+            )
+        _, it, offering = best
+        resolved = node_claim
+        resolved.status.provider_id = f"fake:///{node_claim.name}/{new_uid('instance')}"
+        resolved.status.capacity = dict(it.capacity)
+        resolved.status.allocatable = dict(it.allocatable())
+        resolved.metadata.labels.update(
+            {
+                l.LABEL_INSTANCE_TYPE: it.name,
+                l.LABEL_TOPOLOGY_ZONE: offering.zone,
+                l.CAPACITY_TYPE_LABEL_KEY: offering.capacity_type,
+                l.LABEL_ARCH: it.requirements.get(l.LABEL_ARCH).any_value(),
+                l.LABEL_OS: it.requirements.get(l.LABEL_OS).any_value(),
+            }
+        )
+        self.created[resolved.status.provider_id] = resolved
+        return resolved
+
+    def delete(self, node_claim: NodeClaim) -> None:
+        self.delete_calls.append(node_claim)
+        pid = node_claim.status.provider_id
+        if pid not in self.created:
+            raise errors.NodeClaimNotFoundError(pid)
+        del self.created[pid]
+
+    def get(self, provider_id: str) -> NodeClaim:
+        if provider_id not in self.created:
+            raise errors.NodeClaimNotFoundError(provider_id)
+        return self.created[provider_id]
+
+    def list(self) -> list[NodeClaim]:
+        return list(self.created.values())
+
+    def is_drifted(self, node_claim: NodeClaim) -> Optional[str]:
+        return self.drifted.get(node_claim.name)
+
+    def repair_policies(self) -> list[RepairPolicy]:
+        return self._repair_policies

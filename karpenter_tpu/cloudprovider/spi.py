@@ -1,0 +1,71 @@
+"""The CloudProvider service-provider interface.
+
+Counterpart of reference pkg/cloudprovider/types.go:73-118. Controllers only
+ever talk to this interface; the scheduler itself never does — it consumes
+the InstanceType catalog and emits NodeClaim specs (the seam where the TPU
+solver plugs in).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+from typing import Optional
+
+from karpenter_tpu.cloudprovider.instancetype import InstanceType
+from karpenter_tpu.models.nodeclaim import NodeClaim
+from karpenter_tpu.models.nodepool import NodePool
+
+
+@dataclass
+class RepairPolicy:
+    """An unhealthy-node condition the provider wants remediated
+    (types.go:103-118)."""
+
+    condition_type: str
+    condition_status: str
+    toleration_seconds: float
+
+
+class CloudProvider(abc.ABC):
+    """The 9-method SPI (types.go:73-101)."""
+
+    @abc.abstractmethod
+    def create(self, node_claim: NodeClaim) -> NodeClaim:
+        """Launch an instance for the claim; returns the resolved claim with
+        provider_id, capacity, allocatable and instance labels populated.
+        Raises InsufficientCapacityError / NodeClassNotReadyError /
+        CreateError."""
+
+    @abc.abstractmethod
+    def delete(self, node_claim: NodeClaim) -> None:
+        """Terminate the backing instance. Raises NodeClaimNotFoundError once
+        the instance no longer exists (callers retry until then)."""
+
+    @abc.abstractmethod
+    def get(self, provider_id: str) -> NodeClaim:
+        """Fetch current cloud truth for one instance.
+        Raises NodeClaimNotFoundError."""
+
+    @abc.abstractmethod
+    def list(self) -> list[NodeClaim]:
+        """List all instances owned by this provider."""
+
+    @abc.abstractmethod
+    def get_instance_types(self, node_pool: NodePool) -> list[InstanceType]:
+        """The catalog for one pool. May raise UnevaluatedNodePoolError."""
+
+    @abc.abstractmethod
+    def is_drifted(self, node_claim: NodeClaim) -> Optional[str]:
+        """A drift reason string if the claim drifted from provider-side
+        config, else None."""
+
+    def repair_policies(self) -> list[RepairPolicy]:
+        return []
+
+    @property
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+    def get_supported_node_classes(self) -> list[str]:
+        return []

@@ -1,0 +1,21 @@
+"""Provisioning: pending pods -> NodeClaims.
+
+Counterpart of reference pkg/controllers/provisioning. The scheduler here
+has two interchangeable engines driven by the same template/claim model:
+
+  host_scheduler.py  exact-semantics Python packer — the oracle the device
+                     engine is differentially tested against, and the
+                     fallback for exotic features not yet tensorized
+  scheduler.py       the TPU engine: encode -> ops.solver -> decode
+"""
+
+from karpenter_tpu.controllers.provisioning.nodeclaimtemplate import (  # noqa: F401
+    ClaimTemplate,
+    build_templates,
+)
+from karpenter_tpu.controllers.provisioning.host_scheduler import (  # noqa: F401
+    HostScheduler,
+    SchedulingResult,
+    SimClaim,
+)
+from karpenter_tpu.controllers.provisioning.scheduler import TPUScheduler  # noqa: F401

@@ -1,0 +1,174 @@
+"""Exact-semantics host scheduler — the oracle for the TPU engine.
+
+A faithful Python rendering of the reference's Solve loop
+(scheduler.go:440-790, nodeclaim.go:124-242, nodeclaim.go:541): FFD pod
+order, in-flight claims retried fewest-pods-first with earliest-index
+tie-break, per-claim viable-instance-type filtering by the
+compat × fits × hasOffering triple mask, weight-ordered template fallback.
+
+Deliberately simple and allocation-happy: correctness oracle first, CPU
+fallback second. The TPU engine (scheduler.py) must match its packing
+exactly on featured-covered problems.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from karpenter_tpu.cloudprovider.instancetype import AllocatableOfferings, InstanceType
+from karpenter_tpu.controllers.provisioning.nodeclaimtemplate import ClaimTemplate
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.scheduling import Requirements
+from karpenter_tpu.scheduling.taints import tolerates_all
+from karpenter_tpu.utils import resources as res
+
+
+@dataclass
+class SimClaim:
+    """One simulated in-flight NodeClaim."""
+
+    template: ClaimTemplate
+    requirements: Requirements
+    used: dict[str, float]
+    instance_types: list[InstanceType]
+    pods: list[Pod] = field(default_factory=list)
+    slot: int = 0
+
+    def cheapest_launch(self) -> tuple[Optional[InstanceType], float]:
+        """Cheapest (type, price) among viable types/offerings compatible
+        with the final requirements (kwok Create behavior)."""
+        best_it, best_price = None, float("inf")
+        for it in self.instance_types:
+            p = it.cheapest_offering_price(self.requirements)
+            if p < best_price:
+                best_it, best_price = it, p
+        return best_it, best_price
+
+
+@dataclass
+class SchedulingResult:
+    claims: list[SimClaim]
+    unschedulable: list[tuple[Pod, str]]
+    assignments: dict[str, int]  # pod uid -> claim slot
+
+    @property
+    def node_count(self) -> int:
+        return len(self.claims)
+
+    def total_price(self) -> float:
+        return sum(c.cheapest_launch()[1] for c in self.claims)
+
+
+def ffd_sort(pods: list[Pod]) -> list[Pod]:
+    """CPU+memory descending (queue.go:72-90); stable on ties."""
+    return sorted(
+        pods,
+        key=lambda p: -(
+            p.spec.requests.get(res.CPU, 0.0)
+            + p.spec.requests.get(res.MEMORY, 0.0) / (4.0 * 2**30)
+        ),
+    )
+
+
+def filter_instance_types(
+    its: list[InstanceType], requirements: Requirements, total_requests: dict[str, float]
+) -> list[InstanceType]:
+    """The inner kernel (nodeclaim.go:541): keep types where requirements
+    intersect AND requests fit an allocatable group AND that group has a
+    compatible available offering."""
+    remaining = []
+    for it in its:
+        if it.requirements.intersects(requirements) is not None:
+            continue
+        if _fits_and_offering(it.allocatable_offerings(), requirements, total_requests):
+            remaining.append(it)
+    return remaining
+
+
+def _fits_and_offering(
+    groups: list[AllocatableOfferings], requirements: Requirements, requests: dict[str, float]
+) -> bool:
+    for group in groups:
+        if not res.fits(requests, group.allocatable):
+            continue
+        for o in group.offerings:
+            if requirements.is_compatible(o.requirements, l.WELL_KNOWN_LABELS):
+                return True
+    return False
+
+
+class HostScheduler:
+    def __init__(self, templates: list[ClaimTemplate]):
+        self.templates = templates
+
+    def can_add(self, claim: SimClaim, pod: Pod, pod_reqs: Requirements) -> Optional[SimClaim]:
+        """Feasibility of adding pod to claim (nodeclaim.go:124-242);
+        returns the updated claim state or None."""
+        if tolerates_all(claim.template.taints, pod.spec.tolerations) is not None:
+            return None
+        if claim.requirements.compatible(pod_reqs, l.WELL_KNOWN_LABELS) is not None:
+            return None
+        combined = claim.requirements.copy()
+        combined.add(*pod_reqs.values())
+        total = res.merge(claim.used, pod.total_requests())
+        remaining = filter_instance_types(claim.instance_types, combined, total)
+        if not remaining:
+            return None
+        return SimClaim(
+            template=claim.template,
+            requirements=combined,
+            used=total,
+            instance_types=remaining,
+            pods=claim.pods + [pod],
+            slot=claim.slot,
+        )
+
+    def try_new_claim(self, pod: Pod, pod_reqs: Requirements, slot: int) -> Optional[SimClaim]:
+        for tmpl in self.templates:  # weight order (scheduler.go:695)
+            if tolerates_all(tmpl.taints, pod.spec.tolerations) is not None:
+                continue
+            if tmpl.requirements.compatible(pod_reqs, l.WELL_KNOWN_LABELS) is not None:
+                continue
+            combined = tmpl.requirements.copy()
+            combined.add(*pod_reqs.values())
+            total = res.merge(tmpl.daemon_requests, pod.total_requests())
+            remaining = filter_instance_types(tmpl.instance_types, combined, total)
+            if not remaining:
+                continue
+            return SimClaim(
+                template=tmpl,
+                requirements=combined,
+                used=total,
+                instance_types=remaining,
+                pods=[pod],
+                slot=slot,
+            )
+        return None
+
+    def solve(self, pods: list[Pod]) -> SchedulingResult:
+        claims: list[SimClaim] = []
+        unschedulable: list[tuple[Pod, str]] = []
+        assignments: dict[str, int] = {}
+        for pod in ffd_sort(pods):
+            pod_reqs = Requirements.from_pod(pod)
+            # in-flight claims: fewest pods first, earliest slot tie-break
+            # (scheduler.go:598-599)
+            placed = False
+            for claim in sorted(claims, key=lambda c: (len(c.pods), c.slot)):
+                updated = self.can_add(claim, pod, pod_reqs)
+                if updated is not None:
+                    claims[claims.index(claim)] = updated
+                    assignments[pod.uid] = updated.slot
+                    placed = True
+                    break
+            if placed:
+                continue
+            new_claim = self.try_new_claim(pod, pod_reqs, slot=len(claims))
+            if new_claim is not None:
+                claims.append(new_claim)
+                assignments[pod.uid] = new_claim.slot
+            else:
+                unschedulable.append((pod, "no compatible in-flight claim or template"))
+        return SchedulingResult(claims=claims, unschedulable=unschedulable, assignments=assignments)

@@ -1,0 +1,207 @@
+"""The TPU scheduling engine: encode -> ops.solver -> decode.
+
+Produces bit-identical packings to the HostScheduler oracle (differentially
+tested in tests/test_solver.py): same FFD order, same fewest-pods-first
+claim selection, same weight-ordered template fallback, same triple-mask
+instance-type filtering — but evaluated as dense tensor ops in one
+`lax.scan` on the accelerator instead of per-pod goroutine fan-outs.
+
+Shape discipline: the label vocabulary can grow across solve() calls (new
+pods may introduce new keys/values). Static problem tensors are re-encoded
+whenever the vocab changes, with key/value axes padded to powers of two so
+XLA's compile cache keeps hitting; problem tensors are jit arguments, not
+closure constants, so re-encoding alone never recompiles.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from karpenter_tpu.cloudprovider.instancetype import InstanceType
+from karpenter_tpu.controllers.provisioning.host_scheduler import (
+    SchedulingResult,
+    SimClaim,
+    ffd_sort,
+    filter_instance_types,
+)
+from karpenter_tpu.controllers.provisioning.nodeclaimtemplate import ClaimTemplate
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.ops import solver as ops_solver
+from karpenter_tpu.ops.encode import ProblemEncoder, encode_requirements
+from karpenter_tpu.scheduling import Requirements
+from karpenter_tpu.scheduling.taints import tolerates_all
+from karpenter_tpu.utils import resources as res
+
+
+def _next_pow2(n: int, floor: int = 8) -> int:
+    out = floor
+    while out < n:
+        out *= 2
+    return out
+
+
+class TPUScheduler:
+    """One scheduler instance per template/catalog set; reusable across
+    solve() batches (the vocab may grow between calls)."""
+
+    def __init__(
+        self,
+        templates: list[ClaimTemplate],
+        max_claims: Optional[int] = None,
+        pod_pad: Optional[int] = None,
+    ):
+        self.templates = templates
+        # union catalog over all templates, stable order, deduped by name
+        seen: dict[str, InstanceType] = {}
+        for t in templates:
+            for it in t.instance_types:
+                seen.setdefault(it.name, it)
+        self.catalog: list[InstanceType] = list(seen.values())
+        self._it_index = {name: i for i, name in enumerate(seen)}
+        self.max_claims = max_claims
+        self.pod_pad = pod_pad
+
+        self.encoder = ProblemEncoder()
+        for t in templates:
+            self.encoder.observe_requirements(t.requirements)
+        for it in self.catalog:
+            self.encoder.observe_instance_type(it)
+        self._vocab_sig: Optional[tuple] = None
+
+    # -- encoding ----------------------------------------------------------
+
+    def _sig(self) -> tuple:
+        v = self.encoder.vocab
+        return (v.n_keys, tuple(len(vals) for vals in v.values), self.encoder.n_resources)
+
+    def _pads(self) -> tuple[int, int]:
+        v = self.encoder.vocab
+        return _next_pow2(max(v.n_keys, 1), 8), _next_pow2(max(v.max_values, 1), 8)
+
+    def _encode_static(self) -> None:
+        """(Re-)encode instance types + templates against the current vocab."""
+        enc = self.encoder
+        k_pad, v_pad = self._pads()
+        itt = enc.encode_instance_types(self.catalog)
+        # re-pad the requirement tensors to the bucketed K/V
+        itt = itt._replace(
+            reqs=encode_requirements(enc.vocab, [it.requirements for it in self.catalog], k_pad, v_pad)
+        )
+        self.it_tensors = itt
+        T = len(self.catalog)
+        G = len(self.templates)
+        tmpl_reqs = encode_requirements(
+            enc.vocab, [t.requirements for t in self.templates], k_pad, v_pad
+        )
+        its = np.zeros((G, T), dtype=bool)
+        daemon = np.zeros((G, enc.n_resources), dtype=np.float32)
+        for g, t in enumerate(self.templates):
+            for it in t.instance_types:
+                its[g, self._it_index[it.name]] = True
+            daemon[g] = enc.resources_vector(t.daemon_requests)
+        self.template_tensors = ops_solver.Templates(
+            reqs=tmpl_reqs,
+            its=jnp.asarray(its),
+            daemon_requests=jnp.asarray(daemon),
+            valid=jnp.ones(G, dtype=bool),
+        )
+        wk = enc.vocab.well_known_mask()
+        self.well_known = jnp.asarray(
+            np.pad(wk, (0, k_pad - len(wk)), constant_values=False)
+        )
+        self._vocab_sig = self._sig()
+
+    # -- solving -----------------------------------------------------------
+
+    def solve(self, pods: Sequence[Pod]) -> SchedulingResult:
+        pods_sorted = ffd_sort(list(pods))
+        for p in pods_sorted:
+            self.encoder.observe_pod(p)
+        if self._vocab_sig != self._sig():
+            self._encode_static()
+
+        P = len(pods_sorted)
+        P_pad = self.pod_pad or _next_pow2(max(P, 1))
+        n_claims = self.max_claims or _next_pow2(max(P, 1))
+        k_pad, v_pad = self._pads()
+        pad_pod = Pod()  # zero-request inert pod for padding
+        padded = pods_sorted + [pad_pod] * (P_pad - P)
+        reqs = encode_requirements(
+            self.encoder.vocab, [Requirements.from_pod(p) for p in padded], k_pad, v_pad
+        )
+        requests = np.stack([self.encoder.resources_vector(p.total_requests()) for p in padded])
+        pt = ops_solver.PodTensors(
+            reqs=reqs,
+            strict_reqs=reqs,  # relaxation ladder lands in a later phase
+            requests=jnp.asarray(requests, dtype=jnp.float32),
+            valid=jnp.asarray([True] * P + [False] * (P_pad - P), dtype=bool),
+        )
+        # toleration matrix [P, G] host-side: taint sets are static per template
+        tol = np.zeros((P_pad, len(self.templates)), dtype=bool)
+        for i, p in enumerate(padded):
+            for g, t in enumerate(self.templates):
+                tol[i, g] = tolerates_all(t.taints, p.spec.tolerations) is None
+
+        zone_kid, ct_kid = self.encoder.zone_ct_key_ids()
+        result = ops_solver.solve(
+            pt,
+            jnp.asarray(tol),
+            self.it_tensors,
+            self.template_tensors,
+            self.well_known,
+            zone_kid=zone_kid,
+            ct_kid=ct_kid,
+            n_claims=n_claims,
+        )
+        return self._decode(pods_sorted, result)
+
+    def _decode(self, pods_sorted: list[Pod], result: ops_solver.SolveResult) -> SchedulingResult:
+        """Replay assignments host-side to rebuild exact claim objects.
+
+        The device decides WHO goes WHERE; the host re-derives each claim's
+        Requirements/viable types with the oracle-grade Python algebra, so
+        emitted NodeClaims carry exact reference semantics.
+        """
+        assignment = np.asarray(result.assignment)[: len(pods_sorted)]
+        claim_template = np.asarray(result.claims.template)
+
+        claims: list[SimClaim] = []
+        slot_to_claim: dict[int, SimClaim] = {}
+        unschedulable: list[tuple[Pod, str]] = []
+        assignments: dict[str, int] = {}
+        for i, pod in enumerate(pods_sorted):
+            slot = int(assignment[i])
+            if slot == ops_solver.NO_ROOM:
+                unschedulable.append((pod, "claim-slot capacity exhausted; raise max_claims"))
+                continue
+            if slot < 0:
+                unschedulable.append((pod, "no compatible in-flight claim or template"))
+                continue
+            assignments[pod.uid] = slot
+            claim = slot_to_claim.get(slot)
+            pod_reqs = Requirements.from_pod(pod)
+            if claim is None:
+                tmpl = self.templates[int(claim_template[slot])]
+                claim = SimClaim(
+                    template=tmpl,
+                    requirements=tmpl.requirements.copy(),
+                    used=dict(tmpl.daemon_requests),
+                    instance_types=list(tmpl.instance_types),
+                    pods=[],
+                    slot=slot,
+                )
+                slot_to_claim[slot] = claim
+                claims.append(claim)
+            claim.requirements.add(*pod_reqs.values())
+            claim.used = res.merge(claim.used, pod.total_requests())
+            claim.pods.append(pod)
+        # narrow viable instance types once per claim (host replay)
+        for claim in claims:
+            claim.instance_types = filter_instance_types(
+                claim.instance_types, claim.requirements, claim.used
+            )
+        return SchedulingResult(claims=claims, unschedulable=unschedulable, assignments=assignments)

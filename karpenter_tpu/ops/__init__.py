@@ -1,0 +1,21 @@
+"""JAX tensor encoding + solver kernels — the TPU hot loop.
+
+This package reformulates the reference's scheduling hot loop
+(pkg/controllers/provisioning/scheduling, pkg/scheduling) as dense tensor
+algebra:
+
+  encode.py   label vocabularies; Requirements -> boolean mask tensors;
+              pods / instance types / offerings -> dense arrays
+  kernels.py  requirement-set algebra as batched boolean kernels
+              (has_intersection / intersects / compatible / intersect_sets)
+  solver.py   the scheduling solver: compat × fits × offering feasibility
+              masks + first-fit-decreasing packing via lax.scan
+"""
+
+from karpenter_tpu.ops.encode import (  # noqa: F401
+    InstanceTypeTensors,
+    PodTensors,
+    ProblemEncoder,
+    ReqSetTensors,
+    Vocab,
+)

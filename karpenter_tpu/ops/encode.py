@@ -1,0 +1,315 @@
+"""Phase 0: lossless tensor encoding of the scheduling problem.
+
+The reference's Requirement (pkg/scheduling/requirement.go:36) is a
+compressed set over one label key's values: either a finite set, or the
+complement of one, with optional integer bounds. We encode a *batch* of
+requirement sets as dense tensors over a problem-wide vocabulary:
+
+  mask[B, K, V]  bool  which vocab values the requirement admits — each
+                       entity's own bounds are already folded in host-side
+                       (mask[b,k,v] == req.has(vocab_value))
+  inf[B, K]      bool  complement bit: admits values OUTSIDE the vocab
+  excl[B, K]     bool  complement has a non-empty exclusion set (NotIn-ness;
+                       distinguishes NotIn from Exists for leniency rules)
+  gte/lte[B, K]  int32 inclusive bounds with sentinels; only consulted for
+                       complement×complement intersections (all finite cases
+                       are fully captured by the masks)
+  defined[B, K]  bool  whether the entity constrains this key at all
+
+Undefined keys are encoded as the identity element of intersection
+(mask=all-ones, inf=1, excl=0, bounds=sentinels, defined=0), which makes
+"missing key reads as Exists" (requirements.go:160-166) automatic.
+
+Because the vocab is built from EVERY value mentioned anywhere in the
+problem (pods, instance types, templates, offerings, existing nodes), set
+emptiness over the vocab is exact: In-sets can never have admissible values
+the masks don't see. The only out-of-vocab freedom is the complement
+"infinite remainder", captured by `inf` + the bounds.
+
+Key algebraic facts the kernels rely on (golden-tested against the Python
+oracle in tests/test_encode.py):
+
+  nonempty(A ∩ B) = any(A.mask & B.mask) | (A.inf & B.inf & bounds_overlap)
+  encode(A ∩ B)   = (A.mask & B.mask, A.inf & B.inf, A.excl | B.excl,
+                     max(gte), min(lte), A.defined | B.defined)
+  lenient(A)      = defined & ((inf & excl) | (~inf & ~any(mask)))
+                    — operator ∈ {NotIn, DoesNotExist}
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from karpenter_tpu.cloudprovider.instancetype import InstanceType
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.scheduling import Requirements
+from karpenter_tpu.utils import resources as res
+
+INT_MIN = -(2**31) + 1
+INT_MAX = 2**31 - 1
+
+# Canonical resource axis prefix; extended resources appended per problem.
+BASE_RESOURCES = (res.CPU, res.MEMORY, res.PODS, res.EPHEMERAL_STORAGE)
+
+
+class Vocab:
+    """Per-key value vocabulary for one problem instance."""
+
+    def __init__(self) -> None:
+        self.keys: list[str] = []
+        self.key_to_id: dict[str, int] = {}
+        self.values: list[list[str]] = []  # per key
+        self.value_to_id: list[dict[str, int]] = []
+
+    def add_key(self, key: str) -> int:
+        kid = self.key_to_id.get(key)
+        if kid is None:
+            kid = len(self.keys)
+            self.key_to_id[key] = kid
+            self.keys.append(key)
+            self.values.append([])
+            self.value_to_id.append({})
+        return kid
+
+    def add_value(self, key: str, value: str) -> int:
+        kid = self.add_key(key)
+        vid = self.value_to_id[kid].get(value)
+        if vid is None:
+            vid = len(self.values[kid])
+            self.value_to_id[kid][value] = vid
+            self.values[kid].append(value)
+        return vid
+
+    def observe(self, reqs: Requirements) -> None:
+        for r in reqs:
+            self.add_key(r.key)
+            for v in r.values:
+                self.add_value(r.key, v)
+
+    @property
+    def n_keys(self) -> int:
+        return len(self.keys)
+
+    @property
+    def max_values(self) -> int:
+        return max((len(v) for v in self.values), default=0)
+
+    def well_known_mask(self) -> np.ndarray:
+        return np.array([k in l.WELL_KNOWN_LABELS for k in self.keys], dtype=bool)
+
+
+class ReqSetTensors(NamedTuple):
+    """A batch of encoded requirement sets; leading axis is the batch."""
+
+    mask: jnp.ndarray  # [B, K, V] bool
+    inf: jnp.ndarray  # [B, K] bool
+    excl: jnp.ndarray  # [B, K] bool
+    gte: jnp.ndarray  # [B, K] int32
+    lte: jnp.ndarray  # [B, K] int32
+    defined: jnp.ndarray  # [B, K] bool
+
+    @property
+    def batch(self) -> int:
+        return self.mask.shape[0]
+
+
+def encode_requirements(
+    vocab: Vocab, req_sets: Sequence[Requirements], k_pad: Optional[int] = None, v_pad: Optional[int] = None
+) -> ReqSetTensors:
+    """Encode requirement sets against an already-built vocab.
+
+    Every value referenced by req_sets must already be in the vocab
+    (call vocab.observe first); unknown keys raise.
+    """
+    B = len(req_sets)
+    K = k_pad or max(vocab.n_keys, 1)
+    V = v_pad or max(vocab.max_values, 1)
+    mask = np.ones((B, K, V), dtype=bool)
+    inf = np.ones((B, K), dtype=bool)
+    excl = np.zeros((B, K), dtype=bool)
+    gte = np.full((B, K), INT_MIN, dtype=np.int32)
+    lte = np.full((B, K), INT_MAX, dtype=np.int32)
+    defined = np.zeros((B, K), dtype=bool)
+    # padding key slots beyond the vocab stay at the identity encoding
+    for b, reqs in enumerate(req_sets):
+        for r in reqs:
+            k = vocab.key_to_id[r.key]
+            vals = vocab.values[k]
+            row = np.zeros(V, dtype=bool)
+            for vid, value in enumerate(vals):
+                row[vid] = r.has(value)
+            # vocab slots beyond this key's value count are not real values
+            mask[b, k] = row
+            inf[b, k] = r.complement
+            excl[b, k] = r.complement and bool(r.values)
+            # saturating clamp to int32 on both sides
+            gte[b, k] = min(max(r.gte, INT_MIN), INT_MAX) if r.gte is not None else INT_MIN
+            lte[b, k] = min(max(r.lte, INT_MIN), INT_MAX) if r.lte is not None else INT_MAX
+            defined[b, k] = True
+    return ReqSetTensors(
+        mask=jnp.asarray(mask),
+        inf=jnp.asarray(inf),
+        excl=jnp.asarray(excl),
+        gte=jnp.asarray(gte),
+        lte=jnp.asarray(lte),
+        defined=jnp.asarray(defined),
+    )
+
+
+class InstanceTypeTensors(NamedTuple):
+    """Dense instance-type catalog.
+
+    GR is the allocatable-override group axis (types.go:196-334): group 0 is
+    the base allocatable; extra groups come from offerings with capacity /
+    overhead overrides. Padded groups have alloc=-inf so nothing fits them.
+    """
+
+    reqs: ReqSetTensors  # [T, K, V]
+    alloc: jnp.ndarray  # [T, GR, R] f32
+    group_valid: jnp.ndarray  # [T, GR] bool
+    zc_avail: jnp.ndarray  # [T, GR, Z, C] bool — available offering exists in (zone, ct)
+    price_zc: jnp.ndarray  # [T, Z, C] f32 — min available price, +inf when none
+    valid: jnp.ndarray  # [T] bool — real (non-padding) instance type
+
+    @property
+    def n_types(self) -> int:
+        return self.alloc.shape[0]
+
+
+class PodTensors(NamedTuple):
+    reqs: ReqSetTensors  # [P, K, V] (preferences folded in per reference semantics)
+    strict_reqs: ReqSetTensors  # [P, K, V] required-only (for relaxation)
+    requests: jnp.ndarray  # [P, R] f32 (includes pods=1)
+    valid: jnp.ndarray  # [P] bool
+
+    @property
+    def n_pods(self) -> int:
+        return self.requests.shape[0]
+
+
+class ProblemEncoder:
+    """Builds the vocab + resource axis, then encodes entities.
+
+    Usage: construct, observe() everything, then encode_* — the vocab is
+    frozen by the first encode call.
+    """
+
+    def __init__(self) -> None:
+        self.vocab = Vocab()
+        self.resource_names: list[str] = list(BASE_RESOURCES)
+        self._resource_ids: dict[str, int] = {n: i for i, n in enumerate(self.resource_names)}
+        # zone / capacity-type key ids for offering encoding
+        self.vocab.add_key(l.LABEL_TOPOLOGY_ZONE)
+        self.vocab.add_key(l.CAPACITY_TYPE_LABEL_KEY)
+
+    # -- observation -------------------------------------------------------
+
+    def observe_resources(self, rl: dict[str, float]) -> None:
+        for name in rl:
+            if name not in self._resource_ids:
+                self._resource_ids[name] = len(self.resource_names)
+                self.resource_names.append(name)
+
+    def observe_requirements(self, reqs: Requirements) -> None:
+        self.vocab.observe(reqs)
+
+    def observe_pod(self, pod: Pod) -> None:
+        self.vocab.observe(Requirements.from_pod(pod))
+        self.observe_resources(pod.total_requests())
+
+    def observe_instance_type(self, it: InstanceType) -> None:
+        self.vocab.observe(it.requirements)
+        self.observe_resources(it.capacity)
+        for o in it.offerings:
+            self.vocab.observe(o.requirements)
+            self.observe_resources(o.capacity_override)
+
+    # -- encoding ----------------------------------------------------------
+
+    @property
+    def n_resources(self) -> int:
+        return len(self.resource_names)
+
+    def resources_vector(self, rl: dict[str, float]) -> np.ndarray:
+        out = np.zeros(self.n_resources, dtype=np.float32)
+        for name, v in rl.items():
+            out[self._resource_ids[name]] = v
+        return out
+
+    def encode_requirements(self, req_sets: Sequence[Requirements]) -> ReqSetTensors:
+        return encode_requirements(self.vocab, req_sets)
+
+    def encode_pods(self, pods: Sequence[Pod]) -> PodTensors:
+        reqs = self.encode_requirements([Requirements.from_pod(p) for p in pods])
+        strict = self.encode_requirements(
+            [Requirements.from_pod(p, include_preferred=False) for p in pods]
+        )
+        requests = np.stack(
+            [self.resources_vector(p.total_requests()) for p in pods]
+        ) if pods else np.zeros((0, self.n_resources), dtype=np.float32)
+        return PodTensors(
+            reqs=reqs,
+            strict_reqs=strict,
+            requests=jnp.asarray(requests, dtype=jnp.float32),
+            valid=jnp.ones(len(pods), dtype=bool),
+        )
+
+    def encode_instance_types(self, its: Sequence[InstanceType]) -> InstanceTypeTensors:
+        T = len(its)
+        zone_kid = self.vocab.key_to_id[l.LABEL_TOPOLOGY_ZONE]
+        ct_kid = self.vocab.key_to_id[l.CAPACITY_TYPE_LABEL_KEY]
+        Z = max(len(self.vocab.values[zone_kid]), 1)
+        C = max(len(self.vocab.values[ct_kid]), 1)
+        GR = max((len(it.allocatable_offerings()) for it in its), default=1)
+        R = self.n_resources
+
+        reqs = self.encode_requirements([it.requirements for it in its])
+        alloc = np.full((T, GR, R), -np.inf, dtype=np.float32)
+        group_valid = np.zeros((T, GR), dtype=bool)
+        zc_avail = np.zeros((T, GR, Z, C), dtype=bool)
+        price_zc = np.full((T, Z, C), np.inf, dtype=np.float32)
+
+        zone_values = self.vocab.values[zone_kid]
+        ct_values = self.vocab.values[ct_kid]
+        for t, it in enumerate(its):
+            for g, group in enumerate(it.allocatable_offerings()):
+                alloc[t, g] = self.resources_vector(group.allocatable)
+                group_valid[t, g] = True
+                for o in group.offerings:  # already available-filtered
+                    # An offering admits every (zone, ct) its requirements
+                    # allow: a missing key reads as Exists (all values), and
+                    # multi-value requirements mark multiple cells.
+                    zreq = o.requirements.get(l.LABEL_TOPOLOGY_ZONE)
+                    creq = o.requirements.get(l.CAPACITY_TYPE_LABEL_KEY)
+                    zs = [z for z, v in enumerate(zone_values) if zreq.has(v)]
+                    cs = [c for c, v in enumerate(ct_values) if creq.has(v)]
+                    # empty vocab for a key means no entity constrains it:
+                    # mark the padding column, which unconstrained claim
+                    # masks (all-ones) always admit
+                    if not zone_values and zreq.complement:
+                        zs = [0]
+                    if not ct_values and creq.complement:
+                        cs = [0]
+                    for z in zs:
+                        for c in cs:
+                            zc_avail[t, g, z, c] = True
+                            price_zc[t, z, c] = min(price_zc[t, z, c], o.price)
+        return InstanceTypeTensors(
+            reqs=reqs,
+            alloc=jnp.asarray(alloc),
+            group_valid=jnp.asarray(group_valid),
+            zc_avail=jnp.asarray(zc_avail),
+            price_zc=jnp.asarray(price_zc),
+            valid=jnp.ones(T, dtype=bool),
+        )
+
+    def zone_ct_key_ids(self) -> tuple[int, int]:
+        return (
+            self.vocab.key_to_id[l.LABEL_TOPOLOGY_ZONE],
+            self.vocab.key_to_id[l.CAPACITY_TYPE_LABEL_KEY],
+        )

@@ -1,0 +1,180 @@
+"""Batched requirement-set algebra kernels.
+
+Each kernel is pure tensor algebra over ReqSetTensors batches, shaped for
+XLA fusion on TPU: boolean masks ride the VPU, reductions over the vocab
+axis fuse into the surrounding ops, and all shapes are static.
+
+Semantics are golden-tested against the Python oracle
+(karpenter_tpu/scheduling/requirements.py) in tests/test_encode.py:
+
+  has_intersection  <->  Requirement.has_intersection   (requirement.go:220)
+  intersects        <->  Requirements.Intersects        (requirements.go:254)
+  compatible        <->  Requirements.Compatible        (requirements.go:181)
+  intersect_sets    <->  Requirements.Add               (requirements.go:133)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from karpenter_tpu.ops.encode import ReqSetTensors
+
+
+def lenient(r: ReqSetTensors) -> jnp.ndarray:
+    """[B, K] bool — operator ∈ {NotIn, DoesNotExist}.
+
+    NotIn       = complement with non-empty exclusions (inf & excl)
+    DoesNotExist= concrete empty set (~inf & no admissible vocab value)
+    """
+    any_mask = jnp.any(r.mask, axis=-1)
+    return r.defined & ((r.inf & r.excl) | (~r.inf & ~any_mask))
+
+
+def _pairwise(a: jnp.ndarray, b: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Broadcast [A, ...] and [B, ...] to [A, B, ...]."""
+    return a[:, None], b[None, :]
+
+
+def has_intersection_keys(a: ReqSetTensors, b: ReqSetTensors) -> jnp.ndarray:
+    """[A, B, K] bool — per-key non-empty intersection.
+
+    nonempty(A∩B) = any(maskA & maskB)
+                  | (infA & infB & max(gte) <= min(lte))
+    The finite cases need no bounds check: each side's mask already folds in
+    its own bounds, and a value admitted by both satisfies both bounds.
+    """
+    mask_a, mask_b = _pairwise(a.mask, b.mask)
+    hit = jnp.any(mask_a & mask_b, axis=-1)  # [A, B, K]
+    inf_a, inf_b = _pairwise(a.inf, b.inf)
+    gte = jnp.maximum(*_pairwise(a.gte, b.gte))
+    lte = jnp.minimum(*_pairwise(a.lte, b.lte))
+    return hit | (inf_a & inf_b & (gte <= lte))
+
+
+def intersects(a: ReqSetTensors, b: ReqSetTensors) -> jnp.ndarray:
+    """[A, B] bool — all shared keys intersect (requirements.go:254-274).
+
+    A failed per-key intersection is forgiven when BOTH operators are in
+    {NotIn, DoesNotExist}.
+    """
+    shared = jnp.logical_and(*_pairwise(a.defined, b.defined))  # [A, B, K]
+    both_lenient = jnp.logical_and(*_pairwise(lenient(a), lenient(b)))
+    ok = ~shared | has_intersection_keys(a, b) | both_lenient
+    return jnp.all(ok, axis=-1)
+
+
+def compatible(r: ReqSetTensors, q: ReqSetTensors, well_known: jnp.ndarray) -> jnp.ndarray:
+    """[A, B] bool — r (node side) can loosely meet q (incoming pod side).
+
+    Custom (non-well-known) keys of q must be defined on r unless q's
+    operator is lenient; then all shared keys must intersect
+    (requirements.go:181-197).
+    """
+    q_defined = q.defined[None, :]  # [1, B, K]
+    r_defined = r.defined[:, None]  # [A, 1, K]
+    q_lenient = lenient(q)[None, :]
+    custom_ok = ~q_defined | well_known[None, None, :] | r_defined | q_lenient
+    return jnp.all(custom_ok, axis=-1) & intersects(r, q)
+
+
+def has_intersection_keys_elemwise(a: ReqSetTensors, b: ReqSetTensors) -> jnp.ndarray:
+    """[B, K] bool — per-key non-empty intersection over a shared batch."""
+    hit = jnp.any(a.mask & b.mask, axis=-1)
+    gte = jnp.maximum(a.gte, b.gte)
+    lte = jnp.minimum(a.lte, b.lte)
+    return hit | (a.inf & b.inf & (gte <= lte))
+
+
+def intersects_elemwise(a: ReqSetTensors, b: ReqSetTensors) -> jnp.ndarray:
+    """[B] bool — intersects() over aligned batches (no pairwise blowup)."""
+    shared = a.defined & b.defined
+    both_lenient = lenient(a) & lenient(b)
+    ok = ~shared | has_intersection_keys_elemwise(a, b) | both_lenient
+    return jnp.all(ok, axis=-1)
+
+
+def compatible_elemwise(a: ReqSetTensors, b: ReqSetTensors, well_known: jnp.ndarray) -> jnp.ndarray:
+    """[B] bool — compatible() over aligned batches (a=node side, b=incoming)."""
+    custom_ok = ~b.defined | well_known[None, :] | a.defined | lenient(b)
+    return jnp.all(custom_ok, axis=-1) & intersects_elemwise(a, b)
+
+
+def intersect_sets(a: ReqSetTensors, b: ReqSetTensors) -> ReqSetTensors:
+    """Elementwise requirement-set intersection over a shared batch shape.
+
+    The encoding of A∩B: masks AND (own-bounds folded in), complement AND,
+    exclusions OR, bounds tighten, defined OR. Cross-bounds filtering of
+    finite values is implicit: a vocab value survives only if admitted by
+    both masks, hence by both bounds (a value in both masks satisfies both
+    sides' own bounds, so it satisfies the tightened bounds).
+
+    Canonicalization mirrors requirement.go:186-213: complement∩complement
+    with empty bounds (gte > lte) collapses to concrete DoesNotExist (the
+    mask-AND is already empty in that case — see above — so only the
+    complement bit needs clearing), and concrete results carry no bounds or
+    exclusions. This keeps the derived leniency bit exact.
+    """
+    from karpenter_tpu.ops.encode import INT_MAX, INT_MIN
+
+    inf0 = a.inf & b.inf
+    gte0 = jnp.maximum(a.gte, b.gte)
+    lte0 = jnp.minimum(a.lte, b.lte)
+    inf = inf0 & (gte0 <= lte0)
+    return ReqSetTensors(
+        mask=a.mask & b.mask,
+        inf=inf,
+        excl=(a.excl | b.excl) & inf,
+        gte=jnp.where(inf, gte0, INT_MIN),
+        lte=jnp.where(inf, lte0, INT_MAX),
+        defined=a.defined | b.defined,
+    )
+
+
+def select_set(pred: jnp.ndarray, a: ReqSetTensors, b: ReqSetTensors) -> ReqSetTensors:
+    """where(pred, a, b) over every component; pred broadcasts from [B]."""
+    def w(x, y):
+        p = pred.reshape(pred.shape + (1,) * (x.ndim - pred.ndim))
+        return jnp.where(p, x, y)
+
+    return ReqSetTensors(
+        mask=w(a.mask, b.mask),
+        inf=w(a.inf, b.inf),
+        excl=w(a.excl, b.excl),
+        gte=w(a.gte, b.gte),
+        lte=w(a.lte, b.lte),
+        defined=w(a.defined, b.defined),
+    )
+
+
+def take_set(r: ReqSetTensors, idx) -> ReqSetTensors:
+    """Index the batch axis (static or traced index)."""
+    return ReqSetTensors(
+        mask=r.mask[idx],
+        inf=r.inf[idx],
+        excl=r.excl[idx],
+        gte=r.gte[idx],
+        lte=r.lte[idx],
+        defined=r.defined[idx],
+    )
+
+
+def update_set_at(r: ReqSetTensors, idx, value: ReqSetTensors) -> ReqSetTensors:
+    """Functional batch-element update (for scan carries)."""
+    return ReqSetTensors(
+        mask=r.mask.at[idx].set(value.mask),
+        inf=r.inf.at[idx].set(value.inf),
+        excl=r.excl.at[idx].set(value.excl),
+        gte=r.gte.at[idx].set(value.gte),
+        lte=r.lte.at[idx].set(value.lte),
+        defined=r.defined.at[idx].set(value.defined),
+    )
+
+
+def value_allowed(r: ReqSetTensors, key_id: int, value_ids: jnp.ndarray) -> jnp.ndarray:
+    """[B, ...] bool — does each set admit vocab value value_ids of key_id?
+
+    Used for offering checks: claim's zone/capacity-type mask indexed by the
+    offering's zone/ct vocab ids. Values are always in-vocab by
+    construction, so `inf` freedom never applies.
+    """
+    return r.mask[..., key_id, :][..., value_ids]

@@ -1,0 +1,240 @@
+"""The TPU scheduling solver: batched feasibility masks + scan-FFD packing.
+
+This replaces the reference's Scheduler.Solve hot loop
+(pkg/controllers/provisioning/scheduling/scheduler.go:440,
+nodeclaim.go:124-242, nodeclaim.go:541). Reformulation:
+
+  * Pods are pre-sorted first-fit-decreasing host-side (queue.go:72-90).
+  * One `lax.scan` step places one pod. The carry holds every in-flight
+    simulated NodeClaim as dense state: combined requirement tensors
+    [N, K, V], resource usage [N, R], and the boolean set of still-viable
+    instance types [N, T].
+  * The per-(claim, instance-type) triple mask — requirements-intersect ×
+    resource-fits × offering-available (nodeclaim.go:541's compat/fits/
+    hasOffering) — is computed for ALL claims and instance types at once on
+    the VPU/MXU instead of the reference's goroutine fan-out.
+  * Claim selection mirrors the reference's ordering exactly: in-flight
+    claims sorted fewest-pods-first with earliest-index tie-break
+    (scheduler.go:598-599), via a single argmin over (pod_count, slot).
+  * If no in-flight claim fits, a new claim opens from the highest-priority
+    (weight-ordered) compatible template (scheduler.go:695+), or the pod is
+    marked unschedulable.
+
+The solver is pure and stateless per call (SURVEY.md §5 checkpoint/resume:
+problem tensors are rebuilt from cluster state each cycle). All problem
+tensors are jit ARGUMENTS, not closure constants, so re-encoding the
+problem (e.g. after vocab growth) reuses the compiled executable whenever
+shapes are unchanged; callers pad pods/keys/vocab to bucketed sizes to
+keep shapes stable.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from karpenter_tpu.ops import kernels
+from karpenter_tpu.ops.encode import InstanceTypeTensors, PodTensors, ReqSetTensors
+
+# assignment sentinels
+NO_CLAIM = -1  # no compatible in-flight claim or template
+NO_ROOM = -2  # a template was feasible but the claim-slot capacity is full
+BIG = jnp.int32(2**31 - 1)
+
+
+class Templates(NamedTuple):
+    """NodeClaim templates in weight-priority order (index 0 = first try)."""
+
+    reqs: ReqSetTensors  # [G, K, V]
+    its: jnp.ndarray  # [G, T] bool — statically compatible instance types
+    daemon_requests: jnp.ndarray  # [G, R] f32 — daemonset overhead per template
+    valid: jnp.ndarray  # [G] bool
+
+
+class ClaimsState(NamedTuple):
+    """The scan carry: all in-flight simulated NodeClaims."""
+
+    reqs: ReqSetTensors  # [N, K, V]
+    used: jnp.ndarray  # [N, R] f32 — pod requests incl. daemon overhead
+    its: jnp.ndarray  # [N, T] bool — viable instance types
+    template: jnp.ndarray  # [N] int32
+    open: jnp.ndarray  # [N] bool
+    pods: jnp.ndarray  # [N] int32
+    n_open: jnp.ndarray  # [] int32
+
+
+class SolveResult(NamedTuple):
+    assignment: jnp.ndarray  # [P] int32 — claim slot, NO_CLAIM or NO_ROOM
+    claims: ClaimsState
+
+
+def _fits_and_offering(
+    total: jnp.ndarray,  # [N, R] requested totals per claim
+    comb: ReqSetTensors,  # [N, K, V] combined claim∩pod requirements
+    it: InstanceTypeTensors,
+    zone_kid: int,
+    ct_kid: int,
+) -> jnp.ndarray:
+    """[N, T] bool — exists an allocatable group where resources fit AND a
+    compatible offering is available (nodeclaim.go:630-652 fits()).
+
+    Offering compatibility reduces to: the claim's zone mask admits the
+    offering zone and its capacity-type mask admits the offering ct — both
+    well-known keys whose values are always in-vocab.
+    """
+    # fits per group: [N, T, GR]. Resources with zero requested always pass,
+    # matching the host's "only check requested keys" (resources.fits) even
+    # when an allocatable entry is negative (overhead exceeding capacity).
+    t = total[:, None, None, :]
+    fit = jnp.all((t <= it.alloc[None, :, :, :]) | (t == 0.0), axis=-1)
+    fit = fit & it.group_valid[None, :, :]
+    # offering availability per group: [N, T, GR]
+    zmask = comb.mask[:, zone_kid, :]  # [N, V] — admitted zones
+    cmask = comb.mask[:, ct_kid, :]  # [N, V]
+    Z = it.zc_avail.shape[2]
+    C = it.zc_avail.shape[3]
+    off = jnp.einsum(
+        "tgzc,nz,nc->ntg",
+        it.zc_avail,
+        zmask[:, :Z],
+        cmask[:, :C],
+        preferred_element_type=jnp.float32,
+    ) > 0
+    return jnp.any(fit & off, axis=-1)  # [N, T]
+
+
+def _broadcast_pod(pod: ReqSetTensors, n: int) -> ReqSetTensors:
+    return ReqSetTensors(
+        mask=jnp.broadcast_to(pod.mask[None], (n,) + pod.mask.shape),
+        inf=jnp.broadcast_to(pod.inf[None], (n,) + pod.inf.shape),
+        excl=jnp.broadcast_to(pod.excl[None], (n,) + pod.excl.shape),
+        gte=jnp.broadcast_to(pod.gte[None], (n,) + pod.gte.shape),
+        lte=jnp.broadcast_to(pod.lte[None], (n,) + pod.lte.shape),
+        defined=jnp.broadcast_to(pod.defined[None], (n,) + pod.defined.shape),
+    )
+
+
+def _init_claims(n: int, k: int, v: int, r: int, t: int) -> ClaimsState:
+    identity = ReqSetTensors(
+        mask=jnp.ones((n, k, v), dtype=bool),
+        inf=jnp.ones((n, k), dtype=bool),
+        excl=jnp.zeros((n, k), dtype=bool),
+        gte=jnp.full((n, k), -(2**31) + 1, dtype=jnp.int32),
+        lte=jnp.full((n, k), 2**31 - 1, dtype=jnp.int32),
+        defined=jnp.zeros((n, k), dtype=bool),
+    )
+    return ClaimsState(
+        reqs=identity,
+        used=jnp.zeros((n, r), dtype=jnp.float32),
+        its=jnp.zeros((n, t), dtype=bool),
+        template=jnp.zeros(n, dtype=jnp.int32),
+        open=jnp.zeros(n, dtype=bool),
+        pods=jnp.zeros(n, dtype=jnp.int32),
+        n_open=jnp.int32(0),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("zone_kid", "ct_kid", "n_claims"))
+def solve(
+    pods: PodTensors,
+    pod_tol: jnp.ndarray,  # [P, G] bool
+    it: InstanceTypeTensors,
+    templates: Templates,
+    well_known: jnp.ndarray,  # [K] bool
+    zone_kid: int,
+    ct_kid: int,
+    n_claims: int,
+) -> SolveResult:
+    N = n_claims
+    K = it.reqs.mask.shape[1]
+    V = it.reqs.mask.shape[2]
+    R = it.alloc.shape[2]
+    T = it.alloc.shape[0]
+
+    def step(state: ClaimsState, xs):
+        pod_reqs, pod_requests, tol_g, pod_valid = xs
+
+        pod_b = _broadcast_pod(pod_reqs, N)
+        comb = kernels.intersect_sets(state.reqs, pod_b)  # [N, K, V]
+
+        # claim-level requirement compat (nodeclaim.go:147):
+        # claim.reqs.Compatible(pod.reqs, AllowUndefinedWellKnownLabels)
+        claim_ok = kernels.compatible_elemwise(state.reqs, pod_b, well_known)  # [N]
+
+        # instance-type triple mask against the NEW combined requirements
+        it_compat = kernels.intersects(it.reqs, comb).T  # [N, T]
+        total = state.used + pod_requests[None, :]
+        fits_off = _fits_and_offering(total, comb, it, zone_kid, ct_kid)
+        new_its = state.its & it_compat & fits_off  # [N, T]
+
+        tol = tol_g[state.template]  # [N] — tolerates claim's template taints
+        feas = state.open & claim_ok & tol & jnp.any(new_its, axis=-1) & pod_valid
+
+        # fewest-pods-first with earliest-slot tie-break (scheduler.go:598)
+        order_key = state.pods * jnp.int32(N) + jnp.arange(N, dtype=jnp.int32)
+        pick = jnp.argmin(jnp.where(feas, order_key, BIG))
+        found = feas[pick]
+
+        # --- new-claim path: templates in weight order (scheduler.go:695) --
+        G = templates.its.shape[0]
+        pod_g = _broadcast_pod(pod_reqs, G)
+        comb0 = kernels.intersect_sets(templates.reqs, pod_g)
+        tmpl_ok = kernels.compatible_elemwise(templates.reqs, pod_g, well_known)  # [G]
+        it_compat0 = kernels.intersects(it.reqs, comb0).T  # [G, T]
+        total0 = templates.daemon_requests + pod_requests[None, :]
+        fits_off0 = _fits_and_offering(total0, comb0, it, zone_kid, ct_kid)
+        its0 = templates.its & it_compat0 & fits_off0  # [G, T]
+        tmpl_feas = templates.valid & tmpl_ok & tol_g & jnp.any(its0, axis=-1)
+        g = jnp.argmax(tmpl_feas)  # earliest weight-ordered feasible template
+        any_template = jnp.any(tmpl_feas) & pod_valid & ~found
+        can_open = any_template & (state.n_open < N)
+
+        slot = jnp.where(found, pick, state.n_open)
+        place = found | can_open
+        assignment = jnp.where(
+            place,
+            slot.astype(jnp.int32),
+            jnp.where(any_template, jnp.int32(NO_ROOM), jnp.int32(NO_CLAIM)),
+        )
+
+        # merged update values for the chosen slot
+        sel_reqs = kernels.select_set(
+            found,
+            kernels.take_set(comb, pick),
+            kernels.take_set(comb0, g),
+        )
+        sel_its = jnp.where(found, new_its[pick], its0[g])
+        sel_used = jnp.where(
+            found,
+            total[pick],
+            templates.daemon_requests[g] + pod_requests,
+        )
+        sel_template = jnp.where(found, state.template[pick], g.astype(jnp.int32))
+
+        def apply(state: ClaimsState) -> ClaimsState:
+            return ClaimsState(
+                reqs=kernels.update_set_at(state.reqs, slot, sel_reqs),
+                used=state.used.at[slot].set(sel_used),
+                its=state.its.at[slot].set(sel_its),
+                template=state.template.at[slot].set(sel_template),
+                open=state.open.at[slot].set(True),
+                pods=state.pods.at[slot].add(1),
+                n_open=state.n_open + jnp.where(found, 0, 1).astype(jnp.int32),
+            )
+
+        new_state = jax.tree.map(
+            lambda a, b: jnp.where(
+                place.reshape((1,) * a.ndim) if a.ndim else place, a, b
+            ),
+            apply(state),
+            state,
+        )
+        return new_state, assignment
+
+    state = _init_claims(N, K, V, R, T)
+    xs = (pods.reqs, pods.requests, pod_tol, pods.valid)
+    state, assignment = jax.lax.scan(step, state, xs)
+    return SolveResult(assignment=assignment, claims=state)

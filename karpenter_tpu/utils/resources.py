@@ -20,6 +20,8 @@ from __future__ import annotations
 import math
 import re
 
+import numpy as np
+
 # Canonical resource names (mirror corev1 resource names).
 CPU = "cpu"
 MEMORY = "memory"
@@ -34,9 +36,15 @@ _QTY_RE = re.compile(r"^([+-]?[0-9.eE+-]+?)(Ki|Mi|Gi|Ti|Pi|Ei|[numkMGTPE])?$")
 
 
 def parse_quantity(q: "str | int | float") -> float:
-    """Parse a Kubernetes quantity ('100m', '1Gi', 3, '2e3') into a float."""
+    """Parse a Kubernetes quantity ('100m', '1Gi', 3, '2e3') into a float.
+
+    Values are quantized to float32 so host-side resource arithmetic is
+    bit-identical to the device solver's f32 tensors (same inputs, same
+    accumulation order -> same sums, making exact <= comparisons safe on
+    both sides).
+    """
     if isinstance(q, (int, float)):
-        return float(q)
+        return float(np.float32(q))
     s = q.strip()
     m = _QTY_RE.match(s)
     if not m:
@@ -45,19 +53,27 @@ def parse_quantity(q: "str | int | float") -> float:
     value = float(num)
     if suffix:
         value *= _BIN_SUFFIX.get(suffix) or _DEC_SUFFIX[suffix]
-    return value
+    return float(np.float32(value))
 
 
 def parse_resource_list(rl: "dict[str, str | int | float] | None") -> dict[str, float]:
     return {k: parse_quantity(v) for k, v in (rl or {}).items()}
 
 
+def quantize(rl: "dict[str, float] | None") -> dict[str, float]:
+    """Round every value to float32 (the framework-wide resource dtype)."""
+    return {k: float(np.float32(v)) for k, v in (rl or {}).items()}
+
+
 def merge(*lists: "dict[str, float] | None") -> dict[str, float]:
-    """Sum resource lists key-wise (reference Merge semantics)."""
+    """Sum resource lists key-wise (reference Merge semantics).
+
+    Accumulates in float32 to stay bit-identical with the device solver.
+    """
     out: dict[str, float] = {}
     for rl in lists:
         for k, v in (rl or {}).items():
-            out[k] = out.get(k, 0.0) + v
+            out[k] = float(np.float32(np.float32(out.get(k, 0.0)) + np.float32(v)))
     return out
 
 
@@ -65,18 +81,21 @@ def subtract(a: dict[str, float], b: dict[str, float]) -> dict[str, float]:
     """a - b key-wise; keys only in b appear negated (reference Subtract)."""
     out = dict(a)
     for k, v in b.items():
-        out[k] = out.get(k, 0.0) - v
+        out[k] = float(np.float32(np.float32(out.get(k, 0.0)) - np.float32(v)))
     return out
 
 
 def fits(candidate: dict[str, float], total: dict[str, float]) -> bool:
     """True iff every requested resource in candidate is <= total[k].
 
+    Exact comparison: both sides of the framework quantize to float32 and
+    accumulate in the same order, so no epsilon is needed (and using one
+    would diverge from the device solver's exact f32 compare).
+
     A resource requested but absent from total is treated as 0 available
     (so any positive request fails), matching the reference's Fits.
     """
-    eps = 1e-9
-    return all(v <= total.get(k, 0.0) + eps for k, v in candidate.items())
+    return all(v <= total.get(k, 0.0) for k, v in candidate.items())
 
 
 def cmp(a: float, b: float, rel_tol: float = 1e-9) -> int:

@@ -1,0 +1,199 @@
+"""Golden tests: the tensor encoding + kernels must agree with the
+pure-Python oracle (karpenter_tpu.scheduling) on randomized requirement
+sets — the Phase-0 correctness gate for the TPU solver."""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.ops import kernels
+from karpenter_tpu.ops.encode import ProblemEncoder, Vocab, encode_requirements
+from karpenter_tpu.scheduling import Operator, Requirement, Requirements
+
+KEYS = ["zone", "arch", "team", l.LABEL_TOPOLOGY_ZONE, "tier"]
+VALUES = ["a", "b", "c", "1", "5", "17", "x"]
+
+
+OPS = [
+    Operator.IN,
+    Operator.NOT_IN,
+    Operator.EXISTS,
+    Operator.DOES_NOT_EXIST,
+    Operator.GT,
+    Operator.LT,
+    Operator.GTE,
+    Operator.LTE,
+]
+
+
+def random_requirement(rng, key) -> Requirement:
+    op = OPS[int(rng.integers(0, len(OPS)))]
+    if op in (Operator.GT, Operator.LT, Operator.GTE, Operator.LTE):
+        return Requirement.new(key, op, str(rng.integers(0, 20)))
+    if op in (Operator.EXISTS, Operator.DOES_NOT_EXIST):
+        return Requirement.new(key, op)
+    n = int(rng.integers(1, 4))
+    vals = [str(v) for v in rng.choice(VALUES, size=n, replace=False)]
+    return Requirement.new(key, op, *vals)
+
+
+def random_requirements(rng) -> Requirements:
+    n_keys = int(rng.integers(0, len(KEYS) + 1))
+    keys = list(rng.choice(KEYS, size=n_keys, replace=False))
+    out = Requirements()
+    for k in keys:
+        out.add(random_requirement(rng, k))
+        if rng.random() < 0.3:  # occasionally intersect two reqs on one key
+            out.add(random_requirement(rng, k))
+    return out
+
+
+@pytest.fixture(scope="module")
+def req_batch():
+    rng = np.random.default_rng(42)
+    sets = [random_requirements(rng) for _ in range(40)]
+    vocab = Vocab()
+    for s in sets:
+        vocab.observe(s)
+    # ensure every key exists in vocab even if only bounds-ops hit it
+    for k in KEYS:
+        vocab.add_key(k)
+        for v in VALUES:
+            vocab.add_value(k, v)
+    enc = encode_requirements(vocab, sets)
+    return sets, vocab, enc
+
+
+class TestGoldenKernels:
+    def test_mask_matches_has(self, req_batch):
+        sets, vocab, enc = req_batch
+        mask = np.asarray(enc.mask)
+        for b, s in enumerate(sets):
+            for r in s:
+                k = vocab.key_to_id[r.key]
+                for vid, val in enumerate(vocab.values[k]):
+                    assert mask[b, k, vid] == r.has(val), (r, val)
+
+    def test_intersects_golden(self, req_batch):
+        sets, vocab, enc = req_batch
+        got = np.asarray(kernels.intersects(enc, enc))
+        for i, a in enumerate(sets):
+            for j, b in enumerate(sets):
+                want = a.intersects(b) is None
+                assert got[i, j] == want, f"{i} vs {j}: {a} || {b}"
+
+    def test_compatible_golden(self, req_batch):
+        sets, vocab, enc = req_batch
+        wk = vocab.well_known_mask()
+        got = np.asarray(kernels.compatible(enc, enc, wk))
+        for i, a in enumerate(sets):
+            for j, b in enumerate(sets):
+                want = a.is_compatible(b, allow_undefined=l.WELL_KNOWN_LABELS)
+                assert got[i, j] == want, f"{i} vs {j}: {a} || {b}"
+
+    def test_lenient_golden(self, req_batch):
+        sets, vocab, enc = req_batch
+        got = np.asarray(kernels.lenient(enc))
+        for b, s in enumerate(sets):
+            for r in s:
+                k = vocab.key_to_id[r.key]
+                assert got[b, k] == r.is_lenient(), r
+
+    def test_intersect_sets_golden(self, req_batch):
+        """encode(A.add(B)) must behave identically to
+        intersect_sets(encode(A), encode(B))."""
+        sets, vocab, enc = req_batch
+        import karpenter_tpu.ops.kernels as K
+
+        n = len(sets)
+        perm = list(range(1, n)) + [0]
+        b_enc = kernels.take_set(enc, np.array(perm))
+        combined = kernels.intersect_sets(enc, b_enc)
+        for i in range(n):
+            a, b = sets[i], sets[perm[i]]
+            host = a.copy()
+            host.add(*b.values())
+            host_enc = encode_requirements(vocab, [host])
+            got = kernels.take_set(combined, i)
+            assert np.array_equal(np.asarray(got.mask), np.asarray(host_enc.mask[0])), (a, b)
+            assert np.array_equal(np.asarray(got.defined), np.asarray(host_enc.defined[0]))
+            assert np.array_equal(np.asarray(got.inf), np.asarray(host_enc.inf[0]))
+            # bounds/excl only observable when inf; compare gated
+            inf = np.asarray(got.inf)
+            assert np.array_equal(np.asarray(got.excl) & inf, np.asarray(host_enc.excl[0]) & inf)
+            assert np.array_equal(
+                np.where(inf, np.asarray(got.gte), 0), np.where(inf, np.asarray(host_enc.gte[0]), 0)
+            )
+            assert np.array_equal(
+                np.where(inf, np.asarray(got.lte), 0), np.where(inf, np.asarray(host_enc.lte[0]), 0)
+            )
+            # and the derived leniency agrees
+            got_len = np.asarray(K.lenient(kernels.take_set(combined, np.array([i]))))[0]
+            want_len = np.asarray(K.lenient(host_enc))[0]
+            assert np.array_equal(got_len, want_len)
+
+
+class TestEncoder:
+    def test_pod_encoding(self):
+        from karpenter_tpu.models.pod import make_pod
+
+        enc = ProblemEncoder()
+        pods = [
+            make_pod("a", cpu=1, memory="1Gi", node_selector={l.LABEL_TOPOLOGY_ZONE: "z1"}),
+            make_pod("b", cpu=2, memory="2Gi"),
+        ]
+        for p in pods:
+            enc.observe_pod(p)
+        pt = enc.encode_pods(pods)
+        assert pt.requests.shape[0] == 2
+        # cpu column
+        cpu_id = enc.resource_names.index("cpu")
+        assert pt.requests[0, cpu_id] == 1.0
+        assert pt.requests[1, cpu_id] == 2.0
+        pods_id = enc.resource_names.index("pods")
+        assert pt.requests[0, pods_id] == 1.0
+        # zone requirement encoded
+        zk = enc.vocab.key_to_id[l.LABEL_TOPOLOGY_ZONE]
+        assert bool(np.asarray(pt.reqs.defined)[0, zk])
+        assert not bool(np.asarray(pt.reqs.defined)[1, zk])
+
+    def test_instance_type_encoding(self):
+        from karpenter_tpu.cloudprovider.fake import instance_types
+
+        its = instance_types(8)
+        enc = ProblemEncoder()
+        for it in its:
+            enc.observe_instance_type(it)
+        itt = enc.encode_instance_types(its)
+        assert itt.n_types == 8
+        assert bool(np.asarray(itt.valid).all())
+        # every type has exactly one allocatable group, available in 4 zones × 2 cts
+        zc = np.asarray(itt.zc_avail)
+        assert zc.shape[1] == 1
+        assert int(zc[0, 0].sum()) == 8
+        # price matrix finite where available
+        prices = np.asarray(itt.price_zc)
+        assert np.isfinite(prices[zc[:, 0]]).all()
+        # allocatable below capacity (overhead subtracted)
+        cpu_id = enc.resource_names.index("cpu")
+        alloc = np.asarray(itt.alloc)
+        for t, it in enumerate(its):
+            assert alloc[t, 0, cpu_id] < it.capacity["cpu"]
+            assert alloc[t, 0, cpu_id] == pytest.approx(it.allocatable()["cpu"], rel=1e-5)
+
+    def test_offering_value_allowed(self):
+        from karpenter_tpu.cloudprovider.fake import instance_types
+        from karpenter_tpu.models.pod import make_pod
+
+        its = instance_types(4)
+        pod = make_pod("p", node_selector={l.LABEL_TOPOLOGY_ZONE: "test-zone-2"})
+        enc = ProblemEncoder()
+        for it in its:
+            enc.observe_instance_type(it)
+        enc.observe_pod(pod)
+        pt = enc.encode_pods([pod])
+        zone_kid, _ = enc.zone_ct_key_ids()
+        z2 = enc.vocab.value_to_id[zone_kid]["test-zone-2"]
+        z1 = enc.vocab.value_to_id[zone_kid]["test-zone-1"]
+        allowed = np.asarray(kernels.value_allowed(pt.reqs, zone_kid, np.array([z1, z2])))
+        assert not allowed[0, 0] and allowed[0, 1]

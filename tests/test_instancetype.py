@@ -1,0 +1,225 @@
+"""InstanceType/Offering semantics (behavioral parity with reference
+pkg/cloudprovider/types.go)."""
+
+from karpenter_tpu.cloudprovider import (
+    InstanceType,
+    InstanceTypeOverhead,
+    Offering,
+    order_by_price,
+    satisfies_min_values,
+    truncate_instance_types,
+    worst_launch_price,
+)
+from karpenter_tpu.cloudprovider.fake import (
+    FakeCloudProvider,
+    instance_types,
+    new_instance_type,
+)
+from karpenter_tpu.cloudprovider.instancetype import adjusted_price
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodeclaim import NodeClaim, NodeClaimSpec
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.scheduling import Operator, Requirement, Requirements
+from karpenter_tpu.utils import resources as res
+import pytest
+
+
+def make_it(name="it-1", price=1.0, zone="z1", ct=l.CAPACITY_TYPE_ON_DEMAND, cpu=4.0, **kw):
+    return InstanceType(
+        name=name,
+        requirements=Requirements(
+            Requirement.new(l.LABEL_INSTANCE_TYPE, Operator.IN, name),
+        ),
+        offerings=[
+            Offering(
+                requirements=Requirements(
+                    Requirement.new(l.LABEL_TOPOLOGY_ZONE, Operator.IN, zone),
+                    Requirement.new(l.CAPACITY_TYPE_LABEL_KEY, Operator.IN, ct),
+                ),
+                price=price,
+            )
+        ],
+        capacity={res.CPU: cpu, res.MEMORY: 8 * 2**30, res.PODS: 110.0},
+        **kw,
+    )
+
+
+class TestAllocatable:
+    def test_overhead_subtracted(self):
+        it = make_it(
+            overhead=InstanceTypeOverhead(
+                kube_reserved={res.CPU: 0.1},
+                system_reserved={res.CPU: 0.1},
+                eviction_threshold={res.MEMORY: 100.0},
+            )
+        )
+        alloc = it.allocatable()
+        assert alloc[res.CPU] == pytest.approx(3.8)
+        assert alloc[res.MEMORY] == pytest.approx(8 * 2**30 - 100.0)
+
+    def test_hugepages_reduce_allocatable_memory(self):
+        it = InstanceType(
+            "huge",
+            Requirements(),
+            [],
+            {res.CPU: 4.0, res.MEMORY: 8 * 2**30, "hugepages-2Mi": 2 * 2**30},
+        )
+        assert it.allocatable()[res.MEMORY] == pytest.approx(6 * 2**30)
+
+    def test_hugepages_cannot_go_negative(self):
+        it = InstanceType(
+            "huge", Requirements(), [], {res.MEMORY: 2**30, "hugepages-1Gi": 2 * 2**30}
+        )
+        assert it.allocatable()[res.MEMORY] == 0.0
+
+    def test_offering_override_groups(self):
+        base_off = Offering(requirements=Requirements(), price=1.0)
+        override_off = Offering(
+            requirements=Requirements(), price=2.0, capacity_override={res.CPU: 8.0}
+        )
+        it = InstanceType("o", Requirements(), [base_off, override_off], {res.CPU: 4.0})
+        groups = it.allocatable_offerings()
+        assert len(groups) == 2
+        assert groups[0].allocatable[res.CPU] == 4.0  # base first
+        assert groups[1].allocatable[res.CPU] == 8.0
+        assert groups[0].offerings == [base_off]
+        assert groups[1].offerings == [override_off]
+
+    def test_unavailable_offerings_excluded(self):
+        off = Offering(requirements=Requirements(), price=1.0, available=False)
+        it = InstanceType("u", Requirements(), [off], {res.CPU: 4.0})
+        assert it.allocatable_offerings()[0].offerings == []
+
+
+class TestOrdering:
+    def test_order_by_price_cheapest_compatible(self):
+        a, b = make_it("a", price=3.0), make_it("b", price=1.0)
+        assert [it.name for it in order_by_price([a, b], Requirements())] == ["b", "a"]
+
+    def test_incompatible_offerings_ignored_in_ordering(self):
+        a = make_it("a", price=1.0, zone="z-unwanted")
+        b = make_it("b", price=5.0, zone="z1")
+        reqs = Requirements(Requirement.new(l.LABEL_TOPOLOGY_ZONE, Operator.IN, "z1"))
+        assert [it.name for it in order_by_price([a, b], reqs)] == ["b", "a"]
+
+
+class TestMinValues:
+    def _reqs(self, mv_type=3, mv_family=3):
+        return Requirements(
+            Requirement.new(l.LABEL_INSTANCE_TYPE, Operator.EXISTS, min_values=mv_type),
+            Requirement.new("family", Operator.EXISTS, min_values=mv_family),
+        )
+
+    def _it(self, name, family):
+        it = make_it(name)
+        it.requirements.add(Requirement.new("family", Operator.IN, family))
+        return it
+
+    def test_satisfied(self):
+        its = [self._it("c4.large", "c4"), self._it("c5.xlarge", "c5"), self._it("m4.2xlarge", "m4")]
+        n, bad, err = satisfies_min_values(its, self._reqs())
+        assert (n, bad, err) == (3, {}, None)
+
+    def test_unsatisfied_family(self):
+        its = [self._it("c4.large", "c4"), self._it("c4.xlarge", "c4"), self._it("c5.2xlarge", "c5")]
+        n, bad, err = satisfies_min_values(its, self._reqs())
+        assert n == 3 and bad == {"family": 2} and err is not None
+
+    def test_no_min_values_short_circuits(self):
+        assert satisfies_min_values([], Requirements()) == (0, {}, None)
+
+    def test_truncate_raises_when_minvalues_broken(self):
+        its = [self._it(f"c4-{i}", "c4") for i in range(5)]
+        with pytest.raises(ValueError):
+            truncate_instance_types(its, self._reqs(mv_type=3, mv_family=2), max_items=4)
+
+    def test_truncate_best_effort_allows(self):
+        its = [self._it(f"c4-{i}", "c4") for i in range(5)]
+        out = truncate_instance_types(
+            its, self._reqs(mv_type=3, mv_family=2), max_items=4, min_values_policy_best_effort=True
+        )
+        assert len(out) == 4
+
+
+class TestOfferings:
+    def test_worst_launch_price_precedence(self):
+        mk = lambda ct, price: Offering(
+            requirements=Requirements(
+                Requirement.new(l.CAPACITY_TYPE_LABEL_KEY, Operator.IN, ct),
+                Requirement.new(l.LABEL_TOPOLOGY_ZONE, Operator.IN, "z1"),
+            ),
+            price=price,
+        )
+        offs = [mk(l.CAPACITY_TYPE_ON_DEMAND, 10.0), mk(l.CAPACITY_TYPE_SPOT, 3.0), mk(l.CAPACITY_TYPE_SPOT, 4.0)]
+        # spot present -> worst spot price wins over on-demand
+        assert worst_launch_price(offs, Requirements()) == 4.0
+        # restrict to on-demand
+        od = Requirements(Requirement.new(l.CAPACITY_TYPE_LABEL_KEY, Operator.IN, l.CAPACITY_TYPE_ON_DEMAND))
+        assert worst_launch_price(offs, od) == 10.0
+
+    def test_adjusted_price(self):
+        assert adjusted_price(10.0, "") == 10.0
+        assert adjusted_price(10.0, "5.5") == 5.5
+        assert adjusted_price(10.0, "+2") == 12.0
+        assert adjusted_price(10.0, "-2") == 8.0
+        assert adjusted_price(10.0, "+50%") == 15.0
+        assert adjusted_price(10.0, "-150%") == 0.0  # floors at zero
+
+
+class TestFakeProvider:
+    def test_create_resolves_cheapest_offering(self):
+        cp = FakeCloudProvider()
+        claim = NodeClaim(spec=NodeClaimSpec(requirements=[]))
+        resolved = cp.create(claim)
+        assert resolved.status.provider_id
+        assert resolved.metadata.labels[l.CAPACITY_TYPE_LABEL_KEY] == l.CAPACITY_TYPE_SPOT
+        assert resolved.status.allocatable[res.CPU] > 0
+
+    def test_create_respects_requirements(self):
+        cp = FakeCloudProvider()
+        claim = NodeClaim(
+            spec=NodeClaimSpec(
+                requirements=[
+                    {"key": l.CAPACITY_TYPE_LABEL_KEY, "operator": "In", "values": [l.CAPACITY_TYPE_ON_DEMAND]},
+                    {"key": l.LABEL_TOPOLOGY_ZONE, "operator": "In", "values": ["test-zone-2"]},
+                ]
+            )
+        )
+        resolved = cp.create(claim)
+        assert resolved.metadata.labels[l.CAPACITY_TYPE_LABEL_KEY] == l.CAPACITY_TYPE_ON_DEMAND
+        assert resolved.metadata.labels[l.LABEL_TOPOLOGY_ZONE] == "test-zone-2"
+
+    def test_insufficient_capacity(self):
+        from karpenter_tpu.cloudprovider import InsufficientCapacityError
+
+        cp = FakeCloudProvider(catalog=[])
+        with pytest.raises(InsufficientCapacityError):
+            cp.create(NodeClaim())
+
+    def test_delete_then_not_found(self):
+        from karpenter_tpu.cloudprovider import NodeClaimNotFoundError
+
+        cp = FakeCloudProvider()
+        claim = cp.create(NodeClaim())
+        cp.delete(claim)
+        with pytest.raises(NodeClaimNotFoundError):
+            cp.delete(claim)
+
+    def test_generator_shapes(self):
+        its = instance_types(400)
+        assert len(its) == 400
+        assert len({it.name for it in its}) == 400  # unique names
+        # spot is 70% of on-demand for every type
+        for it in its[:10]:
+            od = it.offering_price("test-zone-1", l.CAPACITY_TYPE_ON_DEMAND)
+            spot = it.offering_price("test-zone-1", l.CAPACITY_TYPE_SPOT)
+            assert spot == pytest.approx(od * 0.7, rel=1e-3)
+
+    def test_scripted_error(self):
+        from karpenter_tpu.cloudprovider import CreateError
+
+        cp = FakeCloudProvider()
+        cp.next_create_err = CreateError("boom", reason="Scripted")
+        with pytest.raises(CreateError):
+            cp.create(NodeClaim())
+        cp.create(NodeClaim())  # next call succeeds

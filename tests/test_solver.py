@@ -1,0 +1,249 @@
+"""Differential tests: the TPU solver must pack exactly like the
+exact-semantics host oracle (claim counts, assignments, viable type sets)."""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.cloudprovider.fake import instance_types, new_instance_type
+from karpenter_tpu.controllers.provisioning import (
+    HostScheduler,
+    TPUScheduler,
+    build_templates,
+)
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import make_pod
+from karpenter_tpu.models.taints import NO_SCHEDULE, Taint, Toleration
+from karpenter_tpu.utils import resources as res
+
+
+def default_pool(name="default", weight=0, requirements=(), taints=()):
+    pool = NodePool()
+    pool.metadata.name = name
+    pool.spec.weight = weight
+    pool.spec.template.spec.requirements = list(requirements)
+    pool.spec.template.spec.taints = list(taints)
+    return pool
+
+
+def random_pods(rng, n, zones=("test-zone-1", "test-zone-2"), selector_rate=0.3):
+    pods = []
+    for i in range(n):
+        cpu = float(rng.choice([0.1, 0.25, 0.5, 1.0, 2.0, 4.0]))
+        mem_gi = float(rng.choice([0.25, 0.5, 1.0, 2.0, 8.0]))
+        sel = {}
+        if rng.random() < selector_rate:
+            sel[l.LABEL_TOPOLOGY_ZONE] = str(rng.choice(zones))
+        if rng.random() < 0.2:
+            sel[l.LABEL_ARCH] = l.ARCH_AMD64
+        pods.append(make_pod(f"p-{i}", cpu=cpu, memory=f"{mem_gi}Gi", node_selector=sel))
+    return pods
+
+
+def assert_same_packing(host_result, tpu_result):
+    assert len(tpu_result.claims) == len(host_result.claims)
+    assert len(tpu_result.unschedulable) == len(host_result.unschedulable)
+    host_by_slot = {c.slot: c for c in host_result.claims}
+    tpu_by_slot = {c.slot: c for c in tpu_result.claims}
+    assert host_result.assignments == tpu_result.assignments
+    for slot, hc in host_by_slot.items():
+        tc = tpu_by_slot[slot]
+        assert [p.uid for p in hc.pods] == [p.uid for p in tc.pods]
+        assert {it.name for it in hc.instance_types} == {it.name for it in tc.instance_types}
+        assert hc.template.nodepool_name == tc.template.nodepool_name
+        for k, v in hc.used.items():
+            assert tc.used.get(k, 0.0) == pytest.approx(v)
+
+
+class TestDifferential:
+    def test_simple_homogeneous(self):
+        pods = [make_pod(f"p-{i}", cpu=1.0, memory="1Gi") for i in range(40)]
+        templates = build_templates([(default_pool(), instance_types(12))])
+        host = HostScheduler(templates).solve(pods)
+        tpu = TPUScheduler(templates).solve(pods)
+        assert_same_packing(host, tpu)
+        assert len(host.claims) >= 1
+        assert not host.unschedulable
+
+    def test_random_mixed(self):
+        rng = np.random.default_rng(7)
+        pods = random_pods(rng, 120)
+        templates = build_templates([(default_pool(), instance_types(24))])
+        host = HostScheduler(templates).solve(pods)
+        tpu = TPUScheduler(templates).solve(pods)
+        assert_same_packing(host, tpu)
+
+    def test_multiple_pools_weight_order(self):
+        rng = np.random.default_rng(3)
+        pods = random_pods(rng, 60)
+        catalog = instance_types(16)
+        heavy = default_pool(
+            "heavy",
+            weight=50,
+            requirements=[{"key": l.LABEL_ARCH, "operator": "In", "values": [l.ARCH_AMD64]}],
+        )
+        light = default_pool("light", weight=1)
+        templates = build_templates([(light, catalog), (heavy, catalog)])
+        assert templates[0].nodepool_name == "heavy"
+        host = HostScheduler(templates).solve(pods)
+        tpu = TPUScheduler(templates).solve(pods)
+        assert_same_packing(host, tpu)
+        # amd64-compatible pods should prefer the heavy pool
+        assert any(c.template.nodepool_name == "heavy" for c in host.claims)
+
+    def test_taints_and_tolerations(self):
+        taint = Taint(key="dedicated", value="gpu", effect=NO_SCHEDULE)
+        tainted = default_pool("tainted", weight=10, taints=[taint])
+        open_pool = default_pool("open")
+        catalog = instance_types(8)
+        templates = build_templates([(tainted, catalog), (open_pool, catalog)])
+        tolerant = make_pod("tolerant", cpu=1)
+        tolerant.spec.tolerations = [Toleration(key="dedicated", operator="Equal", value="gpu")]
+        intolerant = make_pod("intolerant", cpu=1)
+        pods = [tolerant, intolerant]
+        host = HostScheduler(templates).solve(pods)
+        tpu = TPUScheduler(templates).solve(pods)
+        assert_same_packing(host, tpu)
+        # intolerant pod must land on the open pool
+        for c in host.claims:
+            if any(p.uid == intolerant.uid for p in c.pods):
+                assert c.template.nodepool_name == "open"
+
+    def test_unschedulable_pod(self):
+        pods = [make_pod("huge", cpu=10000.0)]
+        templates = build_templates([(default_pool(), instance_types(8))])
+        host = HostScheduler(templates).solve(pods)
+        tpu = TPUScheduler(templates).solve(pods)
+        assert_same_packing(host, tpu)
+        assert len(host.unschedulable) == 1
+
+    def test_zone_selector_constrains_offerings(self):
+        # zone-5 exists as a label value nowhere in the catalog
+        pods = [make_pod("p", node_selector={l.LABEL_TOPOLOGY_ZONE: "zone-nowhere"})]
+        templates = build_templates([(default_pool(), instance_types(8))])
+        host = HostScheduler(templates).solve(pods)
+        tpu = TPUScheduler(templates).solve(pods)
+        assert_same_packing(host, tpu)
+        assert len(host.unschedulable) == 1
+
+    def test_nodepool_requirement_restricts_zone(self):
+        pool = default_pool(
+            "zonal",
+            requirements=[
+                {"key": l.LABEL_TOPOLOGY_ZONE, "operator": "In", "values": ["test-zone-3"]}
+            ],
+        )
+        pods = [make_pod(f"p-{i}", cpu=1.0) for i in range(10)]
+        templates = build_templates([(pool, instance_types(8))])
+        host = HostScheduler(templates).solve(pods)
+        tpu = TPUScheduler(templates).solve(pods)
+        assert_same_packing(host, tpu)
+        for c in tpu.claims:
+            it, price = c.cheapest_launch()
+            assert it is not None
+            # the launchable offering must be in test-zone-3
+            assert c.requirements.get(l.LABEL_TOPOLOGY_ZONE).has("test-zone-3")
+
+    def test_ffd_order_is_stable(self):
+        pods = [make_pod(f"p-{i}", cpu=1.0) for i in range(8)]
+        templates = build_templates([(default_pool(), instance_types(4))])
+        r1 = TPUScheduler(templates).solve(pods)
+        r2 = TPUScheduler(templates).solve(pods)
+        assert r1.assignments == r2.assignments
+
+
+class TestRegressions:
+    def test_scheduler_reuse_with_vocab_growth(self):
+        """A second solve() whose pods introduce new label keys/values must
+        re-encode instead of crashing on shape mismatch."""
+        templates = build_templates([(default_pool(), instance_types(16))])
+        s = TPUScheduler(templates)
+        r1 = s.solve([make_pod("a", cpu=1.0)])
+        pod_b = make_pod("b", cpu=1.0, node_selector={"myteam.example.com/tier": "gold"})
+        r2 = s.solve([pod_b])
+        # the custom label is undefined on the catalog -> unschedulable, not a crash
+        assert len(r2.unschedulable) == 1
+        assert len(r1.claims) == 1
+
+    def test_offering_without_zone_ct_requirements(self):
+        """Offerings that omit zone/capacity-type requirements admit every
+        (zone, ct) — parity with Requirements.Get -> Exists semantics."""
+        from karpenter_tpu.cloudprovider.instancetype import InstanceType, Offering
+        from karpenter_tpu.scheduling import Requirements as Rq
+
+        bare = InstanceType(
+            "bare",
+            Rq(),
+            [Offering(requirements=Rq(), price=1.0)],
+            {res.CPU: 4.0, res.MEMORY: 8 * 2**30, res.PODS: 16.0},
+        )
+        templates = build_templates([(default_pool(), [bare])])
+        pods = [make_pod("p", cpu=1.0)]
+        host = HostScheduler(templates).solve(pods)
+        tpu = TPUScheduler(templates).solve(pods)
+        assert_same_packing(host, tpu)
+        assert not tpu.unschedulable
+
+    def test_large_gt_bound_encodes(self):
+        """Gt/Lt bounds beyond int32 must clamp, not overflow."""
+        pool = default_pool(
+            "bounded",
+            requirements=[{"key": "custom-gen", "operator": "Gt", "values": ["3000000000"]}],
+        )
+        templates = build_templates([(default_pool(), instance_types(4)), (pool, instance_types(4))])
+        tpu = TPUScheduler(templates).solve([make_pod("p", cpu=0.25)])
+        assert not tpu.unschedulable
+
+    def test_claim_capacity_exhaustion_reason(self):
+        """When max_claims is hit, the reason says so explicitly."""
+        # 1-cpu shapes only (allocatable ~0.92): one 0.5-cpu pod per node
+        pods = [make_pod(f"p-{i}", cpu=0.5) for i in range(4)]
+        templates = build_templates([(default_pool(), instance_types(8))])
+        s = TPUScheduler(templates, max_claims=2)
+        result = s.solve(pods)
+        assert len(result.claims) == 2
+        reasons = [r for _, r in result.unschedulable]
+        assert len(reasons) == 2 and all("capacity exhausted" in r for r in reasons)
+
+    def test_float32_boundary_fits_parity(self):
+        """Host and device agree on requests at the exact f32 allocatable
+        boundary (both quantize to f32 and accumulate identically)."""
+        from karpenter_tpu.cloudprovider.instancetype import InstanceType, Offering
+        from karpenter_tpu.scheduling import Requirements as Rq
+
+        weird_mem = 16731028412.16  # not f32-representable
+        it = InstanceType(
+            "edge",
+            Rq(),
+            [Offering(requirements=Rq(), price=1.0)],
+            {res.CPU: 4.0, res.MEMORY: weird_mem, res.PODS: 16.0},
+        )
+        templates = build_templates([(default_pool(), [it])])
+        pod = make_pod("p", cpu=1.0, memory=weird_mem)
+        host = HostScheduler(templates).solve([pod])
+        tpu = TPUScheduler(templates).solve([pod])
+        assert_same_packing(host, tpu)
+        # and every emitted claim has at least one viable launch type
+        for c in tpu.claims:
+            assert c.instance_types
+
+
+class TestPackingQuality:
+    def test_bin_utilization(self):
+        """Packing must fill nodes densely. instance_types(64) spans cpu
+        sizes 1..64 (8 shapes per size), so 64 x 1cpu pods fit in a couple
+        of large nodes rather than one node per pod."""
+        pods = [make_pod(f"p-{i}", cpu=1.0, memory="1Gi") for i in range(64)]
+        templates = build_templates([(default_pool(), instance_types(64))])
+        result = TPUScheduler(templates).solve(pods)
+        assert result.node_count <= 2
+        assert not result.unschedulable
+
+    def test_dense_on_small_catalog(self):
+        """With only 1/2/4-cpu shapes (instance_types(24)), 64 cores of pods
+        need ~64/3.8 nodes — dense given the catalog, not one per pod."""
+        pods = [make_pod(f"p-{i}", cpu=1.0, memory="1Gi") for i in range(64)]
+        templates = build_templates([(default_pool(), instance_types(24))])
+        result = TPUScheduler(templates).solve(pods)
+        assert result.node_count <= 24
+        assert not result.unschedulable
