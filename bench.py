@@ -1,0 +1,92 @@
+"""Headline benchmark: scheduling throughput.
+
+Mirrors the reference's in-process scheduler benchmark
+(scheduling_benchmark_test.go: diverse pods against a 400-type fake
+catalog, gate MinPodsPerSec = 100): packs 2048 mixed pods against 400
+instance types through the full pipeline — host encode, device scan-FFD
+solve, host decode to claims — and reports warm-path pods/sec.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "pods/sec", "vs_baseline": N/100}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+N_PODS = 2048
+N_TYPES = 400
+BASELINE_PODS_PER_SEC = 100.0  # reference MinPodsPerSec gate
+
+
+def build_problem():
+    import numpy as np
+
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.controllers.provisioning import build_templates
+    from karpenter_tpu.models import labels as l
+    from karpenter_tpu.models.nodepool import NodePool
+    from karpenter_tpu.models.pod import make_pod
+
+    pool = NodePool()
+    pool.metadata.name = "default"
+    templates = build_templates([(pool, instance_types(N_TYPES))])
+    rng = np.random.default_rng(0)
+    pods = []
+    zones = ("test-zone-1", "test-zone-2", "test-zone-3", "test-zone-4")
+    for i in range(N_PODS):
+        sel = {}
+        # diverse mix: plain, zonal selectors, arch selectors
+        if i % 5 == 1:
+            sel[l.LABEL_TOPOLOGY_ZONE] = zones[i % len(zones)]
+        if i % 5 == 2:
+            sel[l.LABEL_ARCH] = l.ARCH_AMD64
+        if i % 5 == 3:
+            sel[l.CAPACITY_TYPE_LABEL_KEY] = l.CAPACITY_TYPE_ON_DEMAND
+        pods.append(
+            make_pod(
+                f"p-{i}",
+                cpu=float(rng.choice([0.1, 0.25, 0.5, 1.0, 2.0, 4.0])),
+                memory=f"{rng.choice([0.25, 0.5, 1.0, 2.0, 4.0])}Gi",
+                node_selector=sel,
+            )
+        )
+    return templates, pods
+
+
+def main() -> None:
+    from karpenter_tpu.controllers.provisioning import TPUScheduler
+
+    templates, pods = build_problem()
+    sched = TPUScheduler(templates, pod_pad=N_PODS, max_claims=256)
+    result = sched.solve(pods)  # cold: compile + warmup
+    assert not result.unschedulable, f"{len(result.unschedulable)} unschedulable"
+
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        result = sched.solve(pods)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    pods_per_sec = N_PODS / best
+
+    print(
+        json.dumps(
+            {
+                "metric": f"scheduler_throughput_{N_PODS}pods_{N_TYPES}types",
+                "value": round(pods_per_sec, 1),
+                "unit": "pods/sec",
+                "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 2),
+                "detail": {
+                    "nodes": result.node_count,
+                    "wall_s": round(best, 4),
+                    "total_price_per_hour": round(result.total_price(), 2),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -89,13 +89,15 @@ class TPUScheduler:
         itt = enc.encode_instance_types(self.catalog)
         # re-pad the requirement tensors to the bucketed K/V
         itt = itt._replace(
-            reqs=encode_requirements(enc.vocab, [it.requirements for it in self.catalog], k_pad, v_pad)
+            reqs=encode_requirements(
+                enc.vocab, [it.requirements for it in self.catalog], k_pad, v_pad, enc.skip_keys
+            )
         )
         self.it_tensors = itt
         T = len(self.catalog)
         G = len(self.templates)
         tmpl_reqs = encode_requirements(
-            enc.vocab, [t.requirements for t in self.templates], k_pad, v_pad
+            enc.vocab, [t.requirements for t in self.templates], k_pad, v_pad, enc.skip_keys
         )
         its = np.zeros((G, T), dtype=bool)
         daemon = np.zeros((G, enc.n_resources), dtype=np.float32)
@@ -130,9 +132,11 @@ class TPUScheduler:
         k_pad, v_pad = self._pads()
         pad_pod = Pod()  # zero-request inert pod for padding
         padded = pods_sorted + [pad_pod] * (P_pad - P)
+        pod_req_sets = [Requirements.from_pod(p) for p in padded]
         reqs = encode_requirements(
-            self.encoder.vocab, [Requirements.from_pod(p) for p in padded], k_pad, v_pad
+            self.encoder.vocab, pod_req_sets, k_pad, v_pad, self.encoder.skip_keys
         )
+        it_allow = self.encoder.it_allow_mask(pod_req_sets, self.catalog)
         requests = np.stack([self.encoder.resources_vector(p.total_requests()) for p in padded])
         pt = ops_solver.PodTensors(
             reqs=reqs,
@@ -150,6 +154,7 @@ class TPUScheduler:
         result = ops_solver.solve(
             pt,
             jnp.asarray(tol),
+            jnp.asarray(it_allow),
             self.it_tensors,
             self.template_tensors,
             self.well_known,

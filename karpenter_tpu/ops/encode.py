@@ -85,8 +85,10 @@ class Vocab:
             self.values[kid].append(value)
         return vid
 
-    def observe(self, reqs: Requirements) -> None:
+    def observe(self, reqs: Requirements, skip_keys: frozenset[str] = frozenset()) -> None:
         for r in reqs:
+            if r.key in skip_keys:
+                continue
             self.add_key(r.key)
             for v in r.values:
                 self.add_value(r.key, v)
@@ -119,12 +121,19 @@ class ReqSetTensors(NamedTuple):
 
 
 def encode_requirements(
-    vocab: Vocab, req_sets: Sequence[Requirements], k_pad: Optional[int] = None, v_pad: Optional[int] = None
+    vocab: Vocab,
+    req_sets: Sequence[Requirements],
+    k_pad: Optional[int] = None,
+    v_pad: Optional[int] = None,
+    skip_keys: frozenset[str] = frozenset(),
 ) -> ReqSetTensors:
     """Encode requirement sets against an already-built vocab.
 
     Every value referenced by req_sets must already be in the vocab
-    (call vocab.observe first); unknown keys raise.
+    (call vocab.observe first); unknown keys raise. Keys in skip_keys are
+    left out of the dense encoding entirely (the caller must enforce their
+    semantics by other means — see ProblemEncoder's instance-type-name
+    special-casing).
     """
     B = len(req_sets)
     K = k_pad or max(vocab.n_keys, 1)
@@ -138,6 +147,8 @@ def encode_requirements(
     # padding key slots beyond the vocab stay at the identity encoding
     for b, reqs in enumerate(req_sets):
         for r in reqs:
+            if r.key in skip_keys:
+                continue
             k = vocab.key_to_id[r.key]
             vals = vocab.values[k]
             row = np.zeros(V, dtype=bool)
@@ -199,13 +210,23 @@ class ProblemEncoder:
     frozen by the first encode call.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, special_it_name: bool = True) -> None:
         self.vocab = Vocab()
         self.resource_names: list[str] = list(BASE_RESOURCES)
         self._resource_ids: dict[str, int] = {n: i for i, n in enumerate(self.resource_names)}
         # zone / capacity-type key ids for offering encoding
         self.vocab.add_key(l.LABEL_TOPOLOGY_ZONE)
         self.vocab.add_key(l.CAPACITY_TYPE_LABEL_KEY)
+        # The instance-type NAME key would dominate the value vocabulary
+        # (one value per catalog entry, e.g. 400-1000), blowing up every
+        # [*, K, V] mask. Claims already track name-set intersection
+        # exactly through their viable-instance-type bitmask, and pod /
+        # template name selectors fold into static per-entity allowed-type
+        # masks (it_allow_mask), so the key is excluded from the dense
+        # encoding with identical final feasibility.
+        self.skip_keys: frozenset[str] = (
+            frozenset({l.LABEL_INSTANCE_TYPE}) if special_it_name else frozenset()
+        )
 
     # -- observation -------------------------------------------------------
 
@@ -216,18 +237,30 @@ class ProblemEncoder:
                 self.resource_names.append(name)
 
     def observe_requirements(self, reqs: Requirements) -> None:
-        self.vocab.observe(reqs)
+        self.vocab.observe(reqs, self.skip_keys)
 
     def observe_pod(self, pod: Pod) -> None:
-        self.vocab.observe(Requirements.from_pod(pod))
+        self.vocab.observe(Requirements.from_pod(pod), self.skip_keys)
         self.observe_resources(pod.total_requests())
 
     def observe_instance_type(self, it: InstanceType) -> None:
-        self.vocab.observe(it.requirements)
+        self.vocab.observe(it.requirements, self.skip_keys)
         self.observe_resources(it.capacity)
         for o in it.offerings:
-            self.vocab.observe(o.requirements)
+            self.vocab.observe(o.requirements, self.skip_keys)
             self.observe_resources(o.capacity_override)
+
+    def it_allow_mask(self, req_sets: Sequence[Requirements], its: Sequence[InstanceType]) -> np.ndarray:
+        """[B, T] bool — which instance types each requirement set's
+        instance-type-NAME requirement admits (True when undefined)."""
+        out = np.ones((len(req_sets), len(its)), dtype=bool)
+        for b, reqs in enumerate(req_sets):
+            if not reqs.has(l.LABEL_INSTANCE_TYPE):
+                continue
+            r = reqs.get(l.LABEL_INSTANCE_TYPE)
+            for t, it in enumerate(its):
+                out[b, t] = r.has(it.name)
+        return out
 
     # -- encoding ----------------------------------------------------------
 
@@ -241,8 +274,10 @@ class ProblemEncoder:
             out[self._resource_ids[name]] = v
         return out
 
-    def encode_requirements(self, req_sets: Sequence[Requirements]) -> ReqSetTensors:
-        return encode_requirements(self.vocab, req_sets)
+    def encode_requirements(
+        self, req_sets: Sequence[Requirements], k_pad: Optional[int] = None, v_pad: Optional[int] = None
+    ) -> ReqSetTensors:
+        return encode_requirements(self.vocab, req_sets, k_pad, v_pad, self.skip_keys)
 
     def encode_pods(self, pods: Sequence[Pod]) -> PodTensors:
         reqs = self.encode_requirements([Requirements.from_pod(p) for p in pods])

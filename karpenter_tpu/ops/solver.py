@@ -141,6 +141,7 @@ def _init_claims(n: int, k: int, v: int, r: int, t: int) -> ClaimsState:
 def solve(
     pods: PodTensors,
     pod_tol: jnp.ndarray,  # [P, G] bool
+    pod_it_allow: jnp.ndarray,  # [P, T] bool — instance types the pod's NAME selector admits
     it: InstanceTypeTensors,
     templates: Templates,
     well_known: jnp.ndarray,  # [K] bool
@@ -155,7 +156,7 @@ def solve(
     T = it.alloc.shape[0]
 
     def step(state: ClaimsState, xs):
-        pod_reqs, pod_requests, tol_g, pod_valid = xs
+        pod_reqs, pod_requests, tol_g, it_allow, pod_valid = xs
 
         pod_b = _broadcast_pod(pod_reqs, N)
         comb = kernels.intersect_sets(state.reqs, pod_b)  # [N, K, V]
@@ -168,7 +169,7 @@ def solve(
         it_compat = kernels.intersects(it.reqs, comb).T  # [N, T]
         total = state.used + pod_requests[None, :]
         fits_off = _fits_and_offering(total, comb, it, zone_kid, ct_kid)
-        new_its = state.its & it_compat & fits_off  # [N, T]
+        new_its = state.its & it_compat & fits_off & it_allow[None, :]  # [N, T]
 
         tol = tol_g[state.template]  # [N] — tolerates claim's template taints
         feas = state.open & claim_ok & tol & jnp.any(new_its, axis=-1) & pod_valid
@@ -186,7 +187,7 @@ def solve(
         it_compat0 = kernels.intersects(it.reqs, comb0).T  # [G, T]
         total0 = templates.daemon_requests + pod_requests[None, :]
         fits_off0 = _fits_and_offering(total0, comb0, it, zone_kid, ct_kid)
-        its0 = templates.its & it_compat0 & fits_off0  # [G, T]
+        its0 = templates.its & it_compat0 & fits_off0 & it_allow[None, :]  # [G, T]
         tmpl_feas = templates.valid & tmpl_ok & tol_g & jnp.any(its0, axis=-1)
         g = jnp.argmax(tmpl_feas)  # earliest weight-ordered feasible template
         any_template = jnp.any(tmpl_feas) & pod_valid & ~found
@@ -235,6 +236,6 @@ def solve(
         return new_state, assignment
 
     state = _init_claims(N, K, V, R, T)
-    xs = (pods.reqs, pods.requests, pod_tol, pods.valid)
+    xs = (pods.reqs, pods.requests, pod_tol, pod_it_allow, pods.valid)
     state, assignment = jax.lax.scan(step, state, xs)
     return SolveResult(assignment=assignment, claims=state)

@@ -1,0 +1,23 @@
+"""Device-mesh sharding of the solver.
+
+The reference scales with goroutine fan-outs and kube-apiserver watches
+(SURVEY.md §2.9); the TPU build scales by sharding the dense problem
+tensors over a jax.sharding.Mesh and letting XLA insert ICI collectives:
+
+  "it" axis   instance-type (tensor-parallel) sharding of the catalog —
+              the [claims × instance-types] triple mask is computed on
+              shards and any-reduced (psum) across devices
+  "dp" axis   batch-of-problems data parallelism — consolidation what-ifs
+              and bucketed scheduling batches are independent problems
+              vmapped over the leading axis
+
+DCN enters only for multi-slice scale-out; a single solve call never
+crosses it.
+"""
+
+from karpenter_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    pad_axis_to,
+    shard_instance_types,
+    sharded_solve,
+)

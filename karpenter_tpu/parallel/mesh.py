@@ -1,0 +1,121 @@
+"""Mesh construction and sharded solve entry points."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from karpenter_tpu.ops import solver as ops_solver
+from karpenter_tpu.ops.encode import InstanceTypeTensors, ReqSetTensors
+
+
+def make_mesh(n_devices: Optional[int] = None, axis_names: tuple[str, str] = ("dp", "it")) -> Mesh:
+    """A 2D (dp × it) mesh over the available devices.
+
+    Factorizes n into the most square (dp, it) split with it >= dp, so the
+    instance-type axis (the bigger tensor dimension) gets the larger share.
+    """
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    devices = devices[:n]
+    dp = 1
+    for cand in range(int(math.isqrt(n)), 0, -1):
+        if n % cand == 0:
+            dp = cand
+            break
+    it = n // dp
+    return Mesh(np.array(devices).reshape(dp, it), axis_names)
+
+
+def pad_axis_to(x: jnp.ndarray, axis: int, size: int, fill=0):
+    if x.shape[axis] == size:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, size - x.shape[axis])
+    return jnp.pad(x, pad, constant_values=fill)
+
+
+def _pad_reqs(reqs: ReqSetTensors, size: int) -> ReqSetTensors:
+    """Pad the batch axis; padded rows get the 'matches nothing' encoding so
+    sharded padding types never become viable."""
+    from karpenter_tpu.ops.encode import INT_MAX, INT_MIN
+
+    return ReqSetTensors(
+        mask=pad_axis_to(reqs.mask, 0, size, False),
+        inf=pad_axis_to(reqs.inf, 0, size, False),
+        excl=pad_axis_to(reqs.excl, 0, size, False),
+        gte=pad_axis_to(reqs.gte, 0, size, INT_MIN),
+        lte=pad_axis_to(reqs.lte, 0, size, INT_MAX),
+        defined=pad_axis_to(reqs.defined, 0, size, False),
+    )
+
+
+def shard_instance_types(it: InstanceTypeTensors, mesh: Mesh) -> InstanceTypeTensors:
+    """Shard the catalog over the mesh's "it" axis (pad T to a multiple).
+
+    Padded types are invalid + match nothing + fit nothing, so results are
+    identical to the unsharded solve.
+    """
+    n_it = mesh.shape["it"]
+    T = it.alloc.shape[0]
+    T_pad = ((T + n_it - 1) // n_it) * n_it
+    padded = InstanceTypeTensors(
+        reqs=_pad_reqs(it.reqs, T_pad),
+        alloc=pad_axis_to(it.alloc, 0, T_pad, -np.inf),
+        group_valid=pad_axis_to(it.group_valid, 0, T_pad, False),
+        zc_avail=pad_axis_to(it.zc_avail, 0, T_pad, False),
+        price_zc=pad_axis_to(it.price_zc, 0, T_pad, np.inf),
+        valid=pad_axis_to(it.valid, 0, T_pad, False),
+    )
+    shard = NamedSharding(mesh, P("it"))
+    return InstanceTypeTensors(
+        reqs=ReqSetTensors(*(jax.device_put(x, shard) for x in padded.reqs)),
+        alloc=jax.device_put(padded.alloc, shard),
+        group_valid=jax.device_put(padded.group_valid, shard),
+        zc_avail=jax.device_put(padded.zc_avail, shard),
+        price_zc=jax.device_put(padded.price_zc, shard),
+        valid=jax.device_put(padded.valid, shard),
+    )
+
+
+def sharded_solve(
+    pods,
+    pod_tol,
+    pod_it_allow,
+    it_sharded: InstanceTypeTensors,
+    templates,
+    well_known,
+    *,
+    zone_kid: int,
+    ct_kid: int,
+    n_claims: int,
+):
+    """Run ops_solver.solve with the catalog sharded over the "it" mesh axis.
+
+    The solve body is pure jnp, so GSPMD partitions the [claims × types]
+    triple-mask computation across devices and inserts the any-reduce
+    collectives over ICI. The per-type template and pod-allow masks are
+    padded to the sharded catalog size; everything else is replicated.
+    """
+    T_pad = it_sharded.alloc.shape[0]
+    tmpl = templates._replace(its=pad_axis_to(templates.its, 1, T_pad, False))
+    allow = pad_axis_to(pod_it_allow, 1, T_pad, False)
+    return ops_solver.solve(
+        pods,
+        pod_tol,
+        allow,
+        it_sharded,
+        tmpl,
+        well_known,
+        zone_kid=zone_kid,
+        ct_kid=ct_kid,
+        n_claims=n_claims,
+    )

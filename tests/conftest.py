@@ -3,15 +3,22 @@
 Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths are
 exercised without TPU hardware (the driver separately dry-runs the real
 multi-chip path via __graft_entry__.dryrun_multichip).
+
+Note: the axon TPU plugin in this image overrides the JAX_PLATFORMS env
+var (jax.config.jax_platforms comes up as "axon,cpu"), so we must force
+the CPU platform through jax.config.update, and the XLA flag must be in
+the environment before the backend initializes.
 """
 
 import os
 
-# Must be set before jax import anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
