@@ -1,0 +1,135 @@
+"""kwok-style simulated cloud provider.
+
+Counterpart of the reference harness (kwok/cloudprovider/cloudprovider.go:
+59-279): Create resolves the cheapest compatible offering and fabricates a
+Node object directly into the object store; a simulated "kubelet" marks it
+Ready on the next reconcile pass. This is the e2e backend the performance
+suite runs against.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Optional
+
+from karpenter_tpu.cloudprovider import errors
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.cloudprovider.instancetype import InstanceType, Offering
+from karpenter_tpu.cloudprovider.spi import CloudProvider
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.node import Node, NodeSpec, NodeStatus
+from karpenter_tpu.models.nodeclaim import NodeClaim
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.objects import ObjectMeta
+from karpenter_tpu.models.taints import UNREGISTERED_NO_EXECUTE_TAINT
+from karpenter_tpu.scheduling import Requirements
+from karpenter_tpu.state.store import ObjectStore
+
+_instance_counter = itertools.count(1)
+
+
+class KwokCloudProvider(CloudProvider):
+    def __init__(self, store: ObjectStore, catalog: Optional[list[InstanceType]] = None):
+        self.store = store
+        self.catalog = catalog if catalog is not None else instance_types(256)
+
+    @property
+    def name(self) -> str:
+        return "kwok"
+
+    def get_instance_types(self, node_pool: NodePool) -> list[InstanceType]:
+        return list(self.catalog)
+
+    def _resolve(self, claim: NodeClaim) -> tuple[InstanceType, Offering]:
+        """Cheapest compatible (type, offering) for the claim's requirements
+        (kwok cloudprovider.go:59-88)."""
+        reqs = Requirements.from_node_selector_requirements(claim.spec.requirements)
+        best: Optional[tuple[float, InstanceType, Offering]] = None
+        for it in self.catalog:
+            if it.requirements.intersects(reqs) is not None:
+                continue
+            for o in it.available_offerings():
+                if not reqs.is_compatible(o.requirements, l.WELL_KNOWN_LABELS):
+                    continue
+                if best is None or o.price < best[0]:
+                    best = (o.price, it, o)
+        if best is None:
+            raise errors.InsufficientCapacityError(
+                f"no compatible instance types for {claim.name}"
+            )
+        return best[1], best[2]
+
+    def create(self, claim: NodeClaim) -> NodeClaim:
+        it, offering = self._resolve(claim)
+        seq = next(_instance_counter)
+        provider_id = f"kwok://{claim.name}-{seq}"
+        node_name = f"{claim.name}-{seq}"
+        labels = dict(claim.metadata.labels)
+        labels.update(
+            {
+                l.LABEL_INSTANCE_TYPE: it.name,
+                l.LABEL_TOPOLOGY_ZONE: offering.zone,
+                l.CAPACITY_TYPE_LABEL_KEY: offering.capacity_type,
+                l.LABEL_ARCH: it.requirements.get(l.LABEL_ARCH).any_value() or l.ARCH_AMD64,
+                l.LABEL_OS: it.requirements.get(l.LABEL_OS).any_value() or "linux",
+                l.LABEL_HOSTNAME: node_name,
+            }
+        )
+        claim.status.provider_id = provider_id
+        claim.status.capacity = dict(it.capacity)
+        claim.status.allocatable = dict(it.allocatable())
+        claim.metadata.labels = labels
+
+        node = Node(
+            metadata=ObjectMeta(name=node_name, labels=dict(labels)),
+            spec=NodeSpec(
+                provider_id=provider_id,
+                # nodes join tainted unregistered; registration removes it
+                # (reference taints.go:27-40, registration.go:59-206)
+                taints=[UNREGISTERED_NO_EXECUTE_TAINT] + list(claim.spec.taints),
+            ),
+            status=NodeStatus(
+                capacity=dict(it.capacity),
+                allocatable=dict(it.allocatable()),
+                ready=False,
+            ),
+        )
+        self.store.create(ObjectStore.NODES, node)
+        return claim
+
+    def delete(self, claim: NodeClaim) -> None:
+        node = next(
+            (
+                n
+                for n in self.store.nodes()
+                if n.spec.provider_id == claim.status.provider_id
+            ),
+            None,
+        )
+        if node is None:
+            raise errors.NodeClaimNotFoundError(claim.status.provider_id)
+        node.metadata.finalizers = []
+        self.store.delete(ObjectStore.NODES, node.name)
+
+    def get(self, provider_id: str) -> NodeClaim:
+        for claim in self.store.nodeclaims():
+            if claim.status.provider_id == provider_id:
+                return claim
+        raise errors.NodeClaimNotFoundError(provider_id)
+
+    def list(self) -> list[NodeClaim]:
+        return [c for c in self.store.nodeclaims() if c.status.provider_id]
+
+    def is_drifted(self, claim: NodeClaim) -> Optional[str]:
+        return None
+
+    def simulate_kubelet_ready(self) -> int:
+        """Mark all not-ready kwok nodes Ready (the KWOK controller's
+        heartbeat simulation). Returns how many flipped."""
+        flipped = 0
+        for node in self.store.nodes():
+            if not node.status.ready and node.spec.provider_id.startswith("kwok://"):
+                node.status.ready = True
+                self.store.update(ObjectStore.NODES, node)
+                flipped += 1
+        return flipped

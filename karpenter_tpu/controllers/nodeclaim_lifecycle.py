@@ -1,0 +1,147 @@
+"""NodeClaim lifecycle: launch -> registration -> initialization -> liveness.
+
+Counterpart of reference pkg/controllers/nodeclaim/lifecycle
+(controller.go:168-173, launch.go, registration.go, initialization.go,
+liveness.go). Each reconcile pass runs the sub-reconcilers in order; the
+finalize path drains the node and awaits instance termination.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from karpenter_tpu.cloudprovider import errors
+from karpenter_tpu.cloudprovider.spi import CloudProvider
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodeclaim import (
+    COND_INITIALIZED,
+    COND_LAUNCHED,
+    COND_REGISTERED,
+    NodeClaim,
+)
+from karpenter_tpu.models.taints import UNREGISTERED_NO_EXECUTE_TAINT, is_known_ephemeral_taint
+from karpenter_tpu.state.store import ObjectStore
+from karpenter_tpu.utils.clock import Clock
+
+LAUNCH_TTL_SECONDS = 5 * 60.0  # liveness.go:59 registration/launch timeout
+
+
+class NodeClaimLifecycleController:
+    def __init__(self, store: ObjectStore, cloud: CloudProvider, clock: Clock):
+        self.store = store
+        self.cloud = cloud
+        self.clock = clock
+
+    def reconcile(self, claim: NodeClaim) -> None:
+        if claim.metadata.deleting:
+            self._finalize(claim)
+            return
+        changed = False
+        if l.TERMINATION_FINALIZER not in claim.metadata.finalizers:
+            claim.metadata.finalizers.append(l.TERMINATION_FINALIZER)
+            changed = True
+        changed |= self._launch(claim)
+        changed |= self._register(claim)
+        changed |= self._initialize(claim)
+        self._liveness(claim)
+        # write back only on transition — unconditional updates would
+        # re-trigger the informer forever (idempotent-reconciler discipline)
+        if changed and self.store.get(ObjectStore.NODECLAIMS, claim.name) is not None:
+            self.store.update(ObjectStore.NODECLAIMS, claim)
+
+    # -- launch (launch.go:47-127) -------------------------------------------
+
+    def _launch(self, claim: NodeClaim) -> bool:
+        if claim.conditions.is_true(COND_LAUNCHED):
+            return False
+        try:
+            self.cloud.create(claim)
+        except errors.InsufficientCapacityError as e:
+            # fail fast: delete the claim so pods re-schedule (launch.go:81)
+            claim.conditions.set_false(COND_LAUNCHED, "InsufficientCapacity", str(e), self.clock.now())
+            claim.metadata.finalizers = []
+            self.store.delete(ObjectStore.NODECLAIMS, claim.name)
+            return False
+        except errors.NodeClassNotReadyError as e:
+            return claim.conditions.set_false(
+                COND_LAUNCHED, "NodeClassNotReady", str(e), self.clock.now()
+            )
+        except errors.CreateError as e:
+            return claim.conditions.set_false(COND_LAUNCHED, e.reason, str(e), self.clock.now())
+        claim.conditions.set_true(COND_LAUNCHED, "Launched", now=self.clock.now())
+        return True
+
+    # -- registration (registration.go:59-206) --------------------------------
+
+    def _register(self, claim: NodeClaim) -> bool:
+        if not claim.conditions.is_true(COND_LAUNCHED) or claim.conditions.is_true(COND_REGISTERED):
+            return False
+        node = self._node_for(claim)
+        if node is None:
+            return False
+        # sync labels/taints from the claim, drop the unregistered taint
+        node.metadata.labels.update(claim.metadata.labels)
+        node.metadata.labels[l.NODE_REGISTERED_LABEL_KEY] = "true"
+        node.spec.taints = [
+            t for t in node.spec.taints if not t.match(UNREGISTERED_NO_EXECUTE_TAINT)
+        ]
+        claim.status.node_name = node.name
+        self.store.update(ObjectStore.NODES, node)
+        claim.conditions.set_true(COND_REGISTERED, "Registered", now=self.clock.now())
+        return True
+
+    # -- initialization (initialization.go:56-263) -----------------------------
+
+    def _initialize(self, claim: NodeClaim) -> bool:
+        if not claim.conditions.is_true(COND_REGISTERED) or claim.conditions.is_true(COND_INITIALIZED):
+            return False
+        node = self._node_for(claim)
+        if node is None or not node.status.ready:
+            return False
+        # startup taints must clear; known-ephemeral taints are ignored
+        blocking = [
+            t
+            for t in node.spec.taints
+            if not is_known_ephemeral_taint(t)
+            and any(t.match(st) for st in claim.spec.startup_taints)
+        ]
+        if blocking:
+            return False
+        node.metadata.labels[l.NODE_INITIALIZED_LABEL_KEY] = "true"
+        self.store.update(ObjectStore.NODES, node)
+        claim.conditions.set_true(COND_INITIALIZED, "Initialized", now=self.clock.now())
+        return True
+
+    # -- liveness (liveness.go:59-113) -----------------------------------------
+
+    def _liveness(self, claim: NodeClaim) -> None:
+        if claim.conditions.is_true(COND_REGISTERED):
+            return
+        age = self.clock.now() - claim.metadata.creation_timestamp
+        if age > LAUNCH_TTL_SECONDS:
+            claim.metadata.finalizers = []
+            self.store.delete(ObjectStore.NODECLAIMS, claim.name)
+
+    # -- finalize (controller.go:198) -------------------------------------------
+
+    def _finalize(self, claim: NodeClaim) -> None:
+        # instance termination FIRST (the provider owns the node object in
+        # simulated clouds); the store node is only force-dropped if the
+        # provider had already lost the instance
+        try:
+            if claim.status.provider_id:
+                self.cloud.delete(claim)
+        except errors.NodeClaimNotFoundError:
+            pass  # instance already gone — finalizer can drop
+        node = self._node_for(claim)
+        if node is not None:
+            node.metadata.finalizers = []
+            self.store.delete(ObjectStore.NODES, node.name)
+        self.store.remove_finalizer(ObjectStore.NODECLAIMS, claim.name, l.TERMINATION_FINALIZER)
+
+    # -- helpers -----------------------------------------------------------------
+
+    def _node_for(self, claim: NodeClaim):
+        if not claim.status.provider_id:
+            return None
+        return self.store.node_by_provider_id(claim.status.provider_id)

@@ -1,0 +1,48 @@
+"""Pod batching: debounce window before each provisioning pass.
+
+Counterpart of reference batcher.go:33-100: the window extends while pods
+keep arriving within BatchIdleDuration (1s) and is capped at
+BatchMaxDuration (10s). In our synchronous manager the batcher decides
+WHEN a provisioning pass should run given trigger timestamps.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from karpenter_tpu.utils.clock import Clock
+
+BATCH_IDLE_SECONDS = 1.0  # options.go:129
+BATCH_MAX_SECONDS = 10.0  # options.go:130
+
+
+class Batcher:
+    def __init__(self, clock: Clock, idle: float = BATCH_IDLE_SECONDS, max_duration: float = BATCH_MAX_SECONDS):
+        self.clock = clock
+        self.idle = idle
+        self.max_duration = max_duration
+        self._window_start: Optional[float] = None
+        self._last_trigger: Optional[float] = None
+
+    def trigger(self) -> None:
+        now = self.clock.now()
+        if self._window_start is None:
+            self._window_start = now
+        self._last_trigger = now
+
+    @property
+    def pending(self) -> bool:
+        return self._window_start is not None
+
+    def ready(self) -> bool:
+        """The window closed: idle elapsed since last trigger, or max hit."""
+        if self._window_start is None:
+            return False
+        now = self.clock.now()
+        if now - self._window_start >= self.max_duration:
+            return True
+        return now - self._last_trigger >= self.idle
+
+    def reset(self) -> None:
+        self._window_start = None
+        self._last_trigger = None

@@ -32,6 +32,8 @@ class ClaimTemplate:
     labels: dict[str, str] = field(default_factory=dict)
     daemon_requests: dict[str, float] = field(default_factory=dict)
     is_static: bool = False
+    expire_after_seconds: "float | None" = None
+    termination_grace_period_seconds: "float | None" = None
 
 
 def build_template(pool: NodePool, instance_types: list[InstanceType]) -> ClaimTemplate:
@@ -63,6 +65,8 @@ def build_template(pool: NodePool, instance_types: list[InstanceType]) -> ClaimT
         startup_taints=list(tmpl.spec.startup_taints),
         labels=labels,
         is_static=pool.is_static,
+        expire_after_seconds=tmpl.spec.expire_after_seconds,
+        termination_grace_period_seconds=tmpl.spec.termination_grace_period_seconds,
     )
 
 

@@ -1,0 +1,166 @@
+"""The Provisioner: pending pods -> NodeClaims.
+
+Counterpart of reference provisioner.go:127-577: collect provisionable
+pods (+ pods on deleting nodes), gate on cluster sync, build the scheduler
+from Ready non-static NodePools in weight order, Solve (on TPU), then
+create NodeClaims and nominate.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from karpenter_tpu.cloudprovider.spi import CloudProvider
+from karpenter_tpu.controllers.provisioning.host_scheduler import SchedulingResult, SimClaim
+from karpenter_tpu.controllers.provisioning.nodeclaimtemplate import (
+    MAX_INSTANCE_TYPES,
+    build_templates,
+)
+from karpenter_tpu.controllers.provisioning.scheduler import TPUScheduler
+from karpenter_tpu.cloudprovider.instancetype import order_by_price
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodeclaim import NodeClaim, NodeClaimSpec
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.objects import ObjectMeta, new_uid
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.store import ObjectStore
+from karpenter_tpu.utils.clock import Clock
+
+
+class Provisioner:
+    def __init__(
+        self,
+        store: ObjectStore,
+        cluster: Cluster,
+        cloud: CloudProvider,
+        clock: Clock,
+    ):
+        self.store = store
+        self.cluster = cluster
+        self.cloud = cloud
+        self.clock = clock
+        self._scheduler_cache: Optional[tuple[tuple, TPUScheduler]] = None
+
+    # -- pod collection (provisioner.go:350-385) -------------------------------
+
+    def pending_pods(self) -> list[Pod]:
+        """Provisionable pods without a live nomination to an in-flight
+        claim (prevents double-provisioning while nodes come up)."""
+        return [
+            p
+            for p in self.store.pods()
+            if p.is_provisionable() and self.cluster.pod_nomination(p.uid) is None
+        ]
+
+    # -- scheduling --------------------------------------------------------------
+
+    def _ready_pools(self) -> list[NodePool]:
+        return [p for p in self.store.nodepools() if not p.is_static]
+
+    def _build_scheduler(self) -> Optional[TPUScheduler]:
+        pools = self._ready_pools()
+        if not pools:
+            return None
+        pool_catalogs = [(p, self.cloud.get_instance_types(p)) for p in pools]
+        templates = build_templates(pool_catalogs)
+        if not templates:
+            return None
+        # full-content signature: any template/catalog change invalidates
+        sig = tuple(
+            sorted(
+                (
+                    t.nodepool_name,
+                    t.weight,
+                    str(t.requirements),
+                    tuple(sorted(t.labels.items())),
+                    tuple((x.key, x.value, x.effect) for x in t.taints),
+                    tuple(it.name for it in t.instance_types),
+                )
+                for t in templates
+            )
+        )
+        if self._scheduler_cache is not None and self._scheduler_cache[0] == sig:
+            return self._scheduler_cache[1]
+        sched = TPUScheduler(templates)
+        self._scheduler_cache = (sig, sched)
+        return sched
+
+    def schedule(self, pods: list[Pod]) -> Optional[SchedulingResult]:
+        """Schedule without side effects (used by disruption simulations)."""
+        if not pods or not self.cluster.synced():
+            return None
+        scheduler = self._build_scheduler()
+        if scheduler is None:
+            return None
+        return scheduler.solve(pods)
+
+    # -- claim creation (provisioner.go:169-221, :460-506) -----------------------
+
+    def create_node_claims(self, result: SchedulingResult) -> list[NodeClaim]:
+        created = []
+        for sim in result.claims:
+            claim = self._to_node_claim(sim)
+            self.store.create(ObjectStore.NODECLAIMS, claim)
+            # state-ahead-of-cache update (provisioner.go:501-506)
+            self.cluster.update_nodeclaim(claim)
+            # nominate the scheduled pods so the next pass doesn't
+            # re-provision for them (MarkPodSchedulingDecisions)
+            for pod in sim.pods:
+                self.cluster.nominate_pod(pod.uid, claim.name)
+            created.append(claim)
+        return created
+
+    def _to_node_claim(self, sim: SimClaim) -> NodeClaim:
+        tmpl = sim.template
+        name = f"{tmpl.nodepool_name}-{new_uid('nc')}"
+        launchable = order_by_price(sim.instance_types, sim.requirements)[:MAX_INSTANCE_TYPES]
+        requirements = []
+        for r in sim.requirements.values():
+            entry = {"key": r.key, "operator": r.operator().value}
+            if r.values:
+                entry["values"] = sorted(r.values)
+            if r.min_values is not None:
+                entry["minValues"] = r.min_values
+            requirements.append(entry)
+        # restrict launch flexibility to the viable, price-ordered types
+        requirements.append(
+            {
+                "key": l.LABEL_INSTANCE_TYPE,
+                "operator": "In",
+                "values": [it.name for it in launchable],
+            }
+        )
+        claim = NodeClaim(
+            metadata=ObjectMeta(
+                name=name,
+                labels={**tmpl.labels, l.NODEPOOL_LABEL_KEY: tmpl.nodepool_name},
+            ),
+            spec=NodeClaimSpec(
+                taints=list(tmpl.taints),
+                startup_taints=list(tmpl.startup_taints),
+                requirements=requirements,
+                requests=dict(sim.used),
+                expire_after_seconds=tmpl.expire_after_seconds,
+                termination_grace_period_seconds=tmpl.termination_grace_period_seconds,
+            ),
+        )
+        return claim
+
+    # -- the reconcile pass (provisioner.go:127-165) -------------------------------
+
+    GATED = "gated"  # provisioning blocked (no pools / cluster unsynced); retry
+
+    def reconcile(self):
+        """SchedulingResult | None (nothing to do) | GATED (retry later)."""
+        pods = self.pending_pods()
+        if not pods:
+            return None
+        if not self.cluster.synced():
+            return self.GATED
+        scheduler = self._build_scheduler()
+        if scheduler is None:
+            return self.GATED
+        result = scheduler.solve(pods)
+        self.create_node_claims(result)
+        return result

@@ -1,0 +1,11 @@
+"""Cluster state: the in-memory object store + state mirror.
+
+The reference's durable state lives in the Kubernetes API and its hot state
+in an in-memory Cluster mirror rebuilt from watches (SURVEY.md §5). We keep
+the same two-tier shape: ObjectStore is the API-server equivalent (typed
+buckets, resource versions, watch callbacks); Cluster is the mirror the
+scheduler and disruption engine read.
+"""
+
+from karpenter_tpu.state.store import ObjectStore, EventType  # noqa: F401
+from karpenter_tpu.state.cluster import Cluster, StateNode  # noqa: F401

@@ -1,0 +1,264 @@
+"""The in-memory cluster state mirror.
+
+Counterpart of reference pkg/controllers/state (cluster.go:54-604,
+statenode.go:126-513): StateNode fuses a Node with its NodeClaim; Cluster
+tracks bindings, per-nodepool usage, nomination TTLs, and the
+marked-for-deletion set that guards against double-launches during
+disruption.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.node import Node
+from karpenter_tpu.models.nodeclaim import (
+    COND_INITIALIZED,
+    COND_REGISTERED,
+    NodeClaim,
+)
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.models.taints import DISRUPTED_NO_SCHEDULE_TAINT
+from karpenter_tpu.utils import resources as res
+from karpenter_tpu.utils.clock import Clock
+
+NOMINATION_WINDOW_SECONDS = 20.0  # reference nomination TTL ballpark
+
+
+@dataclass
+class StateNode:
+    """Node + NodeClaim fusion (statenode.go:126)."""
+
+    node: Optional[Node] = None
+    node_claim: Optional[NodeClaim] = None
+    pods: dict[str, Pod] = field(default_factory=dict)  # bound pods by uid
+    marked_for_deletion: bool = False
+    nominated_until: float = 0.0
+
+    @property
+    def name(self) -> str:
+        if self.node is not None:
+            return self.node.name
+        return self.node_claim.status.node_name or self.node_claim.name if self.node_claim else ""
+
+    @property
+    def provider_id(self) -> str:
+        if self.node is not None and self.node.spec.provider_id:
+            return self.node.spec.provider_id
+        return self.node_claim.status.provider_id if self.node_claim else ""
+
+    @property
+    def nodepool_name(self) -> Optional[str]:
+        obj = self.node or self.node_claim
+        return obj.metadata.labels.get(l.NODEPOOL_LABEL_KEY) if obj else None
+
+    @property
+    def registered(self) -> bool:
+        return self.node_claim is None or self.node_claim.conditions.is_true(COND_REGISTERED)
+
+    @property
+    def initialized(self) -> bool:
+        return self.node_claim is None or self.node_claim.conditions.is_true(COND_INITIALIZED)
+
+    @property
+    def managed(self) -> bool:
+        return self.node_claim is not None
+
+    def capacity(self) -> dict[str, float]:
+        if self.node is not None and self.node.status.capacity:
+            return self.node.status.capacity
+        return self.node_claim.status.capacity if self.node_claim else {}
+
+    def allocatable(self) -> dict[str, float]:
+        if self.node is not None and self.node.status.allocatable:
+            return self.node.status.allocatable
+        return self.node_claim.status.allocatable if self.node_claim else {}
+
+    def pod_requests(self) -> dict[str, float]:
+        return res.merge(*(p.total_requests() for p in self.pods.values())) if self.pods else {}
+
+    def available(self) -> dict[str, float]:
+        """allocatable - pod requests (statenode.go:359-397)."""
+        return res.subtract(self.allocatable(), self.pod_requests())
+
+    def is_disrupted(self) -> bool:
+        node = self.node
+        return node is not None and any(
+            t.match(DISRUPTED_NO_SCHEDULE_TAINT) for t in node.spec.taints
+        )
+
+    def nominate(self, now: float) -> None:
+        self.nominated_until = now + NOMINATION_WINDOW_SECONDS
+
+    def is_nominated(self, now: float) -> bool:
+        return self.nominated_until > now
+
+
+class Cluster:
+    """The mirror (cluster.go:54-104). Updated synchronously from ObjectStore
+    watch events by the informer wiring in controllers/manager.py."""
+
+    def __init__(self, clock: Optional[Clock] = None):
+        self.clock = clock or Clock()
+        self._by_provider_id: dict[str, StateNode] = {}
+        self._claim_to_provider_id: dict[str, str] = {}
+        self._node_name_to_provider_id: dict[str, str] = {}
+        self._bindings: dict[str, str] = {}  # pod uid -> node name
+        self._unsynced_claims: set[str] = set()
+        self._consolidation_state = 0
+        # pod uid -> (target name, nomination expiry): scheduling decisions
+        # from prior passes (cluster.go:472 MarkPodSchedulingDecisions) so
+        # the provisioner doesn't double-provision for in-flight claims
+        self._pod_nominations: dict[str, tuple[str, float]] = {}
+
+    # -- sync gate (cluster.go:134) -----------------------------------------
+
+    def synced(self) -> bool:
+        """All launched claims have their cloud state reflected."""
+        return not self._unsynced_claims
+
+    # -- updates (informer entry points) -------------------------------------
+
+    def update_nodeclaim(self, claim: NodeClaim) -> None:
+        pid = claim.status.provider_id
+        if not pid:
+            # created but not launched yet
+            self._unsynced_claims.add(claim.name)
+            return
+        self._unsynced_claims.discard(claim.name)
+        old_pid = self._claim_to_provider_id.get(claim.name)
+        if old_pid and old_pid != pid:
+            self._by_provider_id.pop(old_pid, None)
+        self._claim_to_provider_id[claim.name] = pid
+        sn = self._by_provider_id.setdefault(pid, StateNode())
+        sn.node_claim = claim
+
+    def delete_nodeclaim(self, claim_name: str) -> None:
+        self._unsynced_claims.discard(claim_name)
+        pid = self._claim_to_provider_id.pop(claim_name, None)
+        if pid is None:
+            return
+        sn = self._by_provider_id.get(pid)
+        if sn is not None:
+            sn.node_claim = None
+            if sn.node is None:
+                del self._by_provider_id[pid]
+
+    def update_node(self, node: Node) -> None:
+        pid = node.spec.provider_id or f"node://{node.name}"
+        old_pid = self._node_name_to_provider_id.get(node.name)
+        if old_pid and old_pid != pid:
+            self._by_provider_id.pop(old_pid, None)
+        self._node_name_to_provider_id[node.name] = pid
+        sn = self._by_provider_id.setdefault(pid, StateNode())
+        sn.node = node
+
+    def delete_node(self, node_name: str) -> None:
+        pid = self._node_name_to_provider_id.pop(node_name, None)
+        if pid is None:
+            return
+        sn = self._by_provider_id.get(pid)
+        if sn is not None:
+            sn.node = None
+            if sn.node_claim is None:
+                del self._by_provider_id[pid]
+
+    def update_pod(self, pod: Pod) -> None:
+        node_name = pod.spec.node_name
+        old = self._bindings.get(pod.uid)
+        if old and old != node_name:
+            old_sn = self.node_by_name(old)
+            if old_sn is not None:
+                old_sn.pods.pop(pod.uid, None)
+        if not node_name or pod.is_terminal():
+            self._bindings.pop(pod.uid, None)
+            sn = self.node_by_name(node_name) if node_name else None
+            if sn is not None:
+                sn.pods.pop(pod.uid, None)
+            return
+        self._pod_nominations.pop(pod.uid, None)  # bound: nomination fulfilled
+        self._bindings[pod.uid] = node_name
+        sn = self.node_by_name(node_name)
+        if sn is not None:
+            sn.pods[pod.uid] = pod
+
+    def delete_pod(self, pod: Pod) -> None:
+        node_name = self._bindings.pop(pod.uid, None)
+        if node_name:
+            sn = self.node_by_name(node_name)
+            if sn is not None:
+                sn.pods.pop(pod.uid, None)
+
+    # -- reads ----------------------------------------------------------------
+
+    def nodes(self) -> list[StateNode]:
+        return list(self._by_provider_id.values())
+
+    def node_by_provider_id(self, pid: str) -> Optional[StateNode]:
+        return self._by_provider_id.get(pid)
+
+    def node_by_name(self, name: str) -> Optional[StateNode]:
+        pid = self._node_name_to_provider_id.get(name)
+        if pid is not None:
+            return self._by_provider_id.get(pid)
+        # fall back to claims whose node hasn't joined yet
+        for sn in self._by_provider_id.values():
+            if sn.name == name:
+                return sn
+        return None
+
+    def nodepool_usage(self, nodepool: str) -> dict[str, float]:
+        """Aggregate capacity per nodepool incl. the synthetic 'nodes'
+        resource (for NodePool.Limits)."""
+        usage: dict[str, float] = {"nodes": 0.0}
+        for sn in self._by_provider_id.values():
+            if sn.nodepool_name == nodepool and not sn.marked_for_deletion:
+                usage = res.merge(usage, sn.capacity())
+                usage["nodes"] += 1.0
+        return usage
+
+    # -- disruption coordination (cluster.go:591-604) -------------------------
+
+    def mark_for_deletion(self, *provider_ids: str) -> None:
+        for pid in provider_ids:
+            sn = self._by_provider_id.get(pid)
+            if sn is not None:
+                sn.marked_for_deletion = True
+
+    def unmark_for_deletion(self, *provider_ids: str) -> None:
+        for pid in provider_ids:
+            sn = self._by_provider_id.get(pid)
+            if sn is not None:
+                sn.marked_for_deletion = False
+
+    def nominate_pod(self, pod_uid: str, target: str, window: float = 120.0) -> None:
+        self._pod_nominations[pod_uid] = (target, self.clock.now() + window)
+
+    def pod_nomination(self, pod_uid: str) -> Optional[str]:
+        entry = self._pod_nominations.get(pod_uid)
+        if entry is None:
+            return None
+        target, expiry = entry
+        if expiry <= self.clock.now():
+            del self._pod_nominations[pod_uid]
+            return None
+        return target
+
+    def clear_pod_nomination(self, pod_uid: str) -> None:
+        self._pod_nominations.pop(pod_uid, None)
+
+    def clear_nominations_for(self, target: str) -> None:
+        """Drop nominations to a claim/node that went away so its pods
+        become provisionable again immediately."""
+        self._pod_nominations = {
+            uid: (t, exp) for uid, (t, exp) in self._pod_nominations.items() if t != target
+        }
+
+    def mark_unconsolidated(self) -> int:
+        self._consolidation_state += 1
+        return self._consolidation_state
+
+    def consolidation_state(self) -> int:
+        return self._consolidation_state

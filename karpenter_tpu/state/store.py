@@ -1,0 +1,154 @@
+"""In-memory object store — the kube-apiserver equivalent.
+
+Typed buckets with resource-version bumps and synchronous watch callbacks.
+Controllers register interest per kind; the Manager (controllers/manager.py)
+drains reconcile queues until the system is idle, which is the in-process
+analog of controller-runtime's event-driven reconcile loops.
+
+Deletion follows Kubernetes semantics: delete() sets deletion_timestamp and
+the object lingers while finalizers remain; remove_finalizer() drops it for
+real once the list empties.
+"""
+
+from __future__ import annotations
+
+import enum
+from collections import defaultdict
+from typing import Callable, Iterable, Optional, TypeVar
+
+from karpenter_tpu.utils.clock import Clock
+
+T = TypeVar("T")
+
+
+class EventType(str, enum.Enum):
+    ADDED = "Added"
+    MODIFIED = "Modified"
+    DELETED = "Deleted"
+
+
+class ObjectStore:
+    def __init__(self, clock: Optional[Clock] = None):
+        self.clock = clock or Clock()
+        self._buckets: dict[str, dict[str, object]] = defaultdict(dict)  # kind -> name -> obj
+        self._watchers: dict[str, list[Callable]] = defaultdict(list)
+        self._rv = 0
+        # secondary index: provider_id -> node name (hot lookup for the
+        # lifecycle controllers; avoids O(nodes x claims) scans)
+        self._node_by_pid: dict[str, str] = {}
+
+    def _index(self, kind: str, obj) -> None:
+        if kind == self.NODES and getattr(obj.spec, "provider_id", ""):
+            self._node_by_pid[obj.spec.provider_id] = obj.metadata.name
+
+    def _unindex(self, kind: str, obj) -> None:
+        if kind == self.NODES and getattr(obj.spec, "provider_id", ""):
+            if self._node_by_pid.get(obj.spec.provider_id) == obj.metadata.name:
+                del self._node_by_pid[obj.spec.provider_id]
+
+    def node_by_provider_id(self, provider_id: str):
+        name = self._node_by_pid.get(provider_id)
+        return self._buckets[self.NODES].get(name) if name else None
+
+    # -- watch -------------------------------------------------------------
+
+    def watch(self, kind: str, fn: Callable[[EventType, object], None]) -> None:
+        self._watchers[kind].append(fn)
+
+    def _notify(self, kind: str, event: EventType, obj) -> None:
+        for fn in self._watchers[kind]:
+            fn(event, obj)
+
+    # -- CRUD --------------------------------------------------------------
+
+    def create(self, kind: str, obj) -> object:
+        name = obj.metadata.name
+        if name in self._buckets[kind]:
+            raise ValueError(f"{kind}/{name} already exists")
+        self._rv += 1
+        obj.metadata.resource_version = self._rv
+        # stamp from the injected clock: ObjectMeta's default is wall time,
+        # which would mix clock domains under FakeClock (liveness TTL math)
+        obj.metadata.creation_timestamp = self.clock.now()
+        self._buckets[kind][name] = obj
+        self._index(kind, obj)
+        self._notify(kind, EventType.ADDED, obj)
+        return obj
+
+    def update(self, kind: str, obj) -> object:
+        name = obj.metadata.name
+        if name not in self._buckets[kind]:
+            raise KeyError(f"{kind}/{name} not found")
+        self._rv += 1
+        obj.metadata.resource_version = self._rv
+        self._buckets[kind][name] = obj
+        self._index(kind, obj)
+        self._notify(kind, EventType.MODIFIED, obj)
+        return obj
+
+    def get(self, kind: str, name: str):
+        return self._buckets[kind].get(name)
+
+    def list(self, kind: str, predicate: Optional[Callable[[object], bool]] = None) -> list:
+        objs = list(self._buckets[kind].values())
+        return [o for o in objs if predicate(o)] if predicate else objs
+
+    def delete(self, kind: str, name: str) -> bool:
+        """Graceful delete: stamps deletion_timestamp; object is removed only
+        once no finalizers remain (Kubernetes semantics the reference's
+        termination flows depend on)."""
+        obj = self._buckets[kind].get(name)
+        if obj is None:
+            return False
+        if obj.metadata.deletion_timestamp is None:
+            obj.metadata.deletion_timestamp = self.clock.now()
+        if obj.metadata.finalizers:
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            self._notify(kind, EventType.MODIFIED, obj)
+            return False
+        del self._buckets[kind][name]
+        self._unindex(kind, obj)
+        self._notify(kind, EventType.DELETED, obj)
+        return True
+
+    def remove_finalizer(self, kind: str, name: str, finalizer: str) -> None:
+        obj = self._buckets[kind].get(name)
+        if obj is None:
+            return
+        if finalizer in obj.metadata.finalizers:
+            obj.metadata.finalizers.remove(finalizer)
+        if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
+            del self._buckets[kind][name]
+            self._unindex(kind, obj)
+            self._notify(kind, EventType.DELETED, obj)
+        else:
+            self.update(kind, obj)
+
+    # -- convenience kinds ---------------------------------------------------
+
+    PODS = "pods"
+    NODES = "nodes"
+    NODECLAIMS = "nodeclaims"
+    NODEPOOLS = "nodepools"
+
+    def pods(self) -> list:
+        return self.list(self.PODS)
+
+    def nodes(self) -> list:
+        return self.list(self.NODES)
+
+    def nodeclaims(self) -> list:
+        return self.list(self.NODECLAIMS)
+
+    def nodepools(self) -> list:
+        return self.list(self.NODEPOOLS)
+
+    def bind_pod(self, pod_name: str, node_name: str) -> None:
+        pod = self.get(self.PODS, pod_name)
+        if pod is None:
+            raise KeyError(f"pod {pod_name} not found")
+        pod.spec.node_name = node_name
+        pod.status.phase = "Running"
+        pod.status.conditions["PodScheduled"] = "True"
+        self.update(self.PODS, pod)

@@ -1,0 +1,35 @@
+"""Clock abstraction: real time in production, fake time in tests.
+
+Counterpart of the reference's clocktesting.FakeClock usage
+(pkg/test/environment.go:48,195) — deterministic time travel for
+consolidateAfter, budgets, TTLs and liveness timeouts.
+"""
+
+from __future__ import annotations
+
+import time as _time
+
+
+class Clock:
+    def now(self) -> float:
+        return _time.time()
+
+    def sleep(self, seconds: float) -> None:
+        _time.sleep(seconds)
+
+
+class FakeClock(Clock):
+    def __init__(self, start: float = 1_700_000_000.0):
+        self._now = start
+
+    def now(self) -> float:
+        return self._now
+
+    def sleep(self, seconds: float) -> None:
+        self._now += seconds
+
+    def step(self, seconds: float) -> None:
+        self._now += seconds
+
+    def set(self, t: float) -> None:
+        self._now = t

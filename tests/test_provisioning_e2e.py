@@ -1,0 +1,183 @@
+"""End-to-end slice (BASELINE config #1 shape): pending pods flow through
+store -> batcher -> TPU scheduler -> NodeClaims -> kwok launch -> node
+registration/initialization -> kube-scheduler-sim binding."""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.controllers.manager import KubeSchedulerSim, Manager
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodeclaim import COND_INITIALIZED, COND_LAUNCHED, COND_REGISTERED
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import make_pod
+from karpenter_tpu.state.store import ObjectStore
+from karpenter_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture
+def env():
+    clock = FakeClock()
+    store = ObjectStore(clock)
+    cloud = KwokCloudProvider(store, catalog=instance_types(50))
+    mgr = Manager(store, cloud, clock)
+    pool = NodePool()
+    pool.metadata.name = "default"
+    store.create(ObjectStore.NODEPOOLS, pool)
+    return clock, store, cloud, mgr
+
+
+def make_pods(n, rng=None):
+    rng = rng or np.random.default_rng(0)
+    return [
+        make_pod(
+            f"p-{i}",
+            cpu=float(rng.choice([0.25, 0.5, 1.0, 2.0])),
+            memory=f"{rng.choice([0.5, 1.0, 2.0])}Gi",
+        )
+        for i in range(n)
+    ]
+
+
+class TestProvisioningE2E:
+    def test_full_cycle(self, env):
+        clock, store, cloud, mgr = env
+        for pod in make_pods(100):
+            store.create(ObjectStore.PODS, pod)
+        assert mgr.batcher.pending
+        mgr.run_until_idle()
+
+        claims = store.nodeclaims()
+        assert claims, "provisioning created no claims"
+        for c in claims:
+            assert c.conditions.is_true(COND_LAUNCHED)
+            assert c.conditions.is_true(COND_REGISTERED)
+            assert c.status.provider_id.startswith("kwok://")
+
+        # kwok "kubelet" heartbeats -> nodes Ready -> initialization
+        assert cloud.simulate_kubelet_ready() == len(claims)
+        mgr.run_until_idle()
+        for c in store.nodeclaims():
+            assert c.conditions.is_true(COND_INITIALIZED)
+
+        # nodes carry instance labels and dropped the unregistered taint
+        nodes = store.nodes()
+        assert len(nodes) == len(claims)
+        for n in nodes:
+            assert n.metadata.labels[l.NODEPOOL_LABEL_KEY] == "default"
+            assert n.metadata.labels[l.LABEL_INSTANCE_TYPE]
+            assert all(t.key != l.UNREGISTERED_TAINT_KEY for t in n.spec.taints)
+
+        # the kube-scheduler sim binds every pending pod
+        binder = KubeSchedulerSim(store, mgr.cluster)
+        bound = binder.bind_pending()
+        assert bound == 100
+        assert all(p.spec.node_name for p in store.pods())
+
+        # cluster mirror agrees
+        assert mgr.cluster.synced()
+        assert sum(len(sn.pods) for sn in mgr.cluster.nodes()) == 100
+
+    def test_batch_window_debounce(self, env):
+        clock, store, cloud, mgr = env
+        store.create(ObjectStore.PODS, make_pods(1)[0])
+        assert not mgr.batcher.ready()  # window open, idle not elapsed
+        clock.step(1.1)
+        assert mgr.batcher.ready()
+
+    def test_no_double_provisioning(self, env):
+        clock, store, cloud, mgr = env
+        for pod in make_pods(20):
+            store.create(ObjectStore.PODS, pod)
+        mgr.run_until_idle()
+        n_claims = len(store.nodeclaims())
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        KubeSchedulerSim(store, mgr.cluster).bind_pending()
+        # nothing pending anymore -> another pass creates nothing
+        mgr.batcher.trigger()
+        clock.step(2.0)
+        mgr.run_until_idle()
+        assert len(store.nodeclaims()) == n_claims
+
+    def test_no_double_provisioning_before_nodes_ready(self, env):
+        """Pods scheduled to in-flight claims must not be re-provisioned
+        when new pods trigger another pass before nodes turn Ready."""
+        clock, store, cloud, mgr = env
+        for pod in make_pods(20):
+            store.create(ObjectStore.PODS, pod)
+        mgr.run_until_idle()
+        claims_before = {c.name for c in store.nodeclaims()}
+        total_cpu_before = sum(c.spec.requests.get("cpu", 0) for c in store.nodeclaims())
+        # nodes NOT ready yet; a straggler pod arrives
+        store.create(ObjectStore.PODS, make_pod("straggler", cpu=0.25))
+        mgr.run_until_idle()
+        new_claims = [c for c in store.nodeclaims() if c.name not in claims_before]
+        # only the straggler got capacity, not all 21 pods again
+        new_cpu = sum(c.spec.requests.get("cpu", 0) for c in new_claims)
+        assert new_cpu < total_cpu_before / 2
+        assert len(store.nodeclaims()) <= len(claims_before) + 1
+
+    def test_nodepool_created_after_pods(self, env):
+        """Pods arriving before any NodePool exists must be provisioned once
+        a pool appears (the gated trigger survives / re-fires)."""
+        clock = FakeClock()
+        store = ObjectStore(clock)
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+
+        cloud = KwokCloudProvider(store, catalog=instance_types(50))
+        mgr = Manager(store, cloud, clock)
+        for pod in make_pods(5):
+            store.create(ObjectStore.PODS, pod)
+        mgr.run_until_idle()
+        assert store.nodeclaims() == []
+        pool = NodePool()
+        pool.metadata.name = "late"
+        store.create(ObjectStore.NODEPOOLS, pool)
+        mgr.run_until_idle()
+        assert store.nodeclaims(), "late pool never provisioned pending pods"
+
+    def test_liveness_deletes_unregistered_claim(self, env):
+        """A claim that never registers is deleted after the launch TTL —
+        exercises the fake-clock creation timestamps."""
+        clock, store, cloud, mgr = env
+        from karpenter_tpu.cloudprovider import CreateError
+
+        # make every create fail with a retryable error -> claim never launches
+        orig_create = cloud.create
+        cloud.create = lambda c: (_ for _ in ()).throw(
+            CreateError("cloud down", reason="Scripted")
+        )
+        store.create(ObjectStore.PODS, make_pods(1)[0])
+        mgr.run_until_idle()
+        assert len(store.nodeclaims()) == 1
+        clock.step(6 * 60.0)  # past the 5m launch TTL
+        claims = store.nodeclaims()
+        for c in claims:
+            mgr.lifecycle.reconcile(c)
+        assert store.nodeclaims() == []
+        cloud.create = orig_create
+
+    def test_insufficient_capacity_deletes_claim(self, env):
+        clock, store, cloud, mgr = env
+        # a pod too big for the catalog never yields a claim at all
+        store.create(ObjectStore.PODS, make_pod("huge", cpu=10000.0))
+        mgr.run_until_idle()
+        assert store.nodeclaims() == []
+
+    def test_claim_deletion_finalizes(self, env):
+        clock, store, cloud, mgr = env
+        for pod in make_pods(10):
+            store.create(ObjectStore.PODS, pod)
+        mgr.run_until_idle()
+        claims = store.nodeclaims()
+        assert claims
+        name = claims[0].name
+        store.delete(ObjectStore.NODECLAIMS, name)
+        mgr.run_until_idle()
+        assert store.get(ObjectStore.NODECLAIMS, name) is None
+        # backing node removed too
+        assert all(
+            n.spec.provider_id != claims[0].status.provider_id for n in store.nodes()
+        )
