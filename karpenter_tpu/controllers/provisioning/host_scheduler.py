@@ -48,10 +48,28 @@ class SimClaim:
 
 
 @dataclass
+class ExistingSimNode:
+    """Tier-1 candidate: an existing or in-flight real node
+    (existingnode.go:32-75). requirements seed from the node's labels (incl.
+    hostname) and evolve as pods land; available is allocatable minus
+    current pods minus remaining daemon overhead."""
+
+    name: str
+    index: int
+    requirements: Requirements
+    available: dict[str, float]
+    taints: list = field(default_factory=list)
+    used: dict[str, float] = field(default_factory=dict)
+    pods: list[Pod] = field(default_factory=list)
+
+
+@dataclass
 class SchedulingResult:
     claims: list[SimClaim]
     unschedulable: list[tuple[Pod, str]]
     assignments: dict[str, int]  # pod uid -> claim slot
+    existing: list[ExistingSimNode] = field(default_factory=list)
+    existing_assignments: dict[str, str] = field(default_factory=dict)  # pod uid -> node name
 
     @property
     def node_count(self) -> int:
@@ -100,8 +118,34 @@ def _fits_and_offering(
 
 
 class HostScheduler:
-    def __init__(self, templates: list[ClaimTemplate]):
+    def __init__(
+        self,
+        templates: list[ClaimTemplate],
+        existing_nodes: Optional[list[ExistingSimNode]] = None,
+        budgets: Optional[dict[str, dict[str, float]]] = None,
+    ):
+        """budgets: nodepool -> remaining resources (limits minus current
+        usage; may include the synthetic 'nodes' count). Absent pool =
+        unlimited."""
         self.templates = templates
+        self.existing_nodes = existing_nodes or []
+        self.budgets = {k: dict(v) for k, v in (budgets or {}).items()}
+
+    # -- tier 1: existing nodes (existingnode.go:84-135) ---------------------
+
+    def can_add_existing(self, node: ExistingSimNode, pod: Pod, pod_reqs: Requirements) -> bool:
+        if tolerates_all(node.taints, pod.spec.tolerations) is not None:
+            return False
+        total = res.merge(node.used, pod.total_requests())
+        if not res.fits(total, node.available):
+            return False
+        # strict Compatible: no AllowUndefinedWellKnownLabels
+        if node.requirements.compatible(pod_reqs) is not None:
+            return False
+        node.requirements.add(*pod_reqs.values())
+        node.used = total
+        node.pods.append(pod)
+        return True
 
     def can_add(self, claim: SimClaim, pod: Pod, pod_reqs: Requirements) -> Optional[SimClaim]:
         """Feasibility of adding pod to claim (nodeclaim.go:124-242);
@@ -125,8 +169,35 @@ class HostScheduler:
             slot=claim.slot,
         )
 
+    def _within_budget(self, tmpl: ClaimTemplate, its: list[InstanceType]) -> list[InstanceType]:
+        """filterByRemainingResources (scheduler.go:1068): exclude types
+        whose full capacity would breach the pool's remaining limits."""
+        budget = self.budgets.get(tmpl.nodepool_name)
+        if budget is None:
+            return its
+        return [
+            it
+            for it in its
+            if all(it.capacity.get(k, 0.0) <= v for k, v in budget.items() if k != "nodes")
+        ]
+
+    def _charge_budget(self, tmpl: ClaimTemplate, its: list[InstanceType]) -> None:
+        """subtractMax (scheduler.go:791): reserve the max capacity over the
+        claim's viable types."""
+        budget = self.budgets.get(tmpl.nodepool_name)
+        if budget is None:
+            return
+        for k in list(budget):
+            if k == "nodes":
+                budget[k] -= 1.0
+            else:
+                budget[k] -= max((it.capacity.get(k, 0.0) for it in its), default=0.0)
+
     def try_new_claim(self, pod: Pod, pod_reqs: Requirements, slot: int) -> Optional[SimClaim]:
         for tmpl in self.templates:  # weight order (scheduler.go:695)
+            budget = self.budgets.get(tmpl.nodepool_name)
+            if budget is not None and budget.get("nodes", 1.0) < 1.0:
+                continue  # node limits exhausted (scheduler.go:711-714)
             if tolerates_all(tmpl.taints, pod.spec.tolerations) is not None:
                 continue
             if tmpl.requirements.compatible(pod_reqs, l.WELL_KNOWN_LABELS) is not None:
@@ -134,9 +205,11 @@ class HostScheduler:
             combined = tmpl.requirements.copy()
             combined.add(*pod_reqs.values())
             total = res.merge(tmpl.daemon_requests, pod.total_requests())
-            remaining = filter_instance_types(tmpl.instance_types, combined, total)
+            candidates = self._within_budget(tmpl, tmpl.instance_types)
+            remaining = filter_instance_types(candidates, combined, total)
             if not remaining:
                 continue
+            self._charge_budget(tmpl, remaining)
             return SimClaim(
                 template=tmpl,
                 requirements=combined,
@@ -151,11 +224,20 @@ class HostScheduler:
         claims: list[SimClaim] = []
         unschedulable: list[tuple[Pod, str]] = []
         assignments: dict[str, int] = {}
+        existing_assignments: dict[str, str] = {}
         for pod in ffd_sort(pods):
             pod_reqs = Requirements.from_pod(pod)
-            # in-flight claims: fewest pods first, earliest slot tie-break
-            # (scheduler.go:598-599)
+            # tier 1: existing nodes, earliest index wins (scheduler.go:594)
             placed = False
+            for node in self.existing_nodes:
+                if self.can_add_existing(node, pod, pod_reqs):
+                    existing_assignments[pod.uid] = node.name
+                    placed = True
+                    break
+            if placed:
+                continue
+            # tier 2: in-flight claims, fewest pods first, earliest slot
+            # tie-break (scheduler.go:598-599)
             for claim in sorted(claims, key=lambda c: (len(c.pods), c.slot)):
                 updated = self.can_add(claim, pod, pod_reqs)
                 if updated is not None:
@@ -171,4 +253,10 @@ class HostScheduler:
                 assignments[pod.uid] = new_claim.slot
             else:
                 unschedulable.append((pod, "no compatible in-flight claim or template"))
-        return SchedulingResult(claims=claims, unschedulable=unschedulable, assignments=assignments)
+        return SchedulingResult(
+            claims=claims,
+            unschedulable=unschedulable,
+            assignments=assignments,
+            existing=self.existing_nodes,
+            existing_assignments=existing_assignments,
+        )

@@ -11,7 +11,11 @@ from __future__ import annotations
 from typing import Optional
 
 from karpenter_tpu.cloudprovider.spi import CloudProvider
-from karpenter_tpu.controllers.provisioning.host_scheduler import SchedulingResult, SimClaim
+from karpenter_tpu.controllers.provisioning.host_scheduler import (
+    ExistingSimNode,
+    SchedulingResult,
+    SimClaim,
+)
 from karpenter_tpu.controllers.provisioning.nodeclaimtemplate import (
     MAX_INSTANCE_TYPES,
     build_templates,
@@ -58,6 +62,57 @@ class Provisioner:
     def _ready_pools(self) -> list[NodePool]:
         return [p for p in self.store.nodepools() if not p.is_static]
 
+    def _existing_sim_nodes(self) -> list[ExistingSimNode]:
+        """Registered, schedulable cluster nodes as tier-1 candidates
+        (scheduler.go:1060 calculateExistingNodeClaims), sorted by name for
+        deterministic earliest-index-wins."""
+        from karpenter_tpu.scheduling import Requirements
+        from karpenter_tpu.utils import resources as res
+
+        # requests of nominated-but-unbound pods, charged against their
+        # target so successive passes don't double-book the same headroom
+        reserved: dict[str, dict[str, float]] = {}
+        for p in self.store.pods():
+            if p.is_pending():
+                target = self.cluster.pod_nomination(p.uid)
+                if target is not None:
+                    reserved[target] = res.merge(reserved.get(target), p.total_requests())
+
+        out = []
+        for sn in sorted(self.cluster.nodes(), key=lambda s: s.name):
+            node = sn.node
+            if node is None or sn.marked_for_deletion or sn.is_disrupted():
+                continue
+            if not sn.registered:
+                continue
+            reqs = Requirements.from_labels(dict(node.metadata.labels))
+            available = sn.available()
+            if node.name in reserved:
+                available = res.subtract(available, reserved[node.name])
+            out.append(
+                ExistingSimNode(
+                    name=node.name,
+                    index=len(out),
+                    requirements=reqs,
+                    available=available,
+                    taints=list(node.spec.taints),
+                )
+            )
+        return out
+
+    def _remaining_budgets(self) -> dict[str, dict[str, float]]:
+        """Per-pool remaining limits = spec.limits - current usage
+        (scheduler.go:184, filterByRemainingResources)."""
+        budgets: dict[str, dict[str, float]] = {}
+        for pool in self._ready_pools():
+            if pool.spec.limits is None:
+                continue
+            usage = self.cluster.nodepool_usage(pool.name)
+            budgets[pool.name] = {
+                k: v - usage.get(k, 0.0) for k, v in pool.spec.limits.resources.items()
+            }
+        return budgets
+
     def _build_scheduler(self) -> Optional[TPUScheduler]:
         pools = self._ready_pools()
         if not pools:
@@ -93,7 +148,7 @@ class Provisioner:
         scheduler = self._build_scheduler()
         if scheduler is None:
             return None
-        return scheduler.solve(pods)
+        return scheduler.solve(pods, self._existing_sim_nodes(), self._remaining_budgets())
 
     # -- claim creation (provisioner.go:169-221, :460-506) -----------------------
 
@@ -161,6 +216,13 @@ class Provisioner:
         scheduler = self._build_scheduler()
         if scheduler is None:
             return self.GATED
-        result = scheduler.solve(pods)
+        result = scheduler.solve(pods, self._existing_sim_nodes(), self._remaining_budgets())
         self.create_node_claims(result)
+        # nominate pods placed on existing nodes so the kube-scheduler (sim)
+        # binds them and the next pass doesn't re-provision
+        for pod_uid, node_name in result.existing_assignments.items():
+            self.cluster.nominate_pod(pod_uid, node_name)
+            sn = self.cluster.node_by_name(node_name)
+            if sn is not None:
+                sn.nominate(self.clock.now())
         return result

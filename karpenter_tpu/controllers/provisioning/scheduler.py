@@ -23,12 +23,14 @@ import jax.numpy as jnp
 
 from karpenter_tpu.cloudprovider.instancetype import InstanceType
 from karpenter_tpu.controllers.provisioning.host_scheduler import (
+    ExistingSimNode,
     SchedulingResult,
     SimClaim,
     ffd_sort,
     filter_instance_types,
 )
 from karpenter_tpu.controllers.provisioning.nodeclaimtemplate import ClaimTemplate
+from karpenter_tpu.models import labels as l
 from karpenter_tpu.models.pod import Pod
 from karpenter_tpu.ops import solver as ops_solver
 from karpenter_tpu.ops.encode import ProblemEncoder, encode_requirements
@@ -55,6 +57,8 @@ class TPUScheduler:
         pod_pad: Optional[int] = None,
     ):
         self.templates = templates
+        self.existing_nodes: list[ExistingSimNode] = []
+        self.budgets: dict[str, dict[str, float]] = {}
         # union catalog over all templates, stable order, deduped by name
         seen: dict[str, InstanceType] = {}
         for t in templates:
@@ -110,6 +114,9 @@ class TPUScheduler:
             its=jnp.asarray(its),
             daemon_requests=jnp.asarray(daemon),
             valid=jnp.ones(G, dtype=bool),
+            # per-solve budgets are patched in by solve()
+            budget=jnp.full((G, enc.n_resources), np.inf, dtype=jnp.float32),
+            nodes_budget=jnp.full(G, np.inf, dtype=jnp.float32),
         )
         wk = enc.vocab.well_known_mask()
         self.well_known = jnp.asarray(
@@ -117,14 +124,67 @@ class TPUScheduler:
         )
         self._vocab_sig = self._sig()
 
+    def _encode_budgets(self) -> tuple[jnp.ndarray, jnp.ndarray]:
+        enc = self.encoder
+        G = len(self.templates)
+        budget = np.full((G, enc.n_resources), np.inf, dtype=np.float32)
+        nodes_budget = np.full(G, np.inf, dtype=np.float32)
+        for g, t in enumerate(self.templates):
+            pool_budget = self.budgets.get(t.nodepool_name)
+            if pool_budget is not None:
+                for k, v in pool_budget.items():
+                    if k == "nodes":
+                        nodes_budget[g] = v
+                    elif k in enc.resource_names:
+                        budget[g, enc.resource_names.index(k)] = v
+        return jnp.asarray(budget), jnp.asarray(nodes_budget)
+
+    def _encode_existing(self, e_pad: int) -> ops_solver.ExistingNodes:
+        enc = self.encoder
+        k_pad, v_pad = self._pads()
+        exist_reqs = encode_requirements(
+            enc.vocab,
+            [n.requirements for n in self.existing_nodes]
+            + [Requirements()] * (e_pad - len(self.existing_nodes)),
+            k_pad,
+            v_pad,
+            enc.skip_keys,
+        )
+        avail = np.zeros((e_pad, enc.n_resources), dtype=np.float32)
+        for e, n in enumerate(self.existing_nodes):
+            avail[e] = enc.resources_vector(n.available)
+        return ops_solver.ExistingNodes(
+            reqs=exist_reqs,
+            avail=jnp.asarray(avail),
+            valid=jnp.asarray(
+                [True] * len(self.existing_nodes)
+                + [False] * (e_pad - len(self.existing_nodes))
+            ),
+        )
+
     # -- solving -----------------------------------------------------------
 
-    def solve(self, pods: Sequence[Pod]) -> SchedulingResult:
+    def solve(
+        self,
+        pods: Sequence[Pod],
+        existing_nodes: Optional[list[ExistingSimNode]] = None,
+        budgets: Optional[dict[str, dict[str, float]]] = None,
+    ) -> SchedulingResult:
+        self.existing_nodes = existing_nodes or []
+        self.budgets = {k: dict(v) for k, v in (budgets or {}).items()}
         pods_sorted = ffd_sort(list(pods))
         for p in pods_sorted:
             self.encoder.observe_pod(p)
+        for n in self.existing_nodes:
+            self.encoder.observe_requirements(n.requirements)
+            self.encoder.observe_resources(n.available)
         if self._vocab_sig != self._sig():
             self._encode_static()
+        exist_tensors = self._encode_existing(_next_pow2(max(len(self.existing_nodes), 1), 1))
+        budget, nodes_budget = self._encode_budgets()
+        template_tensors = self.template_tensors._replace(
+            budget=budget, nodes_budget=nodes_budget
+        )
 
         P = len(pods_sorted)
         P_pad = self.pod_pad or _next_pow2(max(P, 1))
@@ -137,6 +197,28 @@ class TPUScheduler:
             self.encoder.vocab, pod_req_sets, k_pad, v_pad, self.encoder.skip_keys
         )
         it_allow = self.encoder.it_allow_mask(pod_req_sets, self.catalog)
+        # hostname selectors can never match a not-yet-named new node
+        for i, rq in enumerate(pod_req_sets):
+            if not self.encoder.hostname_allows(rq, None):
+                it_allow[i, :] = False
+        # static pod×existing-node checks for the skipped keys + taints
+        E = exist_tensors.avail.shape[0]
+        exist_ok = np.zeros((P_pad, E), dtype=bool)
+        for e, n in enumerate(self.existing_nodes):
+            hostname = n.requirements.get(l.LABEL_HOSTNAME).any_value() or None
+            it_name = (
+                n.requirements.get(l.LABEL_INSTANCE_TYPE).any_value() or None
+                if n.requirements.has(l.LABEL_INSTANCE_TYPE)
+                else None
+            )
+            for i, p in enumerate(padded):
+                rq = pod_req_sets[i]
+                ok = tolerates_all(n.taints, p.spec.tolerations) is None
+                ok = ok and self.encoder.hostname_allows(rq, hostname)
+                if ok and rq.has(l.LABEL_INSTANCE_TYPE):
+                    r = rq.get(l.LABEL_INSTANCE_TYPE)
+                    ok = r.has(it_name) if it_name is not None else r.is_lenient()
+                exist_ok[i, e] = ok
         requests = np.stack([self.encoder.resources_vector(p.total_requests()) for p in padded])
         pt = ops_solver.PodTensors(
             reqs=reqs,
@@ -155,16 +237,18 @@ class TPUScheduler:
             pt,
             jnp.asarray(tol),
             jnp.asarray(it_allow),
+            jnp.asarray(exist_ok),
+            exist_tensors,
             self.it_tensors,
-            self.template_tensors,
+            template_tensors,
             self.well_known,
             zone_kid=zone_kid,
             ct_kid=ct_kid,
             n_claims=n_claims,
         )
-        return self._decode(pods_sorted, result)
+        return self._decode(pods_sorted, result, E)
 
-    def _decode(self, pods_sorted: list[Pod], result: ops_solver.SolveResult) -> SchedulingResult:
+    def _decode(self, pods_sorted: list[Pod], result: ops_solver.SolveResult, E: int) -> SchedulingResult:
         """Replay assignments host-side to rebuild exact claim objects.
 
         The device decides WHO goes WHERE; the host re-derives each claim's
@@ -173,11 +257,16 @@ class TPUScheduler:
         """
         assignment = np.asarray(result.assignment)[: len(pods_sorted)]
         claim_template = np.asarray(result.claims.template)
+        # budget replay mirrors the host oracle's filter/charge bookkeeping
+        from karpenter_tpu.controllers.provisioning.host_scheduler import HostScheduler
+
+        hs = HostScheduler(self.templates, budgets=self.budgets)
 
         claims: list[SimClaim] = []
         slot_to_claim: dict[int, SimClaim] = {}
         unschedulable: list[tuple[Pod, str]] = []
         assignments: dict[str, int] = {}
+        existing_assignments: dict[str, str] = {}
         for i, pod in enumerate(pods_sorted):
             slot = int(assignment[i])
             if slot == ops_solver.NO_ROOM:
@@ -186,16 +275,26 @@ class TPUScheduler:
             if slot < 0:
                 unschedulable.append((pod, "no compatible in-flight claim or template"))
                 continue
+            pod_reqs = Requirements.from_pod(pod)
+            if slot < E:
+                # tier 1: existing node (host replay of the commit)
+                node = self.existing_nodes[slot]
+                node.requirements.add(*pod_reqs.values())
+                node.used = res.merge(node.used, pod.total_requests())
+                node.pods.append(pod)
+                existing_assignments[pod.uid] = node.name
+                continue
+            slot -= E
             assignments[pod.uid] = slot
             claim = slot_to_claim.get(slot)
-            pod_reqs = Requirements.from_pod(pod)
-            if claim is None:
+            newly_created = claim is None
+            if newly_created:
                 tmpl = self.templates[int(claim_template[slot])]
                 claim = SimClaim(
                     template=tmpl,
                     requirements=tmpl.requirements.copy(),
                     used=dict(tmpl.daemon_requests),
-                    instance_types=list(tmpl.instance_types),
+                    instance_types=hs._within_budget(tmpl, tmpl.instance_types),
                     pods=[],
                     slot=slot,
                 )
@@ -204,9 +303,22 @@ class TPUScheduler:
             claim.requirements.add(*pod_reqs.values())
             claim.used = res.merge(claim.used, pod.total_requests())
             claim.pods.append(pod)
+            if newly_created:
+                # charge the pool budget with the first-pod viable set
+                # (subtractMax happens at claim creation, scheduler.go:791)
+                hs._charge_budget(
+                    claim.template,
+                    filter_instance_types(claim.instance_types, claim.requirements, claim.used),
+                )
         # narrow viable instance types once per claim (host replay)
         for claim in claims:
             claim.instance_types = filter_instance_types(
                 claim.instance_types, claim.requirements, claim.used
             )
-        return SchedulingResult(claims=claims, unschedulable=unschedulable, assignments=assignments)
+        return SchedulingResult(
+            claims=claims,
+            unschedulable=unschedulable,
+            assignments=assignments,
+            existing=self.existing_nodes,
+            existing_assignments=existing_assignments,
+        )

@@ -182,6 +182,7 @@ class InstanceTypeTensors(NamedTuple):
 
     reqs: ReqSetTensors  # [T, K, V]
     alloc: jnp.ndarray  # [T, GR, R] f32
+    cap: jnp.ndarray  # [T, R] f32 — full capacity (NodePool limits filtering)
     group_valid: jnp.ndarray  # [T, GR] bool
     zc_avail: jnp.ndarray  # [T, GR, Z, C] bool — available offering exists in (zone, ct)
     price_zc: jnp.ndarray  # [T, Z, C] f32 — min available price, +inf when none
@@ -217,15 +218,18 @@ class ProblemEncoder:
         # zone / capacity-type key ids for offering encoding
         self.vocab.add_key(l.LABEL_TOPOLOGY_ZONE)
         self.vocab.add_key(l.CAPACITY_TYPE_LABEL_KEY)
-        # The instance-type NAME key would dominate the value vocabulary
-        # (one value per catalog entry, e.g. 400-1000), blowing up every
-        # [*, K, V] mask. Claims already track name-set intersection
-        # exactly through their viable-instance-type bitmask, and pod /
-        # template name selectors fold into static per-entity allowed-type
-        # masks (it_allow_mask), so the key is excluded from the dense
-        # encoding with identical final feasibility.
+        # Two keys would dominate the value vocabulary and are excluded from
+        # the dense encoding (their semantics are enforced by other means):
+        #   * instance-type NAME (one value per catalog entry, 400-1000):
+        #     claims track name-set intersection exactly through their
+        #     viable-instance-type bitmask; pod/template name selectors fold
+        #     into static allowed-type masks (it_allow_mask).
+        #   * hostname (one value per existing node): hostname selectors
+        #     fold into the static pod×node / pod×template masks computed
+        #     host-side (hostname_allows); hostname topology spread gets
+        #     dedicated machinery in the topology phase.
         self.skip_keys: frozenset[str] = (
-            frozenset({l.LABEL_INSTANCE_TYPE}) if special_it_name else frozenset()
+            frozenset({l.LABEL_INSTANCE_TYPE, l.LABEL_HOSTNAME}) if special_it_name else frozenset()
         )
 
     # -- observation -------------------------------------------------------
@@ -249,6 +253,17 @@ class ProblemEncoder:
         for o in it.offerings:
             self.vocab.observe(o.requirements, self.skip_keys)
             self.observe_resources(o.capacity_override)
+
+    def hostname_allows(self, reqs: Requirements, hostname: Optional[str]) -> bool:
+        """Whether a requirement set's hostname requirement admits the given
+        hostname (None = a not-yet-named new node: only requirement sets
+        without a concrete hostname demand are satisfiable)."""
+        if not reqs.has(l.LABEL_HOSTNAME):
+            return True
+        r = reqs.get(l.LABEL_HOSTNAME)
+        if hostname is None:
+            return r.is_lenient()
+        return r.has(hostname)
 
     def it_allow_mask(self, req_sets: Sequence[Requirements], its: Sequence[InstanceType]) -> np.ndarray:
         """[B, T] bool — which instance types each requirement set's
@@ -305,6 +320,7 @@ class ProblemEncoder:
 
         reqs = self.encode_requirements([it.requirements for it in its])
         alloc = np.full((T, GR, R), -np.inf, dtype=np.float32)
+        cap = np.zeros((T, R), dtype=np.float32)
         group_valid = np.zeros((T, GR), dtype=bool)
         zc_avail = np.zeros((T, GR, Z, C), dtype=bool)
         price_zc = np.full((T, Z, C), np.inf, dtype=np.float32)
@@ -312,6 +328,7 @@ class ProblemEncoder:
         zone_values = self.vocab.values[zone_kid]
         ct_values = self.vocab.values[ct_kid]
         for t, it in enumerate(its):
+            cap[t] = self.resources_vector(it.capacity)
             for g, group in enumerate(it.allocatable_offerings()):
                 alloc[t, g] = self.resources_vector(group.allocatable)
                 group_valid[t, g] = True
@@ -337,6 +354,7 @@ class ProblemEncoder:
         return InstanceTypeTensors(
             reqs=reqs,
             alloc=jnp.asarray(alloc),
+            cap=jnp.asarray(cap),
             group_valid=jnp.asarray(group_valid),
             zc_avail=jnp.asarray(zc_avail),
             price_zc=jnp.asarray(price_zc),

@@ -2,30 +2,32 @@
 
 This replaces the reference's Scheduler.Solve hot loop
 (pkg/controllers/provisioning/scheduling/scheduler.go:440,
-nodeclaim.go:124-242, nodeclaim.go:541). Reformulation:
+nodeclaim.go:124-242, existingnode.go:32-200, nodeclaim.go:541).
+Reformulation:
 
   * Pods are pre-sorted first-fit-decreasing host-side (queue.go:72-90).
-  * One `lax.scan` step places one pod. The carry holds every in-flight
-    simulated NodeClaim as dense state: combined requirement tensors
-    [N, K, V], resource usage [N, R], and the boolean set of still-viable
-    instance types [N, T].
+  * One `lax.scan` step places one pod through the reference's 3-tier
+    cascade (scheduler.go:582-612):
+      tier 1  existing nodes, earliest-index wins (addToExistingNode)
+      tier 2  in-flight simulated NodeClaims, fewest-pods-first with
+              earliest-slot tie-break (addToInflightNode, :598)
+      tier 3  a new claim from the highest-priority weight-ordered
+              compatible template (addToNewNodeClaim)
   * The per-(claim, instance-type) triple mask — requirements-intersect ×
-    resource-fits × offering-available (nodeclaim.go:541's compat/fits/
-    hasOffering) — is computed for ALL claims and instance types at once on
-    the VPU/MXU instead of the reference's goroutine fan-out.
-  * Claim selection mirrors the reference's ordering exactly: in-flight
-    claims sorted fewest-pods-first with earliest-index tie-break
-    (scheduler.go:598-599), via a single argmin over (pod_count, slot).
-  * If no in-flight claim fits, a new claim opens from the highest-priority
-    (weight-ordered) compatible template (scheduler.go:695+), or the pod is
-    marked unschedulable.
+    resource-fits × offering-available (nodeclaim.go:541) — is computed for
+    ALL claims and instance types at once on the VPU/MXU instead of the
+    reference's goroutine fan-out.
+  * NodePool limits ride along as per-template budget vectors: new claims
+    filter instance types by remaining capacity and subtract the max
+    capacity over the claim's viable types on open (scheduler.go:708-727,
+    :1068 filterByRemainingResources / subtractMax).
 
-The solver is pure and stateless per call (SURVEY.md §5 checkpoint/resume:
-problem tensors are rebuilt from cluster state each cycle). All problem
-tensors are jit ARGUMENTS, not closure constants, so re-encoding the
-problem (e.g. after vocab growth) reuses the compiled executable whenever
-shapes are unchanged; callers pad pods/keys/vocab to bucketed sizes to
-keep shapes stable.
+The solver is pure and stateless per call; all problem tensors are jit
+ARGUMENTS, so re-encoding (e.g. after vocab growth) reuses the compiled
+executable whenever shapes are unchanged.
+
+Assignment index space: [0, E) = existing-node slot, [E, E+N) = claim
+slot, NO_CLAIM / NO_ROOM sentinels otherwise.
 """
 
 from __future__ import annotations
@@ -40,7 +42,7 @@ from karpenter_tpu.ops import kernels
 from karpenter_tpu.ops.encode import InstanceTypeTensors, PodTensors, ReqSetTensors
 
 # assignment sentinels
-NO_CLAIM = -1  # no compatible in-flight claim or template
+NO_CLAIM = -1  # no compatible existing node, in-flight claim, or template
 NO_ROOM = -2  # a template was feasible but the claim-slot capacity is full
 BIG = jnp.int32(2**31 - 1)
 
@@ -52,48 +54,60 @@ class Templates(NamedTuple):
     its: jnp.ndarray  # [G, T] bool — statically compatible instance types
     daemon_requests: jnp.ndarray  # [G, R] f32 — daemonset overhead per template
     valid: jnp.ndarray  # [G] bool
+    budget: jnp.ndarray  # [G, R] f32 — remaining pool limits (+inf unlimited)
+    nodes_budget: jnp.ndarray  # [G] f32 — remaining node-count limit (+inf)
 
 
-class ClaimsState(NamedTuple):
-    """The scan carry: all in-flight simulated NodeClaims."""
+class ExistingNodes(NamedTuple):
+    """Existing/in-flight real nodes (tier 1). reqs seed from node labels;
+    avail is allocatable minus current pods and daemon overhead."""
 
+    reqs: ReqSetTensors  # [E, K, V]
+    avail: jnp.ndarray  # [E, R] f32 — remaining schedulable resources
+    valid: jnp.ndarray  # [E] bool
+
+
+class SolverState(NamedTuple):
+    """The scan carry."""
+
+    # tier-1 existing nodes
+    exist_reqs: ReqSetTensors  # [E, K, V] — evolve as pods land
+    exist_used: jnp.ndarray  # [E, R]
+    # tier-2 in-flight claims
     reqs: ReqSetTensors  # [N, K, V]
-    used: jnp.ndarray  # [N, R] f32 — pod requests incl. daemon overhead
-    its: jnp.ndarray  # [N, T] bool — viable instance types
+    used: jnp.ndarray  # [N, R]
+    its: jnp.ndarray  # [N, T] bool
     template: jnp.ndarray  # [N] int32
     open: jnp.ndarray  # [N] bool
     pods: jnp.ndarray  # [N] int32
     n_open: jnp.ndarray  # [] int32
+    # limits
+    budget: jnp.ndarray  # [G, R]
+    nodes_budget: jnp.ndarray  # [G]
 
 
 class SolveResult(NamedTuple):
-    assignment: jnp.ndarray  # [P] int32 — claim slot, NO_CLAIM or NO_ROOM
-    claims: ClaimsState
+    assignment: jnp.ndarray  # [P] int32
+    claims: SolverState
 
 
 def _fits_and_offering(
-    total: jnp.ndarray,  # [N, R] requested totals per claim
-    comb: ReqSetTensors,  # [N, K, V] combined claim∩pod requirements
+    total: jnp.ndarray,  # [B, R] requested totals
+    comb: ReqSetTensors,  # [B, K, V] combined requirements
     it: InstanceTypeTensors,
     zone_kid: int,
     ct_kid: int,
 ) -> jnp.ndarray:
-    """[N, T] bool — exists an allocatable group where resources fit AND a
-    compatible offering is available (nodeclaim.go:630-652 fits()).
-
-    Offering compatibility reduces to: the claim's zone mask admits the
-    offering zone and its capacity-type mask admits the offering ct — both
-    well-known keys whose values are always in-vocab.
-    """
-    # fits per group: [N, T, GR]. Resources with zero requested always pass,
+    """[B, T] bool — exists an allocatable group where resources fit AND a
+    compatible offering is available (nodeclaim.go:630-652 fits())."""
+    # fits per group: [B, T, GR]. Resources with zero requested always pass,
     # matching the host's "only check requested keys" (resources.fits) even
     # when an allocatable entry is negative (overhead exceeding capacity).
     t = total[:, None, None, :]
     fit = jnp.all((t <= it.alloc[None, :, :, :]) | (t == 0.0), axis=-1)
     fit = fit & it.group_valid[None, :, :]
-    # offering availability per group: [N, T, GR]
-    zmask = comb.mask[:, zone_kid, :]  # [N, V] — admitted zones
-    cmask = comb.mask[:, ct_kid, :]  # [N, V]
+    zmask = comb.mask[:, zone_kid, :]  # [B, V] — admitted zones
+    cmask = comb.mask[:, ct_kid, :]
     Z = it.zc_avail.shape[2]
     C = it.zc_avail.shape[3]
     off = jnp.einsum(
@@ -103,7 +117,7 @@ def _fits_and_offering(
         cmask[:, :C],
         preferred_element_type=jnp.float32,
     ) > 0
-    return jnp.any(fit & off, axis=-1)  # [N, T]
+    return jnp.any(fit & off, axis=-1)  # [B, T]
 
 
 def _broadcast_pod(pod: ReqSetTensors, n: int) -> ReqSetTensors:
@@ -117,8 +131,9 @@ def _broadcast_pod(pod: ReqSetTensors, n: int) -> ReqSetTensors:
     )
 
 
-def _init_claims(n: int, k: int, v: int, r: int, t: int) -> ClaimsState:
-    identity = ReqSetTensors(
+def identity_reqs(n: int, k: int, v: int) -> ReqSetTensors:
+    """The intersection-identity encoding (all keys undefined)."""
+    return ReqSetTensors(
         mask=jnp.ones((n, k, v), dtype=bool),
         inf=jnp.ones((n, k), dtype=bool),
         excl=jnp.zeros((n, k), dtype=bool),
@@ -126,22 +141,15 @@ def _init_claims(n: int, k: int, v: int, r: int, t: int) -> ClaimsState:
         lte=jnp.full((n, k), 2**31 - 1, dtype=jnp.int32),
         defined=jnp.zeros((n, k), dtype=bool),
     )
-    return ClaimsState(
-        reqs=identity,
-        used=jnp.zeros((n, r), dtype=jnp.float32),
-        its=jnp.zeros((n, t), dtype=bool),
-        template=jnp.zeros(n, dtype=jnp.int32),
-        open=jnp.zeros(n, dtype=bool),
-        pods=jnp.zeros(n, dtype=jnp.int32),
-        n_open=jnp.int32(0),
-    )
 
 
 @functools.partial(jax.jit, static_argnames=("zone_kid", "ct_kid", "n_claims"))
 def solve(
     pods: PodTensors,
-    pod_tol: jnp.ndarray,  # [P, G] bool
+    pod_tmpl_ok: jnp.ndarray,  # [P, G] bool — tolerates taints + skipped-key static checks
     pod_it_allow: jnp.ndarray,  # [P, T] bool — instance types the pod's NAME selector admits
+    pod_exist_ok: jnp.ndarray,  # [P, E] bool — static checks vs existing nodes
+    exist: ExistingNodes,
     it: InstanceTypeTensors,
     templates: Templates,
     well_known: jnp.ndarray,  # [K] bool
@@ -154,88 +162,162 @@ def solve(
     V = it.reqs.mask.shape[2]
     R = it.alloc.shape[2]
     T = it.alloc.shape[0]
+    E = exist.avail.shape[0]
+    G = templates.its.shape[0]
+    no_wk = jnp.zeros_like(well_known)
 
-    def step(state: ClaimsState, xs):
-        pod_reqs, pod_requests, tol_g, it_allow, pod_valid = xs
+    def step(state: SolverState, xs):
+        pod_reqs, pod_requests, tmpl_ok_g, it_allow, exist_ok_e, pod_valid = xs
 
+        # ---- tier 1: existing nodes (earliest index wins) -----------------
+        pod_e = _broadcast_pod(pod_reqs, E)
+        comb_e = kernels.intersect_sets(state.exist_reqs, pod_e)
+        # strict Compatible — no AllowUndefinedWellKnownLabels
+        # (existingnode.go:101 n.requirements.Compatible(podData.Requirements))
+        exist_compat = kernels.compatible_elemwise(state.exist_reqs, pod_e, no_wk)
+        total_e = state.exist_used + pod_requests[None, :]
+        t_e = total_e
+        exist_fit = jnp.all((t_e <= exist.avail) | (t_e == 0.0), axis=-1)
+        feas_e = exist.valid & exist_ok_e & exist_compat & exist_fit & pod_valid
+        pick_e = jnp.argmin(jnp.where(feas_e, jnp.arange(E, dtype=jnp.int32), BIG))
+        found_e = jnp.any(feas_e)
+
+        # ---- tier 2: in-flight claims (fewest pods, earliest slot) --------
         pod_b = _broadcast_pod(pod_reqs, N)
-        comb = kernels.intersect_sets(state.reqs, pod_b)  # [N, K, V]
-
-        # claim-level requirement compat (nodeclaim.go:147):
-        # claim.reqs.Compatible(pod.reqs, AllowUndefinedWellKnownLabels)
-        claim_ok = kernels.compatible_elemwise(state.reqs, pod_b, well_known)  # [N]
-
-        # instance-type triple mask against the NEW combined requirements
+        comb = kernels.intersect_sets(state.reqs, pod_b)
+        claim_ok = kernels.compatible_elemwise(state.reqs, pod_b, well_known)
         it_compat = kernels.intersects(it.reqs, comb).T  # [N, T]
         total = state.used + pod_requests[None, :]
         fits_off = _fits_and_offering(total, comb, it, zone_kid, ct_kid)
-        new_its = state.its & it_compat & fits_off & it_allow[None, :]  # [N, T]
-
-        tol = tol_g[state.template]  # [N] — tolerates claim's template taints
-        feas = state.open & claim_ok & tol & jnp.any(new_its, axis=-1) & pod_valid
-
-        # fewest-pods-first with earliest-slot tie-break (scheduler.go:598)
+        new_its = state.its & it_compat & fits_off & it_allow[None, :]
+        tol = tmpl_ok_g[state.template]
+        feas = state.open & claim_ok & tol & jnp.any(new_its, axis=-1) & pod_valid & ~found_e
         order_key = state.pods * jnp.int32(N) + jnp.arange(N, dtype=jnp.int32)
         pick = jnp.argmin(jnp.where(feas, order_key, BIG))
-        found = feas[pick]
+        found = jnp.any(feas)
 
-        # --- new-claim path: templates in weight order (scheduler.go:695) --
-        G = templates.its.shape[0]
+        # ---- tier 3: new claim from weight-ordered templates ----------------
         pod_g = _broadcast_pod(pod_reqs, G)
         comb0 = kernels.intersect_sets(templates.reqs, pod_g)
-        tmpl_ok = kernels.compatible_elemwise(templates.reqs, pod_g, well_known)  # [G]
+        tmpl_compat = kernels.compatible_elemwise(templates.reqs, pod_g, well_known)
         it_compat0 = kernels.intersects(it.reqs, comb0).T  # [G, T]
         total0 = templates.daemon_requests + pod_requests[None, :]
         fits_off0 = _fits_and_offering(total0, comb0, it, zone_kid, ct_kid)
-        its0 = templates.its & it_compat0 & fits_off0 & it_allow[None, :]  # [G, T]
-        tmpl_feas = templates.valid & tmpl_ok & tol_g & jnp.any(its0, axis=-1)
-        g = jnp.argmax(tmpl_feas)  # earliest weight-ordered feasible template
-        any_template = jnp.any(tmpl_feas) & pod_valid & ~found
+        # NodePool limits: exclude instance types whose full capacity would
+        # breach the remaining budget (scheduler.go:1068)
+        cap_ok = jnp.all(
+            (it.cap[None, :, :] <= state.budget[:, None, :]), axis=-1
+        )  # [G, T]
+        its0 = (
+            templates.its
+            & it_compat0
+            & fits_off0
+            & it_allow[None, :]
+            & cap_ok
+        )
+        tmpl_feas = (
+            templates.valid
+            & tmpl_compat
+            & tmpl_ok_g
+            & jnp.any(its0, axis=-1)
+            & (state.nodes_budget >= 1.0)
+        )
+        g = jnp.argmax(tmpl_feas)
+        any_template = jnp.any(tmpl_feas) & pod_valid & ~found_e & ~found
         can_open = any_template & (state.n_open < N)
 
-        slot = jnp.where(found, pick, state.n_open)
-        place = found | can_open
+        # ---- merge the three outcomes ----------------------------------------
+        open_slot = state.n_open
+        slot = jnp.where(
+            found_e,
+            pick_e,
+            jnp.where(found, E + pick, E + open_slot),
+        )
+        place = found_e | found | can_open
         assignment = jnp.where(
             place,
             slot.astype(jnp.int32),
             jnp.where(any_template, jnp.int32(NO_ROOM), jnp.int32(NO_CLAIM)),
         )
 
-        # merged update values for the chosen slot
+        # existing-node updates
+        upd_exist = found_e
+        new_exist_reqs = kernels.select_set(
+            upd_exist,
+            kernels.update_set_at(state.exist_reqs, pick_e, kernels.take_set(comb_e, pick_e)),
+            state.exist_reqs,
+        )
+        new_exist_used = jnp.where(
+            upd_exist, state.exist_used.at[pick_e].set(total_e[pick_e]), state.exist_used
+        )
+
+        # claim updates (tier 2 or 3)
+        upd_claim = (found | can_open) & ~found_e
+        cslot = jnp.where(found, pick, open_slot)
         sel_reqs = kernels.select_set(
-            found,
-            kernels.take_set(comb, pick),
-            kernels.take_set(comb0, g),
+            found, kernels.take_set(comb, pick), kernels.take_set(comb0, g)
         )
         sel_its = jnp.where(found, new_its[pick], its0[g])
         sel_used = jnp.where(
-            found,
-            total[pick],
-            templates.daemon_requests[g] + pod_requests,
+            found, total[pick], templates.daemon_requests[g] + pod_requests
         )
         sel_template = jnp.where(found, state.template[pick], g.astype(jnp.int32))
-
-        def apply(state: ClaimsState) -> ClaimsState:
-            return ClaimsState(
-                reqs=kernels.update_set_at(state.reqs, slot, sel_reqs),
-                used=state.used.at[slot].set(sel_used),
-                its=state.its.at[slot].set(sel_its),
-                template=state.template.at[slot].set(sel_template),
-                open=state.open.at[slot].set(True),
-                pods=state.pods.at[slot].add(1),
-                n_open=state.n_open + jnp.where(found, 0, 1).astype(jnp.int32),
-            )
-
-        new_state = jax.tree.map(
-            lambda a, b: jnp.where(
-                place.reshape((1,) * a.ndim) if a.ndim else place, a, b
-            ),
-            apply(state),
-            state,
+        new_reqs = kernels.select_set(
+            upd_claim, kernels.update_set_at(state.reqs, cslot, sel_reqs), state.reqs
         )
-        return new_state, assignment
+        new_used = jnp.where(upd_claim, state.used.at[cslot].set(sel_used), state.used)
+        new_claim_its = jnp.where(upd_claim, state.its.at[cslot].set(sel_its), state.its)
+        new_template = jnp.where(
+            upd_claim, state.template.at[cslot].set(sel_template), state.template
+        )
+        new_open = jnp.where(upd_claim, state.open.at[cslot].set(True), state.open)
+        new_pods = jnp.where(upd_claim, state.pods.at[cslot].add(1), state.pods)
+        opened = can_open & ~found
+        new_n_open = state.n_open + jnp.where(opened, 1, 0).astype(jnp.int32)
 
-    state = _init_claims(N, K, V, R, T)
-    xs = (pods.reqs, pods.requests, pod_tol, pod_it_allow, pods.valid)
+        # limits bookkeeping on open: subtract the max capacity over the
+        # claim's viable instance types (scheduler.go:791 subtractMax)
+        max_cap = jnp.max(
+            jnp.where(its0[g][:, None], it.cap, -jnp.inf), axis=0
+        )  # [R]
+        max_cap = jnp.where(jnp.isfinite(max_cap), max_cap, 0.0)
+        new_budget = jnp.where(
+            opened, state.budget.at[g].add(-max_cap), state.budget
+        )
+        new_nodes_budget = jnp.where(
+            opened, state.nodes_budget.at[g].add(-1.0), state.nodes_budget
+        )
+
+        return (
+            SolverState(
+                exist_reqs=new_exist_reqs,
+                exist_used=new_exist_used,
+                reqs=new_reqs,
+                used=new_used,
+                its=new_claim_its,
+                template=new_template,
+                open=new_open,
+                pods=new_pods,
+                n_open=new_n_open,
+                budget=new_budget,
+                nodes_budget=new_nodes_budget,
+            ),
+            assignment,
+        )
+
+    state = SolverState(
+        exist_reqs=exist.reqs,
+        exist_used=jnp.zeros((E, R), dtype=jnp.float32),
+        reqs=identity_reqs(N, K, V),
+        used=jnp.zeros((N, R), dtype=jnp.float32),
+        its=jnp.zeros((N, T), dtype=bool),
+        template=jnp.zeros(N, dtype=jnp.int32),
+        open=jnp.zeros(N, dtype=bool),
+        pods=jnp.zeros(N, dtype=jnp.int32),
+        n_open=jnp.int32(0),
+        budget=templates.budget,
+        nodes_budget=templates.nodes_budget,
+    )
+    xs = (pods.reqs, pods.requests, pod_tmpl_ok, pod_it_allow, pod_exist_ok, pods.valid)
     state, assignment = jax.lax.scan(step, state, xs)
     return SolveResult(assignment=assignment, claims=state)

@@ -70,6 +70,7 @@ def shard_instance_types(it: InstanceTypeTensors, mesh: Mesh) -> InstanceTypeTen
     padded = InstanceTypeTensors(
         reqs=_pad_reqs(it.reqs, T_pad),
         alloc=pad_axis_to(it.alloc, 0, T_pad, -np.inf),
+        cap=pad_axis_to(it.cap, 0, T_pad, np.inf),  # inf: padding never passes budget filters
         group_valid=pad_axis_to(it.group_valid, 0, T_pad, False),
         zc_avail=pad_axis_to(it.zc_avail, 0, T_pad, False),
         price_zc=pad_axis_to(it.price_zc, 0, T_pad, np.inf),
@@ -79,6 +80,7 @@ def shard_instance_types(it: InstanceTypeTensors, mesh: Mesh) -> InstanceTypeTen
     return InstanceTypeTensors(
         reqs=ReqSetTensors(*(jax.device_put(x, shard) for x in padded.reqs)),
         alloc=jax.device_put(padded.alloc, shard),
+        cap=jax.device_put(padded.cap, shard),
         group_valid=jax.device_put(padded.group_valid, shard),
         zc_avail=jax.device_put(padded.zc_avail, shard),
         price_zc=jax.device_put(padded.price_zc, shard),
@@ -90,6 +92,8 @@ def sharded_solve(
     pods,
     pod_tol,
     pod_it_allow,
+    pod_exist_ok,
+    exist,
     it_sharded: InstanceTypeTensors,
     templates,
     well_known,
@@ -112,6 +116,8 @@ def sharded_solve(
         pods,
         pod_tol,
         allow,
+        pod_exist_ok,
+        exist,
         it_sharded,
         tmpl,
         well_known,

@@ -30,16 +30,18 @@ def test_mesh_too_few_devices():
 def test_sharded_solve_matches_unsharded():
     import __graft_entry__ as ge
 
-    fn, (pt, tol, it_allow, it, templates, well_known), meta = ge._build_entry(
+    fn, (pt, tol, it_allow, exist_ok, exist, it, templates, well_known), meta = ge._build_entry(
         n_pods=32, n_types=12
     )
-    ref = jax.jit(fn)(pt, tol, it_allow, it, templates, well_known)
+    ref = jax.jit(fn)(pt, tol, it_allow, exist_ok, exist, it, templates, well_known)
     ref_assignment = np.asarray(ref.assignment)
 
     mesh = make_mesh(8)
     with mesh:
         it_sharded = shard_instance_types(it, mesh)
-        out = sharded_solve(pt, tol, it_allow, it_sharded, templates, well_known, **meta)
+        out = sharded_solve(
+            pt, tol, it_allow, exist_ok, exist, it_sharded, templates, well_known, **meta
+        )
         out_assignment = np.asarray(out.assignment)
 
     np.testing.assert_array_equal(ref_assignment, out_assignment)

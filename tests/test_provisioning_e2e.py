@@ -159,6 +159,38 @@ class TestProvisioningE2E:
         assert store.nodeclaims() == []
         cloud.create = orig_create
 
+    def test_second_batch_reuses_existing_nodes(self, env):
+        """Once nodes exist with spare capacity, a later batch must fill
+        them (tier-1 existing-node placement) instead of opening claims."""
+        clock, store, cloud, mgr = env
+        for pod in make_pods(30):
+            store.create(ObjectStore.PODS, pod)
+        mgr.run_until_idle()
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        KubeSchedulerSim(store, mgr.cluster).bind_pending()
+        n_claims = len(store.nodeclaims())
+        assert n_claims >= 1
+        # a small second wave fits in the headroom of existing nodes
+        for i in range(3):
+            store.create(ObjectStore.PODS, make_pod(f"wave2-{i}", cpu=0.1, memory="64Mi"))
+        mgr.run_until_idle()
+        assert len(store.nodeclaims()) == n_claims, "second wave opened new claims"
+        bound = KubeSchedulerSim(store, mgr.cluster).bind_pending()
+        assert bound == 3
+
+    def test_nodepool_node_limit_respected(self, env):
+        from karpenter_tpu.models.nodepool import Limits
+
+        clock, store, cloud, mgr = env
+        pool = store.get(ObjectStore.NODEPOOLS, "default")
+        pool.spec.limits = Limits(resources={"nodes": 2})
+        store.update(ObjectStore.NODEPOOLS, pool)
+        for pod in make_pods(200):
+            store.create(ObjectStore.PODS, pod)
+        mgr.run_until_idle()
+        assert len(store.nodeclaims()) <= 2
+
     def test_insufficient_capacity_deletes_claim(self, env):
         clock, store, cloud, mgr = env
         # a pod too big for the catalog never yields a claim at all

@@ -152,6 +152,132 @@ class TestDifferential:
         assert r1.assignments == r2.assignments
 
 
+def make_existing(name, index, cpu_avail=4.0, mem_avail=8 * 2**30, zone="test-zone-1",
+                  it_name="s-4x-amd64", taints=()):
+    from karpenter_tpu.controllers.provisioning.host_scheduler import ExistingSimNode
+    from karpenter_tpu.scheduling import Requirements
+
+    labels = {
+        l.LABEL_TOPOLOGY_ZONE: zone,
+        l.LABEL_INSTANCE_TYPE: it_name,
+        l.CAPACITY_TYPE_LABEL_KEY: l.CAPACITY_TYPE_ON_DEMAND,
+        l.LABEL_ARCH: l.ARCH_AMD64,
+        l.LABEL_OS: "linux",
+        l.LABEL_HOSTNAME: name,
+        l.NODEPOOL_LABEL_KEY: "default",
+    }
+    return ExistingSimNode(
+        name=name,
+        index=index,
+        requirements=Requirements.from_labels(labels),
+        available={res.CPU: cpu_avail, res.MEMORY: float(mem_avail), res.PODS: 50.0},
+        taints=list(taints),
+    )
+
+
+class TestExistingNodes:
+    def _both(self, pods, templates, existing_factory, budgets=None):
+        host = HostScheduler(templates, existing_nodes=existing_factory(), budgets=budgets).solve(pods)
+        tpu = TPUScheduler(templates).solve(pods, existing_factory(), budgets)
+        return host, tpu
+
+    def test_existing_first(self):
+        pods = [make_pod(f"p-{i}", cpu=0.5, memory="512Mi") for i in range(6)]
+        templates = build_templates([(default_pool(), instance_types(16))])
+        factory = lambda: [make_existing("node-a", 0), make_existing("node-b", 1)]
+        host, tpu = self._both(pods, templates, factory)
+        assert_same_packing(host, tpu)
+        assert host.existing_assignments == tpu.existing_assignments
+        # all six fit on the two existing nodes -> zero new claims
+        assert host.node_count == 0
+        assert len(host.existing_assignments) == 6
+
+    def test_overflow_to_new_claims(self):
+        pods = [make_pod(f"p-{i}", cpu=2.0, memory="1Gi") for i in range(8)]
+        templates = build_templates([(default_pool(), instance_types(32))])
+        factory = lambda: [make_existing("node-a", 0, cpu_avail=4.0)]
+        host, tpu = self._both(pods, templates, factory)
+        assert_same_packing(host, tpu)
+        assert host.existing_assignments == tpu.existing_assignments
+        assert len(host.existing_assignments) == 2  # 2x2cpu fit the node
+        assert host.node_count >= 1
+
+    def test_existing_node_zone_constrains(self):
+        pods = [
+            make_pod("z2", cpu=0.5, node_selector={l.LABEL_TOPOLOGY_ZONE: "test-zone-2"}),
+            make_pod("z1", cpu=0.5, node_selector={l.LABEL_TOPOLOGY_ZONE: "test-zone-1"}),
+        ]
+        templates = build_templates([(default_pool(), instance_types(16))])
+        factory = lambda: [make_existing("node-a", 0, zone="test-zone-1")]
+        host, tpu = self._both(pods, templates, factory)
+        assert_same_packing(host, tpu)
+        assert host.existing_assignments == tpu.existing_assignments
+        # only the zone-1 pod lands on the existing node
+        assert list(host.existing_assignments.values()) == ["node-a"]
+
+    def test_existing_node_taints(self):
+        from karpenter_tpu.models.taints import NO_SCHEDULE, Taint
+
+        taint = Taint(key="dedicated", value="x", effect=NO_SCHEDULE)
+        pods = [make_pod("p", cpu=0.5)]
+        templates = build_templates([(default_pool(), instance_types(16))])
+        factory = lambda: [make_existing("node-a", 0, taints=[taint])]
+        host, tpu = self._both(pods, templates, factory)
+        assert_same_packing(host, tpu)
+        assert not host.existing_assignments  # intolerant pod skips the node
+
+    def test_hostname_selector_targets_existing(self):
+        pods = [make_pod("p", cpu=0.5, node_selector={l.LABEL_HOSTNAME: "node-b"})]
+        templates = build_templates([(default_pool(), instance_types(16))])
+        factory = lambda: [make_existing("node-a", 0), make_existing("node-b", 1)]
+        host, tpu = self._both(pods, templates, factory)
+        assert host.existing_assignments == tpu.existing_assignments == {pods[0].uid: "node-b"}
+
+    def test_instance_type_selector_vs_existing(self):
+        pods = [make_pod("p", cpu=0.5, node_selector={l.LABEL_INSTANCE_TYPE: "c-1x-amd64"})]
+        templates = build_templates([(default_pool(), instance_types(16))])
+        factory = lambda: [make_existing("node-a", 0, it_name="s-4x-amd64")]
+        host, tpu = self._both(pods, templates, factory)
+        assert_same_packing(host, tpu)
+        assert not host.existing_assignments  # wrong instance type
+        assert host.node_count == 1  # lands on a new c-1x-amd64 claim
+
+
+class TestLimits:
+    def test_node_count_limit(self):
+        from karpenter_tpu.models.nodepool import Limits
+
+        pool = default_pool()
+        pool.spec.limits = Limits(resources={"nodes": 2})
+        pods = [make_pod(f"p-{i}", cpu=0.5) for i in range(40)]
+        templates = build_templates([(pool, instance_types(8))])  # 1-cpu shapes
+        budgets = {"default": {"nodes": 2.0}}
+        host = HostScheduler(templates, budgets=budgets).solve(pods)
+        tpu = TPUScheduler(templates).solve(pods, budgets=budgets)
+        assert_same_packing(host, tpu)
+        assert host.node_count == 2
+        assert len(host.unschedulable) > 0
+
+    def test_cpu_limit_filters_instance_types(self):
+        pods = [make_pod(f"p-{i}", cpu=0.5) for i in range(4)]
+        templates = build_templates([(default_pool(), instance_types(64))])
+        # only 1-cpu and 2-cpu shapes fit a 2-cpu remaining budget
+        budgets = {"default": {res.CPU: 2.0}}
+        host = HostScheduler(templates, budgets=budgets).solve(pods)
+        tpu = TPUScheduler(templates).solve(pods, budgets=budgets)
+        assert_same_packing(host, tpu)
+        for c in host.claims:
+            assert all(it.capacity[res.CPU] <= 2.0 for it in c.instance_types)
+
+    def test_unlimited_pool_unaffected(self):
+        pods = [make_pod(f"p-{i}", cpu=0.5) for i in range(10)]
+        templates = build_templates([(default_pool(), instance_types(16))])
+        host = HostScheduler(templates).solve(pods)
+        tpu = TPUScheduler(templates).solve(pods)
+        assert_same_packing(host, tpu)
+        assert not host.unschedulable
+
+
 class TestRegressions:
     def test_scheduler_reuse_with_vocab_growth(self):
         """A second solve() whose pods introduce new label keys/values must
