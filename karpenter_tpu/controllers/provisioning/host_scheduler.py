@@ -20,8 +20,11 @@ from karpenter_tpu.cloudprovider.instancetype import AllocatableOfferings, Insta
 from karpenter_tpu.controllers.provisioning.nodeclaimtemplate import ClaimTemplate
 from karpenter_tpu.models import labels as l
 from karpenter_tpu.models.pod import Pod
-from karpenter_tpu.scheduling import Requirements
+from karpenter_tpu.scheduling import Operator, Requirement, Requirements
 from karpenter_tpu.scheduling.taints import tolerates_all
+
+if False:  # typing-only import to avoid a cycle
+    from karpenter_tpu.controllers.provisioning.topology import Topology
 from karpenter_tpu.utils import resources as res
 
 
@@ -35,6 +38,7 @@ class SimClaim:
     instance_types: list[InstanceType]
     pods: list[Pod] = field(default_factory=list)
     slot: int = 0
+    hostname: str = ""  # placeholder hostname (nodeclaim.go:93)
 
     def cheapest_launch(self) -> tuple[Optional[InstanceType], float]:
         """Cheapest (type, price) among viable types/offerings compatible
@@ -123,17 +127,31 @@ class HostScheduler:
         templates: list[ClaimTemplate],
         existing_nodes: Optional[list[ExistingSimNode]] = None,
         budgets: Optional[dict[str, dict[str, float]]] = None,
+        topology: Optional["Topology"] = None,
     ):
         """budgets: nodepool -> remaining resources (limits minus current
         usage; may include the synthetic 'nodes' count). Absent pool =
-        unlimited."""
+        unlimited. topology: pre-built Topology (counts seeded from the
+        live cluster); None disables topology handling."""
+        from karpenter_tpu.controllers.provisioning.topology import Topology as _T
+
         self.templates = templates
         self.existing_nodes = existing_nodes or []
         self.budgets = {k: dict(v) for k, v in (budgets or {}).items()}
+        self.topology = topology if topology is not None else _T()
+        self._hostname_seq = 0
+        for node in self.existing_nodes:
+            self.topology.register(l.LABEL_HOSTNAME, node.name)
+
+    def _next_hostname(self) -> str:
+        self._hostname_seq += 1
+        return f"hostname-placeholder-{self._hostname_seq:04d}"
 
     # -- tier 1: existing nodes (existingnode.go:84-135) ---------------------
 
-    def can_add_existing(self, node: ExistingSimNode, pod: Pod, pod_reqs: Requirements) -> bool:
+    def can_add_existing(
+        self, node: ExistingSimNode, pod: Pod, pod_reqs: Requirements, strict: Requirements
+    ) -> bool:
         if tolerates_all(node.taints, pod.spec.tolerations) is not None:
             return False
         total = res.merge(node.used, pod.total_requests())
@@ -142,31 +160,47 @@ class HostScheduler:
         # strict Compatible: no AllowUndefinedWellKnownLabels
         if node.requirements.compatible(pod_reqs) is not None:
             return False
-        node.requirements.add(*pod_reqs.values())
+        base = node.requirements.copy()
+        base.add(*pod_reqs.values())
+        tightened = self.topology.add_requirements(pod, strict, base)
+        if tightened is None or base.compatible(tightened) is not None:
+            return False
+        node.requirements = tightened
         node.used = total
         node.pods.append(pod)
+        self.topology.record(pod, tightened)
         return True
 
-    def can_add(self, claim: SimClaim, pod: Pod, pod_reqs: Requirements) -> Optional[SimClaim]:
+    def can_add(
+        self, claim: SimClaim, pod: Pod, pod_reqs: Requirements, strict: Requirements
+    ) -> Optional[SimClaim]:
         """Feasibility of adding pod to claim (nodeclaim.go:124-242);
-        returns the updated claim state or None."""
+        returns the updated claim state or None. On success the topology
+        counts are recorded — callers must commit the returned claim."""
         if tolerates_all(claim.template.taints, pod.spec.tolerations) is not None:
             return None
         if claim.requirements.compatible(pod_reqs, l.WELL_KNOWN_LABELS) is not None:
             return None
         combined = claim.requirements.copy()
         combined.add(*pod_reqs.values())
+        # topology comes last: it may collapse a key to a single domain
+        # (nodeclaim.go:199-210)
+        tightened = self.topology.add_requirements(pod, strict, combined)
+        if tightened is None or combined.compatible(tightened, l.WELL_KNOWN_LABELS) is not None:
+            return None
         total = res.merge(claim.used, pod.total_requests())
-        remaining = filter_instance_types(claim.instance_types, combined, total)
+        remaining = filter_instance_types(claim.instance_types, tightened, total)
         if not remaining:
             return None
+        self.topology.record(pod, tightened)
         return SimClaim(
             template=claim.template,
-            requirements=combined,
+            requirements=tightened,
             used=total,
             instance_types=remaining,
             pods=claim.pods + [pod],
             slot=claim.slot,
+            hostname=claim.hostname,
         )
 
     def _within_budget(self, tmpl: ClaimTemplate, its: list[InstanceType]) -> list[InstanceType]:
@@ -193,7 +227,9 @@ class HostScheduler:
             else:
                 budget[k] -= max((it.capacity.get(k, 0.0) for it in its), default=0.0)
 
-    def try_new_claim(self, pod: Pod, pod_reqs: Requirements, slot: int) -> Optional[SimClaim]:
+    def try_new_claim(
+        self, pod: Pod, pod_reqs: Requirements, strict: Requirements, slot: int
+    ) -> Optional[SimClaim]:
         for tmpl in self.templates:  # weight order (scheduler.go:695)
             budget = self.budgets.get(tmpl.nodepool_name)
             if budget is not None and budget.get("nodes", 1.0) < 1.0:
@@ -203,20 +239,32 @@ class HostScheduler:
             if tmpl.requirements.compatible(pod_reqs, l.WELL_KNOWN_LABELS) is not None:
                 continue
             combined = tmpl.requirements.copy()
+            # every new claim gets a placeholder hostname so hostname
+            # topologies see it as a fresh domain (nodeclaim.go:93-97)
+            hostname = self._next_hostname()
+            combined.add(Requirement.new(l.LABEL_HOSTNAME, Operator.IN, hostname))
             combined.add(*pod_reqs.values())
+            tightened = self.topology.add_requirements(pod, strict, combined)
+            if tightened is None or combined.compatible(tightened, l.WELL_KNOWN_LABELS) is not None:
+                self._hostname_seq -= 1  # hostname not consumed
+                continue
             total = res.merge(tmpl.daemon_requests, pod.total_requests())
             candidates = self._within_budget(tmpl, tmpl.instance_types)
-            remaining = filter_instance_types(candidates, combined, total)
+            remaining = filter_instance_types(candidates, tightened, total)
             if not remaining:
+                self._hostname_seq -= 1
                 continue
             self._charge_budget(tmpl, remaining)
+            self.topology.register(l.LABEL_HOSTNAME, hostname)
+            self.topology.record(pod, tightened)
             return SimClaim(
                 template=tmpl,
-                requirements=combined,
+                requirements=tightened,
                 used=total,
                 instance_types=remaining,
                 pods=[pod],
                 slot=slot,
+                hostname=hostname,
             )
         return None
 
@@ -227,10 +275,11 @@ class HostScheduler:
         existing_assignments: dict[str, str] = {}
         for pod in ffd_sort(pods):
             pod_reqs = Requirements.from_pod(pod)
+            strict = Requirements.from_pod(pod, include_preferred=False)
             # tier 1: existing nodes, earliest index wins (scheduler.go:594)
             placed = False
             for node in self.existing_nodes:
-                if self.can_add_existing(node, pod, pod_reqs):
+                if self.can_add_existing(node, pod, pod_reqs, strict):
                     existing_assignments[pod.uid] = node.name
                     placed = True
                     break
@@ -239,7 +288,7 @@ class HostScheduler:
             # tier 2: in-flight claims, fewest pods first, earliest slot
             # tie-break (scheduler.go:598-599)
             for claim in sorted(claims, key=lambda c: (len(c.pods), c.slot)):
-                updated = self.can_add(claim, pod, pod_reqs)
+                updated = self.can_add(claim, pod, pod_reqs, strict)
                 if updated is not None:
                     claims[claims.index(claim)] = updated
                     assignments[pod.uid] = updated.slot
@@ -247,7 +296,7 @@ class HostScheduler:
                     break
             if placed:
                 continue
-            new_claim = self.try_new_claim(pod, pod_reqs, slot=len(claims))
+            new_claim = self.try_new_claim(pod, pod_reqs, strict, slot=len(claims))
             if new_claim is not None:
                 claims.append(new_claim)
                 assignments[pod.uid] = new_claim.slot

@@ -172,6 +172,10 @@ class Provisioner:
         launchable = order_by_price(sim.instance_types, sim.requirements)[:MAX_INSTANCE_TYPES]
         requirements = []
         for r in sim.requirements.values():
+            # the simulation-only placeholder hostname must not leak into
+            # the persisted claim (nodeclaim.go:383-386 FinalizeScheduling)
+            if r.key == l.LABEL_HOSTNAME:
+                continue
             entry = {"key": r.key, "operator": r.operator().value}
             if r.values:
                 entry["values"] = sorted(r.values)

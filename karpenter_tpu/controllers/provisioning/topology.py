@@ -1,0 +1,379 @@
+"""Topology: spread constraints, pod affinity and pod anti-affinity.
+
+Counterpart of reference topology.go / topologygroup.go. Every TSC and
+(anti)affinity term becomes a TopologyGroup tracking a domain -> count map;
+placement tightens a candidate's requirements to the valid domains
+(AddRequirements) and commits counts on placement (Record).
+
+Semantics preserved from the reference:
+  * spread picks THE min-count valid domain (nextDomainTopologySpread);
+    'count + self - globalMin <= maxSkew' gates validity; minDomains forces
+    the global min to 0 while under-provisioned; hostname's global min is
+    always 0 because a new node is always creatable (topologygroup.go:229+)
+  * affinity allows any domain with a matching pod, with the bootstrap
+    rule: a self-selecting pod may seed an empty (or incompatible) group
+    (topologygroup.go:324+)
+  * anti-affinity blocks every domain a matching pod could be in; owners
+    record ALL their possible domains, and pods matched by someone else's
+    anti-affinity selector inherit the restriction via inverse groups
+    (topology.go:200-220)
+
+Selector matching is matchLabels-based (our Pod model); namespaces default
+to the pod's own.
+"""
+
+from __future__ import annotations
+
+import enum
+from collections import defaultdict
+from typing import Iterable, Optional
+
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.scheduling import Operator, Requirement, Requirements
+
+MAX_I32 = 2**31 - 1
+
+
+class TopologyType(enum.Enum):
+    SPREAD = "topology spread"
+    AFFINITY = "pod affinity"
+    ANTI_AFFINITY = "pod anti-affinity"
+
+
+def _selects(selector: dict[str, str], pod: Pod) -> bool:
+    if selector is None:
+        return False
+    return all(pod.metadata.labels.get(k) == v for k, v in selector.items())
+
+
+class TopologyGroup:
+    def __init__(
+        self,
+        ttype: TopologyType,
+        key: str,
+        selector: dict[str, str],
+        max_skew: int = 1,
+        min_domains: Optional[int] = None,
+        namespaces: Optional[frozenset[str]] = None,
+        initial_domains: Iterable[str] = (),
+    ):
+        self.type = ttype
+        self.key = key
+        self.selector = selector
+        self.max_skew = max_skew
+        self.min_domains = min_domains
+        self.namespaces = namespaces or frozenset({"default"})
+        self.domains: dict[str, int] = {d: 0 for d in initial_domains}
+        self.owners: set[str] = set()  # pod uids
+
+    # -- identity (topologygroup.go Hash) ---------------------------------
+
+    def ident(self) -> tuple:
+        return (
+            self.type,
+            self.key,
+            tuple(sorted(self.selector.items())),
+            self.max_skew,
+            self.min_domains,
+            tuple(sorted(self.namespaces)),
+        )
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def register(self, *domains: str) -> None:
+        for d in domains:
+            self.domains.setdefault(d, 0)
+
+    def record(self, *domains: str) -> None:
+        for d in domains:
+            self.domains[d] = self.domains.get(d, 0) + 1
+
+    def selects(self, pod: Pod) -> bool:
+        return pod.metadata.namespace in self.namespaces and _selects(self.selector, pod)
+
+    def is_empty(self) -> bool:
+        return all(c == 0 for c in self.domains.values())
+
+    # -- the domain chooser (topologygroup.go:150-400) ----------------------
+
+    def get(self, pod: Pod, pod_domains: Requirement, node_domains: Requirement) -> Requirement:
+        if self.type is TopologyType.SPREAD:
+            return self._next_spread(pod, pod_domains, node_domains)
+        if self.type is TopologyType.AFFINITY:
+            return self._next_affinity(pod, pod_domains, node_domains)
+        return self._next_anti_affinity(pod_domains, node_domains)
+
+    def _domain_min_count(self, pod_domains: Requirement) -> int:
+        if self.key == l.LABEL_HOSTNAME:
+            return 0  # a new node is always creatable
+        lo_count = MAX_I32
+        supported = 0
+        for domain, count in self.domains.items():
+            if pod_domains.has(domain):
+                supported += 1
+                lo_count = min(lo_count, count)
+        if self.min_domains is not None and supported < self.min_domains:
+            return 0
+        return lo_count
+
+    def _next_spread(self, pod: Pod, pod_domains: Requirement, node_domains: Requirement) -> Requirement:
+        min_count = self._domain_min_count(pod_domains)
+        self_add = 1 if self.selects(pod) else 0
+
+        # hostname with a single concrete node domain: new claims' domains
+        # aren't registered yet; global min is 0 (topologygroup.go:229-246)
+        if self.key == l.LABEL_HOSTNAME and node_domains.operator() is Operator.IN and len(node_domains.values) == 1:
+            hostname = next(iter(node_domains.values))
+            count = self.domains.get(hostname, 0) + self_add
+            if count <= self.max_skew:
+                return Requirement.new(self.key, Operator.IN, hostname)
+            return Requirement.new(self.key, Operator.DOES_NOT_EXIST)
+
+        best_domain, best_count = None, MAX_I32
+        for domain in sorted(self.domains):  # sorted: deterministic tie-break
+            if not node_domains.has(domain) or not pod_domains.has(domain):
+                continue
+            count = self.domains[domain] + self_add
+            if count - min_count <= self.max_skew and count < best_count:
+                best_domain, best_count = domain, count
+        if best_domain is None:
+            return Requirement.new(self.key, Operator.DOES_NOT_EXIST)
+        return Requirement.new(self.key, Operator.IN, best_domain)
+
+    def _any_compatible_pod_domain(self, pod_domains: Requirement) -> bool:
+        return any(pod_domains.has(d) and c > 0 for d, c in self.domains.items())
+
+    def _next_affinity(self, pod: Pod, pod_domains: Requirement, node_domains: Requirement) -> Requirement:
+        options: list[str] = []
+        if self.key == l.LABEL_HOSTNAME and node_domains.operator() is Operator.IN and len(node_domains.values) == 1:
+            hostname = next(iter(node_domains.values))
+            if not pod_domains.has(hostname):
+                return Requirement.new(self.key, Operator.DOES_NOT_EXIST)
+            if self.domains.get(hostname, 0) > 0:
+                return Requirement.new(self.key, Operator.IN, hostname)
+            if self.selects(pod) and (self.is_empty() or not self._any_compatible_pod_domain(pod_domains)):
+                return Requirement.new(self.key, Operator.IN, hostname)
+            return Requirement.new(self.key, Operator.DOES_NOT_EXIST)
+
+        for domain in sorted(self.domains):
+            if pod_domains.has(domain) and self.domains[domain] > 0 and node_domains.has(domain):
+                options.append(domain)
+        if options:
+            return Requirement.new(self.key, Operator.IN, *options)
+        # bootstrap: self-selecting first pod may seed a domain
+        if self.selects(pod) and (self.is_empty() or not self._any_compatible_pod_domain(pod_domains)):
+            for domain in sorted(self.domains):
+                if pod_domains.has(domain) and node_domains.has(domain):
+                    return Requirement.new(self.key, Operator.IN, domain)
+            for domain in sorted(self.domains):
+                if pod_domains.has(domain):
+                    return Requirement.new(self.key, Operator.IN, domain)
+        return Requirement.new(self.key, Operator.DOES_NOT_EXIST)
+
+    def _next_anti_affinity(self, pod_domains: Requirement, node_domains: Requirement) -> Requirement:
+        options = [
+            d
+            for d in sorted(self.domains)
+            if pod_domains.has(d) and node_domains.has(d) and self.domains[d] == 0
+        ]
+        # hostname: a fresh node is always an empty domain; admit the node's
+        # single concrete hostname if it has no count yet
+        if self.key == l.LABEL_HOSTNAME and node_domains.operator() is Operator.IN:
+            for hostname in node_domains.values:
+                if hostname not in self.domains and pod_domains.has(hostname) and hostname not in options:
+                    options.append(hostname)
+        if not options:
+            return Requirement.new(self.key, Operator.DOES_NOT_EXIST)
+        return Requirement.new(self.key, Operator.IN, *options)
+
+
+def build_universe_domains(templates, existing_nodes=()) -> dict[str, set[str]]:
+    """key -> all REACHABLE domains (topology.go:105-145 buildDomainGroups):
+    template In-requirement values, plus instance-type domain values that
+    the template's requirements admit (NotIn exclusions and filtered-out
+    instance-type domains must NOT enter the universe — a permanently-zero
+    domain would pin the spread global min at 0)."""
+    domains: dict[str, set[str]] = defaultdict(set)
+    for t in templates:
+        for r in t.requirements:
+            if r.operator() is Operator.IN:
+                domains[r.key].update(r.values)
+        for it in t.instance_types:
+            for r in it.requirements:
+                if r.operator() is not Operator.IN:
+                    continue
+                tmpl_req = t.requirements.get(r.key)
+                domains[r.key].update(v for v in r.values if tmpl_req.has(v))
+    for n in existing_nodes:
+        for r in n.requirements:
+            if r.operator() is Operator.IN:
+                domains[r.key].update(r.values)
+    return dict(domains)
+
+
+class Topology:
+    """All topology groups for one Solve, seeded from the live cluster."""
+
+    def __init__(self) -> None:
+        self.groups: list[TopologyGroup] = []
+        self.inverse_groups: list[TopologyGroup] = []
+        self._by_ident: dict[tuple, TopologyGroup] = {}
+
+    # -- construction (topology.go:68-145) ----------------------------------
+
+    @staticmethod
+    def build(
+        pods: list[Pod],
+        universe_domains: dict[str, set[str]],
+        bound_pods: Optional[list[tuple[Pod, dict[str, str]]]] = None,
+    ) -> "Topology":
+        """universe_domains: key -> all known domains (from nodepools +
+        instance types + live nodes; buildDomainGroups). bound_pods: pods
+        already placed, with their node's labels — seeds initial counts
+        (topology.go:361-459 countDomains)."""
+        topo = Topology()
+        for pod in pods:
+            for tsc in pod.spec.topology_spread_constraints:
+                if tsc.when_unsatisfiable == "ScheduleAnyway":
+                    # soft constraint: enforced only until the preference
+                    # relaxation ladder (preferences.go:38) strips it; until
+                    # that ladder lands, skip rather than hard-block pods
+                    continue
+                g = topo._ensure(
+                    TopologyType.SPREAD,
+                    tsc.topology_key,
+                    tsc.label_selector,
+                    tsc.max_skew,
+                    tsc.min_domains,
+                    pod,
+                    universe_domains.get(tsc.topology_key, set()),
+                )
+                g.owners.add(pod.uid)
+            for term in pod.spec.pod_affinity:
+                g = topo._ensure(
+                    TopologyType.AFFINITY,
+                    term.topology_key,
+                    term.label_selector,
+                    1,
+                    None,
+                    pod,
+                    universe_domains.get(term.topology_key, set()),
+                )
+                g.owners.add(pod.uid)
+            for term in pod.spec.pod_anti_affinity:
+                g = topo._ensure(
+                    TopologyType.ANTI_AFFINITY,
+                    term.topology_key,
+                    term.label_selector,
+                    1,
+                    None,
+                    pod,
+                    universe_domains.get(term.topology_key, set()),
+                )
+                g.owners.add(pod.uid)
+                # the inverse group records where THIS pod lands so future
+                # pods matching the selector avoid it (topology.go:330-356)
+                ig = topo._ensure_inverse(
+                    term.topology_key,
+                    term.label_selector,
+                    universe_domains.get(term.topology_key, set()),
+                    pod.metadata.namespace,
+                )
+                ig.owners.add(pod.uid)
+        # seed counts from already-bound pods
+        for pod, node_labels in bound_pods or []:
+            for g in topo.groups:
+                domain = node_labels.get(g.key)
+                if domain is not None and g.selects(pod):
+                    g.record(domain)
+            # a bound pod with an anti-affinity term blocks its domain for
+            # every pod matching that selector (updateInverseAffinities)
+            for term in pod.spec.pod_anti_affinity:
+                ig = topo._ensure_inverse(
+                    term.topology_key,
+                    term.label_selector,
+                    universe_domains.get(term.topology_key, set()),
+                    pod.metadata.namespace,
+                )
+                ig.owners.add(pod.uid)
+                domain = node_labels.get(term.topology_key)
+                if domain is not None:
+                    ig.record(domain)
+        return topo
+
+    def _ensure(self, ttype, key, selector, max_skew, min_domains, pod, domains) -> TopologyGroup:
+        g = TopologyGroup(
+            ttype,
+            key,
+            selector,
+            max_skew,
+            min_domains,
+            frozenset({pod.metadata.namespace}),
+            domains,
+        )
+        existing = self._by_ident.get(g.ident())
+        if existing is not None:
+            return existing
+        self._by_ident[g.ident()] = g
+        self.groups.append(g)
+        return g
+
+    def _ensure_inverse(self, key, selector, domains, namespace: str) -> TopologyGroup:
+        g = TopologyGroup(
+            TopologyType.ANTI_AFFINITY, key, selector, 1, None, frozenset({namespace}), domains
+        )
+        ident = ("inverse",) + g.ident()
+        existing = self._by_ident.get(ident)
+        if existing is not None:
+            return existing
+        self._by_ident[ident] = g
+        self.inverse_groups.append(g)
+        return g
+
+    def register(self, key: str, domain: str) -> None:
+        for g in self.groups + self.inverse_groups:
+            if g.key == key:
+                g.register(domain)
+
+    # -- the per-candidate hook (topology.go:226-250) ------------------------
+
+    def matching_groups(self, pod: Pod) -> list[TopologyGroup]:
+        """Direct groups the pod owns + inverse groups whose anti-affinity
+        selector matches the pod (getMatchingTopologies, topology.go:561)."""
+        out = [g for g in self.groups if pod.uid in g.owners]
+        out.extend(g for g in self.inverse_groups if g.selects(pod))
+        return out
+
+    def add_requirements(
+        self, pod: Pod, pod_reqs: Requirements, node_reqs: Requirements
+    ) -> Optional[Requirements]:
+        """Tighten node_reqs with each matching group's valid domains;
+        None if any group has no valid domain (candidate infeasible)."""
+        requirements = node_reqs.copy()
+        for g in self.matching_groups(pod):
+            pod_domains = pod_reqs.get(g.key)
+            node_domains = requirements.get(g.key)
+            domains = g.get(pod, pod_domains, node_domains)
+            if len(domains) == 0:
+                return None
+            requirements.add(domains)
+        return requirements
+
+    # -- commit (topology.go:190-220 Record) ---------------------------------
+
+    def record(self, pod: Pod, requirements: Requirements) -> None:
+        """Commit the placed pod's domains (topology.go:190-220): any group
+        whose selector matches the pod counts it — anti-affinity records
+        every possible domain, others only a collapsed single domain; the
+        pod's own inverse groups record all its candidate domains."""
+        for g in self.groups:
+            if g.selects(pod):
+                domains = requirements.get(g.key)
+                if g.type is TopologyType.ANTI_AFFINITY:
+                    g.record(*sorted(domains.values))
+                elif domains.operator() is Operator.IN and len(domains.values) == 1:
+                    g.record(next(iter(domains.values)))
+        for g in self.inverse_groups:
+            if pod.uid in g.owners:
+                g.record(*sorted(requirements.get(g.key).values))

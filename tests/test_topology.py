@@ -1,0 +1,276 @@
+"""Topology semantics: spread skew, pod affinity, pod anti-affinity —
+behavioral parity with reference topology_test.go expectations (ExpectSkew
+analog) on the host scheduler."""
+
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.controllers.provisioning import HostScheduler, build_templates
+from karpenter_tpu.controllers.provisioning.topology import (
+    Topology,
+    build_universe_domains,
+)
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import PodAffinityTerm, TopologySpreadConstraint, make_pod
+
+
+def default_pool(name="default"):
+    pool = NodePool()
+    pool.metadata.name = name
+    return pool
+
+
+def spread_pods(n, key, max_skew=1, labels=None, cpu=0.5):
+    labels = labels or {"app": "web"}
+    pods = []
+    for i in range(n):
+        p = make_pod(f"sp-{i}", cpu=cpu)
+        p.metadata.labels = dict(labels)
+        p.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(max_skew=max_skew, topology_key=key, label_selector=dict(labels))
+        ]
+        pods.append(p)
+    return pods
+
+
+def build_host(pods, n_types=32, templates=None):
+    templates = templates or build_templates([(default_pool(), instance_types(n_types))])
+    universe = build_universe_domains(templates)
+    topo = Topology.build(pods, universe)
+    return HostScheduler(templates, topology=topo), templates
+
+
+def zone_distribution(result):
+    dist = {}
+    for c in result.claims:
+        zone_req = c.requirements.get(l.LABEL_TOPOLOGY_ZONE)
+        zones = sorted(zone_req.values)
+        assert len(zones) == 1, f"claim zone not collapsed: {zones}"
+        dist[zones[0]] = dist.get(zones[0], 0) + len(c.pods)
+    return dist
+
+
+class TestZonalSpread:
+    def test_even_spread_across_zones(self):
+        pods = spread_pods(12, l.LABEL_TOPOLOGY_ZONE)
+        host, _ = build_host(pods)
+        result = host.solve(pods)
+        assert not result.unschedulable
+        dist = zone_distribution(result)
+        # 4 zones in the fake catalog; 12 pods -> 3 per zone at maxSkew 1
+        assert len(dist) == 4
+        assert max(dist.values()) - min(dist.values()) <= 1
+
+    def test_uneven_count_respects_skew(self):
+        pods = spread_pods(10, l.LABEL_TOPOLOGY_ZONE)
+        host, _ = build_host(pods)
+        result = host.solve(pods)
+        dist = zone_distribution(result)
+        assert sum(dist.values()) == 10
+        assert max(dist.values()) - min(dist.values()) <= 1
+
+    def test_spread_with_max_skew_2(self):
+        pods = spread_pods(8, l.LABEL_TOPOLOGY_ZONE, max_skew=2)
+        host, _ = build_host(pods)
+        result = host.solve(pods)
+        dist = zone_distribution(result)
+        assert max(dist.values()) - min(dist.values()) <= 2
+
+    def test_unrelated_pods_dont_count(self):
+        spread = spread_pods(4, l.LABEL_TOPOLOGY_ZONE)
+        others = [make_pod(f"other-{i}", cpu=0.5) for i in range(6)]
+        host, _ = build_host(spread + others)
+        result = host.solve(spread + others)
+        assert not result.unschedulable
+        # only the 4 labeled pods spread; distribution over them is even
+        counts = {}
+        for c in result.claims:
+            n = sum(1 for p in c.pods if p.metadata.labels.get("app") == "web")
+            if n:
+                zone = sorted(c.requirements.get(l.LABEL_TOPOLOGY_ZONE).values)[0]
+                counts[zone] = counts.get(zone, 0) + n
+        assert sum(counts.values()) == 4
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+
+class TestHostnameSpread:
+    def test_one_pod_per_node(self):
+        pods = spread_pods(5, l.LABEL_HOSTNAME, max_skew=1)
+        host, _ = build_host(pods, n_types=64)
+        result = host.solve(pods)
+        assert not result.unschedulable
+        # hostname spread with skew 1: since a new node is always creatable
+        # (global min 0), each claim holds at most 1 matching pod
+        for c in result.claims:
+            matching = [p for p in c.pods if p.metadata.labels.get("app") == "web"]
+            assert len(matching) <= 1
+        assert len(result.claims) == 5
+
+
+class TestPodAntiAffinity:
+    def test_zone_anti_affinity_with_zone_selectors(self):
+        """Reference 'should not violate pod anti-affinity on zone'
+        (topology_test.go:2319): zone-pinned pods collapse their claims, so
+        self-anti-affinity separates them; a fourth floating pod is blocked
+        because every zone has a matching pod."""
+        pods = []
+        for i, zone in enumerate(["test-zone-1", "test-zone-2", "test-zone-3"]):
+            p = make_pod(f"aa-{i}", cpu=2.0, node_selector={l.LABEL_TOPOLOGY_ZONE: zone})
+            p.metadata.labels = {"security": "s2"}
+            pods.append(p)
+        aff = make_pod("aff", cpu=0.25)
+        aff.spec.pod_anti_affinity = [
+            PodAffinityTerm(topology_key=l.LABEL_TOPOLOGY_ZONE, label_selector={"security": "s2"})
+        ]
+        host, _ = build_host(pods + [aff])
+        result = host.solve(pods + [aff])
+        dist = zone_distribution(result)
+        assert dist.get("test-zone-4", 0) >= 0  # zone-4 is the only free zone
+        # the three pinned pods scheduled; aff only fits zone-4
+        assert {"test-zone-1", "test-zone-2", "test-zone-3"} <= set(dist)
+        aff_claims = [c for c in result.claims if any(p.name == "aff" for p in c.pods)]
+        assert len(aff_claims) == 1
+        assert sorted(aff_claims[0].requirements.get(l.LABEL_TOPOLOGY_ZONE).values) == ["test-zone-4"]
+
+    def test_schroedinger_blocks_same_pass(self):
+        """Reference 'Schrödinger' case (topology_test.go:2499): an
+        anti-affinity owner whose zone never collapses records every zone,
+        blocking matching pods within the same Solve."""
+        anywhere = make_pod("anywhere", cpu=2.0)
+        anywhere.spec.pod_anti_affinity = [
+            PodAffinityTerm(topology_key=l.LABEL_TOPOLOGY_ZONE, label_selector={"security": "s2"})
+        ]
+        target = make_pod("target", cpu=0.25)
+        target.metadata.labels = {"security": "s2"}
+        host, _ = build_host([anywhere, target])
+        result = host.solve([anywhere, target])
+        assert [p.name for p, _ in result.unschedulable] == ["target"]
+
+    def test_self_anti_affinity_zone_first_pass(self):
+        """Self-anti-affinity without zone pins: the first owner takes all
+        (uncollapsed) zones; the rest defer to later passes."""
+        pods = []
+        for i in range(3):
+            p = make_pod(f"aa-{i}", cpu=0.5)
+            p.metadata.labels = {"app": "db"}
+            p.spec.pod_anti_affinity = [
+                PodAffinityTerm(topology_key=l.LABEL_TOPOLOGY_ZONE, label_selector={"app": "db"})
+            ]
+            pods.append(p)
+        host, _ = build_host(pods)
+        result = host.solve(pods)
+        assert len(result.unschedulable) == 2
+
+    def test_hostname_anti_affinity(self):
+        pods = []
+        for i in range(4):
+            p = make_pod(f"ha-{i}", cpu=0.25)
+            p.metadata.labels = {"app": "db"}
+            p.spec.pod_anti_affinity = [
+                PodAffinityTerm(topology_key=l.LABEL_HOSTNAME, label_selector={"app": "db"})
+            ]
+            pods.append(p)
+        host, _ = build_host(pods, n_types=64)
+        result = host.solve(pods)
+        assert not result.unschedulable
+        for c in result.claims:
+            assert len([p for p in c.pods if p.metadata.labels.get("app") == "db"]) == 1
+
+    def test_inverse_anti_affinity_blocks_matched_pods(self):
+        """A zone-pinned pod with anti-affinity against app=web blocks
+        app=web pods from that zone only (inverse groups)."""
+        guard = make_pod(
+            "guard", cpu=4.0, node_selector={l.LABEL_TOPOLOGY_ZONE: "test-zone-1"}
+        )  # big: FFD places it first
+        guard.metadata.labels = {"role": "guard"}
+        guard.spec.pod_anti_affinity = [
+            PodAffinityTerm(topology_key=l.LABEL_TOPOLOGY_ZONE, label_selector={"app": "web"})
+        ]
+        web = make_pod("web", cpu=0.25)
+        web.metadata.labels = {"app": "web"}
+        pods = [guard, web]
+        host, _ = build_host(pods)
+        result = host.solve(pods)
+        assert not result.unschedulable
+        by_name = {}
+        for c in result.claims:
+            zone = sorted(c.requirements.get(l.LABEL_TOPOLOGY_ZONE).values)
+            for p in c.pods:
+                by_name[p.name] = zone
+        assert by_name["guard"] == ["test-zone-1"]
+        assert "test-zone-1" not in by_name["web"]
+
+
+class TestUniverseDomains:
+    def test_notin_exclusions_not_in_universe(self):
+        """A NodePool excluding a zone must not leave that zone in the
+        universe as a permanently-empty domain (pins spread min at 0)."""
+        pool = default_pool()
+        pool.spec.template.spec.requirements = [
+            {"key": l.LABEL_TOPOLOGY_ZONE, "operator": "NotIn", "values": ["test-zone-4"]}
+        ]
+        templates = build_templates([(pool, instance_types(32))])
+        universe = build_universe_domains(templates)
+        assert "test-zone-4" not in universe[l.LABEL_TOPOLOGY_ZONE]
+        pods = spread_pods(6, l.LABEL_TOPOLOGY_ZONE)
+        topo = Topology.build(pods, universe)
+        host = HostScheduler(templates, topology=topo)
+        result = host.solve(pods)
+        assert not result.unschedulable
+        dist = zone_distribution(result)
+        assert set(dist) == {"test-zone-1", "test-zone-2", "test-zone-3"}
+        assert max(dist.values()) - min(dist.values()) <= 1
+
+    def test_schedule_anyway_tsc_is_soft(self):
+        pods = spread_pods(6, l.LABEL_TOPOLOGY_ZONE)
+        for p in pods:
+            p.spec.topology_spread_constraints[0].when_unsatisfiable = "ScheduleAnyway"
+        host, _ = build_host(pods)
+        result = host.solve(pods)
+        assert not result.unschedulable
+
+    def test_inverse_namespace_isolation(self):
+        """Anti-affinity enforcement must work in any namespace."""
+        anywhere = make_pod("anywhere", cpu=2.0)
+        anywhere.metadata.namespace = "prod"
+        anywhere.spec.pod_anti_affinity = [
+            PodAffinityTerm(topology_key=l.LABEL_TOPOLOGY_ZONE, label_selector={"security": "s2"})
+        ]
+        target = make_pod("target", cpu=0.25)
+        target.metadata.namespace = "prod"
+        target.metadata.labels = {"security": "s2"}
+        host, _ = build_host([anywhere, target])
+        result = host.solve([anywhere, target])
+        assert [p.name for p, _ in result.unschedulable] == ["target"]
+
+
+class TestPodAffinity:
+    def test_affinity_colocates(self):
+        leader = make_pod("leader", cpu=2.0)
+        leader.metadata.labels = {"app": "cache"}
+        leader.spec.pod_affinity = [
+            PodAffinityTerm(topology_key=l.LABEL_TOPOLOGY_ZONE, label_selector={"app": "cache"})
+        ]
+        followers = []
+        for i in range(3):
+            p = make_pod(f"f-{i}", cpu=0.25)
+            p.metadata.labels = {"app": "cache"}
+            p.spec.pod_affinity = [
+                PodAffinityTerm(topology_key=l.LABEL_TOPOLOGY_ZONE, label_selector={"app": "cache"})
+            ]
+            followers.append(p)
+        pods = [leader] + followers
+        host, _ = build_host(pods)
+        result = host.solve(pods)
+        assert not result.unschedulable
+        dist = zone_distribution(result)
+        assert len(dist) == 1  # all in one zone
+
+    def test_affinity_to_absent_pods_unschedulable(self):
+        p = make_pod("lonely", cpu=0.5)
+        p.metadata.labels = {"app": "x"}  # does NOT match the selector
+        p.spec.pod_affinity = [
+            PodAffinityTerm(topology_key=l.LABEL_TOPOLOGY_ZONE, label_selector={"app": "absent"})
+        ]
+        host, _ = build_host([p])
+        result = host.solve([p])
+        assert len(result.unschedulable) == 1
