@@ -30,11 +30,13 @@ from karpenter_tpu.controllers.provisioning.host_scheduler import (
     filter_instance_types,
 )
 from karpenter_tpu.controllers.provisioning.nodeclaimtemplate import ClaimTemplate
+from karpenter_tpu.controllers.provisioning.topology import Topology, build_universe_domains
 from karpenter_tpu.models import labels as l
 from karpenter_tpu.models.pod import Pod
 from karpenter_tpu.ops import solver as ops_solver
+from karpenter_tpu.ops import topology as topo_ops
 from karpenter_tpu.ops.encode import ProblemEncoder, encode_requirements
-from karpenter_tpu.scheduling import Requirements
+from karpenter_tpu.scheduling import Operator, Requirement, Requirements
 from karpenter_tpu.scheduling.taints import tolerates_all
 from karpenter_tpu.utils import resources as res
 
@@ -169,9 +171,23 @@ class TPUScheduler:
         pods: Sequence[Pod],
         existing_nodes: Optional[list[ExistingSimNode]] = None,
         budgets: Optional[dict[str, dict[str, float]]] = None,
+        topology: Optional[Topology] = None,
     ) -> SchedulingResult:
         self.existing_nodes = existing_nodes or []
         self.budgets = {k: dict(v) for k, v in (budgets or {}).items()}
+        if topology is None:
+            universe = build_universe_domains(self.templates, self.existing_nodes)
+            topology = Topology.build(list(pods), universe)
+        self.topology = topology
+        for node in self.existing_nodes:
+            topology.register(l.LABEL_HOSTNAME, node.name)
+        # topology keys/domains must be in the vocab before pads freeze
+        for g in topology.groups + topology.inverse_groups:
+            if g.key in self.encoder.skip_keys:
+                continue
+            self.encoder.vocab.add_key(g.key)
+            for d in g.domains:
+                self.encoder.vocab.add_value(g.key, d)
         pods_sorted = ffd_sort(list(pods))
         for p in pods_sorted:
             self.encoder.observe_pod(p)
@@ -219,13 +235,29 @@ class TPUScheduler:
                     r = rq.get(l.LABEL_INSTANCE_TYPE)
                     ok = r.has(it_name) if it_name is not None else r.is_lenient()
                 exist_ok[i, e] = ok
+        strict_sets = [Requirements.from_pod(p, include_preferred=False) for p in padded]
+        strict_reqs = encode_requirements(
+            self.encoder.vocab, strict_sets, k_pad, v_pad, self.encoder.skip_keys
+        )
         requests = np.stack([self.encoder.resources_vector(p.total_requests()) for p in padded])
         pt = ops_solver.PodTensors(
             reqs=reqs,
-            strict_reqs=reqs,  # relaxation ladder lands in a later phase
+            strict_reqs=strict_reqs,
             requests=jnp.asarray(requests, dtype=jnp.float32),
             valid=jnp.asarray([True] * P + [False] * (P_pad - P), dtype=bool),
         )
+        # topology tensors (counts + per-pod group relations); the hostname
+        # slot space gets one spare column so tier-3's fresh-slot read stays
+        # in bounds when every claim slot is open
+        topo_tensors, vg, hg = topo_ops.encode_topology(
+            self.topology,
+            self.encoder,
+            E,
+            n_claims + 1,
+            [n.name for n in self.existing_nodes],
+        )
+        topo_tensors = topo_ops.pad_to_v(topo_tensors, v_pad)
+        pod_topo = topo_ops.encode_pod_topology(self.topology, vg, hg, padded, strict_reqs)
         # toleration matrix [P, G] host-side: taint sets are static per template
         tol = np.zeros((P_pad, len(self.templates)), dtype=bool)
         for i, p in enumerate(padded):
@@ -242,6 +274,8 @@ class TPUScheduler:
             self.it_tensors,
             template_tensors,
             self.well_known,
+            topo_tensors,
+            pod_topo,
             zone_kid=zone_kid,
             ct_kid=ct_kid,
             n_claims=n_claims,
@@ -252,8 +286,9 @@ class TPUScheduler:
         """Replay assignments host-side to rebuild exact claim objects.
 
         The device decides WHO goes WHERE; the host re-derives each claim's
-        Requirements/viable types with the oracle-grade Python algebra, so
-        emitted NodeClaims carry exact reference semantics.
+        Requirements (incl. topology narrowing + count recording) with the
+        oracle-grade Python algebra, so emitted NodeClaims carry exact
+        reference semantics.
         """
         assignment = np.asarray(result.assignment)[: len(pods_sorted)]
         claim_template = np.asarray(result.claims.template)
@@ -261,6 +296,7 @@ class TPUScheduler:
         from karpenter_tpu.controllers.provisioning.host_scheduler import HostScheduler
 
         hs = HostScheduler(self.templates, budgets=self.budgets)
+        topo = self.topology
 
         claims: list[SimClaim] = []
         slot_to_claim: dict[int, SimClaim] = {}
@@ -276,12 +312,22 @@ class TPUScheduler:
                 unschedulable.append((pod, "no compatible in-flight claim or template"))
                 continue
             pod_reqs = Requirements.from_pod(pod)
+            strict = Requirements.from_pod(pod, include_preferred=False)
             if slot < E:
                 # tier 1: existing node (host replay of the commit)
                 node = self.existing_nodes[slot]
-                node.requirements.add(*pod_reqs.values())
+                base = node.requirements.copy()
+                base.add(*pod_reqs.values())
+                tightened = topo.add_requirements(pod, strict, base)
+                if tightened is None:
+                    raise RuntimeError(
+                        f"device/host divergence: topology rejected pod {pod.name} "
+                        f"on existing node {node.name}"
+                    )
+                node.requirements = tightened
                 node.used = res.merge(node.used, pod.total_requests())
                 node.pods.append(pod)
+                topo.record(pod, tightened)
                 existing_assignments[pod.uid] = node.name
                 continue
             slot -= E
@@ -290,19 +336,33 @@ class TPUScheduler:
             newly_created = claim is None
             if newly_created:
                 tmpl = self.templates[int(claim_template[slot])]
+                hostname = hs._next_hostname()
+                requirements = tmpl.requirements.copy()
+                requirements.add(Requirement.new(l.LABEL_HOSTNAME, Operator.IN, hostname))
                 claim = SimClaim(
                     template=tmpl,
-                    requirements=tmpl.requirements.copy(),
+                    requirements=requirements,
                     used=dict(tmpl.daemon_requests),
                     instance_types=hs._within_budget(tmpl, tmpl.instance_types),
                     pods=[],
                     slot=slot,
+                    hostname=hostname,
                 )
                 slot_to_claim[slot] = claim
                 claims.append(claim)
-            claim.requirements.add(*pod_reqs.values())
+                topo.register(l.LABEL_HOSTNAME, hostname)
+            combined = claim.requirements.copy()
+            combined.add(*pod_reqs.values())
+            tightened = topo.add_requirements(pod, strict, combined)
+            if tightened is None:
+                raise RuntimeError(
+                    f"device/host divergence: topology rejected pod {pod.name} "
+                    f"on claim slot {slot}"
+                )
+            claim.requirements = tightened
             claim.used = res.merge(claim.used, pod.total_requests())
             claim.pods.append(pod)
+            topo.record(pod, tightened)
             if newly_created:
                 # charge the pool budget with the first-pod viable set
                 # (subtractMax happens at claim creation, scheduler.go:791)

@@ -39,7 +39,9 @@ import jax
 import jax.numpy as jnp
 
 from karpenter_tpu.ops import kernels
-from karpenter_tpu.ops.encode import InstanceTypeTensors, PodTensors, ReqSetTensors
+from karpenter_tpu.ops import topology as topo_ops
+from karpenter_tpu.ops.encode import INT_MAX, INT_MIN, InstanceTypeTensors, PodTensors, ReqSetTensors
+from karpenter_tpu.ops.topology import PodTopology, TopologyTensors
 
 # assignment sentinels
 NO_CLAIM = -1  # no compatible existing node, in-flight claim, or template
@@ -84,6 +86,9 @@ class SolverState(NamedTuple):
     # limits
     budget: jnp.ndarray  # [G, R]
     nodes_budget: jnp.ndarray  # [G]
+    # topology counts
+    vg_counts: jnp.ndarray  # [NGv, V]
+    hg_counts: jnp.ndarray  # [NGh, E+N]
 
 
 class SolveResult(NamedTuple):
@@ -153,6 +158,8 @@ def solve(
     it: InstanceTypeTensors,
     templates: Templates,
     well_known: jnp.ndarray,  # [K] bool
+    topo: TopologyTensors,
+    pod_topo: PodTopology,
     zone_kid: int,
     ct_kid: int,
     n_claims: int,
@@ -167,7 +174,21 @@ def solve(
     no_wk = jnp.zeros_like(well_known)
 
     def step(state: SolverState, xs):
-        pod_reqs, pod_requests, tmpl_ok_g, it_allow, exist_ok_e, pod_valid = xs
+        (
+            pod_reqs,
+            pod_requests,
+            tmpl_ok_g,
+            it_allow,
+            exist_ok_e,
+            pod_valid,
+            vg_applies,
+            vg_records,
+            vg_self,
+            hg_applies,
+            hg_records,
+            hg_self,
+            strict_mask,
+        ) = xs
 
         # ---- tier 1: existing nodes (earliest index wins) -----------------
         pod_e = _broadcast_pod(pod_reqs, E)
@@ -178,7 +199,17 @@ def solve(
         total_e = state.exist_used + pod_requests[None, :]
         t_e = total_e
         exist_fit = jnp.all((t_e <= exist.avail) | (t_e == 0.0), axis=-1)
-        feas_e = exist.valid & exist_ok_e & exist_compat & exist_fit & pod_valid
+        vg_pre = topo_ops.vg_pod_precompute(
+            topo, state.vg_counts, strict_mask, vg_applies, vg_self, K
+        )
+        key_touched = vg_pre.key_touched
+        topo_e, upd_e, _ = topo_ops.vg_evaluate(topo, vg_pre, comb_e.mask)
+        topo_eh = topo_ops.hg_evaluate(
+            topo, state.hg_counts, jnp.arange(E, dtype=jnp.int32), hg_applies, hg_self
+        )
+        feas_e = (
+            exist.valid & exist_ok_e & exist_compat & exist_fit & topo_e & topo_eh & pod_valid
+        )
         pick_e = jnp.argmin(jnp.where(feas_e, jnp.arange(E, dtype=jnp.int32), BIG))
         found_e = jnp.any(feas_e)
 
@@ -186,12 +217,32 @@ def solve(
         pod_b = _broadcast_pod(pod_reqs, N)
         comb = kernels.intersect_sets(state.reqs, pod_b)
         claim_ok = kernels.compatible_elemwise(state.reqs, pod_b, well_known)
-        it_compat = kernels.intersects(it.reqs, comb).T  # [N, T]
+        topo_n, upd_n, _ = topo_ops.vg_evaluate(topo, vg_pre, comb.mask)
+        topo_nh = topo_ops.hg_evaluate(
+            topo,
+            state.hg_counts,
+            E + jnp.arange(N, dtype=jnp.int32),
+            hg_applies,
+            hg_self,
+        )
+        # the topology-narrowed requirements feed instance-type filtering
+        # (nodeclaim.go:199-213: topology comes before the IT filter)
+        comb_t = _apply_topo(comb, upd_n, key_touched)
+        it_compat = kernels.intersects(it.reqs, comb_t).T  # [N, T]
         total = state.used + pod_requests[None, :]
-        fits_off = _fits_and_offering(total, comb, it, zone_kid, ct_kid)
+        fits_off = _fits_and_offering(total, comb_t, it, zone_kid, ct_kid)
         new_its = state.its & it_compat & fits_off & it_allow[None, :]
         tol = tmpl_ok_g[state.template]
-        feas = state.open & claim_ok & tol & jnp.any(new_its, axis=-1) & pod_valid & ~found_e
+        feas = (
+            state.open
+            & claim_ok
+            & tol
+            & topo_n
+            & topo_nh
+            & jnp.any(new_its, axis=-1)
+            & pod_valid
+            & ~found_e
+        )
         order_key = state.pods * jnp.int32(N) + jnp.arange(N, dtype=jnp.int32)
         pick = jnp.argmin(jnp.where(feas, order_key, BIG))
         found = jnp.any(feas)
@@ -200,9 +251,21 @@ def solve(
         pod_g = _broadcast_pod(pod_reqs, G)
         comb0 = kernels.intersect_sets(templates.reqs, pod_g)
         tmpl_compat = kernels.compatible_elemwise(templates.reqs, pod_g, well_known)
-        it_compat0 = kernels.intersects(it.reqs, comb0).T  # [G, T]
+        topo_g, upd_g, _ = topo_ops.vg_evaluate(topo, vg_pre, comb0.mask)
+        # fresh hostname domain; hg_counts carries a spare slot at E+N so
+        # this read stays in bounds when all N claim slots are open
+        new_slot = E + state.n_open
+        topo_gh = topo_ops.hg_evaluate(
+            topo,
+            state.hg_counts,
+            jnp.broadcast_to(new_slot, (G,)).astype(jnp.int32),
+            hg_applies,
+            hg_self,
+        )
+        comb0_t = _apply_topo(comb0, upd_g, key_touched)
+        it_compat0 = kernels.intersects(it.reqs, comb0_t).T  # [G, T]
         total0 = templates.daemon_requests + pod_requests[None, :]
-        fits_off0 = _fits_and_offering(total0, comb0, it, zone_kid, ct_kid)
+        fits_off0 = _fits_and_offering(total0, comb0_t, it, zone_kid, ct_kid)
         # NodePool limits: exclude instance types whose full capacity would
         # breach the remaining budget (scheduler.go:1068)
         cap_ok = jnp.all(
@@ -219,6 +282,8 @@ def solve(
             templates.valid
             & tmpl_compat
             & tmpl_ok_g
+            & topo_g
+            & topo_gh
             & jnp.any(its0, axis=-1)
             & (state.nodes_budget >= 1.0)
         )
@@ -240,11 +305,12 @@ def solve(
             jnp.where(any_template, jnp.int32(NO_ROOM), jnp.int32(NO_CLAIM)),
         )
 
-        # existing-node updates
+        # existing-node updates (topology-narrowed requirements are stored)
         upd_exist = found_e
+        comb_e_t = _apply_topo(comb_e, upd_e, key_touched)
         new_exist_reqs = kernels.select_set(
             upd_exist,
-            kernels.update_set_at(state.exist_reqs, pick_e, kernels.take_set(comb_e, pick_e)),
+            kernels.update_set_at(state.exist_reqs, pick_e, kernels.take_set(comb_e_t, pick_e)),
             state.exist_reqs,
         )
         new_exist_used = jnp.where(
@@ -255,13 +321,27 @@ def solve(
         upd_claim = (found | can_open) & ~found_e
         cslot = jnp.where(found, pick, open_slot)
         sel_reqs = kernels.select_set(
-            found, kernels.take_set(comb, pick), kernels.take_set(comb0, g)
+            found, kernels.take_set(comb_t, pick), kernels.take_set(comb0_t, g)
         )
         sel_its = jnp.where(found, new_its[pick], its0[g])
         sel_used = jnp.where(
             found, total[pick], templates.daemon_requests[g] + pod_requests
         )
         sel_template = jnp.where(found, state.template[pick], g.astype(jnp.int32))
+
+        # topology count commits for the winning candidate
+        final_reqs = kernels.select_set(found_e, kernels.take_set(comb_e_t, pick_e), sel_reqs)
+        slot_h = jnp.where(found_e, pick_e, E + cslot).astype(jnp.int32)
+        new_vg_counts = jnp.where(
+            place,
+            topo_ops.vg_commit(topo, state.vg_counts, final_reqs.mask, final_reqs.inf, vg_records),
+            state.vg_counts,
+        )
+        new_hg_counts = jnp.where(
+            place,
+            topo_ops.hg_commit(state.hg_counts, slot_h, hg_records, topo.hg_valid),
+            state.hg_counts,
+        )
         new_reqs = kernels.select_set(
             upd_claim, kernels.update_set_at(state.reqs, cslot, sel_reqs), state.reqs
         )
@@ -301,6 +381,8 @@ def solve(
                 n_open=new_n_open,
                 budget=new_budget,
                 nodes_budget=new_nodes_budget,
+                vg_counts=new_vg_counts,
+                hg_counts=new_hg_counts,
             ),
             assignment,
         )
@@ -317,7 +399,37 @@ def solve(
         n_open=jnp.int32(0),
         budget=templates.budget,
         nodes_budget=templates.nodes_budget,
+        vg_counts=topo.vg_counts0,
+        hg_counts=topo.hg_counts0,
     )
-    xs = (pods.reqs, pods.requests, pod_tmpl_ok, pod_it_allow, pod_exist_ok, pods.valid)
+    xs = (
+        pods.reqs,
+        pods.requests,
+        pod_tmpl_ok,
+        pod_it_allow,
+        pod_exist_ok,
+        pods.valid,
+        pod_topo.vg_applies,
+        pod_topo.vg_records,
+        pod_topo.vg_self,
+        pod_topo.hg_applies,
+        pod_topo.hg_records,
+        pod_topo.hg_self,
+        pod_topo.strict_mask,
+    )
     state, assignment = jax.lax.scan(step, state, xs)
     return SolveResult(assignment=assignment, claims=state)
+
+
+def _apply_topo(reqs: ReqSetTensors, upd: jnp.ndarray, touched: jnp.ndarray) -> ReqSetTensors:
+    """AND the topology domain masks into candidate requirements: touched
+    keys become concrete finite sets (requirements.Add of an In set)."""
+    inf = reqs.inf & ~touched[None, :]
+    return ReqSetTensors(
+        mask=reqs.mask & upd,
+        inf=inf,
+        excl=reqs.excl & inf,
+        gte=jnp.where(inf, reqs.gte, INT_MIN),
+        lte=jnp.where(inf, reqs.lte, INT_MAX),
+        defined=reqs.defined | touched[None, :],
+    )

@@ -1,0 +1,390 @@
+"""Topology as tensors: spread/affinity/anti-affinity inside the scan.
+
+The reference evaluates topology per (pod, candidate) with map lookups
+(topologygroup.go:150-440); here every group's domain->count map becomes a
+row of a count matrix carried through the solver scan:
+
+  vocab-key groups   counts [NGv, V]   — domains are vocab value ids of
+                     (zone, custom)      the group's key
+  hostname groups    counts [NGh, S]   — domains are candidate slots
+                                         (S = E existing + N claims); a new
+                                         claim IS a fresh hostname domain
+
+Per scan step, validity masks for ALL candidates × ALL groups are computed
+at once; the winning candidate's key masks are narrowed (spread collapses
+to the min-count domain with sorted-name rank tie-breaks, matching the
+host oracle) and its counts committed.
+
+Approximation (documented): pod hostname *selectors* interacting with
+hostname affinity groups treat podDomains as Exists — the static
+pod×candidate masks already enforce hostname selectors for placement.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.ops.encode import ReqSetTensors
+
+BIG_I32 = jnp.int32(2**31 - 1)
+RANK_BASE = 1 << 16  # count * RANK_BASE + rank must not overflow int32... counts < 2^14
+
+TYPE_SPREAD = 0
+TYPE_AFFINITY = 1
+TYPE_ANTI = 2
+
+
+class TopologyTensors(NamedTuple):
+    # vocab-key groups
+    vg_key: jnp.ndarray  # [NGv] i32
+    vg_type: jnp.ndarray  # [NGv] i32
+    vg_skew: jnp.ndarray  # [NGv] i32
+    vg_min_domains: jnp.ndarray  # [NGv] i32 (0 = unset)
+    vg_domains: jnp.ndarray  # [NGv, V] bool
+    vg_counts0: jnp.ndarray  # [NGv, V] i32
+    vg_rank: jnp.ndarray  # [NGv, V] i32 (sorted-name rank; BIG for non-domains)
+    vg_valid: jnp.ndarray  # [NGv] bool
+    # hostname groups
+    hg_type: jnp.ndarray  # [NGh] i32
+    hg_skew: jnp.ndarray  # [NGh] i32
+    hg_counts0: jnp.ndarray  # [NGh, S] i32
+    hg_extra_nonempty: jnp.ndarray  # [NGh] bool — counts exist outside the slot space
+    hg_valid: jnp.ndarray  # [NGh] bool
+
+
+class PodTopology(NamedTuple):
+    """Per-pod group relationships (host-precomputed)."""
+
+    vg_applies: jnp.ndarray  # [P, NGv] bool — group restricts the pod
+    vg_records: jnp.ndarray  # [P, NGv] bool — pod's placement counts into group
+    vg_self: jnp.ndarray  # [P, NGv] bool — group selector matches the pod
+    hg_applies: jnp.ndarray  # [P, NGh] bool
+    hg_records: jnp.ndarray  # [P, NGh] bool
+    hg_self: jnp.ndarray  # [P, NGh] bool
+    strict_mask: jnp.ndarray  # [P, K, V] bool — strict pod requirement masks
+
+
+def encode_topology(topology, encoder, e_slots: int, n_slots: int, existing_names: Sequence[str]):
+    """Host Topology + ProblemEncoder -> TopologyTensors.
+
+    existing_names maps hostname domains to slots [0, E); counts on
+    hostnames outside the slot space set hg_extra_nonempty.
+    """
+    from karpenter_tpu.controllers.provisioning.topology import TopologyType
+
+    vocab = encoder.vocab
+    V = max(vocab.max_values, 1)
+    v_pad = _pow2(V)
+    groups = topology.groups + topology.inverse_groups
+    vg = [g for g in groups if g.key != l.LABEL_HOSTNAME]
+    hg = [g for g in groups if g.key == l.LABEL_HOSTNAME]
+    NGv, NGh = _pow2(max(len(vg), 1), 1), _pow2(max(len(hg), 1), 1)
+    S = e_slots + n_slots
+    type_map = {
+        TopologyType.SPREAD: TYPE_SPREAD,
+        TopologyType.AFFINITY: TYPE_AFFINITY,
+        TopologyType.ANTI_AFFINITY: TYPE_ANTI,
+    }
+
+    vg_key = np.zeros(NGv, dtype=np.int32)
+    vg_type = np.zeros(NGv, dtype=np.int32)
+    vg_skew = np.ones(NGv, dtype=np.int32)
+    vg_mind = np.zeros(NGv, dtype=np.int32)
+    vg_domains = np.zeros((NGv, v_pad), dtype=bool)
+    vg_counts0 = np.zeros((NGv, v_pad), dtype=np.int32)
+    vg_rank = np.full((NGv, v_pad), 2**30, dtype=np.int32)
+    vg_valid = np.zeros(NGv, dtype=bool)
+    for j, g in enumerate(vg):
+        kid = vocab.add_key(g.key)
+        vg_key[j] = kid
+        vg_type[j] = type_map[g.type]
+        vg_skew[j] = g.max_skew
+        vg_mind[j] = g.min_domains or 0
+        for rank, name in enumerate(sorted(g.domains)):
+            vid = vocab.value_to_id[kid].get(name)
+            if vid is None:
+                continue  # domain value unseen by any requirement: unreachable
+            vg_domains[j, vid] = True
+            vg_counts0[j, vid] = g.domains[name]
+            vg_rank[j, vid] = rank
+        vg_valid[j] = True
+
+    slot_of = {name: i for i, name in enumerate(existing_names)}
+    hg_type = np.zeros(NGh, dtype=np.int32)
+    hg_skew = np.ones(NGh, dtype=np.int32)
+    hg_counts0 = np.zeros((NGh, S), dtype=np.int32)
+    hg_extra = np.zeros(NGh, dtype=bool)
+    hg_valid = np.zeros(NGh, dtype=bool)
+    for j, g in enumerate(hg):
+        hg_type[j] = type_map[g.type]
+        hg_skew[j] = g.max_skew
+        for name, count in g.domains.items():
+            if count <= 0:
+                continue
+            s = slot_of.get(name)
+            if s is None:
+                hg_extra[j] = True
+            else:
+                hg_counts0[j, s] = count
+        hg_valid[j] = True
+
+    tensors = TopologyTensors(
+        vg_key=jnp.asarray(vg_key),
+        vg_type=jnp.asarray(vg_type),
+        vg_skew=jnp.asarray(vg_skew),
+        vg_min_domains=jnp.asarray(vg_mind),
+        vg_domains=jnp.asarray(vg_domains),
+        vg_counts0=jnp.asarray(vg_counts0),
+        vg_rank=jnp.asarray(vg_rank),
+        vg_valid=jnp.asarray(vg_valid),
+        hg_type=jnp.asarray(hg_type),
+        hg_skew=jnp.asarray(hg_skew),
+        hg_counts0=jnp.asarray(hg_counts0),
+        hg_extra_nonempty=jnp.asarray(hg_extra),
+        hg_valid=jnp.asarray(hg_valid),
+    )
+    return tensors, vg, hg
+
+
+def encode_pod_topology(topology, vg, hg, pods, strict_tensors: ReqSetTensors) -> PodTopology:
+    P = strict_tensors.mask.shape[0]
+    NGv, NGh = len(vg), len(hg)
+    NGv_pad = _pow2(max(NGv, 1), 1)
+    NGh_pad = _pow2(max(NGh, 1), 1)
+    vga = np.zeros((P, NGv_pad), dtype=bool)
+    vgr = np.zeros((P, NGv_pad), dtype=bool)
+    vgs = np.zeros((P, NGv_pad), dtype=bool)
+    hga = np.zeros((P, NGh_pad), dtype=bool)
+    hgr = np.zeros((P, NGh_pad), dtype=bool)
+    hgs = np.zeros((P, NGh_pad), dtype=bool)
+    inverse = set(id(g) for g in topology.inverse_groups)
+    for i, pod in enumerate(pods):
+        for j, g in enumerate(vg):
+            sel = g.selects(pod)
+            own = pod.uid in g.owners
+            if id(g) in inverse:
+                vga[i, j] = sel
+                vgr[i, j] = own
+            else:
+                vga[i, j] = own
+                vgr[i, j] = sel
+            vgs[i, j] = sel
+        for j, g in enumerate(hg):
+            sel = g.selects(pod)
+            own = pod.uid in g.owners
+            if id(g) in inverse:
+                hga[i, j] = sel
+                hgr[i, j] = own
+            else:
+                hga[i, j] = own
+                hgr[i, j] = sel
+            hgs[i, j] = sel
+    return PodTopology(
+        vg_applies=jnp.asarray(vga),
+        vg_records=jnp.asarray(vgr),
+        vg_self=jnp.asarray(vgs),
+        hg_applies=jnp.asarray(hga),
+        hg_records=jnp.asarray(hgr),
+        hg_self=jnp.asarray(hgs),
+        strict_mask=strict_tensors.mask,
+    )
+
+
+def _pow2(n: int, floor: int = 1) -> int:
+    out = floor
+    while out < n:
+        out *= 2
+    return out
+
+
+def pad_to_v(tensors: TopologyTensors, v_pad: int) -> TopologyTensors:
+    """Re-pad the per-domain tensors to a bucketed vocab width."""
+    cur = tensors.vg_domains.shape[1]
+    if cur == v_pad:
+        return tensors
+    pad = v_pad - cur
+    return tensors._replace(
+        vg_domains=jnp.pad(tensors.vg_domains, ((0, 0), (0, pad))),
+        vg_counts0=jnp.pad(tensors.vg_counts0, ((0, 0), (0, pad))),
+        vg_rank=jnp.pad(tensors.vg_rank, ((0, 0), (0, pad)), constant_values=2**30),
+    )
+
+
+# ---------------------------------------------------------------------------
+# device-side step functions (called from ops.solver inside the scan)
+# ---------------------------------------------------------------------------
+
+
+class VGPodPre(NamedTuple):
+    """Candidate-independent per-pod precompute (once per scan step)."""
+
+    pd: jnp.ndarray  # [NGv, V] pod strict domains per group
+    eff: jnp.ndarray  # [NGv, V] count + self
+    ok_skew: jnp.ndarray  # [NGv, V]
+    opts: jnp.ndarray  # [NGv, V] affinity options (count>0, pod-compatible)
+    bootstrap: jnp.ndarray  # [NGv]
+    cnt_zero: jnp.ndarray  # [NGv, V]
+    gate: jnp.ndarray  # [NGv] group applies to this pod
+    key_touched: jnp.ndarray  # [K]
+    keys_eq: jnp.ndarray  # [NGv, K]
+
+
+def vg_pod_precompute(
+    topo: TopologyTensors,
+    counts: jnp.ndarray,  # [NGv, V]
+    pod_strict_mask: jnp.ndarray,  # [K, V]
+    applies: jnp.ndarray,  # [NGv]
+    self_sel: jnp.ndarray,  # [NGv]
+    n_keys: int,
+) -> VGPodPre:
+    pd = pod_strict_mask[topo.vg_key]  # [NGv, V]
+    dom = topo.vg_domains
+    cnt = counts
+    self_add = self_sel.astype(jnp.int32)
+
+    # spread min-count (topologygroup.go:298-320 domainMinCount)
+    in_universe = dom & pd
+    supported = jnp.sum(in_universe, axis=-1).astype(jnp.int32)
+    masked_cnt = jnp.where(in_universe, cnt, BIG_I32)
+    minc = jnp.min(masked_cnt, axis=-1)
+    minc = jnp.where(
+        (topo.vg_min_domains > 0) & (supported < topo.vg_min_domains), 0, minc
+    )
+    minc = jnp.where(minc == BIG_I32, 0, minc)  # no supported domains
+    eff = cnt + self_add[:, None]  # [NGv, V]
+    ok_skew = (eff - minc[:, None]) <= topo.vg_skew[:, None]
+
+    # affinity terms (topologygroup.go:324-381)
+    opts = dom & pd & (cnt > 0)
+    group_empty = ~jnp.any(cnt > 0, axis=-1)
+    no_compat = ~jnp.any(pd & (cnt > 0), axis=-1)
+    bootstrap = self_sel & (group_empty | no_compat)
+
+    gate = applies & topo.vg_valid
+    keys_eq = topo.vg_key[:, None] == jnp.arange(n_keys)[None, :]  # [NGv, K]
+    key_touched = jnp.any(gate[:, None] & keys_eq, axis=0)  # [K]
+    return VGPodPre(
+        pd=pd,
+        eff=eff,
+        ok_skew=ok_skew,
+        opts=opts,
+        bootstrap=bootstrap,
+        cnt_zero=cnt == 0,
+        gate=gate,
+        key_touched=key_touched,
+        keys_eq=keys_eq,
+    )
+
+
+def _onehot_rows(space: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """[C, NG, V] one-hot of idx per (c, j), zeroed where space is empty."""
+    C, NG = idx.shape
+    out = jnp.zeros_like(space).at[
+        jnp.arange(C)[:, None], jnp.arange(NG)[None, :], idx
+    ].set(True)
+    return out & jnp.any(space, axis=-1, keepdims=True)
+
+
+def vg_evaluate(
+    topo: TopologyTensors,
+    pre: VGPodPre,
+    comb_mask: jnp.ndarray,  # [C, K, V] candidate combined masks
+):
+    """Returns (feasible [C], upd [C, K, V], narrowed [C, NGv, V]).
+
+    upd is the mask to AND into the winning candidate's requirements;
+    narrowed is the per-group chosen-domain mask (for count commits).
+    """
+    nd = jnp.take(comb_mask, topo.vg_key, axis=1)  # [C, NGv, V]
+    dom = topo.vg_domains
+
+    # ---- spread (topologygroup.go:229-298) -----------------------------
+    valid_sp = dom[None] & nd & pre.ok_skew[None]  # [C, NGv, V]
+    spread_key = jnp.where(valid_sp, pre.eff[None] * RANK_BASE + topo.vg_rank[None], BIG_I32)
+    sp_mask = _onehot_rows(valid_sp, jnp.argmin(spread_key, axis=-1))
+    any_sp = jnp.any(valid_sp, axis=-1)
+
+    # ---- affinity (topologygroup.go:324-381) ----------------------------
+    opts_c = pre.opts[None] & nd  # [C, NGv, V]
+    any_opts = jnp.any(opts_c, axis=-1, keepdims=True)
+    boot_space = dom[None] & pre.pd[None] & nd
+    boot_idx = jnp.argmin(jnp.where(boot_space, topo.vg_rank[None], BIG_I32), axis=-1)
+    boot_mask = _onehot_rows(boot_space, boot_idx)
+    aff_mask = jnp.where(any_opts, opts_c, boot_mask & pre.bootstrap[None, :, None])
+    any_aff = jnp.any(aff_mask, axis=-1)
+
+    # ---- anti-affinity (topologygroup.go:404-440) ------------------------
+    anti_mask = dom[None] & pre.pd[None] & nd & pre.cnt_zero[None]
+    any_anti = jnp.any(anti_mask, axis=-1)
+
+    # ---- select by type ---------------------------------------------------
+    t = topo.vg_type[None, :]
+    narrowed = jnp.where(
+        (t == TYPE_SPREAD)[..., None],
+        sp_mask,
+        jnp.where((t == TYPE_AFFINITY)[..., None], aff_mask, anti_mask),
+    )  # [C, NGv, V]
+    ok = jnp.where(t == TYPE_SPREAD, any_sp, jnp.where(t == TYPE_AFFINITY, any_aff, any_anti))
+    feasible = jnp.all(~pre.gate[None, :] | ok, axis=-1)  # [C]
+
+    # ---- requirement update (AND all applying groups per key) ------------
+    # contrib[c, j, k, v] = ~(gate[j] & key_j==k) | narrowed[c, j, v]
+    contrib = (
+        ~(pre.gate[None, :, None, None] & pre.keys_eq[None, :, :, None])
+    ) | narrowed[:, :, None, :]
+    upd = jnp.all(contrib, axis=1)  # [C, K, V]
+    return feasible, upd, narrowed
+
+
+def vg_commit(
+    topo: TopologyTensors,
+    counts: jnp.ndarray,  # [NGv, V]
+    final_mask: jnp.ndarray,  # [K, V] winner's updated requirement masks
+    final_inf: jnp.ndarray,  # [K] winner's complement bits
+    records: jnp.ndarray,  # [NGv]
+) -> jnp.ndarray:
+    """Commit counts (topology.go:190-212): record the final values of the
+    group's key — all of them for anti-affinity, only a collapsed single
+    value otherwise, and never for complement (infinite) requirements."""
+    vals = final_mask[topo.vg_key]  # [NGv, V]
+    finite = ~final_inf[topo.vg_key]  # [NGv]
+    single = jnp.sum(vals, axis=-1) == 1
+    is_anti = topo.vg_type == TYPE_ANTI
+    do = records & topo.vg_valid & finite & (is_anti | single)
+    delta = jnp.where(do[:, None] & vals, 1, 0)
+    return counts + delta
+
+
+def hg_evaluate(
+    topo: TopologyTensors,
+    counts: jnp.ndarray,  # [NGh, S]
+    cand_slots: jnp.ndarray,  # [C] i32 — candidate hostname slots
+    applies: jnp.ndarray,  # [NGh]
+    self_sel: jnp.ndarray,  # [NGh]
+) -> jnp.ndarray:
+    """[C] bool — hostname-group feasibility per candidate slot."""
+    cnt_s = counts[:, cand_slots].T  # [C, NGh]
+    self_add = self_sel.astype(jnp.int32)[None, :]
+    ok_spread = (cnt_s + self_add) <= topo.hg_skew[None, :]
+    group_empty = ~(jnp.any(counts > 0, axis=-1) | topo.hg_extra_nonempty)  # [NGh]
+    ok_aff = (cnt_s > 0) | (self_sel & group_empty)[None, :]
+    ok_anti = cnt_s == 0
+    t = topo.hg_type[None, :]
+    ok = jnp.where(t == TYPE_SPREAD, ok_spread, jnp.where(t == TYPE_AFFINITY, ok_aff, ok_anti))
+    gate = applies & topo.hg_valid
+    return jnp.all(~gate[None, :] | ok, axis=-1)
+
+
+def hg_commit(
+    counts: jnp.ndarray,  # [NGh, S]
+    slot,  # scalar i32 — winning candidate's hostname slot
+    records: jnp.ndarray,  # [NGh]
+    valid: jnp.ndarray,  # [NGh]
+) -> jnp.ndarray:
+    delta = (records & valid).astype(counts.dtype)
+    return counts.at[:, slot].add(delta)

@@ -97,6 +97,8 @@ def sharded_solve(
     it_sharded: InstanceTypeTensors,
     templates,
     well_known,
+    topo,
+    pod_topo,
     *,
     zone_kid: int,
     ct_kid: int,
@@ -121,6 +123,8 @@ def sharded_solve(
         it_sharded,
         tmpl,
         well_known,
+        topo,
+        pod_topo,
         zone_kid=zone_kid,
         ct_kid=ct_kid,
         n_claims=n_claims,

@@ -30,17 +30,26 @@ def test_mesh_too_few_devices():
 def test_sharded_solve_matches_unsharded():
     import __graft_entry__ as ge
 
-    fn, (pt, tol, it_allow, exist_ok, exist, it, templates, well_known), meta = ge._build_entry(
-        n_pods=32, n_types=12
-    )
-    ref = jax.jit(fn)(pt, tol, it_allow, exist_ok, exist, it, templates, well_known)
+    fn, args, meta = ge._build_entry(n_pods=32, n_types=12)
+    (pt, tol, it_allow, exist_ok, exist, it, templates, well_known, topo, pod_topo) = args
+    ref = jax.jit(fn)(*args)
     ref_assignment = np.asarray(ref.assignment)
 
     mesh = make_mesh(8)
     with mesh:
         it_sharded = shard_instance_types(it, mesh)
         out = sharded_solve(
-            pt, tol, it_allow, exist_ok, exist, it_sharded, templates, well_known, **meta
+            pt,
+            tol,
+            it_allow,
+            exist_ok,
+            exist,
+            it_sharded,
+            templates,
+            well_known,
+            topo,
+            pod_topo,
+            **meta,
         )
         out_assignment = np.asarray(out.assignment)
 

@@ -354,6 +354,163 @@ class TestRegressions:
             assert c.instance_types
 
 
+class TestTopologyDifferential:
+    """The device engine must match the host oracle with topology groups in
+    play (the hard order-dependent case)."""
+
+    def _both(self, pods, n_types=32, existing_factory=None):
+        from karpenter_tpu.controllers.provisioning.topology import (
+            Topology,
+            build_universe_domains,
+        )
+
+        templates = build_templates([(default_pool(), instance_types(n_types))])
+        existing = existing_factory() if existing_factory else []
+        universe = build_universe_domains(templates, existing)
+        host = HostScheduler(
+            templates,
+            existing_nodes=existing_factory() if existing_factory else [],
+            topology=Topology.build(pods, universe),
+        ).solve(pods)
+        tpu = TPUScheduler(templates).solve(
+            pods,
+            existing_factory() if existing_factory else [],
+            topology=Topology.build(pods, universe),
+        )
+        return host, tpu
+
+    def _spread_pods(self, n, key, max_skew=1, cpu=0.5):
+        from karpenter_tpu.models.pod import TopologySpreadConstraint
+
+        pods = []
+        for i in range(n):
+            p = make_pod(f"sp-{i}", cpu=cpu)
+            p.metadata.labels = {"app": "web"}
+            p.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=max_skew, topology_key=key, label_selector={"app": "web"}
+                )
+            ]
+            pods.append(p)
+        return pods
+
+    def test_zonal_spread_matches(self):
+        pods = self._spread_pods(12, l.LABEL_TOPOLOGY_ZONE)
+        host, tpu = self._both(pods)
+        assert_same_packing(host, tpu)
+        assert not tpu.unschedulable
+        # and the packing actually spreads
+        zones = {}
+        for c in tpu.claims:
+            z = sorted(c.requirements.get(l.LABEL_TOPOLOGY_ZONE).values)[0]
+            zones[z] = zones.get(z, 0) + len(c.pods)
+        assert max(zones.values()) - min(zones.values()) <= 1
+
+    def test_hostname_spread_matches(self):
+        pods = self._spread_pods(6, l.LABEL_HOSTNAME)
+        host, tpu = self._both(pods, n_types=64)
+        assert_same_packing(host, tpu)
+        assert len(tpu.claims) == 6  # one matching pod per fresh node
+
+    def test_anti_affinity_matches(self):
+        from karpenter_tpu.models.pod import PodAffinityTerm
+
+        pods = []
+        for i, zone in enumerate(["test-zone-1", "test-zone-2", "test-zone-3"]):
+            p = make_pod(f"aa-{i}", cpu=2.0, node_selector={l.LABEL_TOPOLOGY_ZONE: zone})
+            p.metadata.labels = {"security": "s2"}
+            pods.append(p)
+        aff = make_pod("aff", cpu=0.25)
+        aff.spec.pod_anti_affinity = [
+            PodAffinityTerm(topology_key=l.LABEL_TOPOLOGY_ZONE, label_selector={"security": "s2"})
+        ]
+        host, tpu = self._both(pods + [aff])
+        assert_same_packing(host, tpu)
+
+    def test_hostname_anti_affinity_matches(self):
+        from karpenter_tpu.models.pod import PodAffinityTerm
+
+        pods = []
+        for i in range(4):
+            p = make_pod(f"ha-{i}", cpu=0.25)
+            p.metadata.labels = {"app": "db"}
+            p.spec.pod_anti_affinity = [
+                PodAffinityTerm(topology_key=l.LABEL_HOSTNAME, label_selector={"app": "db"})
+            ]
+            pods.append(p)
+        host, tpu = self._both(pods, n_types=64)
+        assert_same_packing(host, tpu)
+        assert len(tpu.claims) == 4
+
+    def test_affinity_matches(self):
+        from karpenter_tpu.models.pod import PodAffinityTerm
+
+        pods = []
+        for i in range(4):
+            p = make_pod(f"af-{i}", cpu=0.5)
+            p.metadata.labels = {"app": "cache"}
+            p.spec.pod_affinity = [
+                PodAffinityTerm(topology_key=l.LABEL_TOPOLOGY_ZONE, label_selector={"app": "cache"})
+            ]
+            pods.append(p)
+        host, tpu = self._both(pods)
+        assert_same_packing(host, tpu)
+        zones = set()
+        for c in tpu.claims:
+            zones.update(c.requirements.get(l.LABEL_TOPOLOGY_ZONE).values)
+        assert len(zones) == 1
+
+    def test_mixed_benchmark_style(self):
+        """The reference benchmark's pod mix: generic + zonal TSC +
+        hostname TSC + affinity + anti-affinity (1/5 each)."""
+        from karpenter_tpu.models.pod import PodAffinityTerm, TopologySpreadConstraint
+
+        rng = np.random.default_rng(11)
+        pods = []
+        for i in range(40):
+            p = make_pod(
+                f"mix-{i}",
+                cpu=float(rng.choice([0.25, 0.5, 1.0])),
+                memory=f"{rng.choice([0.5, 1.0])}Gi",
+            )
+            kind = i % 5
+            if kind == 1:
+                p.metadata.labels = {"spread": "zonal"}
+                p.spec.topology_spread_constraints = [
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=l.LABEL_TOPOLOGY_ZONE,
+                        label_selector={"spread": "zonal"},
+                    )
+                ]
+            elif kind == 2:
+                p.metadata.labels = {"spread": "host"}
+                p.spec.topology_spread_constraints = [
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=l.LABEL_HOSTNAME,
+                        label_selector={"spread": "host"},
+                    )
+                ]
+            elif kind == 3:
+                p.metadata.labels = {"aff": "group"}
+                p.spec.pod_affinity = [
+                    PodAffinityTerm(
+                        topology_key=l.LABEL_TOPOLOGY_ZONE, label_selector={"aff": "group"}
+                    )
+                ]
+            elif kind == 4:
+                p.metadata.labels = {"anti": "self"}
+                p.spec.pod_anti_affinity = [
+                    PodAffinityTerm(
+                        topology_key=l.LABEL_HOSTNAME, label_selector={"anti": "self"}
+                    )
+                ]
+            pods.append(p)
+        host, tpu = self._both(pods, n_types=48)
+        assert_same_packing(host, tpu)
+
+
 class TestPackingQuality:
     def test_bin_utilization(self):
         """Packing must fill nodes densely. instance_types(64) spans cpu
