@@ -1,0 +1,27 @@
+"""Disruption: turning an overprovisioned cluster into a cheaper one.
+
+Counterpart of reference pkg/controllers/disruption. A polling controller
+evaluates methods in priority order — first success wins
+(controller.go:101-115):
+
+  Emptiness -> Drift -> MultiNodeConsolidation -> SingleNodeConsolidation
+
+Consolidation what-ifs run full scheduling simulations against the cluster
+minus the candidates (helpers.go:53-154); on TPU these reuse the same
+solver the provisioner runs.
+"""
+
+from karpenter_tpu.controllers.disruption.candidates import (  # noqa: F401
+    Candidate,
+    build_candidates,
+    build_disruption_budgets,
+)
+from karpenter_tpu.controllers.disruption.controller import DisruptionController  # noqa: F401
+from karpenter_tpu.controllers.disruption.methods import (  # noqa: F401
+    Command,
+    Drift,
+    Emptiness,
+    MultiNodeConsolidation,
+    SingleNodeConsolidation,
+)
+from karpenter_tpu.controllers.disruption.queue import OrchestrationQueue  # noqa: F401

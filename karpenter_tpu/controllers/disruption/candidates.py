@@ -1,0 +1,137 @@
+"""Disruption candidates and budgets.
+
+Counterpart of reference disruption/types.go:75-160 (Candidate construction
++ disruptability validation) and helpers.go:262-313 (budget mapping).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from karpenter_tpu.cloudprovider.instancetype import InstanceType
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodeclaim import COND_INITIALIZED, NodeClaim
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.state.cluster import Cluster, StateNode
+from karpenter_tpu.utils.clock import Clock
+
+POD_DELETION_COST_ANNOTATION = "controller.kubernetes.io/pod-deletion-cost"
+
+
+@dataclass
+class Candidate:
+    """A node eligible for disruption (types.go:75-92)."""
+
+    state_node: StateNode
+    nodepool: NodePool
+    instance_type: Optional[InstanceType]
+    price: float
+    reschedulable_pods: list[Pod] = field(default_factory=list)
+    disruption_cost: float = 1.0
+
+    @property
+    def name(self) -> str:
+        return self.state_node.name
+
+    @property
+    def provider_id(self) -> str:
+        return self.state_node.provider_id
+
+    @property
+    def savings_ratio(self) -> float:
+        """Sort key: cheaper-to-disrupt-per-dollar first (types.go:145)."""
+        return self.price / self.disruption_cost if self.disruption_cost else self.price
+
+
+def _pod_eviction_cost(pod: Pod) -> float:
+    cost = 1.0
+    raw = pod.metadata.annotations.get(POD_DELETION_COST_ANNOTATION)
+    if raw is not None:
+        try:
+            cost += float(raw) / 1000.0
+        except ValueError:
+            pass
+    return max(cost, 0.0)
+
+
+def is_disruptable(sn: StateNode, clock: Clock) -> Optional[str]:
+    """None if the node may be disrupted, else the blocking reason
+    (types.go:160 construction validation)."""
+    if sn.node is None or sn.node_claim is None:
+        return "not managed"
+    if sn.marked_for_deletion or sn.node.metadata.deleting:
+        return "already deleting"
+    if not sn.node_claim.conditions.is_true(COND_INITIALIZED):
+        return "not initialized"
+    if sn.is_nominated(clock.now()):
+        return "nominated for pending pods"
+    for pod in sn.pods.values():
+        if pod.metadata.annotations.get(l.DO_NOT_DISRUPT_ANNOTATION_KEY) == "true":
+            return f"pod {pod.name} has do-not-disrupt"
+    return None
+
+
+def build_candidates(
+    cluster: Cluster,
+    pools_by_name: dict[str, NodePool],
+    instance_types_by_name: dict[str, InstanceType],
+    clock: Clock,
+) -> list[Candidate]:
+    """All disruptable nodes as candidates, deterministic name order.
+
+    PodDisruptionBudget objects are not modeled yet; when they land, a
+    PDB-violating eviction must disqualify the node here (types.go:160).
+    """
+    out = []
+    for sn in sorted(cluster.nodes(), key=lambda s: s.name):
+        if is_disruptable(sn, clock) is not None:
+            continue
+        pool = pools_by_name.get(sn.nodepool_name or "")
+        if pool is None:
+            continue
+        it_name = (sn.node or sn.node_claim).metadata.labels.get(l.LABEL_INSTANCE_TYPE, "")
+        it = instance_types_by_name.get(it_name)
+        zone = (sn.node or sn.node_claim).metadata.labels.get(l.LABEL_TOPOLOGY_ZONE, "")
+        ct = (sn.node or sn.node_claim).metadata.labels.get(l.CAPACITY_TYPE_LABEL_KEY, "")
+        price = it.offering_price(zone, ct) if it else None
+        if price is None:
+            price = 0.0
+        reschedulable = [p for p in sn.pods.values() if not p.is_terminal()]
+        cost = 1.0 + sum(_pod_eviction_cost(p) for p in reschedulable)
+        out.append(
+            Candidate(
+                state_node=sn,
+                nodepool=pool,
+                instance_type=it,
+                price=price,
+                reschedulable_pods=reschedulable,
+                disruption_cost=cost,
+            )
+        )
+    return out
+
+
+def build_disruption_budgets(
+    pools_by_name: dict[str, NodePool],
+    cluster: Cluster,
+    reason: str,
+    clock: Clock,
+) -> dict[str, int]:
+    """pool -> allowed simultaneous disruptions for the reason, net of nodes
+    already disrupting (helpers.go:262-313)."""
+    out = {}
+    now = clock.now()
+    for name, pool in pools_by_name.items():
+        total = 0
+        disrupting = 0
+        for sn in cluster.nodes():
+            if sn.nodepool_name != name:
+                continue
+            total += 1
+            if sn.marked_for_deletion or sn.is_disrupted():
+                disrupting += 1
+        allowed = pool.allowed_disruptions(reason, total, now)
+        out[name] = max(allowed - disrupting, 0)
+    return out

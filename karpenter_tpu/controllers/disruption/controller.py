@@ -1,0 +1,154 @@
+"""The disruption controller: the 10s polling loop.
+
+Counterpart of reference disruption/controller.go:101-196: state-sync gate,
+stale-taint cleanup, then the method cascade (first success wins) with a
+validation delay before execution (consolidation.go:45, validation.go).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from karpenter_tpu.controllers.disruption.candidates import (
+    Candidate,
+    build_candidates,
+    build_disruption_budgets,
+)
+from karpenter_tpu.controllers.disruption.methods import (
+    Command,
+    Drift,
+    Emptiness,
+    MultiNodeConsolidation,
+    SingleNodeConsolidation,
+)
+from karpenter_tpu.controllers.disruption.queue import OrchestrationQueue
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.taints import DISRUPTED_NO_SCHEDULE_TAINT
+from karpenter_tpu.state.store import ObjectStore
+
+POLL_PERIOD_SECONDS = 10.0  # controller.go:71
+VALIDATION_DELAY_SECONDS = 15.0  # consolidation.go:45
+
+
+@dataclass
+class _PendingValidation:
+    command: Command
+    ready_at: float
+
+
+class DisruptionController:
+    def __init__(self, store: ObjectStore, cluster, provisioner, cloud, clock,
+                 spot_to_spot_enabled: bool = False):
+        self.store = store
+        self.cluster = cluster
+        self.provisioner = provisioner
+        self.cloud = cloud
+        self.clock = clock
+        self.queue = OrchestrationQueue(store, cluster, provisioner, clock)
+        self._pending: Optional[_PendingValidation] = None
+        self.methods = [
+            Emptiness(clock),
+            Drift(self._simulate),
+            MultiNodeConsolidation(self._simulate, clock, spot_to_spot_enabled),
+            SingleNodeConsolidation(self._simulate, clock, spot_to_spot_enabled),
+        ]
+
+    # -- simulation hook ------------------------------------------------------
+
+    def _simulate(self, candidates: list[Candidate]):
+        """SimulateScheduling (helpers.go:53-154): schedule pending pods +
+        candidates' pods against the cluster minus the candidates. Returns
+        (results, unscheduled candidate-pod uids)."""
+        excluded = {c.name for c in candidates}
+        extra = [p for c in candidates for p in c.reschedulable_pods]
+        result = self.provisioner.simulate(excluded, extra)
+        if result is None:
+            return None, set()
+        extra_uids = {p.uid for p in extra}
+        unscheduled = {p.uid for p, _ in result.unschedulable} & extra_uids
+        return result, unscheduled
+
+    # -- the loop (controller.go:128-196) --------------------------------------
+
+    def reconcile(self) -> Optional[Command]:
+        if not self.cluster.synced():
+            return None
+        self._cleanup_stale_taints()
+        self.queue.process()
+
+        # a command awaiting validation takes precedence
+        if self._pending is not None:
+            if self.clock.now() < self._pending.ready_at:
+                return None
+            command = self._pending.command
+            self._pending = None
+            if self._validate(command):
+                self.queue.start(command)
+                return command
+            return None
+
+        pools = {p.name: p for p in self.store.nodepools()}
+        its = {
+            it.name: it
+            for p in pools.values()
+            for it in self.cloud.get_instance_types(p)
+        }
+        candidates = build_candidates(self.cluster, pools, its, self.clock)
+        if not candidates:
+            return None
+        for method in self.methods:
+            budgets = build_disruption_budgets(pools, self.cluster, method.reason, self.clock)
+            command = method.compute(candidates, budgets)
+            if command.is_empty:
+                continue
+            if isinstance(method, Emptiness):
+                # emptiness skips the validation delay (it re-validates
+                # trivially: no pods to displace)
+                self.queue.start(command)
+                return command
+            self._pending = _PendingValidation(
+                command=command, ready_at=self.clock.now() + VALIDATION_DELAY_SECONDS
+            )
+            return None
+        return None
+
+    def _validate(self, command: Command) -> bool:
+        """Re-verify after the delay: candidates still disruptable and the
+        pods still have somewhere to go (validation.go)."""
+        from karpenter_tpu.controllers.disruption.candidates import is_disruptable
+
+        for c in command.candidates:
+            if is_disruptable(c.state_node, self.clock) is not None:
+                return False
+        if command.replacements or any(c.reschedulable_pods for c in command.candidates):
+            results, unscheduled = self._simulate(command.candidates)
+            if results is None or unscheduled:
+                return False
+            # the world may have changed during the delay: the command is
+            # only valid if the displaced pods still fit without MORE new
+            # capacity than the command already launches (validation.go)
+            if len(results.claims) > len(command.replacements):
+                return False
+        return True
+
+    def _cleanup_stale_taints(self) -> None:
+        """Remove disrupted taints from nodes with no in-flight command —
+        crash recovery (controller.go:147-164)."""
+        active = {
+            c.provider_id
+            for item in self.queue.in_flight
+            for c in item.command.candidates
+        }
+        if self._pending is not None:
+            active |= {c.provider_id for c in self._pending.command.candidates}
+        for node in self.store.nodes():
+            if not any(t.match(DISRUPTED_NO_SCHEDULE_TAINT) for t in node.spec.taints):
+                continue
+            sn = self.cluster.node_by_provider_id(node.spec.provider_id)
+            if sn is not None and (sn.marked_for_deletion or node.spec.provider_id in active):
+                continue
+            node.spec.taints = [
+                t for t in node.spec.taints if not t.match(DISRUPTED_NO_SCHEDULE_TAINT)
+            ]
+            self.store.update(ObjectStore.NODES, node)

@@ -1,0 +1,278 @@
+"""Disruption methods, in controller priority order.
+
+Counterpart of reference disruption/{emptiness,drift,consolidation,
+multinodeconsolidation,singlenodeconsolidation}.go. Each method computes a
+Command = (candidates to delete, replacement claims); first non-empty
+command wins the loop (controller.go:101-115).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from karpenter_tpu.controllers.disruption.candidates import Candidate
+from karpenter_tpu.controllers.provisioning.host_scheduler import SchedulingResult, SimClaim
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodepool import (
+    CONSOLIDATION_WHEN_EMPTY,
+    CONSOLIDATION_WHEN_EMPTY_OR_UNDERUTILIZED,
+    REASON_DRIFTED,
+    REASON_EMPTY,
+    REASON_UNDERUTILIZED,
+)
+from karpenter_tpu.models.nodeclaim import COND_DRIFTED
+
+# multinodeconsolidation.go:81 batch cap
+MAX_MULTI_NODE_BATCH = 100
+# consolidation.go:47-48 spot-churn guards
+MIN_INSTANCE_TYPES_FOR_SPOT_TO_SPOT = 15
+MAX_SPOT_TO_SPOT_LAUNCH_FLEXIBILITY = 15
+
+# simulate(candidates) -> (SchedulingResult, unscheduled_candidate_pod_uids)
+SimulateFn = Callable[[list[Candidate]], tuple[Optional[SchedulingResult], set[str]]]
+
+
+@dataclass
+class Command:
+    candidates: list[Candidate] = field(default_factory=list)
+    replacements: list[SimClaim] = field(default_factory=list)
+    reason: str = ""
+    results: Optional[SchedulingResult] = None
+
+    @property
+    def is_empty(self) -> bool:
+        return not self.candidates
+
+    def total_price(self) -> float:
+        return sum(c.price for c in self.candidates)
+
+
+def _within_budget(candidates: list[Candidate], budgets: dict[str, int]) -> list[Candidate]:
+    """Prefilter preserving order so no pool exceeds its budget
+    (multinodeconsolidation.go:52-80)."""
+    taken: dict[str, int] = {}
+    out = []
+    for c in candidates:
+        pool = c.nodepool.name
+        if taken.get(pool, 0) < budgets.get(pool, 0):
+            taken[pool] = taken.get(pool, 0) + 1
+            out.append(c)
+    return out
+
+
+def _consolidatable(c: Candidate, clock, policy_filter: tuple[str, ...]) -> bool:
+    """consolidateAfter gating: policy matches and the idle window elapsed
+    since the last pod event (nodeclaim.disruption Consolidatable)."""
+    disruption = c.nodepool.spec.disruption
+    if disruption.consolidation_policy not in policy_filter:
+        return False
+    after = disruption.consolidate_after_seconds
+    if after is None:
+        return False
+    claim = c.state_node.node_claim
+    anchor = claim.status.last_pod_event_time or claim.metadata.creation_timestamp
+    return clock.now() - anchor >= after
+
+
+class Emptiness:
+    """Delete nodes with zero reschedulable pods (emptiness.go:42-121)."""
+
+    reason = REASON_EMPTY
+
+    def __init__(self, clock):
+        self.clock = clock
+
+    def compute(self, candidates: list[Candidate], budgets: dict[str, int]) -> Command:
+        empty = [
+            c
+            for c in candidates
+            if not c.reschedulable_pods
+            and _consolidatable(
+                c,
+                self.clock,
+                (CONSOLIDATION_WHEN_EMPTY, CONSOLIDATION_WHEN_EMPTY_OR_UNDERUTILIZED),
+            )
+        ]
+        chosen = _within_budget(empty, budgets)
+        return Command(candidates=chosen, reason=self.reason)
+
+
+class Drift:
+    """Delete Drifted claims; replacements come from re-provisioning the
+    evicted pods (drift.go:58-119)."""
+
+    reason = REASON_DRIFTED
+
+    def __init__(self, simulate: SimulateFn):
+        self.simulate = simulate
+
+    def compute(self, candidates: list[Candidate], budgets: dict[str, int]) -> Command:
+        drifted = [
+            c
+            for c in candidates
+            if c.state_node.node_claim is not None
+            and c.state_node.node_claim.conditions.is_true(COND_DRIFTED)
+        ]
+        chosen = _within_budget(drifted, budgets)
+        if not chosen:
+            return Command(reason=self.reason)
+        # one at a time, verifying pods have somewhere to go (drift.go:98+)
+        for c in chosen:
+            results, unscheduled = self.simulate([c])
+            if results is None or unscheduled:
+                continue
+            return Command(
+                candidates=[c],
+                replacements=list(results.claims),
+                reason=self.reason,
+                results=results,
+            )
+        return Command(reason=self.reason)
+
+
+class _ConsolidationBase:
+    reason = REASON_UNDERUTILIZED
+
+    def __init__(self, simulate: SimulateFn, clock, spot_to_spot_enabled: bool = False):
+        self.simulate = simulate
+        self.clock = clock
+        self.spot_to_spot_enabled = spot_to_spot_enabled
+
+    def eligible(self, candidates: list[Candidate]) -> list[Candidate]:
+        return [
+            c
+            for c in candidates
+            if _consolidatable(c, self.clock, (CONSOLIDATION_WHEN_EMPTY_OR_UNDERUTILIZED,))
+        ]
+
+    # -- computeConsolidation (consolidation.go:159-343) --------------------
+
+    def compute_consolidation(self, candidates: list[Candidate]) -> Command:
+        results, unscheduled = self.simulate(candidates)
+        if results is None or unscheduled:
+            return Command(reason=self.reason)
+        if len(results.claims) == 0:
+            return Command(candidates=candidates, reason=self.reason, results=results)
+        if len(results.claims) != 1:
+            return Command(reason=self.reason)
+
+        claim = results.claims[0]
+        candidate_price = sum(c.price for c in candidates)
+        all_spot = all(
+            (c.state_node.node or c.state_node.node_claim).metadata.labels.get(
+                l.CAPACITY_TYPE_LABEL_KEY
+            )
+            == l.CAPACITY_TYPE_SPOT
+            for c in candidates
+        )
+        ct_req = claim.requirements.get(l.CAPACITY_TYPE_LABEL_KEY)
+        if all_spot and ct_req.has(l.CAPACITY_TYPE_SPOT):
+            return self._spot_to_spot(candidates, claim, results, candidate_price)
+
+        if not self._filter_by_price(claim, candidate_price):
+            return Command(reason=self.reason)
+        # OD -> [OD, spot]: after price filtering, force spot so the launch
+        # doesn't pick an on-demand offering pricier than a viable spot one
+        # (consolidation.go:240-243)
+        if ct_req.has(l.CAPACITY_TYPE_SPOT) and ct_req.has(l.CAPACITY_TYPE_ON_DEMAND):
+            from karpenter_tpu.scheduling import Operator, Requirement
+
+            claim.requirements.add(
+                Requirement.new(l.CAPACITY_TYPE_LABEL_KEY, Operator.IN, l.CAPACITY_TYPE_SPOT)
+            )
+        return Command(
+            candidates=candidates, replacements=[claim], reason=self.reason, results=results
+        )
+
+    def _filter_by_price(self, claim: SimClaim, candidate_price: float) -> bool:
+        """RemoveInstanceTypeOptionsByPriceAndMinValues (nodeclaim.go:411):
+        keep instance types with a compatible offering cheaper than the
+        candidates; False if none remain."""
+        claim.instance_types = [
+            it
+            for it in claim.instance_types
+            if it.cheapest_offering_price(claim.requirements) < candidate_price
+        ]
+        return bool(claim.instance_types)
+
+    def _spot_to_spot(
+        self,
+        candidates: list[Candidate],
+        claim: SimClaim,
+        results: SchedulingResult,
+        candidate_price: float,
+    ) -> Command:
+        """consolidation.go:256-343: gated by the feature flag; requires >=15
+        cheaper types and caps launch flexibility at 15 to prevent churn."""
+        if not self.spot_to_spot_enabled:
+            return Command(reason=self.reason)
+        from karpenter_tpu.cloudprovider.instancetype import order_by_price
+        from karpenter_tpu.scheduling import Operator, Requirement
+
+        claim.requirements.add(
+            Requirement.new(l.CAPACITY_TYPE_LABEL_KEY, Operator.IN, l.CAPACITY_TYPE_SPOT)
+        )
+        if not self._filter_by_price(claim, candidate_price):
+            return Command(reason=self.reason)
+        ordered = order_by_price(claim.instance_types, claim.requirements)
+        if len(candidates) == 1 and len(ordered) < MIN_INSTANCE_TYPES_FOR_SPOT_TO_SPOT:
+            return Command(reason=self.reason)
+        claim.instance_types = ordered[:MAX_SPOT_TO_SPOT_LAUNCH_FLEXIBILITY]
+        return Command(
+            candidates=candidates, replacements=[claim], reason=self.reason, results=results
+        )
+
+
+class SingleNodeConsolidation(_ConsolidationBase):
+    """Per-candidate simulation, cheapest-savings first
+    (singlenodeconsolidation.go:33-146)."""
+
+    def compute(self, candidates: list[Candidate], budgets: dict[str, int]) -> Command:
+        eligible = _within_budget(
+            sorted(self.eligible(candidates), key=lambda c: c.savings_ratio), budgets
+        )
+        for c in eligible:
+            cmd = self.compute_consolidation([c])
+            if not cmd.is_empty:
+                return cmd
+        return Command(reason=self.reason)
+
+
+class MultiNodeConsolidation(_ConsolidationBase):
+    """Binary search over the savings-sorted candidate prefix
+    (multinodeconsolidation.go:52-191)."""
+
+    def compute(self, candidates: list[Candidate], budgets: dict[str, int]) -> Command:
+        eligible = _within_budget(
+            sorted(self.eligible(candidates), key=lambda c: c.savings_ratio), budgets
+        )[:MAX_MULTI_NODE_BATCH]
+        if len(eligible) < 2:
+            return Command(reason=self.reason)
+        # binary search on the prefix length: find the largest N where
+        # consolidating candidates[0..N) simulates successfully
+        lo, hi = 1, len(eligible)
+        best = Command(reason=self.reason)
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            cmd = self.compute_consolidation(eligible[:mid])
+            if not cmd.is_empty and self._replacement_improves(cmd, eligible[:mid]):
+                best = cmd
+                lo = mid + 1
+            else:
+                hi = mid - 1
+        return best
+
+    def _replacement_improves(self, cmd: Command, candidates: list[Candidate]) -> bool:
+        """Reject replacing N nodes with the same instance type as one of
+        them at no saving (multinodeconsolidation.go:209-246)."""
+        if not cmd.replacements:
+            return True
+        claim = cmd.replacements[0]
+        names = {it.name for it in claim.instance_types}
+        if len(candidates) == 1:
+            return True
+        return not all(
+            (c.instance_type is not None and c.instance_type.name in names and len(names) == 1)
+            for c in candidates
+        )

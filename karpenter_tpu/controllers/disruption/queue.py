@@ -1,0 +1,125 @@
+"""Disruption orchestration queue.
+
+Counterpart of reference disruption/orchestration/queue.go:313-392: taint
+candidates -> create replacement NodeClaims -> MarkForDeletion (strictly
+after replacements, the double-launch guard, queue.go:342-349) -> await
+replacement initialization -> delete candidates; roll back on failure.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from karpenter_tpu.controllers.disruption.methods import Command
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodeclaim import COND_INITIALIZED
+from karpenter_tpu.models.taints import DISRUPTED_NO_SCHEDULE_TAINT
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.store import ObjectStore
+from karpenter_tpu.utils.clock import Clock
+
+REPLACEMENT_TIMEOUT_SECONDS = 10 * 60.0
+
+
+@dataclass
+class _InFlight:
+    command: Command
+    replacement_names: list[str]
+    started_at: float
+    candidate_provider_ids: list[str] = field(default_factory=list)
+
+
+class OrchestrationQueue:
+    def __init__(self, store: ObjectStore, cluster: Cluster, provisioner, clock: Clock):
+        self.store = store
+        self.cluster = cluster
+        self.provisioner = provisioner
+        self.clock = clock
+        self.in_flight: list[_InFlight] = []
+
+    # -- StartCommand (queue.go:313-392) ------------------------------------
+
+    def start(self, command: Command) -> None:
+        # 1. taint candidates so nothing new schedules there
+        for c in command.candidates:
+            node = c.state_node.node
+            if node is not None and not any(
+                t.match(DISRUPTED_NO_SCHEDULE_TAINT) for t in node.spec.taints
+            ):
+                node.spec.taints.append(DISRUPTED_NO_SCHEDULE_TAINT)
+                self.store.update(ObjectStore.NODES, node)
+        # 2. create replacement NodeClaims, nominating their pods so the
+        # provisioner doesn't double-provision for them (provisioner.go
+        # create_node_claims parity)
+        replacement_names = []
+        for sim in command.replacements:
+            claim = self.provisioner._to_node_claim(sim)
+            self.store.create(ObjectStore.NODECLAIMS, claim)
+            self.cluster.update_nodeclaim(claim)
+            for pod in sim.pods:
+                self.cluster.nominate_pod(pod.uid, claim.name)
+            replacement_names.append(claim.name)
+        # 3. mark for deletion AFTER replacements exist (double-launch guard)
+        pids = [c.provider_id for c in command.candidates]
+        self.cluster.mark_for_deletion(*pids)
+        self.in_flight.append(
+            _InFlight(
+                command=command,
+                replacement_names=replacement_names,
+                started_at=self.clock.now(),
+                candidate_provider_ids=pids,
+            )
+        )
+
+    # -- waitOrTerminate (queue.go:186-257) -----------------------------------
+
+    def process(self) -> int:
+        """Advance in-flight commands; returns completed count."""
+        done = 0
+        remaining = []
+        for item in self.in_flight:
+            status = self._check(item)
+            if status == "wait":
+                remaining.append(item)
+            elif status == "done":
+                done += 1
+            # "rolled-back" items are dropped
+        self.in_flight = remaining
+        return done
+
+    def _check(self, item: _InFlight) -> str:
+        claims = [self.store.get(ObjectStore.NODECLAIMS, n) for n in item.replacement_names]
+        if any(c is None for c in claims):
+            self._rollback(item)  # a replacement failed to launch
+            return "rolled-back"
+        if not all(c.conditions.is_true(COND_INITIALIZED) for c in claims):
+            if self.clock.now() - item.started_at > REPLACEMENT_TIMEOUT_SECONDS:
+                self._rollback(item)
+                return "rolled-back"
+            return "wait"
+        # replacements ready: delete the candidates (graceful; the
+        # termination flow drains and the lifecycle finalizer fires)
+        for c in item.command.candidates:
+            claim = c.state_node.node_claim
+            if claim is not None and self.store.get(ObjectStore.NODECLAIMS, claim.name) is not None:
+                self.store.delete(ObjectStore.NODECLAIMS, claim.name)
+        return "done"
+
+    def _rollback(self, item: _InFlight) -> None:
+        """UnmarkForDeletion + untaint so the nodes return to service
+        (queue.go:416-427)."""
+        self.cluster.unmark_for_deletion(*item.candidate_provider_ids)
+        for c in item.command.candidates:
+            node = c.state_node.node
+            if node is None:
+                continue
+            live = self.store.get(ObjectStore.NODES, node.name)
+            if live is None:
+                continue
+            before = len(live.spec.taints)
+            live.spec.taints = [
+                t for t in live.spec.taints if not t.match(DISRUPTED_NO_SCHEDULE_TAINT)
+            ]
+            if len(live.spec.taints) != before:
+                self.store.update(ObjectStore.NODES, live)

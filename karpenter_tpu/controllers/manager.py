@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import Optional
 
 from karpenter_tpu.cloudprovider.spi import CloudProvider
+from karpenter_tpu.controllers.nodeclaim_disruption import NodeClaimDisruptionController
 from karpenter_tpu.controllers.nodeclaim_lifecycle import NodeClaimLifecycleController
 from karpenter_tpu.controllers.provisioning.batcher import Batcher
 from karpenter_tpu.controllers.provisioning.provisioner import Provisioner
@@ -31,6 +32,12 @@ class Manager:
         self.batcher = Batcher(self.clock)
         self.provisioner = Provisioner(store, self.cluster, cloud, self.clock)
         self.lifecycle = NodeClaimLifecycleController(store, cloud, self.clock)
+        self.nodeclaim_disruption = NodeClaimDisruptionController(store, cloud, self.clock)
+        from karpenter_tpu.controllers.disruption import DisruptionController
+
+        self.disruption = DisruptionController(
+            store, self.cluster, self.provisioner, cloud, self.clock
+        )
         self._dirty_claims: set[str] = set()
         self._claim_by_pid: dict[str, str] = {}  # provider_id -> claim name
         self._gated_passes = 0
@@ -107,6 +114,24 @@ class Manager:
                 self.batcher.reset()
                 worked = worked or outcome is not None
         return worked
+
+    def run_disruption_once(self):
+        """One disruption poll (the 10s singleton loop's body) followed by
+        an orchestration-queue pass and a drain of resulting work."""
+        command = self.disruption.reconcile()
+        self.run_until_idle()
+        self.disruption.queue.process()
+        self.run_until_idle()
+        return command
+
+    def mark_drift(self) -> int:
+        """Run the drift-detection pass over all claims; returns how many
+        transitioned (nodeclaim.disruption controller)."""
+        changed = 0
+        for claim in self.store.nodeclaims():
+            changed += bool(self.nodeclaim_disruption.reconcile(claim))
+        self.run_until_idle()
+        return changed
 
     def run_until_idle(self, max_iterations: int = 1000) -> None:
         """Drain reconcile work to a fixed point; advances the fake clock

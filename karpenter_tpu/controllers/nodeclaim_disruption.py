@@ -1,0 +1,62 @@
+"""NodeClaim disruption-condition controller: marks Drifted.
+
+Counterpart of reference pkg/controllers/nodeclaim/disruption
+(controller.go:77-113, drift.go:86-181): a claim drifts when the provider
+reports drift, or when its NodePool's static-field hash no longer matches
+the hash annotation stamped at creation, or when its requirements no
+longer satisfy the pool's requirements.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from karpenter_tpu.cloudprovider.spi import CloudProvider
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodeclaim import COND_DRIFTED, NodeClaim
+from karpenter_tpu.scheduling.requirements import node_selector_requirement
+from karpenter_tpu.state.store import ObjectStore
+from karpenter_tpu.utils.clock import Clock
+
+
+class NodeClaimDisruptionController:
+    def __init__(self, store: ObjectStore, cloud: CloudProvider, clock: Clock):
+        self.store = store
+        self.cloud = cloud
+        self.clock = clock
+
+    def drift_reason(self, claim: NodeClaim) -> Optional[str]:
+        pool = self.store.get(ObjectStore.NODEPOOLS, claim.nodepool_name or "")
+        if pool is None:
+            return None
+        # provider-side drift (CloudProvider.IsDrifted)
+        reason = self.cloud.is_drifted(claim)
+        if reason:
+            return reason
+        # static-field hash drift (drift.go:154-168)
+        stamped = claim.metadata.annotations.get(l.NODEPOOL_HASH_ANNOTATION_KEY)
+        if stamped is not None and stamped != pool.static_hash():
+            return "NodePoolDrifted"
+        # requirement drift (drift.go:170-181): the claim's labels must
+        # still satisfy every pool requirement — a requirement on a key the
+        # claim has no label for is also drift
+        for r in pool.spec.template.spec.requirements:
+            req = node_selector_requirement(r["key"], r["operator"], r.get("values", ()))
+            label = claim.metadata.labels.get(req.key)
+            if label is None:
+                if not req.is_lenient():
+                    return "RequirementsDrifted"
+                continue
+            if not req.has(label):
+                return "RequirementsDrifted"
+        return None
+
+    def reconcile(self, claim: NodeClaim) -> bool:
+        reason = self.drift_reason(claim)
+        if reason:
+            changed = claim.conditions.set_true(COND_DRIFTED, reason, now=self.clock.now())
+        else:
+            changed = claim.conditions.set_false(COND_DRIFTED, "NotDrifted", now=self.clock.now())
+        if changed and self.store.get(ObjectStore.NODECLAIMS, claim.name) is not None:
+            self.store.update(ObjectStore.NODECLAIMS, claim)
+        return changed

@@ -34,6 +34,7 @@ class ClaimTemplate:
     is_static: bool = False
     expire_after_seconds: "float | None" = None
     termination_grace_period_seconds: "float | None" = None
+    nodepool_hash: str = ""  # drift-detection hash (nodepool.go:334-344)
 
 
 def build_template(pool: NodePool, instance_types: list[InstanceType]) -> ClaimTemplate:
@@ -67,6 +68,7 @@ def build_template(pool: NodePool, instance_types: list[InstanceType]) -> ClaimT
         is_static=pool.is_static,
         expire_after_seconds=tmpl.spec.expire_after_seconds,
         termination_grace_period_seconds=tmpl.spec.termination_grace_period_seconds,
+        nodepool_hash=pool.static_hash(),
     )
 
 

@@ -62,7 +62,48 @@ class Provisioner:
     def _ready_pools(self) -> list[NodePool]:
         return [p for p in self.store.nodepools() if not p.is_static]
 
-    def _existing_sim_nodes(self) -> list[ExistingSimNode]:
+    def _bound_pods(self, excluded_nodes: Optional[set[str]] = None) -> list[tuple]:
+        """(pod, node labels) for bound pods — seeds topology counts
+        (topology.go:361-459 countDomains)."""
+        out = []
+        for sn in self.cluster.nodes():
+            if sn.node is None or (excluded_nodes and sn.name in excluded_nodes):
+                continue
+            for pod in sn.pods.values():
+                if not pod.is_terminal():
+                    out.append((pod, sn.node.metadata.labels))
+        return out
+
+    def _build_topology(self, pods, scheduler, excluded_nodes: Optional[set[str]] = None):
+        from karpenter_tpu.controllers.provisioning.topology import (
+            Topology,
+            build_universe_domains,
+        )
+
+        universe = build_universe_domains(
+            scheduler.templates, self._existing_sim_nodes(excluded_nodes)
+        )
+        return Topology.build(pods, universe, self._bound_pods(excluded_nodes))
+
+    def simulate(self, excluded_node_names: set[str], extra_pods: list[Pod]):
+        """Consolidation what-if (disruption helpers.go:53-154): schedule
+        pending + displaced pods against the cluster minus the excluded
+        nodes. Pure simulation: no claims created, no nominations."""
+        scheduler = self._build_scheduler()
+        if scheduler is None or not self.cluster.synced():
+            return None
+        pods = self.pending_pods() + list(extra_pods)
+        if not pods:
+            return SchedulingResult(claims=[], unschedulable=[], assignments={})
+        existing = self._existing_sim_nodes(excluded_node_names)
+        return scheduler.solve(
+            pods,
+            existing,
+            self._remaining_budgets(),
+            topology=self._build_topology(pods, scheduler, excluded_node_names),
+        )
+
+    def _existing_sim_nodes(self, excluded: Optional[set[str]] = None) -> list[ExistingSimNode]:
         """Registered, schedulable cluster nodes as tier-1 candidates
         (scheduler.go:1060 calculateExistingNodeClaims), sorted by name for
         deterministic earliest-index-wins."""
@@ -82,6 +123,8 @@ class Provisioner:
         for sn in sorted(self.cluster.nodes(), key=lambda s: s.name):
             node = sn.node
             if node is None or sn.marked_for_deletion or sn.is_disrupted():
+                continue
+            if excluded and sn.name in excluded:
                 continue
             if not sn.registered:
                 continue
@@ -141,15 +184,6 @@ class Provisioner:
         self._scheduler_cache = (sig, sched)
         return sched
 
-    def schedule(self, pods: list[Pod]) -> Optional[SchedulingResult]:
-        """Schedule without side effects (used by disruption simulations)."""
-        if not pods or not self.cluster.synced():
-            return None
-        scheduler = self._build_scheduler()
-        if scheduler is None:
-            return None
-        return scheduler.solve(pods, self._existing_sim_nodes(), self._remaining_budgets())
-
     # -- claim creation (provisioner.go:169-221, :460-506) -----------------------
 
     def create_node_claims(self, result: SchedulingResult) -> list[NodeClaim]:
@@ -194,6 +228,10 @@ class Provisioner:
             metadata=ObjectMeta(
                 name=name,
                 labels={**tmpl.labels, l.NODEPOOL_LABEL_KEY: tmpl.nodepool_name},
+                annotations={
+                    l.NODEPOOL_HASH_ANNOTATION_KEY: tmpl.nodepool_hash,
+                    l.NODEPOOL_HASH_VERSION_ANNOTATION_KEY: "v1",
+                },
             ),
             spec=NodeClaimSpec(
                 taints=list(tmpl.taints),
@@ -220,7 +258,12 @@ class Provisioner:
         scheduler = self._build_scheduler()
         if scheduler is None:
             return self.GATED
-        result = scheduler.solve(pods, self._existing_sim_nodes(), self._remaining_budgets())
+        result = scheduler.solve(
+            pods,
+            self._existing_sim_nodes(),
+            self._remaining_budgets(),
+            topology=self._build_topology(pods, scheduler),
+        )
         self.create_node_claims(result)
         # nominate pods placed on existing nodes so the kube-scheduler (sim)
         # binds them and the next pass doesn't re-provision

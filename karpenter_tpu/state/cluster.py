@@ -179,10 +179,14 @@ class Cluster:
                 sn.pods.pop(pod.uid, None)
             return
         self._pod_nominations.pop(pod.uid, None)  # bound: nomination fulfilled
+        newly_bound = old != node_name
         self._bindings[pod.uid] = node_name
         sn = self.node_by_name(node_name)
         if sn is not None:
             sn.pods[pod.uid] = pod
+            # consolidateAfter idle timing (podevents controller analog)
+            if newly_bound and sn.node_claim is not None:
+                sn.node_claim.status.last_pod_event_time = self.clock.now()
 
     def delete_pod(self, pod: Pod) -> None:
         node_name = self._bindings.pop(pod.uid, None)
@@ -190,6 +194,8 @@ class Cluster:
             sn = self.node_by_name(node_name)
             if sn is not None:
                 sn.pods.pop(pod.uid, None)
+                if sn.node_claim is not None:
+                    sn.node_claim.status.last_pod_event_time = self.clock.now()
 
     # -- reads ----------------------------------------------------------------
 
