@@ -98,27 +98,33 @@ class KwokCloudProvider(CloudProvider):
         return claim
 
     def delete(self, claim: NodeClaim) -> None:
-        node = next(
-            (
-                n
-                for n in self.store.nodes()
-                if n.spec.provider_id == claim.status.provider_id
-            ),
-            None,
-        )
+        node = self.store.node_by_provider_id(claim.status.provider_id)
         if node is None:
             raise errors.NodeClaimNotFoundError(claim.status.provider_id)
         node.metadata.finalizers = []
         self.store.delete(ObjectStore.NODES, node.name)
 
+    def _instance_to_claim(self, node) -> NodeClaim:
+        """Cloud truth is the set of fabricated nodes (the instances);
+        surface each as a claim-shaped record."""
+        claim = NodeClaim(metadata=ObjectMeta(name=node.name, labels=dict(node.metadata.labels)))
+        claim.status.provider_id = node.spec.provider_id
+        claim.status.capacity = dict(node.status.capacity)
+        claim.status.allocatable = dict(node.status.allocatable)
+        return claim
+
     def get(self, provider_id: str) -> NodeClaim:
-        for claim in self.store.nodeclaims():
-            if claim.status.provider_id == provider_id:
-                return claim
-        raise errors.NodeClaimNotFoundError(provider_id)
+        node = self.store.node_by_provider_id(provider_id)
+        if node is None or not provider_id.startswith("kwok://"):
+            raise errors.NodeClaimNotFoundError(provider_id)
+        return self._instance_to_claim(node)
 
     def list(self) -> list[NodeClaim]:
-        return [c for c in self.store.nodeclaims() if c.status.provider_id]
+        return [
+            self._instance_to_claim(n)
+            for n in self.store.nodes()
+            if n.spec.provider_id.startswith("kwok://")
+        ]
 
     def is_drifted(self, claim: NodeClaim) -> Optional[str]:
         return None

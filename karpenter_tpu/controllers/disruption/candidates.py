@@ -85,8 +85,15 @@ def build_candidates(
     PDB-violating eviction must disqualify the node here (types.go:160).
     """
     out = []
+    nominated_targets = cluster.nomination_targets()
     for sn in sorted(cluster.nodes(), key=lambda s: s.name):
         if is_disruptable(sn, clock) is not None:
+            continue
+        # capacity that pending pods are nominated onto (a fresh replacement
+        # node, or one awaiting binds) must not be disrupted from under them
+        if sn.name in nominated_targets or (
+            sn.node_claim is not None and sn.node_claim.name in nominated_targets
+        ):
             continue
         pool = pools_by_name.get(sn.nodepool_name or "")
         if pool is None:

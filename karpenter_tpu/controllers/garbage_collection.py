@@ -1,0 +1,151 @@
+"""Reconciling cloud truth: garbage collection, expiration, node repair.
+
+Counterparts of reference pkg/controllers/nodeclaim/garbagecollection
+(controller.go:64-133), nodeclaim/expiration (controller.go:58-107), and
+node/health (controller.go:110-215 with the 20% circuit breaker).
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.cloudprovider.spi import CloudProvider
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodeclaim import COND_LAUNCHED
+from karpenter_tpu.state.store import ObjectStore
+from karpenter_tpu.utils.clock import Clock
+
+UNHEALTHY_CIRCUIT_BREAKER_FRACTION = 0.20  # health/controller.go:110-215
+
+
+class GarbageCollectionController:
+    """Deletes claims whose instance vanished and nodes without claims.
+
+    Pods bound to collected nodes are evicted first so they reschedule —
+    without this, a vanished instance would strand its pods Running with a
+    dangling node_name forever.
+    """
+
+    def __init__(self, store: ObjectStore, cloud: CloudProvider, clock: Clock):
+        from karpenter_tpu.controllers.node_termination import Terminator
+
+        self.store = store
+        self.cloud = cloud
+        self.clock = clock
+        self.terminator = Terminator(store, clock)
+
+    def _evict_bound_pods(self, node_name: str) -> None:
+        for pod in self.store.pods():
+            if pod.spec.node_name == node_name and not pod.is_terminal():
+                self.terminator._evict(pod)
+
+    def reconcile(self) -> int:
+        removed = 0
+        live_pids = {c.status.provider_id for c in self.cloud.list()}
+        for claim in list(self.store.nodeclaims()):
+            if not claim.conditions.is_true(COND_LAUNCHED) or not claim.status.provider_id:
+                continue
+            if claim.status.provider_id not in live_pids:
+                node = self.store.node_by_provider_id(claim.status.provider_id)
+                if node is not None:
+                    self._evict_bound_pods(node.name)
+                elif claim.status.node_name:
+                    self._evict_bound_pods(claim.status.node_name)
+                claim.metadata.finalizers = []
+                self.store.delete(ObjectStore.NODECLAIMS, claim.name)
+                removed += 1
+        claim_pids = {
+            c.status.provider_id for c in self.store.nodeclaims() if c.status.provider_id
+        }
+        for node in list(self.store.nodes()):
+            managed = l.NODEPOOL_LABEL_KEY in node.metadata.labels
+            if managed and node.spec.provider_id not in claim_pids:
+                self._evict_bound_pods(node.name)
+                node.metadata.finalizers = []
+                self.store.delete(ObjectStore.NODES, node.name)
+                removed += 1
+        return removed
+
+
+class ExpirationController:
+    """Forcefully deletes claims older than expireAfter
+    (expiration/controller.go:58-107)."""
+
+    def __init__(self, store: ObjectStore, clock: Clock):
+        self.store = store
+        self.clock = clock
+
+    def reconcile(self) -> int:
+        expired = 0
+        for claim in list(self.store.nodeclaims()):
+            after = claim.spec.expire_after_seconds
+            if after is None:
+                continue
+            if self.clock.now() - claim.metadata.creation_timestamp >= after:
+                self.store.delete(ObjectStore.NODECLAIMS, claim.name)
+                expired += 1
+        return expired
+
+
+class NodeHealthController:
+    """Force-deletes unhealthy nodes per provider RepairPolicies, with a
+    cluster-wide >20%-unhealthy circuit breaker (health/controller.go).
+
+    Condition feed contract: callers observe() when an unhealthy condition
+    appears and resolve() when it recovers — repair requires the condition
+    to PERSIST for the policy's toleration window, so a recovered blip must
+    be resolved or the node would be repaired spuriously.
+    """
+
+    def __init__(self, store: ObjectStore, cloud: CloudProvider, clock: Clock):
+        self.store = store
+        self.cloud = cloud
+        self.clock = clock
+        self._unhealthy_since: dict[str, float] = {}
+
+    def observe(self, node_name: str, condition_type: str, status: str) -> None:
+        """Record a node condition (the harness's kubelet-condition feed)."""
+        key = f"{node_name}/{condition_type}={status}"
+        self._unhealthy_since.setdefault(key, self.clock.now())
+
+    def resolve(self, node_name: str, condition_type: str) -> None:
+        """The condition recovered: drop its timer."""
+        prefix = f"{node_name}/{condition_type}="
+        self._unhealthy_since = {
+            k: v for k, v in self._unhealthy_since.items() if not k.startswith(prefix)
+        }
+
+    def clear(self, node_name: str) -> None:
+        self._unhealthy_since = {
+            k: v for k, v in self._unhealthy_since.items() if not k.startswith(node_name + "/")
+        }
+
+    def reconcile(self) -> int:
+        policies = self.cloud.repair_policies()
+        if not policies:
+            return 0
+        nodes = self.store.nodes()
+        if not nodes:
+            return 0
+        unhealthy_nodes = set()
+        for policy in policies:
+            key_suffix = f"/{policy.condition_type}={policy.condition_status}"
+            for key, since in self._unhealthy_since.items():
+                if key.endswith(key_suffix) and self.clock.now() - since >= policy.toleration_seconds:
+                    unhealthy_nodes.add(key.split("/", 1)[0])
+        if not unhealthy_nodes:
+            return 0
+        # circuit breaker: never repair when >20% of the fleet is unhealthy
+        if len(unhealthy_nodes) / len(nodes) > UNHEALTHY_CIRCUIT_BREAKER_FRACTION and len(nodes) > 1:
+            return 0
+        repaired = 0
+        claim_by_pid = {
+            c.status.provider_id: c for c in self.store.nodeclaims() if c.status.provider_id
+        }
+        for node in nodes:
+            if node.name not in unhealthy_nodes:
+                continue
+            claim = claim_by_pid.get(node.spec.provider_id)
+            if claim is not None:
+                self.store.delete(ObjectStore.NODECLAIMS, claim.name)
+                self.clear(node.name)
+                repaired += 1
+        return repaired

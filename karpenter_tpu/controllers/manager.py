@@ -34,10 +34,18 @@ class Manager:
         self.lifecycle = NodeClaimLifecycleController(store, cloud, self.clock)
         self.nodeclaim_disruption = NodeClaimDisruptionController(store, cloud, self.clock)
         from karpenter_tpu.controllers.disruption import DisruptionController
+        from karpenter_tpu.controllers.garbage_collection import (
+            ExpirationController,
+            GarbageCollectionController,
+            NodeHealthController,
+        )
 
         self.disruption = DisruptionController(
             store, self.cluster, self.provisioner, cloud, self.clock
         )
+        self.garbage_collection = GarbageCollectionController(store, cloud, self.clock)
+        self.expiration = ExpirationController(store, self.clock)
+        self.health = NodeHealthController(store, cloud, self.clock)
         self._dirty_claims: set[str] = set()
         self._claim_by_pid: dict[str, str] = {}  # provider_id -> claim name
         self._gated_passes = 0
@@ -67,6 +75,10 @@ class Manager:
     def _on_node(self, event: EventType, node) -> None:
         if event is EventType.DELETED:
             self.cluster.delete_node(node.name)
+            self.cluster.clear_nominations_for(node.name)
+            self.health.clear(node.name)  # stale entries would jam the breaker
+            if any(p.is_provisionable() for p in self.store.pods()):
+                self.batcher.trigger()
             return
         self.cluster.update_node(node)
         # node changes can unblock registration/initialization
@@ -80,6 +92,9 @@ class Manager:
             self.cluster.clear_nominations_for(claim.name)
             if claim.status.provider_id:
                 self._claim_by_pid.pop(claim.status.provider_id, None)
+            # pods that were counting on this claim need a fresh pass
+            if any(p.is_provisionable() for p in self.store.pods()):
+                self.batcher.trigger()
             return
         self.cluster.update_nodeclaim(claim)
         if claim.status.provider_id:
@@ -123,6 +138,17 @@ class Manager:
         self.disruption.queue.process()
         self.run_until_idle()
         return command
+
+    def run_maintenance(self) -> dict:
+        """One pass of the periodic housekeeping controllers (GC,
+        expiration, health), then drain resulting work."""
+        out = {
+            "expired": self.expiration.reconcile(),
+            "garbage_collected": self.garbage_collection.reconcile(),
+            "repaired": self.health.reconcile(),
+        }
+        self.run_until_idle()
+        return out
 
     def mark_drift(self) -> int:
         """Run the drift-detection pass over all claims; returns how many

@@ -1,0 +1,72 @@
+"""Node termination: taint -> drain -> delete instance -> drop finalizer.
+
+Counterpart of reference pkg/controllers/node/termination
+(controller.go:93-191, terminator/terminator.go:96-138): eviction happens
+in priority groups (non-critical first, critical last). Evictions here are
+immediate — terminationGracePeriod enforcement (terminator.go:140-176,
+force-deleting pods whose graceful eviction would overrun the period) is
+not modeled yet because the harness has no graceful pod shutdown to race.
+
+Evicted pods return to Pending/Unschedulable, so the provisioner
+reschedules them — the harness analog of the kube eviction API.
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.models.node import Node
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.models.taints import DISRUPTED_NO_SCHEDULE_TAINT
+from karpenter_tpu.state.store import ObjectStore
+from karpenter_tpu.utils.clock import Clock
+
+CRITICAL_PRIORITY_THRESHOLD = 2_000_000_000  # system-cluster-critical
+
+
+class Terminator:
+    """Priority-grouped drainer (terminator/terminator.go:96-138)."""
+
+    def __init__(self, store: ObjectStore, clock: Clock):
+        self.store = store
+        self.clock = clock
+
+    def drain(self, node: Node) -> int:
+        """Evict every evictable pod on the node; returns how many moved.
+
+        Non-critical pods are evicted before critical ones so critical
+        workloads keep running while replacements come up.
+        """
+        pods = [
+            p
+            for p in self.store.pods()
+            if p.spec.node_name == node.name and not p.is_terminal()
+        ]
+        pods.sort(key=lambda p: (p.spec.priority >= CRITICAL_PRIORITY_THRESHOLD, p.name))
+        evicted = 0
+        for pod in pods:
+            self._evict(pod)
+            evicted += 1
+        return evicted
+
+    def _evict(self, pod: Pod) -> None:
+        """The eviction-API analog: unbind and mark unschedulable so the
+        provisioner picks the pod up again."""
+        pod.spec.node_name = ""
+        pod.status.phase = "Pending"
+        pod.status.conditions["PodScheduled"] = "Unschedulable"
+        self.store.update(ObjectStore.PODS, pod)
+
+
+class NodeTerminationController:
+    """Drives the termination of nodes whose claims are deleting."""
+
+    def __init__(self, store: ObjectStore, clock: Clock):
+        self.store = store
+        self.clock = clock
+        self.terminator = Terminator(store, clock)
+
+    def prepare(self, node: Node) -> int:
+        """Taint + drain (controller.go:93-138). Returns pods evicted."""
+        if not any(t.match(DISRUPTED_NO_SCHEDULE_TAINT) for t in node.spec.taints):
+            node.spec.taints.append(DISRUPTED_NO_SCHEDULE_TAINT)
+            self.store.update(ObjectStore.NODES, node)
+        return self.terminator.drain(node)

@@ -27,10 +27,15 @@ LAUNCH_TTL_SECONDS = 5 * 60.0  # liveness.go:59 registration/launch timeout
 
 
 class NodeClaimLifecycleController:
-    def __init__(self, store: ObjectStore, cloud: CloudProvider, clock: Clock):
+    def __init__(self, store: ObjectStore, cloud: CloudProvider, clock: Clock, terminator=None):
         self.store = store
         self.cloud = cloud
         self.clock = clock
+        if terminator is None:
+            from karpenter_tpu.controllers.node_termination import NodeTerminationController
+
+            terminator = NodeTerminationController(store, clock)
+        self.terminator = terminator
 
     def reconcile(self, claim: NodeClaim) -> None:
         if claim.metadata.deleting:
@@ -125,7 +130,12 @@ class NodeClaimLifecycleController:
     # -- finalize (controller.go:198) -------------------------------------------
 
     def _finalize(self, claim: NodeClaim) -> None:
-        # instance termination FIRST (the provider owns the node object in
+        # drain first: taint + evict pods so they reschedule (the node
+        # termination flow, termination/controller.go:93-191)
+        node = self._node_for(claim)
+        if node is not None:
+            self.terminator.prepare(node)
+        # then instance termination (the provider owns the node object in
         # simulated clouds); the store node is only force-dropped if the
         # provider had already lost the instance
         try:

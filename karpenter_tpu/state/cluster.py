@@ -255,6 +255,12 @@ class Cluster:
     def clear_pod_nomination(self, pod_uid: str) -> None:
         self._pod_nominations.pop(pod_uid, None)
 
+    def nomination_targets(self) -> set[str]:
+        """Names (claims or nodes) with live pod nominations — capacity that
+        pending pods are counting on and disruption must not take."""
+        now = self.clock.now()
+        return {t for t, exp in self._pod_nominations.values() if exp > now}
+
     def clear_nominations_for(self, target: str) -> None:
         """Drop nominations to a claim/node that went away so its pods
         become provisionable again immediately."""

@@ -1,0 +1,189 @@
+"""Termination/drain, garbage collection, expiration, and node repair."""
+
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.cloudprovider.spi import RepairPolicy
+from karpenter_tpu.controllers.manager import KubeSchedulerSim, Manager
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import make_pod
+from karpenter_tpu.state.store import ObjectStore
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def build_env(expire_after=None, catalog_size=50):
+    clock = FakeClock()
+    store = ObjectStore(clock)
+    cloud = KwokCloudProvider(store, catalog=instance_types(catalog_size))
+    mgr = Manager(store, cloud, clock)
+    pool = NodePool()
+    pool.metadata.name = "default"
+    pool.spec.template.spec.expire_after_seconds = expire_after
+    store.create(ObjectStore.NODEPOOLS, pool)
+    return clock, store, cloud, mgr
+
+
+def provision(mgr, store, cloud, pods):
+    for p in pods:
+        store.create(ObjectStore.PODS, p)
+    mgr.run_until_idle()
+    cloud.simulate_kubelet_ready()
+    mgr.run_until_idle()
+    KubeSchedulerSim(store, mgr.cluster).bind_pending()
+    mgr.run_until_idle()
+
+
+class TestTerminationDrain:
+    def test_claim_deletion_evicts_and_reschedules_pods(self):
+        """The earlier gap: deleting a claim must drain its pods back to
+        Pending so the provisioner re-places them."""
+        clock, store, cloud, mgr = build_env()
+        provision(mgr, store, cloud, [make_pod(f"p-{i}", cpu=0.5) for i in range(6)])
+        assert all(p.spec.node_name for p in store.pods())
+        claim = store.nodeclaims()[0]
+        n_pods_on_node = sum(
+            1 for p in store.pods() if p.spec.node_name == claim.status.node_name
+        )
+        assert n_pods_on_node > 0
+        store.delete(ObjectStore.NODECLAIMS, claim.name)
+        mgr.run_until_idle()
+        # evicted pods become provisionable and a replacement claim appears
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        KubeSchedulerSim(store, mgr.cluster).bind_pending()
+        orphans = [
+            p
+            for p in store.pods()
+            if p.spec.node_name
+            and not any(n.name == p.spec.node_name for n in store.nodes())
+        ]
+        assert orphans == []
+        assert all(p.spec.node_name for p in store.pods()), "pods not rescheduled"
+
+    def test_drain_priority_order(self):
+        from karpenter_tpu.controllers.node_termination import Terminator
+
+        clock, store, cloud, mgr = build_env()
+        critical = make_pod("critical", cpu=0.1)
+        critical.spec.priority = 2_000_000_001
+        normal = make_pod("normal", cpu=0.1)
+        provision(mgr, store, cloud, [critical, normal])
+        node = store.nodes()[0]
+        order = []
+        t = Terminator(store, clock)
+        orig = t._evict
+        t._evict = lambda p: (order.append(p.name), orig(p))
+        t.drain(node)
+        assert order == ["normal", "critical"]
+
+
+class TestGarbageCollection:
+    def test_vanished_instance_collects_claim(self):
+        clock, store, cloud, mgr = build_env()
+        provision(mgr, store, cloud, [make_pod("p", cpu=0.5)])
+        claim = store.nodeclaims()[0]
+        # the instance disappears behind karpenter's back
+        node = store.nodes()[0]
+        cloud_node = node
+        del_claim = claim
+        # simulate cloud-side vanish: remove from provider accounting only
+        cloud.delete(claim)
+        out = mgr.run_maintenance()
+        assert out["garbage_collected"] >= 1
+        assert store.get(ObjectStore.NODECLAIMS, del_claim.name) is None
+        # the pod on the vanished node was evicted and re-provisions
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        KubeSchedulerSim(store, mgr.cluster).bind_pending()
+        pod = store.get(ObjectStore.PODS, "p")
+        assert pod.spec.node_name and any(
+            n.name == pod.spec.node_name for n in store.nodes()
+        ), "pod stranded after instance vanished"
+
+    def test_health_flap_does_not_repair(self):
+        from karpenter_tpu.cloudprovider.spi import RepairPolicy
+
+        clock, store, cloud, mgr = build_env()
+        provision(mgr, store, cloud, [make_pod("p", cpu=0.5)])
+        cloud.repair_policies = lambda: [
+            RepairPolicy(condition_type="Ready", condition_status="False", toleration_seconds=300.0)
+        ]
+        node = store.nodes()[0]
+        mgr.health.observe(node.name, "Ready", "False")
+        clock.step(10.0)
+        mgr.health.resolve(node.name, "Ready")  # the blip recovered
+        clock.step(600.0)
+        assert mgr.run_maintenance()["repaired"] == 0
+
+    def test_orphan_node_collected(self):
+        clock, store, cloud, mgr = build_env()
+        provision(mgr, store, cloud, [make_pod("p", cpu=0.5)])
+        node = store.nodes()[0]
+        claim = store.nodeclaims()[0]
+        # claim vanishes without finalization (e.g. etcd surgery)
+        claim.metadata.finalizers = []
+        store.delete(ObjectStore.NODECLAIMS, claim.name)
+        # instance still exists; the node is managed but claimless
+        out = mgr.run_maintenance()
+        assert all(n.name != node.name for n in store.nodes())
+
+
+class TestExpiration:
+    def test_expired_claim_replaced(self):
+        clock, store, cloud, mgr = build_env(expire_after=3600.0)
+        provision(mgr, store, cloud, [make_pod("p", cpu=0.5)])
+        name = store.nodeclaims()[0].name
+        clock.step(3601.0)
+        out = mgr.run_maintenance()
+        assert out["expired"] == 1
+        assert store.get(ObjectStore.NODECLAIMS, name) is None
+        # the drained pod reschedules onto a fresh claim
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        KubeSchedulerSim(store, mgr.cluster).bind_pending()
+        assert all(p.spec.node_name for p in store.pods())
+
+    def test_not_expired_yet(self):
+        clock, store, cloud, mgr = build_env(expire_after=3600.0)
+        provision(mgr, store, cloud, [make_pod("p", cpu=0.5)])
+        clock.step(600.0)
+        assert mgr.run_maintenance()["expired"] == 0
+
+
+class TestNodeRepair:
+    def _policies(self, cloud):
+        cloud._repair_policies = [
+            RepairPolicy(
+                condition_type="Ready", condition_status="False", toleration_seconds=300.0
+            )
+        ]
+
+    def test_unhealthy_node_repaired_after_toleration(self):
+        clock, store, cloud, mgr = build_env()
+        provision(mgr, store, cloud, [make_pod(f"p-{i}", cpu=0.5) for i in range(2)])
+        # KwokCloudProvider doesn't expose scripted repair policies; patch
+        cloud.repair_policies = lambda: [
+            RepairPolicy(condition_type="Ready", condition_status="False", toleration_seconds=300.0)
+        ]
+        node = store.nodes()[0]
+        mgr.health.observe(node.name, "Ready", "False")
+        assert mgr.run_maintenance()["repaired"] == 0  # toleration not elapsed
+        clock.step(301.0)
+        assert mgr.run_maintenance()["repaired"] == 1
+
+    def test_circuit_breaker(self):
+        # catalog of 1/2/4-cpu shapes: each 3.5-cpu pod needs its own node
+        clock, store, cloud, mgr = build_env(catalog_size=24)
+        provision(
+            mgr, store, cloud,
+            [make_pod(f"p-{i}", cpu=3.5, memory="1Gi") for i in range(4)],
+        )
+        nodes = store.nodes()
+        assert len(nodes) >= 2
+        cloud.repair_policies = lambda: [
+            RepairPolicy(condition_type="Ready", condition_status="False", toleration_seconds=1.0)
+        ]
+        for n in nodes:  # 100% unhealthy > 20% breaker
+            mgr.health.observe(n.name, "Ready", "False")
+        clock.step(10.0)
+        assert mgr.run_maintenance()["repaired"] == 0
