@@ -1,0 +1,55 @@
+"""Capacity buffers: headroom reservation via virtual pods.
+
+Counterpart of reference pkg/apis/autoscaling/v1beta1 CapacityBuffer +
+pkg/controllers/capacitybuffer and the virtual-pod injection in
+provisioning (buffers.go:72-190): a buffer asks for N replicas of a pod
+template to be schedulable at all times; the provisioner injects synthetic
+pods so capacity stays warm, and real pods displace them naturally
+(virtual pods never bind, so their nodes always look available to the
+kube-scheduler).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+
+from karpenter_tpu.models.objects import ObjectMeta
+from karpenter_tpu.models.pod import Pod, PodSpec
+
+BUFFER_POD_ANNOTATION = "karpenter.sh/capacity-buffer"
+
+
+@dataclass
+class CapacityBuffer:
+    """autoscaling.x-k8s.io/v1beta1 CapacityBuffer (capacitybuffer.go:73)."""
+
+    metadata: ObjectMeta = field(default_factory=lambda: ObjectMeta(name="buffer"))
+    pod_template: PodSpec = field(default_factory=PodSpec)
+    replicas: int = 0
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+def virtual_pods(buffers: list[CapacityBuffer]) -> list[Pod]:
+    """Synthetic pods injected into a Solve (buffers.go:72-190); marked so
+    nomination and binding skip them (scheduler.go:305-344)."""
+    out = []
+    for buffer in buffers:
+        for i in range(buffer.replicas):
+            pod = Pod(
+                metadata=ObjectMeta(
+                    name=f"buffer-{buffer.name}-{i}",
+                    annotations={BUFFER_POD_ANNOTATION: buffer.name},
+                ),
+                spec=copy.deepcopy(buffer.pod_template),
+            )
+            pod.status.conditions["PodScheduled"] = "Unschedulable"
+            out.append(pod)
+    return out
+
+
+def is_buffer_pod(pod: Pod) -> bool:
+    return BUFFER_POD_ANNOTATION in pod.metadata.annotations

@@ -77,7 +77,7 @@ class ExpirationController:
         expired = 0
         for claim in list(self.store.nodeclaims()):
             after = claim.spec.expire_after_seconds
-            if after is None:
+            if after is None or claim.metadata.deleting:
                 continue
             if self.clock.now() - claim.metadata.creation_timestamp >= after:
                 self.store.delete(ObjectStore.NODECLAIMS, claim.name)
@@ -125,6 +125,19 @@ class NodeHealthController:
         nodes = self.store.nodes()
         if not nodes:
             return 0
+        # prune entries for nodes that no longer exist — stale timers must
+        # not inflate the circuit breaker
+        live = {n.name for n in nodes}
+        self._unhealthy_since = {
+            k: v for k, v in self._unhealthy_since.items() if k.split("/", 1)[0] in live
+        }
+        # EVERY unhealthy node counts toward the breaker — including
+        # unmanaged ones repair can't touch (health/controller.go:249-263:
+        # a mostly-unhealthy cluster means something systemic, so repairs
+        # must stop) — but only claim-backed nodes are repairable
+        claim_by_pid = {
+            c.status.provider_id: c for c in self.store.nodeclaims() if c.status.provider_id
+        }
         unhealthy_nodes = set()
         for policy in policies:
             key_suffix = f"/{policy.condition_type}={policy.condition_status}"
@@ -137,9 +150,6 @@ class NodeHealthController:
         if len(unhealthy_nodes) / len(nodes) > UNHEALTHY_CIRCUIT_BREAKER_FRACTION and len(nodes) > 1:
             return 0
         repaired = 0
-        claim_by_pid = {
-            c.status.provider_id: c for c in self.store.nodeclaims() if c.status.provider_id
-        }
         for node in nodes:
             if node.name not in unhealthy_nodes:
                 continue

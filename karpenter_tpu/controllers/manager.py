@@ -46,6 +46,9 @@ class Manager:
         self.garbage_collection = GarbageCollectionController(store, cloud, self.clock)
         self.expiration = ExpirationController(store, self.clock)
         self.health = NodeHealthController(store, cloud, self.clock)
+        from karpenter_tpu.controllers.static_capacity import StaticCapacityController
+
+        self.static_capacity = StaticCapacityController(store, self.cluster, cloud, self.clock)
         self._dirty_claims: set[str] = set()
         self._claim_by_pid: dict[str, str] = {}  # provider_id -> claim name
         self._gated_passes = 0
@@ -146,6 +149,7 @@ class Manager:
             "expired": self.expiration.reconcile(),
             "garbage_collected": self.garbage_collection.reconcile(),
             "repaired": self.health.reconcile(),
+            "static_delta": self.static_capacity.reconcile(),
         }
         self.run_until_idle()
         return out

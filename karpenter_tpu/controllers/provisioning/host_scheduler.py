@@ -66,6 +66,18 @@ class ExistingSimNode:
     used: dict[str, float] = field(default_factory=dict)
     pods: list[Pod] = field(default_factory=list)
 
+    def clone(self) -> "ExistingSimNode":
+        """Pristine copy for simulation retries (relaxation loop)."""
+        return ExistingSimNode(
+            name=self.name,
+            index=self.index,
+            requirements=self.requirements.copy(),
+            available=dict(self.available),
+            taints=list(self.taints),
+            used=dict(self.used),
+            pods=list(self.pods),
+        )
+
 
 @dataclass
 class SchedulingResult:
@@ -269,6 +281,27 @@ class HostScheduler:
         return None
 
     def solve(self, pods: list[Pod]) -> SchedulingResult:
+        """Solve with the shared preference relaxation ladder; per-round
+        state (existing nodes, budgets, topology counts) is snapshotted so
+        retries start pristine."""
+        import copy as _copy
+
+        from karpenter_tpu.controllers.provisioning import preferences as prefs
+
+        base_existing = [n.clone() for n in self.existing_nodes]
+        base_budgets = {k: dict(v) for k, v in self.budgets.items()}
+        base_topology = _copy.deepcopy(self.topology)
+
+        def solve_round(current: list[Pod]) -> SchedulingResult:
+            self.existing_nodes = [n.clone() for n in base_existing]
+            self.budgets = {k: dict(v) for k, v in base_budgets.items()}
+            self.topology = _copy.deepcopy(base_topology)
+            self._hostname_seq = 0
+            return self._solve_once(current)
+
+        return prefs.run_with_relaxation(list(pods), solve_round)
+
+    def _solve_once(self, pods: list[Pod]) -> SchedulingResult:
         claims: list[SimClaim] = []
         unschedulable: list[tuple[Pod, str]] = []
         assignments: dict[str, int] = {}

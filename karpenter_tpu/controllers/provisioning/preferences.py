@@ -1,0 +1,126 @@
+"""The preference relaxation ladder.
+
+Exact counterpart of reference preferences.go:38-146. Each relaxation
+round removes exactly ONE preference, trying rungs in the reference's
+order (Relax, preferences.go:39-44):
+
+  1. a required node-affinity OR term (first term dropped; at least one
+     term is always kept)
+  2. a preferred pod-affinity term (heaviest first)
+  3. a preferred pod-anti-affinity term (heaviest first)
+  4. a preferred node-affinity term (heaviest first)
+  5. a ScheduleAnyway topology spread constraint (one per round)
+  6. a toleration for PreferNoSchedule taints (single final rung)
+
+Relaxation derives a relaxed COPY of the pod (same uid) so every
+downstream consumer — Requirements.from_pod, topology group matching,
+toleration checks — sees the relaxed spec. Per-pod state is how many rungs
+of that pod's ladder have been applied.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.models.taints import PREFER_NO_SCHEDULE, TOLERATION_OP_EXISTS, Toleration
+
+RUNG_OR_TERM = "required-or-term"
+RUNG_PREF_POD_AFFINITY = "preferred-pod-affinity"
+RUNG_PREF_POD_ANTI = "preferred-pod-anti-affinity"
+RUNG_PREF_NODE = "preferred-node-affinity"
+RUNG_SOFT_TSC = "schedule-anyway-tsc"
+RUNG_TOLERATE = "tolerate-prefer-no-schedule"
+
+
+def rungs(pod: Pod) -> list[str]:
+    """The pod-specific ladder in reference order; each entry removes one
+    preference."""
+    out: list[str] = []
+    na = pod.spec.node_affinity
+    if na is not None and len(na.required) > 1:
+        out.extend([RUNG_OR_TERM] * (len(na.required) - 1))
+    out.extend([RUNG_PREF_POD_AFFINITY] * len(pod.spec.preferred_pod_affinity))
+    out.extend([RUNG_PREF_POD_ANTI] * len(pod.spec.preferred_pod_anti_affinity))
+    if na is not None:
+        out.extend([RUNG_PREF_NODE] * len(na.preferred))
+    out.extend(
+        [RUNG_SOFT_TSC]
+        * sum(
+            1
+            for t in pod.spec.topology_spread_constraints
+            if t.when_unsatisfiable == "ScheduleAnyway"
+        )
+    )
+    out.append(RUNG_TOLERATE)
+    return out
+
+
+def can_relax(pod: Pod, applied: int) -> bool:
+    return applied < len(rungs(pod))
+
+
+def relax_pod(pod: Pod, applied: int) -> Pod:
+    """A copy of pod with the first `applied` rungs of its ladder applied."""
+    if applied <= 0:
+        return pod
+    steps = rungs(pod)[:applied]
+    relaxed = copy.copy(pod)
+    relaxed.spec = copy.deepcopy(pod.spec)
+    na = relaxed.spec.node_affinity
+
+    dropped_or = steps.count(RUNG_OR_TERM)
+    if dropped_or and na is not None:
+        na.required = na.required[dropped_or:]
+
+    n = steps.count(RUNG_PREF_POD_AFFINITY)
+    if n:
+        relaxed.spec.preferred_pod_affinity = relaxed.spec.preferred_pod_affinity[n:]
+    n = steps.count(RUNG_PREF_POD_ANTI)
+    if n:
+        relaxed.spec.preferred_pod_anti_affinity = relaxed.spec.preferred_pod_anti_affinity[n:]
+
+    n = steps.count(RUNG_PREF_NODE)
+    if n and na is not None:
+        # heaviest first (preferences.go:67: sort desc by weight)
+        ordered = sorted(na.preferred, key=lambda t: -t.weight)
+        na.preferred = ordered[n:]
+
+    n = steps.count(RUNG_SOFT_TSC)
+    if n:
+        kept, removed = [], 0
+        for t in relaxed.spec.topology_spread_constraints:
+            if t.when_unsatisfiable == "ScheduleAnyway" and removed < n:
+                removed += 1
+                continue
+            kept.append(t)
+        relaxed.spec.topology_spread_constraints = kept
+
+    if RUNG_TOLERATE in steps:
+        relaxed.spec.tolerations = list(relaxed.spec.tolerations) + [
+            Toleration(operator=TOLERATION_OP_EXISTS, effect=PREFER_NO_SCHEDULE)
+        ]
+    return relaxed
+
+
+def run_with_relaxation(pods: list[Pod], solve_round):
+    """The outer relax-and-retry loop shared by both engines: each failing
+    pod sheds one rung per round and the whole problem re-solves.
+
+    solve_round(current_pods) -> SchedulingResult; it must be safe to call
+    repeatedly (fresh state per call).
+    """
+    originals = {p.uid: p for p in pods}
+    applied = {p.uid: 0 for p in pods}
+    current = list(pods)
+    while True:
+        result = solve_round(current)
+        relaxed_any = False
+        for p, _reason in result.unschedulable:
+            orig = originals.get(p.uid)
+            if orig is not None and can_relax(orig, applied[p.uid]):
+                applied[p.uid] += 1
+                relaxed_any = True
+        if not relaxed_any:
+            return result
+        current = [relax_pod(originals[p.uid], applied[p.uid]) for p in pods]

@@ -45,17 +45,44 @@ class Provisioner:
         self.cloud = cloud
         self.clock = clock
         self._scheduler_cache: Optional[tuple[tuple, TPUScheduler]] = None
+        self._buffer_pods: dict[tuple[str, int], list[Pod]] = {}
 
     # -- pod collection (provisioner.go:350-385) -------------------------------
 
     def pending_pods(self) -> list[Pod]:
         """Provisionable pods without a live nomination to an in-flight
-        claim (prevents double-provisioning while nodes come up)."""
-        return [
+        claim (prevents double-provisioning while nodes come up), plus
+        virtual capacity-buffer pods (buffers.go:72-190)."""
+        pods = [
             p
             for p in self.store.pods()
             if p.is_provisionable() and self.cluster.pod_nomination(p.uid) is None
         ]
+        pods.extend(
+            p for p in self._virtual_buffer_pods() if self.cluster.pod_nomination(p.uid) is None
+        )
+        return pods
+
+    def _virtual_buffer_pods(self) -> list[Pod]:
+        """Synthetic headroom pods, cached per (buffer, replicas) so their
+        uids are stable across reconciles (a fresh uid every pass would
+        defeat nomination and double-provision the headroom)."""
+        from karpenter_tpu.controllers.capacity_buffer import virtual_pods
+
+        out: list[Pod] = []
+        buffers = self.store.list(self.store.CAPACITY_BUFFERS)
+        live = {b.name for b in buffers}
+        # drop cache entries for deleted buffers and stale generations
+        self._buffer_pods = {k: v for k, v in self._buffer_pods.items() if k[0] in live}
+        for buffer in buffers:
+            key = (buffer.name, buffer.replicas)
+            if key not in self._buffer_pods:
+                self._buffer_pods = {
+                    k: v for k, v in self._buffer_pods.items() if k[0] != buffer.name
+                }
+                self._buffer_pods[key] = virtual_pods([buffer])
+            out.extend(self._buffer_pods[key])
+        return out
 
     # -- scheduling --------------------------------------------------------------
 
@@ -100,7 +127,7 @@ class Provisioner:
             pods,
             existing,
             self._remaining_budgets(),
-            topology=self._build_topology(pods, scheduler, excluded_node_names),
+            topology_factory=lambda ps: self._build_topology(ps, scheduler, excluded_node_names),
         )
 
     def _existing_sim_nodes(self, excluded: Optional[set[str]] = None) -> list[ExistingSimNode]:
@@ -262,7 +289,7 @@ class Provisioner:
             pods,
             self._existing_sim_nodes(),
             self._remaining_budgets(),
-            topology=self._build_topology(pods, scheduler),
+            topology_factory=lambda ps: self._build_topology(ps, scheduler),
         )
         self.create_node_claims(result)
         # nominate pods placed on existing nodes so the kube-scheduler (sim)

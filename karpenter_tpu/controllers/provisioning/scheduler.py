@@ -172,6 +172,43 @@ class TPUScheduler:
         existing_nodes: Optional[list[ExistingSimNode]] = None,
         budgets: Optional[dict[str, dict[str, float]]] = None,
         topology: Optional[Topology] = None,
+        topology_factory=None,
+    ) -> SchedulingResult:
+        """Solve with the preference relaxation ladder (preferences.go:38):
+        each failing pod sheds ONE preference per round (shared loop in
+        preferences.run_with_relaxation) and the whole problem re-solves.
+
+        Fresh per-round state: existing nodes are cloned, and topology
+        comes from topology_factory(pods) when given, else a pristine
+        deepcopy of `topology` (group matching consults the pod's current
+        spec, so shed constraints stop binding even on a stale topology),
+        else a fresh build from the current pods.
+        """
+        import copy as _copy
+
+        from karpenter_tpu.controllers.provisioning import preferences as prefs
+
+        base_existing = list(existing_nodes or [])
+
+        def solve_round(current: list[Pod]) -> SchedulingResult:
+            if topology_factory is not None:
+                topo = topology_factory(current)
+            elif topology is not None:
+                topo = _copy.deepcopy(topology)
+            else:
+                topo = None
+            return self._solve_once(
+                current, [n.clone() for n in base_existing], budgets, topo
+            )
+
+        return prefs.run_with_relaxation(list(pods), solve_round)
+
+    def _solve_once(
+        self,
+        pods: Sequence[Pod],
+        existing_nodes: Optional[list[ExistingSimNode]] = None,
+        budgets: Optional[dict[str, dict[str, float]]] = None,
+        topology: Optional[Topology] = None,
     ) -> SchedulingResult:
         self.existing_nodes = existing_nodes or []
         self.budgets = {k: dict(v) for k, v in (budgets or {}).items()}

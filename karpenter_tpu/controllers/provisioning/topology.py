@@ -235,11 +235,9 @@ class Topology:
         topo = Topology()
         for pod in pods:
             for tsc in pod.spec.topology_spread_constraints:
-                if tsc.when_unsatisfiable == "ScheduleAnyway":
-                    # soft constraint: enforced only until the preference
-                    # relaxation ladder (preferences.go:38) strips it; until
-                    # that ladder lands, skip rather than hard-block pods
-                    continue
+                # ScheduleAnyway constraints are enforced here like the
+                # reference does; the relaxation ladder strips them from the
+                # pod spec when they prove unsatisfiable (preferences.go:82)
                 g = topo._ensure(
                     TopologyType.SPREAD,
                     tsc.topology_key,
@@ -338,10 +336,30 @@ class Topology:
 
     # -- the per-candidate hook (topology.go:226-250) ------------------------
 
+    @staticmethod
+    def still_declared(g: TopologyGroup, pod: Pod) -> bool:
+        """Whether the pod's CURRENT spec still declares this group — the
+        preference relaxation ladder strips ScheduleAnyway TSCs from the
+        spec, and a shed constraint must stop binding even when the group
+        object (keyed by the pod's uid) predates the relaxation."""
+        if g.type is TopologyType.SPREAD:
+            return any(
+                t.topology_key == g.key
+                and t.label_selector == g.selector
+                and t.max_skew == g.max_skew
+                for t in pod.spec.topology_spread_constraints
+            )
+        terms = (
+            pod.spec.pod_affinity if g.type is TopologyType.AFFINITY else pod.spec.pod_anti_affinity
+        )
+        return any(
+            t.topology_key == g.key and t.label_selector == g.selector for t in terms
+        )
+
     def matching_groups(self, pod: Pod) -> list[TopologyGroup]:
         """Direct groups the pod owns + inverse groups whose anti-affinity
         selector matches the pod (getMatchingTopologies, topology.go:561)."""
-        out = [g for g in self.groups if pod.uid in g.owners]
+        out = [g for g in self.groups if pod.uid in g.owners and self.still_declared(g, pod)]
         out.extend(g for g in self.inverse_groups if g.selects(pod))
         return out
 

@@ -1,0 +1,89 @@
+"""Static-capacity NodePools: replica-count reconcilers.
+
+Counterpart of reference pkg/controllers/static/{provisioning,
+deprovisioning} (provisioning/controller.go:75-124,
+deprovisioning/controller.go:84-270): pools with spec.replicas hold
+exactly that many nodes — scale up creates claims from the pool template,
+scale down removes empty-then-youngest claims first.
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.cloudprovider.spi import CloudProvider
+from karpenter_tpu.controllers.provisioning.nodeclaimtemplate import build_template
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodeclaim import NodeClaim, NodeClaimSpec
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.objects import ObjectMeta, new_uid
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.store import ObjectStore
+from karpenter_tpu.utils.clock import Clock
+
+
+class StaticCapacityController:
+    def __init__(self, store: ObjectStore, cluster: Cluster, cloud: CloudProvider, clock: Clock):
+        self.store = store
+        self.cluster = cluster
+        self.cloud = cloud
+        self.clock = clock
+
+    def reconcile(self) -> int:
+        """Returns net claims created (negative = removed)."""
+        delta = 0
+        for pool in self.store.nodepools():
+            if not pool.is_static:
+                continue
+            claims = [
+                c
+                for c in self.store.nodeclaims()
+                if c.nodepool_name == pool.name and not c.metadata.deleting
+            ]
+            want = pool.spec.replicas or 0
+            if len(claims) < want:
+                delta += self._scale_up(pool, want - len(claims))
+            elif len(claims) > want:
+                delta -= self._scale_down(claims, len(claims) - want)
+        return delta
+
+    def _scale_up(self, pool: NodePool, count: int) -> int:
+        template = build_template(pool, self.cloud.get_instance_types(pool))
+        created = 0
+        for _ in range(count):
+            requirements = []
+            for r in template.requirements.values():
+                entry = {"key": r.key, "operator": r.operator().value}
+                if r.values:
+                    entry["values"] = sorted(r.values)
+                requirements.append(entry)
+            claim = NodeClaim(
+                metadata=ObjectMeta(
+                    name=f"{pool.name}-{new_uid('static')}",
+                    labels={**template.labels, l.NODEPOOL_LABEL_KEY: pool.name},
+                    annotations={l.NODEPOOL_HASH_ANNOTATION_KEY: template.nodepool_hash},
+                ),
+                spec=NodeClaimSpec(
+                    taints=list(template.taints),
+                    startup_taints=list(template.startup_taints),
+                    requirements=requirements,
+                    expire_after_seconds=template.expire_after_seconds,
+                ),
+            )
+            self.store.create(ObjectStore.NODECLAIMS, claim)
+            self.cluster.update_nodeclaim(claim)
+            created += 1
+        return created
+
+    def _scale_down(self, claims: list[NodeClaim], count: int) -> int:
+        """Empty nodes first, then youngest (deprovisioning
+        controller.go:84-270)."""
+
+        def sort_key(claim: NodeClaim):
+            sn = self.cluster.node_by_provider_id(claim.status.provider_id or "")
+            pods = len(sn.pods) if sn is not None else 0
+            return (pods, -claim.metadata.creation_timestamp)
+
+        removed = 0
+        for claim in sorted(claims, key=sort_key)[:count]:
+            self.store.delete(ObjectStore.NODECLAIMS, claim.name)
+            removed += 1
+        return removed

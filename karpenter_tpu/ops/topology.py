@@ -166,7 +166,7 @@ def encode_pod_topology(topology, vg, hg, pods, strict_tensors: ReqSetTensors) -
     for i, pod in enumerate(pods):
         for j, g in enumerate(vg):
             sel = g.selects(pod)
-            own = pod.uid in g.owners
+            own = pod.uid in g.owners and topology.still_declared(g, pod)
             if id(g) in inverse:
                 vga[i, j] = sel
                 vgr[i, j] = own
@@ -176,7 +176,7 @@ def encode_pod_topology(topology, vg, hg, pods, strict_tensors: ReqSetTensors) -
             vgs[i, j] = sel
         for j, g in enumerate(hg):
             sel = g.selects(pod)
-            own = pod.uid in g.owners
+            own = pod.uid in g.owners and topology.still_declared(g, pod)
             if id(g) in inverse:
                 hga[i, j] = sel
                 hgr[i, j] = own

@@ -131,6 +131,7 @@ class ObjectStore:
     NODES = "nodes"
     NODECLAIMS = "nodeclaims"
     NODEPOOLS = "nodepools"
+    CAPACITY_BUFFERS = "capacitybuffers"
 
     def pods(self) -> list:
         return self.list(self.PODS)

@@ -1,0 +1,79 @@
+"""Deduplicated event recorder.
+
+Counterpart of reference pkg/events/recorder.go:47-110: domain events are
+deduplicated within a TTL window and rate-limited per dedupe key so event
+storms (e.g. a pod failing to schedule every batch) don't flood the API.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+from karpenter_tpu.utils.clock import Clock
+
+DEDUPE_TTL_SECONDS = 120.0  # recorder.go:56
+MAX_EVENTS = 10_000
+
+
+@dataclass
+class Event:
+    kind: str  # involved object kind
+    name: str  # involved object name
+    type: str  # Normal | Warning
+    reason: str
+    message: str
+    timestamp: float = 0.0
+    count: int = 1
+
+    @property
+    def dedupe_key(self) -> str:
+        return f"{self.kind}/{self.name}/{self.reason}/{self.message}"
+
+
+class Recorder:
+    def __init__(self, clock: Optional[Clock] = None):
+        self.clock = clock or Clock()
+        self.events: deque[Event] = deque(maxlen=MAX_EVENTS)
+        self._last_seen: dict[str, tuple[float, Event]] = {}
+
+    def publish(self, event: Event) -> bool:
+        """Record unless an identical event fired within the TTL; returns
+        whether the event was actually recorded (vs deduped)."""
+        now = self.clock.now()
+        event.timestamp = now
+        seen = self._last_seen.get(event.dedupe_key)
+        if seen is not None and now - seen[0] < DEDUPE_TTL_SECONDS:
+            seen[1].count += 1
+            self._last_seen[event.dedupe_key] = (seen[0], seen[1])
+            return False
+        # prune expired dedupe entries so memory stays bounded by the TTL
+        # window, not by the lifetime count of distinct events
+        if len(self._last_seen) > 4096:
+            self._last_seen = {
+                k: v for k, v in self._last_seen.items() if now - v[0] < DEDUPE_TTL_SECONDS
+            }
+        self._last_seen[event.dedupe_key] = (now, event)
+        self.events.append(event)
+        return True
+
+    def for_object(self, kind: str, name: str) -> list[Event]:
+        return [e for e in self.events if e.kind == kind and e.name == name]
+
+
+# domain event constructors (disruption/events, scheduling/events.go analogs)
+def nominate(pod_name: str, target: str) -> Event:
+    return Event("Pod", pod_name, "Normal", "Nominated", f"Pod should schedule on {target}")
+
+
+def failed_scheduling(pod_name: str, reason: str) -> Event:
+    return Event("Pod", pod_name, "Warning", "FailedScheduling", reason)
+
+
+def disrupting_node(node_name: str, reason: str) -> Event:
+    return Event("Node", node_name, "Normal", "Disrupting", reason)
+
+
+def unconsolidatable(node_name: str, reason: str) -> Event:
+    return Event("Node", node_name, "Normal", "Unconsolidatable", reason)

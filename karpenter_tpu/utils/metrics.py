@@ -1,0 +1,186 @@
+"""Prometheus-style metrics registry.
+
+Counterpart of reference pkg/metrics/metrics.go:32-115 and the scheduler /
+disruption metric families. In-process counters/gauges/histograms with
+label sets and a text exposition dump; the solver additionally reports
+device-side timings captured host-side (the Measure defer-observer
+pattern, pkg/metrics/constants.go:65).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Iterable, Optional
+
+
+class _Family:
+    def __init__(self, name: str, help_text: str, label_names: tuple[str, ...]):
+        self.name = name
+        self.help = help_text
+        self.label_names = label_names
+
+    def _key(self, labels: dict[str, str]) -> tuple:
+        return tuple(labels.get(n, "") for n in self.label_names)
+
+
+class Counter(_Family):
+    def __init__(self, name, help_text, label_names=()):
+        super().__init__(name, help_text, tuple(label_names))
+        self.values: dict[tuple, float] = defaultdict(float)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        self.values[self._key(labels)] += amount
+
+    def get(self, **labels) -> float:
+        return self.values.get(self._key(labels), 0.0)
+
+
+class Gauge(_Family):
+    def __init__(self, name, help_text, label_names=()):
+        super().__init__(name, help_text, tuple(label_names))
+        self.values: dict[tuple, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        self.values[self._key(labels)] = value
+
+    def get(self, **labels) -> float:
+        return self.values.get(self._key(labels), 0.0)
+
+    def delete(self, **labels) -> None:
+        self.values.pop(self._key(labels), None)
+
+
+DEFAULT_BUCKETS = tuple(0.001 * (2.0**i) for i in range(20))  # 1ms .. ~524s
+
+
+class Histogram(_Family):
+    def __init__(self, name, help_text, label_names=(), buckets: Iterable[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help_text, tuple(label_names))
+        self.buckets = tuple(sorted(buckets))
+        self.counts: dict[tuple, list[int]] = {}
+        self.sums: dict[tuple, float] = defaultdict(float)
+        self.totals: dict[tuple, int] = defaultdict(int)
+
+    def observe(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        if key not in self.counts:
+            self.counts[key] = [0] * (len(self.buckets) + 1)
+        idx = len(self.buckets)
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                idx = i
+                break
+        self.counts[key][idx] += 1
+        self.sums[key] += value
+        self.totals[key] += 1
+
+    @contextmanager
+    def time(self, **labels):
+        """The Measure defer-observer (constants.go:65)."""
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(time.perf_counter() - start, **labels)
+
+    def percentile(self, q: float, **labels) -> float:
+        key = self._key(labels)
+        total = self.totals.get(key, 0)
+        if not total:
+            return math.nan
+        target = q * total
+        seen = 0
+        for i, count in enumerate(self.counts[key]):
+            seen += count
+            if seen >= target:
+                return self.buckets[i] if i < len(self.buckets) else math.inf
+        return math.inf
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._families: dict[str, _Family] = {}
+
+    def counter(self, name, help_text="", label_names=()) -> Counter:
+        return self._get_or_create(Counter, name, help_text, label_names)
+
+    def gauge(self, name, help_text="", label_names=()) -> Gauge:
+        return self._get_or_create(Gauge, name, help_text, label_names)
+
+    def histogram(self, name, help_text="", label_names=()) -> Histogram:
+        return self._get_or_create(Histogram, name, help_text, label_names)
+
+    def _get_or_create(self, cls, name, help_text, label_names):
+        fam = self._families.get(name)
+        if fam is None:
+            fam = cls(name, help_text, label_names)
+            self._families[name] = fam
+        if not isinstance(fam, cls):
+            raise TypeError(f"metric {name} already registered as {type(fam).__name__}")
+        return fam
+
+    def expose(self) -> str:
+        """Prometheus text exposition (scrape endpoint analog)."""
+        lines = []
+        for fam in self._families.values():
+            lines.append(f"# HELP {fam.name} {fam.help}")
+            kind = {Counter: "counter", Gauge: "gauge", Histogram: "histogram"}[type(fam)]
+            lines.append(f"# TYPE {fam.name} {kind}")
+            if isinstance(fam, (Counter, Gauge)):
+                for key, value in fam.values.items():
+                    labels = ",".join(
+                        f'{n}="{v}"' for n, v in zip(fam.label_names, key) if v
+                    )
+                    suffix = f"{{{labels}}}" if labels else ""
+                    lines.append(f"{fam.name}{suffix} {value}")
+            else:
+                for key, total in fam.totals.items():
+                    labels = ",".join(
+                        f'{n}="{v}"' for n, v in zip(fam.label_names, key) if v
+                    )
+                    base = f"{{{labels}}}" if labels else ""
+                    lines.append(f"{fam.name}_count{base} {total}")
+                    lines.append(f"{fam.name}_sum{base} {fam.sums[key]}")
+        return "\n".join(lines) + "\n"
+
+
+# The global registry + core metric families (pkg/metrics/metrics.go:32-115)
+REGISTRY = Registry()
+
+NODECLAIMS_CREATED = REGISTRY.counter(
+    "karpenter_nodeclaims_created_total", "NodeClaims created", ("reason", "nodepool")
+)
+NODECLAIMS_TERMINATED = REGISTRY.counter(
+    "karpenter_nodeclaims_terminated_total", "NodeClaims terminated", ("reason", "nodepool")
+)
+NODECLAIMS_DISRUPTED = REGISTRY.counter(
+    "karpenter_nodeclaims_disrupted_total", "NodeClaims disrupted", ("reason", "nodepool")
+)
+NODES_CREATED = REGISTRY.counter("karpenter_nodes_created_total", "Nodes created", ("nodepool",))
+NODES_TERMINATED = REGISTRY.counter(
+    "karpenter_nodes_terminated_total", "Nodes terminated", ("nodepool",)
+)
+PODS_DISRUPTION_INITIATED = REGISTRY.counter(
+    "karpenter_pods_disruption_initiated_total", "Pod evictions initiated", ("nodepool",)
+)
+SCHEDULING_DURATION = REGISTRY.histogram(
+    "karpenter_scheduler_scheduling_duration_seconds", "Solve wall time"
+)
+SCHEDULING_UNSCHEDULABLE = REGISTRY.gauge(
+    "karpenter_scheduler_unschedulable_pods_count", "Pods the last solve could not place"
+)
+DISRUPTION_EVAL_DURATION = REGISTRY.histogram(
+    "karpenter_disruption_evaluation_duration_seconds", "Disruption pass wall time", ("method",)
+)
+DISRUPTION_ELIGIBLE_NODES = REGISTRY.gauge(
+    "karpenter_disruption_eligible_nodes", "Disruptable candidates", ("method",)
+)
+NODEPOOL_USAGE = REGISTRY.gauge(
+    "karpenter_nodepool_usage", "Per-pool resource usage", ("nodepool", "resource_type")
+)
+NODEPOOL_LIMIT = REGISTRY.gauge(
+    "karpenter_nodepool_limit", "Per-pool resource limits", ("nodepool", "resource_type")
+)

@@ -1,0 +1,71 @@
+"""Operator options and feature gates.
+
+Counterpart of reference pkg/operator/options/options.go:68-216: flag+env
+configuration with feature-gate CSV parsing. Values mirror the reference
+defaults (options.go:112-140).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass
+class FeatureGates:
+    # defaults per options.go:134
+    node_repair: bool = False
+    reserved_capacity: bool = True
+    spot_to_spot_consolidation: bool = False
+    node_overlay: bool = False
+    static_capacity: bool = True
+    capacity_buffer: bool = False
+
+    @staticmethod
+    def parse(csv: str) -> "FeatureGates":
+        """'NodeRepair=true,SpotToSpotConsolidation=false' -> gates."""
+        gates = FeatureGates()
+        mapping = {
+            "NodeRepair": "node_repair",
+            "ReservedCapacity": "reserved_capacity",
+            "SpotToSpotConsolidation": "spot_to_spot_consolidation",
+            "NodeOverlay": "node_overlay",
+            "StaticCapacity": "static_capacity",
+            "CapacityBuffer": "capacity_buffer",
+        }
+        for part in csv.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, _, value = part.partition("=")
+            attr = mapping.get(key.strip())
+            if attr is not None:
+                setattr(gates, attr, value.strip().lower() in ("true", "1", "yes"))
+        return gates
+
+
+@dataclass
+class Options:
+    batch_idle_seconds: float = 1.0  # options.go:129
+    batch_max_seconds: float = 10.0  # options.go:130
+    solve_timeout_seconds: float = 60.0  # provisioner.go:415
+    disruption_poll_seconds: float = 10.0  # disruption/controller.go:71
+    preference_policy: str = "Respect"  # Respect | Ignore (options.go:33-45)
+    min_values_policy: str = "Strict"  # Strict | BestEffort
+    feature_gates: FeatureGates = field(default_factory=FeatureGates)
+
+    @staticmethod
+    def from_env(prefix: str = "KARPENTER_") -> "Options":
+        opts = Options()
+        env = os.environ
+        if prefix + "BATCH_IDLE_DURATION" in env:
+            opts.batch_idle_seconds = float(env[prefix + "BATCH_IDLE_DURATION"])
+        if prefix + "BATCH_MAX_DURATION" in env:
+            opts.batch_max_seconds = float(env[prefix + "BATCH_MAX_DURATION"])
+        if prefix + "PREFERENCE_POLICY" in env:
+            opts.preference_policy = env[prefix + "PREFERENCE_POLICY"]
+        if prefix + "MIN_VALUES_POLICY" in env:
+            opts.min_values_policy = env[prefix + "MIN_VALUES_POLICY"]
+        if prefix + "FEATURE_GATES" in env:
+            opts.feature_gates = FeatureGates.parse(env[prefix + "FEATURE_GATES"])
+        return opts

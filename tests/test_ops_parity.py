@@ -1,0 +1,165 @@
+"""Ops-parity subsystems: metrics, events, options, static pools, buffers."""
+
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.controllers.capacity_buffer import CapacityBuffer, is_buffer_pod
+from karpenter_tpu.controllers.manager import KubeSchedulerSim, Manager
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import PodSpec, make_pod
+from karpenter_tpu.state.store import ObjectStore
+from karpenter_tpu.utils import resources as res
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.events import Event, Recorder, failed_scheduling
+from karpenter_tpu.utils.metrics import Registry
+from karpenter_tpu.utils.options import FeatureGates, Options
+
+
+def build_env(catalog_size=50):
+    clock = FakeClock()
+    store = ObjectStore(clock)
+    cloud = KwokCloudProvider(store, catalog=instance_types(catalog_size))
+    mgr = Manager(store, cloud, clock)
+    return clock, store, cloud, mgr
+
+
+class TestMetrics:
+    def test_counter_gauge_histogram(self):
+        reg = Registry()
+        c = reg.counter("test_total", "a counter", ("pool",))
+        c.inc(pool="a")
+        c.inc(2.0, pool="a")
+        assert c.get(pool="a") == 3.0
+        g = reg.gauge("test_gauge", "a gauge")
+        g.set(5.0)
+        assert g.get() == 5.0
+        h = reg.histogram("test_seconds", "a histogram")
+        h.observe(0.05)
+        h.observe(0.2)
+        assert h.totals[()] == 2
+        text = reg.expose()
+        assert 'test_total{pool="a"} 3.0' in text
+        assert "# TYPE test_seconds histogram" in text
+
+    def test_histogram_timer(self):
+        reg = Registry()
+        h = reg.histogram("t_seconds", "")
+        with h.time():
+            pass
+        assert h.totals[()] == 1
+
+
+class TestEvents:
+    def test_dedupe_within_ttl(self):
+        clock = FakeClock()
+        rec = Recorder(clock)
+        assert rec.publish(failed_scheduling("p1", "no capacity"))
+        assert not rec.publish(failed_scheduling("p1", "no capacity"))  # deduped
+        assert len(rec.events) == 1
+        assert rec.events[0].count == 2
+        clock.step(121.0)
+        assert rec.publish(failed_scheduling("p1", "no capacity"))  # TTL expired
+
+    def test_distinct_not_deduped(self):
+        rec = Recorder(FakeClock())
+        assert rec.publish(failed_scheduling("p1", "a"))
+        assert rec.publish(failed_scheduling("p2", "a"))
+        assert len(rec.for_object("Pod", "p1")) == 1
+
+
+class TestOptions:
+    def test_feature_gate_parsing(self):
+        gates = FeatureGates.parse("SpotToSpotConsolidation=true,NodeRepair=true")
+        assert gates.spot_to_spot_consolidation and gates.node_repair
+        assert gates.reserved_capacity  # default preserved
+
+    def test_defaults_match_reference(self):
+        opts = Options()
+        assert opts.batch_idle_seconds == 1.0
+        assert opts.batch_max_seconds == 10.0
+        assert not opts.feature_gates.spot_to_spot_consolidation
+
+
+class TestStaticCapacity:
+    def test_scale_up_to_replicas(self):
+        clock, store, cloud, mgr = build_env()
+        pool = NodePool()
+        pool.metadata.name = "static"
+        pool.spec.replicas = 3
+        store.create(ObjectStore.NODEPOOLS, pool)
+        out = mgr.run_maintenance()
+        assert out["static_delta"] == 3
+        assert len(store.nodeclaims()) == 3
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        assert len(store.nodes()) == 3
+        # steady state: no churn
+        assert mgr.run_maintenance()["static_delta"] == 0
+
+    def test_scale_down(self):
+        clock, store, cloud, mgr = build_env()
+        pool = NodePool()
+        pool.metadata.name = "static"
+        pool.spec.replicas = 3
+        store.create(ObjectStore.NODEPOOLS, pool)
+        mgr.run_maintenance()
+        pool.spec.replicas = 1
+        store.update(ObjectStore.NODEPOOLS, pool)
+        out = mgr.run_maintenance()
+        assert out["static_delta"] == -2
+        assert len([c for c in store.nodeclaims() if not c.metadata.deleting]) == 1
+
+    def test_static_pools_not_used_for_dynamic_provisioning(self):
+        clock, store, cloud, mgr = build_env()
+        pool = NodePool()
+        pool.metadata.name = "static"
+        pool.spec.replicas = 1
+        store.create(ObjectStore.NODEPOOLS, pool)
+        store.create(ObjectStore.PODS, make_pod("p", cpu=0.5))
+        mgr.run_until_idle()
+        # no dynamic pool exists -> pod cannot be provisioned
+        assert all(
+            c.nodepool_name == "static" for c in store.nodeclaims()
+        )
+
+
+class TestCapacityBuffers:
+    def test_buffer_provisions_headroom(self):
+        clock, store, cloud, mgr = build_env()
+        pool = NodePool()
+        pool.metadata.name = "default"
+        store.create(ObjectStore.NODEPOOLS, pool)
+        buffer = CapacityBuffer(replicas=3)
+        buffer.metadata.name = "warm"
+        buffer.pod_template = PodSpec(
+            requests={res.CPU: 1.0, res.MEMORY: float(2**30)}
+        )
+        store.create(ObjectStore.CAPACITY_BUFFERS, buffer)
+        mgr.batcher.trigger()
+        clock.step(2.0)
+        mgr.run_until_idle()
+        claims = store.nodeclaims()
+        assert claims, "buffer produced no headroom claims"
+        total_cpu = sum(c.spec.requests.get("cpu", 0) for c in claims)
+        assert total_cpu >= 3.0
+        # virtual pods never appear in the store
+        assert all(not is_buffer_pod(p) for p in store.pods())
+
+    def test_buffer_headroom_not_double_provisioned(self):
+        clock, store, cloud, mgr = build_env()
+        pool = NodePool()
+        pool.metadata.name = "default"
+        store.create(ObjectStore.NODEPOOLS, pool)
+        buffer = CapacityBuffer(replicas=2)
+        buffer.metadata.name = "warm"
+        buffer.pod_template = PodSpec(requests={res.CPU: 1.0})
+        store.create(ObjectStore.CAPACITY_BUFFERS, buffer)
+        mgr.batcher.trigger()
+        clock.step(2.0)
+        mgr.run_until_idle()
+        n_claims = len(store.nodeclaims())
+        # another pass must not re-provision the same headroom
+        mgr.batcher.trigger()
+        clock.step(2.0)
+        mgr.run_until_idle()
+        assert len(store.nodeclaims()) == n_claims

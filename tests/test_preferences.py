@@ -1,0 +1,247 @@
+"""Preference relaxation ladder (preferences.go parity)."""
+
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.controllers.provisioning import TPUScheduler, build_templates
+from karpenter_tpu.controllers.provisioning.preferences import (
+    RUNG_TOLERATE,
+    can_relax,
+    relax_pod,
+    rungs,
+)
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import (
+    NodeAffinity,
+    NodeSelectorTerm,
+    PreferredSchedulingTerm,
+    TopologySpreadConstraint,
+    make_pod,
+)
+from karpenter_tpu.models.taints import NO_SCHEDULE, PREFER_NO_SCHEDULE, Taint
+
+
+def default_pool(name="default", taints=()):
+    pool = NodePool()
+    pool.metadata.name = name
+    pool.spec.template.spec.taints = list(taints)
+    return pool
+
+
+class TestRelaxPod:
+    def test_preferred_affinity_dropped_first(self):
+        pod = make_pod("p")
+        pod.spec.node_affinity = NodeAffinity(
+            preferred=[PreferredSchedulingTerm(1, [{"key": "x", "operator": "In", "values": ["a"]}])]
+        )
+        assert can_relax(pod, 0)
+        relaxed = relax_pod(pod, 1)
+        assert relaxed.spec.node_affinity.preferred == []
+        assert pod.spec.node_affinity.preferred  # original untouched
+        assert relaxed.uid == pod.uid
+
+    def test_required_or_terms_advance_one_per_rung(self):
+        pod = make_pod("p")
+        pod.spec.node_affinity = NodeAffinity(
+            required=[
+                NodeSelectorTerm([{"key": "zone", "operator": "In", "values": ["nowhere-1"]}]),
+                NodeSelectorTerm([{"key": "zone", "operator": "In", "values": ["nowhere-2"]}]),
+                NodeSelectorTerm([{"key": "zone", "operator": "In", "values": ["test-zone-1"]}]),
+            ]
+        )
+        # ladder: two or-term rungs then the toleration rung
+        assert rungs(pod)[:2] == ["required-or-term", "required-or-term"]
+        one = relax_pod(pod, 1)
+        assert one.spec.node_affinity.required[0].match_expressions[0]["values"] == ["nowhere-2"]
+        two = relax_pod(pod, 2)
+        assert two.spec.node_affinity.required[0].match_expressions[0]["values"] == [
+            "test-zone-1"
+        ]
+
+    def test_schedule_anyway_tsc_dropped(self):
+        pod = make_pod("p")
+        pod.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(
+                topology_key=l.LABEL_TOPOLOGY_ZONE,
+                when_unsatisfiable="ScheduleAnyway",
+                label_selector={"a": "b"},
+            )
+        ]
+        assert rungs(pod) == ["schedule-anyway-tsc", RUNG_TOLERATE]
+        assert relax_pod(pod, 1).spec.topology_spread_constraints == []
+
+    def test_prefer_no_schedule_toleration_last(self):
+        pod = make_pod("p")
+        assert rungs(pod) == [RUNG_TOLERATE]
+        relaxed = relax_pod(pod, 1)
+        assert any(t.effect == PREFER_NO_SCHEDULE for t in relaxed.spec.tolerations)
+        assert not can_relax(pod, 1)
+
+
+class TestLadderEndToEnd:
+    def test_unsatisfiable_preferred_affinity_still_schedules(self):
+        templates = build_templates([(default_pool(), instance_types(16))])
+        pod = make_pod("p", cpu=0.5)
+        pod.spec.node_affinity = NodeAffinity(
+            preferred=[
+                PreferredSchedulingTerm(
+                    10, [{"key": l.LABEL_TOPOLOGY_ZONE, "operator": "In", "values": ["zone-nowhere"]}]
+                )
+            ]
+        )
+        result = TPUScheduler(templates).solve([pod])
+        assert not result.unschedulable
+        # the preference was shed: the claim is launchable on a real offering
+        it, price = result.claims[0].cheapest_launch()
+        assert it is not None and price < float("inf")
+
+    def test_or_terms_fall_through(self):
+        templates = build_templates([(default_pool(), instance_types(16))])
+        pod = make_pod("p", cpu=0.5)
+        pod.spec.node_affinity = NodeAffinity(
+            required=[
+                NodeSelectorTerm(
+                    [{"key": l.LABEL_TOPOLOGY_ZONE, "operator": "In", "values": ["zone-nowhere"]}]
+                ),
+                NodeSelectorTerm(
+                    [{"key": l.LABEL_TOPOLOGY_ZONE, "operator": "In", "values": ["test-zone-2"]}]
+                ),
+            ]
+        )
+        result = TPUScheduler(templates).solve([pod])
+        assert not result.unschedulable
+        assert sorted(result.claims[0].requirements.get(l.LABEL_TOPOLOGY_ZONE).values) == [
+            "test-zone-2"
+        ]
+
+    def test_three_or_terms_fall_through(self):
+        """One term is shed per round, so the THIRD OR term is reachable."""
+        templates = build_templates([(default_pool(), instance_types(16))])
+        pod = make_pod("p", cpu=0.5)
+        pod.spec.node_affinity = NodeAffinity(
+            required=[
+                NodeSelectorTerm(
+                    [{"key": l.LABEL_TOPOLOGY_ZONE, "operator": "In", "values": ["zone-nowhere-1"]}]
+                ),
+                NodeSelectorTerm(
+                    [{"key": l.LABEL_TOPOLOGY_ZONE, "operator": "In", "values": ["zone-nowhere-2"]}]
+                ),
+                NodeSelectorTerm(
+                    [{"key": l.LABEL_TOPOLOGY_ZONE, "operator": "In", "values": ["test-zone-2"]}]
+                ),
+            ]
+        )
+        result = TPUScheduler(templates).solve([pod])
+        assert not result.unschedulable
+        assert sorted(result.claims[0].requirements.get(l.LABEL_TOPOLOGY_ZONE).values) == [
+            "test-zone-2"
+        ]
+
+    def test_schedule_anyway_spreads_when_possible(self):
+        """Soft TSCs spread while capacity allows."""
+        templates = build_templates([(default_pool(), instance_types(32))])
+        pods = []
+        for i in range(8):
+            p = make_pod(f"p-{i}", cpu=0.5)
+            p.metadata.labels = {"app": "soft"}
+            p.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=l.LABEL_TOPOLOGY_ZONE,
+                    when_unsatisfiable="ScheduleAnyway",
+                    label_selector={"app": "soft"},
+                )
+            ]
+            pods.append(p)
+        result = TPUScheduler(templates).solve(pods)
+        assert not result.unschedulable
+        zones = {}
+        for c in result.claims:
+            z = sorted(c.requirements.get(l.LABEL_TOPOLOGY_ZONE).values)[0]
+            zones[z] = zones.get(z, 0) + len(c.pods)
+        assert max(zones.values()) - min(zones.values()) <= 1
+
+    def test_schedule_anyway_violated_when_necessary(self):
+        """A one-zone pool can't spread; soft TSC pods must still schedule."""
+        pool = default_pool()
+        pool.spec.template.spec.requirements = [
+            {"key": l.LABEL_TOPOLOGY_ZONE, "operator": "In", "values": ["test-zone-1"]}
+        ]
+        templates = build_templates([(pool, instance_types(32))])
+        pods = []
+        for i in range(4):
+            p = make_pod(f"p-{i}", cpu=0.5)
+            p.metadata.labels = {"app": "soft"}
+            p.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=l.LABEL_TOPOLOGY_ZONE,
+                    when_unsatisfiable="ScheduleAnyway",
+                    label_selector={"app": "soft"},
+                )
+            ]
+            pods.append(p)
+        result = TPUScheduler(templates).solve(pods)
+        assert not result.unschedulable
+
+    def test_prefer_no_schedule_tolerated_as_last_resort(self):
+        taint = Taint(key="soft-keep-off", effect=PREFER_NO_SCHEDULE)
+        templates = build_templates([(default_pool(taints=[taint]), instance_types(16))])
+        pod = make_pod("p", cpu=0.5)
+        result = TPUScheduler(templates).solve([pod])
+        assert not result.unschedulable
+
+    def test_host_and_device_agree_on_soft_tsc_rescue(self):
+        """Both engines run the ladder: a soft-TSC pod that cannot spread
+        (counts seeded in an unreachable zone) still schedules on BOTH."""
+        from karpenter_tpu.controllers.provisioning import HostScheduler
+        from karpenter_tpu.controllers.provisioning.topology import (
+            Topology,
+            build_universe_domains,
+        )
+
+        pool = default_pool()
+        pool.spec.template.spec.requirements = [
+            {"key": l.LABEL_TOPOLOGY_ZONE, "operator": "In", "values": ["test-zone-1"]}
+        ]
+        templates = build_templates([(pool, instance_types(32))])
+
+        def mk_pod():
+            p = make_pod("p", cpu=0.5)
+            p.metadata.labels = {"app": "soft"}
+            p.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=l.LABEL_TOPOLOGY_ZONE,
+                    when_unsatisfiable="ScheduleAnyway",
+                    label_selector={"app": "soft"},
+                )
+            ]
+            return p
+
+        # seed counts: two app=soft pods already bound in a zone this pool
+        # cannot reach, making the spread unsatisfiable
+        universe = dict(build_universe_domains(templates))
+        universe[l.LABEL_TOPOLOGY_ZONE] = {"test-zone-1", "test-zone-2"}
+        bound = []
+        for i in range(2):
+            bp = make_pod(f"bound-{i}")
+            bp.metadata.labels = {"app": "soft"}
+            bp.spec.topology_spread_constraints = mk_pod().spec.topology_spread_constraints
+            bound.append((bp, {l.LABEL_TOPOLOGY_ZONE: "test-zone-2"}))
+
+        pod_h = mk_pod()
+        topo_h = Topology.build([pod_h] + [b for b, _ in bound], universe, bound)
+        host = HostScheduler(templates, topology=topo_h).solve([pod_h])
+        assert not host.unschedulable, "host ladder failed to rescue soft TSC"
+
+        pod_t = mk_pod()
+        topo_t = Topology.build([pod_t] + [b for b, _ in bound], universe, bound)
+        tpu = TPUScheduler(templates).solve([pod_t], topology=topo_t)
+        assert not tpu.unschedulable, "device ladder failed to rescue soft TSC"
+
+    def test_hard_constraints_never_relaxed(self):
+        taint = Taint(key="dedicated", value="x", effect=NO_SCHEDULE)
+        templates = build_templates([(default_pool(taints=[taint]), instance_types(16))])
+        pod = make_pod("p", cpu=0.5)
+        result = TPUScheduler(templates).solve([pod])
+        assert len(result.unschedulable) == 1
