@@ -95,6 +95,12 @@ class SchedulingResult:
         return sum(c.cheapest_launch()[1] for c in self.claims)
 
 
+def hostname_placeholder(seq: int) -> str:
+    """Simulation-only hostname for new claims (nodeclaim.go:93); shared by
+    both engines so hostname-domain bookkeeping lines up."""
+    return f"hostname-placeholder-{seq:04d}"
+
+
 def ffd_sort(pods: list[Pod]) -> list[Pod]:
     """CPU+memory descending (queue.go:72-90); stable on ties."""
     return sorted(
@@ -114,7 +120,7 @@ def filter_instance_types(
     compatible available offering."""
     remaining = []
     for it in its:
-        if it.requirements.intersects(requirements) is not None:
+        if not it.requirements.intersects_ok(requirements):
             continue
         if _fits_and_offering(it.allocatable_offerings(), requirements, total_requests):
             remaining.append(it)
@@ -157,7 +163,7 @@ class HostScheduler:
 
     def _next_hostname(self) -> str:
         self._hostname_seq += 1
-        return f"hostname-placeholder-{self._hostname_seq:04d}"
+        return hostname_placeholder(self._hostname_seq)
 
     # -- tier 1: existing nodes (existingnode.go:84-135) ---------------------
 

@@ -27,7 +27,7 @@ from karpenter_tpu.controllers.provisioning.host_scheduler import (
     SchedulingResult,
     SimClaim,
     ffd_sort,
-    filter_instance_types,
+    hostname_placeholder,
 )
 from karpenter_tpu.controllers.provisioning.nodeclaimtemplate import ClaimTemplate
 from karpenter_tpu.controllers.provisioning.topology import Topology, build_universe_domains
@@ -329,11 +329,16 @@ class TPUScheduler:
         """
         assignment = np.asarray(result.assignment)[: len(pods_sorted)]
         claim_template = np.asarray(result.claims.template)
-        # budget replay mirrors the host oracle's filter/charge bookkeeping
-        from karpenter_tpu.controllers.provisioning.host_scheduler import HostScheduler
-
-        hs = HostScheduler(self.templates, budgets=self.budgets)
+        # The device already computed each claim's viable-type set
+        # (compat × fits × offering × budget); read it instead of paying an
+        # O(claims × types) host recomputation. This is exact, not
+        # approximate: resource quantities are float32-quantized at every
+        # model boundary and accumulated in the same order on both sides
+        # (utils/resources.py), so device fits == host fits bit-for-bit —
+        # the differential suite compares the sets directly.
+        its_mask = np.asarray(result.claims.its)
         topo = self.topology
+        hostname_seq = 0
 
         claims: list[SimClaim] = []
         slot_to_claim: dict[int, SimClaim] = {}
@@ -373,14 +378,15 @@ class TPUScheduler:
             newly_created = claim is None
             if newly_created:
                 tmpl = self.templates[int(claim_template[slot])]
-                hostname = hs._next_hostname()
+                hostname_seq += 1
+                hostname = hostname_placeholder(hostname_seq)
                 requirements = tmpl.requirements.copy()
                 requirements.add(Requirement.new(l.LABEL_HOSTNAME, Operator.IN, hostname))
                 claim = SimClaim(
                     template=tmpl,
                     requirements=requirements,
                     used=dict(tmpl.daemon_requests),
-                    instance_types=hs._within_budget(tmpl, tmpl.instance_types),
+                    instance_types=[],  # filled from the device mask below
                     pods=[],
                     slot=slot,
                     hostname=hostname,
@@ -400,18 +406,17 @@ class TPUScheduler:
             claim.used = res.merge(claim.used, pod.total_requests())
             claim.pods.append(pod)
             topo.record(pod, tightened)
-            if newly_created:
-                # charge the pool budget with the first-pod viable set
-                # (subtractMax happens at claim creation, scheduler.go:791)
-                hs._charge_budget(
-                    claim.template,
-                    filter_instance_types(claim.instance_types, claim.requirements, claim.used),
-                )
-        # narrow viable instance types once per claim (host replay)
+        # viable instance types come straight from the device solver state
+        # (the device carried budget bookkeeping too, so no host replay of
+        # subtractMax is needed); keep them in the TEMPLATE's catalog order
+        # so cheapest_launch tie-breaks identically to the host oracle
         for claim in claims:
-            claim.instance_types = filter_instance_types(
-                claim.instance_types, claim.requirements, claim.used
-            )
+            viable = {
+                self.catalog[t].name for t in np.nonzero(its_mask[claim.slot])[0]
+            }
+            claim.instance_types = [
+                it for it in claim.template.instance_types if it.name in viable
+            ]
         return SchedulingResult(
             claims=claims,
             unschedulable=unschedulable,

@@ -333,7 +333,35 @@ class Requirements:
         return self.intersects(incoming)
 
     def is_compatible(self, incoming: "Requirements", allow_undefined: frozenset[str] = frozenset()) -> bool:
-        return self.compatible(incoming, allow_undefined) is None
+        """Allocation-free boolean fast path (no error-string formatting —
+        the reference keeps error construction lazy for the same reason,
+        nodeclaim.go:543-556)."""
+        for key in incoming._reqs:
+            if key in allow_undefined:
+                continue
+            if key in self._reqs or incoming._reqs[key].is_lenient():
+                continue
+            return False
+        return self.intersects_ok(incoming)
+
+    def intersects_ok(self, incoming: "Requirements") -> bool:
+        """Boolean twin of intersects() without error strings."""
+        mine = self._reqs
+        theirs = incoming._reqs
+        if len(theirs) < len(mine):
+            small, large = theirs, mine
+        else:
+            small, large = mine, theirs
+        for key in small:
+            if key not in large:
+                continue
+            existing = mine[key]
+            inc = theirs[key]
+            if not existing.has_intersection(inc):
+                if inc.is_lenient() and existing.is_lenient():
+                    continue
+                return False
+        return True
 
     def intersects(self, incoming: "Requirements") -> Optional[str]:
         """None if all shared keys intersect (requirements.go:254-274).
