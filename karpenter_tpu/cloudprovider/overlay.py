@@ -1,0 +1,148 @@
+"""NodeOverlay: runtime price/capacity adjustment of instance types.
+
+Counterpart of reference pkg/apis/v1alpha1 (NodeOverlay) +
+pkg/controllers/nodeoverlay (store.go:45-288) + the overlay cloudprovider
+decorator (pkg/cloudprovider/overlay): overlays match instance types by
+requirements and adjust offering prices (absolute / ±delta / ±percent) or
+merge extra capacity; the decorator applies the evaluated store on every
+GetInstanceTypes. Conflicting overlays resolve by weight, heaviest wins.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from karpenter_tpu.cloudprovider.instancetype import InstanceType, Offering, adjusted_price
+from karpenter_tpu.cloudprovider.spi import CloudProvider
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.objects import ObjectMeta
+from karpenter_tpu.scheduling import Requirements
+from karpenter_tpu.scheduling.requirements import node_selector_requirement
+
+
+@dataclass
+class NodeOverlay:
+    metadata: ObjectMeta = field(default_factory=lambda: ObjectMeta(name="overlay"))
+    requirements: list[dict] = field(default_factory=list)  # {key, operator, values}
+    weight: int = 0  # heaviest wins on conflict
+    price: Optional[str] = None  # absolute / "+N" / "-N" / "±N%"
+    capacity: dict[str, float] = field(default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def matches(self, it: InstanceType) -> bool:
+        reqs = Requirements(
+            *(
+                node_selector_requirement(r["key"], r["operator"], r.get("values", ()))
+                for r in self.requirements
+            )
+        )
+        return it.requirements.is_compatible(reqs, l.WELL_KNOWN_LABELS)
+
+
+class OverlayStore:
+    """Evaluated overlays applied to a catalog (store.go:45-288)."""
+
+    def __init__(self, overlays: list[NodeOverlay]):
+        # heaviest weight first; name tie-break for determinism
+        self.overlays = sorted(overlays, key=lambda o: (-o.weight, o.name))
+
+    def _price_overlay_for(self, it: InstanceType, offering: Offering) -> Optional[NodeOverlay]:
+        """The heaviest price overlay compatible with THIS offering — price
+        updates are keyed per offering (store.go:155-167), so a spot-only
+        overlay never reprices on-demand offerings of the same type."""
+        combined = it.requirements.copy()
+        combined.add(*offering.requirements.values())
+        for o in self.overlays:
+            if o.price is None:
+                continue
+            reqs = Requirements(
+                *(
+                    node_selector_requirement(r["key"], r["operator"], r.get("values", ()))
+                    for r in o.requirements
+                )
+            )
+            if combined.is_compatible(reqs, l.WELL_KNOWN_LABELS):
+                return o
+        return None
+
+    def apply(self, its: list[InstanceType]) -> list[InstanceType]:
+        out = []
+        for it in its:
+            capacity_overlay: Optional[NodeOverlay] = None
+            for o in self.overlays:
+                if o.capacity and o.matches(it):
+                    capacity_overlay = o
+                    break
+            new_offerings = []
+            any_price = False
+            for of in it.offerings:
+                po = self._price_overlay_for(it, of)
+                new_of = Offering(
+                    requirements=of.requirements,
+                    price=adjusted_price(of.price, po.price) if po is not None else of.price,
+                    available=of.available,
+                    reservation_capacity=of.reservation_capacity,
+                    capacity_override=dict(of.capacity_override),
+                    overhead_override=of.overhead_override,
+                )
+                if po is not None:
+                    new_of._price_overlay_applied = True
+                    any_price = True
+                new_offerings.append(new_of)
+            if not any_price and capacity_overlay is None:
+                out.append(it)
+                continue
+            clone = InstanceType(
+                name=it.name,
+                requirements=it.requirements,
+                offerings=new_offerings,
+                capacity=dict(it.capacity),
+                overhead=it.overhead,
+            )
+            if capacity_overlay is not None:
+                clone.apply_capacity_overlay(dict(capacity_overlay.capacity))
+            out.append(clone)
+        return out
+
+
+class OverlayCloudProvider(CloudProvider):
+    """Decorator applying the overlay store on GetInstanceTypes
+    (pkg/cloudprovider/overlay/cloudprovider.go; wiring kwok/main.go:36)."""
+
+    def __init__(self, inner: CloudProvider, store):
+        self.inner = inner
+        self.object_store = store
+
+    @property
+    def name(self) -> str:
+        return self.inner.name
+
+    def get_instance_types(self, node_pool):
+        its = self.inner.get_instance_types(node_pool)
+        overlays = self.object_store.list(self.object_store.NODE_OVERLAYS)
+        if not overlays:
+            return its
+        return OverlayStore(overlays).apply(its)
+
+    # everything else passes through
+    def create(self, node_claim):
+        return self.inner.create(node_claim)
+
+    def delete(self, node_claim):
+        return self.inner.delete(node_claim)
+
+    def get(self, provider_id):
+        return self.inner.get(provider_id)
+
+    def list(self):
+        return self.inner.list()
+
+    def is_drifted(self, node_claim):
+        return self.inner.is_drifted(node_claim)
+
+    def repair_policies(self):
+        return self.inner.repair_policies()

@@ -78,16 +78,19 @@ def build_candidates(
     pools_by_name: dict[str, NodePool],
     instance_types_by_name: dict[str, InstanceType],
     clock: Clock,
+    pdb_blocked: frozenset[str] = frozenset(),
 ) -> list[Candidate]:
     """All disruptable nodes as candidates, deterministic name order.
 
-    PodDisruptionBudget objects are not modeled yet; when they land, a
-    PDB-violating eviction must disqualify the node here (types.go:160).
+    pdb_blocked: uids of pods whose eviction would violate a
+    PodDisruptionBudget — their nodes are excluded (types.go:160).
     """
     out = []
     nominated_targets = cluster.nomination_targets()
     for sn in sorted(cluster.nodes(), key=lambda s: s.name):
         if is_disruptable(sn, clock) is not None:
+            continue
+        if pdb_blocked and any(uid in pdb_blocked for uid in sn.pods):
             continue
         # capacity that pending pods are nominated onto (a fresh replacement
         # node, or one awaiting binds) must not be disrupted from under them

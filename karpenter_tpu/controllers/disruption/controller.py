@@ -39,12 +39,13 @@ class _PendingValidation:
 
 class DisruptionController:
     def __init__(self, store: ObjectStore, cluster, provisioner, cloud, clock,
-                 spot_to_spot_enabled: bool = False):
+                 spot_to_spot_enabled: bool = False, cost_ledger=None):
         self.store = store
         self.cluster = cluster
         self.provisioner = provisioner
         self.cloud = cloud
         self.clock = clock
+        self.cost_ledger = cost_ledger
         self.queue = OrchestrationQueue(store, cluster, provisioner, clock)
         self._pending: Optional[_PendingValidation] = None
         self.methods = [
@@ -94,13 +95,25 @@ class DisruptionController:
             for p in pools.values()
             for it in self.cloud.get_instance_types(p)
         }
-        candidates = build_candidates(self.cluster, pools, its, self.clock)
+        from karpenter_tpu.models.pdb import blocked_pod_uids
+
+        blocked = frozenset(
+            blocked_pod_uids(self.store.list(ObjectStore.PDBS), self.store.pods())
+        )
+        candidates = build_candidates(self.cluster, pools, its, self.clock, blocked)
         if not candidates:
             return None
         for method in self.methods:
             budgets = build_disruption_budgets(pools, self.cluster, method.reason, self.clock)
             command = method.compute(candidates, budgets)
             if command.is_empty:
+                continue
+            # Balanced scoring applies to consolidation only — Drift and
+            # Emptiness are never scored (evaluator invoked only from
+            # singlenodeconsolidation.go:102 / multinodeconsolidation.go:168)
+            if isinstance(
+                method, (MultiNodeConsolidation, SingleNodeConsolidation)
+            ) and not self._balanced_approves(command, candidates):
                 continue
             if isinstance(method, Emptiness):
                 # emptiness skips the validation delay (it re-validates
@@ -112,6 +125,52 @@ class DisruptionController:
             )
             return None
         return None
+
+    def _balanced_approves(self, command: Command, all_candidates: list[Candidate]) -> bool:
+        """ConsolidationPolicy: Balanced (balanced.go:47-130): every
+        Balanced pool touched by the command must approve — a move passes
+        iff (savings / poolCost) / (disruption / poolDisruptionCost)
+        >= 1/k with k=2 (nodepool.go:171). Pools with other policies
+        always approve."""
+        from karpenter_tpu.models.nodepool import BALANCED_K, CONSOLIDATION_BALANCED
+
+        touched = {c.nodepool.name: c.nodepool for c in command.candidates}
+        balanced = {
+            n: p
+            for n, p in touched.items()
+            if p.spec.disruption.consolidation_policy == CONSOLIDATION_BALANCED
+        }
+        if not balanced:
+            return True
+        replacement_price = sum(
+            sim.cheapest_launch()[1] for sim in command.replacements
+        )
+        total_cmd_price = sum(c.price for c in command.candidates)
+        total_savings = total_cmd_price - replacement_price
+        for name in balanced:
+            pool_cmd = [c for c in command.candidates if c.nodepool.name == name]
+            pool_price = sum(c.price for c in pool_cmd)
+            # attribute net savings proportionally across pools
+            # (balanced.go:149-156) — charging each pool the full
+            # replacement cost would double-count it
+            savings = (
+                total_savings * (pool_price / total_cmd_price) if total_cmd_price > 0 else 0.0
+            )
+            disruption = sum(c.disruption_cost for c in pool_cmd)
+            pool_cost = self.cost_ledger.pool_cost(name) if self.cost_ledger is not None else 0.0
+            if pool_cost <= 0:
+                # ledger empty (restart / unknown prices): fall back to the
+                # candidate price sum (balanced.go:94-97)
+                pool_cost = sum(c.price for c in all_candidates if c.nodepool.name == name)
+            pool_disruption = sum(
+                c.disruption_cost for c in all_candidates if c.nodepool.name == name
+            )
+            if pool_cost <= 0 or pool_disruption <= 0 or savings <= 0:
+                return False
+            ratio = (savings / pool_cost) / (disruption / pool_disruption)
+            if ratio < 1.0 / BALANCED_K:
+                return False
+        return True
 
     def _validate(self, command: Command) -> bool:
         """Re-verify after the delay: candidates still disruptable and the

@@ -41,14 +41,20 @@ class Manager:
         )
 
         self.disruption = DisruptionController(
-            store, self.cluster, self.provisioner, cloud, self.clock
+            store, self.cluster, self.provisioner, cloud, self.clock, cost_ledger=None
         )
         self.garbage_collection = GarbageCollectionController(store, cloud, self.clock)
         self.expiration = ExpirationController(store, self.clock)
         self.health = NodeHealthController(store, cloud, self.clock)
         from karpenter_tpu.controllers.static_capacity import StaticCapacityController
+        from karpenter_tpu.state.cost import ClusterCost, NodePoolHealth
 
         self.static_capacity = StaticCapacityController(store, self.cluster, cloud, self.clock)
+        self.cost = ClusterCost()
+        self.pool_health = NodePoolHealth()
+        self.disruption.cost_ledger = self.cost
+        self._launched_claims: set[str] = set()
+        self._catalog_by_name: dict = {}
         self._dirty_claims: set[str] = set()
         self._claim_by_pid: dict[str, str] = {}  # provider_id -> claim name
         self._gated_passes = 0
@@ -63,6 +69,7 @@ class Manager:
         self.store.watch(ObjectStore.NODEPOOLS, self._on_nodepool)
 
     def _on_nodepool(self, event: EventType, pool) -> None:
+        self._catalog_by_name = {}  # pool changes can reshape the catalog
         # a new/changed pool may unblock gated provisioning
         if any(p.is_provisionable() for p in self.store.pods()):
             self.batcher.trigger()
@@ -89,10 +96,37 @@ class Manager:
         if claim_name is not None:
             self._dirty_claims.add(claim_name)
 
+    def _claim_price(self, claim) -> float:
+        from karpenter_tpu.models import labels as l
+
+        name = claim.metadata.labels.get(l.LABEL_INSTANCE_TYPE, "")
+        if name not in self._catalog_by_name:
+            # rebuild on miss: pools/overlays may have changed the catalog
+            self._catalog_by_name = {}
+            for pool in self.store.nodepools():
+                for it in self.cloud.get_instance_types(pool):
+                    self._catalog_by_name.setdefault(it.name, it)
+        it = self._catalog_by_name.get(name)
+        if it is None:
+            return 0.0
+        price = it.offering_price(
+            claim.metadata.labels.get(l.LABEL_TOPOLOGY_ZONE, ""),
+            claim.metadata.labels.get(l.CAPACITY_TYPE_LABEL_KEY, ""),
+        )
+        return price or 0.0
+
     def _on_nodeclaim(self, event: EventType, claim) -> None:
+        from karpenter_tpu.models.nodeclaim import COND_LAUNCHED, COND_REGISTERED
+
         if event is EventType.DELETED:
             self.cluster.delete_nodeclaim(claim.name)
             self.cluster.clear_nominations_for(claim.name)
+            self.cost.remove_claim(claim.nodepool_name, claim.name)
+            if claim.name in self._launched_claims and not claim.conditions.is_true(COND_REGISTERED):
+                # launched but never registered: a failed launch for the
+                # pool-health ring buffer (liveness.go:115)
+                self.pool_health.record(claim.nodepool_name or "", False)
+            self._launched_claims.discard(claim.name)
             if claim.status.provider_id:
                 self._claim_by_pid.pop(claim.status.provider_id, None)
             # pods that were counting on this claim need a fresh pass
@@ -102,6 +136,13 @@ class Manager:
         self.cluster.update_nodeclaim(claim)
         if claim.status.provider_id:
             self._claim_by_pid[claim.status.provider_id] = claim.name
+            if claim.nodepool_name:
+                self.cost.set_claim(claim.nodepool_name, claim.name, self._claim_price(claim))
+        if claim.conditions.is_true(COND_LAUNCHED):
+            self._launched_claims.add(claim.name)
+        if claim.conditions.is_true(COND_REGISTERED) and claim.name in self._launched_claims:
+            self.pool_health.record(claim.nodepool_name or "", True)
+            self._launched_claims.discard(claim.name)
         self._dirty_claims.add(claim.name)
 
     # -- the loop ----------------------------------------------------------------

@@ -183,6 +183,28 @@ class Provisioner:
             }
         return budgets
 
+    def _daemon_overhead(self, template) -> dict[str, float]:
+        """Requests of daemonset pods that would schedule on this template's
+        nodes (scheduler.go:963-1043; approximated per-template rather than
+        per instance-type group)."""
+        from karpenter_tpu.models import labels as l
+        from karpenter_tpu.scheduling import Requirements
+        from karpenter_tpu.scheduling.taints import tolerates_all
+        from karpenter_tpu.utils import resources as res
+
+        total: dict[str, float] = {}
+        for ds in self.store.list(self.store.DAEMONSETS):
+            pod = ds.as_pod()
+            if tolerates_all(template.taints, pod.spec.tolerations) is not None:
+                continue
+            # strict (required-only) requirements with well-known labels
+            # allowed undefined, matching getDaemonOverhead
+            pod_reqs = Requirements.from_pod(pod, include_preferred=False)
+            if template.requirements.compatible(pod_reqs, l.WELL_KNOWN_LABELS) is not None:
+                continue
+            total = res.merge(total, pod.total_requests())
+        return total
+
     def _build_scheduler(self) -> Optional[TPUScheduler]:
         pools = self._ready_pools()
         if not pools:
@@ -191,7 +213,9 @@ class Provisioner:
         templates = build_templates(pool_catalogs)
         if not templates:
             return None
-        # full-content signature: any template/catalog change invalidates
+        for t in templates:
+            t.daemon_requests = self._daemon_overhead(t)
+        # full-content signature: any template/catalog/daemonset change invalidates
         sig = tuple(
             sorted(
                 (
@@ -201,6 +225,7 @@ class Provisioner:
                     tuple(sorted(t.labels.items())),
                     tuple((x.key, x.value, x.effect) for x in t.taints),
                     tuple(it.name for it in t.instance_types),
+                    tuple(sorted(t.daemon_requests.items())),
                 )
                 for t in templates
             )

@@ -1,0 +1,34 @@
+"""DaemonSet model — enough for daemon-overhead accounting.
+
+The reference subtracts the requests of daemonset pods that would schedule
+onto a node from its usable capacity (scheduler.go:963-1043 daemon
+overhead groups). The harness models a DaemonSet as a pod template that
+lands on every compatible node.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from karpenter_tpu.models.objects import ObjectMeta
+from karpenter_tpu.models.pod import Pod, PodSpec
+
+
+@dataclass
+class DaemonSet:
+    metadata: ObjectMeta = field(default_factory=lambda: ObjectMeta(name="daemonset"))
+    pod_template: PodSpec = field(default_factory=PodSpec)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def as_pod(self) -> Pod:
+        """The template as a schedulable pod for compatibility checks."""
+        import copy
+
+        pod = Pod(
+            metadata=ObjectMeta(name=f"daemon-{self.name}"),
+            spec=copy.deepcopy(self.pod_template),
+        )
+        return pod

@@ -1,0 +1,66 @@
+"""PodDisruptionBudget model + limits computation.
+
+Counterpart of reference pkg/utils/pdb (pdb.Limits): a PDB caps voluntary
+evictions of its selected pods; a node whose eviction would overrun any
+matching PDB cannot be a disruption candidate (disruption/types.go:160).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+from karpenter_tpu.models.objects import ObjectMeta
+from karpenter_tpu.models.pod import Pod
+
+
+@dataclass
+class PodDisruptionBudget:
+    metadata: ObjectMeta = field(default_factory=lambda: ObjectMeta(name="pdb"))
+    selector: dict[str, str] = field(default_factory=dict)  # matchLabels
+    min_available: Optional[str] = None  # int or percentage string
+    max_unavailable: Optional[str] = None
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def selects(self, pod: Pod) -> bool:
+        if not self.selector:
+            return False
+        return (
+            pod.metadata.namespace == self.metadata.namespace
+            and all(pod.metadata.labels.get(k) == v for k, v in self.selector.items())
+        )
+
+    def _resolve(self, value: str, total: int, round_up: bool) -> int:
+        s = value.strip()
+        if s.endswith("%"):
+            frac = float(s[:-1]) / 100.0 * total
+            return int(math.ceil(frac)) if round_up else int(math.floor(frac))
+        return int(s)
+
+    def disruptions_allowed(self, matching_healthy: int) -> int:
+        """How many of the matching pods may be evicted right now."""
+        if self.max_unavailable is not None:
+            # Kubernetes rounds maxUnavailable percentages UP
+            # (GetScaledValueFromIntOrPercent roundUp=true)
+            return max(self._resolve(self.max_unavailable, matching_healthy, True), 0)
+        if self.min_available is not None:
+            keep = self._resolve(self.min_available, matching_healthy, True)
+            return max(matching_healthy - keep, 0)
+        return matching_healthy
+
+
+def blocked_pod_uids(pdbs: list[PodDisruptionBudget], pods: list[Pod]) -> set[str]:
+    """Pods whose eviction would violate some PDB (zero budget left).
+
+    The harness treats every running bound pod as healthy.
+    """
+    out: set[str] = set()
+    for pdb in pdbs:
+        matching = [p for p in pods if pdb.selects(p) and p.is_scheduled() and not p.is_terminal()]
+        if pdb.disruptions_allowed(len(matching)) <= 0:
+            out.update(p.uid for p in matching)
+    return out

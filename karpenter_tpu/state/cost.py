@@ -1,0 +1,61 @@
+"""Incremental cluster-cost ledger and launch-health tracking.
+
+Counterparts of reference pkg/state/cost (cost.go:68-315) and
+pkg/state/nodepoolhealth (tracker.go:32-145 with pkg/utils/ringbuffer).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict, deque
+from typing import Optional
+
+
+class ClusterCost:
+    """Per-nodepool hourly price ledger, updated on claim events."""
+
+    def __init__(self) -> None:
+        self._by_pool: dict[str, dict[str, float]] = defaultdict(dict)  # pool -> claim -> price
+
+    def set_claim(self, pool: str, claim_name: str, price: float) -> None:
+        self._by_pool[pool][claim_name] = price
+
+    def remove_claim(self, pool: Optional[str], claim_name: str) -> None:
+        if pool is None:
+            for claims in self._by_pool.values():
+                claims.pop(claim_name, None)
+            return
+        self._by_pool[pool].pop(claim_name, None)
+
+    def pool_cost(self, pool: str) -> float:
+        """GetNodepoolCost (cost.go:315) — feeds Balanced denominators."""
+        return sum(self._by_pool.get(pool, {}).values())
+
+    def total(self) -> float:
+        return sum(self.pool_cost(p) for p in self._by_pool)
+
+
+RING_CAPACITY = 4  # tracker.go BufferSize
+FAILURE_THRESHOLD = 0.5  # tracker.go ThresholdFalse
+
+
+class NodePoolHealth:
+    """Fixed-capacity ring buffer of launch outcomes per pool
+    (tracker.go:32-145): a pool goes unhealthy when failures reach 50% of
+    the buffer SIZE (not of the recorded count — two failures flip a
+    4-slot buffer even before it fills)."""
+
+    def __init__(self, capacity: int = RING_CAPACITY):
+        self.capacity = capacity
+        self._rings: dict[str, deque[bool]] = {}
+
+    def record(self, pool: str, success: bool) -> None:
+        ring = self._rings.setdefault(pool, deque(maxlen=self.capacity))
+        ring.append(success)
+
+    def healthy(self, pool: str) -> Optional[bool]:
+        """None with no data; False when failures / capacity >= threshold."""
+        ring = self._rings.get(pool)
+        if not ring:
+            return None
+        failures = sum(1 for ok in ring if not ok)
+        return failures / self.capacity < FAILURE_THRESHOLD

@@ -132,6 +132,9 @@ class ObjectStore:
     NODECLAIMS = "nodeclaims"
     NODEPOOLS = "nodepools"
     CAPACITY_BUFFERS = "capacitybuffers"
+    DAEMONSETS = "daemonsets"
+    NODE_OVERLAYS = "nodeoverlays"
+    PDBS = "poddisruptionbudgets"
 
     def pods(self) -> list:
         return self.list(self.PODS)

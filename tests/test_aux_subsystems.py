@@ -1,0 +1,258 @@
+"""Daemon overhead, PDBs, cost ledger, pool health, Balanced scoring,
+NodeOverlay."""
+
+import pytest
+
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.cloudprovider.overlay import NodeOverlay, OverlayCloudProvider
+from karpenter_tpu.controllers.manager import KubeSchedulerSim, Manager
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.daemonset import DaemonSet
+from karpenter_tpu.models.nodepool import Budget, NodePool
+from karpenter_tpu.models.pdb import PodDisruptionBudget, blocked_pod_uids
+from karpenter_tpu.models.pod import PodSpec, make_pod
+from karpenter_tpu.state.cost import ClusterCost, NodePoolHealth
+from karpenter_tpu.state.store import ObjectStore
+from karpenter_tpu.utils import resources as res
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def build_env(catalog_size=50):
+    clock = FakeClock()
+    store = ObjectStore(clock)
+    cloud = KwokCloudProvider(store, catalog=instance_types(catalog_size))
+    mgr = Manager(store, cloud, clock)
+    pool = NodePool()
+    pool.metadata.name = "default"
+    pool.spec.disruption.budgets = [Budget(nodes="100%")]
+    store.create(ObjectStore.NODEPOOLS, pool)
+    return clock, store, cloud, mgr
+
+
+def provision(mgr, store, cloud, pods):
+    for p in pods:
+        store.create(ObjectStore.PODS, p)
+    mgr.run_until_idle()
+    cloud.simulate_kubelet_ready()
+    mgr.run_until_idle()
+    KubeSchedulerSim(store, mgr.cluster).bind_pending()
+    mgr.run_until_idle()
+
+
+class TestDaemonOverhead:
+    def test_daemon_requests_reserve_capacity(self):
+        clock, store, cloud, mgr = build_env(catalog_size=8)  # 1-cpu shapes
+        ds = DaemonSet()
+        ds.metadata.name = "log-agent"
+        ds.pod_template = PodSpec(requests={res.CPU: 0.5, res.MEMORY: float(2**28)})
+        store.create(ObjectStore.DAEMONSETS, ds)
+        # a 0.5-cpu pod + 0.5-cpu daemon cannot share a 1-cpu node
+        # (allocatable ~0.92), so each pod needs its own node and a second
+        # 0.5 pod cannot squeeze onto the first node
+        provision(mgr, store, cloud, [make_pod(f"p-{i}", cpu=0.25) for i in range(2)])
+        claims = store.nodeclaims()
+        assert claims
+        for c in claims:
+            # claim requests include the daemon overhead
+            assert c.spec.requests.get("cpu", 0) >= 0.5
+
+    def test_intolerant_daemon_not_counted(self):
+        from karpenter_tpu.models.taints import NO_SCHEDULE, Taint
+
+        clock, store, cloud, mgr = build_env()
+        pool = store.get(ObjectStore.NODEPOOLS, "default")
+        pool.spec.template.spec.taints = [Taint(key="dedicated", value="x", effect=NO_SCHEDULE)]
+        store.update(ObjectStore.NODEPOOLS, pool)
+        ds = DaemonSet()
+        ds.pod_template = PodSpec(requests={res.CPU: 8.0})  # huge, but intolerant
+        store.create(ObjectStore.DAEMONSETS, ds)
+        from karpenter_tpu.models.taints import Toleration
+
+        pod = make_pod("p", cpu=0.5)
+        pod.spec.tolerations = [Toleration(key="dedicated", operator="Exists")]
+        provision(mgr, store, cloud, [pod])
+        claims = store.nodeclaims()
+        assert claims
+        assert claims[0].spec.requests.get("cpu", 0) < 2.0  # daemon not added
+
+
+class TestPDB:
+    def test_blocked_pods(self):
+        pdb = PodDisruptionBudget(selector={"app": "db"}, min_available="2")
+        pods = []
+        for i in range(2):
+            p = make_pod(f"db-{i}")
+            p.metadata.labels = {"app": "db"}
+            p.spec.node_name = f"node-{i}"
+            pods.append(p)
+        blocked = blocked_pod_uids([pdb], pods)
+        assert len(blocked) == 2  # 2 healthy, min 2 -> zero budget
+
+    def test_max_unavailable_allows(self):
+        pdb = PodDisruptionBudget(selector={"app": "db"}, max_unavailable="1")
+        p = make_pod("db-0")
+        p.metadata.labels = {"app": "db"}
+        p.spec.node_name = "n"
+        assert blocked_pod_uids([pdb], [p]) == set()
+
+    def test_pdb_blocks_disruption(self):
+        clock, store, cloud, mgr = build_env()
+        pod = make_pod("db", cpu=1.0)
+        pod.metadata.labels = {"app": "db"}
+        provision(mgr, store, cloud, [pod])
+        store.create(
+            ObjectStore.PDBS,
+            PodDisruptionBudget(selector={"app": "db"}, min_available="1"),
+        )
+        clock.step(60.0)
+        # the node hosts a PDB-protected pod: no disruption command
+        assert mgr.run_disruption_once() is None
+
+
+class TestCostAndHealth:
+    def test_cost_ledger_tracks_pools(self):
+        cost = ClusterCost()
+        cost.set_claim("a", "c1", 1.5)
+        cost.set_claim("a", "c2", 0.5)
+        cost.set_claim("b", "c3", 2.0)
+        assert cost.pool_cost("a") == 2.0
+        assert cost.total() == 4.0
+        cost.remove_claim("a", "c1")
+        assert cost.pool_cost("a") == 0.5
+
+    def test_pool_health_ring(self):
+        h = NodePoolHealth(capacity=4)
+        assert h.healthy("p") is None
+        h.record("p", True)
+        assert h.healthy("p") is True
+        h.record("p", False)
+        assert h.healthy("p") is True  # 1/4 failures < 50%
+        h.record("p", False)
+        assert h.healthy("p") is False  # 2/4 failures hits the threshold
+        for _ in range(4):
+            h.record("p", True)
+        assert h.healthy("p") is True  # window rolled over
+
+    def test_cost_updates_from_lifecycle(self):
+        clock, store, cloud, mgr = build_env()
+        provision(mgr, store, cloud, [make_pod("p", cpu=1.0)])
+        assert mgr.cost.pool_cost("default") > 0
+        assert mgr.pool_health.healthy("default") is True
+        # retire the pod first so the drained claim isn't replaced
+        pod = store.get(ObjectStore.PODS, "p")
+        pod.status.phase = "Succeeded"
+        store.update(ObjectStore.PODS, pod)
+        store.delete(ObjectStore.PODS, pod.name)
+        claim = store.nodeclaims()[0]
+        store.delete(ObjectStore.NODECLAIMS, claim.name)
+        mgr.run_until_idle()
+        assert mgr.cost.pool_cost("default") == 0
+
+
+class TestBalanced:
+    def test_balanced_pool_blocks_low_value_move(self):
+        """With Balanced policy, a move whose savings/disruption ratio is
+        poor must not execute."""
+        clock, store, cloud, mgr = build_env(catalog_size=64)
+        pool = store.get(ObjectStore.NODEPOOLS, "default")
+        pool.spec.disruption.consolidation_policy = "Balanced"
+        pool.spec.template.spec.requirements = [
+            {
+                "key": l.CAPACITY_TYPE_LABEL_KEY,
+                "operator": "In",
+                "values": [l.CAPACITY_TYPE_ON_DEMAND],
+            }
+        ]
+        store.update(ObjectStore.NODEPOOLS, pool)
+        # many pods with high deletion costs -> disruption dwarfs savings
+        pods = []
+        for i in range(8):
+            p = make_pod(f"p-{i}", cpu=1.5, memory="1Gi")
+            p.metadata.annotations["controller.kubernetes.io/pod-deletion-cost"] = "100000"
+            pods.append(p)
+        provision(mgr, store, cloud, pods)
+        # shrink usage: replacement would save a little but disrupt a lot
+        for pod in list(store.pods()):
+            if pod.name not in ("p-0", "p-1"):
+                pod.status.phase = "Succeeded"
+                store.update(ObjectStore.PODS, pod)
+                store.delete(ObjectStore.PODS, pod.name)
+        mgr.run_until_idle()
+        clock.step(60.0)
+        for _ in range(3):
+            cmd = mgr.run_disruption_once()
+            assert cmd is None or not cmd.candidates, "Balanced pool approved a bad move"
+            clock.step(20.0)
+
+
+class TestNodeOverlay:
+    def test_price_overlay_applies(self):
+        clock = FakeClock()
+        store = ObjectStore(clock)
+        inner = KwokCloudProvider(store, catalog=instance_types(8))
+        cloud = OverlayCloudProvider(inner, store)
+        overlay = NodeOverlay(
+            requirements=[{"key": l.LABEL_ARCH, "operator": "In", "values": [l.ARCH_AMD64]}],
+            price="+100%",
+        )
+        overlay.metadata.name = "double-amd64"
+        store.create(ObjectStore.NODE_OVERLAYS, overlay)
+        pool = NodePool()
+        base = {it.name: it for it in inner.get_instance_types(pool)}
+        for it in cloud.get_instance_types(pool):
+            orig = base[it.name]
+            arch = it.requirements.get(l.LABEL_ARCH).any_value()
+            for of, of0 in zip(it.offerings, orig.offerings):
+                if arch == l.ARCH_AMD64:
+                    assert of.price == pytest.approx(of0.price * 2)
+                    assert of.is_price_overlaid
+                else:
+                    assert of.price == of0.price
+
+    def test_spot_only_overlay_leaves_on_demand_alone(self):
+        clock = FakeClock()
+        store = ObjectStore(clock)
+        inner = KwokCloudProvider(store, catalog=instance_types(8))
+        cloud = OverlayCloudProvider(inner, store)
+        overlay = NodeOverlay(
+            requirements=[
+                {
+                    "key": l.CAPACITY_TYPE_LABEL_KEY,
+                    "operator": "In",
+                    "values": [l.CAPACITY_TYPE_SPOT],
+                }
+            ],
+            price="-50%",
+        )
+        overlay.metadata.name = "spot-discount"
+        store.create(ObjectStore.NODE_OVERLAYS, overlay)
+        pool = NodePool()
+        base = {it.name: it for it in inner.get_instance_types(pool)}
+        for it in cloud.get_instance_types(pool):
+            for of, of0 in zip(it.offerings, base[it.name].offerings):
+                if of.capacity_type == l.CAPACITY_TYPE_SPOT:
+                    assert of.price == pytest.approx(of0.price * 0.5)
+                else:
+                    assert of.price == of0.price
+                    assert not of.is_price_overlaid
+
+    def test_capacity_overlay_and_weight(self):
+        clock = FakeClock()
+        store = ObjectStore(clock)
+        inner = KwokCloudProvider(store, catalog=instance_types(4))
+        cloud = OverlayCloudProvider(inner, store)
+        heavy = NodeOverlay(requirements=[], weight=10, price="5.0")
+        heavy.metadata.name = "heavy"
+        light = NodeOverlay(requirements=[], weight=1, price="9.0")
+        light.metadata.name = "light"
+        cap = NodeOverlay(requirements=[], capacity={"example.com/gpu": 4.0})
+        cap.metadata.name = "gpus"
+        for o in (heavy, light, cap):
+            store.create(ObjectStore.NODE_OVERLAYS, o)
+        pool = NodePool()
+        its = cloud.get_instance_types(pool)
+        for it in its:
+            assert all(of.price == 5.0 for of in it.offerings)  # heaviest wins
+            assert it.capacity["example.com/gpu"] == 4.0
+            assert it.is_capacity_overlay_applied
