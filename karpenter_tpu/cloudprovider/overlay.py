@@ -49,6 +49,16 @@ class OverlayStore:
     def __init__(self, overlays: list[NodeOverlay]):
         # heaviest weight first; name tie-break for determinism
         self.overlays = sorted(overlays, key=lambda o: (-o.weight, o.name))
+        # parse each overlay's requirements once, not per offering
+        self._overlay_reqs = [
+            Requirements(
+                *(
+                    node_selector_requirement(r["key"], r["operator"], r.get("values", ()))
+                    for r in o.requirements
+                )
+            )
+            for o in self.overlays
+        ]
 
     def _price_overlay_for(self, it: InstanceType, offering: Offering) -> Optional[NodeOverlay]:
         """The heaviest price overlay compatible with THIS offering — price
@@ -56,27 +66,27 @@ class OverlayStore:
         overlay never reprices on-demand offerings of the same type."""
         combined = it.requirements.copy()
         combined.add(*offering.requirements.values())
-        for o in self.overlays:
+        for o, reqs in zip(self.overlays, self._overlay_reqs):
             if o.price is None:
                 continue
-            reqs = Requirements(
-                *(
-                    node_selector_requirement(r["key"], r["operator"], r.get("values", ()))
-                    for r in o.requirements
-                )
-            )
             if combined.is_compatible(reqs, l.WELL_KNOWN_LABELS):
                 return o
         return None
 
+    def _merged_capacity(self, it: InstanceType) -> dict[str, float]:
+        """Capacity keys merge across ALL matching overlays, heaviest
+        winning per key (store.go:199-207)."""
+        merged: dict[str, float] = {}
+        # lightest first so heavier overlays overwrite per key
+        for o, reqs in reversed(list(zip(self.overlays, self._overlay_reqs))):
+            if o.capacity and it.requirements.is_compatible(reqs, l.WELL_KNOWN_LABELS):
+                merged.update(o.capacity)
+        return merged
+
     def apply(self, its: list[InstanceType]) -> list[InstanceType]:
         out = []
         for it in its:
-            capacity_overlay: Optional[NodeOverlay] = None
-            for o in self.overlays:
-                if o.capacity and o.matches(it):
-                    capacity_overlay = o
-                    break
+            merged_capacity = self._merged_capacity(it)
             new_offerings = []
             any_price = False
             for of in it.offerings:
@@ -93,7 +103,7 @@ class OverlayStore:
                     new_of._price_overlay_applied = True
                     any_price = True
                 new_offerings.append(new_of)
-            if not any_price and capacity_overlay is None:
+            if not any_price and not merged_capacity:
                 out.append(it)
                 continue
             clone = InstanceType(
@@ -103,8 +113,8 @@ class OverlayStore:
                 capacity=dict(it.capacity),
                 overhead=it.overhead,
             )
-            if capacity_overlay is not None:
-                clone.apply_capacity_overlay(dict(capacity_overlay.capacity))
+            if merged_capacity:
+                clone.apply_capacity_overlay(merged_capacity)
             out.append(clone)
         return out
 

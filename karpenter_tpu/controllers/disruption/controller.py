@@ -162,9 +162,7 @@ class DisruptionController:
                 # ledger empty (restart / unknown prices): fall back to the
                 # candidate price sum (balanced.go:94-97)
                 pool_cost = sum(c.price for c in all_candidates if c.nodepool.name == name)
-            pool_disruption = sum(
-                c.disruption_cost for c in all_candidates if c.nodepool.name == name
-            )
+            pool_disruption = self._pool_disruption_total(name)
             if pool_cost <= 0 or pool_disruption <= 0 or savings <= 0:
                 return False
             ratio = (savings / pool_cost) / (disruption / pool_disruption)
@@ -172,13 +170,32 @@ class DisruptionController:
                 return False
         return True
 
-    def _validate(self, command: Command) -> bool:
-        """Re-verify after the delay: candidates still disruptable and the
-        pods still have somewhere to go (validation.go)."""
-        from karpenter_tpu.controllers.disruption.candidates import is_disruptable
+    def _pool_disruption_total(self, pool_name: str) -> float:
+        """Disruption-cost total over ALL the pool's nodes — non-candidates
+        included (balanced.go computeNodePoolTotals)."""
+        from karpenter_tpu.controllers.disruption.candidates import _pod_eviction_cost
 
+        total = 0.0
+        for sn in self.cluster.nodes():
+            if sn.nodepool_name != pool_name:
+                continue
+            total += 1.0 + sum(
+                _pod_eviction_cost(p) for p in sn.pods.values() if not p.is_terminal()
+            )
+        return total
+
+    def _validate(self, command: Command) -> bool:
+        """Re-verify after the delay: candidates still disruptable, not
+        newly PDB-blocked, and the pods still have somewhere to go
+        (validation.go:258)."""
+        from karpenter_tpu.controllers.disruption.candidates import is_disruptable
+        from karpenter_tpu.models.pdb import blocked_pod_uids
+
+        blocked = blocked_pod_uids(self.store.list(ObjectStore.PDBS), self.store.pods())
         for c in command.candidates:
             if is_disruptable(c.state_node, self.clock) is not None:
+                return False
+            if any(uid in blocked for uid in c.state_node.pods):
                 return False
         if command.replacements or any(c.reschedulable_pods for c in command.candidates):
             results, unscheduled = self._simulate(command.candidates)

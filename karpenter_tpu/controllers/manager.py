@@ -54,6 +54,7 @@ class Manager:
         self.pool_health = NodePoolHealth()
         self.disruption.cost_ledger = self.cost
         self._launched_claims: set[str] = set()
+        self._launch_recorded: set[str] = set()  # one ring entry per launch
         self._catalog_by_name: dict = {}
         self._dirty_claims: set[str] = set()
         self._claim_by_pid: dict[str, str] = {}  # provider_id -> claim name
@@ -67,6 +68,10 @@ class Manager:
         self.store.watch(ObjectStore.NODES, self._on_node)
         self.store.watch(ObjectStore.NODECLAIMS, self._on_nodeclaim)
         self.store.watch(ObjectStore.NODEPOOLS, self._on_nodepool)
+        # overlay changes reprice the catalog: drop the price cache
+        self.store.watch(
+            ObjectStore.NODE_OVERLAYS, lambda e, o: self._catalog_by_name.clear()
+        )
 
     def _on_nodepool(self, event: EventType, pool) -> None:
         self._catalog_by_name = {}  # pool changes can reshape the catalog
@@ -122,11 +127,16 @@ class Manager:
             self.cluster.delete_nodeclaim(claim.name)
             self.cluster.clear_nominations_for(claim.name)
             self.cost.remove_claim(claim.nodepool_name, claim.name)
-            if claim.name in self._launched_claims and not claim.conditions.is_true(COND_REGISTERED):
+            if (
+                claim.name in self._launched_claims
+                and claim.name not in self._launch_recorded
+                and not claim.conditions.is_true(COND_REGISTERED)
+            ):
                 # launched but never registered: a failed launch for the
                 # pool-health ring buffer (liveness.go:115)
                 self.pool_health.record(claim.nodepool_name or "", False)
             self._launched_claims.discard(claim.name)
+            self._launch_recorded.discard(claim.name)
             if claim.status.provider_id:
                 self._claim_by_pid.pop(claim.status.provider_id, None)
             # pods that were counting on this claim need a fresh pass
@@ -140,9 +150,15 @@ class Manager:
                 self.cost.set_claim(claim.nodepool_name, claim.name, self._claim_price(claim))
         if claim.conditions.is_true(COND_LAUNCHED):
             self._launched_claims.add(claim.name)
-        if claim.conditions.is_true(COND_REGISTERED) and claim.name in self._launched_claims:
+        # exactly ONE ring entry per launch (tracker.go): success recorded
+        # on the first registration, never again on routine updates
+        if (
+            claim.conditions.is_true(COND_REGISTERED)
+            and claim.name in self._launched_claims
+            and claim.name not in self._launch_recorded
+        ):
             self.pool_health.record(claim.nodepool_name or "", True)
-            self._launched_claims.discard(claim.name)
+            self._launch_recorded.add(claim.name)
         self._dirty_claims.add(claim.name)
 
     # -- the loop ----------------------------------------------------------------

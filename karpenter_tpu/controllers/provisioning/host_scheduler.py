@@ -39,6 +39,7 @@ class SimClaim:
     pods: list[Pod] = field(default_factory=list)
     slot: int = 0
     hostname: str = ""  # placeholder hostname (nodeclaim.go:93)
+    host_ports: list[tuple] = field(default_factory=list)
 
     def cheapest_launch(self) -> tuple[Optional[InstanceType], float]:
         """Cheapest (type, price) among viable types/offerings compatible
@@ -65,6 +66,7 @@ class ExistingSimNode:
     taints: list = field(default_factory=list)
     used: dict[str, float] = field(default_factory=dict)
     pods: list[Pod] = field(default_factory=list)
+    host_ports: list[tuple] = field(default_factory=list)  # (ip, port, proto)
 
     def clone(self) -> "ExistingSimNode":
         """Pristine copy for simulation retries (relaxation loop)."""
@@ -76,6 +78,7 @@ class ExistingSimNode:
             taints=list(self.taints),
             used=dict(self.used),
             pods=list(self.pods),
+            host_ports=list(self.host_ports),
         )
 
 
@@ -146,17 +149,20 @@ class HostScheduler:
         existing_nodes: Optional[list[ExistingSimNode]] = None,
         budgets: Optional[dict[str, dict[str, float]]] = None,
         topology: Optional["Topology"] = None,
+        volume_reqs: Optional[dict] = None,
     ):
         """budgets: nodepool -> remaining resources (limits minus current
         usage; may include the synthetic 'nodes' count). Absent pool =
         unlimited. topology: pre-built Topology (counts seeded from the
-        live cluster); None disables topology handling."""
+        live cluster); None disables topology handling. volume_reqs: pod
+        uid -> PVC-implied zone Requirement."""
         from karpenter_tpu.controllers.provisioning.topology import Topology as _T
 
         self.templates = templates
         self.existing_nodes = existing_nodes or []
         self.budgets = {k: dict(v) for k, v in (budgets or {}).items()}
         self.topology = topology if topology is not None else _T()
+        self.volume_reqs = volume_reqs or {}
         self._hostname_seq = 0
         for node in self.existing_nodes:
             self.topology.register(l.LABEL_HOSTNAME, node.name)
@@ -170,7 +176,11 @@ class HostScheduler:
     def can_add_existing(
         self, node: ExistingSimNode, pod: Pod, pod_reqs: Requirements, strict: Requirements
     ) -> bool:
+        from karpenter_tpu.scheduling import hostports as hp
+
         if tolerates_all(node.taints, pod.spec.tolerations) is not None:
+            return False
+        if hp.conflicts(node.host_ports, pod):
             return False
         total = res.merge(node.used, pod.total_requests())
         if not res.fits(total, node.available):
@@ -186,6 +196,7 @@ class HostScheduler:
         node.requirements = tightened
         node.used = total
         node.pods.append(pod)
+        node.host_ports.extend(hp.port_key(h) for h in pod.spec.host_ports)
         self.topology.record(pod, tightened)
         return True
 
@@ -195,7 +206,11 @@ class HostScheduler:
         """Feasibility of adding pod to claim (nodeclaim.go:124-242);
         returns the updated claim state or None. On success the topology
         counts are recorded — callers must commit the returned claim."""
+        from karpenter_tpu.scheduling import hostports as hp
+
         if tolerates_all(claim.template.taints, pod.spec.tolerations) is not None:
+            return None
+        if hp.conflicts(claim.host_ports, pod):
             return None
         if claim.requirements.compatible(pod_reqs, l.WELL_KNOWN_LABELS) is not None:
             return None
@@ -219,6 +234,7 @@ class HostScheduler:
             pods=claim.pods + [pod],
             slot=claim.slot,
             hostname=claim.hostname,
+            host_ports=claim.host_ports + [hp.port_key(h) for h in pod.spec.host_ports],
         )
 
     def _within_budget(self, tmpl: ClaimTemplate, its: list[InstanceType]) -> list[InstanceType]:
@@ -275,6 +291,8 @@ class HostScheduler:
             self._charge_budget(tmpl, remaining)
             self.topology.register(l.LABEL_HOSTNAME, hostname)
             self.topology.record(pod, tightened)
+            from karpenter_tpu.scheduling import hostports as hp
+
             return SimClaim(
                 template=tmpl,
                 requirements=tightened,
@@ -283,6 +301,7 @@ class HostScheduler:
                 pods=[pod],
                 slot=slot,
                 hostname=hostname,
+                host_ports=[hp.port_key(h) for h in pod.spec.host_ports],
             )
         return None
 
@@ -314,6 +333,9 @@ class HostScheduler:
         existing_assignments: dict[str, str] = {}
         for pod in ffd_sort(pods):
             pod_reqs = Requirements.from_pod(pod)
+            extra = self.volume_reqs.get(pod.uid)
+            if extra is not None:
+                pod_reqs.add(extra)
             strict = Requirements.from_pod(pod, include_preferred=False)
             # tier 1: existing nodes, earliest index wins (scheduler.go:594)
             placed = False

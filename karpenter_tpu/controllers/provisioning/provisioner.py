@@ -89,6 +89,23 @@ class Provisioner:
     def _ready_pools(self) -> list[NodePool]:
         return [p for p in self.store.nodepools() if not p.is_static]
 
+    def _volume_requirements(self, pods: list[Pod]) -> dict:
+        """pod uid -> PVC-implied zone Requirement (volumetopology.go)."""
+        from karpenter_tpu.scheduling.hostports import volume_zone_requirement
+
+        pvcs = {p.name: p for p in self.store.list(self.store.PVCS)}
+        classes = {s.name: s for s in self.store.list(self.store.STORAGE_CLASSES)}
+        if not pvcs:
+            return {}
+        out = {}
+        for pod in pods:
+            if not pod.spec.pvc_names:
+                continue
+            req = volume_zone_requirement(pod, pvcs, classes)
+            if req is not None:
+                out[pod.uid] = req
+        return out
+
     def _bound_pods(self, excluded_nodes: Optional[set[str]] = None) -> list[tuple]:
         """(pod, node labels) for bound pods — seeds topology counts
         (topology.go:361-459 countDomains)."""
@@ -128,6 +145,7 @@ class Provisioner:
             existing,
             self._remaining_budgets(),
             topology_factory=lambda ps: self._build_topology(ps, scheduler, excluded_node_names),
+            volume_reqs=self._volume_requirements(pods),
         )
 
     def _existing_sim_nodes(self, excluded: Optional[set[str]] = None) -> list[ExistingSimNode]:
@@ -315,6 +333,7 @@ class Provisioner:
             self._existing_sim_nodes(),
             self._remaining_budgets(),
             topology_factory=lambda ps: self._build_topology(ps, scheduler),
+            volume_reqs=self._volume_requirements(pods),
         )
         self.create_node_claims(result)
         # nominate pods placed on existing nodes so the kube-scheduler (sim)

@@ -70,6 +70,7 @@ class TPUScheduler:
         self._it_index = {name: i for i, name in enumerate(seen)}
         self.max_claims = max_claims
         self.pod_pad = pod_pad
+        self._volume_reqs: dict = {}
 
         self.encoder = ProblemEncoder()
         for t in templates:
@@ -162,6 +163,7 @@ class TPUScheduler:
                 [True] * len(self.existing_nodes)
                 + [False] * (e_pad - len(self.existing_nodes))
             ),
+            ports=jnp.zeros((e_pad, 1), dtype=bool),  # re-filled per solve
         )
 
     # -- solving -----------------------------------------------------------
@@ -173,6 +175,7 @@ class TPUScheduler:
         budgets: Optional[dict[str, dict[str, float]]] = None,
         topology: Optional[Topology] = None,
         topology_factory=None,
+        volume_reqs: Optional[dict] = None,
     ) -> SchedulingResult:
         """Solve with the preference relaxation ladder (preferences.go:38):
         each failing pod sheds ONE preference per round (shared loop in
@@ -189,6 +192,7 @@ class TPUScheduler:
         from karpenter_tpu.controllers.provisioning import preferences as prefs
 
         base_existing = list(existing_nodes or [])
+        self._volume_reqs = volume_reqs or {}
 
         def solve_round(current: list[Pod]) -> SchedulingResult:
             if topology_factory is not None:
@@ -202,6 +206,16 @@ class TPUScheduler:
             )
 
         return prefs.run_with_relaxation(list(pods), solve_round)
+
+    def _pod_reqs(self, pod: Pod) -> Requirements:
+        """Full pod requirements + PVC-implied zone restriction (volume
+        topology folds into the NODE side via the combine, not into strict
+        requirements, so TSC counting ignores it — volumetopology.go)."""
+        reqs = Requirements.from_pod(pod)
+        extra = self._volume_reqs.get(pod.uid)
+        if extra is not None:
+            reqs.add(extra)
+        return reqs
 
     def _solve_once(
         self,
@@ -228,6 +242,11 @@ class TPUScheduler:
         pods_sorted = ffd_sort(list(pods))
         for p in pods_sorted:
             self.encoder.observe_pod(p)
+            extra = self._volume_reqs.get(p.uid)
+            if extra is not None:
+                self.encoder.vocab.add_key(extra.key)
+                for v in extra.values:
+                    self.encoder.vocab.add_value(extra.key, v)
         for n in self.existing_nodes:
             self.encoder.observe_requirements(n.requirements)
             self.encoder.observe_resources(n.available)
@@ -245,7 +264,7 @@ class TPUScheduler:
         k_pad, v_pad = self._pads()
         pad_pod = Pod()  # zero-request inert pod for padding
         padded = pods_sorted + [pad_pod] * (P_pad - P)
-        pod_req_sets = [Requirements.from_pod(p) for p in padded]
+        pod_req_sets = [self._pod_reqs(p) for p in padded]
         reqs = encode_requirements(
             self.encoder.vocab, pod_req_sets, k_pad, v_pad, self.encoder.skip_keys
         )
@@ -301,12 +320,52 @@ class TPUScheduler:
             for g, t in enumerate(self.templates):
                 tol[i, g] = tolerates_all(t.taints, p.spec.tolerations) is None
 
+        # host-port vocabulary + wildcard-expanded conflict masks
+        from karpenter_tpu.scheduling import hostports as hostports_mod
+
+        port_keys: list[tuple] = []
+        port_index: dict[tuple, int] = {}
+
+        def port_id(key: tuple) -> int:
+            if key not in port_index:
+                port_index[key] = len(port_keys)
+                port_keys.append(key)
+            return port_index[key]
+
+        for n in self.existing_nodes:
+            for key in n.host_ports:
+                port_id(key)
+        for p in padded:
+            for h in p.spec.host_ports:
+                port_id(hostports_mod.port_key(h))
+        NP = max(len(port_keys), 1)
+        pod_ports = np.zeros((P_pad, NP), dtype=bool)
+        pod_port_conf = np.zeros((P_pad, NP), dtype=bool)
+        for i, p in enumerate(padded):
+            for h in p.spec.host_ports:
+                ip, port, proto = hostports_mod.port_key(h)
+                pod_ports[i, port_index[(ip, port, proto)]] = True
+                for j, (jip, jport, jproto) in enumerate(port_keys):
+                    if port == jport and proto == jproto and (
+                        ip == hostports_mod.WILDCARD_IP
+                        or jip == hostports_mod.WILDCARD_IP
+                        or ip == jip
+                    ):
+                        pod_port_conf[i, j] = True
+        exist_ports0 = np.zeros((E, NP), dtype=bool)
+        for e, n in enumerate(self.existing_nodes):
+            for key in n.host_ports:
+                exist_ports0[e, port_index[key]] = True
+        exist_tensors = exist_tensors._replace(ports=jnp.asarray(exist_ports0))
+
         zone_kid, ct_kid = self.encoder.zone_ct_key_ids()
         result = ops_solver.solve(
             pt,
             jnp.asarray(tol),
             jnp.asarray(it_allow),
             jnp.asarray(exist_ok),
+            jnp.asarray(pod_ports),
+            jnp.asarray(pod_port_conf),
             exist_tensors,
             self.it_tensors,
             template_tensors,
@@ -353,7 +412,7 @@ class TPUScheduler:
             if slot < 0:
                 unschedulable.append((pod, "no compatible in-flight claim or template"))
                 continue
-            pod_reqs = Requirements.from_pod(pod)
+            pod_reqs = self._pod_reqs(pod)
             strict = Requirements.from_pod(pod, include_preferred=False)
             if slot < E:
                 # tier 1: existing node (host replay of the commit)
@@ -369,6 +428,9 @@ class TPUScheduler:
                 node.requirements = tightened
                 node.used = res.merge(node.used, pod.total_requests())
                 node.pods.append(pod)
+                from karpenter_tpu.scheduling import hostports as hpmod
+
+                node.host_ports.extend(hpmod.port_key(h) for h in pod.spec.host_ports)
                 topo.record(pod, tightened)
                 existing_assignments[pod.uid] = node.name
                 continue

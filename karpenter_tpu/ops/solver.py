@@ -67,6 +67,7 @@ class ExistingNodes(NamedTuple):
     reqs: ReqSetTensors  # [E, K, V]
     avail: jnp.ndarray  # [E, R] f32 — remaining schedulable resources
     valid: jnp.ndarray  # [E] bool
+    ports: jnp.ndarray  # [E, NP] bool — host ports already in use
 
 
 class SolverState(NamedTuple):
@@ -89,6 +90,9 @@ class SolverState(NamedTuple):
     # topology counts
     vg_counts: jnp.ndarray  # [NGv, V]
     hg_counts: jnp.ndarray  # [NGh, E+N]
+    # host ports in use (hostportusage.go:35-97)
+    exist_ports: jnp.ndarray  # [E, NP] bool
+    claim_ports: jnp.ndarray  # [N, NP] bool
 
 
 class SolveResult(NamedTuple):
@@ -154,6 +158,8 @@ def solve(
     pod_tmpl_ok: jnp.ndarray,  # [P, G] bool — tolerates taints + skipped-key static checks
     pod_it_allow: jnp.ndarray,  # [P, T] bool — instance types the pod's NAME selector admits
     pod_exist_ok: jnp.ndarray,  # [P, E] bool — static checks vs existing nodes
+    pod_ports: jnp.ndarray,  # [P, NP] bool — the pod's own host-port keys
+    pod_port_conf: jnp.ndarray,  # [P, NP] bool — keys the pod CONFLICTS with (wildcard-expanded)
     exist: ExistingNodes,
     it: InstanceTypeTensors,
     templates: Templates,
@@ -180,6 +186,8 @@ def solve(
             tmpl_ok_g,
             it_allow,
             exist_ok_e,
+            ports_p,
+            port_conf_p,
             pod_valid,
             vg_applies,
             vg_records,
@@ -207,8 +215,16 @@ def solve(
         topo_eh = topo_ops.hg_evaluate(
             topo, state.hg_counts, jnp.arange(E, dtype=jnp.int32), hg_applies, hg_self
         )
+        ports_ok_e = ~jnp.any(port_conf_p[None, :] & state.exist_ports, axis=-1)  # [E]
         feas_e = (
-            exist.valid & exist_ok_e & exist_compat & exist_fit & topo_e & topo_eh & pod_valid
+            exist.valid
+            & exist_ok_e
+            & exist_compat
+            & exist_fit
+            & topo_e
+            & topo_eh
+            & ports_ok_e
+            & pod_valid
         )
         pick_e = jnp.argmin(jnp.where(feas_e, jnp.arange(E, dtype=jnp.int32), BIG))
         found_e = jnp.any(feas_e)
@@ -233,12 +249,14 @@ def solve(
         fits_off = _fits_and_offering(total, comb_t, it, zone_kid, ct_kid)
         new_its = state.its & it_compat & fits_off & it_allow[None, :]
         tol = tmpl_ok_g[state.template]
+        ports_ok_n = ~jnp.any(port_conf_p[None, :] & state.claim_ports, axis=-1)  # [N]
         feas = (
             state.open
             & claim_ok
             & tol
             & topo_n
             & topo_nh
+            & ports_ok_n
             & jnp.any(new_its, axis=-1)
             & pod_valid
             & ~found_e
@@ -316,6 +334,11 @@ def solve(
         new_exist_used = jnp.where(
             upd_exist, state.exist_used.at[pick_e].set(total_e[pick_e]), state.exist_used
         )
+        new_exist_ports = jnp.where(
+            upd_exist,
+            state.exist_ports.at[pick_e].set(state.exist_ports[pick_e] | ports_p),
+            state.exist_ports,
+        )
 
         # claim updates (tier 2 or 3)
         upd_claim = (found | can_open) & ~found_e
@@ -352,6 +375,11 @@ def solve(
         )
         new_open = jnp.where(upd_claim, state.open.at[cslot].set(True), state.open)
         new_pods = jnp.where(upd_claim, state.pods.at[cslot].add(1), state.pods)
+        new_claim_ports = jnp.where(
+            upd_claim,
+            state.claim_ports.at[cslot].set(state.claim_ports[cslot] | ports_p),
+            state.claim_ports,
+        )
         opened = can_open & ~found
         new_n_open = state.n_open + jnp.where(opened, 1, 0).astype(jnp.int32)
 
@@ -383,6 +411,8 @@ def solve(
                 nodes_budget=new_nodes_budget,
                 vg_counts=new_vg_counts,
                 hg_counts=new_hg_counts,
+                exist_ports=new_exist_ports,
+                claim_ports=new_claim_ports,
             ),
             assignment,
         )
@@ -401,6 +431,8 @@ def solve(
         nodes_budget=templates.nodes_budget,
         vg_counts=topo.vg_counts0,
         hg_counts=topo.hg_counts0,
+        exist_ports=exist.ports,
+        claim_ports=jnp.zeros((N, pod_ports.shape[1]), dtype=bool),
     )
     xs = (
         pods.reqs,
@@ -408,6 +440,8 @@ def solve(
         pod_tmpl_ok,
         pod_it_allow,
         pod_exist_ok,
+        pod_ports,
+        pod_port_conf,
         pods.valid,
         pod_topo.vg_applies,
         pod_topo.vg_records,

@@ -93,6 +93,8 @@ def sharded_solve(
     pod_tol,
     pod_it_allow,
     pod_exist_ok,
+    pod_ports,
+    pod_port_conf,
     exist,
     it_sharded: InstanceTypeTensors,
     templates,
@@ -119,6 +121,8 @@ def sharded_solve(
         pod_tol,
         allow,
         pod_exist_ok,
+        pod_ports,
+        pod_port_conf,
         exist,
         it_sharded,
         tmpl,

@@ -135,6 +135,8 @@ class ObjectStore:
     DAEMONSETS = "daemonsets"
     NODE_OVERLAYS = "nodeoverlays"
     PDBS = "poddisruptionbudgets"
+    PVCS = "persistentvolumeclaims"
+    STORAGE_CLASSES = "storageclasses"
 
     def pods(self) -> list:
         return self.list(self.PODS)

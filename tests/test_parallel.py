@@ -31,26 +31,16 @@ def test_sharded_solve_matches_unsharded():
     import __graft_entry__ as ge
 
     fn, args, meta = ge._build_entry(n_pods=32, n_types=12)
-    (pt, tol, it_allow, exist_ok, exist, it, templates, well_known, topo, pod_topo) = args
+    it = args[7]  # InstanceTypeTensors position in the solve signature
     ref = jax.jit(fn)(*args)
     ref_assignment = np.asarray(ref.assignment)
 
     mesh = make_mesh(8)
     with mesh:
         it_sharded = shard_instance_types(it, mesh)
-        out = sharded_solve(
-            pt,
-            tol,
-            it_allow,
-            exist_ok,
-            exist,
-            it_sharded,
-            templates,
-            well_known,
-            topo,
-            pod_topo,
-            **meta,
-        )
+        sharded_args = list(args)
+        sharded_args[7] = it_sharded
+        out = sharded_solve(*sharded_args, **meta)
         out_assignment = np.asarray(out.assignment)
 
     np.testing.assert_array_equal(ref_assignment, out_assignment)

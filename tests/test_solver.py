@@ -511,6 +511,95 @@ class TestTopologyDifferential:
         assert_same_packing(host, tpu)
 
 
+class TestHostPortsAndVolumes:
+    def test_hostport_conflict_separates_pods(self):
+        from karpenter_tpu.models.pod import HostPort
+
+        pods = []
+        for i in range(3):
+            p = make_pod(f"hp-{i}", cpu=0.25)
+            p.spec.host_ports = [HostPort(port=8080)]
+            pods.append(p)
+        templates = build_templates([(default_pool(), instance_types(64))])
+        host = HostScheduler(templates).solve(pods)
+        tpu = TPUScheduler(templates).solve(pods)
+        assert_same_packing(host, tpu)
+        assert not tpu.unschedulable
+        # one 8080 per node
+        assert len(tpu.claims) == 3
+
+    def test_wildcard_ip_conflicts_with_specific(self):
+        from karpenter_tpu.models.pod import HostPort
+
+        a = make_pod("a", cpu=0.25)
+        a.spec.host_ports = [HostPort(port=53, host_ip="0.0.0.0")]
+        b = make_pod("b", cpu=0.25)
+        b.spec.host_ports = [HostPort(port=53, host_ip="10.0.0.1")]
+        c = make_pod("c", cpu=0.25)
+        c.spec.host_ports = [HostPort(port=53, protocol="UDP")]  # different proto: no conflict
+        templates = build_templates([(default_pool(), instance_types(64))])
+        host = HostScheduler(templates).solve([a, b, c])
+        tpu = TPUScheduler(templates).solve([a, b, c])
+        assert_same_packing(host, tpu)
+        assert len(tpu.claims) == 2  # a and b separated; c shares with one
+
+    def test_hostport_vs_existing_node(self):
+        from karpenter_tpu.models.pod import HostPort
+
+        templates = build_templates([(default_pool(), instance_types(16))])
+        pod = make_pod("p", cpu=0.25)
+        pod.spec.host_ports = [HostPort(port=443)]
+
+        def factory():
+            n = make_existing("node-a", 0)
+            n.host_ports = [("0.0.0.0", 443, "TCP")]
+            return [n]
+
+        host = HostScheduler(templates, existing_nodes=factory()).solve([pod])
+        tpu = TPUScheduler(templates).solve([pod], factory())
+        assert_same_packing(host, tpu)
+        assert not host.existing_assignments  # port taken on the node
+        assert host.node_count == 1
+
+    def test_volume_zone_requirement(self):
+        from karpenter_tpu.scheduling import Requirement
+        from karpenter_tpu.scheduling.hostports import (
+            PersistentVolumeClaim,
+            StorageClass,
+            volume_zone_requirement,
+        )
+
+        pod = make_pod("p", cpu=0.25)
+        pod.spec.pvc_names = ["data"]
+        pvc = PersistentVolumeClaim(storage_class="zonal")
+        pvc.metadata.name = "data"
+        sc = StorageClass(zones=["test-zone-2"])
+        sc.metadata.name = "zonal"
+        req = volume_zone_requirement(pod, {"data": pvc}, {"zonal": sc})
+        assert sorted(req.values) == ["test-zone-2"]
+
+        templates = build_templates([(default_pool(), instance_types(16))])
+        vol = {pod.uid: req}
+        host = HostScheduler(templates, volume_reqs=vol).solve([pod])
+        tpu = TPUScheduler(templates).solve([pod], volume_reqs=vol)
+        assert_same_packing(host, tpu)
+        for c in tpu.claims:
+            assert sorted(c.requirements.get(l.LABEL_TOPOLOGY_ZONE).values) == ["test-zone-2"]
+
+    def test_bound_pvc_pins_zone(self):
+        from karpenter_tpu.scheduling.hostports import (
+            PersistentVolumeClaim,
+            volume_zone_requirement,
+        )
+
+        pod = make_pod("p")
+        pod.spec.pvc_names = ["data"]
+        pvc = PersistentVolumeClaim(bound_zone="test-zone-3")
+        pvc.metadata.name = "data"
+        req = volume_zone_requirement(pod, {"data": pvc}, {})
+        assert sorted(req.values) == ["test-zone-3"]
+
+
 class TestPackingQuality:
     def test_bin_utilization(self):
         """Packing must fill nodes densely. instance_types(64) spans cpu
