@@ -52,9 +52,14 @@ class OrchestrationQueue:
         # 2. create replacement NodeClaims, nominating their pods so the
         # provisioner doesn't double-provision for them (provisioner.go
         # create_node_claims parity)
+        from karpenter_tpu.utils import metrics
+
         replacement_names = []
         for sim in command.replacements:
             claim = self.provisioner._to_node_claim(sim)
+            metrics.NODECLAIMS_CREATED.inc(
+                reason=command.reason, nodepool=sim.template.nodepool_name
+            )
             self.store.create(ObjectStore.NODECLAIMS, claim)
             self.cluster.update_nodeclaim(claim)
             for pod in sim.pods:
@@ -99,10 +104,26 @@ class OrchestrationQueue:
                 return "rolled-back"
             return "wait"
         # replacements ready: delete the candidates (graceful; the
-        # termination flow drains and the lifecycle finalizer fires)
+        # termination flow drains and the lifecycle finalizer fires).
+        # Disruption metrics count HERE — an aborted command must not be
+        # recorded as a disruption (queue.go:247-248)
+        from karpenter_tpu.utils import metrics
+
         for c in item.command.candidates:
             claim = c.state_node.node_claim
             if claim is not None and self.store.get(ObjectStore.NODECLAIMS, claim.name) is not None:
+                metrics.NODECLAIMS_DISRUPTED.inc(
+                    reason=item.command.reason, nodepool=c.nodepool.name
+                )
+                metrics.PODS_DISRUPTION_INITIATED.inc(
+                    float(len(c.reschedulable_pods)), nodepool=c.nodepool.name
+                )
+                claim.metadata.annotations[l.NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION_KEY] = str(
+                    self.clock.now()
+                )
+                claim.metadata.annotations["karpenter.sh/termination-reason"] = (
+                    item.command.reason.lower()
+                )
                 self.store.delete(ObjectStore.NODECLAIMS, claim.name)
         return "done"
 

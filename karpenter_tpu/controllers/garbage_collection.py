@@ -80,6 +80,7 @@ class ExpirationController:
             if after is None or claim.metadata.deleting:
                 continue
             if self.clock.now() - claim.metadata.creation_timestamp >= after:
+                claim.metadata.annotations["karpenter.sh/termination-reason"] = "expired"
                 self.store.delete(ObjectStore.NODECLAIMS, claim.name)
                 expired += 1
         return expired
@@ -155,6 +156,7 @@ class NodeHealthController:
                 continue
             claim = claim_by_pid.get(node.spec.provider_id)
             if claim is not None:
+                claim.metadata.annotations["karpenter.sh/termination-reason"] = "unhealthy"
                 self.store.delete(ObjectStore.NODECLAIMS, claim.name)
                 self.clear(node.name)
                 repaired += 1

@@ -202,13 +202,36 @@ class Manager:
     def run_maintenance(self) -> dict:
         """One pass of the periodic housekeeping controllers (GC,
         expiration, health), then drain resulting work."""
+        from karpenter_tpu.controllers.status_controllers import (
+            ConsistencyController,
+            NodePoolStatusController,
+        )
+
         out = {
             "expired": self.expiration.reconcile(),
             "garbage_collected": self.garbage_collection.reconcile(),
             "repaired": self.health.reconcile(),
             "static_delta": self.static_capacity.reconcile(),
+            "inconsistent": ConsistencyController(self.store, self.clock).reconcile(),
         }
         self.run_until_idle()
+        # nodepool usage/limit gauges (controllers/metrics/nodepool analog):
+        # the status controller just computed usage into pool.status; clear
+        # the whole family first so series for vanished pools/resources
+        # don't linger at stale values
+        NodePoolStatusController(self.store, self.cluster, self.clock).reconcile()
+        from karpenter_tpu.utils import metrics
+
+        metrics.NODEPOOL_USAGE.values.clear()
+        metrics.NODEPOOL_LIMIT.values.clear()
+        for pool in self.store.nodepools():
+            for resource, value in pool.status.resources.items():
+                metrics.NODEPOOL_USAGE.set(value, nodepool=pool.name, resource_type=resource)
+            if pool.spec.limits is not None:
+                for resource, value in pool.spec.limits.resources.items():
+                    metrics.NODEPOOL_LIMIT.set(
+                        value, nodepool=pool.name, resource_type=resource
+                    )
         return out
 
     def mark_drift(self) -> int:

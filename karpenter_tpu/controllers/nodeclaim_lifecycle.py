@@ -130,6 +130,15 @@ class NodeClaimLifecycleController:
     # -- finalize (controller.go:198) -------------------------------------------
 
     def _finalize(self, claim: NodeClaim) -> None:
+        from karpenter_tpu.models import labels as labels_mod
+        from karpenter_tpu.utils import metrics
+
+        metrics.NODECLAIMS_TERMINATED.inc(
+            reason=claim.metadata.annotations.get(
+                "karpenter.sh/termination-reason", "deleted"
+            ),
+            nodepool=claim.metadata.labels.get(labels_mod.NODEPOOL_LABEL_KEY, ""),
+        )
         # drain first: taint + evict pods so they reschedule (the node
         # termination flow, termination/controller.go:93-191)
         node = self._node_for(claim)

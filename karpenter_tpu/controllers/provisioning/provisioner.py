@@ -257,9 +257,14 @@ class Provisioner:
     # -- claim creation (provisioner.go:169-221, :460-506) -----------------------
 
     def create_node_claims(self, result: SchedulingResult) -> list[NodeClaim]:
+        from karpenter_tpu.utils import metrics
+
         created = []
         for sim in result.claims:
             claim = self._to_node_claim(sim)
+            metrics.NODECLAIMS_CREATED.inc(
+                reason="provisioning", nodepool=sim.template.nodepool_name
+            )
             self.store.create(ObjectStore.NODECLAIMS, claim)
             # state-ahead-of-cache update (provisioner.go:501-506)
             self.cluster.update_nodeclaim(claim)
@@ -328,13 +333,17 @@ class Provisioner:
         scheduler = self._build_scheduler()
         if scheduler is None:
             return self.GATED
-        result = scheduler.solve(
-            pods,
-            self._existing_sim_nodes(),
-            self._remaining_budgets(),
-            topology_factory=lambda ps: self._build_topology(ps, scheduler),
-            volume_reqs=self._volume_requirements(pods),
-        )
+        from karpenter_tpu.utils import metrics
+
+        with metrics.SCHEDULING_DURATION.time():
+            result = scheduler.solve(
+                pods,
+                self._existing_sim_nodes(),
+                self._remaining_budgets(),
+                topology_factory=lambda ps: self._build_topology(ps, scheduler),
+                volume_reqs=self._volume_requirements(pods),
+            )
+        metrics.SCHEDULING_UNSCHEDULABLE.set(float(len(result.unschedulable)))
         self.create_node_claims(result)
         # nominate pods placed on existing nodes so the kube-scheduler (sim)
         # binds them and the next pass doesn't re-provision

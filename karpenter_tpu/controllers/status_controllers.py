@@ -1,0 +1,81 @@
+"""Small status reconcilers: claim consistency + NodePool status.
+
+Counterparts of reference pkg/controllers/nodeclaim/consistency
+(ConsistentStateFound on claim/node capacity mismatch) and
+pkg/controllers/nodepool/{counter,readiness,hash} (usage into
+status.resources, Ready condition, drift-hash annotation).
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodeclaim import COND_CONSISTENT_STATE_FOUND, COND_REGISTERED
+from karpenter_tpu.models.nodepool import (
+    CONDITION_NODECLASS_READY,
+    CONDITION_READY,
+    NODEPOOL_HASH_VERSION,
+)
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.store import ObjectStore
+from karpenter_tpu.utils.clock import Clock
+
+CAPACITY_TOLERANCE = 0.10  # relative mismatch that flags inconsistency
+
+
+class ConsistencyController:
+    """Detects claim<->node capacity drift (consistency/controller.go)."""
+
+    def __init__(self, store: ObjectStore, clock: Clock):
+        self.store = store
+        self.clock = clock
+
+    def reconcile(self) -> int:
+        flagged = 0
+        for claim in self.store.nodeclaims():
+            if not claim.conditions.is_true(COND_REGISTERED):
+                continue
+            node = self.store.node_by_provider_id(claim.status.provider_id)
+            if node is None:
+                continue
+            consistent = True
+            for resource, expected in claim.status.capacity.items():
+                actual = node.status.capacity.get(resource, 0.0)
+                if expected <= 0:
+                    continue
+                if abs(actual - expected) / expected > CAPACITY_TOLERANCE:
+                    consistent = False
+                    break
+            if consistent:
+                claim.conditions.set_true(
+                    COND_CONSISTENT_STATE_FOUND, "Consistent", now=self.clock.now()
+                )
+            else:
+                claim.conditions.set_false(
+                    COND_CONSISTENT_STATE_FOUND, "CapacityMismatch", now=self.clock.now()
+                )
+                flagged += 1
+        return flagged
+
+
+class NodePoolStatusController:
+    """Usage into status.resources + Ready condition + hash annotation
+    (nodepool/{counter,readiness,hash})."""
+
+    def __init__(self, store: ObjectStore, cluster: Cluster, clock: Clock):
+        self.store = store
+        self.cluster = cluster
+        self.clock = clock
+
+    def reconcile(self) -> None:
+        for pool in self.store.nodepools():
+            usage = self.cluster.nodepool_usage(pool.name)
+            pool.status.resources = usage
+            pool.status.node_count = int(usage.get("nodes", 0))
+            # the harness has no NodeClass objects: class readiness is
+            # vacuously true, making the pool Ready
+            pool.conditions.set_true(CONDITION_NODECLASS_READY, "NoNodeClass", now=self.clock.now())
+            pool.conditions.set_true(CONDITION_READY, "Ready", now=self.clock.now())
+            pool.metadata.annotations[l.NODEPOOL_HASH_ANNOTATION_KEY] = pool.static_hash()
+            pool.metadata.annotations[l.NODEPOOL_HASH_VERSION_ANNOTATION_KEY] = (
+                NODEPOOL_HASH_VERSION
+            )

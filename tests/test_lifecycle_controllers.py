@@ -150,6 +150,33 @@ class TestExpiration:
         assert mgr.run_maintenance()["expired"] == 0
 
 
+class TestStatusControllers:
+    def test_consistency_flags_capacity_mismatch(self):
+        clock, store, cloud, mgr = build_env()
+        provision(mgr, store, cloud, [make_pod("p", cpu=0.5)])
+        out = mgr.run_maintenance()
+        assert out["inconsistent"] == 0
+        claim = store.nodeclaims()[0]
+        assert claim.conditions.is_true("ConsistentStateFound")
+        node = store.nodes()[0]
+        node.status.capacity["cpu"] = node.status.capacity["cpu"] * 2  # cloud lied
+        out = mgr.run_maintenance()
+        assert out["inconsistent"] == 1
+        assert not store.nodeclaims()[0].conditions.is_true("ConsistentStateFound")
+
+    def test_nodepool_status_updated(self):
+        clock, store, cloud, mgr = build_env()
+        provision(mgr, store, cloud, [make_pod("p", cpu=0.5)])
+        mgr.run_maintenance()
+        pool = store.get(ObjectStore.NODEPOOLS, "default")
+        assert pool.status.node_count == 1
+        assert pool.status.resources.get("cpu", 0) > 0
+        assert pool.conditions.is_true("Ready")
+        assert pool.metadata.annotations.get(
+            "karpenter.sh/nodepool-hash"
+        ) == pool.static_hash()
+
+
 class TestNodeRepair:
     def _policies(self, cloud):
         cloud._repair_policies = [

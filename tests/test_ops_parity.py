@@ -80,6 +80,31 @@ class TestOptions:
         assert not opts.feature_gates.spot_to_spot_consolidation
 
 
+class TestMetricsWiring:
+    def test_provisioning_and_disruption_emit_metrics(self):
+        from karpenter_tpu.utils import metrics
+
+        clock, store, cloud, mgr = build_env()
+        pool = NodePool()
+        pool.metadata.name = "default"
+        from karpenter_tpu.models.nodepool import Budget
+
+        pool.spec.disruption.budgets = [Budget(nodes="100%")]
+        store.create(ObjectStore.NODEPOOLS, pool)
+        before = metrics.NODECLAIMS_CREATED.get(reason="provisioning", nodepool="default")
+        store.create(ObjectStore.PODS, make_pod("p", cpu=1.0))
+        mgr.run_until_idle()
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        KubeSchedulerSim(store, mgr.cluster).bind_pending()
+        assert metrics.NODECLAIMS_CREATED.get(reason="provisioning", nodepool="default") > before
+        assert metrics.SCHEDULING_DURATION.totals[()] > 0
+        mgr.run_maintenance()
+        assert metrics.NODEPOOL_USAGE.get(nodepool="default", resource_type="nodes") >= 1.0
+        exposition = metrics.REGISTRY.expose()
+        assert "karpenter_nodeclaims_created_total" in exposition
+
+
 class TestStaticCapacity:
     def test_scale_up_to_replicas(self):
         clock, store, cloud, mgr = build_env()
