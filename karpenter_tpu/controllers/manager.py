@@ -18,8 +18,11 @@ from karpenter_tpu.controllers.nodeclaim_lifecycle import NodeClaimLifecycleCont
 from karpenter_tpu.controllers.provisioning.batcher import Batcher
 from karpenter_tpu.controllers.provisioning.provisioner import Provisioner
 from karpenter_tpu.models import labels as l
+from karpenter_tpu.scheduling import Requirements
+from karpenter_tpu.scheduling.taints import tolerates_all
 from karpenter_tpu.state.cluster import Cluster
 from karpenter_tpu.state.store import EventType, ObjectStore
+from karpenter_tpu.utils import resources as res
 from karpenter_tpu.utils.clock import Clock
 
 
@@ -261,35 +264,63 @@ class Manager:
 class KubeSchedulerSim:
     """Minimal kube-scheduler stand-in for the e2e harness: binds pending
     pods to Ready, registered, untainted-compatible nodes (the reference
-    relies on the real kube-scheduler + KWOK for this)."""
+    relies on the real kube-scheduler + KWOK for this).
+
+    Nominated pods bind to their nominated target first — the solver's
+    topology-aware placement must not be scrambled by greedy first-fit
+    (the real kube-scheduler re-evaluates TSC itself; this sim trusts the
+    solver's decision instead)."""
 
     def __init__(self, store: ObjectStore, cluster: Cluster):
         self.store = store
         self.cluster = cluster
 
-    def bind_pending(self) -> int:
-        from karpenter_tpu.models import labels as l  # noqa: F811
-        from karpenter_tpu.scheduling import Requirements
-        from karpenter_tpu.scheduling.taints import tolerates_all
-        from karpenter_tpu.utils import resources as res
+    def _bindable(self, sn, pod, pod_reqs) -> bool:
+        node = sn.node
+        if node is None or not node.status.ready or sn.marked_for_deletion:
+            return False
+        if tolerates_all(node.spec.taints, pod.spec.tolerations) is not None:
+            return False
+        node_reqs = Requirements.from_labels(node.metadata.labels)
+        if node_reqs.compatible(pod_reqs, l.WELL_KNOWN_LABELS) is not None:
+            return False
+        return res.fits(pod.total_requests(), sn.available())
 
+    def _node_for_target(self, target: str):
+        """A nomination target is a node name or a claim name."""
+        sn = self.cluster.node_by_name(target)
+        if sn is not None and sn.node is not None:
+            return sn
+        claim = self.store.get(ObjectStore.NODECLAIMS, target)
+        if claim is not None and claim.status.node_name:
+            return self.cluster.node_by_name(claim.status.node_name)
+        return None
+
+    def bind_pending(self) -> int:
         bound = 0
         for pod in self.store.pods():
             if not pod.is_pending():
                 continue
             pod_reqs = Requirements.from_pod(pod)
+            # nominated target first
+            target = self.cluster.pod_nomination(pod.uid)
+            if target is not None:
+                sn = self._node_for_target(target)
+                if sn is not None and self._bindable(sn, pod, pod_reqs):
+                    self.store.bind_pod(pod.name, sn.node.name)
+                    bound += 1
+                    continue
+                continue  # target not ready yet: wait instead of scrambling
+            # greedy fallback must not consume capacity OTHER pods' live
+            # nominations reserved
+            reserved = self.cluster.nomination_targets()
             for sn in self.cluster.nodes():
-                node = sn.node
-                if node is None or not node.status.ready or sn.marked_for_deletion:
+                if sn.name in reserved or (
+                    sn.node_claim is not None and sn.node_claim.name in reserved
+                ):
                     continue
-                if tolerates_all(node.spec.taints, pod.spec.tolerations) is not None:
-                    continue
-                node_reqs = Requirements.from_labels(node.metadata.labels)
-                if node_reqs.compatible(pod_reqs, l.WELL_KNOWN_LABELS) is not None:
-                    continue
-                if not res.fits(pod.total_requests(), sn.available()):
-                    continue
-                self.store.bind_pod(pod.name, node.name)
-                bound += 1
-                break
+                if self._bindable(sn, pod, pod_reqs):
+                    self.store.bind_pod(pod.name, sn.node.name)
+                    bound += 1
+                    break
         return bound

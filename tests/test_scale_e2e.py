@@ -1,0 +1,137 @@
+"""Scale e2e: a mixed workload (the reference benchmark's pod-family mix)
+through the full kwok harness — provisioning, binding, and a consolidation
+cycle — verifying global invariants rather than exact placements."""
+
+import numpy as np
+
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.controllers.manager import KubeSchedulerSim, Manager
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodepool import Budget, NodePool
+from karpenter_tpu.models.pod import PodAffinityTerm, TopologySpreadConstraint, make_pod
+from karpenter_tpu.state.store import ObjectStore
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def mixed_pods(n, rng):
+    pods = []
+    for i in range(n):
+        p = make_pod(
+            f"mix-{i}",
+            cpu=float(rng.choice([0.25, 0.5, 1.0, 2.0])),
+            memory=f"{rng.choice([0.5, 1.0, 2.0])}Gi",
+        )
+        kind = i % 5
+        if kind == 1:
+            p.metadata.labels = {"spread": "zonal"}
+            p.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=l.LABEL_TOPOLOGY_ZONE,
+                    label_selector={"spread": "zonal"},
+                )
+            ]
+        elif kind == 2:
+            p.metadata.labels = {"spread": "host"}
+            p.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=2,
+                    topology_key=l.LABEL_HOSTNAME,
+                    label_selector={"spread": "host"},
+                )
+            ]
+        elif kind == 3:
+            p.metadata.labels = {"aff": "group"}
+            p.spec.pod_affinity = [
+                PodAffinityTerm(topology_key=l.LABEL_TOPOLOGY_ZONE, label_selector={"aff": "group"})
+            ]
+        elif kind == 4:
+            p.metadata.labels = {"anti": "self"}
+            p.spec.pod_anti_affinity = [
+                PodAffinityTerm(topology_key=l.LABEL_HOSTNAME, label_selector={"anti": "self"})
+            ]
+        pods.append(p)
+    return pods
+
+
+def test_mixed_workload_full_cycle():
+    clock = FakeClock()
+    store = ObjectStore(clock)
+    cloud = KwokCloudProvider(store, catalog=instance_types(100))
+    mgr = Manager(store, cloud, clock)
+    pool = NodePool()
+    pool.metadata.name = "default"
+    pool.spec.disruption.budgets = [Budget(nodes="100%")]
+    store.create(ObjectStore.NODEPOOLS, pool)
+
+    rng = np.random.default_rng(5)
+    pods = mixed_pods(300, rng)
+    for p in pods:
+        store.create(ObjectStore.PODS, p)
+
+    # provision + register + bind until converged (multi-pass: affinity
+    # groups may need a second batch once zones collapse)
+    for _ in range(6):
+        mgr.run_until_idle()
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        KubeSchedulerSim(store, mgr.cluster).bind_pending()
+        if all(p.spec.node_name for p in store.pods()):
+            break
+        mgr.batcher.trigger()
+        clock.step(2.0)
+
+    bound = [p for p in store.pods() if p.spec.node_name]
+    assert len(bound) == 300, f"only {len(bound)}/300 pods bound"
+
+    # invariant: zonal spread within skew over the spread-labeled pods
+    zone_counts = {}
+    node_zone = {n.name: n.metadata.labels[l.LABEL_TOPOLOGY_ZONE] for n in store.nodes()}
+    for p in store.pods():
+        if p.metadata.labels.get("spread") == "zonal":
+            z = node_zone[p.spec.node_name]
+            zone_counts[z] = zone_counts.get(z, 0) + 1
+    assert zone_counts and max(zone_counts.values()) - min(zone_counts.values()) <= 1
+
+    # invariant: hostname anti-affinity holds — one anti pod per node
+    per_node = {}
+    for p in store.pods():
+        if p.metadata.labels.get("anti") == "self":
+            per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
+    assert per_node and max(per_node.values()) == 1
+
+    # invariant: zone affinity pods co-located in one zone
+    aff_zones = {
+        node_zone[p.spec.node_name]
+        for p in store.pods()
+        if p.metadata.labels.get("aff") == "group"
+    }
+    assert len(aff_zones) == 1
+
+    # shrink the workload and run disruption cycles: capacity must drop
+    # while every surviving pod stays bound after settling
+    survivors = {f"mix-{i}" for i in range(60)}
+    for pod in list(store.pods()):
+        if pod.name not in survivors:
+            pod.status.phase = "Succeeded"
+            store.update(ObjectStore.PODS, pod)
+            store.delete(ObjectStore.PODS, pod.name)
+    mgr.run_until_idle()
+    cpu_before = sum(n.status.capacity["cpu"] for n in store.nodes())
+    clock.step(60.0)
+    for _ in range(10):
+        mgr.run_disruption_once()
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        KubeSchedulerSim(store, mgr.cluster).bind_pending()
+        clock.step(20.0)
+    cpu_after = sum(n.status.capacity["cpu"] for n in store.nodes())
+    assert cpu_after < cpu_before, "no capacity reclaimed"
+    for _ in range(4):
+        mgr.run_until_idle()
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        KubeSchedulerSim(store, mgr.cluster).bind_pending()
+    unbound = [p.name for p in store.pods() if not p.spec.node_name]
+    assert not unbound, f"pods stranded after consolidation: {unbound}"
