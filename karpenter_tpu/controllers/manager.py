@@ -27,13 +27,32 @@ from karpenter_tpu.utils.clock import Clock
 
 
 class Manager:
-    def __init__(self, store: ObjectStore, cloud: CloudProvider, clock: Optional[Clock] = None):
+    def __init__(
+        self,
+        store: ObjectStore,
+        cloud: CloudProvider,
+        clock: Optional[Clock] = None,
+        options=None,
+    ):
+        from karpenter_tpu.utils.options import Options
+
         self.store = store
         self.cloud = cloud
         self.clock = clock or store.clock
+        self.options = options or Options()
         self.cluster = Cluster(self.clock)
-        self.batcher = Batcher(self.clock)
-        self.provisioner = Provisioner(store, self.cluster, cloud, self.clock)
+        self.batcher = Batcher(
+            self.clock,
+            idle=self.options.batch_idle_seconds,
+            max_duration=self.options.batch_max_seconds,
+        )
+        self.provisioner = Provisioner(
+            store,
+            self.cluster,
+            cloud,
+            self.clock,
+            ignore_preferences=self.options.preference_policy == "Ignore",
+        )
         self.lifecycle = NodeClaimLifecycleController(store, cloud, self.clock)
         self.nodeclaim_disruption = NodeClaimDisruptionController(store, cloud, self.clock)
         from karpenter_tpu.controllers.disruption import DisruptionController
@@ -44,7 +63,13 @@ class Manager:
         )
 
         self.disruption = DisruptionController(
-            store, self.cluster, self.provisioner, cloud, self.clock, cost_ledger=None
+            store,
+            self.cluster,
+            self.provisioner,
+            cloud,
+            self.clock,
+            spot_to_spot_enabled=self.options.feature_gates.spot_to_spot_consolidation,
+            cost_ledger=None,
         )
         self.garbage_collection = GarbageCollectionController(store, cloud, self.clock)
         self.expiration = ExpirationController(store, self.clock)

@@ -33,6 +33,24 @@ RUNG_SOFT_TSC = "schedule-anyway-tsc"
 RUNG_TOLERATE = "tolerate-prefer-no-schedule"
 
 
+def strip_preferences(pod: Pod) -> Pod:
+    """PreferencePolicy=Ignore (options.go:33-45): drop preferred node
+    affinity, preferred pod (anti)affinity and ScheduleAnyway spread
+    constraints up front — required OR terms and tolerations untouched."""
+    relaxed = copy.copy(pod)
+    relaxed.spec = copy.deepcopy(pod.spec)
+    if relaxed.spec.node_affinity is not None:
+        relaxed.spec.node_affinity.preferred = []
+    relaxed.spec.preferred_pod_affinity = []
+    relaxed.spec.preferred_pod_anti_affinity = []
+    relaxed.spec.topology_spread_constraints = [
+        t
+        for t in relaxed.spec.topology_spread_constraints
+        if t.when_unsatisfiable != "ScheduleAnyway"
+    ]
+    return relaxed
+
+
 def rungs(pod: Pod) -> list[str]:
     """The pod-specific ladder in reference order; each entry removes one
     preference."""

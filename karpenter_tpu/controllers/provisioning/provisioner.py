@@ -39,11 +39,13 @@ class Provisioner:
         cluster: Cluster,
         cloud: CloudProvider,
         clock: Clock,
+        ignore_preferences: bool = False,
     ):
         self.store = store
         self.cluster = cluster
         self.cloud = cloud
         self.clock = clock
+        self.ignore_preferences = ignore_preferences  # PreferencePolicy=Ignore
         self._scheduler_cache: Optional[tuple[tuple, TPUScheduler]] = None
         self._buffer_pods: dict[tuple[str, int], list[Pod]] = {}
 
@@ -61,6 +63,10 @@ class Provisioner:
         pods.extend(
             p for p in self._virtual_buffer_pods() if self.cluster.pod_nomination(p.uid) is None
         )
+        if self.ignore_preferences:
+            from karpenter_tpu.controllers.provisioning.preferences import strip_preferences
+
+            pods = [strip_preferences(p) for p in pods]
         return pods
 
     def _virtual_buffer_pods(self) -> list[Pod]:

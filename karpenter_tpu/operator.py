@@ -1,0 +1,148 @@
+"""The operator runtime: wiring + the steady-state loop.
+
+Counterpart of reference pkg/operator + kwok/main.go:29-50: construct the
+store, cloud provider (with the overlay decorator), controller manager and
+the periodic loops, then run. Single process, no leader election — the
+solver is stateless so HA is a deployment concern, not a code one
+(SURVEY.md §2.9).
+
+`python -m karpenter_tpu.operator` runs a self-contained kwok demo:
+provisions a workload, prints the metrics exposition, consolidates after
+the workload shrinks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+# NOTE: jax-touching modules (manager -> scheduler -> solver) are imported
+# lazily inside Operator.new so entry points can guard accelerator init
+# first (a hung TPU tunnel would otherwise stall at import time).
+from karpenter_tpu.state.store import ObjectStore
+from karpenter_tpu.utils.clock import Clock, FakeClock
+from karpenter_tpu.utils.options import Options
+
+
+@dataclass
+class Operator:
+    """Everything a provider binary wires together (operator.go:126).
+
+    Option consumption status: batch windows, the spot-to-spot gate and
+    the preference policy are wired; min_values_policy and the solve/poll
+    timeouts land with the in-solve minValues work (STATUS.md round-2).
+    """
+
+    store: ObjectStore
+    cloud: object
+    manager: object
+    options: Options = field(default_factory=Options)
+
+    @staticmethod
+    def new(
+        clock: Optional[Clock] = None,
+        catalog=None,
+        options: Optional[Options] = None,
+    ) -> "Operator":
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.cloudprovider.overlay import OverlayCloudProvider
+        from karpenter_tpu.controllers.manager import Manager
+
+        clock = clock or Clock()
+        options = options or Options.from_env()
+        store = ObjectStore(clock)
+        inner = KwokCloudProvider(store, catalog=catalog)
+        cloud = OverlayCloudProvider(inner, store)
+        manager = Manager(store, cloud, clock, options=options)
+        return Operator(store=store, cloud=cloud, manager=manager, options=options)
+
+    def tick(self) -> None:
+        """One steady-state iteration: reconcile work, a disruption poll,
+        housekeeping, and harness binding."""
+        from karpenter_tpu.controllers.manager import KubeSchedulerSim
+
+        self.manager.run_until_idle()
+        self.manager.run_disruption_once()
+        self.manager.run_maintenance()
+        KubeSchedulerSim(self.store, self.manager.cluster).bind_pending()
+
+
+def _demo() -> None:
+    from karpenter_tpu.models.nodepool import Budget, NodePool
+    from karpenter_tpu.models.pod import make_pod
+    from karpenter_tpu.utils import metrics
+
+    from karpenter_tpu.models import labels as l
+
+    clock = FakeClock()
+    op = Operator.new(clock=clock)
+    pool = NodePool()
+    pool.metadata.name = "default"
+    pool.spec.disruption.budgets = [Budget(nodes="100%")]
+    # on-demand so consolidation may replace (spot->spot is gated off)
+    pool.spec.template.spec.requirements = [
+        {
+            "key": l.CAPACITY_TYPE_LABEL_KEY,
+            "operator": "In",
+            "values": [l.CAPACITY_TYPE_ON_DEMAND],
+        }
+    ]
+    op.store.create(ObjectStore.NODEPOOLS, pool)
+
+    print("== provisioning 60 pods ==")
+    for i in range(60):
+        op.store.create(ObjectStore.PODS, make_pod(f"demo-{i}", cpu=0.5, memory="512Mi"))
+    op.tick()
+    op.cloud.inner.simulate_kubelet_ready()
+    op.tick()
+    print(f"nodes: {len(op.store.nodes())}, claims: {len(op.store.nodeclaims())}, "
+          f"bound: {sum(1 for p in op.store.pods() if p.spec.node_name)}/60")
+
+    print("== workload shrinks to 10 pods; consolidating ==")
+    for pod in list(op.store.pods()):
+        if int(pod.name.split("-")[1]) >= 10:
+            pod.status.phase = "Succeeded"
+            op.store.update(ObjectStore.PODS, pod)
+            op.store.delete(ObjectStore.PODS, pod.name)
+    clock.step(60.0)
+    for _ in range(8):
+        op.tick()
+        op.cloud.inner.simulate_kubelet_ready()
+        clock.step(20.0)
+    op.tick()
+    cpu = sum(n.status.capacity["cpu"] for n in op.store.nodes())
+    print(f"nodes: {len(op.store.nodes())} ({cpu:.0f} cpu), "
+          f"bound: {sum(1 for p in op.store.pods() if p.spec.node_name)}/10")
+    print("== metrics ==")
+    for line in metrics.REGISTRY.expose().splitlines():
+        if line.startswith("#"):
+            continue
+        value = line.rsplit(" ", 1)[-1]
+        if value not in ("0.0", "0"):
+            print(" ", line)
+
+
+def _accelerator_usable(timeout: float = 90.0) -> bool:
+    """Probe device init in a subprocess — a hung TPU tunnel must not
+    stall the demo (jax backend init is uninterruptible in-process)."""
+    import subprocess
+    import sys
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout,
+            capture_output=True,
+        )
+        return out.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+if __name__ == "__main__":
+    if not _accelerator_usable():
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        print("(accelerator init timed out; demo on CPU)")
+    _demo()

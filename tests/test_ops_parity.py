@@ -80,6 +80,28 @@ class TestOptions:
         assert not opts.feature_gates.spot_to_spot_consolidation
 
 
+class TestOperator:
+    def test_operator_wiring_and_tick(self):
+        from karpenter_tpu.operator import Operator
+        from karpenter_tpu.utils.options import FeatureGates, Options
+
+        clock = FakeClock()
+        opts = Options(feature_gates=FeatureGates.parse("SpotToSpotConsolidation=true"))
+        op = Operator.new(clock=clock, options=opts)
+        # feature gate propagated into the consolidation methods
+        assert op.manager.disruption.methods[2].spot_to_spot_enabled
+        assert op.manager.disruption.methods[3].spot_to_spot_enabled
+        pool = NodePool()
+        pool.metadata.name = "default"
+        op.store.create(ObjectStore.NODEPOOLS, pool)
+        op.store.create(ObjectStore.PODS, make_pod("p", cpu=0.5))
+        op.tick()
+        op.cloud.inner.simulate_kubelet_ready()
+        op.tick()
+        assert len(op.store.nodes()) == 1
+        assert all(p.spec.node_name for p in op.store.pods())
+
+
 class TestMetricsWiring:
     def test_provisioning_and_disruption_emit_metrics(self):
         from karpenter_tpu.utils import metrics
