@@ -55,28 +55,10 @@ def build_problem():
     return templates, pods
 
 
-def _accelerator_usable(timeout: float = 90.0) -> bool:
-    """Probe device init in a subprocess — a hung TPU tunnel must not
-    stall the benchmark (jax backend init is uninterruptible in-process)."""
-    import subprocess
-    import sys
-
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout,
-            capture_output=True,
-        )
-        return out.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
-
-
 def main() -> None:
-    if not _accelerator_usable():
-        import jax
+    from karpenter_tpu.utils.accel import force_cpu_if_unavailable
 
-        jax.config.update("jax_platforms", "cpu")
+    if force_cpu_if_unavailable():
         print('{"warning": "accelerator init timed out; benchmarking on CPU"}')
 
     from karpenter_tpu.controllers.provisioning import TPUScheduler

@@ -127,6 +127,14 @@ def filter_instance_types(
             continue
         if _fits_and_offering(it.allocatable_offerings(), requirements, total_requests):
             remaining.append(it)
+    # minValues (nodeclaim.go:606-617, Strict policy): the surviving set
+    # must retain enough distinct values per min-keyed requirement
+    if remaining and requirements.has_min_values():
+        from karpenter_tpu.cloudprovider.instancetype import satisfies_min_values
+
+        _, _, err = satisfies_min_values(remaining, requirements)
+        if err:
+            return []
     return remaining
 
 

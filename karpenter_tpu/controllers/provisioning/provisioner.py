@@ -142,7 +142,14 @@ class Provisioner:
         scheduler = self._build_scheduler()
         if scheduler is None or not self.cluster.synced():
             return None
-        pods = self.pending_pods() + list(extra_pods)
+        extra_pods = list(extra_pods)
+        if self.ignore_preferences:
+            # the reference applies IgnorePreferences to the WHOLE
+            # simulation, displaced pods included (disruption helpers.go)
+            from karpenter_tpu.controllers.provisioning.preferences import strip_preferences
+
+            extra_pods = [strip_preferences(p) for p in extra_pods]
+        pods = self.pending_pods() + extra_pods
         if not pods:
             return SchedulingResult(claims=[], unschedulable=[], assignments={})
         existing = self._existing_sim_nodes(excluded_node_names)

@@ -112,6 +112,49 @@ class TPUScheduler:
             for it in t.instance_types:
                 its[g, self._it_index[it.name]] = True
             daemon[g] = enc.resources_vector(t.daemon_requests)
+        # minValues floors from template requirements (the only carriers of
+        # minValues — pods never set them); -1 keys the instance-type NAME.
+        # The distinct min-keyed label names index a pre-gathered [T, J, V]
+        # slab of each instance type's DEFINED finite values for that key
+        # (undefined/complement keys contribute nothing — Values() parity).
+        mv_keys_named: list[str] = []
+        mv_lists = []
+        for t in self.templates:
+            entries = []
+            for r in t.requirements.values():
+                if r.min_values is None:
+                    continue
+                if r.key == l.LABEL_INSTANCE_TYPE:
+                    entries.append((-1, r.min_values))
+                else:
+                    if r.key not in mv_keys_named:
+                        mv_keys_named.append(r.key)
+                    entries.append((mv_keys_named.index(r.key), r.min_values))
+            mv_lists.append(entries)
+        M = _next_pow2(max((len(e) for e in mv_lists), default=1), 1)
+        mv_key = np.full((G, M), -2, dtype=np.int32)
+        mv_min = np.zeros((G, M), dtype=np.int32)
+        for g, entries in enumerate(mv_lists):
+            for m, (k, v) in enumerate(entries):
+                mv_key[g, m] = k
+                mv_min[g, m] = v
+        J = max(len(mv_keys_named), 1)
+        mv_it_values = np.zeros((T, J, v_pad), dtype=bool)
+        for j, key_name in enumerate(mv_keys_named):
+            kid = enc.vocab.key_to_id.get(key_name)
+            if kid is None:
+                continue
+            for t_idx, it in enumerate(self.catalog):
+                if not it.requirements.has(key_name):
+                    continue
+                r = it.requirements.get(key_name)
+                if r.complement:
+                    continue  # Values() is empty for complements
+                for v in r.values:
+                    vid = enc.vocab.value_to_id[kid].get(v)
+                    if vid is not None:
+                        mv_it_values[t_idx, j, vid] = True
+        self._mv_active = any(mv_lists)
         self.template_tensors = ops_solver.Templates(
             reqs=tmpl_reqs,
             its=jnp.asarray(its),
@@ -120,6 +163,9 @@ class TPUScheduler:
             # per-solve budgets are patched in by solve()
             budget=jnp.full((G, enc.n_resources), np.inf, dtype=jnp.float32),
             nodes_budget=jnp.full(G, np.inf, dtype=jnp.float32),
+            mv_key=jnp.asarray(mv_key),
+            mv_min=jnp.asarray(mv_min),
+            mv_it_values=jnp.asarray(mv_it_values),
         )
         wk = enc.vocab.well_known_mask()
         self.well_known = jnp.asarray(
@@ -375,6 +421,7 @@ class TPUScheduler:
             zone_kid=zone_kid,
             ct_kid=ct_kid,
             n_claims=n_claims,
+            mv_active=self._mv_active,
         )
         return self._decode(pods_sorted, result, E)
 

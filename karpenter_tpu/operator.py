@@ -122,27 +122,9 @@ def _demo() -> None:
             print(" ", line)
 
 
-def _accelerator_usable(timeout: float = 90.0) -> bool:
-    """Probe device init in a subprocess — a hung TPU tunnel must not
-    stall the demo (jax backend init is uninterruptible in-process)."""
-    import subprocess
-    import sys
-
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout,
-            capture_output=True,
-        )
-        return out.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
-
-
 if __name__ == "__main__":
-    if not _accelerator_usable():
-        import jax
+    from karpenter_tpu.utils.accel import force_cpu_if_unavailable
 
-        jax.config.update("jax_platforms", "cpu")
+    if force_cpu_if_unavailable():
         print("(accelerator init timed out; demo on CPU)")
     _demo()

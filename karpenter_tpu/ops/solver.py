@@ -58,6 +58,15 @@ class Templates(NamedTuple):
     valid: jnp.ndarray  # [G] bool
     budget: jnp.ndarray  # [G, R] f32 — remaining pool limits (+inf unlimited)
     nodes_budget: jnp.ndarray  # [G] f32 — remaining node-count limit (+inf)
+    # minValues flexibility floors (types.go:399-433; Strict policy):
+    # mv_key indexes the pre-gathered mv_it_values slab (-1 = the
+    # instance-type NAME key, -2 = unused)
+    mv_key: jnp.ndarray  # [G, M] i32
+    mv_min: jnp.ndarray  # [G, M] i32 (0 = unused)
+    # [T, J, V] — per min-keyed label, the values each instance type
+    # DEFINES (finite sets only: undefined/complement keys contribute
+    # nothing, matching Requirements.Get(k).Values())
+    mv_it_values: jnp.ndarray
 
 
 class ExistingNodes(NamedTuple):
@@ -152,7 +161,33 @@ def identity_reqs(n: int, k: int, v: int) -> ReqSetTensors:
     )
 
 
-@functools.partial(jax.jit, static_argnames=("zone_kid", "ct_kid", "n_claims"))
+def _min_values_ok(
+    viable: jnp.ndarray,  # [C, T] bool — surviving instance types
+    mv_key_c: jnp.ndarray,  # [C, M] i32 — indexes into the J axis
+    mv_min_c: jnp.ndarray,  # [C, M] i32
+    mv_it_values: jnp.ndarray,  # [T, J, V] bool — pre-gathered min-keyed values
+) -> jnp.ndarray:
+    """[C] bool — distinct-value floors hold over the viable set
+    (SatisfiesMinValues, types.go:399-433)."""
+    present = (
+        jnp.einsum(
+            "ct,tjv->cjv",
+            viable.astype(jnp.float32),
+            mv_it_values.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        > 0
+    )
+    counts_all = jnp.sum(present, axis=-1).astype(jnp.int32)  # [C, J]
+    name_count = jnp.sum(viable, axis=-1).astype(jnp.int32)  # [C]
+    key = jnp.clip(mv_key_c, 0, mv_it_values.shape[1] - 1)
+    per_key = jnp.take_along_axis(counts_all, key, axis=1)  # [C, M]
+    cnt = jnp.where(mv_key_c == -1, name_count[:, None], per_key)
+    ok = (mv_min_c <= 0) | (cnt >= mv_min_c)
+    return jnp.all(ok, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("zone_kid", "ct_kid", "n_claims", "mv_active"))
 def solve(
     pods: PodTensors,
     pod_tmpl_ok: jnp.ndarray,  # [P, G] bool — tolerates taints + skipped-key static checks
@@ -169,6 +204,7 @@ def solve(
     zone_kid: int,
     ct_kid: int,
     n_claims: int,
+    mv_active: bool = False,
 ) -> SolveResult:
     N = n_claims
     K = it.reqs.mask.shape[1]
@@ -261,6 +297,13 @@ def solve(
             & pod_valid
             & ~found_e
         )
+        if mv_active:
+            feas &= _min_values_ok(
+                new_its,
+                templates.mv_key[state.template],
+                templates.mv_min[state.template],
+                templates.mv_it_values,
+            )
         order_key = state.pods * jnp.int32(N) + jnp.arange(N, dtype=jnp.int32)
         pick = jnp.argmin(jnp.where(feas, order_key, BIG))
         found = jnp.any(feas)
@@ -305,6 +348,10 @@ def solve(
             & jnp.any(its0, axis=-1)
             & (state.nodes_budget >= 1.0)
         )
+        if mv_active:
+            tmpl_feas &= _min_values_ok(
+                its0, templates.mv_key, templates.mv_min, templates.mv_it_values
+            )
         g = jnp.argmax(tmpl_feas)
         any_template = jnp.any(tmpl_feas) & pod_valid & ~found_e & ~found
         can_open = any_template & (state.n_open < N)

@@ -1,0 +1,30 @@
+"""Accelerator availability probing shared by entry points."""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+
+def accelerator_usable(timeout: float = 90.0) -> bool:
+    """Probe device init in a subprocess — a hung TPU tunnel must not
+    stall the caller (jax backend init is uninterruptible in-process)."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout,
+            capture_output=True,
+        )
+        return out.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def force_cpu_if_unavailable(timeout: float = 90.0) -> bool:
+    """CPU-fallback stanza: returns True when the fallback was applied."""
+    if accelerator_usable(timeout):
+        return False
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return True

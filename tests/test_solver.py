@@ -511,6 +511,62 @@ class TestTopologyDifferential:
         assert_same_packing(host, tpu)
 
 
+class TestMinValues:
+    def _pool(self, key, mv):
+        return default_pool(
+            "mv",
+            requirements=[{"key": key, "operator": "Exists", "minValues": mv}],
+        )
+
+    def test_min_values_name_key_limits_claims(self):
+        """instance-type minValues=3: a claim must keep >=3 viable types, so
+        it stops accepting pods earlier than an unconstrained claim."""
+        pool = self._pool(l.LABEL_INSTANCE_TYPE, 3)
+        pods = [make_pod(f"p-{i}", cpu=1.0, memory="1Gi") for i in range(12)]
+        templates = build_templates([(pool, instance_types(64))])
+        host = HostScheduler(templates).solve(pods)
+        tpu = TPUScheduler(templates).solve(pods)
+        assert_same_packing(host, tpu)
+        assert not tpu.unschedulable
+        for c in tpu.claims:
+            assert len({it.name for it in c.instance_types}) >= 3
+
+    def test_min_values_family_key(self):
+        pool = self._pool("karpenter-tpu.sh/instance-family", 2)
+        pods = [make_pod(f"p-{i}", cpu=0.5) for i in range(6)]
+        templates = build_templates([(pool, instance_types(64))])
+        host = HostScheduler(templates).solve(pods)
+        tpu = TPUScheduler(templates).solve(pods)
+        assert_same_packing(host, tpu)
+        for c in tpu.claims:
+            families = set()
+            for it in c.instance_types:
+                families.update(it.requirements.get("karpenter-tpu.sh/instance-family").values)
+            assert len(families) >= 2
+
+    def test_min_values_on_undefined_key(self):
+        """Types that don't define the min-keyed label contribute ZERO
+        values (Values() parity) — the floor must fail, not pass through
+        the identity encoding."""
+        pool = self._pool("example.com/undefined-everywhere", 2)
+        pods = [make_pod("p", cpu=0.5)]
+        templates = build_templates([(pool, instance_types(16))])
+        host = HostScheduler(templates).solve(pods)
+        tpu = TPUScheduler(templates).solve(pods)
+        assert_same_packing(host, tpu)
+        assert len(tpu.unschedulable) == 1
+
+    def test_unsatisfiable_min_values(self):
+        """minValues beyond the catalog's diversity -> unschedulable."""
+        pool = self._pool("karpenter-tpu.sh/instance-family", 99)
+        pods = [make_pod("p", cpu=0.5)]
+        templates = build_templates([(pool, instance_types(16))])
+        host = HostScheduler(templates).solve(pods)
+        tpu = TPUScheduler(templates).solve(pods)
+        assert_same_packing(host, tpu)
+        assert len(tpu.unschedulable) == 1
+
+
 class TestHostPortsAndVolumes:
     def test_hostport_conflict_separates_pods(self):
         from karpenter_tpu.models.pod import HostPort
