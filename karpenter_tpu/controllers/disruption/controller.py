@@ -103,9 +103,14 @@ class DisruptionController:
         candidates = build_candidates(self.cluster, pools, its, self.clock, blocked)
         if not candidates:
             return None
+        from karpenter_tpu.utils import metrics
+
         for method in self.methods:
             budgets = build_disruption_budgets(pools, self.cluster, method.reason, self.clock)
-            command = method.compute(candidates, budgets)
+            method_name = type(method).__name__
+            metrics.DISRUPTION_ELIGIBLE_NODES.set(float(len(candidates)), method=method_name)
+            with metrics.DISRUPTION_EVAL_DURATION.time(method=method_name):
+                command = method.compute(candidates, budgets)
             if command.is_empty:
                 continue
             # Balanced scoring applies to consolidation only — Drift and
