@@ -253,6 +253,55 @@ class TestPodRequirements:
         assert not strict.has("zone")
 
 
+class TestBudgetReasons:
+    def test_reason_scoped_budget(self):
+        from karpenter_tpu.models.nodepool import Budget, NodePool
+
+        pool = NodePool()
+        pool.spec.disruption.budgets = [
+            Budget(nodes="0", reasons=["Drifted"]),  # freeze drift disruptions
+            Budget(nodes="50%"),  # everything else at 50%
+        ]
+        now = 1_700_000_000.0
+        assert pool.allowed_disruptions("Drifted", total_nodes=10, now=now) == 0
+        assert pool.allowed_disruptions("Underutilized", total_nodes=10, now=now) == 5
+        assert pool.allowed_disruptions("Empty", total_nodes=10, now=now) == 5
+
+    def test_all_reason_budget(self):
+        from karpenter_tpu.models.nodepool import Budget, NodePool
+
+        pool = NodePool()
+        pool.spec.disruption.budgets = [Budget(nodes="2", reasons=["All"])]
+        now = 1_700_000_000.0
+        for reason in ("Drifted", "Underutilized", "Empty"):
+            assert pool.allowed_disruptions(reason, total_nodes=10, now=now) == 2
+
+    def test_min_across_active_budgets(self):
+        from karpenter_tpu.models.nodepool import Budget, NodePool
+
+        pool = NodePool()
+        pool.spec.disruption.budgets = [Budget(nodes="4"), Budget(nodes="30%")]
+        now = 1_700_000_000.0
+        # min(4, floor(10 * 0.3)) = 3
+        assert pool.allowed_disruptions("Empty", total_nodes=10, now=now) == 3
+
+    def test_inactive_window_ignored(self):
+        import calendar
+
+        from karpenter_tpu.models.nodepool import Budget, NodePool
+
+        pool = NodePool()
+        pool.spec.disruption.budgets = [
+            Budget(nodes="0", schedule="0 9 * * 1-5", duration_seconds=3600.0)
+        ]
+        # Wed 2026-07-29 09:30 UTC — inside the freeze window
+        inside = calendar.timegm((2026, 7, 29, 9, 30, 0, 0, 0, 0))
+        # Wed 2026-07-29 12:00 UTC — outside
+        outside = calendar.timegm((2026, 7, 29, 12, 0, 0, 0, 0, 0))
+        assert pool.allowed_disruptions("Empty", total_nodes=10, now=inside) == 0
+        assert pool.allowed_disruptions("Empty", total_nodes=10, now=outside) == 10
+
+
 class TestTaints:
     def test_tolerates(self):
         from karpenter_tpu.models.taints import NO_SCHEDULE, Taint, Toleration
