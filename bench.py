@@ -58,8 +58,16 @@ def build_problem():
 def main() -> None:
     from karpenter_tpu.utils.accel import force_cpu_if_unavailable
 
-    if force_cpu_if_unavailable():
-        print('{"warning": "accelerator init timed out; benchmarking on CPU"}')
+    fallback = force_cpu_if_unavailable()
+    if fallback:
+        reason = {
+            "timeout": "accelerator init timed out",
+            "absent": "no accelerator attached",
+        }[fallback]
+        print(json.dumps({"warning": f"{reason}; benchmarking on CPU"}))
+    import jax
+
+    platform = jax.devices()[0].platform
 
     from karpenter_tpu.controllers.provisioning import TPUScheduler
 
@@ -84,6 +92,7 @@ def main() -> None:
                 "unit": "pods/sec",
                 "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 2),
                 "detail": {
+                    "platform": platform,
                     "nodes": result.node_count,
                     "wall_s": round(best, 4),
                     "total_price_per_hour": round(result.total_price(), 2),

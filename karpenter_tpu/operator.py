@@ -125,6 +125,7 @@ def _demo() -> None:
 if __name__ == "__main__":
     from karpenter_tpu.utils.accel import force_cpu_if_unavailable
 
-    if force_cpu_if_unavailable():
-        print("(accelerator init timed out; demo on CPU)")
+    fallback = force_cpu_if_unavailable()
+    if fallback:
+        print(f"(accelerator unusable: {fallback}; demo on CPU)")
     _demo()

@@ -2,29 +2,62 @@
 
 from __future__ import annotations
 
+import os
 import subprocess
 import sys
 
+# Cold TPU tunnels (the axon plugin) can take minutes to come up; the
+# round-1 bench fell back to CPU because the 90s probe was too short.
+DEFAULT_PROBE_TIMEOUT = float(os.environ.get("KTPU_ACCEL_PROBE_TIMEOUT", "300"))
 
-def accelerator_usable(timeout: float = 90.0) -> bool:
+
+def probe_accelerator(timeout: float = DEFAULT_PROBE_TIMEOUT) -> str:
     """Probe device init in a subprocess — a hung TPU tunnel must not
-    stall the caller (jax backend init is uninterruptible in-process)."""
+    stall the caller (jax backend init is uninterruptible in-process).
+
+    Returns "ok" (a non-CPU device is usable), "absent" (jax came up
+    CPU-only), or "timeout" (device init hung — e.g. a dead TPU tunnel).
+    """
     try:
         out = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
+            [
+                sys.executable,
+                "-c",
+                "import jax, sys; d = jax.devices(); "
+                "sys.exit(0 if d and d[0].platform != 'cpu' else 3)",
+            ],
             timeout=timeout,
             capture_output=True,
         )
-        return out.returncode == 0
+        return "ok" if out.returncode == 0 else "absent"
     except subprocess.TimeoutExpired:
-        return False
+        return "timeout"
 
 
-def force_cpu_if_unavailable(timeout: float = 90.0) -> bool:
-    """CPU-fallback stanza: returns True when the fallback was applied."""
-    if accelerator_usable(timeout):
-        return False
+def accelerator_usable(timeout: float = DEFAULT_PROBE_TIMEOUT) -> bool:
+    return probe_accelerator(timeout) == "ok"
+
+
+def force_cpu() -> None:
+    """Force the CPU platform. Must run before the first jax backend use.
+
+    The axon TPU plugin overrides the JAX_PLATFORMS env var (the effective
+    platform list comes up as "axon,cpu" regardless), so env-only forcing
+    silently initializes the TPU tunnel anyway; the config update is the
+    only reliable mechanism in this image.
+    """
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    return True
+
+
+def force_cpu_if_unavailable(timeout: float = DEFAULT_PROBE_TIMEOUT) -> str | None:
+    """CPU-fallback stanza: probes for an accelerator and forces the CPU
+    platform when none is usable. Returns the probe failure mode
+    ("absent" or "timeout") when the fallback was applied, None otherwise.
+    """
+    status = probe_accelerator(timeout)
+    if status == "ok":
+        return None
+    force_cpu()
+    return status
