@@ -63,6 +63,7 @@ def main() -> None:
         reason = {
             "timeout": "accelerator init timed out",
             "absent": "no accelerator attached",
+            "error": "accelerator probe crashed",
         }[fallback]
         print(json.dumps({"warning": f"{reason}; benchmarking on CPU"}))
     import jax

@@ -24,6 +24,7 @@ from karpenter_tpu.controllers.disruption.methods import (
 )
 from karpenter_tpu.controllers.disruption.queue import OrchestrationQueue
 from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodepool import REASON_EMPTY
 from karpenter_tpu.models.taints import DISRUPTED_NO_SCHEDULE_TAINT
 from karpenter_tpu.state.store import ObjectStore
 
@@ -120,11 +121,9 @@ class DisruptionController:
                 method, (MultiNodeConsolidation, SingleNodeConsolidation)
             ) and not self._balanced_approves(command, candidates):
                 continue
-            if isinstance(method, Emptiness):
-                # emptiness skips the validation delay (it re-validates
-                # trivially: no pods to displace)
-                self.queue.start(command)
-                return command
+            # every method — including Emptiness — waits out the validation
+            # delay (emptiness.go:101 validator.Validate): a pod may bind to
+            # an "empty" node between candidate computation and execution
             self._pending = _PendingValidation(
                 command=command, ready_at=self.clock.now() + VALIDATION_DELAY_SECONDS
             )
@@ -198,10 +197,22 @@ class DisruptionController:
 
         blocked = blocked_pod_uids(self.store.list(ObjectStore.PDBS), self.store.pods())
         for c in command.candidates:
-            if is_disruptable(c.state_node, self.clock) is not None:
+            sn = self.cluster.node_by_name(c.name)
+            if sn is None:
+                return False  # node vanished during the window
+            if is_disruptable(sn, self.clock) is not None:
                 return False
-            if any(uid in blocked for uid in c.state_node.pods):
+            if any(uid in blocked for uid in sn.pods):
                 return False
+            fresh = [p for p in sn.pods.values() if not p.is_terminal()]
+            if command.reason == REASON_EMPTY and fresh:
+                # emptiness: a pod bound during the delay (emptiness.go:101)
+                return False
+            # re-simulate against the CURRENT pod set — a pod that bound
+            # during the delay must be rescheduled too, not evicted blind
+            # (validation.go re-builds candidates from live state)
+            c.state_node = sn
+            c.reschedulable_pods = fresh
         if command.replacements or any(c.reschedulable_pods for c in command.candidates):
             results, unscheduled = self._simulate(command.candidates)
             if results is None or unscheduled:

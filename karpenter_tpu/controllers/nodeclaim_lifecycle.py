@@ -103,12 +103,15 @@ class NodeClaimLifecycleController:
         node = self._node_for(claim)
         if node is None or not node.status.ready:
             return False
-        # startup taints must clear; known-ephemeral taints are ignored
+        # initialization waits for BOTH ladders to clear: every known
+        # ephemeral taint (e.g. node.kubernetes.io/not-ready) AND every
+        # startup taint (initialization.go:78-81 StartupTaintsRemoved +
+        # KnownEphemeralTaintsRemoved)
         blocking = [
             t
             for t in node.spec.taints
-            if not is_known_ephemeral_taint(t)
-            and any(t.match(st) for st in claim.spec.startup_taints)
+            if is_known_ephemeral_taint(t)
+            or any(t.match(st) for st in claim.spec.startup_taints)
         ]
         if blocking:
             return False

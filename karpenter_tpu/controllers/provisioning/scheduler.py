@@ -147,9 +147,11 @@ class TPUScheduler:
             for t_idx, it in enumerate(self.catalog):
                 if not it.requirements.has(key_name):
                     continue
+                # raw value set regardless of operator — Go's
+                # Requirement.Values() (requirement.go:282-284) returns the
+                # stored set even for NotIn, and the host oracle counts the
+                # same way (satisfies_min_values)
                 r = it.requirements.get(key_name)
-                if r.complement:
-                    continue  # Values() is empty for complements
                 for v in r.values:
                     vid = enc.vocab.value_to_id[kid].get(v)
                     if vid is not None:

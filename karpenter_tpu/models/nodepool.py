@@ -55,7 +55,9 @@ class Budget:
     def allowed(self, total_nodes: int) -> int:
         s = self.nodes.strip()
         if s.endswith("%"):
-            return int(math.floor(total_nodes * float(s[:-1]) / 100.0))
+            # round UP (nodepool.go:391-396 GetScaledValueFromIntOrPercent
+            # roundUp=true) so small pools still allow one disruption
+            return int(math.ceil(total_nodes * float(s[:-1]) / 100.0))
         return int(s)
 
     def is_active(self, now: float) -> bool:

@@ -105,6 +105,7 @@ def sharded_solve(
     zone_kid: int,
     ct_kid: int,
     n_claims: int,
+    mv_active: bool = False,
 ):
     """Run ops_solver.solve with the catalog sharded over the "it" mesh axis.
 
@@ -114,7 +115,13 @@ def sharded_solve(
     padded to the sharded catalog size; everything else is replicated.
     """
     T_pad = it_sharded.alloc.shape[0]
-    tmpl = templates._replace(its=pad_axis_to(templates.its, 1, T_pad, False))
+    # every per-type tensor must grow with the padded catalog: the template
+    # membership mask [G, T] and the minValues value slab [T, J, V] (padded
+    # types contribute no distinct values, so floors count identically)
+    tmpl = templates._replace(
+        its=pad_axis_to(templates.its, 1, T_pad, False),
+        mv_it_values=pad_axis_to(templates.mv_it_values, 0, T_pad, False),
+    )
     allow = pad_axis_to(pod_it_allow, 1, T_pad, False)
     return ops_solver.solve(
         pods,
@@ -132,4 +139,5 @@ def sharded_solve(
         zone_kid=zone_kid,
         ct_kid=ct_kid,
         n_claims=n_claims,
+        mv_active=mv_active,
     )

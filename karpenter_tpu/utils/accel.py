@@ -16,7 +16,8 @@ def probe_accelerator(timeout: float = DEFAULT_PROBE_TIMEOUT) -> str:
     stall the caller (jax backend init is uninterruptible in-process).
 
     Returns "ok" (a non-CPU device is usable), "absent" (jax came up
-    CPU-only), or "timeout" (device init hung — e.g. a dead TPU tunnel).
+    CPU-only), "timeout" (device init hung — e.g. a dead TPU tunnel), or
+    "error" (the probe crashed — broken jax install / plugin fault).
     """
     try:
         out = subprocess.run(
@@ -29,7 +30,9 @@ def probe_accelerator(timeout: float = DEFAULT_PROBE_TIMEOUT) -> str:
             timeout=timeout,
             capture_output=True,
         )
-        return "ok" if out.returncode == 0 else "absent"
+        if out.returncode == 0:
+            return "ok"
+        return "absent" if out.returncode == 3 else "error"
     except subprocess.TimeoutExpired:
         return "timeout"
 

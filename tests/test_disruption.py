@@ -58,6 +58,17 @@ def delete_pods(store, mgr, predicate):
     mgr.run_until_idle()
 
 
+def disrupt_through_validation(mgr, clock, polls=3, step=16.0):
+    """First poll stages a command for the 15s validation window
+    (emptiness.go:101 — every method validates); later polls execute it."""
+    for _ in range(polls):
+        cmd = mgr.run_disruption_once()
+        if cmd is not None:
+            return cmd
+        clock.step(step)
+    return None
+
+
 class TestEmptiness:
     def test_empty_nodes_deleted(self):
         clock, store, cloud, mgr = build_env()
@@ -67,7 +78,7 @@ class TestEmptiness:
         # all pods finish -> all nodes empty
         delete_pods(store, mgr, lambda p: True)
         clock.step(30.0)
-        cmd = mgr.run_disruption_once()
+        cmd = disrupt_through_validation(mgr, clock)
         assert cmd is not None and cmd.reason == "Empty"
         mgr.run_until_idle()
         assert len(store.nodes()) < n_before
@@ -81,7 +92,7 @@ class TestEmptiness:
         cmd = mgr.run_disruption_once()
         assert cmd is None
         clock.step(300.0)
-        cmd = mgr.run_disruption_once()
+        cmd = disrupt_through_validation(mgr, clock)
         assert cmd is not None
 
     def test_emptiness_budget(self):
@@ -94,8 +105,38 @@ class TestEmptiness:
         assert n_nodes >= 3
         delete_pods(store, mgr, lambda p: True)
         clock.step(30.0)
-        cmd = mgr.run_disruption_once()
+        cmd = disrupt_through_validation(mgr, clock)
         assert cmd is not None and len(cmd.candidates) == 1  # budget caps at 1
+
+    def test_emptiness_validated_not_immediate(self):
+        """Emptiness waits out the 15s validation delay; a pod binding to
+        the 'empty' node during the window cancels the command
+        (emptiness.go:101 validator.Validate)."""
+        clock, store, cloud, mgr = build_env()
+        provision(mgr, store, cloud, [make_pod("p", cpu=1.0)])
+        n_before = len(store.nodes())
+        delete_pods(store, mgr, lambda p: True)
+        clock.step(30.0)
+        # first poll only stages the command
+        assert mgr.run_disruption_once() is None
+        assert len(store.nodes()) == n_before
+        # a fresh pod lands on the node during the validation window
+        newcomer = make_pod("late", cpu=0.5)
+        newcomer.spec.node_name = store.nodes()[0].name
+        store.create(ObjectStore.PODS, newcomer)
+        mgr.run_until_idle()
+        clock.step(16.0)
+        assert mgr.run_disruption_once() is None
+        assert len(store.nodes()) == n_before, "node deleted under a fresh pod"
+
+    def test_budget_percentage_rounds_up(self):
+        # reference rounds percentages UP (nodepool.go:391-396) so pools
+        # under 10 nodes still allow one disruption at the default 10%
+        assert Budget(nodes="10%").allowed(5) == 1
+        assert Budget(nodes="10%").allowed(0) == 0
+        assert Budget(nodes="10%").allowed(25) == 3
+        assert Budget(nodes="50%").allowed(3) == 2
+        assert Budget(nodes="3").allowed(100) == 3
 
 
 class TestConsolidation:
@@ -198,7 +239,7 @@ class TestOrchestration:
         provision(mgr, store, cloud, [make_pod(f"p-{i}", cpu=1.0) for i in range(4)])
         delete_pods(store, mgr, lambda p: True)
         clock.step(30.0)
-        cmd = mgr.run_disruption_once()
+        cmd = disrupt_through_validation(mgr, clock)
         assert cmd is not None
         # nodes tainted during the window, then deleted once processed
         for _ in range(3):

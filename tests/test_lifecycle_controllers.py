@@ -33,6 +33,32 @@ def provision(mgr, store, cloud, pods):
     mgr.run_until_idle()
 
 
+class TestInitialization:
+    def test_known_ephemeral_taint_blocks_initialization(self):
+        """A node still carrying node.kubernetes.io/not-ready must not be
+        marked Initialized even if Ready and startup taints are clear
+        (initialization.go:78-81 KnownEphemeralTaintsRemoved)."""
+        from karpenter_tpu.models.nodeclaim import COND_INITIALIZED
+        from karpenter_tpu.models.taints import NO_SCHEDULE, TAINT_NODE_NOT_READY, Taint
+
+        clock, store, cloud, mgr = build_env()
+        store.create(ObjectStore.PODS, make_pod("p", cpu=0.5))
+        mgr.run_until_idle()  # claim created, node joined + registered
+        node = store.nodes()[0]
+        node.spec.taints.append(Taint(key=TAINT_NODE_NOT_READY, effect=NO_SCHEDULE))
+        store.update(ObjectStore.NODES, node)
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        claim = store.nodeclaims()[0]
+        assert not claim.conditions.is_true(COND_INITIALIZED)
+        node = store.nodes()[0]
+        node.spec.taints = [t for t in node.spec.taints if t.key != TAINT_NODE_NOT_READY]
+        store.update(ObjectStore.NODES, node)
+        mgr.run_until_idle()
+        claim = store.nodeclaims()[0]
+        assert claim.conditions.is_true(COND_INITIALIZED)
+
+
 class TestTerminationDrain:
     def test_claim_deletion_evicts_and_reschedules_pods(self):
         """The earlier gap: deleting a claim must drain its pods back to

@@ -54,6 +54,34 @@ def test_sharded_solve_matches_unsharded():
     assert not np.asarray(out.claims.its)[:, T:].any()
 
 
+def test_sharded_solve_enforces_min_values():
+    """minValues floors must survive sharding: the mv value slab is padded
+    alongside the catalog and mv_active threads through sharded_solve."""
+    import __graft_entry__ as ge
+
+    fn, args, meta = ge._build_entry(
+        n_pods=24, n_types=12, min_values=("karpenter-tpu.sh/instance-family", 2)
+    )
+    assert meta["mv_active"]
+    it = args[7]
+    ref = jax.jit(fn)(*args)
+    ref_assignment = np.asarray(ref.assignment)
+
+    mesh = make_mesh(8)
+    with mesh:
+        it_sharded = shard_instance_types(it, mesh)
+        sharded_args = list(args)
+        sharded_args[7] = it_sharded
+        out = sharded_solve(*sharded_args, **meta)
+        out_assignment = np.asarray(out.assignment)
+
+    np.testing.assert_array_equal(ref_assignment, out_assignment)
+    T = it.alloc.shape[0]
+    np.testing.assert_array_equal(
+        np.asarray(ref.claims.its), np.asarray(out.claims.its)[:, :T]
+    )
+
+
 def test_dryrun_entry():
     import __graft_entry__ as ge
 

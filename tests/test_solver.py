@@ -556,6 +556,27 @@ class TestMinValues:
         assert_same_packing(host, tpu)
         assert len(tpu.unschedulable) == 1
 
+    def test_min_values_complement_catalog_parity(self):
+        """Instance types carrying NotIn requirements on the counted key
+        contribute their RAW value set — Go's Requirement.Values()
+        (requirement.go:282-284) returns the stored set regardless of
+        operator, and both engines must count identically."""
+        from karpenter_tpu.scheduling import Operator, Requirement
+
+        pool = self._pool("example.com/tier", 3)
+        its = instance_types(16)
+        for i, it in enumerate(its):
+            it.requirements.add(
+                Requirement.new("example.com/tier", Operator.NOT_IN, f"tier-{i % 4}")
+            )
+        pods = [make_pod(f"p-{i}", cpu=0.5) for i in range(4)]
+        templates = build_templates([(pool, its)])
+        host = HostScheduler(templates).solve(pods)
+        tpu = TPUScheduler(templates).solve(pods)
+        assert_same_packing(host, tpu)
+        # 4 distinct excluded values across the catalog >= floor of 3
+        assert not host.unschedulable
+
     def test_unsatisfiable_min_values(self):
         """minValues beyond the catalog's diversity -> unschedulable."""
         pool = self._pool("karpenter-tpu.sh/instance-family", 99)
