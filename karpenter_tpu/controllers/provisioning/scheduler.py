@@ -70,6 +70,9 @@ class TPUScheduler:
         self._it_index = {name: i for i, name in enumerate(seen)}
         self.max_claims = max_claims
         self.pod_pad = pod_pad
+        import os
+
+        self.solve_chunk = int(os.environ.get("KTPU_SOLVE_CHUNK", "2048"))
         self._volume_reqs: dict = {}
 
         self.encoder = ProblemEncoder()
@@ -255,6 +258,33 @@ class TPUScheduler:
 
         return prefs.run_with_relaxation(list(pods), solve_round)
 
+    def _kind_sig(self, pod: Pod):
+        """Canonical content signature for pod-kind dedup.
+
+        Serializes the FULL spec (requests, selectors, affinity, TSC,
+        tolerations, ports — everything any encoder reads), the labels
+        (topology group selection), and the pod's volume-implied zone
+        restriction. Two pods with equal signatures produce identical rows
+        in every problem tensor, including topology ownership: groups are
+        deduped by identity (`Topology._by_ident`), so content-identical
+        declarers own the same group.
+        """
+        import dataclasses
+        import json
+
+        vol = self._volume_reqs.get(pod.uid)
+        vol_sig = (
+            None
+            if vol is None
+            else (vol.key, vol.complement, tuple(sorted(vol.values)), vol.gte, vol.lte)
+        )
+        return (
+            json.dumps(dataclasses.asdict(pod.spec), sort_keys=True, default=str),
+            tuple(sorted(pod.metadata.labels.items())),
+            pod.metadata.namespace,  # topology groups are per-namespace
+            vol_sig,
+        )
+
     def _pod_reqs(self, pod: Pod) -> Requirements:
         """Full pod requirements + PVC-implied zone restriction (volume
         topology folds into the NODE side via the combine, not into strict
@@ -272,6 +302,9 @@ class TPUScheduler:
         budgets: Optional[dict[str, dict[str, float]]] = None,
         topology: Optional[Topology] = None,
     ) -> SchedulingResult:
+        import time as _time
+
+        self._t_solve_start = _time.perf_counter()
         self.existing_nodes = existing_nodes or []
         self.budgets = {k: dict(v) for k, v in (budgets or {}).items()}
         if topology is None:
@@ -288,7 +321,33 @@ class TPUScheduler:
             for d in g.domains:
                 self.encoder.vocab.add_value(g.key, d)
         pods_sorted = ffd_sort(list(pods))
-        for p in pods_sorted:
+        # ---- pod-kind dedup -------------------------------------------------
+        # Every per-pod encoding below is a pure function of pod CONTENT
+        # (spec + labels + volume restriction), so it is computed once per
+        # distinct kind and gathered per pod. Real workloads are
+        # deployment-shaped (P >> kinds), which turns the O(P) python
+        # encode loops into O(kinds) + numpy gathers.
+        P = len(pods_sorted)
+        P_pad = self.pod_pad or _next_pow2(max(P, 1))
+        if P_pad > self.solve_chunk:
+            # chunked dispatch: every chunk shares one compiled shape
+            P_pad = ((P_pad + self.solve_chunk - 1) // self.solve_chunk) * self.solve_chunk
+        n_claims = self.max_claims or _next_pow2(max(P, 1))
+        pad_pod = Pod()  # zero-request inert pod for padding
+        padded = pods_sorted + [pad_pod] * (P_pad - P)
+        kind_of = np.empty(P_pad, dtype=np.int64)
+        reps: list[Pod] = []
+        sig_to_kind: dict = {}
+        for i, p in enumerate(padded):
+            s = self._kind_sig(p)
+            k = sig_to_kind.get(s)
+            if k is None:
+                k = len(reps)
+                sig_to_kind[s] = k
+                reps.append(p)
+            kind_of[i] = k
+
+        for p in reps:
             self.encoder.observe_pod(p)
             extra = self._volume_reqs.get(p.uid)
             if extra is not None:
@@ -306,24 +365,21 @@ class TPUScheduler:
             budget=budget, nodes_budget=nodes_budget
         )
 
-        P = len(pods_sorted)
-        P_pad = self.pod_pad or _next_pow2(max(P, 1))
-        n_claims = self.max_claims or _next_pow2(max(P, 1))
+        U = len(reps)
         k_pad, v_pad = self._pads()
-        pad_pod = Pod()  # zero-request inert pod for padding
-        padded = pods_sorted + [pad_pod] * (P_pad - P)
-        pod_req_sets = [self._pod_reqs(p) for p in padded]
-        reqs = encode_requirements(
-            self.encoder.vocab, pod_req_sets, k_pad, v_pad, self.encoder.skip_keys
+        rep_req_sets = [self._pod_reqs(p) for p in reps]
+        reqs_k = encode_requirements(
+            self.encoder.vocab, rep_req_sets, k_pad, v_pad, self.encoder.skip_keys
         )
-        it_allow = self.encoder.it_allow_mask(pod_req_sets, self.catalog)
+        it_allow_k = self.encoder.it_allow_mask(rep_req_sets, self.catalog)
         # hostname selectors can never match a not-yet-named new node
-        for i, rq in enumerate(pod_req_sets):
+        for u, rq in enumerate(rep_req_sets):
             if not self.encoder.hostname_allows(rq, None):
-                it_allow[i, :] = False
+                it_allow_k[u, :] = False
+        it_allow = it_allow_k[kind_of]
         # static pod×existing-node checks for the skipped keys + taints
         E = exist_tensors.avail.shape[0]
-        exist_ok = np.zeros((P_pad, E), dtype=bool)
+        exist_ok_k = np.zeros((U, E), dtype=bool)
         for e, n in enumerate(self.existing_nodes):
             hostname = n.requirements.get(l.LABEL_HOSTNAME).any_value() or None
             it_name = (
@@ -331,23 +387,29 @@ class TPUScheduler:
                 if n.requirements.has(l.LABEL_INSTANCE_TYPE)
                 else None
             )
-            for i, p in enumerate(padded):
-                rq = pod_req_sets[i]
+            for u, p in enumerate(reps):
+                rq = rep_req_sets[u]
                 ok = tolerates_all(n.taints, p.spec.tolerations) is None
                 ok = ok and self.encoder.hostname_allows(rq, hostname)
                 if ok and rq.has(l.LABEL_INSTANCE_TYPE):
                     r = rq.get(l.LABEL_INSTANCE_TYPE)
                     ok = r.has(it_name) if it_name is not None else r.is_lenient()
-                exist_ok[i, e] = ok
-        strict_sets = [Requirements.from_pod(p, include_preferred=False) for p in padded]
-        strict_reqs = encode_requirements(
+                exist_ok_k[u, e] = ok
+        exist_ok = exist_ok_k[kind_of]
+        strict_sets = [Requirements.from_pod(p, include_preferred=False) for p in reps]
+        strict_reqs_k = encode_requirements(
             self.encoder.vocab, strict_sets, k_pad, v_pad, self.encoder.skip_keys
         )
-        requests = np.stack([self.encoder.resources_vector(p.total_requests()) for p in padded])
+        kind_idx = jnp.asarray(kind_of)
+        from karpenter_tpu.ops.kernels import take_set
+
+        requests_k = np.stack(
+            [self.encoder.resources_vector(p.total_requests()) for p in reps]
+        )
         pt = ops_solver.PodTensors(
-            reqs=reqs,
-            strict_reqs=strict_reqs,
-            requests=jnp.asarray(requests, dtype=jnp.float32),
+            reqs=take_set(reqs_k, kind_idx),
+            strict_reqs=take_set(strict_reqs_k, kind_idx),
+            requests=jnp.asarray(requests_k[kind_of], dtype=jnp.float32),
             valid=jnp.asarray([True] * P + [False] * (P_pad - P), dtype=bool),
         )
         # topology tensors (counts + per-pod group relations); the hostname
@@ -361,12 +423,14 @@ class TPUScheduler:
             [n.name for n in self.existing_nodes],
         )
         topo_tensors = topo_ops.pad_to_v(topo_tensors, v_pad)
-        pod_topo = topo_ops.encode_pod_topology(self.topology, vg, hg, padded, strict_reqs)
-        # toleration matrix [P, G] host-side: taint sets are static per template
-        tol = np.zeros((P_pad, len(self.templates)), dtype=bool)
-        for i, p in enumerate(padded):
+        pod_topo_k = topo_ops.encode_pod_topology(self.topology, vg, hg, reps, strict_reqs_k)
+        pod_topo = topo_ops.take_pod_topology(pod_topo_k, kind_idx)
+        # toleration matrix [U, G] host-side: taint sets are static per template
+        tol_k = np.zeros((U, len(self.templates)), dtype=bool)
+        for u, p in enumerate(reps):
             for g, t in enumerate(self.templates):
-                tol[i, g] = tolerates_all(t.taints, p.spec.tolerations) is None
+                tol_k[u, g] = tolerates_all(t.taints, p.spec.tolerations) is None
+        tol = tol_k[kind_of]
 
         # host-port vocabulary + wildcard-expanded conflict masks
         from karpenter_tpu.scheduling import hostports as hostports_mod
@@ -383,23 +447,25 @@ class TPUScheduler:
         for n in self.existing_nodes:
             for key in n.host_ports:
                 port_id(key)
-        for p in padded:
+        for p in reps:
             for h in p.spec.host_ports:
                 port_id(hostports_mod.port_key(h))
         NP = max(len(port_keys), 1)
-        pod_ports = np.zeros((P_pad, NP), dtype=bool)
-        pod_port_conf = np.zeros((P_pad, NP), dtype=bool)
-        for i, p in enumerate(padded):
+        pod_ports_k = np.zeros((U, NP), dtype=bool)
+        pod_port_conf_k = np.zeros((U, NP), dtype=bool)
+        for u, p in enumerate(reps):
             for h in p.spec.host_ports:
                 ip, port, proto = hostports_mod.port_key(h)
-                pod_ports[i, port_index[(ip, port, proto)]] = True
+                pod_ports_k[u, port_index[(ip, port, proto)]] = True
                 for j, (jip, jport, jproto) in enumerate(port_keys):
                     if port == jport and proto == jproto and (
                         ip == hostports_mod.WILDCARD_IP
                         or jip == hostports_mod.WILDCARD_IP
                         or ip == jip
                     ):
-                        pod_port_conf[i, j] = True
+                        pod_port_conf_k[u, j] = True
+        pod_ports = pod_ports_k[kind_of]
+        pod_port_conf = pod_port_conf_k[kind_of]
         exist_ports0 = np.zeros((E, NP), dtype=bool)
         for e, n in enumerate(self.existing_nodes):
             for key in n.host_ports:
@@ -407,7 +473,24 @@ class TPUScheduler:
         exist_tensors = exist_tensors._replace(ports=jnp.asarray(exist_ports0))
 
         zone_kid, ct_kid = self.encoder.zone_ct_key_ids()
-        result = ops_solver.solve(
+        # static set of vocab keys topology groups narrow — the solver
+        # handles these with exact per-key corrections so topology-mixed
+        # workloads stay on the fast incremental tier-2 path
+        topo_kids = tuple(
+            sorted(
+                {
+                    int(k)
+                    for k, valid in zip(
+                        np.asarray(topo_tensors.vg_key), np.asarray(topo_tensors.vg_valid)
+                    )
+                    if valid
+                }
+            )
+        )
+        import time as _time
+
+        _t_encode_done = _time.perf_counter()
+        result = self._run_solve(
             pt,
             jnp.asarray(tol),
             jnp.asarray(it_allow),
@@ -415,17 +498,90 @@ class TPUScheduler:
             jnp.asarray(pod_ports),
             jnp.asarray(pod_port_conf),
             exist_tensors,
-            self.it_tensors,
             template_tensors,
-            self.well_known,
             topo_tensors,
             pod_topo,
             zone_kid=zone_kid,
             ct_kid=ct_kid,
             n_claims=n_claims,
-            mv_active=self._mv_active,
+            topo_kids=topo_kids,
         )
-        return self._decode(pods_sorted, result, E)
+        result.assignment.block_until_ready()
+        _t_device_done = _time.perf_counter()
+        out = self._decode(pods_sorted, result, E)
+        _t_end = _time.perf_counter()
+        # phase timings for profiling/bench (VERDICT: expose the device vs
+        # host split so optimization work isn't flying blind)
+        self.last_timings = {
+            "encode_s": _t_encode_done - self._t_solve_start,
+            "device_s": _t_device_done - _t_encode_done,
+            "decode_s": _t_end - _t_device_done,
+        }
+        return out
+
+    def _run_solve(
+        self,
+        pt,
+        tol,
+        it_allow,
+        exist_ok,
+        pod_ports,
+        pod_port_conf,
+        exist_tensors,
+        template_tensors,
+        topo_tensors,
+        pod_topo,
+        *,
+        zone_kid,
+        ct_kid,
+        n_claims,
+        topo_kids,
+    ) -> ops_solver.SolveResult:
+        """Dispatch the scan, chunking large pod batches: one compiled
+        executable per chunk shape, bounded per-dispatch transfers, and the
+        SolverState carried across calls — bit-identical to a single scan."""
+        from karpenter_tpu.ops import kernels
+
+        P_pad = pt.valid.shape[0]
+        chunk = self.solve_chunk
+        common = dict(
+            zone_kid=zone_kid,
+            ct_kid=ct_kid,
+            n_claims=n_claims,
+            mv_active=self._mv_active,
+            topo_kids=topo_kids,
+        )
+        if P_pad <= chunk:
+            return ops_solver.solve(
+                pt, tol, it_allow, exist_ok, pod_ports, pod_port_conf,
+                exist_tensors, self.it_tensors, template_tensors,
+                self.well_known, topo_tensors, pod_topo, **common,
+            )
+        state = ops_solver.initial_state(
+            exist_tensors, self.it_tensors, template_tensors, topo_tensors,
+            n_claims, pod_ports.shape[1],
+        )
+        parts = []
+        for lo in range(0, P_pad, chunk):
+            sl = slice(lo, lo + chunk)
+            pt_c = ops_solver.PodTensors(
+                reqs=kernels.take_set(pt.reqs, sl),
+                strict_reqs=kernels.take_set(pt.strict_reqs, sl),
+                requests=pt.requests[sl],
+                valid=pt.valid[sl],
+            )
+            topo_c = topo_ops.take_pod_topology(pod_topo, sl)
+            res = ops_solver.solve_from(
+                state, pt_c, tol[sl], it_allow[sl], exist_ok[sl],
+                pod_ports[sl], pod_port_conf[sl],
+                exist_tensors, self.it_tensors, template_tensors,
+                self.well_known, topo_tensors, topo_c, **common,
+            )
+            state = res.claims
+            parts.append(res.assignment)
+        return ops_solver.SolveResult(
+            assignment=jnp.concatenate(parts), claims=state
+        )
 
     def _decode(self, pods_sorted: list[Pod], result: ops_solver.SolveResult, E: int) -> SchedulingResult:
         """Replay assignments host-side to rebuild exact claim objects.

@@ -99,6 +99,63 @@ def compatible_elemwise(a: ReqSetTensors, b: ReqSetTensors, well_known: jnp.ndar
     return jnp.all(custom_ok, axis=-1) & intersects_elemwise(a, b)
 
 
+def set_eq_rows(a: ReqSetTensors, b: ReqSetTensors) -> jnp.ndarray:
+    """[..., K] bool — full-tuple per-key equality over broadcastable
+    batches (same mask, complement bit, exclusions, bounds, defined).
+
+    Two equal encodings denote the same requirement, so any intersection
+    test against a third set gives identical results — the foundation of
+    the solver's incremental tier-2 classification.
+    """
+    return (
+        jnp.all(a.mask == b.mask, axis=-1)
+        & (a.inf == b.inf)
+        & (a.excl == b.excl)
+        & (a.gte == b.gte)
+        & (a.lte == b.lte)
+        & (a.defined == b.defined)
+    )
+
+
+def per_key_ok_table(a: ReqSetTensors, b: ReqSetTensors) -> jnp.ndarray:
+    """[A, K] bool — the per-key term of intersects() between every row of
+    a and a SINGLE set b (shape [K, V]): ~shared | nonempty | both_lenient.
+
+    intersects(a_i, b) == all_k(per_key_ok_table(a, b)[i, k]).
+    """
+    shared = a.defined & b.defined[None, :]
+    hit = jnp.any(a.mask & b.mask[None], axis=-1)
+    gte = jnp.maximum(a.gte, b.gte[None, :])
+    lte = jnp.minimum(a.lte, b.lte[None, :])
+    nonempty = hit | (a.inf & b.inf[None, :] & (gte <= lte))
+    both_lenient = lenient(a) & lenient(b)[None, :]  # lenient() is shape-generic
+    return ~shared | nonempty | both_lenient
+
+
+def per_key_ok_at(a: ReqSetTensors, b: ReqSetTensors, k: int) -> jnp.ndarray:
+    """[B, A] bool — the per-key intersects() term at static key k between
+    every row of a ([A, K, V]) and every row of b ([B, K, V]).
+
+    The [B, A] orientation matches the solver's [claims, types] layout.
+    """
+    shared = b.defined[:, None, k] & a.defined[None, :, k]
+    hit = (
+        jnp.einsum(
+            "bv,av->ba",
+            b.mask[:, k, :].astype(jnp.bfloat16),
+            a.mask[:, k, :].astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        > 0.0
+    )
+    gte = jnp.maximum(b.gte[:, None, k], a.gte[None, :, k])
+    lte = jnp.minimum(b.lte[:, None, k], a.lte[None, :, k])
+    nonempty = hit | (b.inf[:, None, k] & a.inf[None, :, k] & (gte <= lte))
+    len_a = lenient(a)[None, :, k]
+    len_b = lenient(b)[:, None, k]
+    return ~shared | nonempty | (len_a & len_b)
+
+
 def intersect_sets(a: ReqSetTensors, b: ReqSetTensors) -> ReqSetTensors:
     """Elementwise requirement-set intersection over a shared batch shape.
 

@@ -130,9 +130,9 @@ def _fits_and_offering(
     C = it.zc_avail.shape[3]
     off = jnp.einsum(
         "tgzc,nz,nc->ntg",
-        it.zc_avail,
-        zmask[:, :Z],
-        cmask[:, :C],
+        it.zc_avail.astype(jnp.bfloat16),
+        zmask[:, :Z].astype(jnp.bfloat16),
+        cmask[:, :C].astype(jnp.bfloat16),
         preferred_element_type=jnp.float32,
     ) > 0
     return jnp.any(fit & off, axis=-1)  # [B, T]
@@ -172,8 +172,8 @@ def _min_values_ok(
     present = (
         jnp.einsum(
             "ct,tjv->cjv",
-            viable.astype(jnp.float32),
-            mv_it_values.astype(jnp.float32),
+            viable.astype(jnp.bfloat16),
+            mv_it_values.astype(jnp.bfloat16),
             preferred_element_type=jnp.float32,
         )
         > 0
@@ -187,33 +187,29 @@ def _min_values_ok(
     return jnp.all(ok, axis=-1)
 
 
-@functools.partial(jax.jit, static_argnames=("zone_kid", "ct_kid", "n_claims", "mv_active"))
-def solve(
-    pods: PodTensors,
-    pod_tmpl_ok: jnp.ndarray,  # [P, G] bool — tolerates taints + skipped-key static checks
-    pod_it_allow: jnp.ndarray,  # [P, T] bool — instance types the pod's NAME selector admits
-    pod_exist_ok: jnp.ndarray,  # [P, E] bool — static checks vs existing nodes
-    pod_ports: jnp.ndarray,  # [P, NP] bool — the pod's own host-port keys
-    pod_port_conf: jnp.ndarray,  # [P, NP] bool — keys the pod CONFLICTS with (wildcard-expanded)
+def _make_step(
     exist: ExistingNodes,
     it: InstanceTypeTensors,
     templates: Templates,
-    well_known: jnp.ndarray,  # [K] bool
+    well_known: jnp.ndarray,
     topo: TopologyTensors,
-    pod_topo: PodTopology,
     zone_kid: int,
     ct_kid: int,
     n_claims: int,
-    mv_active: bool = False,
-) -> SolveResult:
+    mv_active: bool,
+    topo_kids: tuple,
+):
+    """Build the per-pod scan step closure shared by solve/solve_from."""
     N = n_claims
     K = it.reqs.mask.shape[1]
-    V = it.reqs.mask.shape[2]
-    R = it.alloc.shape[2]
-    T = it.alloc.shape[0]
     E = exist.avail.shape[0]
     G = templates.its.shape[0]
     no_wk = jnp.zeros_like(well_known)
+    # static [K] mask of keys handled exactly per-step (topology narrowing);
+    # the incremental tier-2 classification covers the rest
+    kid_mask = jnp.zeros(K, dtype=bool)
+    for k in topo_kids:
+        kid_mask = kid_mask.at[k].set(True)
 
     def step(state: SolverState, xs):
         (
@@ -280,7 +276,48 @@ def solve(
         # the topology-narrowed requirements feed instance-type filtering
         # (nodeclaim.go:199-213: topology comes before the IT filter)
         comb_t = _apply_topo(comb, upd_n, key_touched)
-        it_compat = kernels.intersects(it.reqs, comb_t).T  # [N, T]
+
+        # ---- incremental it-compat (replaces the O(N·T·K·V) per-step
+        # intersects recompute — the round-1 dominant cost). Each
+        # (claim, key) of comb_t is classified:
+        #   == pod row   -> read the per-step [T, K] pod×type table
+        #   == claim row -> implied true wherever state.its holds (state.its
+        #                   certifies intersects(it, claim) from the step
+        #                   that stored the row)
+        #   topology key -> exact per-key einsum (static, small set)
+        #   otherwise    -> partial-overlap conflict; rare -> lax.cond runs
+        #                   the full pairwise intersects for this step.
+        # Only claims that can be picked (open & Compatible) gate the
+        # fallback; garbage values elsewhere are masked by feas/state.its.
+        eqP = kernels.set_eq_rows(comb_t, _broadcast_pod(pod_reqs, N))  # [N, K]
+        eqC = kernels.set_eq_rows(comb_t, state.reqs)  # [N, K]
+        nonkid = ~kid_mask[None, :]
+        need_exact = ~eqP & ~eqC & nonkid
+        any_fallback = jnp.any(
+            state.open & claim_ok & jnp.any(need_exact, axis=-1)
+        )
+
+        def _full_compat():
+            return kernels.intersects(it.reqs, comb_t).T  # [N, T]
+
+        def _fast_compat():
+            pod_tkok = kernels.per_key_ok_table(it.reqs, pod_reqs)  # [T, K]
+            use_pk = (eqP & ~eqC & nonkid).astype(jnp.bfloat16)
+            viol = (
+                jnp.einsum(
+                    "nk,tk->nt",
+                    use_pk,
+                    (~pod_tkok).astype(jnp.bfloat16),
+                    preferred_element_type=jnp.float32,
+                )
+                > 0.0
+            )
+            ok = ~viol
+            for k in topo_kids:
+                ok &= kernels.per_key_ok_at(it.reqs, comb_t, k)
+            return ok
+
+        it_compat = jax.lax.cond(any_fallback, _full_compat, _fast_compat)
         total = state.used + pod_requests[None, :]
         fits_off = _fits_and_offering(total, comb_t, it, zone_kid, ct_kid)
         new_its = state.its & it_compat & fits_off & it_allow[None, :]
@@ -464,7 +501,25 @@ def solve(
             assignment,
         )
 
-    state = SolverState(
+    return step
+
+
+def initial_state(
+    exist: ExistingNodes,
+    it: InstanceTypeTensors,
+    templates: Templates,
+    topo: TopologyTensors,
+    n_claims: int,
+    n_ports: int,
+) -> SolverState:
+    """The empty carry (no pods placed yet)."""
+    N = n_claims
+    K = it.reqs.mask.shape[1]
+    V = it.reqs.mask.shape[2]
+    R = it.alloc.shape[2]
+    T = it.alloc.shape[0]
+    E = exist.avail.shape[0]
+    return SolverState(
         exist_reqs=exist.reqs,
         exist_used=jnp.zeros((E, R), dtype=jnp.float32),
         reqs=identity_reqs(N, K, V),
@@ -479,9 +534,12 @@ def solve(
         vg_counts=topo.vg_counts0,
         hg_counts=topo.hg_counts0,
         exist_ports=exist.ports,
-        claim_ports=jnp.zeros((N, pod_ports.shape[1]), dtype=bool),
+        claim_ports=jnp.zeros((N, n_ports), dtype=bool),
     )
-    xs = (
+
+
+def _xs(pods, pod_tmpl_ok, pod_it_allow, pod_exist_ok, pod_ports, pod_port_conf, pod_topo):
+    return (
         pods.reqs,
         pods.requests,
         pod_tmpl_ok,
@@ -498,6 +556,69 @@ def solve(
         pod_topo.hg_self,
         pod_topo.strict_mask,
     )
+
+
+_STATIC = ("zone_kid", "ct_kid", "n_claims", "mv_active", "topo_kids")
+
+
+@functools.partial(jax.jit, static_argnames=_STATIC)
+def solve(
+    pods: PodTensors,
+    pod_tmpl_ok: jnp.ndarray,  # [P, G] bool — tolerates taints + skipped-key static checks
+    pod_it_allow: jnp.ndarray,  # [P, T] bool — instance types the pod's NAME selector admits
+    pod_exist_ok: jnp.ndarray,  # [P, E] bool — static checks vs existing nodes
+    pod_ports: jnp.ndarray,  # [P, NP] bool — the pod's own host-port keys
+    pod_port_conf: jnp.ndarray,  # [P, NP] bool — keys the pod CONFLICTS with (wildcard-expanded)
+    exist: ExistingNodes,
+    it: InstanceTypeTensors,
+    templates: Templates,
+    well_known: jnp.ndarray,  # [K] bool
+    topo: TopologyTensors,
+    pod_topo: PodTopology,
+    zone_kid: int,
+    ct_kid: int,
+    n_claims: int,
+    mv_active: bool = False,
+    topo_kids: tuple = (),
+) -> SolveResult:
+    state = initial_state(exist, it, templates, topo, n_claims, pod_ports.shape[1])
+    step = _make_step(
+        exist, it, templates, well_known, topo, zone_kid, ct_kid, n_claims, mv_active, topo_kids
+    )
+    xs = _xs(pods, pod_tmpl_ok, pod_it_allow, pod_exist_ok, pod_ports, pod_port_conf, pod_topo)
+    state, assignment = jax.lax.scan(step, state, xs)
+    return SolveResult(assignment=assignment, claims=state)
+
+
+@functools.partial(jax.jit, static_argnames=_STATIC)
+def solve_from(
+    state: SolverState,
+    pods: PodTensors,
+    pod_tmpl_ok: jnp.ndarray,
+    pod_it_allow: jnp.ndarray,
+    pod_exist_ok: jnp.ndarray,
+    pod_ports: jnp.ndarray,
+    pod_port_conf: jnp.ndarray,
+    exist: ExistingNodes,
+    it: InstanceTypeTensors,
+    templates: Templates,
+    well_known: jnp.ndarray,
+    topo: TopologyTensors,
+    pod_topo: PodTopology,
+    zone_kid: int,
+    ct_kid: int,
+    n_claims: int,
+    mv_active: bool = False,
+    topo_kids: tuple = (),
+) -> SolveResult:
+    """Resume the scan from an explicit carry — the chunked-solve entry:
+    the host splits a large pod batch into fixed-size chunks (bounded
+    per-dispatch transfers and a single compiled executable) and threads
+    SolverState between calls. Bit-identical to one big scan."""
+    step = _make_step(
+        exist, it, templates, well_known, topo, zone_kid, ct_kid, n_claims, mv_active, topo_kids
+    )
+    xs = _xs(pods, pod_tmpl_ok, pod_it_allow, pod_exist_ok, pod_ports, pod_port_conf, pod_topo)
     state, assignment = jax.lax.scan(step, state, xs)
     return SolveResult(assignment=assignment, claims=state)
 

@@ -195,6 +195,19 @@ def encode_pod_topology(topology, vg, hg, pods, strict_tensors: ReqSetTensors) -
     )
 
 
+def take_pod_topology(pt: PodTopology, idx) -> PodTopology:
+    """Index/slice every per-pod row (kind gathers, chunk slices)."""
+    return PodTopology(
+        vg_applies=pt.vg_applies[idx],
+        vg_records=pt.vg_records[idx],
+        vg_self=pt.vg_self[idx],
+        hg_applies=pt.hg_applies[idx],
+        hg_records=pt.hg_records[idx],
+        hg_self=pt.hg_self[idx],
+        strict_mask=pt.strict_mask[idx],
+    )
+
+
 def _pow2(n: int, floor: int = 1) -> int:
     out = floor
     while out < n:

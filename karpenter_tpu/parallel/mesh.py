@@ -106,6 +106,7 @@ def sharded_solve(
     ct_kid: int,
     n_claims: int,
     mv_active: bool = False,
+    topo_kids: tuple = (),
 ):
     """Run ops_solver.solve with the catalog sharded over the "it" mesh axis.
 
@@ -140,4 +141,5 @@ def sharded_solve(
         ct_kid=ct_kid,
         n_claims=n_claims,
         mv_active=mv_active,
+        topo_kids=topo_kids,
     )

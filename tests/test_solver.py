@@ -511,6 +511,129 @@ class TestTopologyDifferential:
         assert_same_packing(host, tpu)
 
 
+class TestIncrementalCompat:
+    """The tier-2 fast path classifies (claim, key) rows by comb==pod /
+    comb==claim; these cases force the remaining classes."""
+
+    def _solve_both(self, pods, n_types=32):
+        from karpenter_tpu.controllers.provisioning.topology import (
+            Topology,
+            build_universe_domains,
+        )
+
+        templates = build_templates([(default_pool(), instance_types(n_types))])
+        universe = build_universe_domains(templates)
+        host = HostScheduler(
+            templates, topology=Topology.build(pods, universe)
+        ).solve(pods)
+        tpu = TPUScheduler(templates).solve(
+            pods, topology=Topology.build(pods, universe)
+        )
+        assert_same_packing(host, tpu)
+        return host, tpu
+
+    def test_partial_overlap_selectors_force_exact_fallback(self):
+        """Zone selectors {1,2} and {2,3} interleaved: the second pod's
+        comb on the zone key ({2}) equals neither its own row nor the
+        claim's — the lax.cond fallback must reproduce full semantics."""
+        from karpenter_tpu.models.pod import NodeAffinity, NodeSelectorTerm
+
+        pods = []
+        for i in range(8):
+            zones = (
+                ["test-zone-1", "test-zone-2"]
+                if i % 2 == 0
+                else ["test-zone-2", "test-zone-3"]
+            )
+            p = make_pod(f"p-{i}", cpu=0.5, memory="1Gi")
+            p.spec.node_affinity = NodeAffinity(
+                required=[
+                    NodeSelectorTerm(
+                        match_expressions=[
+                            {
+                                "key": l.LABEL_TOPOLOGY_ZONE,
+                                "operator": "In",
+                                "values": zones,
+                            }
+                        ]
+                    )
+                ]
+            )
+            pods.append(p)
+        host, tpu = self._solve_both(pods)
+        assert not tpu.unschedulable
+
+    def test_disjoint_selectors_never_share_a_claim(self):
+        """Disjoint zone selectors make comb empty on the zone key — the
+        claims must stay separate in both engines."""
+        pods = []
+        for i in range(6):
+            zone = "test-zone-1" if i % 2 == 0 else "test-zone-2"
+            pods.append(
+                make_pod(
+                    f"p-{i}",
+                    cpu=0.5,
+                    memory="1Gi",
+                    node_selector={l.LABEL_TOPOLOGY_ZONE: zone},
+                )
+            )
+        host, tpu = self._solve_both(pods)
+        for c in tpu.claims:
+            assert len(c.requirements.get(l.LABEL_TOPOLOGY_ZONE).values) == 1
+
+    def test_namespace_scoped_kinds_not_deduped(self):
+        """Content-identical pods in different namespaces belong to
+        different (per-namespace) topology groups — kind dedup must keep
+        them apart or anti-affinity leaks across namespaces."""
+        pods = []
+        for i in range(4):
+            p = make_pod(f"p-{i}", cpu=0.5, memory="1Gi")
+            p.metadata.namespace = "ns-a" if i % 2 == 0 else "ns-b"
+            p.metadata.labels = {"app": "nginx"}
+            from karpenter_tpu.models.pod import PodAffinityTerm
+
+            p.spec.pod_anti_affinity = [
+                PodAffinityTerm(
+                    topology_key=l.LABEL_HOSTNAME, label_selector={"app": "nginx"}
+                )
+            ]
+            pods.append(p)
+        host, tpu = self._solve_both(pods)
+        # anti-affinity is namespace-scoped: same-namespace pods separate,
+        # cross-namespace pods may share -> 2 nodes of one pod per namespace
+        assert len(tpu.claims) == 2
+        for c in tpu.claims:
+            assert len({p.metadata.namespace for p in c.pods}) == len(c.pods)
+
+    def test_narrowing_selector_lands_on_wider_claim(self):
+        """A wide-selector pod opens a claim; a narrower pod (comb == pod
+        row, the precomputed-table class) joins and narrows it."""
+        from karpenter_tpu.models.pod import NodeAffinity, NodeSelectorTerm
+
+        wide = make_pod("wide", cpu=0.5, memory="1Gi")
+        wide.spec.node_affinity = NodeAffinity(
+            required=[
+                NodeSelectorTerm(
+                    match_expressions=[
+                        {
+                            "key": l.LABEL_TOPOLOGY_ZONE,
+                            "operator": "In",
+                            "values": ["test-zone-1", "test-zone-2", "test-zone-3"],
+                        }
+                    ]
+                )
+            ]
+        )
+        narrow = make_pod(
+            "narrow",
+            cpu=0.5,
+            memory="1Gi",
+            node_selector={l.LABEL_TOPOLOGY_ZONE: "test-zone-2"},
+        )
+        host, tpu = self._solve_both([wide, narrow])
+        assert not tpu.unschedulable
+
+
 class TestMinValues:
     def _pool(self, key, mv):
         return default_pool(
