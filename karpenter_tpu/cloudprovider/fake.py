@@ -56,7 +56,12 @@ def new_instance_type(
     capacity_types: tuple[str, ...] = (l.CAPACITY_TYPE_SPOT, l.CAPACITY_TYPE_ON_DEMAND),
     extra_resources: Optional[dict[str, float]] = None,
     price_multiplier: float = 1.0,
+    reservations: Optional[list[tuple[str, str, int]]] = None,
 ) -> InstanceType:
+    """reservations: [(zone, reservation_id, capacity)] — adds reserved
+    offerings (capacity-type=reserved + reservation-id requirement,
+    priced 0 per the reserved->spot->on-demand launch-price precedence,
+    types.go:587-598)."""
     mem_ratio = FAMILIES[family][1]
     memory = cpu * mem_ratio * GIB
     capacity = {
@@ -80,6 +85,24 @@ def new_instance_type(
                 available=True,
             )
         )
+    for zone, rid, cap in reservations or ():
+        offerings.append(
+            Offering(
+                requirements=Requirements(
+                    Requirement.new(l.LABEL_TOPOLOGY_ZONE, Operator.IN, zone),
+                    Requirement.new(
+                        l.CAPACITY_TYPE_LABEL_KEY, Operator.IN, l.CAPACITY_TYPE_RESERVED
+                    ),
+                    Requirement.new(l.RESERVATION_ID_LABEL_KEY, Operator.IN, rid),
+                ),
+                price=0.0,
+                available=True,
+                reservation_capacity=cap,
+            )
+        )
+    capacity_types_all = tuple(capacity_types) + (
+        (l.CAPACITY_TYPE_RESERVED,) if reservations else ()
+    )
     requirements = Requirements(
         Requirement.new(l.LABEL_INSTANCE_TYPE, Operator.IN, name),
         Requirement.new("karpenter-tpu.sh/instance-family", Operator.IN, family),
@@ -87,8 +110,16 @@ def new_instance_type(
         Requirement.new(l.LABEL_ARCH, Operator.IN, arch),
         Requirement.new(l.LABEL_OS, Operator.IN, os),
         Requirement.new(l.LABEL_TOPOLOGY_ZONE, Operator.IN, *zones),
-        Requirement.new(l.CAPACITY_TYPE_LABEL_KEY, Operator.IN, *capacity_types),
+        Requirement.new(l.CAPACITY_TYPE_LABEL_KEY, Operator.IN, *capacity_types_all),
     )
+    if reservations:
+        requirements.add(
+            Requirement.new(
+                l.RESERVATION_ID_LABEL_KEY,
+                Operator.IN,
+                *sorted({rid for _, rid, _ in reservations}),
+            )
+        )
     overhead = InstanceTypeOverhead(
         kube_reserved={res.CPU: 0.080 + cpu * 0.002, res.MEMORY: 255.0 * 2**20 + memory * 0.01},
         system_reserved={res.CPU: 0.0, res.MEMORY: 100.0 * 2**20},

@@ -21,7 +21,7 @@ from karpenter_tpu.scheduling.requirements import (
 )
 from karpenter_tpu.utils import resources as res
 
-RESERVATION_ID_LABEL = l.GROUP + "/reservation-id"
+RESERVATION_ID_LABEL = l.RESERVATION_ID_LABEL_KEY
 
 MAX_FLOAT = math.inf
 
@@ -190,10 +190,19 @@ class InstanceType:
         return [o for o in self.offerings if o.available]
 
     def cheapest_offering_price(self, reqs: Requirements) -> float:
-        """Cheapest available offering compatible with reqs, inf if none."""
+        """Cheapest available LAUNCHABLE offering compatible with reqs, inf
+        if none. Reserved offerings only count when the requirements pin a
+        reservation id — a provider never launches into a reservation the
+        claim doesn't name (FinalizeScheduling injects the pin,
+        nodeclaim.go:393-401), so an unpinned claim prices at spot/OD."""
+        pinned = reqs.has(RESERVATION_ID_LABEL)
         best = MAX_FLOAT
         for o in self.offerings:
-            if o.available and reqs.is_compatible(o.requirements, l.WELL_KNOWN_LABELS):
+            if not o.available:
+                continue
+            if o.capacity_type == l.CAPACITY_TYPE_RESERVED and not pinned:
+                continue
+            if reqs.is_compatible(o.requirements, l.WELL_KNOWN_LABELS):
                 best = min(best, o.price)
         return best
 

@@ -43,12 +43,19 @@ class KwokCloudProvider(CloudProvider):
     def _resolve(self, claim: NodeClaim) -> tuple[InstanceType, Offering]:
         """Cheapest compatible (type, offering) for the claim's requirements
         (kwok cloudprovider.go:59-88)."""
+        from karpenter_tpu.cloudprovider.instancetype import RESERVATION_ID_LABEL
+
         reqs = Requirements.from_node_selector_requirements(claim.spec.requirements)
+        # a provider only launches into a reservation the claim names
+        # (the scheduler pins reservation-id at FinalizeScheduling)
+        rid_pinned = reqs.has(RESERVATION_ID_LABEL)
         best: Optional[tuple[float, InstanceType, Offering]] = None
         for it in self.catalog:
             if it.requirements.intersects(reqs) is not None:
                 continue
             for o in it.available_offerings():
+                if o.capacity_type == l.CAPACITY_TYPE_RESERVED and not rid_pinned:
+                    continue
                 if not reqs.is_compatible(o.requirements, l.WELL_KNOWN_LABELS):
                     continue
                 if best is None or o.price < best[0]:
@@ -61,6 +68,12 @@ class KwokCloudProvider(CloudProvider):
 
     def create(self, claim: NodeClaim) -> NodeClaim:
         it, offering = self._resolve(claim)
+        if offering.capacity_type == l.CAPACITY_TYPE_RESERVED:
+            # the provider is the source of truth for reservation usage: a
+            # launch consumes a slot, so the catalog the NEXT scheduling
+            # loop reads reflects it (AWS refreshes ReservationCapacity on
+            # every GetInstanceTypes; types.go:482-487)
+            offering.reservation_capacity = max(offering.reservation_capacity - 1, 0)
         seq = next(_instance_counter)
         provider_id = f"kwok://{claim.name}-{seq}"
         node_name = f"{claim.name}-{seq}"
@@ -75,6 +88,10 @@ class KwokCloudProvider(CloudProvider):
                 l.LABEL_HOSTNAME: node_name,
             }
         )
+        if offering.capacity_type == l.CAPACITY_TYPE_RESERVED:
+            from karpenter_tpu.cloudprovider.instancetype import RESERVATION_ID_LABEL
+
+            labels[RESERVATION_ID_LABEL] = offering.reservation_id
         claim.status.provider_id = provider_id
         claim.status.capacity = dict(it.capacity)
         claim.status.allocatable = dict(it.allocatable())
@@ -101,6 +118,24 @@ class KwokCloudProvider(CloudProvider):
         node = self.store.node_by_provider_id(claim.status.provider_id)
         if node is None:
             raise errors.NodeClaimNotFoundError(claim.status.provider_id)
+        # terminating a reserved instance frees its reservation slot
+        labels = node.metadata.labels
+        if labels.get(l.CAPACITY_TYPE_LABEL_KEY) == l.CAPACITY_TYPE_RESERVED:
+            from karpenter_tpu.cloudprovider.instancetype import RESERVATION_ID_LABEL
+
+            rid = labels.get(RESERVATION_ID_LABEL)
+            it_name = labels.get(l.LABEL_INSTANCE_TYPE)
+            for it in self.catalog:
+                if it.name != it_name:
+                    continue
+                for o in it.offerings:
+                    if (
+                        o.capacity_type == l.CAPACITY_TYPE_RESERVED
+                        and o.reservation_id == rid
+                        and o.zone == labels.get(l.LABEL_TOPOLOGY_ZONE)
+                    ):
+                        o.reservation_capacity += 1
+                        break
         node.metadata.finalizers = []
         self.store.delete(ObjectStore.NODES, node.name)
 

@@ -58,7 +58,9 @@ class OrchestrationQueue:
         for sim in command.replacements:
             claim = self.provisioner._to_node_claim(sim)
             metrics.NODECLAIMS_CREATED.inc(
-                reason=command.reason, nodepool=sim.template.nodepool_name
+                reason=command.reason,
+                nodepool=sim.template.nodepool_name,
+                min_values_relaxed="true" if sim.min_values_relaxed else "false",
             )
             self.store.create(ObjectStore.NODECLAIMS, claim)
             self.cluster.update_nodeclaim(claim)

@@ -52,6 +52,8 @@ class Manager:
             cloud,
             self.clock,
             ignore_preferences=self.options.preference_policy == "Ignore",
+            reserved_capacity_enabled=self.options.feature_gates.reserved_capacity,
+            min_values_policy=self.options.min_values_policy,
         )
         self.lifecycle = NodeClaimLifecycleController(store, cloud, self.clock)
         self.nodeclaim_disruption = NodeClaimDisruptionController(store, cloud, self.clock)

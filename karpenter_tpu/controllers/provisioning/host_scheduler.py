@@ -40,6 +40,10 @@ class SimClaim:
     slot: int = 0
     hostname: str = ""  # placeholder hostname (nodeclaim.go:93)
     host_ports: list[tuple] = field(default_factory=list)
+    # reservation ids this claim pessimistically holds (nodeclaim.go:52-60)
+    reserved_ids: frozenset = frozenset()
+    # BestEffort minValues relaxation happened (scheduler.go:769)
+    min_values_relaxed: bool = False
 
     def cheapest_launch(self) -> tuple[Optional[InstanceType], float]:
         """Cheapest (type, price) among viable types/offerings compatible
@@ -104,6 +108,24 @@ def hostname_placeholder(seq: int) -> str:
     return f"hostname-placeholder-{seq:04d}"
 
 
+def finalize_reserved(claim: SimClaim) -> None:
+    """FinalizeScheduling's reserved-capacity injection (nodeclaim.go:385-
+    401): a claim holding reservations is pinned to capacity-type=reserved
+    + its reservation ids so multiple claims never over-launch into one
+    reservation. Shared by both engines' decode paths."""
+    if not claim.reserved_ids:
+        return
+    from karpenter_tpu.cloudprovider.instancetype import RESERVATION_ID_LABEL
+    from karpenter_tpu.scheduling import Operator, Requirement
+
+    claim.requirements.add(
+        Requirement.new(l.CAPACITY_TYPE_LABEL_KEY, Operator.IN, l.CAPACITY_TYPE_RESERVED)
+    )
+    claim.requirements.add(
+        Requirement.new(RESERVATION_ID_LABEL, Operator.IN, *sorted(claim.reserved_ids))
+    )
+
+
 def ffd_sort(pods: list[Pod]) -> list[Pod]:
     """CPU+memory descending (queue.go:72-90); stable on ties."""
     return sorted(
@@ -116,11 +138,18 @@ def ffd_sort(pods: list[Pod]) -> list[Pod]:
 
 
 def filter_instance_types(
-    its: list[InstanceType], requirements: Requirements, total_requests: dict[str, float]
+    its: list[InstanceType],
+    requirements: Requirements,
+    total_requests: dict[str, float],
+    relax_min_values: bool = False,
 ) -> list[InstanceType]:
     """The inner kernel (nodeclaim.go:541): keep types where requirements
     intersect AND requests fit an allocatable group AND that group has a
-    compatible available offering."""
+    compatible available offering.
+
+    relax_min_values (MinValuesPolicy=BestEffort, nodeclaim.go:606-613):
+    unmet minValues floors keep the surviving set instead of emptying it;
+    the achievable floors are written back at finalize."""
     remaining = []
     for it in its:
         if not it.requirements.intersects_ok(requirements):
@@ -129,13 +158,32 @@ def filter_instance_types(
             remaining.append(it)
     # minValues (nodeclaim.go:606-617, Strict policy): the surviving set
     # must retain enough distinct values per min-keyed requirement
-    if remaining and requirements.has_min_values():
+    if remaining and requirements.has_min_values() and not relax_min_values:
         from karpenter_tpu.cloudprovider.instancetype import satisfies_min_values
 
         _, _, err = satisfies_min_values(remaining, requirements)
         if err:
             return []
     return remaining
+
+
+def finalize_min_values(claim: SimClaim) -> None:
+    """BestEffort bookkeeping at the end of a solve (scheduler.go:763-772 +
+    nodeclaim.go:214-219): floors the final viable set cannot meet are
+    lowered to the achievable distinct-value count and the claim is
+    flagged relaxed. No-op for satisfiable floors (and always a no-op
+    under Strict, where unmet floors never survive the filter)."""
+    reqs = claim.requirements
+    if not reqs.has_min_values():
+        return
+    from karpenter_tpu.cloudprovider.instancetype import satisfies_min_values
+
+    _, unsat, err = satisfies_min_values(claim.instance_types, reqs)
+    if not err:
+        return
+    for key, achievable in unsat.items():
+        reqs.relax_min_values(key, achievable)
+    claim.min_values_relaxed = True
 
 
 def _fits_and_offering(
@@ -158,12 +206,18 @@ class HostScheduler:
         budgets: Optional[dict[str, dict[str, float]]] = None,
         topology: Optional["Topology"] = None,
         volume_reqs: Optional[dict] = None,
+        reserved_mode: str = "fallback",
+        reserved_capacity_enabled: bool = True,
+        min_values_policy: str = "Strict",
+        reserved_in_use: Optional[dict[str, int]] = None,
     ):
         """budgets: nodepool -> remaining resources (limits minus current
         usage; may include the synthetic 'nodes' count). Absent pool =
         unlimited. topology: pre-built Topology (counts seeded from the
         live cluster); None disables topology handling. volume_reqs: pod
-        uid -> PVC-implied zone Requirement."""
+        uid -> PVC-implied zone Requirement. reserved_mode: strict fails
+        adds that would lose reserved capacity (scheduler.go:59-78);
+        fallback lets them fall through to spot/on-demand."""
         from karpenter_tpu.controllers.provisioning.topology import Topology as _T
 
         self.templates = templates
@@ -171,9 +225,32 @@ class HostScheduler:
         self.budgets = {k: dict(v) for k, v in (budgets or {}).items()}
         self.topology = topology if topology is not None else _T()
         self.volume_reqs = volume_reqs or {}
+        self.reserved_mode = reserved_mode
+        self.reserved_capacity_enabled = reserved_capacity_enabled
+        self.min_values_policy = min_values_policy
+        self.reserved_in_use = reserved_in_use or {}
+        self._rm = None
         self._hostname_seq = 0
         for node in self.existing_nodes:
             self.topology.register(l.LABEL_HOSTNAME, node.name)
+
+    def _build_rm(self):
+        """Fresh per-round ReservationManager (scheduler.go:187) — None
+        when the gate is off or no reserved offerings exist."""
+        from karpenter_tpu.scheduling.reservations import ReservationManager
+
+        if not self.reserved_capacity_enabled:
+            return None
+        seen: dict[str, object] = {}
+        for t in self.templates:
+            for it in t.instance_types:
+                seen.setdefault(it.name, it)
+        rm = ReservationManager(seen.values())
+        # ids pinned by in-flight claims the provider hasn't launched yet
+        for rid, n in self.reserved_in_use.items():
+            if rid in rm.capacity:
+                rm.capacity[rid] = max(rm.capacity[rid] - n, 0)
+        return rm if rm.capacity else None
 
     def _next_hostname(self) -> str:
         self._hostname_seq += 1
@@ -230,9 +307,29 @@ class HostScheduler:
         if tightened is None or combined.compatible(tightened, l.WELL_KNOWN_LABELS) is not None:
             return None
         total = res.merge(claim.used, pod.total_requests())
-        remaining = filter_instance_types(claim.instance_types, tightened, total)
+        remaining = filter_instance_types(
+            claim.instance_types, tightened, total,
+            relax_min_values=self.min_values_policy == "BestEffort",
+        )
         if not remaining:
             return None
+        # reserved-capacity accounting (nodeclaim.go:256-262, 304-349)
+        from karpenter_tpu.scheduling.reservations import (
+            ReservedOfferingError,
+            offerings_to_reserve,
+        )
+
+        try:
+            ofs = offerings_to_reserve(
+                self._rm, claim.hostname, remaining, tightened,
+                claim.reserved_ids, self.reserved_mode,
+            )
+        except ReservedOfferingError:
+            return None
+        new_ids = frozenset(o.reservation_id for o in ofs)
+        if self._rm is not None:
+            self._rm.reserve(claim.hostname, ofs)
+            self._rm.release(claim.hostname, *(claim.reserved_ids - new_ids))
         self.topology.record(pod, tightened)
         return SimClaim(
             template=claim.template,
@@ -243,6 +340,7 @@ class HostScheduler:
             slot=claim.slot,
             hostname=claim.hostname,
             host_ports=claim.host_ports + [hp.port_key(h) for h in pod.spec.host_ports],
+            reserved_ids=new_ids,
         )
 
     def _within_budget(self, tmpl: ClaimTemplate, its: list[InstanceType]) -> list[InstanceType]:
@@ -292,10 +390,28 @@ class HostScheduler:
                 continue
             total = res.merge(tmpl.daemon_requests, pod.total_requests())
             candidates = self._within_budget(tmpl, tmpl.instance_types)
-            remaining = filter_instance_types(candidates, tightened, total)
+            remaining = filter_instance_types(
+                candidates, tightened, total,
+                relax_min_values=self.min_values_policy == "BestEffort",
+            )
             if not remaining:
                 self._hostname_seq -= 1
                 continue
+            from karpenter_tpu.scheduling.reservations import (
+                ReservedOfferingError,
+                offerings_to_reserve,
+            )
+
+            try:
+                ofs = offerings_to_reserve(
+                    self._rm, hostname, remaining, tightened,
+                    frozenset(), self.reserved_mode,
+                )
+            except ReservedOfferingError:
+                self._hostname_seq -= 1
+                continue
+            if self._rm is not None:
+                self._rm.reserve(hostname, ofs)
             self._charge_budget(tmpl, remaining)
             self.topology.register(l.LABEL_HOSTNAME, hostname)
             self.topology.record(pod, tightened)
@@ -310,6 +426,7 @@ class HostScheduler:
                 slot=slot,
                 hostname=hostname,
                 host_ports=[hp.port_key(h) for h in pod.spec.host_ports],
+                reserved_ids=frozenset(o.reservation_id for o in ofs),
             )
         return None
 
@@ -335,6 +452,7 @@ class HostScheduler:
         return prefs.run_with_relaxation(list(pods), solve_round)
 
     def _solve_once(self, pods: list[Pod]) -> SchedulingResult:
+        self._rm = self._build_rm()
         claims: list[SimClaim] = []
         unschedulable: list[tuple[Pod, str]] = []
         assignments: dict[str, int] = {}
@@ -371,6 +489,10 @@ class HostScheduler:
                 assignments[pod.uid] = new_claim.slot
             else:
                 unschedulable.append((pod, "no compatible in-flight claim or template"))
+        for claim in claims:
+            finalize_reserved(claim)
+            if self.min_values_policy == "BestEffort":
+                finalize_min_values(claim)
         return SchedulingResult(
             claims=claims,
             unschedulable=unschedulable,

@@ -40,12 +40,16 @@ class Provisioner:
         cloud: CloudProvider,
         clock: Clock,
         ignore_preferences: bool = False,
+        reserved_capacity_enabled: bool = True,
+        min_values_policy: str = "Strict",
     ):
         self.store = store
         self.cluster = cluster
         self.cloud = cloud
         self.clock = clock
         self.ignore_preferences = ignore_preferences  # PreferencePolicy=Ignore
+        self.reserved_capacity_enabled = reserved_capacity_enabled
+        self.min_values_policy = min_values_policy
         self._scheduler_cache: Optional[tuple[tuple, TPUScheduler]] = None
         self._buffer_pods: dict[tuple[str, int], list[Pod]] = {}
 
@@ -135,6 +139,22 @@ class Provisioner:
         )
         return Topology.build(pods, universe, self._bound_pods(excluded_nodes))
 
+    def _reserved_in_use(self) -> dict[str, int]:
+        """Reservation ids pinned by in-flight claims the provider has not
+        launched yet — the catalog's capacities can't reflect them, so the
+        schedulers subtract them from the per-solve snapshot."""
+        from karpenter_tpu.cloudprovider.instancetype import RESERVATION_ID_LABEL
+
+        out: dict[str, int] = {}
+        for c in self.store.nodeclaims():
+            if c.status.provider_id:
+                continue  # launched: the provider's catalog already counts it
+            for r in c.spec.requirements:
+                if r.get("key") == RESERVATION_ID_LABEL and r.get("values"):
+                    rid = r["values"][0]
+                    out[rid] = out.get(rid, 0) + 1
+        return out
+
     def simulate(self, excluded_node_names: set[str], extra_pods: list[Pod]):
         """Consolidation what-if (disruption helpers.go:53-154): schedule
         pending + displaced pods against the cluster minus the excluded
@@ -159,6 +179,7 @@ class Provisioner:
             self._remaining_budgets(),
             topology_factory=lambda ps: self._build_topology(ps, scheduler, excluded_node_names),
             volume_reqs=self._volume_requirements(pods),
+            reserved_in_use=self._reserved_in_use(),
         )
 
     def _existing_sim_nodes(self, excluded: Optional[set[str]] = None) -> list[ExistingSimNode]:
@@ -263,7 +284,11 @@ class Provisioner:
         )
         if self._scheduler_cache is not None and self._scheduler_cache[0] == sig:
             return self._scheduler_cache[1]
-        sched = TPUScheduler(templates)
+        sched = TPUScheduler(
+            templates,
+            reserved_capacity_enabled=self.reserved_capacity_enabled,
+            min_values_policy=self.min_values_policy,
+        )
         self._scheduler_cache = (sig, sched)
         return sched
 
@@ -276,7 +301,9 @@ class Provisioner:
         for sim in result.claims:
             claim = self._to_node_claim(sim)
             metrics.NODECLAIMS_CREATED.inc(
-                reason="provisioning", nodepool=sim.template.nodepool_name
+                reason="provisioning",
+                nodepool=sim.template.nodepool_name,
+                min_values_relaxed="true" if sim.min_values_relaxed else "false",
             )
             self.store.create(ObjectStore.NODECLAIMS, claim)
             # state-ahead-of-cache update (provisioner.go:501-506)
@@ -291,6 +318,11 @@ class Provisioner:
     def _to_node_claim(self, sim: SimClaim) -> NodeClaim:
         tmpl = sim.template
         name = f"{tmpl.nodepool_name}-{new_uid('nc')}"
+        annotations = {
+            l.NODECLAIM_MIN_VALUES_RELAXED_ANNOTATION_KEY: (
+                "true" if sim.min_values_relaxed else "false"
+            )
+        }
         launchable = order_by_price(sim.instance_types, sim.requirements)[:MAX_INSTANCE_TYPES]
         requirements = []
         for r in sim.requirements.values():
@@ -319,6 +351,7 @@ class Provisioner:
                 annotations={
                     l.NODEPOOL_HASH_ANNOTATION_KEY: tmpl.nodepool_hash,
                     l.NODEPOOL_HASH_VERSION_ANNOTATION_KEY: "v1",
+                    **annotations,
                 },
             ),
             spec=NodeClaimSpec(
@@ -349,12 +382,19 @@ class Provisioner:
         from karpenter_tpu.utils import metrics
 
         with metrics.SCHEDULING_DURATION.time():
+            # regular provisioning disables reserved-capacity fallback
+            # (provisioner.go:389 DisableReservedCapacityFallback): a pod
+            # that can't get a reservation retries next loop instead of
+            # launching paid capacity; disruption simulations keep the
+            # fallback default (strict would stalemate drift)
             result = scheduler.solve(
                 pods,
                 self._existing_sim_nodes(),
                 self._remaining_budgets(),
                 topology_factory=lambda ps: self._build_topology(ps, scheduler),
                 volume_reqs=self._volume_requirements(pods),
+                reserved_mode="strict",
+                reserved_in_use=self._reserved_in_use(),
             )
         metrics.SCHEDULING_UNSCHEDULABLE.set(float(len(result.unschedulable)))
         self.create_node_claims(result)

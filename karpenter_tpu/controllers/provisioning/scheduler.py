@@ -57,7 +57,13 @@ class TPUScheduler:
         templates: list[ClaimTemplate],
         max_claims: Optional[int] = None,
         pod_pad: Optional[int] = None,
+        reserved_mode: str = "fallback",
+        reserved_capacity_enabled: bool = True,
+        min_values_policy: str = "Strict",
     ):
+        self.reserved_mode = reserved_mode
+        self.reserved_capacity_enabled = reserved_capacity_enabled
+        self.min_values_policy = min_values_policy
         self.templates = templates
         self.existing_nodes: list[ExistingSimNode] = []
         self.budgets: dict[str, dict[str, float]] = {}
@@ -74,6 +80,7 @@ class TPUScheduler:
 
         self.solve_chunk = int(os.environ.get("KTPU_SOLVE_CHUNK", "2048"))
         self._volume_reqs: dict = {}
+        self._reserved_in_use: dict[str, int] = {}
 
         self.encoder = ProblemEncoder()
         for t in templates:
@@ -176,6 +183,17 @@ class TPUScheduler:
         self.well_known = jnp.asarray(
             np.pad(wk, (0, k_pad - len(wk)), constant_values=False)
         )
+        # reserved-capacity vocabulary (reservationmanager.go:40-47);
+        # capacities are re-read per solve — the provider mutates them as
+        # reserved instances launch and terminate
+        rid_kid, res_vid, rid_names = enc.reservation_ids()
+        self._rid_kid, self._res_vid, self._rid_names = rid_kid, res_vid, rid_names
+        self._res_active = (
+            self.reserved_capacity_enabled
+            and rid_kid >= 0
+            and res_vid >= 0
+            and bool(np.asarray(self.it_tensors.res_ofs).any())
+        )
         self._vocab_sig = self._sig()
 
     def _encode_budgets(self) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -227,6 +245,8 @@ class TPUScheduler:
         topology: Optional[Topology] = None,
         topology_factory=None,
         volume_reqs: Optional[dict] = None,
+        reserved_mode: Optional[str] = None,
+        reserved_in_use: Optional[dict[str, int]] = None,
     ) -> SchedulingResult:
         """Solve with the preference relaxation ladder (preferences.go:38):
         each failing pod sheds ONE preference per round (shared loop in
@@ -244,6 +264,7 @@ class TPUScheduler:
 
         base_existing = list(existing_nodes or [])
         self._volume_reqs = volume_reqs or {}
+        self._reserved_in_use = reserved_in_use or {}
 
         def solve_round(current: list[Pod]) -> SchedulingResult:
             if topology_factory is not None:
@@ -256,7 +277,13 @@ class TPUScheduler:
                 current, [n.clone() for n in base_existing], budgets, topo
             )
 
-        return prefs.run_with_relaxation(list(pods), solve_round)
+        prev_mode = self.reserved_mode
+        if reserved_mode is not None:
+            self.reserved_mode = reserved_mode
+        try:
+            return prefs.run_with_relaxation(list(pods), solve_round)
+        finally:
+            self.reserved_mode = prev_mode
 
     def _kind_sig(self, pod: Pod):
         """Canonical content signature for pod-kind dedup.
@@ -359,6 +386,22 @@ class TPUScheduler:
             self.encoder.observe_resources(n.available)
         if self._vocab_sig != self._sig():
             self._encode_static()
+        # per-solve reservation capacities: current catalog counts (the
+        # provider decrements on launch) minus ids pinned by in-flight
+        # claims the provider hasn't launched yet
+        RID = self.it_tensors.res_ofs.shape[1]
+        cap0 = np.zeros(RID, dtype=np.int32)
+        if self._rid_names:
+            from karpenter_tpu.scheduling.reservations import ReservationManager
+
+            rm = ReservationManager(self.catalog)
+            for i, rid in enumerate(self._rid_names):
+                cap0[i] = rm.capacity.get(rid, 0)
+            for rid, n in (self._reserved_in_use or {}).items():
+                if rid in self._rid_names:
+                    i = self._rid_names.index(rid)
+                    cap0[i] = max(cap0[i] - n, 0)
+        self._res_cap0 = jnp.asarray(cap0)
         exist_tensors = self._encode_existing(_next_pow2(max(len(self.existing_nodes), 1), 1))
         budget, nodes_budget = self._encode_budgets()
         template_tensors = self.template_tensors._replace(
@@ -548,18 +591,25 @@ class TPUScheduler:
             zone_kid=zone_kid,
             ct_kid=ct_kid,
             n_claims=n_claims,
-            mv_active=self._mv_active,
+            # BestEffort never enforces floors in-solve; achievable floors
+            # are written back at decode (nodeclaim.go:606-613)
+            mv_active=self._mv_active and self.min_values_policy != "BestEffort",
             topo_kids=topo_kids,
+            rid_kid=self._rid_kid,
+            res_vid=self._res_vid,
+            res_active=self._res_active,
+            res_strict=self.reserved_mode == "strict",
         )
         if P_pad <= chunk:
             return ops_solver.solve(
                 pt, tol, it_allow, exist_ok, pod_ports, pod_port_conf,
                 exist_tensors, self.it_tensors, template_tensors,
-                self.well_known, topo_tensors, pod_topo, **common,
+                self.well_known, topo_tensors, pod_topo,
+                res_cap0=self._res_cap0, **common,
             )
         state = ops_solver.initial_state(
             exist_tensors, self.it_tensors, template_tensors, topo_tensors,
-            n_claims, pod_ports.shape[1],
+            n_claims, pod_ports.shape[1], self._res_cap0,
         )
         parts = []
         for lo in range(0, P_pad, chunk):
@@ -677,6 +727,11 @@ class TPUScheduler:
         # (the device carried budget bookkeeping too, so no host replay of
         # subtractMax is needed); keep them in the TEMPLATE's catalog order
         # so cheapest_launch tie-breaks identically to the host oracle
+        held = np.asarray(result.claims.held)
+        from karpenter_tpu.controllers.provisioning.host_scheduler import (
+            finalize_reserved,
+        )
+
         for claim in claims:
             viable = {
                 self.catalog[t].name for t in np.nonzero(its_mask[claim.slot])[0]
@@ -684,6 +739,19 @@ class TPUScheduler:
             claim.instance_types = [
                 it for it in claim.template.instance_types if it.name in viable
             ]
+            # reservations the scan committed for this claim slot
+            if self._rid_names:
+                claim.reserved_ids = frozenset(
+                    self._rid_names[r]
+                    for r in np.nonzero(held[claim.slot][: len(self._rid_names)])[0]
+                )
+            finalize_reserved(claim)
+            if self.min_values_policy == "BestEffort":
+                from karpenter_tpu.controllers.provisioning.host_scheduler import (
+                    finalize_min_values,
+                )
+
+                finalize_min_values(claim)
         return SchedulingResult(
             claims=claims,
             unschedulable=unschedulable,

@@ -53,6 +53,11 @@ CAPACITY_TYPE_SPOT = "spot"
 CAPACITY_TYPE_ON_DEMAND = "on-demand"
 CAPACITY_TYPE_RESERVED = "reserved"
 
+# reservation id injected into reserved offerings' requirements
+# (cloudprovider/types.go:50-53 ReservationIDLabel; providers register it
+# as well-known so claims without the key stay compatible)
+RESERVATION_ID_LABEL_KEY = GROUP + "/reservation-id"
+
 ARCH_AMD64 = "amd64"
 ARCH_ARM64 = "arm64"
 
@@ -66,6 +71,7 @@ WELL_KNOWN_LABELS = frozenset(
         LABEL_OS,
         CAPACITY_TYPE_LABEL_KEY,
         LABEL_WINDOWS_BUILD,
+        RESERVATION_ID_LABEL_KEY,
     }
 )
 

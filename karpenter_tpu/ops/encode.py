@@ -187,6 +187,9 @@ class InstanceTypeTensors(NamedTuple):
     zc_avail: jnp.ndarray  # [T, GR, Z, C] bool — available offering exists in (zone, ct)
     price_zc: jnp.ndarray  # [T, Z, C] f32 — min available price, +inf when none
     valid: jnp.ndarray  # [T] bool — real (non-padding) instance type
+    # reserved offerings by (type, reservation-id value id, zone value id);
+    # feeds the in-scan ReservationManager twin (reservationmanager.go)
+    res_ofs: jnp.ndarray  # [T, RID, Z] bool
 
     @property
     def n_types(self) -> int:
@@ -327,6 +330,10 @@ class ProblemEncoder:
 
         zone_values = self.vocab.values[zone_kid]
         ct_values = self.vocab.values[ct_kid]
+        rid_kid = self.vocab.key_to_id.get(l.RESERVATION_ID_LABEL_KEY)
+        rid_values = self.vocab.values[rid_kid] if rid_kid is not None else []
+        RID = max(len(rid_values), 1)
+        res_ofs = np.zeros((T, RID, Z), dtype=bool)
         for t, it in enumerate(its):
             cap[t] = self.resources_vector(it.capacity)
             for g, group in enumerate(it.allocatable_offerings()):
@@ -351,6 +358,17 @@ class ProblemEncoder:
                         for c in cs:
                             zc_avail[t, g, z, c] = True
                             price_zc[t, z, c] = min(price_zc[t, z, c], o.price)
+            for o in it.offerings:
+                if o.capacity_type != l.CAPACITY_TYPE_RESERVED or not o.available:
+                    continue
+                rid = o.reservation_id
+                if rid not in rid_values:
+                    continue  # unseen by any requirement: unreachable
+                r = rid_values.index(rid)
+                zreq = o.requirements.get(l.LABEL_TOPOLOGY_ZONE)
+                for z, v in enumerate(zone_values):
+                    if zreq.has(v):
+                        res_ofs[t, r, z] = True
         return InstanceTypeTensors(
             reqs=reqs,
             alloc=jnp.asarray(alloc),
@@ -359,6 +377,7 @@ class ProblemEncoder:
             zc_avail=jnp.asarray(zc_avail),
             price_zc=jnp.asarray(price_zc),
             valid=jnp.ones(T, dtype=bool),
+            res_ofs=jnp.asarray(res_ofs),
         )
 
     def zone_ct_key_ids(self) -> tuple[int, int]:
@@ -366,3 +385,14 @@ class ProblemEncoder:
             self.vocab.key_to_id[l.LABEL_TOPOLOGY_ZONE],
             self.vocab.key_to_id[l.CAPACITY_TYPE_LABEL_KEY],
         )
+
+    def reservation_ids(self) -> tuple[int, int, list[str]]:
+        """(rid key id, reserved-ct value id, rid names in value-id order);
+        -1 ids when no reservation vocabulary exists."""
+        rid_kid = self.vocab.key_to_id.get(l.RESERVATION_ID_LABEL_KEY, -1)
+        ct_kid = self.vocab.key_to_id.get(l.CAPACITY_TYPE_LABEL_KEY)
+        res_vid = -1
+        if ct_kid is not None and l.CAPACITY_TYPE_RESERVED in self.vocab.values[ct_kid]:
+            res_vid = self.vocab.values[ct_kid].index(l.CAPACITY_TYPE_RESERVED)
+        rid_names = list(self.vocab.values[rid_kid]) if rid_kid >= 0 else []
+        return rid_kid, res_vid, rid_names

@@ -102,6 +102,9 @@ class SolverState(NamedTuple):
     # host ports in use (hostportusage.go:35-97)
     exist_ports: jnp.ndarray  # [E, NP] bool
     claim_ports: jnp.ndarray  # [N, NP] bool
+    # reserved-capacity twin (reservationmanager.go:28-115)
+    res_cap: jnp.ndarray  # [RID] i32 — remaining capacity per reservation id
+    held: jnp.ndarray  # [N, RID] bool — reservations each claim holds
 
 
 class SolveResult(NamedTuple):
@@ -198,6 +201,10 @@ def _make_step(
     n_claims: int,
     mv_active: bool,
     topo_kids: tuple,
+    rid_kid: int,
+    res_vid: int,
+    res_active: bool,
+    res_strict: bool,
 ):
     """Build the per-pod scan step closure shared by solve/solve_from."""
     N = n_claims
@@ -205,11 +212,34 @@ def _make_step(
     E = exist.avail.shape[0]
     G = templates.its.shape[0]
     no_wk = jnp.zeros_like(well_known)
+    RID = it.res_ofs.shape[1]
+    Zr = it.res_ofs.shape[2]
     # static [K] mask of keys handled exactly per-step (topology narrowing);
     # the incremental tier-2 classification covers the rest
     kid_mask = jnp.zeros(K, dtype=bool)
     for k in topo_kids:
         kid_mask = kid_mask.at[k].set(True)
+
+    def _reserve_options(viable, comb):
+        """[B, RID] bool — reserved offerings compatible with each
+        candidate over its viable types (offeringsToReserve's scan,
+        nodeclaim.go:313-332): an available reserved offering on a
+        surviving type whose zone, capacity-type and reservation-id the
+        combined requirements admit."""
+        zmask = comb.mask[:, zone_kid, :Zr]
+        ridmask = comb.mask[:, rid_kid, :RID]
+        ct_res = comb.mask[:, ct_kid, res_vid]
+        hit = (
+            jnp.einsum(
+                "bt,trz,bz->br",
+                viable.astype(jnp.bfloat16),
+                it.res_ofs.astype(jnp.bfloat16),
+                zmask.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            )
+            > 0
+        )
+        return hit & ridmask & ct_res[:, None]
 
     def step(state: SolverState, xs):
         (
@@ -341,6 +371,19 @@ def _make_step(
                 templates.mv_min[state.template],
                 templates.mv_it_values,
             )
+        if res_active:
+            ofs_c = _reserve_options(new_its, comb_t)  # [N, RID]
+            to_res = ofs_c & (state.held | (state.res_cap > 0)[None, :])
+            if res_strict:
+                # strict mode (scheduler.go:75-78): fail the add when
+                # compatible reserved offerings exist but none can be
+                # reserved, or when it would drop existing reservations
+                no_res = ~jnp.any(to_res, axis=-1)
+                feas &= ~(
+                    (jnp.any(ofs_c, axis=-1) | jnp.any(state.held, axis=-1)) & no_res
+                )
+        else:
+            to_res = state.held  # unused; keeps shapes uniform
         order_key = state.pods * jnp.int32(N) + jnp.arange(N, dtype=jnp.int32)
         pick = jnp.argmin(jnp.where(feas, order_key, BIG))
         found = jnp.any(feas)
@@ -389,6 +432,13 @@ def _make_step(
             tmpl_feas &= _min_values_ok(
                 its0, templates.mv_key, templates.mv_min, templates.mv_it_values
             )
+        if res_active:
+            ofs0 = _reserve_options(its0, comb0_t)  # [G, RID]
+            to_res0 = ofs0 & (state.res_cap > 0)[None, :]
+            if res_strict:
+                tmpl_feas &= ~(jnp.any(ofs0, axis=-1) & ~jnp.any(to_res0, axis=-1))
+        else:
+            to_res0 = jnp.zeros((G, state.held.shape[1]), dtype=bool)
         g = jnp.argmax(tmpl_feas)
         any_template = jnp.any(tmpl_feas) & pod_valid & ~found_e & ~found
         can_open = any_template & (state.n_open < N)
@@ -467,6 +517,26 @@ def _make_step(
         opened = can_open & ~found
         new_n_open = state.n_open + jnp.where(opened, 1, 0).astype(jnp.int32)
 
+        # reserved-capacity commit: reserve new ids, release dropped ones
+        # (nodeclaim.go:260-262 Reserve + releaseReservedOfferings)
+        if res_active:
+            sel_res = jnp.where(found, to_res[pick], to_res0[g])  # [RID]
+            prev_res = jnp.where(
+                found, state.held[pick], jnp.zeros_like(state.held[0])
+            )
+            newly = sel_res & ~prev_res
+            released = prev_res & ~sel_res
+            new_res_cap = jnp.where(
+                upd_claim,
+                state.res_cap + released.astype(jnp.int32) - newly.astype(jnp.int32),
+                state.res_cap,
+            )
+            new_held = jnp.where(
+                upd_claim, state.held.at[cslot].set(sel_res), state.held
+            )
+        else:
+            new_res_cap, new_held = state.res_cap, state.held
+
         # limits bookkeeping on open: subtract the max capacity over the
         # claim's viable instance types (scheduler.go:791 subtractMax)
         max_cap = jnp.max(
@@ -497,6 +567,8 @@ def _make_step(
                 hg_counts=new_hg_counts,
                 exist_ports=new_exist_ports,
                 claim_ports=new_claim_ports,
+                res_cap=new_res_cap,
+                held=new_held,
             ),
             assignment,
         )
@@ -511,6 +583,7 @@ def initial_state(
     topo: TopologyTensors,
     n_claims: int,
     n_ports: int,
+    res_cap0=None,
 ) -> SolverState:
     """The empty carry (no pods placed yet)."""
     N = n_claims
@@ -535,6 +608,12 @@ def initial_state(
         hg_counts=topo.hg_counts0,
         exist_ports=exist.ports,
         claim_ports=jnp.zeros((N, n_ports), dtype=bool),
+        res_cap=(
+            jnp.asarray(res_cap0, dtype=jnp.int32)
+            if res_cap0 is not None
+            else jnp.zeros(it.res_ofs.shape[1], dtype=jnp.int32)
+        ),
+        held=jnp.zeros((N, it.res_ofs.shape[1]), dtype=bool),
     )
 
 
@@ -558,7 +637,17 @@ def _xs(pods, pod_tmpl_ok, pod_it_allow, pod_exist_ok, pod_ports, pod_port_conf,
     )
 
 
-_STATIC = ("zone_kid", "ct_kid", "n_claims", "mv_active", "topo_kids")
+_STATIC = (
+    "zone_kid",
+    "ct_kid",
+    "n_claims",
+    "mv_active",
+    "topo_kids",
+    "rid_kid",
+    "res_vid",
+    "res_active",
+    "res_strict",
+)
 
 
 @functools.partial(jax.jit, static_argnames=_STATIC)
@@ -580,10 +669,18 @@ def solve(
     n_claims: int,
     mv_active: bool = False,
     topo_kids: tuple = (),
+    res_cap0=None,
+    rid_kid: int = -1,
+    res_vid: int = -1,
+    res_active: bool = False,
+    res_strict: bool = False,
 ) -> SolveResult:
-    state = initial_state(exist, it, templates, topo, n_claims, pod_ports.shape[1])
+    state = initial_state(
+        exist, it, templates, topo, n_claims, pod_ports.shape[1], res_cap0
+    )
     step = _make_step(
-        exist, it, templates, well_known, topo, zone_kid, ct_kid, n_claims, mv_active, topo_kids
+        exist, it, templates, well_known, topo, zone_kid, ct_kid, n_claims,
+        mv_active, topo_kids, rid_kid, res_vid, res_active, res_strict,
     )
     xs = _xs(pods, pod_tmpl_ok, pod_it_allow, pod_exist_ok, pod_ports, pod_port_conf, pod_topo)
     state, assignment = jax.lax.scan(step, state, xs)
@@ -610,13 +707,18 @@ def solve_from(
     n_claims: int,
     mv_active: bool = False,
     topo_kids: tuple = (),
+    rid_kid: int = -1,
+    res_vid: int = -1,
+    res_active: bool = False,
+    res_strict: bool = False,
 ) -> SolveResult:
     """Resume the scan from an explicit carry — the chunked-solve entry:
     the host splits a large pod batch into fixed-size chunks (bounded
     per-dispatch transfers and a single compiled executable) and threads
     SolverState between calls. Bit-identical to one big scan."""
     step = _make_step(
-        exist, it, templates, well_known, topo, zone_kid, ct_kid, n_claims, mv_active, topo_kids
+        exist, it, templates, well_known, topo, zone_kid, ct_kid, n_claims,
+        mv_active, topo_kids, rid_kid, res_vid, res_active, res_strict,
     )
     xs = _xs(pods, pod_tmpl_ok, pod_it_allow, pod_exist_ok, pod_ports, pod_port_conf, pod_topo)
     state, assignment = jax.lax.scan(step, state, xs)

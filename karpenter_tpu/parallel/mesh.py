@@ -75,6 +75,7 @@ def shard_instance_types(it: InstanceTypeTensors, mesh: Mesh) -> InstanceTypeTen
         zc_avail=pad_axis_to(it.zc_avail, 0, T_pad, False),
         price_zc=pad_axis_to(it.price_zc, 0, T_pad, np.inf),
         valid=pad_axis_to(it.valid, 0, T_pad, False),
+        res_ofs=pad_axis_to(it.res_ofs, 0, T_pad, False),
     )
     shard = NamedSharding(mesh, P("it"))
     return InstanceTypeTensors(
@@ -85,6 +86,7 @@ def shard_instance_types(it: InstanceTypeTensors, mesh: Mesh) -> InstanceTypeTen
         zc_avail=jax.device_put(padded.zc_avail, shard),
         price_zc=jax.device_put(padded.price_zc, shard),
         valid=jax.device_put(padded.valid, shard),
+        res_ofs=jax.device_put(padded.res_ofs, shard),
     )
 
 
@@ -107,6 +109,11 @@ def sharded_solve(
     n_claims: int,
     mv_active: bool = False,
     topo_kids: tuple = (),
+    res_cap0=None,
+    rid_kid: int = -1,
+    res_vid: int = -1,
+    res_active: bool = False,
+    res_strict: bool = False,
 ):
     """Run ops_solver.solve with the catalog sharded over the "it" mesh axis.
 
@@ -142,4 +149,9 @@ def sharded_solve(
         n_claims=n_claims,
         mv_active=mv_active,
         topo_kids=topo_kids,
+        res_cap0=res_cap0,
+        rid_kid=rid_kid,
+        res_vid=res_vid,
+        res_active=res_active,
+        res_strict=res_strict,
     )

@@ -307,6 +307,16 @@ class Requirements:
         out._reqs = dict(self._reqs)
         return out
 
+    def relax_min_values(self, key: str, min_values: int) -> None:
+        """Lower a key's minValues floor (BestEffort relaxation,
+        nodeclaim.go:214-219). Replaces the Requirement object — instances
+        may be shared across claims and templates."""
+        import dataclasses
+
+        r = self._reqs.get(key)
+        if r is not None:
+            self._reqs[key] = dataclasses.replace(r, min_values=min_values)
+
     def labels(self) -> dict[str, str]:
         """Single-valued In requirements as labels (for node fabrication)."""
         out = {}

@@ -1,0 +1,125 @@
+"""Reserved-capacity accounting for a single Solve.
+
+Counterpart of reference
+pkg/controllers/provisioning/scheduling/reservationmanager.go:28-115 and
+the reserve/release/strict-mode flow in nodeclaim.go:256-349:
+
+  * capacity per reservation id (min over duplicate offerings — multiple
+    nodepools may reference one reservation with a capacity update between
+    GetInstanceTypes calls)
+  * hostname -> reserved-id set; Reserve/Release are idempotent per host
+  * offerings_to_reserve: pessimistically reserve EVERY compatible,
+    available, reservable reserved offering over a claim's remaining
+    instance types
+  * Strict mode fails an add when compatible reserved offerings exist but
+    none can be reserved, or when the add would drop a claim's existing
+    reservations to zero; Fallback lets the claim fall through to
+    spot/on-demand
+
+In Fallback mode a claim whose only offerings are reserved-but-exhausted
+is still created (the type filter counts reserved offerings as available,
+mirroring nodeclaim.go:541's hasOffering); the launch then fails with
+InsufficientCapacity and the lifecycle controller deletes the claim and
+reschedules — the reference's fail-fast path (launch.go:81).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.scheduling.requirements import Requirements
+
+RESERVED_MODE_FALLBACK = "fallback"
+RESERVED_MODE_STRICT = "strict"
+
+
+class ReservedOfferingError(Exception):
+    """An add failed on reservation grounds (nodeclaim.go:64-80); distinct
+    from ordinary incompatibility so callers can treat it as retryable."""
+
+
+class ReservationManager:
+    def __init__(self, instance_types: Iterable):
+        self.capacity: dict[str, int] = {}
+        for it in instance_types:
+            for o in it.offerings:
+                if o.capacity_type != l.CAPACITY_TYPE_RESERVED:
+                    continue
+                rid = o.reservation_id
+                cur = self.capacity.get(rid)
+                if cur is None or cur > o.reservation_capacity:
+                    self.capacity[rid] = o.reservation_capacity
+        self.reservations: dict[str, set[str]] = {}  # hostname -> {rid}
+
+    def can_reserve(self, hostname: str, offering) -> bool:
+        rid = offering.reservation_id
+        if rid in self.reservations.get(hostname, ()):
+            return True
+        return self.capacity.get(rid, 0) > 0
+
+    def reserve(self, hostname: str, offerings: Iterable) -> None:
+        held = self.reservations.setdefault(hostname, set())
+        for o in offerings:
+            rid = o.reservation_id
+            if rid in held:
+                continue
+            self.capacity[rid] -= 1
+            assert self.capacity[rid] >= 0, f"over-reserved {rid}"
+            held.add(rid)
+
+    def release(self, hostname: str, *rids: str) -> None:
+        held = self.reservations.get(hostname)
+        if not held:
+            return
+        for rid in rids:
+            if rid in held:
+                held.discard(rid)
+                self.capacity[rid] += 1
+
+    def has_reservation(self, hostname: str, offering) -> bool:
+        return offering.reservation_id in self.reservations.get(hostname, ())
+
+    def remaining(self, rid: str) -> int:
+        return self.capacity.get(rid, 0)
+
+
+def offerings_to_reserve(
+    rm: Optional[ReservationManager],
+    hostname: str,
+    instance_types: Iterable,
+    claim_reqs: Requirements,
+    held_rids: frozenset[str],
+    mode: str,
+) -> list:
+    """The set of reserved offerings to (pessimistically) hold for a claim
+    after an add (nodeclaim.go:304-349 offeringsToReserve). Raises
+    ReservedOfferingError on the Strict-mode failure conditions. rm=None
+    means the ReservedCapacity feature gate is off -> no reservations."""
+    if rm is None:
+        return []
+    has_compatible = False
+    out = []
+    seen: set[str] = set()
+    for it in instance_types:
+        for o in it.offerings:
+            if o.capacity_type != l.CAPACITY_TYPE_RESERVED or not o.available:
+                continue
+            if claim_reqs.compatible(o.requirements, l.WELL_KNOWN_LABELS) is not None:
+                continue
+            has_compatible = True
+            if o.reservation_id in seen:
+                continue
+            if o.reservation_id in held_rids or rm.can_reserve(hostname, o):
+                seen.add(o.reservation_id)
+                out.append(o)
+    if mode == RESERVED_MODE_STRICT:
+        if has_compatible and not out:
+            raise ReservedOfferingError(
+                "compatible reserved offerings exist but none could be reserved"
+            )
+        if held_rids and not out:
+            raise ReservedOfferingError(
+                "updated constraints would drop all reserved offering options"
+            )
+    return out

@@ -151,7 +151,9 @@ class Registry:
 REGISTRY = Registry()
 
 NODECLAIMS_CREATED = REGISTRY.counter(
-    "karpenter_nodeclaims_created_total", "NodeClaims created", ("reason", "nodepool")
+    "karpenter_nodeclaims_created_total",
+    "NodeClaims created",
+    ("reason", "nodepool", "min_values_relaxed"),
 )
 NODECLAIMS_TERMINATED = REGISTRY.counter(
     "karpenter_nodeclaims_terminated_total", "NodeClaims terminated", ("reason", "nodepool")

@@ -113,13 +113,20 @@ class TestMetricsWiring:
 
         pool.spec.disruption.budgets = [Budget(nodes="100%")]
         store.create(ObjectStore.NODEPOOLS, pool)
-        before = metrics.NODECLAIMS_CREATED.get(reason="provisioning", nodepool="default")
+        before = metrics.NODECLAIMS_CREATED.get(
+            reason="provisioning", nodepool="default", min_values_relaxed="false"
+        )
         store.create(ObjectStore.PODS, make_pod("p", cpu=1.0))
         mgr.run_until_idle()
         cloud.simulate_kubelet_ready()
         mgr.run_until_idle()
         KubeSchedulerSim(store, mgr.cluster).bind_pending()
-        assert metrics.NODECLAIMS_CREATED.get(reason="provisioning", nodepool="default") > before
+        assert (
+            metrics.NODECLAIMS_CREATED.get(
+                reason="provisioning", nodepool="default", min_values_relaxed="false"
+            )
+            > before
+        )
         assert metrics.SCHEDULING_DURATION.totals[()] > 0
         mgr.run_maintenance()
         assert metrics.NODEPOOL_USAGE.get(nodepool="default", resource_type="nodes") >= 1.0

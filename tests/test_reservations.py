@@ -1,0 +1,243 @@
+"""Reserved-capacity semantics: ReservationManager + scheduler integration
+(reference reservationmanager.go:28-115, nodeclaim.go:256-349, FinalizeScheduling
+nodeclaim.go:385-401), differentially tested across both engines."""
+
+import pytest
+
+from karpenter_tpu.cloudprovider.fake import instance_types, new_instance_type
+from karpenter_tpu.cloudprovider.instancetype import RESERVATION_ID_LABEL
+from karpenter_tpu.controllers.provisioning import TPUScheduler, build_templates
+from karpenter_tpu.controllers.provisioning.host_scheduler import HostScheduler
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import make_pod
+from karpenter_tpu.scheduling.reservations import (
+    RESERVED_MODE_STRICT,
+    ReservationManager,
+)
+
+
+def pool(name="default"):
+    p = NodePool()
+    p.metadata.name = name
+    return p
+
+
+def reserved_catalog(cap=2, cpu=4, extra_plain=1):
+    """One instance type with a reserved offering (capacity `cap` in
+    test-zone-1) + optional plain types."""
+    its = [
+        new_instance_type(
+            "res-4x",
+            cpu=cpu,
+            reservations=[("test-zone-1", "res-1", cap)],
+        )
+    ]
+    for i in range(extra_plain):
+        its.append(new_instance_type(f"plain-{i}", cpu=cpu))
+    return its
+
+
+class TestReservationManager:
+    def test_capacity_min_over_duplicates(self):
+        its = [
+            new_instance_type("a", reservations=[("test-zone-1", "r", 5)]),
+            new_instance_type("b", reservations=[("test-zone-1", "r", 3)]),
+        ]
+        rm = ReservationManager(its)
+        assert rm.capacity["r"] == 3
+
+    def test_idempotent_reserve_release(self):
+        its = [new_instance_type("a", reservations=[("test-zone-1", "r", 2)])]
+        rm = ReservationManager(its)
+        o = [of for of in its[0].offerings if of.capacity_type == "reserved"][0]
+        assert rm.can_reserve("h1", o)
+        rm.reserve("h1", [o])
+        rm.reserve("h1", [o])  # idempotent per host
+        assert rm.remaining("r") == 1
+        assert rm.has_reservation("h1", o)
+        rm.release("h1", "r")
+        rm.release("h1", "r")
+        assert rm.remaining("r") == 2
+
+    def test_exhausted_capacity_blocks_new_hosts(self):
+        its = [new_instance_type("a", reservations=[("test-zone-1", "r", 1)])]
+        rm = ReservationManager(its)
+        o = [of for of in its[0].offerings if of.capacity_type == "reserved"][0]
+        rm.reserve("h1", [o])
+        assert not rm.can_reserve("h2", o)
+        assert rm.can_reserve("h1", o)  # existing holder keeps it
+
+
+def solve_both(catalog, pods, reserved_mode="fallback"):
+    templates = build_templates([(pool(), catalog)])
+    host = HostScheduler(templates, reserved_mode=reserved_mode).solve(pods)
+    tpu = TPUScheduler(templates, reserved_mode=reserved_mode).solve(pods)
+    assert len(host.claims) == len(tpu.claims)
+    assert host.assignments == tpu.assignments
+    for hc, tc in zip(host.claims, tpu.claims):
+        assert hc.reserved_ids == tc.reserved_ids, (hc.slot, hc.reserved_ids, tc.reserved_ids)
+        assert {it.name for it in hc.instance_types} == {it.name for it in tc.instance_types}
+        assert hc.requirements.get(RESERVATION_ID_LABEL).values == (
+            tc.requirements.get(RESERVATION_ID_LABEL).values
+        )
+        assert hc.requirements.get(l.CAPACITY_TYPE_LABEL_KEY).values == (
+            tc.requirements.get(l.CAPACITY_TYPE_LABEL_KEY).values
+        )
+    return host, tpu
+
+
+class TestReservedScheduling:
+    def test_claim_pins_to_reserved(self):
+        host, _ = solve_both(reserved_catalog(cap=2), [make_pod("p", cpu=1.0)])
+        [claim] = host.claims
+        assert claim.reserved_ids == {"res-1"}
+        assert claim.requirements.get(l.CAPACITY_TYPE_LABEL_KEY).values == frozenset(
+            {l.CAPACITY_TYPE_RESERVED}
+        )
+        assert claim.requirements.get(RESERVATION_ID_LABEL).values == frozenset({"res-1"})
+        # reserved launches are free (WorstLaunchPrice precedence)
+        assert claim.cheapest_launch()[1] == 0.0
+
+    def test_stacking_pods_holds_one_reservation(self):
+        """Multiple pods on one claim decrement capacity once (idempotent
+        per-hostname reserve)."""
+        host, _ = solve_both(
+            reserved_catalog(cap=2), [make_pod(f"p-{i}", cpu=1.0) for i in range(3)]
+        )
+        [claim] = host.claims
+        assert len(claim.pods) == 3
+        assert claim.reserved_ids == {"res-1"}
+
+    def test_fallback_after_capacity_exhausted(self):
+        """cap=1: the first claim takes the reservation; a second claim
+        (forced by big pods) falls back to spot/on-demand."""
+        catalog = reserved_catalog(cap=1, cpu=4)
+        pods = [make_pod(f"p-{i}", cpu=3.0) for i in range(2)]  # one pod per node
+        host, _ = solve_both(catalog, pods)
+        assert len(host.claims) == 2
+        reserved = [c for c in host.claims if c.reserved_ids]
+        plain = [c for c in host.claims if not c.reserved_ids]
+        assert len(reserved) == 1 and len(plain) == 1
+        assert plain[0].cheapest_launch()[1] > 0.0
+        assert not plain[0].requirements.get(l.CAPACITY_TYPE_LABEL_KEY).has(
+            l.CAPACITY_TYPE_RESERVED
+        ) or plain[0].requirements.get(l.CAPACITY_TYPE_LABEL_KEY).values != frozenset(
+            {l.CAPACITY_TYPE_RESERVED}
+        )
+
+    def test_strict_mode_fails_instead_of_falling_back(self):
+        """Strict: when the reservation is exhausted the add must FAIL so a
+        later loop can retry once capacity frees (scheduler.go:75-78)."""
+        catalog = reserved_catalog(cap=1, cpu=4, extra_plain=0)
+        pods = [make_pod(f"p-{i}", cpu=3.0) for i in range(2)]
+        host, tpu = solve_both(catalog, pods, reserved_mode=RESERVED_MODE_STRICT)
+        assert len(host.claims) == 1
+        assert len(host.unschedulable) == 1
+        assert len(tpu.unschedulable) == 1
+
+    def test_release_on_narrowing(self):
+        """A claim holding reservations in two zones releases the one a new
+        pod's zone selector filters out."""
+        its = [
+            new_instance_type(
+                "res-4x",
+                cpu=4,
+                reservations=[("test-zone-1", "r-a", 1), ("test-zone-2", "r-b", 1)],
+            )
+        ]
+        templates = build_templates([(pool(), its)])
+        host_sched = HostScheduler(templates)
+        wide = make_pod("wide", cpu=1.0)
+        narrow = make_pod(
+            "narrow", cpu=1.0, node_selector={l.LABEL_TOPOLOGY_ZONE: "test-zone-1"}
+        )
+        result = host_sched.solve([wide, narrow])
+        [claim] = result.claims
+        assert claim.reserved_ids == {"r-a"}
+        assert host_sched._rm.remaining("r-b") == 1, "narrowed-out reservation not released"
+
+    def test_reserved_e2e_launch(self):
+        """Full harness: a pod provisions onto reserved capacity; the
+        launched node carries capacity-type=reserved + the reservation id
+        and prices at zero."""
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.controllers.manager import KubeSchedulerSim, Manager
+        from karpenter_tpu.state.store import ObjectStore
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        store = ObjectStore(clock)
+        cloud = KwokCloudProvider(store, catalog=reserved_catalog(cap=2))
+        mgr = Manager(store, cloud, clock)
+        store.create(ObjectStore.NODEPOOLS, pool())
+        store.create(ObjectStore.PODS, make_pod("p", cpu=1.0))
+        mgr.run_until_idle()
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        KubeSchedulerSim(store, mgr.cluster).bind_pending()
+        [node] = store.nodes()
+        assert node.metadata.labels[l.CAPACITY_TYPE_LABEL_KEY] == l.CAPACITY_TYPE_RESERVED
+        [claim] = store.nodeclaims()
+        rid_req = [
+            r for r in claim.spec.requirements if r.get("key") == RESERVATION_ID_LABEL
+        ]
+        assert rid_req and rid_req[0]["values"] == ["res-1"]
+
+    def test_capacity_not_oversubscribed_across_loops(self):
+        """A launched reserved instance consumes catalog capacity, so the
+        NEXT provisioning loop cannot double-book the reservation — and
+        deleting the node frees it again."""
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.controllers.manager import KubeSchedulerSim, Manager
+        from karpenter_tpu.state.store import ObjectStore
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        store = ObjectStore(clock)
+        cloud = KwokCloudProvider(store, catalog=reserved_catalog(cap=1, cpu=4, extra_plain=0))
+        mgr = Manager(store, cloud, clock)
+        store.create(ObjectStore.NODEPOOLS, pool())
+        store.create(ObjectStore.PODS, make_pod("a", cpu=3.0))
+        mgr.run_until_idle()
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        KubeSchedulerSim(store, mgr.cluster).bind_pending()
+        assert len(store.nodes()) == 1
+        # second loop: the reservation is consumed — strict provisioning
+        # must NOT launch a second instance into it
+        store.create(ObjectStore.PODS, make_pod("b", cpu=3.0))
+        for _ in range(3):
+            mgr.run_until_idle()
+            cloud.simulate_kubelet_ready()
+            mgr.run_until_idle()
+        reserved_nodes = [
+            n
+            for n in store.nodes()
+            if n.metadata.labels.get(l.CAPACITY_TYPE_LABEL_KEY) == l.CAPACITY_TYPE_RESERVED
+        ]
+        assert len(reserved_nodes) == 1, "reservation double-booked across loops"
+        # freeing the node restores the slot for the pending pod
+        pod_a = next(p for p in store.pods() if p.name == "a")
+        pod_a.status.phase = "Succeeded"
+        store.update(ObjectStore.PODS, pod_a)
+        store.delete(ObjectStore.PODS, pod_a.name)
+        claim = store.nodeclaims()[0]
+        store.delete(ObjectStore.NODECLAIMS, claim.name)
+        mgr.run_until_idle()
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        KubeSchedulerSim(store, mgr.cluster).bind_pending()
+        pod_b = next(p for p in store.pods() if p.name == "b")
+        assert pod_b.spec.node_name, "freed reservation not reused"
+
+    def test_reserved_mix_differential(self):
+        """BASELINE config #5 shape: spot/on-demand/reserved mix at small
+        scale — both engines agree on packing and reservations."""
+        catalog = instance_types(16) + [
+            new_instance_type(
+                "res-8x", cpu=8, reservations=[("test-zone-1", "big-res", 2)]
+            )
+        ]
+        pods = [make_pod(f"p-{i}", cpu=1.0, memory="1Gi") for i in range(12)]
+        solve_both(catalog, pods)

@@ -710,6 +710,26 @@ class TestMinValues:
         assert_same_packing(host, tpu)
         assert len(tpu.unschedulable) == 1
 
+    def test_min_values_best_effort_relaxes(self):
+        """The same unsatisfiable floor under MinValuesPolicy=BestEffort:
+        the pod schedules, the claim is flagged relaxed, and the floor is
+        lowered to the achievable distinct-value count
+        (nodeclaim.go:606-613 + scheduler.go:763-772)."""
+        pool = self._pool("karpenter-tpu.sh/instance-family", 99)
+        pods = [make_pod("p", cpu=0.5)]
+        templates = build_templates([(pool, instance_types(16))])
+        host = HostScheduler(templates, min_values_policy="BestEffort").solve(pods)
+        tpu = TPUScheduler(templates, min_values_policy="BestEffort").solve(pods)
+        assert_same_packing(host, tpu)
+        for r in (host, tpu):
+            assert not r.unschedulable
+            [claim] = r.claims
+            assert claim.min_values_relaxed
+            # instance_types(16) spans exactly 4 families
+            assert (
+                claim.requirements.get("karpenter-tpu.sh/instance-family").min_values == 4
+            )
+
 
 class TestHostPortsAndVolumes:
     def test_hostport_conflict_separates_pods(self):
