@@ -14,7 +14,7 @@ from typing import Optional
 
 from karpenter_tpu.cloudprovider import errors
 from karpenter_tpu.cloudprovider.fake import instance_types
-from karpenter_tpu.cloudprovider.instancetype import InstanceType, Offering
+from karpenter_tpu.cloudprovider.instancetype import RESERVATION_ID_LABEL, InstanceType, Offering
 from karpenter_tpu.cloudprovider.spi import CloudProvider
 from karpenter_tpu.models import labels as l
 from karpenter_tpu.models.node import Node, NodeSpec, NodeStatus
@@ -43,8 +43,6 @@ class KwokCloudProvider(CloudProvider):
     def _resolve(self, claim: NodeClaim) -> tuple[InstanceType, Offering]:
         """Cheapest compatible (type, offering) for the claim's requirements
         (kwok cloudprovider.go:59-88)."""
-        from karpenter_tpu.cloudprovider.instancetype import RESERVATION_ID_LABEL
-
         reqs = Requirements.from_node_selector_requirements(claim.spec.requirements)
         # a provider only launches into a reservation the claim names
         # (the scheduler pins reservation-id at FinalizeScheduling)
@@ -54,7 +52,13 @@ class KwokCloudProvider(CloudProvider):
             if it.requirements.intersects(reqs) is not None:
                 continue
             for o in it.available_offerings():
-                if o.capacity_type == l.CAPACITY_TYPE_RESERVED and not rid_pinned:
+                # a reserved offering is launchable only when the claim pins
+                # its id AND a slot remains; exhausted reservations fail fast
+                # with InsufficientCapacity so the lifecycle controller can
+                # delete the claim and reschedule (types.go:482-487)
+                if o.capacity_type == l.CAPACITY_TYPE_RESERVED and (
+                    not rid_pinned or o.reservation_capacity <= 0
+                ):
                     continue
                 if not reqs.is_compatible(o.requirements, l.WELL_KNOWN_LABELS):
                     continue
@@ -73,7 +77,7 @@ class KwokCloudProvider(CloudProvider):
             # launch consumes a slot, so the catalog the NEXT scheduling
             # loop reads reflects it (AWS refreshes ReservationCapacity on
             # every GetInstanceTypes; types.go:482-487)
-            offering.reservation_capacity = max(offering.reservation_capacity - 1, 0)
+            offering.reservation_capacity -= 1
         seq = next(_instance_counter)
         provider_id = f"kwok://{claim.name}-{seq}"
         node_name = f"{claim.name}-{seq}"
@@ -89,8 +93,6 @@ class KwokCloudProvider(CloudProvider):
             }
         )
         if offering.capacity_type == l.CAPACITY_TYPE_RESERVED:
-            from karpenter_tpu.cloudprovider.instancetype import RESERVATION_ID_LABEL
-
             labels[RESERVATION_ID_LABEL] = offering.reservation_id
         claim.status.provider_id = provider_id
         claim.status.capacity = dict(it.capacity)
@@ -121,8 +123,6 @@ class KwokCloudProvider(CloudProvider):
         # terminating a reserved instance frees its reservation slot
         labels = node.metadata.labels
         if labels.get(l.CAPACITY_TYPE_LABEL_KEY) == l.CAPACITY_TYPE_RESERVED:
-            from karpenter_tpu.cloudprovider.instancetype import RESERVATION_ID_LABEL
-
             rid = labels.get(RESERVATION_ID_LABEL)
             it_name = labels.get(l.LABEL_INSTANCE_TYPE)
             for it in self.catalog:

@@ -21,6 +21,7 @@ from karpenter_tpu.controllers.provisioning.nodeclaimtemplate import ClaimTempla
 from karpenter_tpu.models import labels as l
 from karpenter_tpu.models.pod import Pod
 from karpenter_tpu.scheduling import Operator, Requirement, Requirements
+from karpenter_tpu.scheduling.reservations import ReservedOfferingError, offerings_to_reserve
 from karpenter_tpu.scheduling.taints import tolerates_all
 
 if False:  # typing-only import to avoid a cycle
@@ -116,7 +117,6 @@ def finalize_reserved(claim: SimClaim) -> None:
     if not claim.reserved_ids:
         return
     from karpenter_tpu.cloudprovider.instancetype import RESERVATION_ID_LABEL
-    from karpenter_tpu.scheduling import Operator, Requirement
 
     claim.requirements.add(
         Requirement.new(l.CAPACITY_TYPE_LABEL_KEY, Operator.IN, l.CAPACITY_TYPE_RESERVED)
@@ -252,6 +252,30 @@ class HostScheduler:
                 rm.capacity[rid] = max(rm.capacity[rid] - n, 0)
         return rm if rm.capacity else None
 
+    def _reserve_for(
+        self,
+        hostname: str,
+        remaining: "list[InstanceType]",
+        tightened: Requirements,
+        held_ids: frozenset,
+    ) -> Optional[frozenset]:
+        """Reserved-capacity accounting shared by the in-flight and
+        new-claim paths (nodeclaim.go:256-262, 304-349): reserve every
+        compatible reservable offering, release held ids the tightened
+        requirements no longer reach. Returns the new held-id set, or None
+        when Strict mode would lose reservations."""
+        try:
+            ofs = offerings_to_reserve(
+                self._rm, hostname, remaining, tightened, held_ids, self.reserved_mode
+            )
+        except ReservedOfferingError:
+            return None
+        new_ids = frozenset(o.reservation_id for o in ofs)
+        if self._rm is not None:
+            self._rm.reserve(hostname, ofs)
+            self._rm.release(hostname, *(held_ids - new_ids))
+        return new_ids
+
     def _next_hostname(self) -> str:
         self._hostname_seq += 1
         return hostname_placeholder(self._hostname_seq)
@@ -313,23 +337,9 @@ class HostScheduler:
         )
         if not remaining:
             return None
-        # reserved-capacity accounting (nodeclaim.go:256-262, 304-349)
-        from karpenter_tpu.scheduling.reservations import (
-            ReservedOfferingError,
-            offerings_to_reserve,
-        )
-
-        try:
-            ofs = offerings_to_reserve(
-                self._rm, claim.hostname, remaining, tightened,
-                claim.reserved_ids, self.reserved_mode,
-            )
-        except ReservedOfferingError:
+        new_ids = self._reserve_for(claim.hostname, remaining, tightened, claim.reserved_ids)
+        if new_ids is None:
             return None
-        new_ids = frozenset(o.reservation_id for o in ofs)
-        if self._rm is not None:
-            self._rm.reserve(claim.hostname, ofs)
-            self._rm.release(claim.hostname, *(claim.reserved_ids - new_ids))
         self.topology.record(pod, tightened)
         return SimClaim(
             template=claim.template,
@@ -397,21 +407,10 @@ class HostScheduler:
             if not remaining:
                 self._hostname_seq -= 1
                 continue
-            from karpenter_tpu.scheduling.reservations import (
-                ReservedOfferingError,
-                offerings_to_reserve,
-            )
-
-            try:
-                ofs = offerings_to_reserve(
-                    self._rm, hostname, remaining, tightened,
-                    frozenset(), self.reserved_mode,
-                )
-            except ReservedOfferingError:
+            new_ids = self._reserve_for(hostname, remaining, tightened, frozenset())
+            if new_ids is None:
                 self._hostname_seq -= 1
                 continue
-            if self._rm is not None:
-                self._rm.reserve(hostname, ofs)
             self._charge_budget(tmpl, remaining)
             self.topology.register(l.LABEL_HOSTNAME, hostname)
             self.topology.record(pod, tightened)
@@ -426,7 +425,7 @@ class HostScheduler:
                 slot=slot,
                 hostname=hostname,
                 host_ports=[hp.port_key(h) for h in pod.spec.host_ports],
-                reserved_ids=frozenset(o.reservation_id for o in ofs),
+                reserved_ids=new_ids,
             )
         return None
 

@@ -151,8 +151,12 @@ class Provisioner:
                 continue  # launched: the provider's catalog already counts it
             for r in c.spec.requirements:
                 if r.get("key") == RESERVATION_ID_LABEL and r.get("values"):
-                    rid = r["values"][0]
-                    out[rid] = out.get(rid, 0) + 1
+                    # a multi-id pin holds EVERY named reservation until the
+                    # provider collapses it at launch (pessimistic, like the
+                    # in-solve reservation manager) — counting only one id
+                    # would let the next loop double-book the others
+                    for rid in r["values"]:
+                        out[rid] = out.get(rid, 0) + 1
         return out
 
     def simulate(self, excluded_node_names: set[str], extra_pods: list[Pod]):

@@ -1,0 +1,80 @@
+"""Dynamic Resource Allocation (DRA) for the TPU-native framework.
+
+Counterpart of reference pkg/scheduling/dynamicresources/ — the reference's
+single largest production package. Device-claim allocation is modeled as a
+backtracking DFS over in-cluster ResourceSlices and per-instance-type
+cloud-provider device templates, with snapshot/restore of topology
+requirements, stateful match constraints, consumable (multi-allocation)
+capacity, and partitionable devices drawing from shared counter budgets.
+
+The search itself is host-side by design: DRA problems are small, deep, and
+data-dependent (claims per pod are bounded by AllocationResultsMaxSize), the
+opposite shape of the scan-friendly pod-packing hot loop that runs on the
+TPU. The host allocator feeds its surviving-instance-type sets and
+contributed topology requirements into the same claim pipeline both engines
+share, so DRA pods constrain the solve without entering the device kernel.
+"""
+
+from karpenter_tpu.scheduling.dra.types import (
+    ALLOCATION_RESULTS_MAX_SIZE,
+    AttrValue,
+    CounterConsumption,
+    CounterSet,
+    Device,
+    DeviceCapacity,
+    DeviceClaimStatus,
+    DeviceClass,
+    DeviceID,
+    DeviceRequest,
+    DeviceSubRequest,
+    MatchConstraintSpec,
+    PoolKey,
+    RequestName,
+    RequestPolicy,
+    ResourceClaim,
+    ResourceSlice,
+)
+from karpenter_tpu.scheduling.dra.cel import SelectorCache, SelectorError
+from karpenter_tpu.scheduling.dra.pool import DeviceWithID, Pool, filter_pools, gather_pools
+from karpenter_tpu.scheduling.dra.tracker import AllocatedDeviceState, AllocationTracker
+from karpenter_tpu.scheduling.dra.allocator import (
+    AllocationResult,
+    Allocator,
+    DeviceAllocationResult,
+    DRAError,
+    DRANodeClaim,
+    ResourceClaimAllocationMetadata,
+)
+
+__all__ = [
+    "ALLOCATION_RESULTS_MAX_SIZE",
+    "AllocatedDeviceState",
+    "AllocationResult",
+    "AllocationTracker",
+    "Allocator",
+    "AttrValue",
+    "CounterConsumption",
+    "CounterSet",
+    "Device",
+    "DeviceAllocationResult",
+    "DeviceCapacity",
+    "DeviceClaimStatus",
+    "DeviceClass",
+    "DeviceID",
+    "DeviceRequest",
+    "DeviceSubRequest",
+    "DeviceWithID",
+    "DRAError",
+    "DRANodeClaim",
+    "MatchConstraintSpec",
+    "Pool",
+    "PoolKey",
+    "RequestName",
+    "RequestPolicy",
+    "ResourceClaim",
+    "ResourceSlice",
+    "SelectorCache",
+    "SelectorError",
+    "filter_pools",
+    "gather_pools",
+]

@@ -1,0 +1,946 @@
+"""The DRA allocator: per-pod backtracking DFS over device pools.
+
+Counterpart of reference pkg/scheduling/dynamicresources/allocator.go and
+request.go. One Allocator is shared across a scheduling loop; Allocate() is
+read-only on the shared state, and a successful result carries an
+Allocation handle whose commit() applies it — mirroring the reference's
+split so the scheduler can discard failed candidate evaluations for free.
+
+Per instance type, the DFS walks claims → requests → sub-requests → device
+slots (allocator.go:716-765), trying in-cluster devices first so variance
+across ITs stays low, then the IT's template devices. Allocating a device
+with slice topology pushes a (requirements, pools) snapshot that
+backtracking pops (allocator.go:920-976). ITs whose DFS fails are pruned;
+requirements contributed by surviving ITs accumulate so the result is
+always representable by a single NodeClaim (allocator.go:663-669).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.scheduling.dra.capacity import (
+    add_capacity,
+    compute_consumed_capacity,
+    sub_capacity,
+)
+from karpenter_tpu.scheduling.dra.cel import SelectorCache, SelectorError
+from karpenter_tpu.scheduling.dra.constraints import (
+    AttributeBindings,
+    BindingFallback,
+    MatchAttributeConstraint,
+)
+from karpenter_tpu.scheduling.dra.pool import DeviceWithID, Pool, filter_pools, gather_pools
+from karpenter_tpu.scheduling.dra.tracker import (
+    AllocatedDeviceState,
+    AllocationTracker,
+    Capacity,
+    Counters,
+)
+from karpenter_tpu.scheduling.dra.types import (
+    ALLOCATION_RESULTS_MAX_SIZE,
+    DeviceClass,
+    DeviceID,
+    DeviceRequest,
+    DeviceSubRequest,
+    PoolKey,
+    RequestName,
+    ResourceClaim,
+    ResourceSlice,
+    node_selector_to_requirements,
+)
+from karpenter_tpu.scheduling.requirements import Requirement, Requirements
+
+# DFS wall-clock budget per pod allocation (allocator.go:41-43).
+ALLOCATE_TIMEOUT_SECONDS = 5.0
+
+
+class DRAError(Exception):
+    """Allocation or validation failure; the pod cannot use this NodeClaim."""
+
+
+@dataclass
+class DRANodeClaim:
+    """The allocator's view of a node claim — existing node, pre-initialized
+    node, or in-flight claim (types.go:72-93)."""
+
+    id: str
+    nodepool: str
+    requirements: Requirements
+    instance_types: list[str]
+    # Per-instance-type cloud-provider template slices (potential devices).
+    resource_slices: dict[str, list[ResourceSlice]] = field(default_factory=dict)
+    node_name: str = ""
+
+
+@dataclass
+class DeviceAllocationResult:
+    """One device granted to a claim under one instance type
+    (allocator.go:136-143)."""
+
+    device_id: DeviceID
+    request_name: RequestName
+    consumed_capacity: Optional[dict[str, float]] = None
+
+
+@dataclass
+class ResourceClaimAllocationMetadata:
+    """In-memory allocation state for one claim (allocator.go:87-134)."""
+
+    nodeclaim_id: str
+    contributed_requirements: dict[str, Requirements] = field(default_factory=dict)
+    total_requirements: Requirements = field(default_factory=Requirements)
+    used_template_devices: bool = False
+    devices: dict[str, list[DeviceAllocationResult]] = field(default_factory=dict)
+
+
+@dataclass
+class AllocationResult:
+    """Output of a successful Allocate(): surviving ITs, accumulated
+    topology requirements, and the commit handle (None when nothing new was
+    allocated)."""
+
+    instance_types: list[str]
+    requirements: Requirements
+    allocation: Optional[Callable[[], None]] = None
+
+    def commit(self) -> None:
+        if self.allocation is not None:
+            self.allocation()
+
+
+@dataclass
+class _RequestData:
+    """Parsed request (request.go:84-116)."""
+
+    name: RequestName
+    selectors: list[str] = field(default_factory=list)
+    num_devices: int = 0
+    allocation_mode: str = "ExactCount"
+    capacity_requests: Optional[dict[str, float]] = None
+    all_devices: list[DeviceWithID] = field(default_factory=list)
+    all_template_devices_by_it: dict[str, list[DeviceWithID]] = field(default_factory=dict)
+    sub_requests: list["_RequestData"] = field(default_factory=list)
+
+
+@dataclass
+class _ClaimData:
+    id: str
+    requests: list[_RequestData] = field(default_factory=list)
+    constraints: list[MatchAttributeConstraint] = field(default_factory=list)
+
+
+@dataclass
+class _DeviceAllocation:
+    """One DFS-path device pick (allocator.go:557-563)."""
+
+    claim_index: int
+    device: DeviceWithID
+    consumed_capacity: Optional[dict[str, float]]
+    request_name: RequestName
+
+
+class Allocator:
+    """Shared allocator for one scheduling loop (allocator.go:48-67)."""
+
+    def __init__(
+        self,
+        in_cluster_slices: list[ResourceSlice],
+        allocated_state: Optional[AllocatedDeviceState] = None,
+        device_classes: Optional[dict[str, DeviceClass]] = None,
+        attribute_bindings: Optional[AttributeBindings] = None,
+        deleting_pod_uids: Optional[set[str]] = None,
+    ):
+        self.tracker = AllocationTracker(allocated_state)
+        self.selector_cache = SelectorCache()
+        self.device_classes = device_classes or {}
+        self.attribute_bindings = attribute_bindings or AttributeBindings()
+        self.in_cluster_slices = in_cluster_slices
+        self.deleting_pod_uids = deleting_pod_uids or set()
+        self.pool_cache: dict[str, list[Pool]] = {}
+        self.claim_allocation_metadata: dict[str, ResourceClaimAllocationMetadata] = {}
+        # Seed counter budgets up-front so Allocate() stays read-only on the
+        # tracker (allocator.go:174-179).
+        for pool in gather_pools(in_cluster_slices, Requirements(), ""):
+            self.tracker.init_remaining_counters(pool)
+
+    def metadata_for_claim(self, claim_key: str) -> Optional[ResourceClaimAllocationMetadata]:
+        return self.claim_allocation_metadata.get(claim_key)
+
+    def release_instance_types(self, nodeclaim_id: str, *it_names: str) -> None:
+        """Free device allocations for ITs pruned from a NodeClaim
+        (allocator.go:253-288): drops their contributed requirements and
+        recomputes claim totals so later pods can relax."""
+        self.tracker.release_instance_types(nodeclaim_id, *it_names)
+        for meta in self.claim_allocation_metadata.values():
+            if meta.nodeclaim_id != nodeclaim_id:
+                continue
+            needs_recompute = False
+            for it_name in it_names:
+                if meta.contributed_requirements.get(it_name):
+                    needs_recompute = True
+                meta.contributed_requirements.pop(it_name, None)
+                meta.devices.pop(it_name, None)
+            if needs_recompute:
+                updated = Requirements()
+                for it_reqs in meta.contributed_requirements.values():
+                    updated.add(*it_reqs.values())
+                meta.total_requirements = updated
+
+    # -- claim classification ---------------------------------------------
+
+    def _claim_reserved_entirely_by_deleting_pods(self, claim: ResourceClaim) -> bool:
+        """allocator.go:465-484: all pod consumers deleting → re-allocate."""
+        if not claim.reserved_for:
+            return False
+        return all(uid in self.deleting_pod_uids for uid in claim.reserved_for)
+
+    def _classify_claims(
+        self, nodeclaim: DRANodeClaim, claims: list[ResourceClaim]
+    ) -> tuple[list[ResourceClaim], Requirements]:
+        """Split claims into unallocated vs already-allocated, folding the
+        allocated ones' topology into the effective requirements
+        (allocator.go:406-463)."""
+        requirements = nodeclaim.requirements.copy()
+        if nodeclaim.node_name:
+            # An existing node has a concrete hostname; node-pinned devices
+            # contribute hostname topology that must land on defined keys.
+            requirements.add(Requirement.new(l.LABEL_HOSTNAME, "In", nodeclaim.node_name))
+        unallocated: list[ResourceClaim] = []
+        for claim in claims:
+            if claim.allocation is not None and self._claim_reserved_entirely_by_deleting_pods(claim):
+                unallocated.append(claim)
+                continue
+            if claim.allocation is not None:
+                reqs = node_selector_to_requirements(claim.allocation.node_selector_terms)
+                if reqs is not None:
+                    if not requirements.is_compatible(reqs, l.WELL_KNOWN_LABELS):
+                        raise DRAError(
+                            f"claim {claim.key}: in-cluster allocation topology incompatible with NodeClaim"
+                        )
+                    requirements.add(*reqs.values())
+                continue
+            meta = self.claim_allocation_metadata.get(claim.key)
+            if meta is not None:
+                if meta.used_template_devices:
+                    if meta.nodeclaim_id != nodeclaim.id:
+                        raise DRAError(
+                            f"claim {claim.key} is bound to a different in-flight NodeClaim"
+                        )
+                elif len(meta.total_requirements) != 0:
+                    if not requirements.is_compatible(meta.total_requirements, l.WELL_KNOWN_LABELS):
+                        raise DRAError(
+                            f"claim {claim.key}: in-memory allocation topology incompatible with NodeClaim"
+                        )
+                    requirements.add(*meta.total_requirements.values())
+                continue
+            unallocated.append(claim)
+        return unallocated, requirements
+
+    # -- request validation ------------------------------------------------
+
+    def _build_request_data(
+        self,
+        claim: ResourceClaim,
+        name: RequestName,
+        req: "DeviceRequest | DeviceSubRequest",
+        pools: list[Pool],
+        template_devices_by_it: dict[str, list[DeviceWithID]],
+    ) -> _RequestData:
+        cls = self.device_classes.get(req.device_class)
+        if req.device_class and cls is None:
+            raise DRAError(f"claim {claim.key} request {name}: DeviceClass {req.device_class!r} not found")
+        selectors = list(cls.selectors) if cls else []
+        selectors.extend(req.selectors)
+        for s in selectors:
+            try:
+                self.selector_cache.compile(s)
+            except SelectorError as e:
+                raise DRAError(f"claim {claim.key} request {name}: {e}") from None
+
+        rd = _RequestData(
+            name=name,
+            selectors=selectors,
+            num_devices=req.count,
+            allocation_mode="ExactCount",
+            capacity_requests=dict(req.capacity_requests) if req.capacity_requests else None,
+        )
+        if req.allocation_mode == "All":
+            rd.allocation_mode = "All"
+            rd.num_devices = 0
+            rd.all_devices = self._collect_all_mode(claim, pools, selectors)
+            if template_devices_by_it:
+                in_cluster = rd.all_devices
+                for it_name, devices in template_devices_by_it.items():
+                    matched = [
+                        dw
+                        for dw in devices
+                        if self._matches(dw, selectors)
+                    ]
+                    # Keep the IT if it has matches, or in-cluster devices
+                    # keep the request satisfiable with zero templates
+                    # (request.go:363-377).
+                    if matched or in_cluster:
+                        rd.all_template_devices_by_it[it_name] = matched
+        return rd
+
+    def _matches(self, dw: DeviceWithID, selectors: list[str]) -> bool:
+        return all(self.selector_cache.matches(s, dw.device, dw.id) for s in selectors)
+
+    def _collect_all_mode(
+        self, claim: ResourceClaim, pools: list[Pool], selectors: list[str]
+    ) -> list[DeviceWithID]:
+        """All-mode needs a complete, valid view (request.go:386-409)."""
+        devices: list[DeviceWithID] = []
+        for pool in pools:
+            if pool.invalid:
+                raise DRAError(
+                    f"claim {claim.key}: pool {pool.key.driver}/{pool.key.pool} is invalid (duplicate device names)"
+                )
+            if pool.incomplete:
+                raise DRAError(
+                    f"claim {claim.key}: pool {pool.key.driver}/{pool.key.pool} is incomplete (missing slices)"
+                )
+            devices.extend(dw for dw in pool.devices if self._matches(dw, selectors))
+        return devices
+
+    def _validate_claim(
+        self,
+        claim: ResourceClaim,
+        pools: list[Pool],
+        template_devices_by_it: dict[str, list[DeviceWithID]],
+    ) -> _ClaimData:
+        """request.go:130-259 — parse constraints + requests, enforce the
+        device-count cap, prune ITs whose template devices overflow it."""
+        cd = _ClaimData(id=claim.key)
+        for spec in claim.constraints:
+            if spec.distinct_attribute is not None:
+                raise DRAError(f"claim {claim.key}: DistinctAttribute constraints not supported")
+            if not spec.attribute:
+                raise DRAError(f"claim {claim.key}: unsupported constraint type")
+            cd.constraints.append(
+                MatchAttributeConstraint(
+                    attribute=spec.attribute,
+                    request_names=frozenset(spec.requests),
+                )
+            )
+        for req in claim.requests:
+            if req.first_available:
+                parent = _RequestData(name=RequestName(req.name))
+                for sub in req.first_available:
+                    sub_rd = self._build_request_data(
+                        claim, RequestName(req.name, sub.name), sub, pools, template_devices_by_it
+                    )
+                    parent.sub_requests.append(sub_rd)
+                cd.requests.append(parent)
+            else:
+                cd.requests.append(
+                    self._build_request_data(
+                        claim, RequestName(req.name), req, pools, template_devices_by_it
+                    )
+                )
+
+        # Base device total (IT-independent part), request.go:186-205.
+        base_total = 0
+        for rd in cd.requests:
+            if rd.sub_requests:
+                base_total += min(sub.num_devices + len(sub.all_devices) for sub in rd.sub_requests)
+            else:
+                base_total += rd.num_devices + len(rd.all_devices)
+        if base_total > ALLOCATION_RESULTS_MAX_SIZE:
+            raise DRAError(
+                f"claim {claim.key} requests {base_total} devices, exceeding the maximum of {ALLOCATION_RESULTS_MAX_SIZE}"
+            )
+
+        # Per-IT pruning of template All-mode devices (request.go:207-255).
+        all_its: set[str] = set()
+        for rd in cd.requests:
+            for sub in rd.sub_requests or [rd]:
+                all_its.update(sub.all_template_devices_by_it)
+        pruned = 0
+        for it_name in all_its:
+            template_count = 0
+            for rd in cd.requests:
+                if rd.sub_requests:
+                    template_count += min(
+                        len(sub.all_template_devices_by_it.get(it_name, [])) for sub in rd.sub_requests
+                    )
+                else:
+                    template_count += len(rd.all_template_devices_by_it.get(it_name, []))
+            if base_total + template_count > ALLOCATION_RESULTS_MAX_SIZE:
+                pruned += 1
+                for rd in cd.requests:
+                    for sub in rd.sub_requests or [rd]:
+                        sub.all_template_devices_by_it.pop(it_name, None)
+        if all_its and pruned == len(all_its):
+            raise DRAError(
+                f"claim {claim.key}: no instance type can satisfy this claim within the maximum of "
+                f"{ALLOCATION_RESULTS_MAX_SIZE} devices"
+            )
+        return cd
+
+    # -- allocation --------------------------------------------------------
+
+    def allocate(self, nodeclaim: DRANodeClaim, claims: list[ResourceClaim]) -> AllocationResult:
+        """Satisfy all of a pod's claims against one NodeClaim
+        (allocator.go:290-396). Raises DRAError when no instance type can."""
+        if not claims:
+            return AllocationResult(instance_types=list(nodeclaim.instance_types), requirements=Requirements())
+
+        unallocated, requirements = self._classify_claims(nodeclaim, claims)
+        if not unallocated:
+            return AllocationResult(
+                instance_types=list(nodeclaim.instance_types), requirements=requirements
+            )
+
+        cached = self.pool_cache.get(nodeclaim.id)
+        if cached is not None:
+            pools = filter_pools(cached, requirements, nodeclaim.node_name)
+        else:
+            pools = gather_pools(self.in_cluster_slices, requirements, nodeclaim.node_name)
+
+        template_devices_by_it: dict[str, list[DeviceWithID]] = {}
+        for it_name, slices in nodeclaim.resource_slices.items():
+            for s in slices:
+                for d in s.devices:
+                    template_devices_by_it.setdefault(it_name, []).append(
+                        DeviceWithID(
+                            device=d,
+                            id=DeviceID(driver=s.driver, pool=s.pool, device=d.name, template=True),
+                        )
+                    )
+
+        claim_data = [self._validate_claim(c, pools, template_devices_by_it) for c in unallocated]
+        search = _Search(
+            allocator=self,
+            nodeclaim=nodeclaim,
+            pools=pools,
+            template_devices_by_it=template_devices_by_it,
+            claim_data=claim_data,
+            requirements=requirements,
+        )
+        return search.run(list(nodeclaim.instance_types))
+
+
+class _Search:
+    """Per-Allocate() mutable DFS state (allocator.go:486-540)."""
+
+    def __init__(
+        self,
+        allocator: Allocator,
+        nodeclaim: DRANodeClaim,
+        pools: list[Pool],
+        template_devices_by_it: dict[str, list[DeviceWithID]],
+        claim_data: list[_ClaimData],
+        requirements: Requirements,
+    ):
+        self.allocator = allocator
+        self.tracker = allocator.tracker
+        self.nodeclaim = nodeclaim
+        self.initial_pools = pools
+        self.pools = pools
+        self.pools_by_key: dict[PoolKey, Pool] = {}
+        self.template_devices_by_it = template_devices_by_it
+        self.claim_data = claim_data
+        self.requirements = requirements
+        self.it_name = ""
+        self.deadline = time.monotonic() + ALLOCATE_TIMEOUT_SECONDS
+        self.match_cache: dict[tuple[DeviceID, int, int, int], bool] = {}
+
+        self.allocated_devices: set[DeviceID] = set()
+        self.allocation_path: list[_DeviceAllocation] = []
+        self.allocating_counters: Counters = {}
+        self.template_allocating_counters: Counters = {}
+        self.template_remaining_counters: Optional[Counters] = None
+        self.allocating_capacity: Capacity = {}
+        self.template_allocating_capacity: Capacity = {}
+        self.snapshots: list[tuple[Requirements, list[Pool]]] = []
+
+    # -- top-level per-IT loop --------------------------------------------
+
+    def run(self, instance_types: list[str]) -> AllocationResult:
+        surviving: list[str] = []
+        device_ids_by_it: dict[str, list[DeviceID]] = {}
+        counters_by_it: dict[str, Counters] = {}
+        template_counters_by_it: dict[str, Counters] = {}
+        capacity_by_it: dict[str, Capacity] = {}
+        template_capacity_by_it: dict[str, Capacity] = {}
+        template_counter_totals_by_it: dict[str, Counters] = {}
+
+        claim_meta = [
+            ResourceClaimAllocationMetadata(nodeclaim_id=self.nodeclaim.id)
+            for _ in self.claim_data
+        ]
+
+        for it_name in instance_types:
+            if time.monotonic() > self.deadline:
+                break
+            self.it_name = it_name
+            self._restore_state()
+            fallback = BindingFallback(
+                bindings=self.allocator.attribute_bindings,
+                nodepool=self.nodeclaim.nodepool,
+                instance_type=it_name,
+            )
+            for cd in self.claim_data:
+                for con in cd.constraints:
+                    con.binding_fallback = fallback
+
+            if not self._counters_feasible():
+                continue
+            if not self._dfs(0, 0, -1, 0):
+                continue
+
+            surviving.append(it_name)
+            counters_by_it[it_name] = self.allocating_counters
+            template_counters_by_it[it_name] = self.template_allocating_counters
+            capacity_by_it[it_name] = self.allocating_capacity
+            template_capacity_by_it[it_name] = self.template_allocating_capacity
+            if (
+                self.template_remaining_counters is not None
+                and self.tracker.template_remaining_for_it(self.nodeclaim.id, it_name) is None
+            ):
+                template_counter_totals_by_it[it_name] = self.template_remaining_counters
+            self.allocating_counters = {}
+            self.template_allocating_counters = {}
+            self.allocating_capacity = {}
+            self.template_allocating_capacity = {}
+
+            device_ids_by_it[it_name] = [da.device.id for da in self.allocation_path]
+            it_reqs = Requirements()
+            for da in self.allocation_path:
+                meta = claim_meta[da.claim_index]
+                if da.device.topology_requirements is not None:
+                    claim_it_reqs = meta.contributed_requirements.setdefault(it_name, Requirements())
+                    claim_it_reqs.add(*da.device.topology_requirements.values())
+                    it_reqs.add(*da.device.topology_requirements.values())
+                if da.device.id.template:
+                    meta.used_template_devices = True
+                meta.devices.setdefault(it_name, []).append(
+                    DeviceAllocationResult(
+                        device_id=da.device.id,
+                        request_name=da.request_name,
+                        consumed_capacity=da.consumed_capacity,
+                    )
+                )
+            # Later ITs must stay representable alongside this one
+            # (allocator.go:663-669).
+            self.requirements.add(*it_reqs.values())
+
+        if not surviving:
+            raise DRAError("no instance type can satisfy the allocation")
+
+        nodeclaim_requirements = Requirements()
+        meta_by_claim: dict[str, ResourceClaimAllocationMetadata] = {}
+        for idx, meta in enumerate(claim_meta):
+            total = Requirements()
+            for it_reqs in meta.contributed_requirements.values():
+                for req in it_reqs.values():
+                    total.add(req)
+                    nodeclaim_requirements.add(req)
+            meta.total_requirements = total
+            meta_by_claim[self.claim_data[idx].id] = meta
+
+        filtered_pools = filter_pools(self.initial_pools, self.requirements, self.nodeclaim.node_name)
+        allocator = self.allocator
+        nodeclaim_id = self.nodeclaim.id
+
+        def commit() -> None:
+            """allocation.Commit (allocator.go:231-251)."""
+            allocator.tracker.commit(
+                nodeclaim_id,
+                device_ids_by_it,
+                counters_by_it,
+                template_counters_by_it,
+                capacity_by_it,
+                template_capacity_by_it,
+                template_counter_totals_by_it,
+            )
+            allocator.pool_cache[nodeclaim_id] = filtered_pools
+            for claim_id, meta in meta_by_claim.items():
+                if claim_id in allocator.claim_allocation_metadata:
+                    raise AssertionError("attempted to commit claim which was already allocated")
+                allocator.claim_allocation_metadata[claim_id] = meta
+
+        return AllocationResult(
+            instance_types=surviving,
+            requirements=nodeclaim_requirements,
+            allocation=commit,
+        )
+
+    def _restore_state(self) -> None:
+        """Reset mutable DFS state for a new IT (allocator.go:986-1004);
+        requirements intentionally persist across ITs."""
+        self.allocation_path = []
+        self.pools = self.initial_pools
+        self._build_pool_index()
+        self.allocated_devices = set()
+        self.allocating_counters = {}
+        self.template_allocating_counters = {}
+        self.template_remaining_counters = self._build_template_counters()
+        self.allocating_capacity = {}
+        self.template_allocating_capacity = {}
+        self.snapshots = []
+        for cd in self.claim_data:
+            for con in cd.constraints:
+                con.reset()
+
+    def _build_pool_index(self) -> None:
+        self.pools_by_key = {p.key: p for p in self.pools}
+
+    def _build_template_counters(self) -> Optional[Counters]:
+        """allocator.go:1013-1061 — per-(NC, IT) template budgets, from the
+        tracker when a prior pod initialized them, else computed locally."""
+        remaining = self.tracker.template_remaining_for_it(self.nodeclaim.id, self.it_name)
+        if remaining is not None:
+            return remaining
+        slices = self.nodeclaim.resource_slices.get(self.it_name)
+        if not slices:
+            return None
+        totals: Counters = {}
+        for s in slices:
+            if not s.shared_counters:
+                continue
+            pool_key = PoolKey(driver=s.driver, pool=s.pool)
+            counter_sets = totals.setdefault(pool_key, {})
+            for cs in s.shared_counters:
+                dst = counter_sets.setdefault(cs.name, {})
+                for name, value in cs.counters.items():
+                    dst[name] = value
+        return totals or None
+
+    # -- DFS ---------------------------------------------------------------
+
+    def _dfs(self, claim_idx: int, req_idx: int, sub_req_idx: int, slot_idx: int) -> bool:
+        if time.monotonic() > self.deadline:
+            return False
+        if claim_idx >= len(self.claim_data):
+            return True
+        cd = self.claim_data[claim_idx]
+        if req_idx >= len(cd.requests):
+            return self._dfs(claim_idx + 1, 0, -1, 0)
+        rd = cd.requests[req_idx] if sub_req_idx < 0 else cd.requests[req_idx].sub_requests[sub_req_idx]
+
+        if sub_req_idx < 0 and rd.sub_requests:
+            # FirstAvailable: alternatives in priority order (allocator.go:781-788).
+            for sub_idx in range(len(rd.sub_requests)):
+                if self._dfs(claim_idx, req_idx, sub_idx, 0):
+                    return True
+            return False
+
+        num_slots = self._num_slots(rd)
+        if rd.allocation_mode == "All" and num_slots == 0:
+            return False
+        if slot_idx == 0 and self._claim_device_count(claim_idx) + num_slots > ALLOCATION_RESULTS_MAX_SIZE:
+            return False
+        if slot_idx >= num_slots:
+            return self._dfs(claim_idx, req_idx + 1, -1, 0)
+
+        if rd.allocation_mode == "All":
+            # Each slot maps to one predetermined device (allocator.go:827-841).
+            in_cluster = len(rd.all_devices)
+            if slot_idx < in_cluster:
+                dw = rd.all_devices[slot_idx]
+                return self._try_device(claim_idx, req_idx, sub_req_idx, slot_idx, cd, rd, dw)
+            template_devices = rd.all_template_devices_by_it.get(self.it_name, [])
+            template_idx = slot_idx - in_cluster
+            if template_idx < len(template_devices):
+                dw = template_devices[template_idx]
+                return self._try_device(claim_idx, req_idx, sub_req_idx, slot_idx, cd, rd, dw)
+            return False
+
+        # ExactCount: iterate devices lazily from current pools then templates
+        # (allocator.go:800-823) so pool re-filtering is reflected mid-search.
+        for pool in self.pools:
+            if pool.incomplete:
+                continue
+            exhausted = self._pool_counters_exhausted(pool)
+            for dw in pool.devices:
+                if exhausted and dw.device.consumes_counters:
+                    continue
+                if self._try_device(claim_idx, req_idx, sub_req_idx, slot_idx, cd, rd, dw):
+                    return True
+        for dw in self.template_devices_by_it.get(self.it_name, []):
+            if self._try_device(claim_idx, req_idx, sub_req_idx, slot_idx, cd, rd, dw):
+                return True
+        return False
+
+    def _num_slots(self, rd: _RequestData) -> int:
+        if rd.allocation_mode == "All":
+            return len(rd.all_devices) + len(rd.all_template_devices_by_it.get(self.it_name, []))
+        return rd.num_devices
+
+    def _claim_device_count(self, claim_idx: int) -> int:
+        return sum(1 for da in self.allocation_path if da.claim_index == claim_idx)
+
+    def _try_device(
+        self,
+        claim_idx: int,
+        req_idx: int,
+        sub_req_idx: int,
+        slot_idx: int,
+        cd: _ClaimData,
+        rd: _RequestData,
+        dw: DeviceWithID,
+    ) -> bool:
+        """allocator.go:847-983 — availability, counters, selector match,
+        constraints, topology compatibility; record, recurse, backtrack."""
+        device_id = dw.id
+
+        # 1. Availability: capacity gates multi-alloc devices, binary
+        #    tracking gates exclusive ones.
+        consumed: Optional[dict[str, float]] = None
+        if dw.device.allow_multiple_allocations:
+            ok, consumed = self._check_capacity(dw, rd)
+            if not ok:
+                return False
+        else:
+            if self.tracker.is_allocated(device_id, self.nodeclaim.id, self.it_name):
+                return False
+            if device_id in self.allocated_devices:
+                return False
+
+        # 2. Shared counter budgets.
+        if dw.device.consumes_counters:
+            pool_key = PoolKey(driver=device_id.driver, pool=device_id.pool)
+            if device_id.template:
+                remaining = (self.template_remaining_counters or {}).get(pool_key)
+            else:
+                if pool_key not in self.pools_by_key:
+                    return False
+                remaining = self.tracker.remaining_counters.get(pool_key)
+            if not self._check_counters(dw, pool_key, remaining, device_id.template):
+                return False
+
+        # 3. Selector match (cached per device/claim/request position).
+        mk = (device_id, claim_idx, req_idx, sub_req_idx)
+        matched = self.match_cache.get(mk)
+        if matched is None:
+            matched = self.allocator._matches(dw, rd.selectors)
+            self.match_cache[mk] = matched
+        if not matched:
+            return False
+
+        # 4. Constraints (stateful, with exact rollback on failure).
+        added = 0
+        for con in cd.constraints:
+            if not con.add(rd.name, dw.device, device_id):
+                for j in range(added - 1, -1, -1):
+                    cd.constraints[j].remove(rd.name, dw.device, device_id)
+                return False
+            added += 1
+
+        # 5. Topology compatibility; push a snapshot when tightening.
+        pushed = False
+        if dw.topology_requirements is not None:
+            if not self.requirements.is_compatible(dw.topology_requirements, l.WELL_KNOWN_LABELS):
+                for j in range(added - 1, -1, -1):
+                    cd.constraints[j].remove(rd.name, dw.device, device_id)
+                return False
+            self.snapshots.append((self.requirements.copy(), self.pools))
+            self.requirements.add(*dw.topology_requirements.values())
+            self.pools = filter_pools(self.pools, self.requirements, self.nodeclaim.node_name)
+            self._build_pool_index()
+            pushed = True
+
+        # Record.
+        self.allocated_devices.add(device_id)
+        self.allocation_path.append(
+            _DeviceAllocation(
+                claim_index=claim_idx,
+                device=dw,
+                consumed_capacity=consumed,
+                request_name=rd.name,
+            )
+        )
+        if dw.device.allow_multiple_allocations:
+            # Ensure an entry exists so commit can identify multi-alloc
+            # devices via capacity presence (allocator.go:947-954).
+            cap_map = self.template_allocating_capacity if device_id.template else self.allocating_capacity
+            cap_map.setdefault(device_id, {})
+        self._deduct_capacity(consumed, device_id, device_id.template)
+        self._deduct_counters(dw, device_id.template)
+
+        if self._dfs(claim_idx, req_idx, sub_req_idx, slot_idx + 1):
+            return True
+
+        # Backtrack, reversing application order.
+        self._restore_capacity(consumed, device_id, device_id.template)
+        self._restore_counters(dw, device_id.template)
+        self.allocation_path.pop()
+        self.allocated_devices.discard(device_id)
+        if pushed:
+            reqs, pools = self.snapshots.pop()
+            self.requirements = reqs
+            self.pools = pools
+            self._build_pool_index()
+        for j in range(added - 1, -1, -1):
+            cd.constraints[j].remove(rd.name, dw.device, device_id)
+        return False
+
+    # -- consumable capacity ----------------------------------------------
+
+    def _check_capacity(self, dw: DeviceWithID, rd: _RequestData) -> tuple[bool, Optional[dict[str, float]]]:
+        """consumable_capacity.go:31-72."""
+        device_id = dw.id
+        try:
+            consumed = compute_consumed_capacity(rd.capacity_requests, dw.device.capacity)
+        except ValueError:
+            return False, None
+        if consumed is None:
+            return True, None
+        if device_id.template:
+            sources = []
+            tc = self.tracker.template_consumed_capacity_for_it(self.nodeclaim.id, self.it_name)
+            if tc is not None:
+                sources.append(tc.get(device_id, {}))
+            sources.append(self.template_allocating_capacity.get(device_id, {}))
+        else:
+            sources = [
+                self.tracker.preallocated_consumed_capacity.get(device_id, {}),
+                self.tracker.inflight_consumed_capacity.get(device_id, {}),
+                self.allocating_capacity.get(device_id, {}),
+            ]
+        for name, qty in consumed.items():
+            total = dw.device.capacity[name].value
+            used = sum(src.get(name, 0.0) for src in sources) + qty
+            if used > total * (1 + 1e-9):
+                return False, None
+        return True, consumed
+
+    def _deduct_capacity(self, consumed: Optional[dict[str, float]], device_id: DeviceID, template: bool) -> None:
+        if not consumed:
+            return
+        cap_map = self.template_allocating_capacity if template else self.allocating_capacity
+        cap_map[device_id] = add_capacity(cap_map.get(device_id), consumed)
+
+    def _restore_capacity(self, consumed: Optional[dict[str, float]], device_id: DeviceID, template: bool) -> None:
+        if not consumed:
+            return
+        cap_map = self.template_allocating_capacity if template else self.allocating_capacity
+        if device_id in cap_map:
+            sub_capacity(cap_map[device_id], consumed)
+
+    # -- shared counters ---------------------------------------------------
+
+    def _pool_counters_exhausted(self, pool: Pool) -> bool:
+        """partitionable_devices.go poolCountersExhausted."""
+        if not pool.counter_sets:
+            return False
+        remaining = self.tracker.remaining_counters.get(pool.key)
+        allocating = self.allocating_counters.get(pool.key)
+        if remaining is None or allocating is None:
+            return False
+        for cs_name, counters in allocating.items():
+            cs_remaining = remaining.get(cs_name)
+            if cs_remaining is None:
+                continue
+            for name, alloc_value in counters.items():
+                if name in cs_remaining and cs_remaining[name] - alloc_value <= 0:
+                    return True
+        return False
+
+    def _check_counters(
+        self,
+        dw: DeviceWithID,
+        pool_key: PoolKey,
+        remaining: Optional[dict[str, dict[str, float]]],
+        template: bool,
+    ) -> bool:
+        """partitionable_devices.go checkCounters."""
+        if not dw.device.consumes_counters:
+            return True
+        if remaining is None:
+            return False
+        allocating_sets = (
+            self.template_allocating_counters if template else self.allocating_counters
+        ).get(pool_key, {})
+        for cc in dw.device.consumes_counters:
+            cs_remaining = remaining.get(cc.counter_set)
+            if cs_remaining is None:
+                return False
+            allocating = allocating_sets.get(cc.counter_set, {})
+            for name, value in cc.counters.items():
+                if name not in cs_remaining:
+                    return False
+                if cs_remaining[name] - allocating.get(name, 0.0) < value * (1 - 1e-9):
+                    return False
+        return True
+
+    def _deduct_counters(self, dw: DeviceWithID, template: bool) -> None:
+        if not dw.device.consumes_counters:
+            return
+        pool_key = PoolKey(driver=dw.id.driver, pool=dw.id.pool)
+        counter_map = self.template_allocating_counters if template else self.allocating_counters
+        counter_sets = counter_map.setdefault(pool_key, {})
+        for cc in dw.device.consumes_counters:
+            counters = counter_sets.setdefault(cc.counter_set, {})
+            for name, value in cc.counters.items():
+                counters[name] = counters.get(name, 0.0) + value
+
+    def _restore_counters(self, dw: DeviceWithID, template: bool) -> None:
+        if not dw.device.consumes_counters:
+            return
+        pool_key = PoolKey(driver=dw.id.driver, pool=dw.id.pool)
+        counter_map = self.template_allocating_counters if template else self.allocating_counters
+        counter_sets = counter_map.get(pool_key)
+        if counter_sets is None:
+            return
+        for cc in dw.device.consumes_counters:
+            counters = counter_sets.get(cc.counter_set)
+            if counters is None:
+                continue
+            for name, value in cc.counters.items():
+                if name in counters:
+                    counters[name] -= value
+
+    # -- pre-DFS feasibility ----------------------------------------------
+
+    def _counters_feasible(self) -> bool:
+        """partitionable_devices.go countersFeasible — lower-bound check for
+        All-mode requests whose device sets are predetermined."""
+        for cd in self.claim_data:
+            for rd in cd.requests:
+                if rd.sub_requests:
+                    if not any(
+                        sub.allocation_mode != "All" or self._all_mode_feasible(sub)
+                        for sub in rd.sub_requests
+                    ):
+                        return False
+                elif rd.allocation_mode == "All":
+                    if not self._all_mode_feasible(rd):
+                        return False
+        return True
+
+    def _all_mode_feasible(self, rd: _RequestData) -> bool:
+        in_cluster_shadow: Counters = {}
+        template_shadow: Counters = {}
+        devices = list(rd.all_devices) + list(rd.all_template_devices_by_it.get(self.it_name, []))
+        for dw in devices:
+            if not dw.device.consumes_counters:
+                continue
+            pool_key = PoolKey(driver=dw.id.driver, pool=dw.id.pool)
+            shadow = template_shadow if dw.id.template else in_cluster_shadow
+            if pool_key not in shadow:
+                if dw.id.template:
+                    remaining = (self.template_remaining_counters or {}).get(pool_key)
+                else:
+                    remaining = self.tracker.remaining_counters.get(pool_key)
+                if remaining is None:
+                    return True
+                shadow[pool_key] = {cs: dict(counters) for cs, counters in remaining.items()}
+            pool_shadow = shadow[pool_key]
+            for cc in dw.device.consumes_counters:
+                cs_shadow = pool_shadow.get(cc.counter_set)
+                if cs_shadow is None:
+                    return False
+                for name, value in cc.counters.items():
+                    if name not in cs_shadow:
+                        return False
+                    cs_shadow[name] -= value
+                    if cs_shadow[name] < -1e-9:
+                        return False
+        return True

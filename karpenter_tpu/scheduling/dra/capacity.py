@@ -1,0 +1,129 @@
+"""Consumable-capacity math for multi-allocatable devices.
+
+Counterpart of reference pkg/scheduling/dynamicresources/consumable_capacity.go
+(policy evaluation at :358-464). A multi-allocatable device is shared across
+claims; each allocation consumes per-dimension quantities computed from the
+request and the device's request policy (default fill, range rounding,
+valid-value rounding), and the allocator verifies the running total never
+exceeds the dimension's capacity.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from karpenter_tpu.scheduling.dra.types import DeviceCapacity, RequestPolicy
+
+_REL_TOL = 1e-9
+
+
+def _leq(a: float, b: float) -> bool:
+    return a <= b or math.isclose(a, b, rel_tol=_REL_TOL)
+
+
+def fill_empty_request(capacity: DeviceCapacity) -> float:
+    """Unrequested dimension: policy default if set, else the full value
+    (consumable_capacity.go:380-385)."""
+    p = capacity.request_policy
+    if p is not None and p.default is not None:
+        return p.default
+    return capacity.value
+
+
+def round_up_range(requested: float, policy: RequestPolicy) -> float:
+    """Round up into [min, min + N*step] (consumable_capacity.go:392-408)."""
+    lo = policy.valid_range_min
+    assert lo is not None
+    if requested < lo:
+        return lo
+    step = policy.valid_range_step
+    if step is None or step <= 0:
+        return requested
+    n = math.ceil((requested - lo) / step - _REL_TOL)
+    return lo + step * n
+
+
+def round_up_valid_values(requested: float, valid_values: list[float]) -> float:
+    """First valid value >= requested; requested itself if none
+    (consumable_capacity.go:412-420)."""
+    for v in valid_values:
+        if _leq(requested, v):
+            return v
+    return requested
+
+
+def calculate_consumed(requested: Optional[float], capacity: DeviceCapacity) -> float:
+    """Consumed quantity for one dimension (consumable_capacity.go:362-376)."""
+    if requested is None:
+        return fill_empty_request(capacity)
+    p = capacity.request_policy
+    if p is None:
+        return requested
+    if p.valid_range_min is not None:
+        return round_up_range(requested, p)
+    if p.valid_values:
+        return round_up_valid_values(requested, p.valid_values)
+    return requested
+
+
+def violates_policy(consumed: float, policy: Optional[RequestPolicy]) -> bool:
+    """Post-rounding policy check (consumable_capacity.go:424-464)."""
+    if policy is None:
+        return False
+    if policy.default is not None and math.isclose(consumed, policy.default, rel_tol=_REL_TOL):
+        return False
+    if policy.valid_range_min is not None:
+        if policy.valid_range_max is not None and consumed > policy.valid_range_max * (1 + _REL_TOL):
+            return True
+        step = policy.valid_range_step
+        if step:
+            n = (consumed - policy.valid_range_min) / step
+            if not math.isclose(n, round(n), abs_tol=1e-6):
+                return True
+        return False
+    if policy.valid_values:
+        return not any(math.isclose(consumed, v, rel_tol=_REL_TOL) for v in policy.valid_values)
+    return False
+
+
+def compute_consumed_capacity(
+    capacity_requests: Optional[dict[str, float]],
+    device_capacity: dict[str, DeviceCapacity],
+) -> Optional[dict[str, float]]:
+    """Per-dimension consumed quantities for one allocation, or None when
+    the device has no capacity dimensions. Raises ValueError on requests for
+    nonexistent dimensions or policy violations
+    (consumable_capacity.go:290-312,346-356)."""
+    if capacity_requests:
+        for name in capacity_requests:
+            if name not in device_capacity:
+                raise ValueError(f"capacity dimension {name!r} does not exist on device")
+    if not device_capacity:
+        return None
+    consumed: dict[str, float] = {}
+    for name, cap in device_capacity.items():
+        requested = capacity_requests.get(name) if capacity_requests else None
+        c = calculate_consumed(requested, cap)
+        if violates_policy(c, cap.request_policy):
+            raise ValueError(f"capacity request violates policy for dimension {name!r}")
+        consumed[name] = c
+    return consumed
+
+
+def add_capacity(dst: Optional[dict[str, float]], src: Optional[dict[str, float]]) -> dict[str, float]:
+    if not src:
+        return dst if dst is not None else {}
+    if dst is None:
+        dst = {}
+    for name, qty in src.items():
+        dst[name] = dst.get(name, 0.0) + qty
+    return dst
+
+
+def sub_capacity(dst: dict[str, float], src: Optional[dict[str, float]]) -> dict[str, float]:
+    if not src:
+        return dst
+    for name, qty in src.items():
+        dst[name] = dst.get(name, 0.0) - qty
+    return dst

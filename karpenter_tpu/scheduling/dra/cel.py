@@ -1,0 +1,217 @@
+"""Device selector expressions.
+
+The reference evaluates CEL expressions against a device view
+(request.go:431-463 via k8s.io/dynamic-resource-allocation/cel). This module
+is the framework's equivalent: a small, safe expression engine over the same
+device context, compiled once per distinct expression and cached
+(the analog of dracel.Cache — allocator.go:370, request.go:334-339).
+
+Supported surface (CEL-compatible where it matters to device selectors):
+
+    device.driver                        -> str
+    device.attributes["domain/name"]     -> typed attribute value
+    device.capacity["dimension"]         -> float (quantity)
+    device.allowMultipleAllocations      -> bool
+    ==  !=  <  <=  >  >=  in             comparisons
+    &&  ||  !                            boolean operators (CEL spelling)
+    quantity("10Gi")                     -> float
+    string/int/float/bool literals, lists, parentheses, + - * /
+
+Attribute lookups use the driver-qualified fallback of
+constraint.go:168-180: ``device.attributes["d/x"]`` on a device of driver
+``d`` also matches an attribute published unqualified as ``x``.
+
+Expressions are parsed with the Python ``ast`` module against a strict node
+whitelist and evaluated with empty builtins — no calls other than
+``quantity``, no dunder access, no comprehensions. A compile failure is a
+validation error (claims referencing it are rejected, request.go:334-339); a
+runtime failure (missing attribute, type mismatch) makes the device
+non-matching, mirroring DeviceMatchesSelectors' error-as-no-match handling
+in tryDevice (allocator.go:893-905).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from karpenter_tpu.scheduling.dra.types import Device, DeviceID, Version
+from karpenter_tpu.utils.resources import parse_quantity
+
+
+class SelectorError(Exception):
+    """Raised for selector compile failures and runtime lookup misses."""
+
+
+_ALLOWED_NODES = (
+    ast.Expression,
+    ast.BoolOp,
+    ast.And,
+    ast.Or,
+    ast.UnaryOp,
+    ast.Not,
+    ast.USub,
+    ast.BinOp,
+    ast.Add,
+    ast.Sub,
+    ast.Mult,
+    ast.Div,
+    ast.Compare,
+    ast.Eq,
+    ast.NotEq,
+    ast.Lt,
+    ast.LtE,
+    ast.Gt,
+    ast.GtE,
+    ast.In,
+    ast.NotIn,
+    ast.Name,
+    ast.Load,
+    ast.Attribute,
+    ast.Subscript,
+    ast.Constant,
+    ast.Call,
+    ast.List,
+    ast.Tuple,
+)
+
+_ALLOWED_NAMES = {"device", "quantity", "True", "False"}
+
+# CEL spellings -> Python: `&&`, `||`, and bare `!` (but not `!=`).
+_CEL_REWRITES = (
+    (re.compile(r"&&"), " and "),
+    (re.compile(r"\|\|"), " or "),
+    (re.compile(r"!(?!=)"), " not "),
+    (re.compile(r"\btrue\b"), "True"),
+    (re.compile(r"\bfalse\b"), "False"),
+)
+
+
+def _rewrite(expression: str) -> str:
+    # Protect string literals from rewrites by splitting on quoted spans.
+    parts = re.split(r"(\"[^\"]*\"|'[^']*')", expression)
+    out = []
+    for i, part in enumerate(parts):
+        if i % 2 == 0:
+            for pattern, repl in _CEL_REWRITES:
+                part = pattern.sub(repl, part)
+        out.append(part)
+    return "".join(out)
+
+
+def _validate(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, _ALLOWED_NODES):
+            raise SelectorError(f"disallowed syntax: {type(node).__name__}")
+        if isinstance(node, ast.Name) and node.id not in _ALLOWED_NAMES:
+            raise SelectorError(f"unknown identifier: {node.id}")
+        if isinstance(node, ast.Attribute) and node.attr.startswith("_"):
+            raise SelectorError(f"disallowed attribute: {node.attr}")
+        if isinstance(node, ast.Call):
+            if not (isinstance(node.func, ast.Name) and node.func.id == "quantity"):
+                raise SelectorError("only quantity(...) calls are allowed")
+            if node.keywords or len(node.args) != 1:
+                raise SelectorError("quantity takes exactly one positional argument")
+
+
+class _AttrMap:
+    """Attribute lookup with the driver-qualified fallback."""
+
+    def __init__(self, device: Device, device_id: DeviceID):
+        self._attrs = device.attributes
+        self._driver = device_id.driver
+
+    def __getitem__(self, name: str):
+        if name in self._attrs:
+            return _unwrap(self._attrs[name])
+        domain, sep, ident = name.partition("/")
+        if sep and domain == self._driver and ident in self._attrs:
+            return _unwrap(self._attrs[ident])
+        raise SelectorError(f"attribute {name!r} not present")
+
+    def __contains__(self, name: str) -> bool:
+        try:
+            self[name]
+            return True
+        except SelectorError:
+            return False
+
+
+class _CapacityMap:
+    def __init__(self, device: Device):
+        self._capacity = device.capacity
+
+    def __getitem__(self, name: str) -> float:
+        if name not in self._capacity:
+            raise SelectorError(f"capacity {name!r} not present")
+        return self._capacity[name].value
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._capacity
+
+
+def _unwrap(value):
+    return value.value if isinstance(value, Version) else value
+
+
+class _DeviceView:
+    """The ``device`` binding visible to selector expressions."""
+
+    def __init__(self, device: Device, device_id: DeviceID):
+        self.driver = device_id.driver
+        self.attributes = _AttrMap(device, device_id)
+        self.capacity = _CapacityMap(device)
+        self.allowMultipleAllocations = device.allow_multiple_allocations
+
+
+def _quantity(q) -> float:
+    return parse_quantity(q)
+
+
+class SelectorCache:
+    """Compile-once cache for selector expressions (dracel.Cache analog)."""
+
+    def __init__(self) -> None:
+        self._compiled: dict[str, object] = {}
+        self._errors: dict[str, SelectorError] = {}
+
+    def compile(self, expression: str):
+        """Compile an expression, caching both successes and failures.
+        Raises SelectorError on invalid expressions."""
+        if expression in self._errors:
+            raise self._errors[expression]
+        code = self._compiled.get(expression)
+        if code is None:
+            try:
+                tree = ast.parse(_rewrite(expression), mode="eval")
+                _validate(tree)
+                code = compile(tree, "<selector>", "eval")
+            except (SyntaxError, ValueError, SelectorError) as e:
+                err = SelectorError(f"selector {expression!r}: {e}")
+                self._errors[expression] = err
+                raise err from None
+            self._compiled[expression] = code
+        return code
+
+    def matches(self, expression: str, device: Device, device_id: DeviceID) -> bool:
+        """Evaluate one selector against a device. Compile errors propagate
+        (callers validate up-front); runtime errors mean no-match."""
+        code = self.compile(expression)
+        env = {"device": _DeviceView(device, device_id), "quantity": _quantity}
+        try:
+            return bool(eval(code, {"__builtins__": {}}, env))  # noqa: S307 - whitelisted AST
+        except SelectorError:
+            return False
+        except (TypeError, KeyError, AttributeError, ZeroDivisionError, ValueError):
+            # ValueError covers malformed quantity literals at eval time.
+            return False
+
+
+def device_matches_selectors(
+    cache: SelectorCache,
+    device: Device,
+    device_id: DeviceID,
+    selectors: list[str],
+) -> bool:
+    """AND semantics across selectors (request.go:431-463)."""
+    return all(cache.matches(s, device, device_id) for s in selectors)

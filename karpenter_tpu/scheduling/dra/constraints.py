@@ -1,0 +1,199 @@
+"""Stateful inter-device constraints and attribute bindings.
+
+Counterparts of reference pkg/scheduling/dynamicresources/constraint.go and
+attributebindings.go. MatchAttribute pins a value with the first allocated
+device and rejects later devices that disagree; Add/Remove form an exact
+undo pair so the DFS can backtrack. Attribute bindings cover runtime-only
+attributes (e.g. a PCI-root id unknown until launch): the cloud provider
+declares which devices on an instance type will share the value, and the
+constraint falls back to group membership when the attribute is absent from
+the device template.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+from karpenter_tpu.scheduling.dra.types import (
+    AttrValue,
+    Device,
+    DeviceID,
+    RequestName,
+    attr_values_equal,
+)
+
+# Bare device identity (driver, pool, device) without the template flag —
+# bindings are declared by the provider on template device names.
+_BareID = tuple[str, str, str]
+
+
+def _bare(device_id: DeviceID) -> _BareID:
+    return (device_id.driver, device_id.pool, device_id.device)
+
+
+@dataclass
+class AttributeBindingDecl:
+    """A provider-declared binding: these devices on this instance type will
+    share a value for ``attribute`` at runtime."""
+
+    attribute: str
+    devices: list[_BareID]
+
+
+class AttributeBindings:
+    """Transitive-closure binding graph keyed by
+    (attribute, nodepool, instance type) (attributebindings.go:41-167)."""
+
+    def __init__(self) -> None:
+        # attribute -> nodepool -> it -> device -> set of bound devices
+        self._graph: dict[str, dict[str, dict[str, dict[_BareID, set[_BareID]]]]] = {}
+
+    @staticmethod
+    def build(decls_by_pool_it: dict[tuple[str, str], list[AttributeBindingDecl]]) -> "AttributeBindings":
+        """decls_by_pool_it maps (nodepool, instance type name) to the
+        provider's binding declarations for that instance type."""
+        ab = AttributeBindings()
+        for (nodepool, it_name), decls in decls_by_pool_it.items():
+            for decl in decls:
+                if len(decl.devices) < 2:
+                    continue
+                per_it = (
+                    ab._graph.setdefault(decl.attribute, {})
+                    .setdefault(nodepool, {})
+                    .setdefault(it_name, {})
+                )
+                for i, dev in enumerate(decl.devices):
+                    group = per_it.setdefault(dev, set())
+                    for j, other in enumerate(decl.devices):
+                        if i != j:
+                            group.add(other)
+        # Transitive closure per triple via BFS from each device
+        # (attributebindings.go:137-166).
+        for per_attr in ab._graph.values():
+            for per_pool in per_attr.values():
+                for per_it in per_pool.values():
+                    closures: dict[_BareID, set[_BareID]] = {}
+                    for device in per_it:
+                        visited: set[_BareID] = set()
+                        queue = deque([device])
+                        while queue:
+                            curr = queue.popleft()
+                            if curr in visited:
+                                continue
+                            visited.add(curr)
+                            queue.extend(n for n in per_it.get(curr, ()) if n not in visited)
+                        visited.discard(device)
+                        closures[device] = visited
+                    per_it.update(closures)
+        return ab
+
+    def _lookup(self, nodepool: str, it_name: str, attribute: str) -> Optional[dict[_BareID, set[_BareID]]]:
+        return self._graph.get(attribute, {}).get(nodepool, {}).get(it_name)
+
+    def has_bindings(self, nodepool: str, it_name: str, attribute: str, device_id: DeviceID) -> bool:
+        per_it = self._lookup(nodepool, it_name, attribute)
+        return per_it is not None and _bare(device_id) in per_it
+
+    def bound(self, nodepool: str, it_name: str, attribute: str, a: DeviceID, b: DeviceID) -> bool:
+        per_it = self._lookup(nodepool, it_name, attribute)
+        if per_it is None:
+            return False
+        group = per_it.get(_bare(a))
+        if group is None:
+            return False
+        if _bare(a) == _bare(b):
+            return len(group) > 0
+        return _bare(b) in group
+
+
+@dataclass
+class BindingFallback:
+    """Context for binding lookups during one IT's DFS
+    (constraint.go:71-75)."""
+
+    bindings: AttributeBindings
+    nodepool: str
+    instance_type: str
+
+
+def lookup_attribute(device: Device, device_id: DeviceID, name: str) -> Optional[AttrValue]:
+    """Qualified lookup with driver-domain fallback (constraint.go:168-180)."""
+    if name in device.attributes:
+        return device.attributes[name]
+    domain, sep, ident = name.partition("/")
+    if sep and domain == device_id.driver and ident in device.attributes:
+        return device.attributes[ident]
+    return None
+
+
+@dataclass
+class MatchAttributeConstraint:
+    """All devices for the constrained requests share one attribute value
+    (constraint.go:46-163). Concrete-value and binding-fallback paths are
+    mutually exclusive once established."""
+
+    attribute: str
+    request_names: frozenset[str] = frozenset()
+    binding_fallback: Optional[BindingFallback] = None
+
+    pinned_value: Optional[AttrValue] = None
+    used_binding: bool = False
+    allocated_ids: list[DeviceID] = field(default_factory=list)
+
+    def _applies(self, request_name: RequestName) -> bool:
+        if not self.request_names:
+            return True
+        if request_name.parent in self.request_names:
+            return True
+        if request_name.sub:
+            return str(request_name) in self.request_names
+        return False
+
+    def add(self, request_name: RequestName, device: Device, device_id: DeviceID) -> bool:
+        if not self._applies(request_name):
+            return True
+        value = lookup_attribute(device, device_id, self.attribute)
+        if value is not None:
+            if self.used_binding:
+                return False
+            if not self.allocated_ids:
+                self.pinned_value = value
+                self.allocated_ids.append(device_id)
+                return True
+            if self.pinned_value is None or not attr_values_equal(self.pinned_value, value):
+                return False
+            self.allocated_ids.append(device_id)
+            return True
+        # Attribute absent — binding fallback path.
+        if self.allocated_ids and not self.used_binding:
+            return False
+        fb = self.binding_fallback
+        if fb is None:
+            return False
+        if not fb.bindings.has_bindings(fb.nodepool, fb.instance_type, self.attribute, device_id):
+            return False
+        if not self.allocated_ids:
+            self.used_binding = True
+            self.allocated_ids.append(device_id)
+            return True
+        # Bindings are transitive, so one representative check suffices.
+        if not fb.bindings.bound(fb.nodepool, fb.instance_type, self.attribute, self.allocated_ids[0], device_id):
+            return False
+        self.allocated_ids.append(device_id)
+        return True
+
+    def remove(self, request_name: RequestName, device: Device, device_id: DeviceID) -> None:
+        if not self._applies(request_name):
+            return
+        if self.allocated_ids:
+            self.allocated_ids.pop()
+        if not self.allocated_ids:
+            self.pinned_value = None
+            self.used_binding = False
+
+    def reset(self) -> None:
+        self.pinned_value = None
+        self.used_binding = False
+        self.allocated_ids.clear()

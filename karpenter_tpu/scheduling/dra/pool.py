@@ -1,0 +1,256 @@
+"""Device pools: gathering, generation supersession, and filtering.
+
+Counterpart of reference pkg/scheduling/dynamicresources/pool.go. Pools
+group in-cluster ResourceSlices by (driver, pool name). Completeness is a
+global pool property (all slices at the newest generation counted,
+pool.go:278-292), while device visibility is scoped to the NodeClaim being
+evaluated: only slices whose node affinity matches contribute allocatable
+devices. Devices that consume shared counters on *non-matching* slices are
+kept as NonTargetingDevices so their counter draw stays visible
+(pool.go:56-61,144-149).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.scheduling.dra.types import (
+    CounterSet,
+    Device,
+    DeviceID,
+    PoolKey,
+    ResourceSlice,
+    or_node_selector_terms,
+)
+from karpenter_tpu.scheduling.requirements import Requirement, Requirements
+
+
+@dataclass
+class DeviceWithID:
+    """A device plus identity and the topology requirements inherited from
+    its slice's node selector (None for all-nodes and template devices) —
+    pool.go:40-44."""
+
+    device: Device
+    id: DeviceID
+    topology_requirements: Optional[Requirements] = None
+
+
+@dataclass
+class Pool:
+    key: PoolKey
+    slices: list[ResourceSlice] = field(default_factory=list)
+    devices: list[DeviceWithID] = field(default_factory=list)
+    non_targeting_devices: list[DeviceWithID] = field(default_factory=list)
+    counter_sets: dict[str, dict[str, float]] = field(default_factory=dict)
+    incomplete: bool = False
+    invalid: bool = False
+
+
+def slice_topology_requirements(s: ResourceSlice) -> Optional[Requirements]:
+    """Requirements implied by a slice's node accessibility: None when the
+    slice is all-nodes. Node-name-pinned slices contribute a hostname
+    requirement (stricter than the reference, whose sliceTopologyRequirements
+    returns nil for them — pool.go:199-215 — letting a claim satisfied from
+    a node-local device be reused from another node); ORed selector terms
+    fold via the sound union (see dra.types.or_node_selector_terms)."""
+    if s.all_nodes:
+        return None
+    if s.node_name:
+        return Requirements(Requirement.new(l.LABEL_HOSTNAME, "In", s.node_name))
+    if s.node_selector_terms is None:
+        return None
+    return or_node_selector_terms(s.node_selector_terms)
+
+
+def _slice_matches(s: ResourceSlice, requirements: Requirements, node_name: str) -> bool:
+    """Accessibility of a slice to the evaluated NodeClaim
+    (pool.go:180-197)."""
+    if s.potential:
+        raise AssertionError("potential slices must not enter pool gathering")
+    if s.all_nodes:
+        return True
+    if s.shared_counters is not None:
+        return True
+    if s.node_name:
+        return bool(node_name) and s.node_name == node_name
+    if s.node_selector_terms is not None:
+        # Terms are ORed; a term matches when compatible with requirements.
+        return any(
+            requirements.is_compatible(term, l.WELL_KNOWN_LABELS) for term in s.node_selector_terms
+        )
+    return False
+
+
+def _device_with_id(key: PoolKey, d: Device, topo: Optional[Requirements]) -> DeviceWithID:
+    return DeviceWithID(
+        device=d,
+        id=DeviceID(driver=key.driver, pool=key.pool, device=d.name),
+        topology_requirements=topo,
+    )
+
+
+class _PoolBuilder:
+    """Accumulates slices for one pool with generation supersession
+    (pool.go:238-269): older generations are discarded, a newer generation
+    replaces everything seen so far."""
+
+    def __init__(self) -> None:
+        self.entries: list[tuple[ResourceSlice, bool]] = []
+        self.generation = 0
+        self.resource_slice_count = 1
+
+    def add(self, s: ResourceSlice, matched: bool) -> None:
+        if not self.entries:
+            self.entries.append((s, matched))
+            self.generation = s.generation
+            self.resource_slice_count = s.resource_slice_count
+            return
+        if s.generation < self.generation:
+            return
+        if s.generation > self.generation:
+            self.entries = [(s, matched)]
+            self.generation = s.generation
+            self.resource_slice_count = s.resource_slice_count
+            return
+        self.entries.append((s, matched))
+
+    def build(self, key: PoolKey) -> Optional[Pool]:
+        pool = Pool(key=key)
+        if len(self.entries) != self.resource_slice_count:
+            pool.incomplete = True
+
+        counter_set_slices: list[ResourceSlice] = []
+        non_targeting_slices: list[ResourceSlice] = []
+        seen_names: set[str] = set()
+        for s, matched in self.entries:
+            if s.shared_counters is not None:
+                counter_set_slices.append(s)
+                continue
+            if not matched:
+                non_targeting_slices.append(s)
+                for d in s.devices:
+                    if d.consumes_counters:
+                        pool.non_targeting_devices.append(_device_with_id(key, d, None))
+                continue
+            pool.slices.append(s)
+            topo = slice_topology_requirements(s)
+            for d in s.devices:
+                if d.name in seen_names:
+                    pool.invalid = True
+                seen_names.add(d.name)
+                pool.devices.append(_device_with_id(key, d, topo))
+
+        counter_sets, valid = _collect_counter_sets(counter_set_slices)
+        pool.counter_sets = counter_sets
+        pool.invalid = pool.invalid or not valid
+        pool.invalid = pool.invalid or not _counter_consumption_valid(counter_sets, pool.slices)
+        pool.invalid = pool.invalid or not _counter_consumption_valid(counter_sets, non_targeting_slices)
+
+        if pool.invalid:
+            # Invalid pools contribute no allocatable devices, but their
+            # counter-consuming devices remain visible (pool.go:323-332).
+            for dw in pool.devices:
+                if dw.device.consumes_counters:
+                    pool.non_targeting_devices.append(dw)
+            pool.devices = []
+            pool.slices = []
+            return pool
+        if not pool.slices and not pool.devices and not pool.non_targeting_devices:
+            return None
+        return pool
+
+
+def _collect_counter_sets(
+    slices: list[ResourceSlice],
+) -> tuple[dict[str, dict[str, float]], bool]:
+    """Aggregate SharedCounters; duplicate counter-set names invalidate the
+    pool (pool.go:341-353)."""
+    counter_sets: dict[str, dict[str, float]] = {}
+    valid = True
+    for s in slices:
+        for cs in s.shared_counters or []:
+            if cs.name in counter_sets:
+                valid = False
+            counter_sets[cs.name] = dict(cs.counters)
+    return counter_sets, valid
+
+
+def _counter_consumption_valid(
+    counter_sets: dict[str, dict[str, float]],
+    slices,
+) -> bool:
+    """Every consumed counter must exist in a declared counter set
+    (pool.go:357-376). Accepts ResourceSlices or Pool slices."""
+    for s in slices:
+        devices = s.devices if isinstance(s, ResourceSlice) else [dw.device for dw in s]
+        for d in devices:
+            for cc in d.consumes_counters:
+                cs = counter_sets.get(cc.counter_set)
+                if cs is None:
+                    return False
+                for counter_name in cc.counters:
+                    if counter_name not in cs:
+                        return False
+    return True
+
+
+def gather_pools(
+    in_cluster_slices: list[ResourceSlice],
+    requirements: Requirements,
+    node_name: str = "",
+) -> list[Pool]:
+    """Build the in-cluster pool set for a NodeClaim (pool.go:87-112)."""
+    builders: dict[PoolKey, _PoolBuilder] = {}
+    for s in in_cluster_slices:
+        matched = _slice_matches(s, requirements, node_name)
+        key = PoolKey(driver=s.driver, pool=s.pool)
+        builders.setdefault(key, _PoolBuilder()).add(s, matched)
+    pools = []
+    for key, b in builders.items():
+        p = b.build(key)
+        if p is not None:
+            pools.append(p)
+    return pools
+
+
+def filter_pools(
+    pools: list[Pool],
+    requirements: Requirements,
+    node_name: str = "",
+) -> list[Pool]:
+    """Narrow cached pools against tightened requirements without
+    regathering (pool.go:119-166)."""
+    filtered = []
+    for pool in pools:
+        p = _filter_pool(pool, requirements, node_name)
+        if p is not None:
+            filtered.append(p)
+    return filtered
+
+
+def _filter_pool(pool: Pool, requirements: Requirements, node_name: str) -> Optional[Pool]:
+    p = Pool(
+        key=pool.key,
+        incomplete=pool.incomplete,
+        invalid=pool.invalid,
+        counter_sets=pool.counter_sets,
+        non_targeting_devices=list(pool.non_targeting_devices),
+    )
+    for s in pool.slices:
+        if not _slice_matches(s, requirements, node_name):
+            for d in s.devices:
+                if d.consumes_counters:
+                    p.non_targeting_devices.append(_device_with_id(pool.key, d, None))
+            continue
+        p.slices.append(s)
+        topo = slice_topology_requirements(s)
+        for d in s.devices:
+            p.devices.append(_device_with_id(pool.key, d, topo))
+    if p.invalid:
+        return p
+    if not p.slices and not p.devices and not p.non_targeting_devices:
+        return None
+    return p

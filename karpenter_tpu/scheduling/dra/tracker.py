@@ -1,0 +1,363 @@
+"""Cross-pod allocation tracking for one scheduling loop.
+
+Counterpart of reference pkg/scheduling/dynamicresources/allocationtracker.go
+plus the tracker halves of consumable_capacity.go and
+partitionable_devices.go. The tracker is the shared, committed state the
+per-pod DFS reads: which devices earlier pods (or the API server) already
+hold, how much consumable capacity and shared-counter budget is spoken for.
+
+Karpenter's NodeClaim superposition makes allocation non-binary: an
+in-flight NodeClaim is simultaneously "every surviving instance type", and
+a device may be allocated under several of those candidate ITs at once.
+Committed consumption is therefore tracked per (NodeClaim, IT) and rolled
+up with a pessimistic max across ITs; pruning ITs releases exactly the
+delta the max loses (partitionable_devices.go:29-79,
+consumable_capacity.go:102-238).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from karpenter_tpu.scheduling.dra.pool import Pool
+from karpenter_tpu.scheduling.dra.types import DeviceID, PoolKey
+
+# Nested alias soup, kept close to the reference's shapes:
+# counters:  pool -> counterSet -> counter -> float
+Counters = dict[PoolKey, dict[str, dict[str, float]]]
+# capacity:  device -> dimension -> float
+Capacity = dict[DeviceID, dict[str, float]]
+
+
+@dataclass
+class AllocatedDeviceState:
+    """Seed state from the cluster: devices exclusively held by committed
+    claims, and aggregated consumed capacity of multi-allocatable devices
+    (allocator.go:145-150)."""
+
+    exclusive_devices: set[DeviceID] = field(default_factory=set)
+    consumed_capacity: Capacity = field(default_factory=dict)
+
+
+@dataclass
+class InflightAllocationMetadata:
+    """Which NodeClaim holds an in-cluster device, and under which candidate
+    instance types (allocationtracker.go:114-123)."""
+
+    nodeclaim_id: str
+    instance_types: set[str] = field(default_factory=set)
+
+
+def _merge_counters(dst: Counters, src: Counters) -> None:
+    for pool_key, counter_sets in src.items():
+        dst_sets = dst.setdefault(pool_key, {})
+        for cs_name, counters in counter_sets.items():
+            dst_counters = dst_sets.setdefault(cs_name, {})
+            for name, value in counters.items():
+                dst_counters[name] = dst_counters.get(name, 0.0) + value
+
+
+def _counter_max(by_it: dict[str, Counters]) -> Counters:
+    """Pessimistic per-counter max across instance types
+    (partitionable_devices.go pessimisticCounterMax)."""
+    out: Counters = {}
+    for counters in by_it.values():
+        for pool_key, counter_sets in counters.items():
+            out_sets = out.setdefault(pool_key, {})
+            for cs_name, cmap in counter_sets.items():
+                out_counters = out_sets.setdefault(cs_name, {})
+                for name, value in cmap.items():
+                    if value > out_counters.get(name, 0.0):
+                        out_counters[name] = value
+    return out
+
+
+def _capacity_max(by_it: dict[str, Capacity]) -> Capacity:
+    """Pessimistic per-device per-dimension max across instance types
+    (consumable_capacity.go:265-285)."""
+    out: Capacity = {}
+    for devices in by_it.values():
+        for device_id, dims in devices.items():
+            out_dims = out.setdefault(device_id, {})
+            for name, qty in dims.items():
+                if qty > out_dims.get(name, 0.0):
+                    out_dims[name] = qty
+    return out
+
+
+class AllocationTracker:
+    """Committed allocation state shared across all pods in one loop."""
+
+    def __init__(self, allocated_state: Optional[AllocatedDeviceState] = None):
+        state = allocated_state or AllocatedDeviceState()
+        self.preallocated_devices: set[DeviceID] = {
+            DeviceID(d.driver, d.pool, d.device) for d in state.exclusive_devices
+        }
+        self.preallocated_consumed_capacity: Capacity = {
+            DeviceID(d.driver, d.pool, d.device): dict(v)
+            for d, v in state.consumed_capacity.items()
+        }
+        self.inflight_cluster_allocations: dict[DeviceID, InflightAllocationMetadata] = {}
+        # nodeclaim -> it -> device ids (acceleration index, and template twin)
+        self.inflight_by_nodeclaim: dict[str, dict[str, set[DeviceID]]] = {}
+        self.inflight_template_allocations: dict[str, dict[str, set[DeviceID]]] = {}
+        # Rolled-up (pessimistic-max) consumption visible to every DFS.
+        self.inflight_consumed_capacity: Capacity = {}
+        self.remaining_counters: Counters = {}
+        # Precise per-(nodeclaim, it) records enabling exact release.
+        self._capacity_by_nodeclaim_it: dict[str, dict[str, Capacity]] = {}
+        self._counters_by_nodeclaim_it: dict[str, dict[str, Counters]] = {}
+        # Template (per-IT-local) state; no pessimistic max needed.
+        self._template_capacity: dict[str, dict[str, Capacity]] = {}
+        self._template_remaining_counters: dict[str, dict[str, Counters]] = {}
+
+    # -- counter budgets ---------------------------------------------------
+
+    def init_remaining_counters(self, pool: Pool) -> None:
+        """Seed a pool's budget: totals minus the draw of devices already
+        allocated in-cluster (including non-targeting ones)
+        (allocator.go:174-179 + partitionable seeding)."""
+        if not pool.counter_sets or pool.key in self.remaining_counters:
+            return
+        remaining = {cs: dict(counters) for cs, counters in pool.counter_sets.items()}
+        self.remaining_counters[pool.key] = remaining
+        for dw in list(pool.devices) + list(pool.non_targeting_devices):
+            if dw.id in self.preallocated_devices or dw.id in self.preallocated_consumed_capacity:
+                for cc in dw.device.consumes_counters:
+                    cs = remaining.get(cc.counter_set)
+                    if cs is None:
+                        continue
+                    for name, value in cc.counters.items():
+                        cs[name] = cs.get(name, 0.0) - value
+
+    def template_remaining_for_it(self, nodeclaim_id: str, it_name: str) -> Optional[Counters]:
+        return self._template_remaining_counters.get(nodeclaim_id, {}).get(it_name)
+
+    def init_template_remaining_counters(self, nodeclaim_id: str, it_name: str, totals: Counters) -> None:
+        per_nc = self._template_remaining_counters.setdefault(nodeclaim_id, {})
+        if it_name not in per_nc:
+            per_nc[it_name] = totals
+
+    def template_consumed_capacity_for_it(self, nodeclaim_id: str, it_name: str) -> Optional[Capacity]:
+        return self._template_capacity.get(nodeclaim_id, {}).get(it_name)
+
+    # -- allocation status -------------------------------------------------
+
+    def is_allocated(self, device_id: DeviceID, nodeclaim_id: str, it_name: str) -> bool:
+        """Allocation is relative to the asking (NodeClaim, IT)
+        (allocationtracker.go:231-268): a device held by the same NodeClaim
+        under *other* ITs is still free for this IT, because the NodeClaim
+        collapses to one IT at launch."""
+        if device_id.template:
+            return device_id in self.inflight_template_allocations.get(nodeclaim_id, {}).get(it_name, set())
+        if device_id in self.preallocated_devices:
+            return True
+        meta = self.inflight_cluster_allocations.get(device_id)
+        if meta is not None:
+            if meta.nodeclaim_id != nodeclaim_id:
+                return True
+            return it_name in meta.instance_types
+        return False
+
+    # -- commit ------------------------------------------------------------
+
+    def commit(
+        self,
+        nodeclaim_id: str,
+        device_ids_by_it: dict[str, list[DeviceID]],
+        counter_consumption_by_it: dict[str, Counters],
+        template_counter_consumption_by_it: dict[str, Counters],
+        capacity_consumption_by_it: dict[str, Capacity],
+        template_capacity_consumption_by_it: dict[str, Capacity],
+        template_counter_totals_by_it: dict[str, Counters],
+    ) -> None:
+        """Apply one pod's successful allocation (allocationtracker.go:126-174)."""
+        for it_name, device_ids in device_ids_by_it.items():
+            for device_id in device_ids:
+                if device_id.template:
+                    # Multi-alloc template devices are tracked via capacity.
+                    if device_id in template_capacity_consumption_by_it.get(it_name, {}):
+                        continue
+                    self.inflight_template_allocations.setdefault(nodeclaim_id, {}).setdefault(
+                        it_name, set()
+                    ).add(device_id)
+                    continue
+                if device_id in capacity_consumption_by_it.get(it_name, {}):
+                    continue
+                self.inflight_by_nodeclaim.setdefault(nodeclaim_id, {}).setdefault(it_name, set()).add(
+                    device_id
+                )
+                meta = self.inflight_cluster_allocations.get(device_id)
+                if meta is not None:
+                    if meta.nodeclaim_id != nodeclaim_id:
+                        raise AssertionError("device already allocated for a different nodeclaim")
+                    if it_name in meta.instance_types:
+                        raise AssertionError("device already allocated for instance type")
+                    meta.instance_types.add(it_name)
+                else:
+                    self.inflight_cluster_allocations[device_id] = InflightAllocationMetadata(
+                        nodeclaim_id=nodeclaim_id, instance_types={it_name}
+                    )
+        self._commit_counters(nodeclaim_id, counter_consumption_by_it)
+        for it_name, totals in template_counter_totals_by_it.items():
+            self.init_template_remaining_counters(nodeclaim_id, it_name, totals)
+        self._commit_template_counters(nodeclaim_id, template_counter_consumption_by_it)
+        self._commit_capacity(nodeclaim_id, capacity_consumption_by_it)
+        self._commit_template_capacity(nodeclaim_id, template_capacity_consumption_by_it)
+
+    def _commit_counters(self, nodeclaim_id: str, by_it: dict[str, Counters]) -> None:
+        if not by_it:
+            return
+        stored = self._counters_by_nodeclaim_it.setdefault(nodeclaim_id, {})
+        old_max = _counter_max(stored) if stored else {}
+        for it_name, counters in by_it.items():
+            if it_name not in stored:
+                stored[it_name] = counters
+            else:
+                _merge_counters(stored[it_name], counters)
+        new_max = _counter_max(stored)
+        self._apply_counter_delta(old_max, new_max)
+
+    def _apply_counter_delta(self, old_max: Counters, new_max: Counters) -> None:
+        """Deduct (new - old) pessimistic max from remaining budgets
+        (partitionable_devices.go subtractDeltaFromRemaining)."""
+        for pool_key, counter_sets in new_max.items():
+            pool_remaining = self.remaining_counters.get(pool_key)
+            if pool_remaining is None:
+                continue
+            for cs_name, counters in counter_sets.items():
+                cs_remaining = pool_remaining.get(cs_name)
+                if cs_remaining is None:
+                    continue
+                for name, new_value in counters.items():
+                    old_value = old_max.get(pool_key, {}).get(cs_name, {}).get(name, 0.0)
+                    delta = new_value - old_value
+                    if delta > 0:
+                        cs_remaining[name] = cs_remaining.get(name, 0.0) - delta
+
+    def _commit_template_counters(self, nodeclaim_id: str, by_it: dict[str, Counters]) -> None:
+        if not by_it:
+            return
+        per_nc = self._template_remaining_counters.get(nodeclaim_id)
+        if per_nc is None:
+            return
+        for it_name, counters in by_it.items():
+            remaining = per_nc.get(it_name)
+            if remaining is None:
+                continue
+            for pool_key, counter_sets in counters.items():
+                rem_sets = remaining.get(pool_key, {})
+                for cs_name, cmap in counter_sets.items():
+                    rem_counters = rem_sets.get(cs_name, {})
+                    for name, value in cmap.items():
+                        rem_counters[name] = rem_counters.get(name, 0.0) - value
+
+    def _commit_capacity(self, nodeclaim_id: str, by_it: dict[str, Capacity]) -> None:
+        if not by_it:
+            return
+        stored = self._capacity_by_nodeclaim_it.setdefault(nodeclaim_id, {})
+        old_max = _capacity_max(stored) if stored else {}
+        for it_name, devices in by_it.items():
+            stored_devices = stored.setdefault(it_name, {})
+            for device_id, dims in devices.items():
+                stored_dims = stored_devices.setdefault(device_id, {})
+                for name, qty in dims.items():
+                    stored_dims[name] = stored_dims.get(name, 0.0) + qty
+        new_max = _capacity_max(stored)
+        for device_id, dims in new_max.items():
+            for name, new_qty in dims.items():
+                delta = new_qty - old_max.get(device_id, {}).get(name, 0.0)
+                if delta > 0:
+                    inflight = self.inflight_consumed_capacity.setdefault(device_id, {})
+                    inflight[name] = inflight.get(name, 0.0) + delta
+
+    def _commit_template_capacity(self, nodeclaim_id: str, by_it: dict[str, Capacity]) -> None:
+        if not by_it:
+            return
+        stored = self._template_capacity.setdefault(nodeclaim_id, {})
+        for it_name, devices in by_it.items():
+            stored_devices = stored.setdefault(it_name, {})
+            for device_id, dims in devices.items():
+                stored_dims = stored_devices.setdefault(device_id, {})
+                for name, qty in dims.items():
+                    stored_dims[name] = stored_dims.get(name, 0.0) + qty
+
+    # -- release -----------------------------------------------------------
+
+    def release_instance_types(self, nodeclaim_id: str, *it_names: str) -> None:
+        """Free everything a NodeClaim held under pruned instance types
+        (allocationtracker.go:198-229)."""
+        for it_name in it_names:
+            devices = self.inflight_by_nodeclaim.get(nodeclaim_id, {}).pop(it_name, set())
+            for device_id in devices:
+                meta = self.inflight_cluster_allocations.get(device_id)
+                if meta is None or it_name not in meta.instance_types:
+                    raise AssertionError("inflight allocation metadata missing instance type reference")
+                meta.instance_types.discard(it_name)
+                if not meta.instance_types:
+                    del self.inflight_cluster_allocations[device_id]
+            self.inflight_template_allocations.get(nodeclaim_id, {}).pop(it_name, None)
+        self._release_counters(nodeclaim_id, it_names)
+        self._release_template(self._template_remaining_counters, nodeclaim_id, it_names)
+        self._release_capacity(nodeclaim_id, it_names)
+        self._release_template(self._template_capacity, nodeclaim_id, it_names)
+
+    def _release_counters(self, nodeclaim_id: str, it_names) -> None:
+        stored = self._counters_by_nodeclaim_it.get(nodeclaim_id)
+        if stored is None:
+            return
+        old_max = _counter_max(stored)
+        for it_name in it_names:
+            stored.pop(it_name, None)
+        new_max = _counter_max(stored)
+        # Return (old - new) to the remaining budgets.
+        for pool_key, counter_sets in old_max.items():
+            pool_remaining = self.remaining_counters.get(pool_key)
+            if pool_remaining is None:
+                continue
+            for cs_name, counters in counter_sets.items():
+                cs_remaining = pool_remaining.get(cs_name)
+                if cs_remaining is None:
+                    continue
+                for name, old_value in counters.items():
+                    delta = old_value - new_max.get(pool_key, {}).get(cs_name, {}).get(name, 0.0)
+                    if delta > 0:
+                        cs_remaining[name] = cs_remaining.get(name, 0.0) + delta
+        if not stored:
+            del self._counters_by_nodeclaim_it[nodeclaim_id]
+
+    def _release_capacity(self, nodeclaim_id: str, it_names) -> None:
+        stored = self._capacity_by_nodeclaim_it.get(nodeclaim_id)
+        if stored is None:
+            return
+        old_max = _capacity_max(stored)
+        for it_name in it_names:
+            stored.pop(it_name, None)
+        new_max = _capacity_max(stored)
+        for device_id, dims in old_max.items():
+            for name, old_qty in dims.items():
+                delta = old_qty - new_max.get(device_id, {}).get(name, 0.0)
+                if delta > 0:
+                    inflight = self.inflight_consumed_capacity.get(device_id)
+                    if inflight is None:
+                        continue
+                    remaining = inflight.get(name, 0.0) - delta
+                    if remaining <= 1e-12:
+                        inflight.pop(name, None)
+                    else:
+                        inflight[name] = remaining
+                    if not inflight:
+                        self.inflight_consumed_capacity.pop(device_id, None)
+        if not stored:
+            del self._capacity_by_nodeclaim_it[nodeclaim_id]
+
+    @staticmethod
+    def _release_template(store: dict[str, dict[str, object]], nodeclaim_id: str, it_names) -> None:
+        per_nc = store.get(nodeclaim_id)
+        if per_nc is None:
+            return
+        for it_name in it_names:
+            per_nc.pop(it_name, None)
+        if not per_nc:
+            store.pop(nodeclaim_id, None)

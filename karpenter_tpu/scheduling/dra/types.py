@@ -1,0 +1,273 @@
+"""DRA object model.
+
+Counterpart of reference pkg/scheduling/dynamicresources/types.go and the
+resource.k8s.io/v1 API surface the allocator consumes: ResourceSlices
+(in-cluster and cloud-provider templates), Devices with typed attributes,
+consumable capacity with request policies, shared counter sets
+(partitionable devices), DeviceClasses, and ResourceClaims with Exactly /
+FirstAvailable device requests and MatchAttribute constraints.
+
+Quantities are floats throughout (the repo-wide convention from
+utils/resources.parse_quantity); attribute values keep their Python type so
+typed equality mirrors DeviceAttribute semantics (constraint.go:183-201):
+an int attribute never matches a string attribute, and bools are compared
+only against bools.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import NamedTuple, Optional, Sequence, Union
+
+from karpenter_tpu.scheduling.requirements import Requirements
+from karpenter_tpu.utils.resources import parse_quantity
+
+# resource.k8s.io/v1 AllocationResultsMaxSize — the hard cap on devices per
+# claim, enforced up-front per claim and re-checked per-IT in the DFS
+# (request.go:201-255, allocator.go:753-756).
+ALLOCATION_RESULTS_MAX_SIZE = 32
+
+# Attribute values are typed: str | int | bool | Version. Versions are
+# modeled as strings tagged by wrapping in a 1-tuple is avoided — instead a
+# dedicated class keeps typed-equality honest.
+AttrValue = Union[str, int, bool, "Version"]
+
+
+@dataclass(frozen=True)
+class Version:
+    """A semver-ish attribute value; equality is string equality."""
+
+    value: str
+
+
+def attr_values_equal(a: AttrValue, b: AttrValue) -> bool:
+    """Typed equality: bool-vs-int and int-vs-str never match
+    (constraint.go:183-201)."""
+    if isinstance(a, bool) != isinstance(b, bool):
+        return False
+    if isinstance(a, Version) != isinstance(b, Version):
+        return False
+    if type(a) in (int, str) and type(b) in (int, str) and type(a) is not type(b):
+        return False
+    return a == b
+
+
+class DeviceID(NamedTuple):
+    """Globally unique device identity (types.go:49-56). ``template`` marks
+    potential (cloud-provider template) devices, which are tracked per
+    (NodeClaim, InstanceType) rather than globally."""
+
+    driver: str
+    pool: str
+    device: str
+    template: bool = False
+
+    def __str__(self) -> str:
+        prefix = "virtual/" if self.template else ""
+        return f"{prefix}{self.driver}/{self.pool}/{self.device}"
+
+
+class PoolKey(NamedTuple):
+    driver: str
+    pool: str
+
+
+class RequestName(NamedTuple):
+    """Identifies a device request within a claim; ``sub`` is set for
+    FirstAvailable sub-requests (types.go:60-70)."""
+
+    parent: str
+    sub: str = ""
+
+    def __str__(self) -> str:
+        return f"{self.parent}/{self.sub}" if self.sub else self.parent
+
+
+@dataclass
+class RequestPolicy:
+    """Consumable-capacity request policy (consumable_capacity.go:358-420)."""
+
+    default: Optional[float] = None
+    valid_range_min: Optional[float] = None
+    valid_range_max: Optional[float] = None
+    valid_range_step: Optional[float] = None
+    valid_values: Optional[list[float]] = None  # sorted ascending
+
+
+@dataclass
+class DeviceCapacity:
+    value: float
+    request_policy: Optional[RequestPolicy] = None
+
+
+@dataclass
+class CounterConsumption:
+    """A device's draw against a pool-level shared counter set."""
+
+    counter_set: str
+    counters: dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class CounterSet:
+    name: str
+    counters: dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class Device:
+    """One allocatable device within a slice."""
+
+    name: str
+    attributes: dict[str, AttrValue] = field(default_factory=dict)
+    capacity: dict[str, DeviceCapacity] = field(default_factory=dict)
+    allow_multiple_allocations: bool = False
+    consumes_counters: list[CounterConsumption] = field(default_factory=list)
+
+
+@dataclass
+class ResourceSlice:
+    """A group of devices published by a driver, either in-cluster (API
+    server) or as a cloud-provider template for an instance type
+    (types.go:98-260 collapses both behind one interface; here one concrete
+    class with a ``potential`` flag serves both roles).
+
+    Node accessibility is exactly one of: ``all_nodes``, ``node_name``
+    (pinned to one concrete node), or ``node_selector_terms`` (ORed
+    Requirements terms). Template slices are always node-local to the
+    NodeClaim they are attached to and carry no selector.
+    """
+
+    driver: str
+    pool: str
+    devices: list[Device] = field(default_factory=list)
+    generation: int = 0
+    resource_slice_count: int = 1
+    node_name: str = ""
+    node_selector_terms: Optional[list[Requirements]] = None
+    all_nodes: bool = False
+    shared_counters: Optional[list[CounterSet]] = None
+    potential: bool = False
+
+
+@dataclass
+class DeviceClass:
+    """resource.k8s.io DeviceClass: a named bundle of selectors every
+    request referencing it inherits (request.go:313-339)."""
+
+    name: str
+    selectors: list[str] = field(default_factory=list)
+
+
+@dataclass
+class DeviceSubRequest:
+    """One alternative inside a FirstAvailable request."""
+
+    name: str
+    device_class: str = ""
+    selectors: list[str] = field(default_factory=list)
+    allocation_mode: str = "ExactCount"  # or "All"
+    count: int = 1
+    capacity_requests: Optional[dict[str, float]] = None
+
+
+@dataclass
+class DeviceRequest:
+    """A top-level device request: either Exactly (fields inline) or
+    FirstAvailable (ordered ``first_available`` alternatives)."""
+
+    name: str
+    device_class: str = ""
+    selectors: list[str] = field(default_factory=list)
+    allocation_mode: str = "ExactCount"
+    count: int = 1
+    capacity_requests: Optional[dict[str, float]] = None
+    first_available: list[DeviceSubRequest] = field(default_factory=list)
+
+
+@dataclass
+class MatchConstraintSpec:
+    """MatchAttribute constraint spec: all devices for the named requests
+    (all requests when empty) must share one value for ``attribute``."""
+
+    attribute: str
+    requests: list[str] = field(default_factory=list)
+    distinct_attribute: Optional[str] = None  # unsupported, like the reference
+
+
+@dataclass
+class AllocatedDevice:
+    """One committed device in a claim's status allocation."""
+
+    request: str
+    driver: str
+    pool: str
+    device: str
+    consumed_capacity: Optional[dict[str, float]] = None
+
+
+@dataclass
+class DeviceClaimStatus:
+    """Claim status once allocated: the chosen devices plus the node
+    selector terms that scope where the claim is usable."""
+
+    devices: list[AllocatedDevice] = field(default_factory=list)
+    node_selector_terms: Optional[list[Requirements]] = None
+
+
+@dataclass
+class ResourceClaim:
+    """resource.k8s.io ResourceClaim. ``allocation`` is set once committed
+    (in-cluster); ``reserved_for`` lists consuming pod UIDs."""
+
+    name: str
+    namespace: str = "default"
+    requests: list[DeviceRequest] = field(default_factory=list)
+    constraints: list[MatchConstraintSpec] = field(default_factory=list)
+    allocation: Optional[DeviceClaimStatus] = None
+    reserved_for: list[str] = field(default_factory=list)  # pod UIDs
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+def quantities(d: "dict[str, str | int | float] | None") -> dict[str, float]:
+    """Parse a resource-list-style mapping of quantity strings to floats."""
+    if not d:
+        return {}
+    return {k: parse_quantity(v) for k, v in d.items()}
+
+
+def make_capacity(d: "dict[str, str | int | float] | None") -> dict[str, DeviceCapacity]:
+    return {k: DeviceCapacity(value=parse_quantity(v)) for k, v in (d or {}).items()}
+
+
+def or_node_selector_terms(terms: Sequence[Requirements]) -> Requirements:
+    """Fold ORed node-selector terms into one Requirements set as a sound
+    over-approximation: keys constrained by EVERY term keep the union of
+    their constraints; keys any term leaves free are unconstrained. This
+    deliberately diverges from the reference (types.go:262-274 adds all
+    terms into one set, intersecting per key — which turns
+    [zone In a] OR [zone In b] into an empty set): a node matching any term
+    always satisfies the folded result."""
+    if not terms:
+        return Requirements()
+    out = Requirements()
+    common = set(terms[0].keys())
+    for term in terms[1:]:
+        common &= term.keys()
+    for key in common:
+        req = terms[0].get(key)
+        for term in terms[1:]:
+            req = req.union(term.get(key))
+        out.add(req)
+    return out
+
+
+def node_selector_to_requirements(terms: Optional[Sequence[Requirements]]) -> Optional[Requirements]:
+    """Requirements form of a claim allocation's node selector, or None when
+    the allocation carries no topology constraint."""
+    if terms is None:
+        return None
+    return or_node_selector_terms(terms)

@@ -140,6 +140,24 @@ class Requirement:
             key=self.key, complement=complement, values=values, gte=gte, lte=lte, min_values=min_values
         )
 
+    def union(self, other: "Requirement") -> "Requirement":
+        """Sound over-approximation of set union (no Go counterpart — the
+        reference folds ORed node-selector terms by intersection, which can
+        collapse to an empty set; see dra.types.or_node_selector_terms).
+        Every value admitted by either side is admitted by the result."""
+        both_gte = self.gte is not None and other.gte is not None
+        both_lte = self.lte is not None and other.lte is not None
+        if self.complement and other.complement:
+            values = self.values & other.values
+            gte = min(self.gte, other.gte) if both_gte else None
+            lte = max(self.lte, other.lte) if both_lte else None
+            return Requirement(key=self.key, complement=True, values=values, gte=gte, lte=lte)
+        if self.complement:
+            return Requirement(key=self.key, complement=True, values=self.values - other.values)
+        if other.complement:
+            return Requirement(key=self.key, complement=True, values=other.values - self.values)
+        return Requirement(key=self.key, complement=False, values=self.values | other.values)
+
     def has_intersection(self, other: "Requirement") -> bool:
         """Allocation-free fast path (requirement.go:220-254)."""
         gte = _max_opt(self.gte, other.gte)

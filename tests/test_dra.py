@@ -1,0 +1,690 @@
+"""DRA allocator tests.
+
+Behavioral ports of the reference's dynamicresources suite
+(allocator_test.go, pool_test.go, request_test.go, constraint_test.go,
+types_test.go): selector matching, exclusive/multi-alloc availability,
+MatchAttribute constraints with backtracking, FirstAvailable fallback,
+All-mode, shared counters (partitionable devices), consumable capacity,
+slice topology contribution, generation supersession, pessimistic-max
+commit/release across instance types, and attribute bindings.
+"""
+
+import pytest
+
+from karpenter_tpu.scheduling.dra import (
+    AllocatedDeviceState,
+    Allocator,
+    CounterConsumption,
+    CounterSet,
+    Device,
+    DeviceCapacity,
+    DeviceClaimStatus,
+    DeviceClass,
+    DeviceID,
+    DeviceRequest,
+    DeviceSubRequest,
+    DRAError,
+    DRANodeClaim,
+    MatchConstraintSpec,
+    ResourceClaim,
+    ResourceSlice,
+    gather_pools,
+)
+from karpenter_tpu.scheduling.dra.constraints import AttributeBindingDecl, AttributeBindings
+from karpenter_tpu.scheduling.dra.types import RequestPolicy
+from karpenter_tpu.scheduling.requirements import Requirement, Requirements
+
+
+def gpu(name, memory="16Gi", vendor="acme", **attrs):
+    return Device(
+        name=name,
+        attributes={"vendor": vendor, **attrs},
+        capacity={"memory": DeviceCapacity(value=float(str(memory).rstrip("Gi")) * 2**30)},
+    )
+
+
+def slice_of(*devices, driver="gpu.acme.com", pool="pool-a", all_nodes=True, **kw):
+    return ResourceSlice(driver=driver, pool=pool, devices=list(devices), all_nodes=all_nodes, **kw)
+
+
+def claim(name, *requests, constraints=()):
+    return ResourceClaim(name=name, requests=list(requests), constraints=list(constraints))
+
+
+def req(name="r0", count=1, selectors=(), device_class="", mode="ExactCount", capacity=None):
+    return DeviceRequest(
+        name=name,
+        device_class=device_class,
+        selectors=list(selectors),
+        allocation_mode=mode,
+        count=count,
+        capacity_requests=capacity,
+    )
+
+
+def nodeclaim(id="nc-1", its=("it-a",), slices=None, reqs=None, nodepool="np", node_name=""):
+    return DRANodeClaim(
+        id=id,
+        nodepool=nodepool,
+        requirements=reqs or Requirements(),
+        instance_types=list(its),
+        resource_slices=slices or {},
+        node_name=node_name,
+    )
+
+
+class TestSelectorEngine:
+    def test_attribute_match_and_driver_fallback(self):
+        a = Allocator([slice_of(gpu("d0"), gpu("d1", vendor="other"))])
+        r = a.allocate(
+            nodeclaim(),
+            [claim("c", req(selectors=['device.attributes["vendor"] == "acme"']))],
+        )
+        r.commit()
+        meta = a.metadata_for_claim("default/c")
+        assert [d.device_id.device for d in meta.devices["it-a"]] == ["d0"]
+        # Driver-qualified spelling resolves against unqualified attributes.
+        a2 = Allocator([slice_of(gpu("d0"))])
+        r2 = a2.allocate(
+            nodeclaim(),
+            [claim("c", req(selectors=['device.attributes["gpu.acme.com/vendor"] == "acme"']))],
+        )
+        assert r2.instance_types == ["it-a"]
+
+    def test_capacity_and_boolean_operators(self):
+        a = Allocator([slice_of(gpu("small", memory="8Gi"), gpu("big", memory="32Gi"))])
+        r = a.allocate(
+            nodeclaim(),
+            [
+                claim(
+                    "c",
+                    req(
+                        selectors=[
+                            'device.capacity["memory"] >= quantity("16Gi") && !(device.driver == "other")'
+                        ]
+                    ),
+                )
+            ],
+        )
+        r.commit()
+        meta = a.metadata_for_claim("default/c")
+        assert [d.device_id.device for d in meta.devices["it-a"]] == ["big"]
+
+    def test_missing_attribute_is_no_match_not_error(self):
+        a = Allocator([slice_of(gpu("d0"))])
+        with pytest.raises(DRAError, match="no instance type"):
+            a.allocate(
+                nodeclaim(),
+                [claim("c", req(selectors=['device.attributes["nonexistent"] == "x"']))],
+            )
+
+    def test_invalid_selector_is_validation_error(self):
+        a = Allocator([slice_of(gpu("d0"))])
+        with pytest.raises(DRAError, match="selector"):
+            a.allocate(nodeclaim(), [claim("c", req(selectors=["__import__('os')"]))])
+
+    def test_device_class_selectors_combine(self):
+        classes = {"acme-gpu": DeviceClass(name="acme-gpu", selectors=['device.attributes["vendor"] == "acme"'])}
+        a = Allocator([slice_of(gpu("d0", vendor="other"), gpu("d1"))], device_classes=classes)
+        r = a.allocate(nodeclaim(), [claim("c", req(device_class="acme-gpu"))])
+        r.commit()
+        assert a.metadata_for_claim("default/c").devices["it-a"][0].device_id.device == "d1"
+
+    def test_unknown_device_class_fails(self):
+        a = Allocator([slice_of(gpu("d0"))])
+        with pytest.raises(DRAError, match="DeviceClass"):
+            a.allocate(nodeclaim(), [claim("c", req(device_class="missing"))])
+
+
+class TestExclusiveAllocation:
+    def test_two_nodeclaims_contend_for_one_device(self):
+        slices = [slice_of(gpu("only"))]
+        a = Allocator(slices)
+        r1 = a.allocate(nodeclaim(id="nc-1"), [claim("c1", req())])
+        r1.commit()
+        with pytest.raises(DRAError):
+            a.allocate(nodeclaim(id="nc-2"), [claim("c2", req())])
+
+    def test_release_instance_type_frees_device(self):
+        a = Allocator([slice_of(gpu("only"))])
+        r1 = a.allocate(nodeclaim(id="nc-1", its=("it-a",)), [claim("c1", req())])
+        r1.commit()
+        a.release_instance_types("nc-1", "it-a")
+        r2 = a.allocate(nodeclaim(id="nc-2"), [claim("c2", req())])
+        assert r2.instance_types == ["it-a"]
+
+    def test_same_nodeclaim_different_its_share_device(self):
+        # A NodeClaim collapses to one IT, so one device may be allocated
+        # under each candidate IT (allocationtracker.go:262-264).
+        a = Allocator([slice_of(gpu("only"))])
+        r = a.allocate(nodeclaim(id="nc-1", its=("it-a", "it-b")), [claim("c1", req())])
+        assert r.instance_types == ["it-a", "it-b"]
+
+    def test_preallocated_devices_unavailable(self):
+        state = AllocatedDeviceState(exclusive_devices={DeviceID("gpu.acme.com", "pool-a", "d0")})
+        a = Allocator([slice_of(gpu("d0"), gpu("d1"))], allocated_state=state)
+        r = a.allocate(nodeclaim(), [claim("c", req())])
+        r.commit()
+        assert a.metadata_for_claim("default/c").devices["it-a"][0].device_id.device == "d1"
+
+
+class TestConstraints:
+    def test_match_attribute_forces_same_value(self):
+        devices = [
+            gpu("a0", numa="0"),
+            gpu("a1", numa="1"),
+            gpu("a2", numa="1"),
+        ]
+        a = Allocator([slice_of(*devices)])
+        r = a.allocate(
+            nodeclaim(),
+            [claim("c", req(count=2), constraints=[MatchConstraintSpec(attribute="numa")])],
+        )
+        r.commit()
+        chosen = {d.device_id.device for d in a.metadata_for_claim("default/c").devices["it-a"]}
+        # a0 pins numa=0 first but has no partner; backtracking finds the pair.
+        assert chosen == {"a1", "a2"}
+
+    def test_match_attribute_across_requests(self):
+        devices = [
+            Device(name="gpu0", attributes={"kind": "gpu", "root": "p1"}),
+            Device(name="nic0", attributes={"kind": "nic", "root": "p2"}),
+            Device(name="nic1", attributes={"kind": "nic", "root": "p1"}),
+        ]
+        a = Allocator([slice_of(*devices)])
+        r = a.allocate(
+            nodeclaim(),
+            [
+                claim(
+                    "c",
+                    req(name="gpu", selectors=['device.attributes["kind"] == "gpu"']),
+                    req(name="nic", selectors=['device.attributes["kind"] == "nic"']),
+                    constraints=[MatchConstraintSpec(attribute="root", requests=["gpu", "nic"])],
+                )
+            ],
+        )
+        r.commit()
+        chosen = {d.device_id.device for d in a.metadata_for_claim("default/c").devices["it-a"]}
+        assert chosen == {"gpu0", "nic1"}
+
+    def test_typed_equality_no_cross_type_pin(self):
+        devices = [
+            Device(name="d0", attributes={"v": 1}),
+            Device(name="d1", attributes={"v": "1"}),
+        ]
+        a = Allocator([slice_of(*devices)])
+        with pytest.raises(DRAError):
+            a.allocate(
+                nodeclaim(),
+                [claim("c", req(count=2), constraints=[MatchConstraintSpec(attribute="v")])],
+            )
+
+    def test_distinct_attribute_unsupported(self):
+        a = Allocator([slice_of(gpu("d0"))])
+        with pytest.raises(DRAError, match="DistinctAttribute"):
+            a.allocate(
+                nodeclaim(),
+                [
+                    claim(
+                        "c",
+                        req(),
+                        constraints=[MatchConstraintSpec(attribute="", distinct_attribute="x")],
+                    )
+                ],
+            )
+
+
+class TestAttributeBindings:
+    def _bindings(self):
+        return AttributeBindings.build(
+            {
+                ("np", "it-a"): [
+                    AttributeBindingDecl(
+                        attribute="pci-root",
+                        devices=[
+                            ("gpu.acme.com", "tmpl", "g0"),
+                            ("gpu.acme.com", "tmpl", "n0"),
+                        ],
+                    ),
+                    # Transitivity: n0~n1 implies g0~n1.
+                    AttributeBindingDecl(
+                        attribute="pci-root",
+                        devices=[
+                            ("gpu.acme.com", "tmpl", "n0"),
+                            ("gpu.acme.com", "tmpl", "n1"),
+                        ],
+                    ),
+                ]
+            }
+        )
+
+    def test_runtime_only_attribute_via_binding(self):
+        templates = {
+            "it-a": [
+                ResourceSlice(
+                    driver="gpu.acme.com",
+                    pool="tmpl",
+                    devices=[Device(name="g0"), Device(name="n1"), Device(name="x9")],
+                    potential=True,
+                )
+            ]
+        }
+        a = Allocator([], attribute_bindings=self._bindings())
+        r = a.allocate(
+            nodeclaim(slices=templates),
+            [
+                claim(
+                    "c",
+                    req(count=2),
+                    constraints=[MatchConstraintSpec(attribute="pci-root")],
+                )
+            ],
+        )
+        r.commit()
+        chosen = {d.device_id.device for d in a.metadata_for_claim("default/c").devices["it-a"]}
+        # x9 participates in no binding group, so the transitive g0-n1 pair wins.
+        assert chosen == {"g0", "n1"}
+
+    def test_no_binding_group_fails(self):
+        templates = {
+            "it-a": [
+                ResourceSlice(
+                    driver="gpu.acme.com",
+                    pool="tmpl",
+                    devices=[Device(name="x1"), Device(name="x2")],
+                    potential=True,
+                )
+            ]
+        }
+        a = Allocator([], attribute_bindings=self._bindings())
+        with pytest.raises(DRAError):
+            a.allocate(
+                nodeclaim(slices=templates),
+                [claim("c", req(count=2), constraints=[MatchConstraintSpec(attribute="pci-root")])],
+            )
+
+
+class TestFirstAvailable:
+    def test_falls_through_to_second_subrequest(self):
+        a = Allocator([slice_of(gpu("cheap", tier="b"))])
+        r = a.allocate(
+            nodeclaim(),
+            [
+                claim(
+                    "c",
+                    DeviceRequest(
+                        name="r0",
+                        first_available=[
+                            DeviceSubRequest(
+                                name="premium", selectors=['device.attributes["tier"] == "a"']
+                            ),
+                            DeviceSubRequest(
+                                name="standard", selectors=['device.attributes["tier"] == "b"']
+                            ),
+                        ],
+                    ),
+                )
+            ],
+        )
+        r.commit()
+        result = a.metadata_for_claim("default/c").devices["it-a"][0]
+        assert result.device_id.device == "cheap"
+        assert str(result.request_name) == "r0/standard"
+
+
+class TestAllMode:
+    def test_allocates_every_matching_device(self):
+        a = Allocator([slice_of(gpu("d0"), gpu("d1"), gpu("d2", vendor="other"))])
+        r = a.allocate(
+            nodeclaim(),
+            [claim("c", req(mode="All", selectors=['device.attributes["vendor"] == "acme"']))],
+        )
+        r.commit()
+        chosen = {d.device_id.device for d in a.metadata_for_claim("default/c").devices["it-a"]}
+        assert chosen == {"d0", "d1"}
+
+    def test_incomplete_pool_rejects_all_mode(self):
+        s = slice_of(gpu("d0"))
+        s.resource_slice_count = 2  # a second slice never arrived
+        a = Allocator([s])
+        with pytest.raises(DRAError, match="incomplete"):
+            a.allocate(nodeclaim(), [claim("c", req(mode="All"))])
+
+    def test_duplicate_device_names_invalidate_pool(self):
+        a = Allocator(
+            [
+                ResourceSlice(
+                    driver="d",
+                    pool="p",
+                    generation=1,
+                    resource_slice_count=2,
+                    all_nodes=True,
+                    devices=[gpu("dup")],
+                ),
+                ResourceSlice(
+                    driver="d",
+                    pool="p",
+                    generation=1,
+                    resource_slice_count=2,
+                    all_nodes=True,
+                    devices=[gpu("dup")],
+                ),
+            ]
+        )
+        with pytest.raises(DRAError, match="invalid"):
+            a.allocate(nodeclaim(), [claim("c", req(mode="All"))])
+
+
+class TestConsumableCapacity:
+    def _shared_device(self, total="10"):
+        return Device(
+            name="shared",
+            allow_multiple_allocations=True,
+            capacity={"bandwidth": DeviceCapacity(value=float(total))},
+        )
+
+    def test_capacity_gates_multi_alloc(self):
+        a = Allocator([slice_of(self._shared_device())])
+        r1 = a.allocate(nodeclaim(id="nc-1"), [claim("c1", req(capacity={"bandwidth": 6.0}))])
+        r1.commit()
+        with pytest.raises(DRAError):
+            a.allocate(nodeclaim(id="nc-2"), [claim("c2", req(capacity={"bandwidth": 6.0}))])
+        r3 = a.allocate(nodeclaim(id="nc-3"), [claim("c3", req(capacity={"bandwidth": 4.0}))])
+        assert r3.instance_types == ["it-a"]
+
+    def test_unrequested_dimension_consumes_full_value(self):
+        a = Allocator([slice_of(self._shared_device())])
+        r1 = a.allocate(nodeclaim(id="nc-1"), [claim("c1", req())])
+        r1.commit()
+        with pytest.raises(DRAError):
+            a.allocate(nodeclaim(id="nc-2"), [claim("c2", req(capacity={"bandwidth": 1.0}))])
+
+    def test_request_policy_rounds_up(self):
+        d = Device(
+            name="shared",
+            allow_multiple_allocations=True,
+            capacity={
+                "bandwidth": DeviceCapacity(
+                    value=10.0,
+                    request_policy=RequestPolicy(valid_range_min=4.0, valid_range_step=4.0),
+                )
+            },
+        )
+        a = Allocator([slice_of(d)])
+        r1 = a.allocate(nodeclaim(id="nc-1"), [claim("c1", req(capacity={"bandwidth": 5.0}))])
+        r1.commit()  # rounds to 8
+        with pytest.raises(DRAError):
+            a.allocate(nodeclaim(id="nc-2"), [claim("c2", req(capacity={"bandwidth": 1.0}))])
+
+    def test_nonexistent_dimension_fails(self):
+        a = Allocator([slice_of(self._shared_device())])
+        with pytest.raises(DRAError):
+            a.allocate(nodeclaim(), [claim("c", req(capacity={"nope": 1.0}))])
+
+
+class TestPartitionableDevices:
+    def _partitioned_pool(self):
+        """A GPU partitioned into slices drawing from one memory budget."""
+        counter_slice = ResourceSlice(
+            driver="gpu.acme.com",
+            pool="mig",
+            generation=1,
+            resource_slice_count=2,
+            shared_counters=[CounterSet(name="gpu0", counters={"memory": 40.0})],
+        )
+        device_slice = ResourceSlice(
+            driver="gpu.acme.com",
+            pool="mig",
+            generation=1,
+            resource_slice_count=2,
+            all_nodes=True,
+            devices=[
+                Device(
+                    name="mig-20-a",
+                    consumes_counters=[CounterConsumption("gpu0", {"memory": 20.0})],
+                ),
+                Device(
+                    name="mig-20-b",
+                    consumes_counters=[CounterConsumption("gpu0", {"memory": 20.0})],
+                ),
+                Device(
+                    name="mig-40",
+                    consumes_counters=[CounterConsumption("gpu0", {"memory": 40.0})],
+                ),
+            ],
+        )
+        return [counter_slice, device_slice]
+
+    def test_counter_budget_limits_partitions(self):
+        a = Allocator(self._partitioned_pool())
+        r1 = a.allocate(nodeclaim(id="nc-1"), [claim("c1", req(count=2))])
+        r1.commit()  # two 20s exhaust the 40 budget
+        with pytest.raises(DRAError):
+            a.allocate(nodeclaim(id="nc-2"), [claim("c2", req())])
+
+    def test_release_returns_counter_budget(self):
+        a = Allocator(self._partitioned_pool())
+        r1 = a.allocate(nodeclaim(id="nc-1"), [claim("c1", req(count=2))])
+        r1.commit()
+        a.release_instance_types("nc-1", "it-a")
+        r2 = a.allocate(nodeclaim(id="nc-2"), [claim("c2", req())])
+        assert r2.instance_types == ["it-a"]
+
+    def test_pessimistic_max_across_its(self):
+        # nc-1 allocates one 20 partition under each of it-a and it-b; the
+        # budget charge is the pessimistic max (20), not the sum (40).
+        pool = self._partitioned_pool()
+        a = Allocator(pool)
+        r = a.allocate(
+            DRANodeClaim(
+                id="nc-1",
+                nodepool="np",
+                requirements=Requirements(),
+                instance_types=["it-a", "it-b"],
+                resource_slices={},
+            ),
+            [claim("c1", req())],
+        )
+        r.commit()
+        with pytest.raises(DRAError):
+            a.allocate(nodeclaim(id="nc-2"), [claim("c2", req(count=2))])
+        # Releasing it-a leaves it-b's 20 charged; a single partition still fits.
+        a.release_instance_types("nc-1", "it-a")
+        r2 = a.allocate(nodeclaim(id="nc-2"), [claim("c2", req())])
+        assert r2.instance_types == ["it-a"]
+
+
+class TestTemplateDevices:
+    def _templates(self, its=("it-a", "it-b")):
+        return {
+            it: [
+                ResourceSlice(
+                    driver="tpu.acme.com",
+                    pool=f"tmpl-{it}",
+                    potential=True,
+                    devices=[gpu("t0", vendor="acme"), gpu("t1", vendor="acme")],
+                )
+            ]
+            for it in its
+        }
+
+    def test_template_devices_per_it(self):
+        a = Allocator([])
+        r = a.allocate(
+            nodeclaim(its=("it-a", "it-b"), slices=self._templates()),
+            [claim("c", req(count=2))],
+        )
+        r.commit()
+        meta = a.metadata_for_claim("default/c")
+        assert meta.used_template_devices
+        assert set(meta.devices) == {"it-a", "it-b"}
+
+    def test_template_claim_node_local(self):
+        # A claim satisfied with template devices pins pods to that NodeClaim.
+        a = Allocator([])
+        r = a.allocate(nodeclaim(id="nc-1", slices=self._templates(("it-a",))), [claim("c", req())])
+        r.commit()
+        with pytest.raises(DRAError, match="different in-flight"):
+            a.allocate(nodeclaim(id="nc-2", slices=self._templates(("it-a",))), [claim("c", req())])
+        # Same NodeClaim: already satisfied, no new DFS needed.
+        r2 = a.allocate(nodeclaim(id="nc-1", slices=self._templates(("it-a",))), [claim("c", req())])
+        assert r2.allocation is None
+
+    def test_template_counters_are_per_it(self):
+        templates = {
+            "it-a": [
+                ResourceSlice(
+                    driver="tpu.acme.com",
+                    pool="tmpl",
+                    potential=True,
+                    shared_counters=[CounterSet(name="hbm", counters={"gb": 32.0})],
+                    devices=[
+                        Device(name="half-a", consumes_counters=[CounterConsumption("hbm", {"gb": 16.0})]),
+                        Device(name="half-b", consumes_counters=[CounterConsumption("hbm", {"gb": 16.0})]),
+                        Device(name="full", consumes_counters=[CounterConsumption("hbm", {"gb": 32.0})]),
+                    ],
+                )
+            ]
+        }
+        a = Allocator([])
+        r1 = a.allocate(nodeclaim(id="nc-1", slices=templates), [claim("c1", req(count=2))])
+        r1.commit()
+        # The two halves consumed the 32GB budget on nc-1/it-a.
+        with pytest.raises(DRAError):
+            a.allocate(nodeclaim(id="nc-1", slices=templates), [claim("c2", req())])
+
+
+class TestTopology:
+    def _zonal_slices(self):
+        zone_a = Requirements(Requirement.new("topology.kubernetes.io/zone", "In", "zone-a"))
+        return [
+            ResourceSlice(
+                driver="net.acme.com",
+                pool="zonal",
+                node_selector_terms=[zone_a],
+                devices=[gpu("za")],
+            )
+        ]
+
+    def test_device_topology_contributes_requirements(self):
+        a = Allocator(self._zonal_slices())
+        r = a.allocate(nodeclaim(), [claim("c", req())])
+        zone_req = r.requirements.get("topology.kubernetes.io/zone")
+        assert zone_req is not None and zone_req.has("zone-a")
+
+    def test_incompatible_nodeclaim_rejected(self):
+        a = Allocator(self._zonal_slices())
+        reqs = Requirements(Requirement.new("topology.kubernetes.io/zone", "In", "zone-b"))
+        with pytest.raises(DRAError):
+            a.allocate(nodeclaim(reqs=reqs), [claim("c", req())])
+
+    def test_node_name_pinned_slice(self):
+        s = ResourceSlice(driver="d", pool="p", node_name="node-7", devices=[gpu("local")])
+        a = Allocator([s])
+        # In-flight NodeClaims (no node name) can't reach node-pinned slices.
+        with pytest.raises(DRAError):
+            a.allocate(nodeclaim(), [claim("c", req())])
+        r = a.allocate(nodeclaim(id="nc-e", node_name="node-7"), [claim("c", req())])
+        assert r.instance_types == ["it-a"]
+        # The device pins the claim to its node's hostname, so a pod sharing
+        # the claim can't land on a different node.
+        r.commit()
+        assert a.metadata_for_claim("default/c").total_requirements.get(
+            "kubernetes.io/hostname"
+        ).has("node-7")
+        other = Requirements(Requirement.new("kubernetes.io/hostname", "In", "node-99"))
+        with pytest.raises(DRAError, match="incompatible"):
+            a.allocate(
+                nodeclaim(id="nc-other", node_name="node-99", reqs=other), [claim("c", req())]
+            )
+
+    def test_or_terms_fold_as_union(self):
+        # A slice selectable in zone-a OR zone-b contributes the union, not
+        # the (empty) intersection, so its devices stay allocatable.
+        terms = [
+            Requirements(Requirement.new("topology.kubernetes.io/zone", "In", "zone-a")),
+            Requirements(Requirement.new("topology.kubernetes.io/zone", "In", "zone-b")),
+        ]
+        s = ResourceSlice(driver="d", pool="p", node_selector_terms=terms, devices=[gpu("d0")])
+        a = Allocator([s])
+        reqs = Requirements(Requirement.new("topology.kubernetes.io/zone", "In", "zone-a"))
+        r = a.allocate(nodeclaim(reqs=reqs), [claim("c", req())])
+        zone = r.requirements.get("topology.kubernetes.io/zone")
+        assert zone.has("zone-a")
+
+    def test_or_terms_in_claim_allocation(self):
+        zone_ab = [
+            Requirements(Requirement.new("topology.kubernetes.io/zone", "In", "zone-a")),
+            Requirements(Requirement.new("topology.kubernetes.io/zone", "In", "zone-b")),
+        ]
+        c = ResourceClaim(name="done", allocation=DeviceClaimStatus(node_selector_terms=zone_ab))
+        a = Allocator([])
+        reqs = Requirements(Requirement.new("topology.kubernetes.io/zone", "In", "zone-a"))
+        r = a.allocate(nodeclaim(reqs=reqs), [c])
+        assert r.requirements.get("topology.kubernetes.io/zone").has("zone-a")
+
+    def test_malformed_quantity_is_no_match_not_crash(self):
+        a = Allocator([slice_of(gpu("d0"))])
+        with pytest.raises(DRAError, match="no instance type"):
+            a.allocate(
+                nodeclaim(),
+                [claim("c", req(selectors=['device.capacity["memory"] > quantity("10Q")']))],
+            )
+
+    def test_in_cluster_allocated_claim_folds_topology(self):
+        zone_a = Requirements(Requirement.new("topology.kubernetes.io/zone", "In", "zone-a"))
+        c = ResourceClaim(
+            name="done",
+            allocation=DeviceClaimStatus(node_selector_terms=[zone_a]),
+        )
+        a = Allocator([])
+        r = a.allocate(nodeclaim(), [c])
+        assert r.requirements.get("topology.kubernetes.io/zone").has("zone-a")
+        reqs = Requirements(Requirement.new("topology.kubernetes.io/zone", "In", "zone-b"))
+        with pytest.raises(DRAError, match="incompatible"):
+            a.allocate(nodeclaim(id="nc-2", reqs=reqs), [c])
+
+    def test_claim_reserved_by_deleting_pods_reallocates(self):
+        c = ResourceClaim(
+            name="migrating",
+            requests=[req()],
+            allocation=DeviceClaimStatus(),
+            reserved_for=["pod-uid-1"],
+        )
+        a = Allocator([slice_of(gpu("d0"))], deleting_pod_uids={"pod-uid-1"})
+        r = a.allocate(nodeclaim(), [c])
+        r.commit()
+        assert a.metadata_for_claim("default/migrating") is not None
+        # With a live consumer the claim stays committed in place.
+        c2 = ResourceClaim(
+            name="pinned",
+            requests=[req()],
+            allocation=DeviceClaimStatus(),
+            reserved_for=["pod-uid-1", "live-pod"],
+        )
+        a2 = Allocator([slice_of(gpu("d0"))], deleting_pod_uids={"pod-uid-1"})
+        r2 = a2.allocate(nodeclaim(), [c2])
+        assert r2.allocation is None
+
+
+class TestPools:
+    def test_generation_supersession(self):
+        old = ResourceSlice(driver="d", pool="p", generation=1, all_nodes=True, devices=[gpu("old")])
+        new = ResourceSlice(driver="d", pool="p", generation=2, all_nodes=True, devices=[gpu("new")])
+        pools = gather_pools([old, new], Requirements())
+        assert len(pools) == 1
+        assert [dw.device.name for dw in pools[0].devices] == ["new"]
+        assert not pools[0].incomplete
+
+    def test_incomplete_pool_still_usable_for_exact_count(self):
+        s = slice_of(gpu("d0"))
+        s.resource_slice_count = 3
+        a = Allocator([s])
+        # ExactCount skips incomplete pools' devices entirely (allocator.go:806).
+        with pytest.raises(DRAError):
+            a.allocate(nodeclaim(), [claim("c", req())])
+
+    def test_max_devices_cap(self):
+        a = Allocator([slice_of(*[gpu(f"d{i}") for i in range(40)])])
+        with pytest.raises(DRAError, match="maximum"):
+            a.allocate(nodeclaim(), [claim("c", req(count=33))])
