@@ -106,10 +106,18 @@ class InstanceType:
         offerings: list[Offering],
         capacity: dict[str, float],
         overhead: Optional[InstanceTypeOverhead] = None,
+        dra_slices: Optional[list] = None,
+        dra_attribute_bindings: Optional[list] = None,
     ):
         self.name = name
         self.requirements = requirements
         self.offerings = offerings
+        # DRA: potential-device ResourceSlice templates this instance type
+        # would publish after launch, and attribute-binding declarations for
+        # runtime-only attributes (reference types.go InstanceType
+        # .DynamicResources; consumed by scheduling/dra).
+        self.dra_slices = dra_slices or []
+        self.dra_attribute_bindings = dra_attribute_bindings or []
         # resource dicts are float32-quantized at every model boundary so
         # host arithmetic and the f32 device tensors agree exactly
         self.capacity = res.quantize(capacity)

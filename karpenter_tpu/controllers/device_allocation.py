@@ -1,0 +1,208 @@
+"""Device allocation controller.
+
+Counterpart of the reference's deviceallocation controller
+(pkg/controllers/nodeclaim/deviceallocation/) fused with the dra-kwok-driver
+harness (kwok/apis + dra driver): once a NodeClaim that carried simulated
+device allocations launches and its node's instance type is known, the
+controller collapses the per-IT superposition to the chosen type, writes the
+ResourceClaim's status allocation (devices + node selector + reservedFor),
+and publishes the instance type's template ResourceSlices as node-local
+in-cluster slices — the driver's job in a real cluster.
+
+Template device identities are node-scoped at publish time (pool name gets
+the node suffix) so two nodes launched from the same instance type never
+merge into one pool with duplicate device names, which pool gathering would
+flag invalid (pool.go:311).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.scheduling.dra.types import (
+    AllocatedDevice,
+    DeviceClaimStatus,
+    ResourceSlice,
+)
+from karpenter_tpu.scheduling.requirements import Requirement, Requirements
+from karpenter_tpu.state.store import ObjectStore
+
+
+def _node_scoped_pool(pool: str, node_name: str) -> str:
+    return f"{pool}-{node_name}"
+
+
+@dataclass
+class PendingAllocation:
+    """One claim's simulated allocation awaiting launch collapse."""
+
+    claim_name: str
+    nodeclaim_name: str  # "" for existing-node allocations
+    node_name: str  # set for existing-node allocations
+    metadata: object  # dra.allocator.ResourceClaimAllocationMetadata
+    pod_uids: list[str] = field(default_factory=list)
+    # per-IT template slices of the originating candidate set, for publish
+    it_slices: dict[str, list[ResourceSlice]] = field(default_factory=dict)
+
+
+class DeviceAllocationController:
+    def __init__(self, store: ObjectStore, clock=None):
+        from karpenter_tpu.state.store import EventType
+
+        self.store = store
+        self.clock = clock
+        self._pending: list[PendingAllocation] = []
+        self._published_nodes: set[str] = set()
+        store.watch(
+            ObjectStore.NODES,
+            lambda ev, obj: (
+                self.on_node_deleted(obj.metadata.name) if ev == EventType.DELETED else None
+            ),
+        )
+
+    def register(self, pending: PendingAllocation) -> None:
+        self._pending.append(pending)
+
+    def reconcile_once(self) -> int:
+        """Resolve pending allocations whose node is known; returns how many
+        claim statuses were written."""
+        written = 0
+        still_pending: list[PendingAllocation] = []
+        for p in self._pending:
+            outcome = self._resolve_node(p)
+            if outcome == "drop":
+                # Target vanished (failed launch / GC / node deleted): the
+                # claim stays unallocated and the next loop re-runs the DFS.
+                continue
+            if outcome == "wait":
+                still_pending.append(p)
+                continue
+            node_name, it_name = outcome
+            if self._write_allocation(p, node_name, it_name):
+                written += 1
+        self._pending = still_pending
+        return written
+
+    def _resolve_node(self, p: PendingAllocation):
+        """(node_name, instance_type) once launch collapsed the claim;
+        "wait" while launch is in flight; "drop" when the target is gone."""
+        if p.node_name:
+            node = self.store.get(ObjectStore.NODES, p.node_name)
+            if node is None:
+                return "drop"
+            return p.node_name, node.metadata.labels.get(l.LABEL_INSTANCE_TYPE, "")
+        claim = self.store.get(ObjectStore.NODECLAIMS, p.nodeclaim_name)
+        if claim is None:
+            return "drop"
+        it_name = claim.metadata.labels.get(l.LABEL_INSTANCE_TYPE, "")
+        if not it_name or not claim.status.provider_id:
+            return "wait"
+        node = self.store.node_by_provider_id(claim.status.provider_id)
+        if node is None:
+            return "wait"
+        return node.metadata.name, it_name
+
+    def _write_allocation(self, p: PendingAllocation, node_name: str, it_name: str) -> bool:
+        rc = self.store.get(ObjectStore.RESOURCE_CLAIMS, p.claim_name)
+        if rc is None:
+            return False
+        if rc.allocation is not None:
+            # Already committed (a later pod joined the claim): just extend
+            # the consumer reservation (reservedFor maintenance).
+            new_uids = [u for u in p.pod_uids if u not in rc.reserved_for]
+            if new_uids:
+                rc.reserved_for.extend(new_uids)
+                self.store.update(ObjectStore.RESOURCE_CLAIMS, rc)
+            return False
+        meta = p.metadata
+        results = meta.devices.get(it_name)
+        if results is None and meta.devices:
+            # Launch collapsed to a type the allocator never simulated
+            # (shouldn't happen: the claim's requirements pin the surviving
+            # set). Writing another IT's simulated devices would reference
+            # hardware that doesn't exist on this node — leave the claim
+            # unallocated so the next loop re-runs the DFS against reality.
+            return False
+        devices = []
+        for r in results or []:
+            pool = r.device_id.pool
+            if r.device_id.template:
+                pool = _node_scoped_pool(pool, node_name)
+            devices.append(
+                AllocatedDevice(
+                    request=str(r.request_name),
+                    driver=r.device_id.driver,
+                    pool=pool,
+                    device=r.device_id.device,
+                    consumed_capacity=dict(r.consumed_capacity) if r.consumed_capacity else None,
+                )
+            )
+        if meta.used_template_devices:
+            # Node-local devices: the claim is usable only from this node.
+            terms = [Requirements(Requirement.new(l.LABEL_HOSTNAME, "In", node_name))]
+        else:
+            contributed = meta.contributed_requirements.get(it_name)
+            terms = [contributed.copy()] if contributed and len(contributed) else None
+        rc.allocation = DeviceClaimStatus(devices=devices, node_selector_terms=terms)
+        rc.reserved_for = list(p.pod_uids)
+        self.store.update(ObjectStore.RESOURCE_CLAIMS, rc)
+        if meta.used_template_devices:
+            self._publish_slices(p, node_name, it_name)
+        return True
+
+    def _publish_slices(self, p: PendingAllocation, node_name: str, it_name: str) -> None:
+        """The driver's half: surface the launched instance's template
+        devices as published, node-pinned ResourceSlices."""
+        if node_name in self._published_nodes:
+            return
+        self._published_nodes.add(node_name)
+        from karpenter_tpu.models.objects import ObjectMeta
+
+        # Group template slices per (driver, pool): pool gathering treats a
+        # counter-bearing slice as counter-only (pool.go:293-296), so a
+        # template carrying both devices and SharedCounters publishes as two
+        # slices, and resource_slice_count covers the full scoped pool.
+        by_pool: dict[tuple[str, str], list[ResourceSlice]] = {}
+        for tmpl in p.it_slices.get(it_name, []):
+            by_pool.setdefault((tmpl.driver, tmpl.pool), []).append(tmpl)
+        for (driver, orig_pool), tmpls in by_pool.items():
+            pool = _node_scoped_pool(orig_pool, node_name)
+            device_slices = [t for t in tmpls if t.devices]
+            counter_sets = [cs for t in tmpls for cs in (t.shared_counters or [])]
+            total = len(device_slices) + (1 if counter_sets else 0)
+            published: list[ResourceSlice] = []
+            for t in device_slices:
+                published.append(
+                    ResourceSlice(
+                        driver=driver,
+                        pool=pool,
+                        devices=list(t.devices),
+                        generation=1,
+                        node_name=node_name,
+                    )
+                )
+            if counter_sets:
+                published.append(
+                    ResourceSlice(
+                        driver=driver,
+                        pool=pool,
+                        generation=1,
+                        shared_counters=counter_sets,
+                    )
+                )
+            for idx, s in enumerate(published):
+                s.resource_slice_count = total
+                s.metadata = ObjectMeta(name=f"{node_name}-{driver}-{orig_pool}-{idx}")
+                if self.store.get(ObjectStore.RESOURCE_SLICES, s.metadata.name) is None:
+                    self.store.create(ObjectStore.RESOURCE_SLICES, s)
+
+    def on_node_deleted(self, node_name: str) -> None:
+        """Driver cleanup: withdraw the node's published slices — including
+        the pool's counter-set slice, which carries no node pin but shares
+        the node-prefixed name (leaving it would strand a permanently
+        incomplete pool that fails every All-mode claim)."""
+        self._published_nodes.discard(node_name)
+        for s in list(self.store.list(ObjectStore.RESOURCE_SLICES)):
+            if s.node_name == node_name or s.metadata.name.startswith(f"{node_name}-"):
+                self.store.delete(ObjectStore.RESOURCE_SLICES, s.metadata.name)

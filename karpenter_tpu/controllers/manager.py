@@ -54,7 +54,14 @@ class Manager:
             ignore_preferences=self.options.preference_policy == "Ignore",
             reserved_capacity_enabled=self.options.feature_gates.reserved_capacity,
             min_values_policy=self.options.min_values_policy,
+            dynamic_resources_enabled=self.options.feature_gates.dynamic_resources,
         )
+        self.device_allocation = None
+        if self.options.feature_gates.dynamic_resources:
+            from karpenter_tpu.controllers.device_allocation import DeviceAllocationController
+
+            self.device_allocation = DeviceAllocationController(store, self.clock)
+            self.provisioner.device_allocation = self.device_allocation
         self.lifecycle = NodeClaimLifecycleController(store, cloud, self.clock)
         self.nodeclaim_disruption = NodeClaimDisruptionController(store, cloud, self.clock)
         from karpenter_tpu.controllers.disruption import DisruptionController
@@ -203,6 +210,9 @@ class Manager:
             if claim is not None:
                 self.lifecycle.reconcile(claim)
                 worked = True
+        # device allocation collapse (DRA): claims whose NodeClaim launched
+        if self.device_allocation is not None:
+            worked = bool(self.device_allocation.reconcile_once()) or worked
         # provisioning batch window
         if self.batcher.ready():
             outcome = self.provisioner.reconcile()
@@ -298,9 +308,16 @@ class KubeSchedulerSim:
     (the real kube-scheduler re-evaluates TSC itself; this sim trusts the
     solver's decision instead)."""
 
-    def __init__(self, store: ObjectStore, cluster: Cluster):
+    def __init__(self, store: ObjectStore, cluster: Cluster, dra_aware: bool = True):
         self.store = store
         self.cluster = cluster
+        # The real kube-scheduler always enforces DRA allocation before
+        # binding (and can allocate in-cluster claims itself, which this sim
+        # cannot). Harnesses running with the DynamicResources gate OFF but
+        # claim-bearing pods should pass dra_aware=False — the analog of the
+        # reference's IgnoreDRARequests (scheduler.go:584) — or claim pods
+        # will wait forever for an allocation nothing is going to write.
+        self.dra_aware = dra_aware
 
     def _bindable(self, sn, pod, pod_reqs) -> bool:
         node = sn.node
@@ -311,7 +328,29 @@ class KubeSchedulerSim:
         node_reqs = Requirements.from_labels(node.metadata.labels)
         if node_reqs.compatible(pod_reqs, l.WELL_KNOWN_LABELS) is not None:
             return False
+        if not self._dra_bindable(node, pod, node_reqs):
+            return False
         return res.fits(pod.total_requests(), sn.available())
+
+    def _dra_bindable(self, node, pod, node_reqs) -> bool:
+        """The real kube-scheduler's DRA plugin refuses to bind a pod whose
+        ResourceClaims aren't allocated and reserved for it on a node the
+        allocation's selector admits; mirror that here so unallocated DRA
+        pods wait instead of landing deviceless."""
+        if not self.dra_aware or not pod.spec.resource_claims:
+            return True
+        for name in pod.spec.resource_claims:
+            rc = self.store.get(ObjectStore.RESOURCE_CLAIMS, name)
+            if rc is None or rc.allocation is None:
+                return False
+            if pod.uid not in rc.reserved_for:
+                return False
+            terms = rc.allocation.node_selector_terms
+            if terms and not any(
+                node_reqs.is_compatible(term, l.WELL_KNOWN_LABELS) for term in terms
+            ):
+                return False
+        return True
 
     def _node_for_target(self, target: str):
         """A nomination target is a node name or a claim name."""

@@ -94,6 +94,8 @@ class SchedulingResult:
     assignments: dict[str, int]  # pod uid -> claim slot
     existing: list[ExistingSimNode] = field(default_factory=list)
     existing_assignments: dict[str, str] = field(default_factory=dict)  # pod uid -> node name
+    # the winning round's DRARound (device allocation metadata), when DRA ran
+    dra: object = None
 
     @property
     def node_count(self) -> int:
@@ -210,6 +212,7 @@ class HostScheduler:
         reserved_capacity_enabled: bool = True,
         min_values_policy: str = "Strict",
         reserved_in_use: Optional[dict[str, int]] = None,
+        dra_problem=None,
     ):
         """budgets: nodepool -> remaining resources (limits minus current
         usage; may include the synthetic 'nodes' count). Absent pool =
@@ -229,6 +232,8 @@ class HostScheduler:
         self.reserved_capacity_enabled = reserved_capacity_enabled
         self.min_values_policy = min_values_policy
         self.reserved_in_use = reserved_in_use or {}
+        self.dra_problem = dra_problem  # scheduling.dra.integration.DRAProblem
+        self._dra = None
         self._rm = None
         self._hostname_seq = 0
         for node in self.existing_nodes:
@@ -299,9 +304,21 @@ class HostScheduler:
             return False
         base = node.requirements.copy()
         base.add(*pod_reqs.values())
+        alloc = None
+        if self._dra is not None and pod.spec.resource_claims:
+            # existing node: single collapsed instance type, published
+            # (in-cluster) slices only (existingnode.go:81-135)
+            alloc = self._dra.try_allocate_existing(pod, node.name, base)
+            if alloc is None:
+                return False
+            if base.compatible(alloc.requirements, l.WELL_KNOWN_LABELS) is not None:
+                return False
+            base.add(*alloc.requirements.values())
         tightened = self.topology.add_requirements(pod, strict, base)
         if tightened is None or base.compatible(tightened) is not None:
             return False
+        if alloc is not None:
+            self._dra.commit(alloc, node.name, set(alloc.instance_types))
         node.requirements = tightened
         node.used = total
         node.pods.append(pod)
@@ -325,6 +342,18 @@ class HostScheduler:
             return None
         combined = claim.requirements.copy()
         combined.add(*pod_reqs.values())
+        # DRA device allocation runs before topology so contributed device
+        # topology feeds the full filtering pipeline (nodeclaim.go:179-192)
+        alloc = None
+        if self._dra is not None and pod.spec.resource_claims:
+            alloc = self._dra.try_allocate(
+                pod, claim.hostname, claim.template.nodepool_name, combined, claim.instance_types
+            )
+            if alloc is None:
+                return None
+            if combined.compatible(alloc.requirements, l.WELL_KNOWN_LABELS) is not None:
+                return None
+            combined.add(*alloc.requirements.values())
         # topology comes last: it may collapse a key to a single domain
         # (nodeclaim.go:199-210)
         tightened = self.topology.add_requirements(pod, strict, combined)
@@ -335,11 +364,18 @@ class HostScheduler:
             claim.instance_types, tightened, total,
             relax_min_values=self.min_values_policy == "BestEffort",
         )
+        if alloc is not None:
+            # only instance types whose device allocation succeeded survive
+            # (nodeclaim.go:226-237)
+            surviving = set(alloc.instance_types)
+            remaining = [it for it in remaining if it.name in surviving]
         if not remaining:
             return None
         new_ids = self._reserve_for(claim.hostname, remaining, tightened, claim.reserved_ids)
         if new_ids is None:
             return None
+        if alloc is not None:
+            self._dra.commit(alloc, claim.hostname, {it.name for it in remaining})
         self.topology.record(pod, tightened)
         return SimClaim(
             template=claim.template,
@@ -394,6 +430,15 @@ class HostScheduler:
             hostname = self._next_hostname()
             combined.add(Requirement.new(l.LABEL_HOSTNAME, Operator.IN, hostname))
             combined.add(*pod_reqs.values())
+            alloc = None
+            if self._dra is not None and pod.spec.resource_claims:
+                alloc = self._dra.try_allocate(
+                    pod, hostname, tmpl.nodepool_name, combined, tmpl.instance_types
+                )
+                if alloc is None or combined.compatible(alloc.requirements, l.WELL_KNOWN_LABELS) is not None:
+                    self._hostname_seq -= 1
+                    continue
+                combined.add(*alloc.requirements.values())
             tightened = self.topology.add_requirements(pod, strict, combined)
             if tightened is None or combined.compatible(tightened, l.WELL_KNOWN_LABELS) is not None:
                 self._hostname_seq -= 1  # hostname not consumed
@@ -404,6 +449,9 @@ class HostScheduler:
                 candidates, tightened, total,
                 relax_min_values=self.min_values_policy == "BestEffort",
             )
+            if alloc is not None:
+                surviving = set(alloc.instance_types)
+                remaining = [it for it in remaining if it.name in surviving]
             if not remaining:
                 self._hostname_seq -= 1
                 continue
@@ -411,6 +459,8 @@ class HostScheduler:
             if new_ids is None:
                 self._hostname_seq -= 1
                 continue
+            if alloc is not None:
+                self._dra.commit(alloc, hostname, {it.name for it in remaining})
             self._charge_budget(tmpl, remaining)
             self.topology.register(l.LABEL_HOSTNAME, hostname)
             self.topology.record(pod, tightened)
@@ -452,11 +502,19 @@ class HostScheduler:
 
     def _solve_once(self, pods: list[Pod]) -> SchedulingResult:
         self._rm = self._build_rm()
+        self._dra = self.dra_problem.fresh_round() if self.dra_problem is not None else None
         claims: list[SimClaim] = []
         unschedulable: list[tuple[Pod, str]] = []
         assignments: dict[str, int] = {}
         existing_assignments: dict[str, str] = {}
         for pod in ffd_sort(pods):
+            if self._dra is not None:
+                err = self._dra.pod_error(pod)
+                if err is not None:
+                    # unresolved claim reference: no candidate can accept
+                    # the pod this loop (scheduler.go:587-589)
+                    unschedulable.append((pod, err))
+                    continue
             pod_reqs = Requirements.from_pod(pod)
             extra = self.volume_reqs.get(pod.uid)
             if extra is not None:
@@ -498,4 +556,5 @@ class HostScheduler:
             assignments=assignments,
             existing=self.existing_nodes,
             existing_assignments=existing_assignments,
+            dra=self._dra,
         )

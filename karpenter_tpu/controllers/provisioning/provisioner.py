@@ -42,6 +42,7 @@ class Provisioner:
         ignore_preferences: bool = False,
         reserved_capacity_enabled: bool = True,
         min_values_policy: str = "Strict",
+        dynamic_resources_enabled: bool = False,
     ):
         self.store = store
         self.cluster = cluster
@@ -50,6 +51,9 @@ class Provisioner:
         self.ignore_preferences = ignore_preferences  # PreferencePolicy=Ignore
         self.reserved_capacity_enabled = reserved_capacity_enabled
         self.min_values_policy = min_values_policy
+        self.dynamic_resources_enabled = dynamic_resources_enabled
+        # DeviceAllocationController; wired by the manager when DRA is on
+        self.device_allocation = None
         self._scheduler_cache: Optional[tuple[tuple, TPUScheduler]] = None
         self._buffer_pods: dict[tuple[str, int], list[Pod]] = {}
 
@@ -139,6 +143,21 @@ class Provisioner:
         )
         return Topology.build(pods, universe, self._bound_pods(excluded_nodes))
 
+    def _build_dra_problem(self, pods):
+        """Per-loop DRA inputs (DynamicResources gate, off by default like
+        the reference's feature flag); None when disabled or no pod uses
+        resource claims."""
+        if not self.dynamic_resources_enabled:
+            return None
+        if not any(p.spec.resource_claims for p in pods):
+            return None  # keep the no-DRA hot path free of catalog fetches
+        from karpenter_tpu.scheduling.dra.integration import DRAProblem
+
+        catalogs = {
+            p.name: self.cloud.get_instance_types(p) for p in self.store.nodepools()
+        }
+        return DRAProblem.build(self.store, pods, catalogs)
+
     def _reserved_in_use(self) -> dict[str, int]:
         """Reservation ids pinned by in-flight claims the provider has not
         launched yet — the catalog's capacities can't reflect them, so the
@@ -177,6 +196,18 @@ class Provisioner:
         if not pods:
             return SchedulingResult(claims=[], unschedulable=[], assignments={})
         existing = self._existing_sim_nodes(excluded_node_names)
+        dra_problem = self._build_dra_problem(pods)
+        if dra_problem is not None:
+            # pods displaced off the excluded nodes are migrating: their
+            # claims' devices are freed and re-allocated in the what-if
+            dra_problem.deleting_pod_uids |= {p.uid for p in extra_pods}
+            from karpenter_tpu.scheduling.dra.integration import gather_allocated_state
+
+            dra_problem.allocated_state = gather_allocated_state(
+                self.store.list(ObjectStore.RESOURCE_CLAIMS),
+                dra_problem.in_cluster_slices,
+                dra_problem.deleting_pod_uids,
+            )
         return scheduler.solve(
             pods,
             existing,
@@ -184,6 +215,7 @@ class Provisioner:
             topology_factory=lambda ps: self._build_topology(ps, scheduler, excluded_node_names),
             volume_reqs=self._volume_requirements(pods),
             reserved_in_use=self._reserved_in_use(),
+            dra_problem=dra_problem,
         )
 
     def _existing_sim_nodes(self, excluded: Optional[set[str]] = None) -> list[ExistingSimNode]:
@@ -316,8 +348,75 @@ class Provisioner:
             # re-provision for them (MarkPodSchedulingDecisions)
             for pod in sim.pods:
                 self.cluster.nominate_pod(pod.uid, claim.name)
+            if result.dra is not None and self.device_allocation is not None:
+                self._register_device_allocations(result.dra, sim, claim)
             created.append(claim)
+        if result.dra is not None and self.device_allocation is not None:
+            self._register_existing_device_allocations(result)
+            self._extend_claim_reservations(result)
         return created
+
+    def _extend_claim_reservations(self, result: SchedulingResult) -> None:
+        """Pods that joined a claim already allocated in-cluster never pass
+        through the allocator (classified committed-in-place), so their
+        consumer reservation (reservedFor) is extended directly."""
+        placed = [p for sim in result.claims for p in sim.pods]
+        placed += [p for node in result.existing for p in node.pods]
+        for pod in placed:
+            for name in pod.spec.resource_claims:
+                rc = self.store.get(ObjectStore.RESOURCE_CLAIMS, name)
+                if rc is None or rc.allocation is None:
+                    continue  # pending collapse: deviceallocation stamps it
+                if pod.uid not in rc.reserved_for:
+                    rc.reserved_for.append(pod.uid)
+                    self.store.update(ObjectStore.RESOURCE_CLAIMS, rc)
+
+    def _register_device_allocations(self, dra_round, sim: SimClaim, claim: NodeClaim) -> None:
+        """Hand the winning round's per-claim allocation metadata to the
+        deviceallocation controller, keyed to the real NodeClaim (the
+        simulation knows it only by placeholder hostname)."""
+        from karpenter_tpu.controllers.device_allocation import PendingAllocation
+
+        for claim_key, meta in dra_round.allocator.claim_allocation_metadata.items():
+            if meta.nodeclaim_id != sim.hostname:
+                continue
+            claim_name = claim_key.split("/", 1)[1]
+            pod_uids = [p.uid for p in sim.pods if claim_name in p.spec.resource_claims]
+            self.device_allocation.register(
+                PendingAllocation(
+                    claim_name=claim_name,
+                    nodeclaim_name=claim.name,
+                    node_name="",
+                    metadata=meta,
+                    pod_uids=pod_uids,
+                    it_slices={
+                        it.name: list(getattr(it, "dra_slices", []) or [])
+                        for it in sim.instance_types
+                    },
+                )
+            )
+
+    def _register_existing_device_allocations(self, result: SchedulingResult) -> None:
+        """Claims allocated against existing nodes collapse immediately —
+        the node and its published devices already exist."""
+        from karpenter_tpu.controllers.device_allocation import PendingAllocation
+
+        nodes_by_name = {n.name: n for n in result.existing}
+        for claim_key, meta in result.dra.allocator.claim_allocation_metadata.items():
+            node = nodes_by_name.get(meta.nodeclaim_id)
+            if node is None:
+                continue
+            claim_name = claim_key.split("/", 1)[1]
+            pod_uids = [p.uid for p in node.pods if claim_name in p.spec.resource_claims]
+            self.device_allocation.register(
+                PendingAllocation(
+                    claim_name=claim_name,
+                    nodeclaim_name="",
+                    node_name=meta.nodeclaim_id,
+                    metadata=meta,
+                    pod_uids=pod_uids,
+                )
+            )
 
     def _to_node_claim(self, sim: SimClaim) -> NodeClaim:
         tmpl = sim.template
@@ -399,6 +498,7 @@ class Provisioner:
                 volume_reqs=self._volume_requirements(pods),
                 reserved_mode="strict",
                 reserved_in_use=self._reserved_in_use(),
+                dra_problem=self._build_dra_problem(pods),
             )
         metrics.SCHEDULING_UNSCHEDULABLE.set(float(len(result.unschedulable)))
         self.create_node_claims(result)

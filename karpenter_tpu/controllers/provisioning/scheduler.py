@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from karpenter_tpu.cloudprovider.instancetype import InstanceType
 from karpenter_tpu.controllers.provisioning.host_scheduler import (
     ExistingSimNode,
+    HostScheduler,
     SchedulingResult,
     SimClaim,
     ffd_sort,
@@ -247,6 +248,7 @@ class TPUScheduler:
         volume_reqs: Optional[dict] = None,
         reserved_mode: Optional[str] = None,
         reserved_in_use: Optional[dict[str, int]] = None,
+        dra_problem=None,
     ) -> SchedulingResult:
         """Solve with the preference relaxation ladder (preferences.go:38):
         each failing pod sheds ONE preference per round (shared loop in
@@ -261,6 +263,27 @@ class TPUScheduler:
         import copy as _copy
 
         from karpenter_tpu.controllers.provisioning import preferences as prefs
+
+        if dra_problem is not None and any(p.spec.resource_claims for p in pods):
+            # DRA pods need the device-allocation DFS — deep, data-dependent
+            # control flow with per-claim state that has no scan-friendly
+            # shape. The host oracle is authoritative for these solves; the
+            # device kernel keeps handling the claim-free hot path.
+            host = HostScheduler(
+                self.templates,
+                existing_nodes=list(existing_nodes or []),
+                budgets=budgets,
+                topology=(
+                    topology_factory(list(pods)) if topology_factory is not None else topology
+                ),
+                volume_reqs=volume_reqs,
+                reserved_mode=reserved_mode if reserved_mode is not None else self.reserved_mode,
+                reserved_capacity_enabled=self.reserved_capacity_enabled,
+                min_values_policy=self.min_values_policy,
+                reserved_in_use=reserved_in_use,
+                dra_problem=dra_problem,
+            )
+            return host.solve(list(pods))
 
         base_existing = list(existing_nodes or [])
         self._volume_reqs = volume_reqs or {}

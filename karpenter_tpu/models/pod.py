@@ -75,6 +75,8 @@ class PodSpec:
     priority: int = 0
     pvc_names: list[str] = field(default_factory=list)
     restart_policy: str = "Always"
+    # Names of ResourceClaims (DRA) this pod consumes (pod.spec.resourceClaims)
+    resource_claims: list[str] = field(default_factory=list)
 
 
 @dataclass

@@ -657,9 +657,14 @@ class _Search:
         for pool in self.pools:
             if pool.incomplete:
                 continue
-            exhausted = self._pool_counters_exhausted(pool)
+            exhausted = self._exhausted_counters(pool)
             for dw in pool.devices:
-                if exhausted and dw.device.consumes_counters:
+                if exhausted and any(
+                    (cc.counter_set, name) in exhausted
+                    for cc in dw.device.consumes_counters
+                    for name, value in cc.counters.items()
+                    if value > 0
+                ):
                     continue
                 if self._try_device(claim_idx, req_idx, sub_req_idx, slot_idx, cd, rd, dw):
                     return True
@@ -826,22 +831,27 @@ class _Search:
 
     # -- shared counters ---------------------------------------------------
 
-    def _pool_counters_exhausted(self, pool: Pool) -> bool:
-        """partitionable_devices.go poolCountersExhausted."""
+    def _exhausted_counters(self, pool: Pool) -> set[tuple[str, str]]:
+        """(counterSet, counter) pairs with no budget left after DFS-local
+        tentative draws. A fast-path prune refining the reference's
+        pool-level poolCountersExhausted, which skips EVERY counter-consuming
+        device once ANY pool counter hits zero — over-pruning devices that
+        draw only on untouched sets."""
         if not pool.counter_sets:
-            return False
+            return set()
         remaining = self.tracker.remaining_counters.get(pool.key)
         allocating = self.allocating_counters.get(pool.key)
         if remaining is None or allocating is None:
-            return False
+            return set()
+        out: set[tuple[str, str]] = set()
         for cs_name, counters in allocating.items():
             cs_remaining = remaining.get(cs_name)
             if cs_remaining is None:
                 continue
             for name, alloc_value in counters.items():
                 if name in cs_remaining and cs_remaining[name] - alloc_value <= 0:
-                    return True
-        return False
+                    out.add((cs_name, name))
+        return out
 
     def _check_counters(
         self,

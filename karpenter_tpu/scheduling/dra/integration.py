@@ -1,0 +1,251 @@
+"""DRA ↔ scheduler integration.
+
+Counterpart of the allocator call sites in the reference scheduler
+(scheduling/scheduler.go:139,253-258,571-589 resolvePodClaims,
+nodeclaim.go:124-283 CanAdd/Add, existingnode.go:81). A DRAProblem is built
+once per provisioning loop from store state (slices, device classes,
+claims, committed allocations, deleting pods); each preference-relaxation
+round gets a fresh Allocator via fresh_round() because rounds restart the
+simulation from scratch.
+
+DRA pods route through the host engine: the allocation DFS is deep,
+data-dependent, and bounded-small (AllocationResultsMaxSize per claim) —
+the structural opposite of the scan-friendly packing loop that runs on the
+TPU — so TPUScheduler.solve delegates whole solves containing DRA pods to
+its host-oracle twin, keeping the device kernel free of ragged control
+flow. The gate is off by default, like the reference's feature flag.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from karpenter_tpu.scheduling.dra.allocator import (
+    AllocationResult,
+    Allocator,
+    DRAError,
+    DRANodeClaim,
+)
+from karpenter_tpu.scheduling.dra.constraints import AttributeBindingDecl, AttributeBindings
+from karpenter_tpu.scheduling.dra.tracker import AllocatedDeviceState
+from karpenter_tpu.scheduling.dra.types import (
+    DeviceClass,
+    DeviceID,
+    ResourceClaim,
+    ResourceSlice,
+)
+from karpenter_tpu.scheduling.requirements import Requirements
+
+
+def gather_allocated_state(
+    claims: list[ResourceClaim],
+    slices: list[ResourceSlice],
+    deleting_pod_uids: set[str],
+) -> AllocatedDeviceState:
+    """Seed the tracker from committed claim allocations (the reference's
+    gatherAllocatedDevices): exclusive devices vs aggregated consumed
+    capacity for multi-alloc devices. A claim reserved entirely by deleting
+    pods is freed from the seed so the DFS re-allocates it onto replacement
+    capacity (allocator.go:62-66)."""
+    multi_alloc: set[DeviceID] = set()
+    for s in slices:
+        for d in s.devices:
+            if d.allow_multiple_allocations:
+                multi_alloc.add(DeviceID(s.driver, s.pool, d.name))
+    state = AllocatedDeviceState()
+    for claim in claims:
+        if claim.allocation is None:
+            continue
+        if claim.reserved_for and all(uid in deleting_pod_uids for uid in claim.reserved_for):
+            continue  # migrating: device freed, claim re-runs the DFS
+        for dev in claim.allocation.devices:
+            device_id = DeviceID(dev.driver, dev.pool, dev.device)
+            if device_id in multi_alloc or dev.consumed_capacity:
+                dims = state.consumed_capacity.setdefault(device_id, {})
+                for name, qty in (dev.consumed_capacity or {}).items():
+                    dims[name] = dims.get(name, 0.0) + qty
+            else:
+                state.exclusive_devices.add(device_id)
+    return state
+
+
+def build_attribute_bindings(
+    catalogs_by_pool: dict[str, list],
+) -> AttributeBindings:
+    """Fold the catalog's per-IT binding declarations into the transitive
+    graph (attributebindings.go:93-135). catalogs_by_pool maps nodepool name
+    to its InstanceType list."""
+    decls: dict[tuple[str, str], list[AttributeBindingDecl]] = {}
+    for nodepool, catalog in catalogs_by_pool.items():
+        for it in catalog:
+            if getattr(it, "dra_attribute_bindings", None):
+                decls[(nodepool, it.name)] = list(it.dra_attribute_bindings)
+    return AttributeBindings.build(decls)
+
+
+@dataclass
+class DRAProblem:
+    """Per-scheduling-loop DRA inputs, shared across relaxation rounds."""
+
+    in_cluster_slices: list[ResourceSlice] = field(default_factory=list)
+    device_classes: dict[str, DeviceClass] = field(default_factory=dict)
+    claims_by_pod: dict[str, list[ResourceClaim]] = field(default_factory=dict)
+    errors_by_pod: dict[str, str] = field(default_factory=dict)
+    allocated_state: AllocatedDeviceState = field(default_factory=AllocatedDeviceState)
+    attribute_bindings: AttributeBindings = field(default_factory=AttributeBindings)
+    deleting_pod_uids: set[str] = field(default_factory=set)
+
+    @staticmethod
+    def build(store, pods, catalogs_by_pool: dict[str, list]) -> Optional["DRAProblem"]:
+        """Resolve pod claim references against the store
+        (scheduler.go:571-589 resolvePodClaims); None when no pod uses DRA.
+        Pods whose claims can't be resolved are flagged — no candidate can
+        accept them this loop."""
+        from karpenter_tpu.state.store import ObjectStore
+
+        problem = DRAProblem(
+            in_cluster_slices=[
+                s for s in store.list(ObjectStore.RESOURCE_SLICES) if not s.potential
+            ],
+            device_classes={c.name: c for c in store.list(ObjectStore.DEVICE_CLASSES)},
+            attribute_bindings=build_attribute_bindings(catalogs_by_pool),
+        )
+        any_dra = False
+        for pod in pods:
+            names = pod.spec.resource_claims
+            if not names:
+                continue
+            any_dra = True
+            resolved = []
+            for name in names:
+                rc = store.get(ObjectStore.RESOURCE_CLAIMS, name)
+                if rc is None:
+                    problem.errors_by_pod[pod.uid] = f"ResourceClaim {name!r} not found"
+                    break
+                resolved.append(rc)
+            else:
+                problem.claims_by_pod[pod.uid] = resolved
+        if not any_dra:
+            return None
+        # Pods migrating off deleting nodes free their claims' devices.
+        deleting_nodes = {
+            n.metadata.name for n in store.nodes() if getattr(n.metadata, "deletion_timestamp", None)
+        }
+        problem.deleting_pod_uids = {
+            p.uid
+            for p in store.pods()
+            if getattr(p.metadata, "deletion_timestamp", None) or p.spec.node_name in deleting_nodes
+        }
+        problem.allocated_state = gather_allocated_state(
+            store.list(ObjectStore.RESOURCE_CLAIMS),
+            problem.in_cluster_slices,
+            problem.deleting_pod_uids,
+        )
+        return problem
+
+    def fresh_round(self) -> "DRARound":
+        return DRARound(
+            problem=self,
+            allocator=Allocator(
+                in_cluster_slices=self.in_cluster_slices,
+                allocated_state=AllocatedDeviceState(
+                    exclusive_devices=set(self.allocated_state.exclusive_devices),
+                    consumed_capacity={
+                        k: dict(v) for k, v in self.allocated_state.consumed_capacity.items()
+                    },
+                ),
+                device_classes=self.device_classes,
+                attribute_bindings=self.attribute_bindings,
+                deleting_pod_uids=self.deleting_pod_uids,
+            ),
+        )
+
+
+@dataclass
+class DRARound:
+    """One relaxation round's allocator plus the call-site helpers the host
+    scheduler uses (the nodeclaim.go:164-283 seam)."""
+
+    problem: DRAProblem
+    allocator: Allocator
+
+    def pod_claims(self, pod) -> Optional[list[ResourceClaim]]:
+        """The pod's resolved claims; None when the pod doesn't use DRA."""
+        if not pod.spec.resource_claims:
+            return None
+        return self.problem.claims_by_pod.get(pod.uid)
+
+    def pod_error(self, pod) -> Optional[str]:
+        return self.problem.errors_by_pod.get(pod.uid)
+
+    def try_allocate(
+        self,
+        pod,
+        nodeclaim_id: str,
+        nodepool: str,
+        requirements: Requirements,
+        instance_types: list,
+        node_name: str = "",
+    ) -> Optional[AllocationResult]:
+        """Simulate allocation for a candidate (nodeclaim.go:179-192);
+        None when no instance type can satisfy the pod's claims there."""
+        claims = self.pod_claims(pod)
+        if claims is None:
+            return AllocationResult(
+                instance_types=[it.name for it in instance_types], requirements=Requirements()
+            )
+        resource_slices = {
+            it.name: list(getattr(it, "dra_slices", []) or []) for it in instance_types
+        }
+        adapter = DRANodeClaim(
+            id=nodeclaim_id,
+            nodepool=nodepool,
+            requirements=requirements,
+            instance_types=[it.name for it in instance_types],
+            resource_slices=resource_slices,
+            node_name=node_name,
+        )
+        try:
+            return self.allocator.allocate(adapter, claims)
+        except DRAError:
+            return None
+
+    def try_allocate_existing(
+        self,
+        pod,
+        node_name: str,
+        requirements: Requirements,
+    ) -> Optional[AllocationResult]:
+        """Existing-node variant (existingnode.go:81): the node has one
+        collapsed instance type and no template slices — only published
+        (in-cluster) devices are reachable."""
+        claims = self.pod_claims(pod)
+        if claims is None:
+            return AllocationResult(instance_types=[], requirements=Requirements())
+        from karpenter_tpu.models import labels as l
+
+        it_req = requirements.get(l.LABEL_INSTANCE_TYPE)
+        it_name = it_req.any_value() if it_req is not None else ""
+        pool_req = requirements.get(l.NODEPOOL_LABEL_KEY)
+        adapter = DRANodeClaim(
+            id=node_name,
+            nodepool=pool_req.any_value() if pool_req is not None else "",
+            requirements=requirements,
+            instance_types=[it_name or "existing"],
+            resource_slices={},
+            node_name=node_name,
+        )
+        try:
+            return self.allocator.allocate(adapter, claims)
+        except DRAError:
+            return None
+
+    def commit(self, result: AllocationResult, nodeclaim_id: str, final_it_names: set[str]) -> None:
+        """Commit a finalized placement and release ITs the downstream
+        filters pruned from the allocator's surviving set
+        (nodeclaim.go:265-283)."""
+        result.commit()
+        pruned = [it for it in result.instance_types if it not in final_it_names]
+        if pruned:
+            self.allocator.release_instance_types(nodeclaim_id, *pruned)

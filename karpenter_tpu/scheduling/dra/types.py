@@ -148,6 +148,13 @@ class ResourceSlice:
     all_nodes: bool = False
     shared_counters: Optional[list[CounterSet]] = None
     potential: bool = False
+    metadata: object = None  # ObjectMeta when persisted in the ObjectStore
+
+    def __post_init__(self) -> None:
+        if self.metadata is None:
+            from karpenter_tpu.models.objects import ObjectMeta
+
+            self.metadata = ObjectMeta(name=f"{self.driver}-{self.pool}")
 
 
 @dataclass
@@ -157,6 +164,13 @@ class DeviceClass:
 
     name: str
     selectors: list[str] = field(default_factory=list)
+    metadata: object = None
+
+    def __post_init__(self) -> None:
+        if self.metadata is None:
+            from karpenter_tpu.models.objects import ObjectMeta
+
+            self.metadata = ObjectMeta(name=self.name)
 
 
 @dataclass
@@ -226,6 +240,13 @@ class ResourceClaim:
     constraints: list[MatchConstraintSpec] = field(default_factory=list)
     allocation: Optional[DeviceClaimStatus] = None
     reserved_for: list[str] = field(default_factory=list)  # pod UIDs
+    metadata: object = None
+
+    def __post_init__(self) -> None:
+        if self.metadata is None:
+            from karpenter_tpu.models.objects import ObjectMeta
+
+            self.metadata = ObjectMeta(name=self.name)
 
     @property
     def key(self) -> str:

@@ -137,6 +137,9 @@ class ObjectStore:
     PDBS = "poddisruptionbudgets"
     PVCS = "persistentvolumeclaims"
     STORAGE_CLASSES = "storageclasses"
+    RESOURCE_CLAIMS = "resourceclaims"
+    RESOURCE_SLICES = "resourceslices"
+    DEVICE_CLASSES = "deviceclasses"
 
     def pods(self) -> list:
         return self.list(self.PODS)

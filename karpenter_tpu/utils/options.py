@@ -20,6 +20,7 @@ class FeatureGates:
     node_overlay: bool = False
     static_capacity: bool = True
     capacity_buffer: bool = False
+    dynamic_resources: bool = False
 
     @staticmethod
     def parse(csv: str) -> "FeatureGates":
@@ -32,6 +33,7 @@ class FeatureGates:
             "NodeOverlay": "node_overlay",
             "StaticCapacity": "static_capacity",
             "CapacityBuffer": "capacity_buffer",
+            "DynamicResources": "dynamic_resources",
         }
         for part in csv.split(","):
             part = part.strip()

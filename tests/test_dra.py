@@ -470,6 +470,38 @@ class TestPartitionableDevices:
         r2 = a.allocate(nodeclaim(id="nc-2"), [claim("c2", req())])
         assert r2.instance_types == ["it-a"]
 
+    def test_independent_counter_sets_not_over_pruned(self):
+        # Exhausting counter set A must not prune devices that draw only on
+        # set B (a refinement over the reference's pool-level prune).
+        slices = [
+            ResourceSlice(
+                driver="d",
+                pool="p",
+                generation=1,
+                resource_slice_count=2,
+                shared_counters=[
+                    CounterSet(name="A", counters={"x": 40.0}),
+                    CounterSet(name="B", counters={"x": 40.0}),
+                ],
+            ),
+            ResourceSlice(
+                driver="d",
+                pool="p",
+                generation=1,
+                resource_slice_count=2,
+                all_nodes=True,
+                devices=[
+                    Device(name="a-full", consumes_counters=[CounterConsumption("A", {"x": 40.0})]),
+                    Device(name="b-full", consumes_counters=[CounterConsumption("B", {"x": 40.0})]),
+                ],
+            ),
+        ]
+        a = Allocator(slices)
+        r = a.allocate(nodeclaim(), [claim("c", req(count=2))])
+        r.commit()
+        chosen = {d.device_id.device for d in a.metadata_for_claim("default/c").devices["it-a"]}
+        assert chosen == {"a-full", "b-full"}
+
     def test_pessimistic_max_across_its(self):
         # nc-1 allocates one 20 partition under each of it-a and it-b; the
         # budget charge is the pessimistic max (20), not the sum (40).

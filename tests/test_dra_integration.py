@@ -1,0 +1,259 @@
+"""DRA scheduler/controller integration tests.
+
+Behavioral ports of the reference's DRA scheduling wiring
+(scheduling/scheduler.go resolvePodClaims, nodeclaim.go:179-283 CanAdd/Add,
+existingnode.go:81, the deviceallocation controller, and the
+dra-kwok-driver harness): instance-type pruning by allocation survival,
+claim status writes at launch collapse, node-local slice publication,
+claim sharing pinning pods to the allocated node, and device contention
+producing unschedulable pods.
+"""
+
+import pytest
+
+from karpenter_tpu.cloudprovider.fake import new_instance_type
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.controllers.manager import KubeSchedulerSim, Manager
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import make_pod
+from karpenter_tpu.scheduling.dra import (
+    Device,
+    DeviceClass,
+    DeviceRequest,
+    ResourceClaim,
+    ResourceSlice,
+)
+from karpenter_tpu.state.store import ObjectStore
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.options import Options
+
+
+def tpu_slice_template():
+    """A 4-device accelerator template, as a cloud provider would declare
+    for an accelerator instance type."""
+    return ResourceSlice(
+        driver="tpu.dra.x-k8s.io",
+        pool="accel",
+        potential=True,
+        devices=[Device(name=f"chip{i}", attributes={"kind": "tpu"}) for i in range(4)],
+    )
+
+
+def dra_catalog():
+    small = new_instance_type("small-4x", cpu=4)
+    accel = new_instance_type("accel-8x", cpu=8)
+    accel.dra_slices = [tpu_slice_template()]
+    return [small, accel]
+
+
+def dra_options():
+    opts = Options()
+    opts.feature_gates.dynamic_resources = True
+    return opts
+
+
+def make_harness(catalog=None, options=None):
+    clock = FakeClock()
+    store = ObjectStore(clock)
+    cloud = KwokCloudProvider(store, catalog=catalog if catalog is not None else dra_catalog())
+    mgr = Manager(store, cloud, clock, options=options or dra_options())
+    store.create(ObjectStore.NODEPOOLS, NodePool())
+    store.create(
+        ObjectStore.DEVICE_CLASSES,
+        DeviceClass(name="tpu", selectors=['device.attributes["kind"] == "tpu"']),
+    )
+    return clock, store, cloud, mgr
+
+
+def settle(mgr, cloud, store):
+    mgr.run_until_idle()
+    cloud.simulate_kubelet_ready()
+    mgr.run_until_idle()
+    KubeSchedulerSim(store, mgr.cluster).bind_pending()
+    mgr.run_until_idle()
+
+
+class TestDRAProvisioning:
+    def test_template_claim_end_to_end(self):
+        clock, store, cloud, mgr = make_harness()
+        store.create(
+            ObjectStore.RESOURCE_CLAIMS,
+            ResourceClaim(name="train", requests=[DeviceRequest(name="r0", device_class="tpu", count=2)]),
+        )
+        pod = make_pod("worker", cpu=1.0, resource_claims=["train"])
+        store.create(ObjectStore.PODS, pod)
+        settle(mgr, cloud, store)
+
+        # The pod landed on a node of the accelerator type.
+        pod = store.get(ObjectStore.PODS, "worker")
+        assert pod.spec.node_name
+        node = store.get(ObjectStore.NODES, pod.spec.node_name)
+        assert node.metadata.labels[l.LABEL_INSTANCE_TYPE] == "accel-8x"
+
+        # The claim collapsed: status allocation written, node-pinned.
+        rc = store.get(ObjectStore.RESOURCE_CLAIMS, "train")
+        assert rc.allocation is not None
+        assert len(rc.allocation.devices) == 2
+        assert rc.allocation.devices[0].driver == "tpu.dra.x-k8s.io"
+        hostname_req = rc.allocation.node_selector_terms[0].get(l.LABEL_HOSTNAME)
+        assert hostname_req.has(pod.spec.node_name)
+        assert rc.reserved_for == [pod.uid]
+
+        # The driver published the node-local slice (node-scoped pool).
+        slices = store.list(ObjectStore.RESOURCE_SLICES)
+        assert len(slices) == 1
+        assert slices[0].node_name == pod.spec.node_name
+        assert slices[0].pool == f"accel-{pod.spec.node_name}"
+        assert rc.allocation.devices[0].pool == slices[0].pool
+
+    def test_allocation_prunes_instance_types(self):
+        clock, store, cloud, mgr = make_harness()
+        store.create(
+            ObjectStore.RESOURCE_CLAIMS,
+            ResourceClaim(name="c", requests=[DeviceRequest(name="r0", device_class="tpu")]),
+        )
+        store.create(ObjectStore.PODS, make_pod("p", cpu=1.0, resource_claims=["c"]))
+        mgr.run_until_idle()
+        claims = store.nodeclaims()
+        assert len(claims) == 1
+        it_req = next(
+            r for r in claims[0].spec.requirements if r["key"] == l.LABEL_INSTANCE_TYPE
+        )
+        # small-4x survived resource filtering but not device allocation.
+        assert it_req["values"] == ["accel-8x"]
+
+    def test_missing_claim_blocks_pod(self):
+        clock, store, cloud, mgr = make_harness()
+        store.create(ObjectStore.PODS, make_pod("p", cpu=1.0, resource_claims=["nope"]))
+        mgr.run_until_idle()
+        assert store.nodeclaims() == []
+
+    def test_gate_off_ignores_claims(self):
+        opts = Options()  # DynamicResources defaults off, like the reference
+        clock, store, cloud, mgr = make_harness(options=opts)
+        store.create(
+            ObjectStore.RESOURCE_CLAIMS,
+            ResourceClaim(name="c", requests=[DeviceRequest(name="r0", device_class="tpu")]),
+        )
+        store.create(ObjectStore.PODS, make_pod("p", cpu=1.0, resource_claims=["c"]))
+        mgr.run_until_idle()
+        claims = store.nodeclaims()
+        assert len(claims) == 1
+        it_req = next(
+            r for r in claims[0].spec.requirements if r["key"] == l.LABEL_INSTANCE_TYPE
+        )
+        # claims ignored: the cheaper non-accelerator type wins
+        assert "small-4x" in it_req["values"]
+
+    def test_shared_claim_pins_second_pod_to_same_node(self):
+        clock, store, cloud, mgr = make_harness()
+        store.create(
+            ObjectStore.RESOURCE_CLAIMS,
+            ResourceClaim(name="shared", requests=[DeviceRequest(name="r0", device_class="tpu")]),
+        )
+        store.create(ObjectStore.PODS, make_pod("p1", cpu=1.0, resource_claims=["shared"]))
+        settle(mgr, cloud, store)
+        p1 = store.get(ObjectStore.PODS, "p1")
+        assert p1.spec.node_name
+
+        store.create(ObjectStore.PODS, make_pod("p2", cpu=1.0, resource_claims=["shared"]))
+        settle(mgr, cloud, store)
+        p2 = store.get(ObjectStore.PODS, "p2")
+        assert p2.spec.node_name == p1.spec.node_name
+        assert len(store.nodes()) == 1
+        rc = store.get(ObjectStore.RESOURCE_CLAIMS, "shared")
+        assert p1.uid in rc.reserved_for and p2.uid in rc.reserved_for
+
+    def test_in_cluster_device_contention(self):
+        clock, store, cloud, mgr = make_harness()
+        # One published single-device pool reachable from any node.
+        store.create(
+            ObjectStore.RESOURCE_SLICES,
+            ResourceSlice(
+                driver="fpga.dra.x-k8s.io",
+                pool="shared-pool",
+                all_nodes=True,
+                devices=[Device(name="only", attributes={"kind": "fpga"})],
+            ),
+        )
+        store.create(
+            ObjectStore.DEVICE_CLASSES,
+            DeviceClass(name="fpga", selectors=['device.attributes["kind"] == "fpga"']),
+        )
+        for i in (1, 2):
+            store.create(
+                ObjectStore.RESOURCE_CLAIMS,
+                ResourceClaim(name=f"c{i}", requests=[DeviceRequest(name="r0", device_class="fpga")]),
+            )
+            store.create(ObjectStore.PODS, make_pod(f"p{i}", cpu=1.0, resource_claims=[f"c{i}"]))
+        settle(mgr, cloud, store)
+        bound = [p for p in store.pods() if p.spec.node_name]
+        assert len(bound) == 1
+        # The winning claim holds the device in its committed status.
+        winner = bound[0].spec.resource_claims[0]
+        rc = store.get(ObjectStore.RESOURCE_CLAIMS, winner)
+        assert rc.allocation is not None
+        assert rc.allocation.devices[0].device == "only"
+
+    def test_two_pods_two_claims_share_template_node(self):
+        # Two pods with separate claims, each wanting 2 of the 4 template
+        # chips: both fit one accelerator node.
+        clock, store, cloud, mgr = make_harness()
+        for i in (1, 2):
+            store.create(
+                ObjectStore.RESOURCE_CLAIMS,
+                ResourceClaim(
+                    name=f"c{i}",
+                    requests=[DeviceRequest(name="r0", device_class="tpu", count=2)],
+                ),
+            )
+            store.create(ObjectStore.PODS, make_pod(f"p{i}", cpu=1.0, resource_claims=[f"c{i}"]))
+        settle(mgr, cloud, store)
+        bound = [p for p in store.pods() if p.spec.node_name]
+        assert len(bound) == 2
+        assert len(store.nodes()) == 1
+        c1 = store.get(ObjectStore.RESOURCE_CLAIMS, "c1")
+        c2 = store.get(ObjectStore.RESOURCE_CLAIMS, "c2")
+        used = {d.device for d in c1.allocation.devices} | {d.device for d in c2.allocation.devices}
+        assert len(used) == 4  # disjoint chips
+
+    def test_node_deletion_withdraws_published_slices(self):
+        # Counter-set slices carry no node pin but must be withdrawn with
+        # the node, or the pool stays permanently incomplete.
+        from karpenter_tpu.scheduling.dra import CounterConsumption, CounterSet
+
+        catalog = dra_catalog()
+        accel = catalog[1]
+        accel.dra_slices[0].shared_counters = [CounterSet(name="hbm", counters={"gb": 64.0})]
+        for d in accel.dra_slices[0].devices:
+            d.consumes_counters = [CounterConsumption("hbm", {"gb": 16.0})]
+        clock, store, cloud, mgr = make_harness(catalog=catalog)
+        store.create(
+            ObjectStore.RESOURCE_CLAIMS,
+            ResourceClaim(name="c", requests=[DeviceRequest(name="r0", device_class="tpu")]),
+        )
+        store.create(ObjectStore.PODS, make_pod("p", cpu=1.0, resource_claims=["c"]))
+        settle(mgr, cloud, store)
+        published = store.list(ObjectStore.RESOURCE_SLICES)
+        assert len(published) == 2  # device slice + counter-set slice
+        node_name = store.get(ObjectStore.PODS, "p").spec.node_name
+        store.delete(ObjectStore.NODES, node_name)
+        assert store.list(ObjectStore.RESOURCE_SLICES) == []
+
+    def test_template_capacity_forces_second_node(self):
+        # Three claims x 2 chips > 4 chips per node: a second node launches.
+        clock, store, cloud, mgr = make_harness()
+        for i in (1, 2, 3):
+            store.create(
+                ObjectStore.RESOURCE_CLAIMS,
+                ResourceClaim(
+                    name=f"c{i}",
+                    requests=[DeviceRequest(name="r0", device_class="tpu", count=2)],
+                ),
+            )
+            store.create(ObjectStore.PODS, make_pod(f"p{i}", cpu=1.0, resource_claims=[f"c{i}"]))
+        settle(mgr, cloud, store)
+        bound = [p for p in store.pods() if p.spec.node_name]
+        assert len(bound) == 3
+        assert len(store.nodes()) == 2
