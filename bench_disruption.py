@@ -1,0 +1,113 @@
+"""Disruption what-if benchmark: batched vs sequential candidate evaluation.
+
+The tensorized twin of the reference's per-candidate SimulateScheduling
+loop (multinodeconsolidation.go:136-183, singlenodeconsolidation.go:33-146):
+N single-candidate scenarios evaluated as ONE vmapped device dispatch
+(TPUScheduler.whatif_batch) against N sequential full re-solves
+(Provisioner.simulate). Differential parity between the two paths is pinned
+by tests/test_whatif.py; this measures the wall-clock win.
+
+Prints ONE JSON line:
+  {"metric": "whatif_batch_speedup", "value": <x faster>, "unit": "x",
+   "vs_baseline": <same>, "detail": {...}}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+N_CANDIDATES = 100
+SEQUENTIAL_SAMPLE = 10  # full sequential sweep extrapolated from a sample
+
+
+def build_cluster(n_nodes: int):
+    from karpenter_tpu.cloudprovider.fake import new_instance_type
+    from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+    from karpenter_tpu.controllers.manager import KubeSchedulerSim, Manager
+    from karpenter_tpu.models import labels as l
+    from karpenter_tpu.models.nodepool import NodePool
+    from karpenter_tpu.models.pod import make_pod
+    from karpenter_tpu.state.store import ObjectStore
+    from karpenter_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    store = ObjectStore(clock)
+    catalog = [new_instance_type("n-4x", cpu=4), new_instance_type("n-8x", cpu=8)]
+    cloud = KwokCloudProvider(store, catalog=catalog)
+    mgr = Manager(store, cloud, clock)
+    store.create(ObjectStore.NODEPOOLS, NodePool())
+    for i in range(n_nodes):
+        store.create(
+            ObjectStore.PODS,
+            make_pod(f"p{i}", cpu=2.0, node_selector={l.LABEL_INSTANCE_TYPE: "n-4x"}),
+        )
+    mgr.run_until_idle()
+    cloud.simulate_kubelet_ready()
+    mgr.run_until_idle()
+    KubeSchedulerSim(store, mgr.cluster).bind_pending()
+    mgr.run_until_idle()
+    return store, mgr
+
+
+class _Candidate:
+    def __init__(self, name, pods):
+        self.name = name
+        self.reschedulable_pods = pods
+
+
+def main() -> None:
+    from karpenter_tpu.utils import accel
+
+    platform = "tpu" if accel.accelerator_usable() else "cpu"
+    if platform == "cpu":
+        accel.force_cpu()
+
+    store, mgr = build_cluster(N_CANDIDATES)
+    by_node: dict[str, list] = {}
+    for p in store.pods():
+        if p.spec.node_name:
+            by_node.setdefault(p.spec.node_name, []).append(p)
+    candidates = [_Candidate(name, pods) for name, pods in sorted(by_node.items())]
+    scenarios = [[c] for c in candidates]
+    prov = mgr.provisioner
+
+    # warm both paths (compile cache) before timing
+    warm = prov.simulate_batch(scenarios)
+    assert warm is not None, "batch path gated"
+    prov.simulate({candidates[0].name}, candidates[0].reschedulable_pods)
+
+    t0 = time.perf_counter()
+    signals = prov.simulate_batch(scenarios)
+    t_batch = time.perf_counter() - t0
+    assert signals is not None and len(signals) == len(scenarios)
+
+    t0 = time.perf_counter()
+    for c in candidates[:SEQUENTIAL_SAMPLE]:
+        prov.simulate({c.name}, c.reschedulable_pods)
+    t_seq_sample = time.perf_counter() - t0
+    t_seq = t_seq_sample * (len(candidates) / SEQUENTIAL_SAMPLE)
+
+    speedup = t_seq / t_batch if t_batch > 0 else float("inf")
+    print(
+        json.dumps(
+            {
+                "metric": "whatif_batch_speedup",
+                "value": round(speedup, 2),
+                "unit": "x",
+                "vs_baseline": round(speedup, 2),
+                "detail": {
+                    "candidates": len(candidates),
+                    "batch_s": round(t_batch, 3),
+                    "sequential_s_extrapolated": round(t_seq, 3),
+                    "sequential_sample": SEQUENTIAL_SAMPLE,
+                    "platform": platform,
+                    "feasible": sum(1 for ok, _ in signals if ok),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

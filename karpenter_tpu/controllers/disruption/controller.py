@@ -52,8 +52,12 @@ class DisruptionController:
         self.methods = [
             Emptiness(clock),
             Drift(self._simulate),
-            MultiNodeConsolidation(self._simulate, clock, spot_to_spot_enabled),
-            SingleNodeConsolidation(self._simulate, clock, spot_to_spot_enabled),
+            MultiNodeConsolidation(
+                self._simulate, clock, spot_to_spot_enabled, simulate_batch=self._simulate_batch
+            ),
+            SingleNodeConsolidation(
+                self._simulate, clock, spot_to_spot_enabled, simulate_batch=self._simulate_batch
+            ),
         ]
 
     # -- simulation hook ------------------------------------------------------
@@ -70,6 +74,14 @@ class DisruptionController:
         extra_uids = {p.uid for p in extra}
         unscheduled = {p.uid for p, _ in result.unschedulable} & extra_uids
         return result, unscheduled
+
+    def _simulate_batch(self, scenarios: list[list[Candidate]]):
+        """Batched what-if prefilter: one device dispatch for all candidate
+        sets (see Provisioner.simulate_batch); None when unsupported."""
+        batch = getattr(self.provisioner, "simulate_batch", None)
+        if batch is None:
+            return None
+        return batch(scenarios)
 
     # -- the loop (controller.go:128-196) --------------------------------------
 

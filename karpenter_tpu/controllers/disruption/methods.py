@@ -134,10 +134,21 @@ class Drift:
 class _ConsolidationBase:
     reason = REASON_UNDERUTILIZED
 
-    def __init__(self, simulate: SimulateFn, clock, spot_to_spot_enabled: bool = False):
+    def __init__(
+        self,
+        simulate: SimulateFn,
+        clock,
+        spot_to_spot_enabled: bool = False,
+        simulate_batch=None,
+    ):
         self.simulate = simulate
         self.clock = clock
         self.spot_to_spot_enabled = spot_to_spot_enabled
+        # Batched what-if prefilter (one vmapped device dispatch for all
+        # candidate sets); None falls back to sequential simulation. The
+        # batch over-approximates feasibility, so every chosen scenario is
+        # confirmed with the sequential simulate before acting.
+        self.simulate_batch = simulate_batch
 
     def eligible(self, candidates: list[Candidate]) -> list[Candidate]:
         return [
@@ -226,12 +237,22 @@ class _ConsolidationBase:
 
 class SingleNodeConsolidation(_ConsolidationBase):
     """Per-candidate simulation, cheapest-savings first
-    (singlenodeconsolidation.go:33-146)."""
+    (singlenodeconsolidation.go:33-146). With the batched prefilter, every
+    candidate's what-if runs as one device dispatch and only batch-feasible
+    candidates pay a sequential confirmation."""
 
     def compute(self, candidates: list[Candidate], budgets: dict[str, int]) -> Command:
         eligible = _within_budget(
             sorted(self.eligible(candidates), key=lambda c: c.savings_ratio), budgets
         )
+        if len(eligible) > 1 and self.simulate_batch is not None:
+            signals = self.simulate_batch([[c] for c in eligible])
+            if signals is not None:
+                eligible = [
+                    c
+                    for c, (ok, n_new) in zip(eligible, signals)
+                    if ok and n_new <= 1
+                ]
         for c in eligible:
             cmd = self.compute_consolidation([c])
             if not cmd.is_empty:
@@ -249,6 +270,24 @@ class MultiNodeConsolidation(_ConsolidationBase):
         )[:MAX_MULTI_NODE_BATCH]
         if len(eligible) < 2:
             return Command(reason=self.reason)
+        if self.simulate_batch is not None:
+            signals = self.simulate_batch([eligible[:n] for n in range(1, len(eligible) + 1)])
+            if signals is not None:
+                # every prefix evaluated in ONE device dispatch; confirm the
+                # largest batch-feasible prefixes sequentially (price rules
+                # and exact preference semantics run there), bounded to the
+                # sequential binary search's O(log N) simulate budget
+                feasible = [
+                    n
+                    for n, (ok, n_new) in zip(range(1, len(eligible) + 1), signals)
+                    if ok and n_new <= 1
+                ]
+                confirm_budget = max(2, len(eligible).bit_length())
+                for n in sorted(feasible, reverse=True)[:confirm_budget]:
+                    cmd = self.compute_consolidation(eligible[:n])
+                    if not cmd.is_empty and self._replacement_improves(cmd, eligible[:n]):
+                        return cmd
+                return Command(reason=self.reason)
         # binary search on the prefix length: find the largest N where
         # consolidating candidates[0..N) simulates successfully
         lo, hi = 1, len(eligible)

@@ -143,10 +143,11 @@ class Provisioner:
         )
         return Topology.build(pods, universe, self._bound_pods(excluded_nodes))
 
-    def _build_dra_problem(self, pods):
+    def _build_dra_problem(self, pods, extra_deleting_uids=None):
         """Per-loop DRA inputs (DynamicResources gate, off by default like
         the reference's feature flag); None when disabled or no pod uses
-        resource claims."""
+        resource claims. extra_deleting_uids marks pods migrating in a
+        disruption what-if so their claims' devices re-allocate."""
         if not self.dynamic_resources_enabled:
             return None
         if not any(p.spec.resource_claims for p in pods):
@@ -156,7 +157,7 @@ class Provisioner:
         catalogs = {
             p.name: self.cloud.get_instance_types(p) for p in self.store.nodepools()
         }
-        return DRAProblem.build(self.store, pods, catalogs)
+        return DRAProblem.build(self.store, pods, catalogs, extra_deleting_uids)
 
     def _reserved_in_use(self) -> dict[str, int]:
         """Reservation ids pinned by in-flight claims the provider has not
@@ -196,18 +197,11 @@ class Provisioner:
         if not pods:
             return SchedulingResult(claims=[], unschedulable=[], assignments={})
         existing = self._existing_sim_nodes(excluded_node_names)
-        dra_problem = self._build_dra_problem(pods)
-        if dra_problem is not None:
-            # pods displaced off the excluded nodes are migrating: their
-            # claims' devices are freed and re-allocated in the what-if
-            dra_problem.deleting_pod_uids |= {p.uid for p in extra_pods}
-            from karpenter_tpu.scheduling.dra.integration import gather_allocated_state
-
-            dra_problem.allocated_state = gather_allocated_state(
-                self.store.list(ObjectStore.RESOURCE_CLAIMS),
-                dra_problem.in_cluster_slices,
-                dra_problem.deleting_pod_uids,
-            )
+        # pods displaced off the excluded nodes are migrating: their claims'
+        # devices are freed and re-allocated in the what-if
+        dra_problem = self._build_dra_problem(
+            pods, extra_deleting_uids={p.uid for p in extra_pods}
+        )
         return scheduler.solve(
             pods,
             existing,
@@ -216,6 +210,49 @@ class Provisioner:
             volume_reqs=self._volume_requirements(pods),
             reserved_in_use=self._reserved_in_use(),
             dra_problem=dra_problem,
+        )
+
+    def simulate_batch(self, scenarios: "list[list]") -> "Optional[list[tuple[bool, int]]]":
+        """Batched consolidation what-ifs: one device dispatch evaluates
+        every candidate set's feasibility (no displaced pod unscheduled) and
+        replacement count (new claims opened). scenarios is a list of
+        candidate lists (objects with .name and .reschedulable_pods).
+
+        This is a PRE-FILTER, deliberately over-approximate: pods are
+        fully preference-relaxed up front (the terminal rung of the shared
+        relaxation ladder), so a scenario the sequential path could rescue
+        by relaxing reads feasible here too. Callers confirm the chosen
+        scenario with simulate() before acting. Returns None when gated
+        (unsynced cluster, no scheduler, or DRA pods present — those solve
+        on the host path)."""
+        scheduler = self._build_scheduler()
+        if scheduler is None or not self.cluster.synced() or not scenarios:
+            return None
+        from karpenter_tpu.controllers.provisioning.preferences import strip_preferences
+
+        pending = self.pending_pods()
+        union: dict[str, Pod] = {}
+        specs: list[tuple[set, set, set]] = []
+        for candidates in scenarios:
+            excluded = {c.name for c in candidates}
+            displaced = [p for c in candidates for p in c.reschedulable_pods]
+            for p in displaced:
+                union.setdefault(p.uid, p)
+            displaced_uids = {p.uid for p in displaced}
+            active = {p.uid for p in pending} | displaced_uids
+            specs.append((excluded, active, displaced_uids))
+        all_pods = [strip_preferences(p) for p in pending + list(union.values())]
+        if self.dynamic_resources_enabled and any(p.spec.resource_claims for p in all_pods):
+            return None
+        existing = self._existing_sim_nodes()
+        return scheduler.whatif_batch(
+            all_pods,
+            existing,
+            self._remaining_budgets(),
+            specs,
+            lambda ps, excluded: self._build_topology(ps, scheduler, excluded),
+            volume_reqs=self._volume_requirements(all_pods),
+            reserved_in_use=self._reserved_in_use(),
         )
 
     def _existing_sim_nodes(self, excluded: Optional[set[str]] = None) -> list[ExistingSimNode]:

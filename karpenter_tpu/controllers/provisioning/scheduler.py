@@ -355,6 +355,167 @@ class TPUScheduler:
         import time as _time
 
         self._t_solve_start = _time.perf_counter()
+        pods_sorted, enc = self._encode(pods, existing_nodes, budgets, topology)
+        _t_encode_done = _time.perf_counter()
+        result = self._run_solve(
+            enc["pt"],
+            enc["tol"],
+            enc["it_allow"],
+            enc["exist_ok"],
+            enc["pod_ports"],
+            enc["pod_port_conf"],
+            enc["exist_tensors"],
+            enc["template_tensors"],
+            enc["topo_tensors"],
+            enc["pod_topo"],
+            zone_kid=enc["zone_kid"],
+            ct_kid=enc["ct_kid"],
+            n_claims=enc["n_claims"],
+            topo_kids=enc["topo_kids"],
+        )
+        result.assignment.block_until_ready()
+        _t_device_done = _time.perf_counter()
+        out = self._decode(pods_sorted, result, enc["E"])
+        _t_end = _time.perf_counter()
+        # phase timings for profiling/bench (VERDICT: expose the device vs
+        # host split so optimization work isn't flying blind)
+        self.last_timings = {
+            "encode_s": _t_encode_done - self._t_solve_start,
+            "device_s": _t_device_done - _t_encode_done,
+            "decode_s": _t_end - _t_device_done,
+        }
+        return out
+
+    def whatif_batch(
+        self,
+        pods: Sequence[Pod],
+        existing_nodes: list[ExistingSimNode],
+        budgets: Optional[dict[str, dict[str, float]]],
+        scenarios: list[tuple[set, set, set]],
+        topology_factory,
+        volume_reqs: Optional[dict] = None,
+        reserved_in_use: Optional[dict[str, int]] = None,
+    ) -> Optional[list[tuple[bool, int]]]:
+        """Batched disruption what-ifs: evaluate S candidate exclusion sets
+        in ONE vmapped device dispatch instead of S sequential re-solves
+        (the tensorized twin of multinodeconsolidation.go:136-183's
+        per-prefix SimulateScheduling loop).
+
+        pods is the UNION pod set (pending + every scenario's displaced
+        pods); each scenario is (excluded_node_names, active_pod_uids,
+        counted_pod_uids). The encoded problem is shared — only per-scenario
+        validity masks and topology count seeds differ. Returns
+        (feasible, n_new_claims) per scenario, where feasible means no
+        counted pod went unscheduled.
+        """
+        import numpy as _np
+
+        self._volume_reqs = volume_reqs or {}
+        self._reserved_in_use = reserved_in_use or {}
+        pods = list(pods)
+        topo0 = topology_factory(pods, scenarios[0][0])
+        pods_sorted, enc = self._encode(
+            pods, [n.clone() for n in existing_nodes], budgets, topo0
+        )
+        tt = enc["topo_tensors"]
+        E = enc["E"]
+        node_names = [n.name for n in self.existing_nodes]
+        base_valid = _np.asarray(enc["pt"].valid)
+        # Each scenario gathers its COMPACT pod list from the union encoding,
+        # so the vmapped scan length is the largest scenario, not the union
+        # size (singleton candidate what-ifs stay near-free even when the
+        # union carries every candidate's pods). Both axes pad to powers of
+        # two so repeated disruption polls share compiled executables.
+        S = len(scenarios)
+        S_pad = _next_pow2(S, 1)
+        per_scenario_idx: list[list[int]] = []
+        for excluded, active_uids, counted_uids in scenarios:
+            per_scenario_idx.append(
+                [
+                    i
+                    for i, p in enumerate(pods_sorted)
+                    if base_valid[i] and p.uid in active_uids
+                ]
+            )
+        L = _next_pow2(max((len(ix) for ix in per_scenario_idx), default=1), 1)
+        idx = _np.zeros((S_pad, L), dtype=_np.int32)
+        active = _np.zeros((S_pad, L), dtype=bool)
+        pc = _np.zeros((S_pad, L), dtype=bool)
+        ev = _np.ones((S_pad, E), dtype=bool)
+        vg0 = _np.broadcast_to(
+            _np.asarray(tt.vg_counts0), (S_pad,) + tt.vg_counts0.shape
+        ).copy()
+        hg0 = _np.broadcast_to(
+            _np.asarray(tt.hg_counts0), (S_pad,) + tt.hg_counts0.shape
+        ).copy()
+        for s, (excluded, active_uids, counted_uids) in enumerate(scenarios):
+            for e, name in enumerate(node_names):
+                ev[s, e] = name not in excluded
+            for j, i in enumerate(per_scenario_idx[s]):
+                idx[s, j] = i
+                active[s, j] = True
+                pc[s, j] = pods_sorted[i].uid in counted_uids
+            if s == 0:
+                continue  # scenario 0's seeds are the encoded baseline
+            topo_s = topology_factory(pods, excluded)
+            for node in self.existing_nodes:
+                topo_s.register(l.LABEL_HOSTNAME, node.name)
+            counts = topo_ops.encode_topology_counts(
+                topo_s, self.encoder, E, enc["n_claims"] + 1, node_names,
+                tt.vg_counts0.shape[1], enc["vg_groups"], enc["hg_groups"],
+            )
+            if counts is None:
+                # Group structure diverged across scenarios (inverse
+                # anti-affinity groups derive from bound pods, which differ
+                # per exclusion set): the shared encoding can't represent
+                # every scenario — callers fall back to sequential simulation.
+                return None
+            vg0[s], hg0[s] = counts
+
+        unsched, n_open = ops_solver.solve_whatif(
+            jnp.asarray(idx),
+            jnp.asarray(active),
+            jnp.asarray(pc),
+            jnp.asarray(ev),
+            jnp.asarray(vg0),
+            jnp.asarray(hg0),
+            enc["pt"],
+            enc["tol"],
+            enc["it_allow"],
+            enc["exist_ok"],
+            enc["pod_ports"],
+            enc["pod_port_conf"],
+            enc["exist_tensors"],
+            self.it_tensors,
+            enc["template_tensors"],
+            self.well_known,
+            tt,
+            enc["pod_topo"],
+            zone_kid=enc["zone_kid"],
+            ct_kid=enc["ct_kid"],
+            n_claims=enc["n_claims"],
+            mv_active=self._mv_active and self.min_values_policy != "BestEffort",
+            topo_kids=enc["topo_kids"],
+            res_cap0=self._res_cap0,
+            rid_kid=self._rid_kid,
+            res_vid=self._res_vid,
+            res_active=self._res_active,
+            res_strict=self.reserved_mode == "strict",
+        )
+        unsched = _np.asarray(unsched)
+        n_open = _np.asarray(n_open)
+        return [(int(unsched[s]) == 0, int(n_open[s])) for s in range(S)]
+
+    def _encode(
+        self,
+        pods: Sequence[Pod],
+        existing_nodes: Optional[list[ExistingSimNode]] = None,
+        budgets: Optional[dict[str, dict[str, float]]] = None,
+        topology: Optional[Topology] = None,
+    ) -> tuple[list[Pod], dict]:
+        """Encode one problem into solver tensors (everything _run_solve
+        needs); shared by the provisioning solve and the batched what-if
+        path, which re-masks the same encoding per scenario."""
         self.existing_nodes = existing_nodes or []
         self.budgets = {k: dict(v) for k, v in (budgets or {}).items()}
         if topology is None:
@@ -553,37 +714,26 @@ class TPUScheduler:
                 }
             )
         )
-        import time as _time
-
-        _t_encode_done = _time.perf_counter()
-        result = self._run_solve(
-            pt,
-            jnp.asarray(tol),
-            jnp.asarray(it_allow),
-            jnp.asarray(exist_ok),
-            jnp.asarray(pod_ports),
-            jnp.asarray(pod_port_conf),
-            exist_tensors,
-            template_tensors,
-            topo_tensors,
-            pod_topo,
+        return pods_sorted, dict(
+            pt=pt,
+            tol=jnp.asarray(tol),
+            it_allow=jnp.asarray(it_allow),
+            exist_ok=jnp.asarray(exist_ok),
+            pod_ports=jnp.asarray(pod_ports),
+            pod_port_conf=jnp.asarray(pod_port_conf),
+            exist_tensors=exist_tensors,
+            template_tensors=template_tensors,
+            topo_tensors=topo_tensors,
+            pod_topo=pod_topo,
             zone_kid=zone_kid,
             ct_kid=ct_kid,
             n_claims=n_claims,
             topo_kids=topo_kids,
+            E=E,
+            P=P,
+            vg_groups=vg,
+            hg_groups=hg,
         )
-        result.assignment.block_until_ready()
-        _t_device_done = _time.perf_counter()
-        out = self._decode(pods_sorted, result, E)
-        _t_end = _time.perf_counter()
-        # phase timings for profiling/bench (VERDICT: expose the device vs
-        # host split so optimization work isn't flying blind)
-        self.last_timings = {
-            "encode_s": _t_encode_done - self._t_solve_start,
-            "device_s": _t_device_done - _t_encode_done,
-            "decode_s": _t_end - _t_device_done,
-        }
-        return out
 
     def _run_solve(
         self,

@@ -725,6 +725,83 @@ def solve_from(
     return SolveResult(assignment=assignment, claims=state)
 
 
+@functools.partial(jax.jit, static_argnames=_STATIC)
+def solve_whatif(
+    scen_pod_idx: jnp.ndarray,  # [S, L] i32 — this scenario's pods (indices into the union)
+    scen_active: jnp.ndarray,  # [S, L] bool — real entries (False = padding)
+    scen_count: jnp.ndarray,  # [S, L] bool — pods whose failure matters (displaced)
+    scen_exist_valid: jnp.ndarray,  # [S, E] bool — per-scenario surviving nodes
+    scen_vg_counts0: jnp.ndarray,  # [S, NGv, V] i32 — per-scenario topology seeds
+    scen_hg_counts0: jnp.ndarray,  # [S, NGh, Sl] i32
+    pods: PodTensors,
+    pod_tmpl_ok: jnp.ndarray,
+    pod_it_allow: jnp.ndarray,
+    pod_exist_ok: jnp.ndarray,
+    pod_ports: jnp.ndarray,
+    pod_port_conf: jnp.ndarray,
+    exist: ExistingNodes,
+    it: InstanceTypeTensors,
+    templates: Templates,
+    well_known: jnp.ndarray,
+    topo: TopologyTensors,
+    pod_topo: PodTopology,
+    zone_kid: int,
+    ct_kid: int,
+    n_claims: int,
+    mv_active: bool = False,
+    topo_kids: tuple = (),
+    res_cap0=None,
+    rid_kid: int = -1,
+    res_vid: int = -1,
+    res_active: bool = False,
+    res_strict: bool = False,
+):
+    """Batched consolidation what-ifs: S disruption scenarios solved in ONE
+    device dispatch (the reference runs SimulateScheduling sequentially per
+    candidate set — multinodeconsolidation.go:136-183). Every scenario
+    shares the encoded union problem; each gathers its OWN compact pod list
+    (scan length L = the largest scenario, not the union size — singleton
+    candidate scenarios stay cheap even when the union holds every
+    candidate's pods), plus its exclusion mask and topology count seeds.
+    vmap vectorizes the whole thing across the batch.
+
+    Returns per-scenario (n_unsched [S] i32 — failures among the pods each
+    scenario counts, n_open [S] i32 — new claims opened).
+    """
+
+    def one(idx, active, count, exist_valid, vg0, hg0):
+        ex = exist._replace(valid=exist_valid)
+        tp = topo._replace(vg_counts0=vg0, hg_counts0=hg0)
+        valid = pods.valid[idx] & active
+        pd = PodTensors(
+            reqs=kernels.take_set(pods.reqs, idx),
+            strict_reqs=kernels.take_set(pods.strict_reqs, idx),
+            requests=pods.requests[idx],
+            valid=valid,
+        )
+        state = initial_state(ex, it, templates, tp, n_claims, pod_ports.shape[1], res_cap0)
+        step = _make_step(
+            ex, it, templates, well_known, tp, zone_kid, ct_kid, n_claims,
+            mv_active, topo_kids, rid_kid, res_vid, res_active, res_strict,
+        )
+        xs = _xs(
+            pd,
+            pod_tmpl_ok[idx],
+            pod_it_allow[idx],
+            pod_exist_ok[idx],
+            pod_ports[idx],
+            pod_port_conf[idx],
+            topo_ops.take_pod_topology(pod_topo, idx),
+        )
+        state, assignment = jax.lax.scan(step, state, xs)
+        n_unsched = jnp.sum(count & valid & (assignment < 0)).astype(jnp.int32)
+        return n_unsched, state.n_open
+
+    return jax.vmap(one)(
+        scen_pod_idx, scen_active, scen_count, scen_exist_valid, scen_vg_counts0, scen_hg_counts0
+    )
+
+
 def _apply_topo(reqs: ReqSetTensors, upd: jnp.ndarray, touched: jnp.ndarray) -> ReqSetTensors:
     """AND the topology domain masks into candidate requirements: touched
     keys become concrete finite sets (requirements.Add of an In set)."""

@@ -69,6 +69,70 @@ class PodTopology(NamedTuple):
     strict_mask: jnp.ndarray  # [P, K, V] bool — strict pod requirement masks
 
 
+def encode_topology_counts(
+    topology,
+    encoder,
+    e_slots: int,
+    n_slots: int,
+    existing_names: Sequence[str],
+    v_pad: int,
+    base_vg: Sequence,
+    base_hg: Sequence,
+):
+    """Numpy-only (vg_counts0, hg_counts0) for a scenario topology, with
+    rows ALIGNED to a baseline's group lists by group identity — the batched
+    what-if path re-seeds counts per scenario and must not round-trip tiny
+    arrays through the device (each host<->device hop costs ~80ms over the
+    TPU tunnel). Inverse anti-affinity groups derive from bound pods, which
+    differ per exclusion set, so positional alignment is unsound; groups
+    are matched by ident() instead. Returns None when the scenario's group
+    multiset diverges from the baseline's (callers fall back to sequential
+    simulation)."""
+    groups = topology.groups + topology.inverse_groups
+    vg = [g for g in groups if g.key != l.LABEL_HOSTNAME]
+    hg = [g for g in groups if g.key == l.LABEL_HOSTNAME]
+
+    def align(scenario_groups, base_groups):
+        by_ident: dict = {}
+        for g in scenario_groups:
+            by_ident.setdefault(g.ident(), []).append(g)
+        ordered = []
+        for b in base_groups:
+            bucket = by_ident.get(b.ident())
+            if not bucket:
+                return None
+            ordered.append(bucket.pop(0))
+        if any(bucket for bucket in by_ident.values()):
+            return None  # scenario has groups the baseline encoding lacks
+        return ordered
+
+    vg_aligned = align(vg, base_vg)
+    hg_aligned = align(hg, base_hg)
+    if vg_aligned is None or hg_aligned is None:
+        return None
+
+    NGv, NGh = _pow2(max(len(base_vg), 1), 1), _pow2(max(len(base_hg), 1), 1)
+    S = e_slots + n_slots
+    vocab = encoder.vocab
+    vg_counts0 = np.zeros((NGv, v_pad), dtype=np.int32)
+    for j, g in enumerate(vg_aligned):
+        kid = vocab.add_key(g.key)
+        for name, count in g.domains.items():
+            vid = vocab.value_to_id[kid].get(name)
+            if vid is not None:
+                vg_counts0[j, vid] = count
+    slot_of = {name: i for i, name in enumerate(existing_names)}
+    hg_counts0 = np.zeros((NGh, S), dtype=np.int32)
+    for j, g in enumerate(hg_aligned):
+        for name, count in g.domains.items():
+            if count <= 0:
+                continue
+            s = slot_of.get(name)
+            if s is not None:
+                hg_counts0[j, s] = count
+    return vg_counts0, hg_counts0
+
+
 def encode_topology(topology, encoder, e_slots: int, n_slots: int, existing_names: Sequence[str]):
     """Host Topology + ProblemEncoder -> TopologyTensors.
 

@@ -97,7 +97,12 @@ class DRAProblem:
     deleting_pod_uids: set[str] = field(default_factory=set)
 
     @staticmethod
-    def build(store, pods, catalogs_by_pool: dict[str, list]) -> Optional["DRAProblem"]:
+    def build(
+        store,
+        pods,
+        catalogs_by_pool: dict[str, list],
+        extra_deleting_uids: Optional[set[str]] = None,
+    ) -> Optional["DRAProblem"]:
         """Resolve pod claim references against the store
         (scheduler.go:571-589 resolvePodClaims); None when no pod uses DRA.
         Pods whose claims can't be resolved are flagged — no candidate can
@@ -137,6 +142,8 @@ class DRAProblem:
             for p in store.pods()
             if getattr(p.metadata, "deletion_timestamp", None) or p.spec.node_name in deleting_nodes
         }
+        if extra_deleting_uids:
+            problem.deleting_pod_uids |= extra_deleting_uids
         problem.allocated_state = gather_allocated_state(
             store.list(ObjectStore.RESOURCE_CLAIMS),
             problem.in_cluster_slices,

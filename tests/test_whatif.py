@@ -1,0 +1,161 @@
+"""Batched consolidation what-if tests.
+
+The vmapped scenario batch (ops/solver.py solve_whatif +
+TPUScheduler.whatif_batch) must agree with the sequential simulate path on
+feasibility and replacement count — the tensorized twin of the reference's
+per-candidate SimulateScheduling loop (multinodeconsolidation.go:136-183).
+"""
+
+import pytest
+
+from karpenter_tpu.cloudprovider.fake import new_instance_type
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.controllers.manager import KubeSchedulerSim, Manager
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import make_pod
+from karpenter_tpu.state.store import ObjectStore
+from karpenter_tpu.utils.clock import FakeClock
+
+
+class FakeCandidate:
+    """The minimal candidate surface simulate_batch consumes."""
+
+    def __init__(self, name, pods):
+        self.name = name
+        self.reschedulable_pods = pods
+
+
+def build_cluster(n_small_pods=6, extra_pod_cpu=None):
+    """A cluster with several 4-cpu nodes, each carrying bound pods."""
+    clock = FakeClock()
+    store = ObjectStore(clock)
+    catalog = [new_instance_type("n-4x", cpu=4), new_instance_type("n-8x", cpu=8)]
+    cloud = KwokCloudProvider(store, catalog=catalog)
+    mgr = Manager(store, cloud, clock)
+    store.create(ObjectStore.NODEPOOLS, NodePool())
+    for i in range(n_small_pods):
+        # 2-cpu pods pinned to the 4-cpu type: one node per pod, so
+        # consolidation onto the 8-cpu type has real work to find
+        store.create(
+            ObjectStore.PODS,
+            make_pod(f"p{i}", cpu=2.0, node_selector={l.LABEL_INSTANCE_TYPE: "n-4x"}),
+        )
+    mgr.run_until_idle()
+    cloud.simulate_kubelet_ready()
+    mgr.run_until_idle()
+    KubeSchedulerSim(store, mgr.cluster).bind_pending()
+    mgr.run_until_idle()
+    assert all(p.spec.node_name for p in store.pods())
+    return clock, store, cloud, mgr
+
+
+def node_candidates(store, mgr):
+    by_node: dict[str, list] = {}
+    for p in store.pods():
+        if p.spec.node_name:
+            by_node.setdefault(p.spec.node_name, []).append(p)
+    return [FakeCandidate(name, pods) for name, pods in sorted(by_node.items())]
+
+
+def sequential_signal(provisioner, candidates):
+    """The ground truth the batch must reproduce: sequential simulate's
+    (feasible, n_new_claims)."""
+    excluded = {c.name for c in candidates}
+    extra = [p for c in candidates for p in c.reschedulable_pods]
+    result = provisioner.simulate(excluded, extra)
+    if result is None:
+        return None
+    extra_uids = {p.uid for p in extra}
+    unscheduled = {p.uid for p, _ in result.unschedulable} & extra_uids
+    return (not unscheduled, len(result.claims))
+
+
+class TestWhatIfBatch:
+    def test_differential_vs_sequential(self):
+        clock, store, cloud, mgr = build_cluster()
+        candidates = node_candidates(store, mgr)
+        assert len(candidates) >= 3
+        # all prefixes plus each single candidate — the exact scenario mix
+        # the consolidation methods submit
+        scenarios = [candidates[:n] for n in range(1, len(candidates) + 1)]
+        scenarios += [[c] for c in candidates]
+        signals = mgr.provisioner.simulate_batch(scenarios)
+        assert signals is not None
+        assert len(signals) == len(scenarios)
+        for scen, got in zip(scenarios, signals):
+            want = sequential_signal(mgr.provisioner, scen)
+            assert want is not None
+            assert got == want, f"scenario {[c.name for c in scen]}: batch {got} vs sequential {want}"
+
+    def test_infeasible_scenario_detected(self):
+        # Remove every node at once with a catalog too small to absorb all
+        # pods onto one replacement: the all-nodes scenario still succeeds
+        # (new claims open), but feasibility and claim count must agree
+        # with the sequential path — including the n_new > 1 signal the
+        # consolidation filter rejects.
+        clock, store, cloud, mgr = build_cluster(n_small_pods=8)
+        candidates = node_candidates(store, mgr)
+        scenarios = [candidates]
+        signals = mgr.provisioner.simulate_batch(scenarios)
+        want = sequential_signal(mgr.provisioner, candidates)
+        assert signals[0] == want
+
+    def test_anti_affinity_bound_pods_fall_back_to_sequential(self):
+        # Inverse anti-affinity groups derive from bound pods, which differ
+        # per exclusion set; the shared batch encoding can't represent that,
+        # so simulate_batch must return None (sequential fallback), never a
+        # misaligned answer.
+        from karpenter_tpu.models.pod import PodAffinityTerm
+
+        clock = FakeClock()
+        store = ObjectStore(clock)
+        catalog = [new_instance_type("n-4x", cpu=4), new_instance_type("n-8x", cpu=8)]
+        cloud = KwokCloudProvider(store, catalog=catalog)
+        mgr = Manager(store, cloud, clock)
+        store.create(ObjectStore.NODEPOOLS, NodePool())
+        for i in range(3):
+            pod = make_pod(
+                f"aa{i}",
+                cpu=2.0,
+                node_selector={l.LABEL_INSTANCE_TYPE: "n-4x"},
+            )
+            pod.metadata.labels["app"] = "aa"
+            pod.spec.pod_anti_affinity = [
+                PodAffinityTerm(topology_key=l.LABEL_HOSTNAME, label_selector={"app": "aa"})
+            ]
+            store.create(ObjectStore.PODS, pod)
+        mgr.run_until_idle()
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        KubeSchedulerSim(store, mgr.cluster).bind_pending()
+        mgr.run_until_idle()
+        candidates = node_candidates(store, mgr)
+        assert len(candidates) >= 2
+        signals = mgr.provisioner.simulate_batch([[c] for c in candidates])
+        if signals is not None:
+            # if the encoding could align, it must still match sequential
+            for c, got in zip(candidates, signals):
+                assert got == sequential_signal(mgr.provisioner, [c])
+
+    def test_multinode_consolidation_uses_batch(self, monkeypatch):
+        # The disruption controller's multi-node pass should produce the
+        # same command with the batch prefilter as with pure binary search,
+        # while issuing at most one batch call.
+        clock, store, cloud, mgr = build_cluster()
+        calls = {"batch": 0, "seq": 0}
+        orig_batch = mgr.provisioner.simulate_batch
+        orig_seq = mgr.provisioner.simulate
+
+        def counting_batch(scenarios):
+            calls["batch"] += 1
+            return orig_batch(scenarios)
+
+        def counting_seq(excluded, extra):
+            calls["seq"] += 1
+            return orig_seq(excluded, extra)
+
+        monkeypatch.setattr(mgr.provisioner, "simulate_batch", counting_batch)
+        monkeypatch.setattr(mgr.provisioner, "simulate", counting_seq)
+        cmd = mgr.run_disruption_once()
+        assert calls["batch"] <= 2  # multi-node + single-node passes
