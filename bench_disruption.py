@@ -22,41 +22,16 @@ SEQUENTIAL_SAMPLE = 10  # full sequential sweep extrapolated from a sample
 
 
 def build_cluster(n_nodes: int):
-    from karpenter_tpu.cloudprovider.fake import new_instance_type
-    from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
-    from karpenter_tpu.controllers.manager import KubeSchedulerSim, Manager
-    from karpenter_tpu.models import labels as l
-    from karpenter_tpu.models.nodepool import NodePool
-    from karpenter_tpu.models.pod import make_pod
-    from karpenter_tpu.state.store import ObjectStore
-    from karpenter_tpu.utils.clock import FakeClock
+    """The shared fixture cluster (karpenter_tpu.testing) the parity tests
+    also pin — the benchmark measures the exact same bootstrap."""
+    from karpenter_tpu.testing import build_bound_cluster
 
-    clock = FakeClock()
-    store = ObjectStore(clock)
-    catalog = [new_instance_type("n-4x", cpu=4), new_instance_type("n-8x", cpu=8)]
-    cloud = KwokCloudProvider(store, catalog=catalog)
-    mgr = Manager(store, cloud, clock)
-    store.create(ObjectStore.NODEPOOLS, NodePool())
-    for i in range(n_nodes):
-        store.create(
-            ObjectStore.PODS,
-            make_pod(f"p{i}", cpu=2.0, node_selector={l.LABEL_INSTANCE_TYPE: "n-4x"}),
-        )
-    mgr.run_until_idle()
-    cloud.simulate_kubelet_ready()
-    mgr.run_until_idle()
-    KubeSchedulerSim(store, mgr.cluster).bind_pending()
-    mgr.run_until_idle()
+    _clock, store, _cloud, mgr = build_bound_cluster(n_pods=n_nodes, pod_cpu=2.0)
     return store, mgr
 
 
-class _Candidate:
-    def __init__(self, name, pods):
-        self.name = name
-        self.reschedulable_pods = pods
-
-
 def main() -> None:
+    from karpenter_tpu.testing import FakeCandidate
     from karpenter_tpu.utils import accel
 
     platform = "tpu" if accel.accelerator_usable() else "cpu"
@@ -68,7 +43,7 @@ def main() -> None:
     for p in store.pods():
         if p.spec.node_name:
             by_node.setdefault(p.spec.node_name, []).append(p)
-    candidates = [_Candidate(name, pods) for name, pods in sorted(by_node.items())]
+    candidates = [FakeCandidate(name, pods) for name, pods in sorted(by_node.items())]
     scenarios = [[c] for c in candidates]
     prov = mgr.provisioner
 

@@ -248,10 +248,16 @@ class SingleNodeConsolidation(_ConsolidationBase):
         if len(eligible) > 1 and self.simulate_batch is not None:
             signals = self.simulate_batch([[c] for c in eligible])
             if signals is not None:
-                eligible = [
-                    c
-                    for c, (ok, n_new) in zip(eligible, signals)
-                    if ok and n_new <= 1
+                # feasibility is a sound over-approximation (the batch is
+                # fully relaxed), so ok=False candidates are truly dead.
+                # n_new is a packing heuristic — first-fit is non-monotone
+                # under constraint removal — so it only ORDERS the
+                # sequential confirms, never drops a feasible candidate.
+                feasible = [
+                    (c, n_new) for c, (ok, n_new) in zip(eligible, signals) if ok
+                ]
+                eligible = [c for c, n in feasible if n <= 1] + [
+                    c for c, n in feasible if n > 1
                 ]
         for c in eligible:
             cmd = self.compute_consolidation([c])
@@ -270,31 +276,55 @@ class MultiNodeConsolidation(_ConsolidationBase):
         )[:MAX_MULTI_NODE_BATCH]
         if len(eligible) < 2:
             return Command(reason=self.reason)
+        # memoized per prefix length: the confirm walk and the binary-search
+        # fallback share results, bounding total sequential simulates to
+        # confirm_budget + log N with no repeats
+        prefix_memo: dict[int, Command] = {}
+
+        def compute_prefix(n: int) -> Command:
+            if n not in prefix_memo:
+                prefix_memo[n] = self.compute_consolidation(eligible[:n])
+            return prefix_memo[n]
+
         if self.simulate_batch is not None:
             signals = self.simulate_batch([eligible[:n] for n in range(1, len(eligible) + 1)])
             if signals is not None:
                 # every prefix evaluated in ONE device dispatch; confirm the
                 # largest batch-feasible prefixes sequentially (price rules
                 # and exact preference semantics run there), bounded to the
-                # sequential binary search's O(log N) simulate budget
+                # sequential binary search's O(log N) simulate budget.
+                # Feasibility (ok) soundly over-approximates — ok=False
+                # prefixes are sequentially infeasible too. n_new<=1 is only
+                # a likely-single-replacement ORDERING hint (first-fit is
+                # non-monotone under relaxation), so feasible prefixes it
+                # deprioritizes still get tried, and if the confirm budget
+                # can't cover every feasible prefix we fall back to the
+                # exact binary search rather than silently skip.
                 feasible = [
-                    n
+                    (n, n_new)
                     for n, (ok, n_new) in zip(range(1, len(eligible) + 1), signals)
-                    if ok and n_new <= 1
+                    if ok
                 ]
+                ordered = sorted((n for n, nn in feasible if nn <= 1), reverse=True) + sorted(
+                    (n for n, nn in feasible if nn > 1), reverse=True
+                )
                 confirm_budget = max(2, len(eligible).bit_length())
-                for n in sorted(feasible, reverse=True)[:confirm_budget]:
-                    cmd = self.compute_consolidation(eligible[:n])
+                for n in ordered[:confirm_budget]:
+                    cmd = compute_prefix(n)
                     if not cmd.is_empty and self._replacement_improves(cmd, eligible[:n]):
                         return cmd
-                return Command(reason=self.reason)
+                if len(ordered) <= confirm_budget:
+                    # every batch-feasible prefix was confirmed infeasible
+                    # sequentially; nothing was skipped
+                    return Command(reason=self.reason)
+                # untried feasible prefixes remain — run the exact search
         # binary search on the prefix length: find the largest N where
         # consolidating candidates[0..N) simulates successfully
         lo, hi = 1, len(eligible)
         best = Command(reason=self.reason)
         while lo <= hi:
             mid = (lo + hi) // 2
-            cmd = self.compute_consolidation(eligible[:mid])
+            cmd = compute_prefix(mid)
             if not cmd.is_empty and self._replacement_improves(cmd, eligible[:mid]):
                 best = cmd
                 lo = mid + 1

@@ -72,6 +72,9 @@ class ExistingSimNode:
     used: dict[str, float] = field(default_factory=dict)
     pods: list[Pod] = field(default_factory=list)
     host_ports: list[tuple] = field(default_factory=list)  # (ip, port, proto)
+    # CSI attach tracking seeded from the live node (statenode.go:411);
+    # None = no limits published, unconstrained
+    volume_usage: object = None
 
     def clone(self) -> "ExistingSimNode":
         """Pristine copy for simulation retries (relaxation loop)."""
@@ -84,6 +87,7 @@ class ExistingSimNode:
             used=dict(self.used),
             pods=list(self.pods),
             host_ports=list(self.host_ports),
+            volume_usage=self.volume_usage.copy() if self.volume_usage is not None else None,
         )
 
 
@@ -126,6 +130,24 @@ def finalize_reserved(claim: SimClaim) -> None:
     claim.requirements.add(
         Requirement.new(RESERVATION_ID_LABEL, Operator.IN, *sorted(claim.reserved_ids))
     )
+
+
+def normalize_volume_reqs(volume_reqs: Optional[dict]) -> dict:
+    """uid -> list[Requirements] alternatives. Accepts legacy single
+    Requirement / Requirements values for convenience."""
+    out: dict = {}
+    for uid, v in (volume_reqs or {}).items():
+        if v is None:
+            continue
+        if isinstance(v, Requirement):
+            rs = Requirements()
+            rs.add(v)
+            out[uid] = [rs]
+        elif isinstance(v, Requirements):
+            out[uid] = [v]
+        else:
+            out[uid] = list(v)
+    return out
 
 
 def ffd_sort(pods: list[Pod]) -> list[Pod]:
@@ -213,21 +235,25 @@ class HostScheduler:
         min_values_policy: str = "Strict",
         reserved_in_use: Optional[dict[str, int]] = None,
         dra_problem=None,
+        pod_volumes: Optional[dict] = None,
     ):
         """budgets: nodepool -> remaining resources (limits minus current
         usage; may include the synthetic 'nodes' count). Absent pool =
         unlimited. topology: pre-built Topology (counts seeded from the
         live cluster); None disables topology handling. volume_reqs: pod
-        uid -> PVC-implied zone Requirement. reserved_mode: strict fails
-        adds that would lose reserved capacity (scheduler.go:59-78);
-        fallback lets them fall through to spot/on-demand."""
+        uid -> PVC-implied topology alternatives (list[Requirements]).
+        pod_volumes: pod uid -> CSI Volumes (driver -> pvc ids) for
+        attach-limit checks. reserved_mode: strict fails adds that would
+        lose reserved capacity (scheduler.go:59-78); fallback lets them
+        fall through to spot/on-demand."""
         from karpenter_tpu.controllers.provisioning.topology import Topology as _T
 
         self.templates = templates
         self.existing_nodes = existing_nodes or []
         self.budgets = {k: dict(v) for k, v in (budgets or {}).items()}
         self.topology = topology if topology is not None else _T()
-        self.volume_reqs = volume_reqs or {}
+        self.volume_reqs = normalize_volume_reqs(volume_reqs)
+        self.pod_volumes = pod_volumes or {}
         self.reserved_mode = reserved_mode
         self.reserved_capacity_enabled = reserved_capacity_enabled
         self.min_values_policy = min_values_policy
@@ -287,6 +313,12 @@ class HostScheduler:
 
     # -- tier 1: existing nodes (existingnode.go:84-135) ---------------------
 
+    def _alternatives_for(self, pod: Pod) -> list:
+        """The pod's volume-topology alternatives, or [None] when
+        unconstrained (nodeclaim.go:140-147: a single nil entry)."""
+        alts = self.volume_reqs.get(pod.uid)
+        return list(alts) if alts else [None]
+
     def can_add_existing(
         self, node: ExistingSimNode, pod: Pod, pod_reqs: Requirements, strict: Requirements
     ) -> bool:
@@ -294,6 +326,11 @@ class HostScheduler:
 
         if tolerates_all(node.taints, pod.spec.tolerations) is not None:
             return False
+        # CSI attach limits before anything stateful (existingnode.go:88)
+        pod_vols = self.pod_volumes.get(pod.uid)
+        if pod_vols and node.volume_usage is not None:
+            if node.volume_usage.exceeds_limits(pod_vols) is not None:
+                return False
         if hp.conflicts(node.host_ports, pod):
             return False
         total = res.merge(node.used, pod.total_requests())
@@ -302,8 +339,34 @@ class HostScheduler:
         # strict Compatible: no AllowUndefinedWellKnownLabels
         if node.requirements.compatible(pod_reqs) is not None:
             return False
+        for volreq in self._alternatives_for(pod):
+            if self._try_alternative_existing(node, pod, pod_reqs, strict, volreq, total):
+                if pod_vols and node.volume_usage is not None:
+                    node.volume_usage.add(pod.uid, pod_vols)
+                return True
+        return False
+
+    def _try_alternative_existing(
+        self,
+        node: ExistingSimNode,
+        pod: Pod,
+        pod_reqs: Requirements,
+        strict: Requirements,
+        volreq,
+        total: dict,
+    ) -> bool:
+        """One volume alternative against an existing node
+        (existingnode.go:143-168 tryVolumeAlternative): the alternative
+        tightens the NODE requirements only, never the pod's affinity, so
+        TSC counting stays on the pod's own spec."""
+        from karpenter_tpu.scheduling import hostports as hp
+
         base = node.requirements.copy()
         base.add(*pod_reqs.values())
+        if volreq is not None:
+            if base.compatible(volreq, l.WELL_KNOWN_LABELS) is not None:
+                return False
+            base.add(*volreq.values())
         alloc = None
         if self._dra is not None and pod.spec.resource_claims:
             # existing node: single collapsed instance type, published
@@ -331,7 +394,9 @@ class HostScheduler:
     ) -> Optional[SimClaim]:
         """Feasibility of adding pod to claim (nodeclaim.go:124-242);
         returns the updated claim state or None. On success the topology
-        counts are recorded — callers must commit the returned claim."""
+        counts are recorded — callers must commit the returned claim.
+        Volume alternatives are tried in order, first success wins
+        (nodeclaim.go:149-161)."""
         from karpenter_tpu.scheduling import hostports as hp
 
         if tolerates_all(claim.template.taints, pod.spec.tolerations) is not None:
@@ -340,8 +405,25 @@ class HostScheduler:
             return None
         if claim.requirements.compatible(pod_reqs, l.WELL_KNOWN_LABELS) is not None:
             return None
+        for volreq in self._alternatives_for(pod):
+            updated = self._try_alternative_claim(claim, pod, pod_reqs, strict, volreq)
+            if updated is not None:
+                return updated
+        return None
+
+    def _try_alternative_claim(
+        self, claim: SimClaim, pod: Pod, pod_reqs: Requirements, strict: Requirements, volreq
+    ) -> Optional[SimClaim]:
+        """One volume alternative against an in-flight claim
+        (nodeclaim.go:163-242 tryVolumeAlternative)."""
+        from karpenter_tpu.scheduling import hostports as hp
+
         combined = claim.requirements.copy()
         combined.add(*pod_reqs.values())
+        if volreq is not None:
+            if combined.compatible(volreq, l.WELL_KNOWN_LABELS) is not None:
+                return None
+            combined.add(*volreq.values())
         # DRA device allocation runs before topology so contributed device
         # topology feeds the full filtering pipeline (nodeclaim.go:179-192)
         alloc = None
@@ -424,60 +506,80 @@ class HostScheduler:
                 continue
             if tmpl.requirements.compatible(pod_reqs, l.WELL_KNOWN_LABELS) is not None:
                 continue
-            combined = tmpl.requirements.copy()
             # every new claim gets a placeholder hostname so hostname
             # topologies see it as a fresh domain (nodeclaim.go:93-97)
             hostname = self._next_hostname()
-            combined.add(Requirement.new(l.LABEL_HOSTNAME, Operator.IN, hostname))
-            combined.add(*pod_reqs.values())
-            alloc = None
-            if self._dra is not None and pod.spec.resource_claims:
-                alloc = self._dra.try_allocate(
-                    pod, hostname, tmpl.nodepool_name, combined, tmpl.instance_types
-                )
-                if alloc is None or combined.compatible(alloc.requirements, l.WELL_KNOWN_LABELS) is not None:
-                    self._hostname_seq -= 1
-                    continue
-                combined.add(*alloc.requirements.values())
-            tightened = self.topology.add_requirements(pod, strict, combined)
-            if tightened is None or combined.compatible(tightened, l.WELL_KNOWN_LABELS) is not None:
+            claim = None
+            for volreq in self._alternatives_for(pod):
+                claim = self._try_alternative_new(tmpl, pod, pod_reqs, strict, volreq, slot, hostname)
+                if claim is not None:
+                    break
+            if claim is None:
                 self._hostname_seq -= 1  # hostname not consumed
                 continue
-            total = res.merge(tmpl.daemon_requests, pod.total_requests())
-            candidates = self._within_budget(tmpl, tmpl.instance_types)
-            remaining = filter_instance_types(
-                candidates, tightened, total,
-                relax_min_values=self.min_values_policy == "BestEffort",
-            )
-            if alloc is not None:
-                surviving = set(alloc.instance_types)
-                remaining = [it for it in remaining if it.name in surviving]
-            if not remaining:
-                self._hostname_seq -= 1
-                continue
-            new_ids = self._reserve_for(hostname, remaining, tightened, frozenset())
-            if new_ids is None:
-                self._hostname_seq -= 1
-                continue
-            if alloc is not None:
-                self._dra.commit(alloc, hostname, {it.name for it in remaining})
-            self._charge_budget(tmpl, remaining)
-            self.topology.register(l.LABEL_HOSTNAME, hostname)
-            self.topology.record(pod, tightened)
-            from karpenter_tpu.scheduling import hostports as hp
-
-            return SimClaim(
-                template=tmpl,
-                requirements=tightened,
-                used=total,
-                instance_types=remaining,
-                pods=[pod],
-                slot=slot,
-                hostname=hostname,
-                host_ports=[hp.port_key(h) for h in pod.spec.host_ports],
-                reserved_ids=new_ids,
-            )
+            return claim
         return None
+
+    def _try_alternative_new(
+        self,
+        tmpl: ClaimTemplate,
+        pod: Pod,
+        pod_reqs: Requirements,
+        strict: Requirements,
+        volreq,
+        slot: int,
+        hostname: str,
+    ) -> Optional[SimClaim]:
+        combined = tmpl.requirements.copy()
+        combined.add(Requirement.new(l.LABEL_HOSTNAME, Operator.IN, hostname))
+        combined.add(*pod_reqs.values())
+        if volreq is not None:
+            if combined.compatible(volreq, l.WELL_KNOWN_LABELS) is not None:
+                return None
+            combined.add(*volreq.values())
+        alloc = None
+        if self._dra is not None and pod.spec.resource_claims:
+            alloc = self._dra.try_allocate(
+                pod, hostname, tmpl.nodepool_name, combined, tmpl.instance_types
+            )
+            if alloc is None or combined.compatible(alloc.requirements, l.WELL_KNOWN_LABELS) is not None:
+                return None
+            combined.add(*alloc.requirements.values())
+        tightened = self.topology.add_requirements(pod, strict, combined)
+        if tightened is None or combined.compatible(tightened, l.WELL_KNOWN_LABELS) is not None:
+            return None
+        total = res.merge(tmpl.daemon_requests, pod.total_requests())
+        candidates = self._within_budget(tmpl, tmpl.instance_types)
+        remaining = filter_instance_types(
+            candidates, tightened, total,
+            relax_min_values=self.min_values_policy == "BestEffort",
+        )
+        if alloc is not None:
+            surviving = set(alloc.instance_types)
+            remaining = [it for it in remaining if it.name in surviving]
+        if not remaining:
+            return None
+        new_ids = self._reserve_for(hostname, remaining, tightened, frozenset())
+        if new_ids is None:
+            return None
+        if alloc is not None:
+            self._dra.commit(alloc, hostname, {it.name for it in remaining})
+        self._charge_budget(tmpl, remaining)
+        self.topology.register(l.LABEL_HOSTNAME, hostname)
+        self.topology.record(pod, tightened)
+        from karpenter_tpu.scheduling import hostports as hp
+
+        return SimClaim(
+            template=tmpl,
+            requirements=tightened,
+            used=total,
+            instance_types=remaining,
+            pods=[pod],
+            slot=slot,
+            hostname=hostname,
+            host_ports=[hp.port_key(h) for h in pod.spec.host_ports],
+            reserved_ids=new_ids,
+        )
 
     def solve(self, pods: list[Pod]) -> SchedulingResult:
         """Solve with the shared preference relaxation ladder; per-round
@@ -515,10 +617,10 @@ class HostScheduler:
                     # the pod this loop (scheduler.go:587-589)
                     unschedulable.append((pod, err))
                     continue
+            # volume alternatives are tried inside can_add/can_add_existing
+            # against the CANDIDATE's requirements, never merged here — the
+            # pod's own affinity drives TSC counting (nodeclaim.go:168-173)
             pod_reqs = Requirements.from_pod(pod)
-            extra = self.volume_reqs.get(pod.uid)
-            if extra is not None:
-                pod_reqs.add(extra)
             strict = Requirements.from_pod(pod, include_preferred=False)
             # tier 1: existing nodes, earliest index wins (scheduler.go:594)
             placed = False

@@ -51,6 +51,27 @@ def strip_preferences(pod: Pod) -> Pod:
     return relaxed
 
 
+def terminal_relaxed(pod: Pod) -> Pod:
+    """A pod at (or beyond) the END of its relaxation ladder — the sound
+    over-approximation the batched what-if prefilter needs.
+
+    strip_preferences alone is NOT enough: the sequential ladder can also
+    drop required node-affinity OR terms (trying term k after term k-1
+    fails) and add a PreferNoSchedule Exists toleration. Here multi-term
+    required affinity is removed ENTIRELY (a superset of every OR branch,
+    since Requirements.from_pod binds only required[0]) and the terminal
+    toleration is always added, so anything schedulable at ANY rung is
+    schedulable for this pod."""
+    relaxed = strip_preferences(pod)
+    na = relaxed.spec.node_affinity
+    if na is not None and len(na.required) > 1:
+        na.required = []
+    relaxed.spec.tolerations = list(relaxed.spec.tolerations) + [
+        Toleration(operator=TOLERATION_OP_EXISTS, effect=PREFER_NO_SCHEDULE)
+    ]
+    return relaxed
+
+
 def rungs(pod: Pod) -> list[str]:
     """The pod-specific ladder in reference order; each entry removes one
     preference."""

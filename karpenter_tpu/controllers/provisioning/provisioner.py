@@ -103,21 +103,45 @@ class Provisioner:
     def _ready_pools(self) -> list[NodePool]:
         return [p for p in self.store.nodepools() if not p.is_static]
 
-    def _volume_requirements(self, pods: list[Pod]) -> dict:
-        """pod uid -> PVC-implied zone Requirement (volumetopology.go)."""
-        from karpenter_tpu.scheduling.hostports import volume_zone_requirement
-
+    def _volume_context(self) -> tuple[dict, dict]:
+        """(pvcs, storage classes) by name, scanned ONCE per solve entry
+        point and threaded through every volume helper."""
         pvcs = {p.name: p for p in self.store.list(self.store.PVCS)}
         classes = {s.name: s for s in self.store.list(self.store.STORAGE_CLASSES)}
+        return pvcs, classes
+
+    def _volume_requirements(self, pods: list[Pod], volctx=None) -> dict:
+        """pod uid -> PVC-implied topology alternatives
+        (volumetopology.go:65-91 GetRequirements)."""
+        from karpenter_tpu.scheduling.volumes import volume_requirement_alternatives
+
+        pvcs, classes = volctx if volctx is not None else self._volume_context()
         if not pvcs:
             return {}
         out = {}
         for pod in pods:
             if not pod.spec.pvc_names:
                 continue
-            req = volume_zone_requirement(pod, pvcs, classes)
-            if req is not None:
-                out[pod.uid] = req
+            alts = volume_requirement_alternatives(pod, pvcs, classes)
+            if alts:
+                out[pod.uid] = alts
+        return out
+
+    def _pod_volumes(self, pods: list[Pod], volctx=None) -> dict:
+        """pod uid -> CSI Volumes (driver -> pvc ids) for attach-limit
+        checks (volumeusage.go:82-113 GetVolumes)."""
+        from karpenter_tpu.scheduling.volumes import get_volumes
+
+        pvcs, classes = volctx if volctx is not None else self._volume_context()
+        if not pvcs:
+            return {}
+        out = {}
+        for pod in pods:
+            if not pod.spec.pvc_names:
+                continue
+            vols = get_volumes(pod, pvcs, classes)
+            if vols:
+                out[pod.uid] = vols
         return out
 
     def _bound_pods(self, excluded_nodes: Optional[set[str]] = None) -> list[tuple]:
@@ -196,7 +220,8 @@ class Provisioner:
         pods = self.pending_pods() + extra_pods
         if not pods:
             return SchedulingResult(claims=[], unschedulable=[], assignments={})
-        existing = self._existing_sim_nodes(excluded_node_names)
+        volctx = self._volume_context()
+        existing = self._existing_sim_nodes(excluded_node_names, volctx)
         # pods displaced off the excluded nodes are migrating: their claims'
         # devices are freed and re-allocated in the what-if
         dra_problem = self._build_dra_problem(
@@ -207,7 +232,8 @@ class Provisioner:
             existing,
             self._remaining_budgets(),
             topology_factory=lambda ps: self._build_topology(ps, scheduler, excluded_node_names),
-            volume_reqs=self._volume_requirements(pods),
+            volume_reqs=self._volume_requirements(pods, volctx),
+            pod_volumes=self._pod_volumes(pods, volctx),
             reserved_in_use=self._reserved_in_use(),
             dra_problem=dra_problem,
         )
@@ -228,7 +254,7 @@ class Provisioner:
         scheduler = self._build_scheduler()
         if scheduler is None or not self.cluster.synced() or not scenarios:
             return None
-        from karpenter_tpu.controllers.provisioning.preferences import strip_preferences
+        from karpenter_tpu.controllers.provisioning.preferences import terminal_relaxed
 
         pending = self.pending_pods()
         union: dict[str, Pod] = {}
@@ -241,21 +267,28 @@ class Provisioner:
             displaced_uids = {p.uid for p in displaced}
             active = {p.uid for p in pending} | displaced_uids
             specs.append((excluded, active, displaced_uids))
-        all_pods = [strip_preferences(p) for p in pending + list(union.values())]
+        # terminal_relaxed (not strip_preferences): the batch must be a
+        # sound over-approximation of EVERY rung of the sequential ladder,
+        # including dropped required OR terms and the PreferNoSchedule
+        # toleration, or batch-infeasible verdicts wrongly kill candidates
+        all_pods = [terminal_relaxed(p) for p in pending + list(union.values())]
         if self.dynamic_resources_enabled and any(p.spec.resource_claims for p in all_pods):
             return None
-        existing = self._existing_sim_nodes()
+        volctx = self._volume_context()
+        existing = self._existing_sim_nodes(volctx=volctx)
         return scheduler.whatif_batch(
             all_pods,
             existing,
             self._remaining_budgets(),
             specs,
             lambda ps, excluded: self._build_topology(ps, scheduler, excluded),
-            volume_reqs=self._volume_requirements(all_pods),
+            volume_reqs=self._volume_requirements(all_pods, volctx),
             reserved_in_use=self._reserved_in_use(),
         )
 
-    def _existing_sim_nodes(self, excluded: Optional[set[str]] = None) -> list[ExistingSimNode]:
+    def _existing_sim_nodes(
+        self, excluded: Optional[set[str]] = None, volctx=None
+    ) -> list[ExistingSimNode]:
         """Registered, schedulable cluster nodes as tier-1 candidates
         (scheduler.go:1060 calculateExistingNodeClaims), sorted by name for
         deterministic earliest-index-wins."""
@@ -271,6 +304,9 @@ class Provisioner:
                 if target is not None:
                     reserved[target] = res.merge(reserved.get(target), p.total_requests())
 
+        from karpenter_tpu.scheduling.volumes import VolumeUsage, get_volumes
+
+        pvcs, classes = volctx if volctx is not None else self._volume_context()
         out = []
         for sn in sorted(self.cluster.nodes(), key=lambda s: s.name):
             node = sn.node
@@ -284,6 +320,19 @@ class Provisioner:
             available = sn.available()
             if node.name in reserved:
                 available = res.subtract(available, reserved[node.name])
+            usage = None
+            if node.spec.csi_drivers:
+                # CSINode-published attach limits + resident pods' volumes
+                # (cluster.go:845-857 populateVolumeLimits)
+                usage = VolumeUsage()
+                for driver, count in node.spec.csi_drivers.items():
+                    usage.add_limit(driver, count)
+                for pod in sn.pods.values():
+                    if pod.is_terminal() or not pod.spec.pvc_names:
+                        continue
+                    vols = get_volumes(pod, pvcs, classes)
+                    if vols:
+                        usage.add(pod.uid, vols)
             out.append(
                 ExistingSimNode(
                     name=node.name,
@@ -291,6 +340,7 @@ class Provisioner:
                     requirements=reqs,
                     available=available,
                     taints=list(node.spec.taints),
+                    volume_usage=usage,
                 )
             )
         return out
@@ -527,12 +577,14 @@ class Provisioner:
             # that can't get a reservation retries next loop instead of
             # launching paid capacity; disruption simulations keep the
             # fallback default (strict would stalemate drift)
+            volctx = self._volume_context()
             result = scheduler.solve(
                 pods,
-                self._existing_sim_nodes(),
+                self._existing_sim_nodes(volctx=volctx),
                 self._remaining_budgets(),
                 topology_factory=lambda ps: self._build_topology(ps, scheduler),
-                volume_reqs=self._volume_requirements(pods),
+                volume_reqs=self._volume_requirements(pods, volctx),
+                pod_volumes=self._pod_volumes(pods, volctx),
                 reserved_mode="strict",
                 reserved_in_use=self._reserved_in_use(),
                 dra_problem=self._build_dra_problem(pods),

@@ -29,6 +29,7 @@ from karpenter_tpu.controllers.provisioning.host_scheduler import (
     SimClaim,
     ffd_sort,
     hostname_placeholder,
+    normalize_volume_reqs,
 )
 from karpenter_tpu.controllers.provisioning.nodeclaimtemplate import ClaimTemplate
 from karpenter_tpu.controllers.provisioning.topology import Topology, build_universe_domains
@@ -40,6 +41,11 @@ from karpenter_tpu.ops.encode import ProblemEncoder, encode_requirements
 from karpenter_tpu.scheduling import Operator, Requirement, Requirements
 from karpenter_tpu.scheduling.taints import tolerates_all
 from karpenter_tpu.utils import resources as res
+
+
+class DivergenceError(RuntimeError):
+    """Device decode disagreed with the host algebra; the solve falls back
+    to the host oracle (never aborts provisioning)."""
 
 
 def _next_pow2(n: int, floor: int = 8) -> int:
@@ -249,6 +255,7 @@ class TPUScheduler:
         reserved_mode: Optional[str] = None,
         reserved_in_use: Optional[dict[str, int]] = None,
         dra_problem=None,
+        pod_volumes: Optional[dict] = None,
     ) -> SchedulingResult:
         """Solve with the preference relaxation ladder (preferences.go:38):
         each failing pod sheds ONE preference per round (shared loop in
@@ -264,29 +271,63 @@ class TPUScheduler:
 
         from karpenter_tpu.controllers.provisioning import preferences as prefs
 
-        if dra_problem is not None and any(p.spec.resource_claims for p in pods):
-            # DRA pods need the device-allocation DFS — deep, data-dependent
-            # control flow with per-claim state that has no scan-friendly
-            # shape. The host oracle is authoritative for these solves; the
-            # device kernel keeps handling the claim-free hot path.
+        norm_vol = normalize_volume_reqs(volume_reqs)
+
+        def host_solve(reason: str) -> SchedulingResult:
+            from karpenter_tpu.utils.metrics import SOLVER_HOST_FALLBACKS
+
+            SOLVER_HOST_FALLBACKS.inc(reason=reason)
             host = HostScheduler(
                 self.templates,
-                existing_nodes=list(existing_nodes or []),
+                existing_nodes=[n.clone() for n in (existing_nodes or [])],
                 budgets=budgets,
                 topology=(
                     topology_factory(list(pods)) if topology_factory is not None else topology
                 ),
-                volume_reqs=volume_reqs,
+                volume_reqs=norm_vol,
                 reserved_mode=reserved_mode if reserved_mode is not None else self.reserved_mode,
                 reserved_capacity_enabled=self.reserved_capacity_enabled,
                 min_values_policy=self.min_values_policy,
                 reserved_in_use=reserved_in_use,
                 dra_problem=dra_problem,
+                pod_volumes=pod_volumes,
             )
             return host.solve(list(pods))
 
+        if dra_problem is not None and any(p.spec.resource_claims for p in pods):
+            # DRA pods need the device-allocation DFS — deep, data-dependent
+            # control flow with per-claim state that has no scan-friendly
+            # shape. The host oracle is authoritative for these solves; the
+            # device kernel keeps handling the claim-free hot path.
+            return host_solve("dra")
+        if any(len(alts) > 1 for alts in norm_vol.values()):
+            # combinatorial volume-topology alternatives need the per-pod
+            # try-each-alternative loop (nodeclaim.go:149-161); the device
+            # kernel folds exactly one restriction per pod
+            return host_solve("volume_alternatives")
+        if pod_volumes and any(
+            n.volume_usage is not None and n.volume_usage.limits
+            for n in (existing_nodes or [])
+        ):
+            # CSI attach limits count DISTINCT pvc ids across co-resident
+            # pods (volumeusage.go:201-208) — host-exact for now
+            return host_solve("volume_limits")
+        if norm_vol and existing_nodes:
+            # the host checks volume requirements against existing nodes
+            # with well-known-label leniency (existingnode.go:150); the
+            # device folds them into the strict pod-reqs check. Identical
+            # when every node defines the keys — route the rare
+            # undefined-key case to the host to preserve parity
+            vol_keys = {
+                r.key for alts in norm_vol.values() for a in alts for r in a.values()
+            }
+            if any(
+                not n.requirements.has(k) for n in existing_nodes for k in vol_keys
+            ):
+                return host_solve("volume_undefined_key")
+
         base_existing = list(existing_nodes or [])
-        self._volume_reqs = volume_reqs or {}
+        self._volume_reqs = norm_vol
         self._reserved_in_use = reserved_in_use or {}
 
         def solve_round(current: list[Pod]) -> SchedulingResult:
@@ -305,6 +346,11 @@ class TPUScheduler:
             self.reserved_mode = reserved_mode
         try:
             return prefs.run_with_relaxation(list(pods), solve_round)
+        except DivergenceError:
+            # the reference never aborts a Solve — a device/host decode
+            # divergence re-solves the whole problem on the exact oracle
+            # and records the event instead of failing provisioning
+            return host_solve("divergence")
         finally:
             self.reserved_mode = prev_mode
 
@@ -322,11 +368,17 @@ class TPUScheduler:
         import dataclasses
         import json
 
-        vol = self._volume_reqs.get(pod.uid)
+        alts = self._volume_reqs.get(pod.uid)
         vol_sig = (
             None
-            if vol is None
-            else (vol.key, vol.complement, tuple(sorted(vol.values)), vol.gte, vol.lte)
+            if not alts
+            else tuple(
+                tuple(
+                    (r.key, r.complement, tuple(sorted(r.values)), r.gte, r.lte)
+                    for r in sorted(a.values(), key=lambda r: r.key)
+                )
+                for a in alts
+            )
         )
         return (
             json.dumps(dataclasses.asdict(pod.spec), sort_keys=True, default=str),
@@ -340,9 +392,11 @@ class TPUScheduler:
         topology folds into the NODE side via the combine, not into strict
         requirements, so TSC counting ignores it — volumetopology.go)."""
         reqs = Requirements.from_pod(pod)
-        extra = self._volume_reqs.get(pod.uid)
-        if extra is not None:
-            reqs.add(extra)
+        alts = self._volume_reqs.get(pod.uid)
+        if alts:
+            # the device path only runs single-alternative problems (multi
+            # routes to the host oracle in solve())
+            reqs.add(*alts[0].values())
         return reqs
 
     def _solve_once(
@@ -410,7 +464,24 @@ class TPUScheduler:
         """
         import numpy as _np
 
-        self._volume_reqs = volume_reqs or {}
+        self._volume_reqs = normalize_volume_reqs(volume_reqs)
+        if any(len(alts) > 1 for alts in self._volume_reqs.values()):
+            # multi-alternative volume topologies need the host's
+            # try-each loop — decline, callers simulate sequentially
+            return None
+        if any(
+            n.volume_usage is not None and n.volume_usage.limits for n in existing_nodes
+        ) and any(p.spec.pvc_names for p in pods):
+            return None
+        if self._volume_reqs and existing_nodes:
+            # same undefined-key parity guard as solve()
+            vol_keys = {
+                r.key for alts in self._volume_reqs.values() for a in alts for r in a.values()
+            }
+            if any(
+                not n.requirements.has(k) for n in existing_nodes for k in vol_keys
+            ):
+                return None
         self._reserved_in_use = reserved_in_use or {}
         pods = list(pods)
         topo0 = topology_factory(pods, scenarios[0][0])
@@ -560,11 +631,11 @@ class TPUScheduler:
 
         for p in reps:
             self.encoder.observe_pod(p)
-            extra = self._volume_reqs.get(p.uid)
-            if extra is not None:
-                self.encoder.vocab.add_key(extra.key)
-                for v in extra.values:
-                    self.encoder.vocab.add_value(extra.key, v)
+            for alt in self._volume_reqs.get(p.uid) or ():
+                for r in alt.values():
+                    self.encoder.vocab.add_key(r.key)
+                    for v in r.values:
+                        self.encoder.vocab.add_value(r.key, v)
         for n in self.existing_nodes:
             self.encoder.observe_requirements(n.requirements)
             self.encoder.observe_resources(n.available)
@@ -849,7 +920,7 @@ class TPUScheduler:
                 base.add(*pod_reqs.values())
                 tightened = topo.add_requirements(pod, strict, base)
                 if tightened is None:
-                    raise RuntimeError(
+                    raise DivergenceError(
                         f"device/host divergence: topology rejected pod {pod.name} "
                         f"on existing node {node.name}"
                     )
@@ -888,7 +959,7 @@ class TPUScheduler:
             combined.add(*pod_reqs.values())
             tightened = topo.add_requirements(pod, strict, combined)
             if tightened is None:
-                raise RuntimeError(
+                raise DivergenceError(
                     f"device/host divergence: topology rejected pod {pod.name} "
                     f"on claim slot {slot}"
                 )

@@ -20,6 +20,10 @@ class NodeSpec:
     provider_id: str = ""
     taints: list[Taint] = field(default_factory=list)
     unschedulable: bool = False
+    # CSINode analog: per-driver volume attach limits published by the
+    # node's kubelet (csinode.spec.drivers[].allocatable.count; consumed by
+    # cluster.go:845-857 populateVolumeLimits)
+    csi_drivers: dict[str, int] = field(default_factory=dict)
 
 
 @dataclass

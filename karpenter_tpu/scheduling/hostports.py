@@ -1,16 +1,12 @@
-"""Host-port conflict tracking and volume topology requirements.
+"""Host-port conflict tracking + the PVC/StorageClass object models.
 
-Counterparts of reference pkg/scheduling/hostportusage.go:35-97 and
-volumetopology.go:65-141.
+Counterpart of reference pkg/scheduling/hostportusage.go:35-97: two pods
+exposing the same (hostIP, port, protocol) cannot share a node; "0.0.0.0"
+conflicts with every IP.
 
-Host ports: two pods exposing the same (hostIP, port, protocol) cannot
-share a node; "0.0.0.0" conflicts with every IP.
-
-Volume topology: each PVC restricts the pod to the zones its storage class
-allows (a bound volume pins a single zone); the pod's effective zone
-requirement is the intersection across its PVCs. (The reference builds
-combinatorial alternatives when classes list multiple allowed topologies —
-this port collapses to the intersection, the single-combination case.)
+Volume-topology ALTERNATIVES and CSI attach-limit tracking live in
+scheduling/volumes.py (volumetopology.go / volumeusage.go counterparts);
+this module keeps the storage object models they consume.
 """
 
 from __future__ import annotations
@@ -18,10 +14,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Iterable, Optional
 
-from karpenter_tpu.models import labels as l
 from karpenter_tpu.models.objects import ObjectMeta
 from karpenter_tpu.models.pod import HostPort, Pod
-from karpenter_tpu.scheduling.requirements import Operator, Requirement
 
 WILDCARD_IP = "0.0.0.0"
 
@@ -52,6 +46,11 @@ def conflicts(existing: Iterable[tuple[str, int, str]], pod: Pod) -> bool:
 class StorageClass:
     metadata: ObjectMeta = field(default_factory=lambda: ObjectMeta(name="standard"))
     zones: Optional[list[str]] = None  # allowedTopologies; None = any zone
+    # CSI driver name, the attach-limit tracking key (volumeusage.go:156)
+    provisioner: str = ""
+    # full allowedTopologies: each term (key -> values dict) is one OR'd
+    # alternative (volumetopology.go:176-186); overrides `zones` when set
+    allowed_topologies: Optional[list[dict]] = None
 
     @property
     def name(self) -> str:
@@ -63,35 +62,10 @@ class PersistentVolumeClaim:
     metadata: ObjectMeta = field(default_factory=lambda: ObjectMeta(name="pvc"))
     storage_class: str = "standard"
     bound_zone: Optional[str] = None  # a bound volume pins its zone
+    # bound PV's CSI driver (ResolveDriver's driverFromVolume path,
+    # volumeusage.go:168-180); None = resolve via the storage class
+    driver: Optional[str] = None
 
     @property
     def name(self) -> str:
         return self.metadata.name
-
-
-def volume_zone_requirement(
-    pod: Pod,
-    pvcs_by_name: dict[str, PersistentVolumeClaim],
-    classes_by_name: dict[str, StorageClass],
-) -> Optional[Requirement]:
-    """The pod's zone requirement implied by its PVCs, or None.
-
-    Unknown PVCs/classes impose no constraint (they may not exist yet —
-    the reference defers those pods, we schedule permissively).
-    """
-    allowed: Optional[set[str]] = None
-    for name in pod.spec.pvc_names:
-        pvc = pvcs_by_name.get(name)
-        if pvc is None:
-            continue
-        if pvc.bound_zone is not None:
-            zones = {pvc.bound_zone}
-        else:
-            sc = classes_by_name.get(pvc.storage_class)
-            if sc is None or sc.zones is None:
-                continue
-            zones = set(sc.zones)
-        allowed = zones if allowed is None else (allowed & zones)
-    if allowed is None:
-        return None
-    return Requirement.new(l.LABEL_TOPOLOGY_ZONE, Operator.IN, *sorted(allowed))

@@ -1,0 +1,184 @@
+"""CSI volume attach-limit tracking and volume-topology alternatives.
+
+Counterparts of reference pkg/scheduling/volumeusage.go:45-230 (per-node
+per-driver distinct-PVC attach limits) and
+pkg/controllers/provisioning/scheduling/volumetopology.go:65-225 (per-volume
+topology requirement ALTERNATIVES, merged across a pod's volumes by
+compatible cross-product with a full-product fallback).
+
+Volumes are tracked as driver -> set of PVC ids; two pods mounting the same
+PVC consume one attachment. Limits come from the node's CSINode-published
+per-driver allocatable counts (cluster.go:845-857 populateVolumeLimits);
+drivers without a published limit are unconstrained. Only existing nodes
+enforce limits (existingnode.go:88) — a new NodeClaim has no CSINode yet.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.scheduling.requirements import Operator, Requirement, Requirements
+
+# Volumes: driver name -> set of PVC ids (volumeusage.go:45)
+Volumes = dict
+
+
+def vol_union(a: Volumes, b: Volumes) -> Volumes:
+    """Union two driver->pvc-set maps (volumeusage.go:56-70)."""
+    out = {k: set(v) for k, v in a.items()}
+    for k, v in b.items():
+        out.setdefault(k, set()).update(v)
+    return out
+
+
+class VolumeUsage:
+    """Per-node attach tracking (volumeusage.go:187-229): the union of every
+    resident pod's volumes, a per-pod index for removal, and per-driver
+    limits from the node's CSINode."""
+
+    def __init__(self):
+        self.volumes: Volumes = {}
+        self.pod_volumes: dict[str, Volumes] = {}
+        self.limits: dict[str, int] = {}
+
+    def add_limit(self, driver: str, count: int) -> None:
+        self.limits[driver] = count
+
+    def exceeds_limits(self, vols: Volumes) -> Optional[str]:
+        """Error string when adding vols would push any limited driver over
+        its distinct-volume cap (volumeusage.go:201-208), else None."""
+        for driver, pvcs in vol_union(self.volumes, vols).items():
+            limit = self.limits.get(driver)
+            if limit is not None and len(pvcs) > limit:
+                return (
+                    f"would exceed volume limit, provisioner={driver} "
+                    f"volume-count={len(pvcs)} volume-limit={limit}"
+                )
+        return None
+
+    def add(self, pod_uid: str, vols: Volumes) -> None:
+        self.pod_volumes[pod_uid] = {k: set(v) for k, v in vols.items()}
+        self.volumes = vol_union(self.volumes, vols)
+
+    def delete_pod(self, pod_uid: str) -> None:
+        """Rebuild from scratch — pvc ids may be shared (volumeusage.go:222)."""
+        self.pod_volumes.pop(pod_uid, None)
+        self.volumes = {}
+        for vols in self.pod_volumes.values():
+            self.volumes = vol_union(self.volumes, vols)
+
+    def copy(self) -> "VolumeUsage":
+        out = VolumeUsage()
+        out.volumes = {k: set(v) for k, v in self.volumes.items()}
+        out.pod_volumes = {
+            uid: {k: set(v) for k, v in vols.items()} for uid, vols in self.pod_volumes.items()
+        }
+        out.limits = dict(self.limits)
+        return out
+
+
+def get_volumes(pod: Pod, pvcs_by_name: dict, classes_by_name: dict) -> Volumes:
+    """The pod's CSI volumes as driver -> {pvc ids} (GetVolumes,
+    volumeusage.go:82-113). Driver resolution (ResolveDriver,
+    volumeusage.go:115-152): a bound PVC uses its PV's CSI driver (modeled
+    as pvc.driver); an unbound PVC uses its StorageClass provisioner.
+    Unknown PVCs/classes and empty driver names are skipped — non-CSI or
+    already-deleted volumes don't count against limits."""
+    out: Volumes = {}
+    for name in pod.spec.pvc_names:
+        pvc = pvcs_by_name.get(name)
+        if pvc is None:
+            continue
+        driver = getattr(pvc, "driver", None)
+        if driver is None:
+            sc = classes_by_name.get(pvc.storage_class)
+            driver = getattr(sc, "provisioner", "") if sc is not None else ""
+        if driver:
+            out.setdefault(driver, set()).add(pvc.name)
+    return out
+
+
+def _term_requirements(term: dict) -> Requirements:
+    """One topology term (key -> allowed values) as a Requirements set."""
+    reqs = Requirements()
+    for key, values in term.items():
+        reqs.add(Requirement.new(key, Operator.IN, *values))
+    return reqs
+
+
+def _volume_alternatives(pvc, classes_by_name: dict) -> list[Requirements]:
+    """Topology alternatives for one PVC (getRequirements,
+    volumetopology.go:143-170): a bound volume pins its zone (the PV
+    node-affinity path); an unbound PVC takes one alternative per
+    StorageClass allowed-topology term (each term is OR'd,
+    volumetopology.go:172-190)."""
+    if pvc.bound_zone is not None:
+        reqs = Requirements()
+        reqs.add(Requirement.new(l.LABEL_TOPOLOGY_ZONE, Operator.IN, pvc.bound_zone))
+        return [reqs]
+    sc = classes_by_name.get(pvc.storage_class)
+    if sc is None:
+        return []
+    terms = getattr(sc, "allowed_topologies", None)
+    if terms:
+        return [_term_requirements(t) for t in terms]
+    if sc.zones is not None:
+        # single term over the zone key (the common case)
+        reqs = Requirements()
+        reqs.add(Requirement.new(l.LABEL_TOPOLOGY_ZONE, Operator.IN, *sorted(sc.zones)))
+        return [reqs]
+    return []
+
+
+def _compatible(a: Optional[Requirements], b: Optional[Requirements]) -> bool:
+    if a is None or b is None:
+        return True
+    return a.intersects(b) is None
+
+
+def _merge(a: Optional[Requirements], b: Requirements) -> Requirements:
+    merged = Requirements()
+    if a is not None:
+        merged.add(*a.values())
+    merged.add(*b.values())
+    return merged
+
+
+def merge_alternatives(
+    alternatives: list[Optional[Requirements]], vol_alts: list[Requirements]
+) -> list[Requirements]:
+    """Cross-product merge of per-volume alternatives
+    (mergeVolumeRequirementAlternatives, volumetopology.go:93-126): prefer
+    only compatible branches; when every branch is incompatible keep the
+    full product so the pod stays schedulable-looking (the reference keeps
+    it for metrics/decision parity)."""
+    compat = [
+        _merge(existing, va)
+        for existing in alternatives
+        for va in vol_alts
+        if _compatible(existing, va)
+    ]
+    if compat:
+        return compat
+    return [_merge(existing, va) for existing in alternatives for va in vol_alts]
+
+
+def volume_requirement_alternatives(
+    pod: Pod, pvcs_by_name: dict, classes_by_name: dict
+) -> list[Requirements]:
+    """All valid topology-requirement combinations for the pod's volumes
+    (GetRequirements, volumetopology.go:65-91), or [] when unconstrained."""
+    alternatives: list[Optional[Requirements]] = [None]
+    for name in pod.spec.pvc_names:
+        pvc = pvcs_by_name.get(name)
+        if pvc is None:
+            continue
+        vol_alts = _volume_alternatives(pvc, classes_by_name)
+        if not vol_alts:
+            continue
+        alternatives = merge_alternatives(alternatives, vol_alts)
+    if len(alternatives) == 1 and alternatives[0] is None:
+        return []
+    return [a for a in alternatives if a is not None]

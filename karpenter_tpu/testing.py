@@ -1,0 +1,61 @@
+"""Shared simulation fixtures for tests and benchmarks.
+
+The analog of the reference's pkg/test fixture package: canonical small
+clusters built through the real kwok provider + manager loop, so tests and
+benchmarks measure the same bootstrap the parity suites pin.
+"""
+
+from __future__ import annotations
+
+
+class FakeCandidate:
+    """The minimal candidate surface simulate_batch consumes."""
+
+    def __init__(self, name, pods):
+        self.name = name
+        self.reschedulable_pods = pods
+
+
+def build_bound_cluster(n_pods: int = 6, pod_cpu: float = 2.0, catalog=None):
+    """A cluster of kwok nodes with bound pods pinned to the 4-cpu type
+    (2-cpu pods: one node per pod, so consolidation has work to find).
+
+    Returns (clock, store, cloud, mgr) with every pod bound.
+    """
+    from karpenter_tpu.cloudprovider.fake import new_instance_type
+    from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+    from karpenter_tpu.controllers.manager import KubeSchedulerSim, Manager
+    from karpenter_tpu.models import labels as l
+    from karpenter_tpu.models.nodepool import NodePool
+    from karpenter_tpu.models.pod import make_pod
+    from karpenter_tpu.state.store import ObjectStore
+    from karpenter_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    store = ObjectStore(clock)
+    if catalog is None:
+        catalog = [new_instance_type("n-4x", cpu=4), new_instance_type("n-8x", cpu=8)]
+    cloud = KwokCloudProvider(store, catalog=catalog)
+    mgr = Manager(store, cloud, clock)
+    store.create(ObjectStore.NODEPOOLS, NodePool())
+    for i in range(n_pods):
+        store.create(
+            ObjectStore.PODS,
+            make_pod(f"p{i}", cpu=pod_cpu, node_selector={l.LABEL_INSTANCE_TYPE: "n-4x"}),
+        )
+    mgr.run_until_idle()
+    cloud.simulate_kubelet_ready()
+    mgr.run_until_idle()
+    KubeSchedulerSim(store, mgr.cluster).bind_pending()
+    mgr.run_until_idle()
+    assert all(p.spec.node_name for p in store.pods())
+    return clock, store, cloud, mgr
+
+
+def node_candidates(store):
+    """One FakeCandidate per node carrying bound pods, sorted by name."""
+    by_node: dict[str, list] = {}
+    for p in store.pods():
+        if p.spec.node_name:
+            by_node.setdefault(p.spec.node_name, []).append(p)
+    return [FakeCandidate(name, pods) for name, pods in sorted(by_node.items())]

@@ -174,6 +174,11 @@ SCHEDULING_DURATION = REGISTRY.histogram(
 SCHEDULING_UNSCHEDULABLE = REGISTRY.gauge(
     "karpenter_scheduler_unschedulable_pods_count", "Pods the last solve could not place"
 )
+SOLVER_HOST_FALLBACKS = REGISTRY.counter(
+    "karpenter_solver_host_fallback_total",
+    "Solves routed to the host oracle instead of the device kernel",
+    ("reason",),
+)
 DISRUPTION_EVAL_DURATION = REGISTRY.histogram(
     "karpenter_disruption_evaluation_duration_seconds", "Disruption pass wall time", ("method",)
 )

@@ -782,12 +782,8 @@ class TestHostPortsAndVolumes:
         assert host.node_count == 1
 
     def test_volume_zone_requirement(self):
-        from karpenter_tpu.scheduling import Requirement
-        from karpenter_tpu.scheduling.hostports import (
-            PersistentVolumeClaim,
-            StorageClass,
-            volume_zone_requirement,
-        )
+        from karpenter_tpu.scheduling.hostports import PersistentVolumeClaim, StorageClass
+        from karpenter_tpu.scheduling.volumes import volume_requirement_alternatives
 
         pod = make_pod("p", cpu=0.25)
         pod.spec.pvc_names = ["data"]
@@ -795,11 +791,12 @@ class TestHostPortsAndVolumes:
         pvc.metadata.name = "data"
         sc = StorageClass(zones=["test-zone-2"])
         sc.metadata.name = "zonal"
-        req = volume_zone_requirement(pod, {"data": pvc}, {"zonal": sc})
-        assert sorted(req.values) == ["test-zone-2"]
+        alts = volume_requirement_alternatives(pod, {"data": pvc}, {"zonal": sc})
+        assert len(alts) == 1
+        assert sorted(alts[0].get(l.LABEL_TOPOLOGY_ZONE).values) == ["test-zone-2"]
 
         templates = build_templates([(default_pool(), instance_types(16))])
-        vol = {pod.uid: req}
+        vol = {pod.uid: alts}
         host = HostScheduler(templates, volume_reqs=vol).solve([pod])
         tpu = TPUScheduler(templates).solve([pod], volume_reqs=vol)
         assert_same_packing(host, tpu)
@@ -807,17 +804,16 @@ class TestHostPortsAndVolumes:
             assert sorted(c.requirements.get(l.LABEL_TOPOLOGY_ZONE).values) == ["test-zone-2"]
 
     def test_bound_pvc_pins_zone(self):
-        from karpenter_tpu.scheduling.hostports import (
-            PersistentVolumeClaim,
-            volume_zone_requirement,
-        )
+        from karpenter_tpu.scheduling.hostports import PersistentVolumeClaim
+        from karpenter_tpu.scheduling.volumes import volume_requirement_alternatives
 
         pod = make_pod("p")
         pod.spec.pvc_names = ["data"]
         pvc = PersistentVolumeClaim(bound_zone="test-zone-3")
         pvc.metadata.name = "data"
-        req = volume_zone_requirement(pod, {"data": pvc}, {})
-        assert sorted(req.values) == ["test-zone-3"]
+        alts = volume_requirement_alternatives(pod, {"data": pvc}, {})
+        assert len(alts) == 1
+        assert sorted(alts[0].get(l.LABEL_TOPOLOGY_ZONE).values) == ["test-zone-3"]
 
 
 class TestPackingQuality:

@@ -1,0 +1,337 @@
+"""CSI volume attach limits + combinatorial volume-topology alternatives.
+
+Mirrors reference pkg/scheduling/volumeusage.go behavior (distinct-PVC
+per-driver limits on existing nodes) and volumetopology.go's alternatives
+loop (try zone B after zone A fails)."""
+
+import pytest
+
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.controllers.provisioning import (
+    HostScheduler,
+    TPUScheduler,
+    build_templates,
+)
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import make_pod
+from karpenter_tpu.scheduling.hostports import PersistentVolumeClaim, StorageClass
+from karpenter_tpu.scheduling.requirements import Operator, Requirement, Requirements
+from karpenter_tpu.scheduling.volumes import (
+    VolumeUsage,
+    get_volumes,
+    merge_alternatives,
+    vol_union,
+    volume_requirement_alternatives,
+)
+from karpenter_tpu.utils import resources as res
+
+from tests.test_solver import default_pool, make_existing
+
+
+def pvc(name, storage_class="standard", bound_zone=None, driver=None):
+    p = PersistentVolumeClaim(storage_class=storage_class, bound_zone=bound_zone, driver=driver)
+    p.metadata.name = name
+    return p
+
+
+def sc(name, zones=None, provisioner="", allowed_topologies=None):
+    s = StorageClass(zones=zones, provisioner=provisioner, allowed_topologies=allowed_topologies)
+    s.metadata.name = name
+    return s
+
+
+class TestVolumeUsage:
+    def test_union_dedups_shared_pvcs(self):
+        a = {"ebs.csi.aws.com": {"pvc-1"}}
+        b = {"ebs.csi.aws.com": {"pvc-1", "pvc-2"}}
+        assert vol_union(a, b) == {"ebs.csi.aws.com": {"pvc-1", "pvc-2"}}
+
+    def test_exceeds_limits(self):
+        u = VolumeUsage()
+        u.add_limit("d", 2)
+        u.add("pod-1", {"d": {"v1", "v2"}})
+        assert u.exceeds_limits({"d": {"v3"}}) is not None
+        # a shared pvc doesn't count twice
+        assert u.exceeds_limits({"d": {"v1"}}) is None
+        # unlimited driver never blocks
+        assert u.exceeds_limits({"other": {"x", "y", "z"}}) is None
+
+    def test_delete_pod_rebuilds(self):
+        u = VolumeUsage()
+        u.add("pod-1", {"d": {"v1"}})
+        u.add("pod-2", {"d": {"v1", "v2"}})
+        u.delete_pod("pod-2")
+        assert u.volumes == {"d": {"v1"}}
+        u.delete_pod("pod-1")
+        assert u.volumes == {}
+
+    def test_copy_is_deep(self):
+        u = VolumeUsage()
+        u.add_limit("d", 1)
+        u.add("pod-1", {"d": {"v1"}})
+        c = u.copy()
+        c.add("pod-2", {"d": {"v2"}})
+        assert u.volumes == {"d": {"v1"}}
+        assert c.exceeds_limits({}) is not None
+
+    def test_get_volumes_driver_resolution(self):
+        pod = make_pod("p")
+        pod.spec.pvc_names = ["a", "b", "c", "missing"]
+        pvcs = {
+            # bound PV's CSI driver wins (volumeusage.go:168-180)
+            "a": pvc("a", storage_class="zonal", driver="pv.csi"),
+            # unbound resolves via the class provisioner
+            "b": pvc("b", storage_class="zonal"),
+            # class without provisioner -> untracked (non-CSI)
+            "c": pvc("c", storage_class="plain"),
+        }
+        classes = {"zonal": sc("zonal", provisioner="sc.csi"), "plain": sc("plain")}
+        vols = get_volumes(pod, pvcs, classes)
+        assert vols == {"pv.csi": {"a"}, "sc.csi": {"b"}}
+
+
+class TestAttachLimits:
+    def test_limit_forces_second_node(self):
+        """Existing node takes one PVC-bearing pod, the second pod's volume
+        would exceed the driver limit -> a new claim opens."""
+        templates = build_templates([(default_pool(), instance_types(8))])
+        pods = []
+        pod_volumes = {}
+        for i in range(2):
+            p = make_pod(f"p-{i}", cpu=0.25)
+            p.spec.pvc_names = [f"vol-{i}"]
+            pods.append(p)
+            pod_volumes[p.uid] = {"ebs": {f"vol-{i}"}}
+        node = make_existing("node-a", 0, cpu_avail=8.0)
+        usage = VolumeUsage()
+        usage.add_limit("ebs", 1)
+        node.volume_usage = usage
+        result = HostScheduler(
+            templates, existing_nodes=[node], pod_volumes=pod_volumes
+        ).solve(pods)
+        assert len(result.existing_assignments) == 1
+        assert len(result.claims) == 1
+        assert not result.unschedulable
+
+    def test_shared_pvc_dedups(self):
+        """Two pods mounting the SAME pvc consume one attachment."""
+        templates = build_templates([(default_pool(), instance_types(8))])
+        pods = []
+        pod_volumes = {}
+        for i in range(2):
+            p = make_pod(f"p-{i}", cpu=0.25)
+            p.spec.pvc_names = ["shared"]
+            pods.append(p)
+            pod_volumes[p.uid] = {"ebs": {"shared"}}
+        node = make_existing("node-a", 0, cpu_avail=8.0)
+        usage = VolumeUsage()
+        usage.add_limit("ebs", 1)
+        node.volume_usage = usage
+        result = HostScheduler(
+            templates, existing_nodes=[node], pod_volumes=pod_volumes
+        ).solve(pods)
+        assert len(result.existing_assignments) == 2
+        assert not result.claims
+
+    def test_unlimited_node_unaffected(self):
+        templates = build_templates([(default_pool(), instance_types(8))])
+        pods = []
+        pod_volumes = {}
+        for i in range(3):
+            p = make_pod(f"p-{i}", cpu=0.25)
+            p.spec.pvc_names = [f"vol-{i}"]
+            pods.append(p)
+            pod_volumes[p.uid] = {"ebs": {f"vol-{i}"}}
+        node = make_existing("node-a", 0, cpu_avail=8.0)  # no volume_usage
+        result = HostScheduler(
+            templates, existing_nodes=[node], pod_volumes=pod_volumes
+        ).solve(pods)
+        assert len(result.existing_assignments) == 3
+
+    def test_device_engine_routes_to_host_on_limits(self):
+        """The device kernel declines attach-limited problems; results
+        match the host oracle exactly (it IS the host oracle)."""
+        from karpenter_tpu.utils.metrics import SOLVER_HOST_FALLBACKS
+
+        templates = build_templates([(default_pool(), instance_types(8))])
+        pods = []
+        pod_volumes = {}
+        for i in range(2):
+            p = make_pod(f"p-{i}", cpu=0.25)
+            p.spec.pvc_names = [f"vol-{i}"]
+            pods.append(p)
+            pod_volumes[p.uid] = {"ebs": {f"vol-{i}"}}
+
+        def node():
+            n = make_existing("node-a", 0, cpu_avail=8.0)
+            u = VolumeUsage()
+            u.add_limit("ebs", 1)
+            n.volume_usage = u
+            return n
+
+        before = SOLVER_HOST_FALLBACKS.get(reason="volume_limits")
+        host = HostScheduler(
+            templates, existing_nodes=[node()], pod_volumes=pod_volumes
+        ).solve(list(pods))
+        tpu = TPUScheduler(templates).solve(
+            pods, existing_nodes=[node()], pod_volumes=pod_volumes
+        )
+        assert SOLVER_HOST_FALLBACKS.get(reason="volume_limits") == before + 1
+        assert len(tpu.claims) == len(host.claims) == 1
+        assert tpu.existing_assignments == host.existing_assignments
+
+
+class TestAlternatives:
+    def test_storage_class_terms_are_alternatives(self):
+        pod = make_pod("p")
+        pod.spec.pvc_names = ["data"]
+        classes = {
+            "multi": sc(
+                "multi",
+                allowed_topologies=[
+                    {l.LABEL_TOPOLOGY_ZONE: ["test-zone-1"]},
+                    {l.LABEL_TOPOLOGY_ZONE: ["test-zone-2"]},
+                ],
+            )
+        }
+        alts = volume_requirement_alternatives(pod, {"data": pvc("data", "multi")}, classes)
+        assert len(alts) == 2
+        assert sorted(next(iter(a.get(l.LABEL_TOPOLOGY_ZONE).values)) for a in alts) == [
+            "test-zone-1",
+            "test-zone-2",
+        ]
+
+    def test_bound_zone_single_alternative(self):
+        pod = make_pod("p")
+        pod.spec.pvc_names = ["data"]
+        alts = volume_requirement_alternatives(
+            pod, {"data": pvc("data", bound_zone="test-zone-2")}, {}
+        )
+        assert len(alts) == 1
+        assert alts[0].get(l.LABEL_TOPOLOGY_ZONE).values == frozenset({"test-zone-2"})
+
+    def test_compatible_cross_product_prunes(self):
+        """Two volumes: one allows zones {1,2}, the other {2,3} -> only the
+        compatible combination(s) survive (volumetopology.go:104-118)."""
+        a = Requirements()
+        a.add(Requirement.new(l.LABEL_TOPOLOGY_ZONE, Operator.IN, "z1", "z2"))
+        b1 = Requirements()
+        b1.add(Requirement.new(l.LABEL_TOPOLOGY_ZONE, Operator.IN, "z2", "z3"))
+        b2 = Requirements()
+        b2.add(Requirement.new(l.LABEL_TOPOLOGY_ZONE, Operator.IN, "z4"))
+        merged = merge_alternatives([a], [b1, b2])
+        assert len(merged) == 1
+        assert merged[0].get(l.LABEL_TOPOLOGY_ZONE).values == frozenset({"z2"})
+
+    def test_all_incompatible_keeps_full_product(self):
+        a = Requirements()
+        a.add(Requirement.new(l.LABEL_TOPOLOGY_ZONE, Operator.IN, "z1"))
+        b = Requirements()
+        b.add(Requirement.new(l.LABEL_TOPOLOGY_ZONE, Operator.IN, "z2"))
+        merged = merge_alternatives([a], [b])
+        assert len(merged) == 1  # kept, not dropped (volumetopology.go:96-102)
+
+    def test_second_zone_tried_after_first_fails(self):
+        """Alternative order is honored: zone-1 is tried first, but the
+        catalog only offers zone-2, so the pod lands there (the reference's
+        tryVolumeAlternative loop, nodeclaim.go:149-161)."""
+        pool = default_pool()
+        pool.spec.template.spec.requirements = [
+            {"key": l.LABEL_TOPOLOGY_ZONE, "operator": "In", "values": ["test-zone-2"]}
+        ]
+        templates = build_templates([(pool, instance_types(8))])
+        pod = make_pod("p", cpu=0.25)
+        pod.spec.pvc_names = ["data"]
+        alts = volume_requirement_alternatives(
+            pod,
+            {"data": pvc("data", "multi")},
+            {
+                "multi": sc(
+                    "multi",
+                    allowed_topologies=[
+                        {l.LABEL_TOPOLOGY_ZONE: ["test-zone-1"]},
+                        {l.LABEL_TOPOLOGY_ZONE: ["test-zone-2"]},
+                    ],
+                )
+            },
+        )
+        vol = {pod.uid: alts}
+        host = HostScheduler(templates, volume_reqs=vol).solve([pod])
+        assert len(host.claims) == 1
+        assert not host.unschedulable
+        zone = host.claims[0].requirements.get(l.LABEL_TOPOLOGY_ZONE).values
+        assert zone == frozenset({"test-zone-2"})
+        # device engine routes multi-alternative problems to the host oracle
+        tpu = TPUScheduler(templates).solve([pod], volume_reqs=vol)
+        assert len(tpu.claims) == 1
+        assert tpu.claims[0].requirements.get(l.LABEL_TOPOLOGY_ZONE).values == frozenset(
+            {"test-zone-2"}
+        )
+
+    def test_single_alternative_stays_on_device(self):
+        """One alternative folds into the device solve (no fallback)."""
+        from karpenter_tpu.utils.metrics import SOLVER_HOST_FALLBACKS
+
+        templates = build_templates([(default_pool(), instance_types(16))])
+        pod = make_pod("p", cpu=0.25)
+        pod.spec.pvc_names = ["data"]
+        alts = volume_requirement_alternatives(
+            pod, {"data": pvc("data", "zonal")}, {"zonal": sc("zonal", zones=["test-zone-2"])}
+        )
+        assert len(alts) == 1
+        vol = {pod.uid: alts}
+        before = SOLVER_HOST_FALLBACKS.get(reason="volume_alternatives")
+        host = HostScheduler(templates, volume_reqs=vol).solve([pod])
+        tpu = TPUScheduler(templates).solve([pod], volume_reqs=vol)
+        assert SOLVER_HOST_FALLBACKS.get(reason="volume_alternatives") == before
+        assert len(tpu.claims) == len(host.claims) == 1
+        for c in tpu.claims:
+            assert sorted(c.requirements.get(l.LABEL_TOPOLOGY_ZONE).values) == ["test-zone-2"]
+
+
+class TestProvisionerWiring:
+    def test_csinode_limits_flow_through(self):
+        """End-to-end: a node publishing csi_drivers limits fits only one
+        PVC attachment; the second pod gets a fresh claim."""
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.controllers.manager import KubeSchedulerSim, Manager
+        from karpenter_tpu.models.nodepool import NodePool
+        from karpenter_tpu.state.store import ObjectStore
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        store = ObjectStore(clock)
+        cloud = KwokCloudProvider(store, catalog=instance_types(16))
+        mgr = Manager(store, cloud, clock)
+        pool = NodePool()
+        pool.metadata.name = "default"
+        store.create(ObjectStore.NODEPOOLS, pool)
+        # land a seed pod so one node exists
+        store.create(ObjectStore.PODS, make_pod("seed", cpu=0.25))
+        mgr.run_until_idle()
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        KubeSchedulerSim(store, mgr.cluster).bind_pending()
+        mgr.run_until_idle()
+        nodes = store.nodes()
+        assert len(nodes) == 1
+        nodes[0].spec.csi_drivers = {"ebs": 1}
+        store.create(ObjectStore.STORAGE_CLASSES, sc("standard", provisioner="ebs"))
+        for i in range(2):
+            p = make_pod(f"pv-{i}", cpu=0.25)
+            p.spec.pvc_names = [f"vol-{i}"]
+            store.create(ObjectStore.PODS, p)
+            store.create(ObjectStore.PVCS, pvc(f"vol-{i}", storage_class="standard"))
+        mgr.run_until_idle()
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        bound = KubeSchedulerSim(store, mgr.cluster).bind_pending()
+        # the limited node takes at most one of the two pvc pods; a new
+        # claim covers the other
+        assert len(store.nodeclaims()) == 2
+        per_node = {}
+        for p in store.pods():
+            if p.spec.pvc_names and p.spec.node_name:
+                per_node.setdefault(p.spec.node_name, []).append(p.name)
+        assert all(len(v) == 1 for v in per_node.values())

@@ -18,44 +18,18 @@ from karpenter_tpu.state.store import ObjectStore
 from karpenter_tpu.utils.clock import FakeClock
 
 
-class FakeCandidate:
-    """The minimal candidate surface simulate_batch consumes."""
-
-    def __init__(self, name, pods):
-        self.name = name
-        self.reschedulable_pods = pods
+from karpenter_tpu.testing import FakeCandidate, build_bound_cluster
 
 
-def build_cluster(n_small_pods=6, extra_pod_cpu=None):
-    """A cluster with several 4-cpu nodes, each carrying bound pods."""
-    clock = FakeClock()
-    store = ObjectStore(clock)
-    catalog = [new_instance_type("n-4x", cpu=4), new_instance_type("n-8x", cpu=8)]
-    cloud = KwokCloudProvider(store, catalog=catalog)
-    mgr = Manager(store, cloud, clock)
-    store.create(ObjectStore.NODEPOOLS, NodePool())
-    for i in range(n_small_pods):
-        # 2-cpu pods pinned to the 4-cpu type: one node per pod, so
-        # consolidation onto the 8-cpu type has real work to find
-        store.create(
-            ObjectStore.PODS,
-            make_pod(f"p{i}", cpu=2.0, node_selector={l.LABEL_INSTANCE_TYPE: "n-4x"}),
-        )
-    mgr.run_until_idle()
-    cloud.simulate_kubelet_ready()
-    mgr.run_until_idle()
-    KubeSchedulerSim(store, mgr.cluster).bind_pending()
-    mgr.run_until_idle()
-    assert all(p.spec.node_name for p in store.pods())
-    return clock, store, cloud, mgr
+def build_cluster(n_small_pods=6, extra_pod_cpu=None, pod_cpu=2.0):
+    """Shared fixture: several 4-cpu nodes, each carrying bound pods."""
+    return build_bound_cluster(n_pods=n_small_pods, pod_cpu=pod_cpu)
 
 
 def node_candidates(store, mgr):
-    by_node: dict[str, list] = {}
-    for p in store.pods():
-        if p.spec.node_name:
-            by_node.setdefault(p.spec.node_name, []).append(p)
-    return [FakeCandidate(name, pods) for name, pods in sorted(by_node.items())]
+    from karpenter_tpu.testing import node_candidates as nc
+
+    return nc(store)
 
 
 def sequential_signal(provisioner, candidates):
@@ -142,7 +116,34 @@ class TestWhatIfBatch:
         # The disruption controller's multi-node pass should produce the
         # same command with the batch prefilter as with pure binary search,
         # while issuing at most one batch call.
-        clock, store, cloud, mgr = build_cluster()
+        def build_underutilized():
+            """6 nodes at 3x 1-cpu pods each, then shed 2 pods per node:
+            displaced pods fit in siblings' free capacity, so a multi-node
+            delete prefix is genuinely consolidatable."""
+            clock, store, cloud, mgr = build_cluster(n_small_pods=18, pod_cpu=1.0)
+            keep_first = set()
+            doomed = []
+            for p in store.pods():
+                if p.spec.node_name not in keep_first:
+                    keep_first.add(p.spec.node_name)
+                else:
+                    doomed.append(p.name)
+            for name in doomed:
+                pod = store.get(ObjectStore.PODS, name)
+                pod.status.phase = "Succeeded"
+                store.update(ObjectStore.PODS, pod)
+                store.delete(ObjectStore.PODS, name)
+            mgr.run_until_idle()
+            # permissive budget so multi-node consolidation can disrupt
+            # several nodes (the default 10% caps a 6-node cluster at 1)
+            from karpenter_tpu.models.nodepool import Budget
+
+            pool = store.get(ObjectStore.NODEPOOLS, "default")
+            pool.spec.disruption.budgets = [Budget(nodes="100%")]
+            store.update(ObjectStore.NODEPOOLS, pool)
+            return clock, store, cloud, mgr
+
+        clock, store, cloud, mgr = build_underutilized()
         calls = {"batch": 0, "seq": 0}
         orig_batch = mgr.provisioner.simulate_batch
         orig_seq = mgr.provisioner.simulate
@@ -157,5 +158,34 @@ class TestWhatIfBatch:
 
         monkeypatch.setattr(mgr.provisioner, "simulate_batch", counting_batch)
         monkeypatch.setattr(mgr.provisioner, "simulate", counting_seq)
-        cmd = mgr.run_disruption_once()
-        assert calls["batch"] <= 2  # multi-node + single-node passes
+
+        def drive(mgr_, clock_, cloud_, store_):
+            """Poll until a command executes (staging + 15s validation)."""
+            clock_.step(60.0)
+            executed = None
+            for _ in range(6):
+                cmd = mgr_.run_disruption_once()
+                executed = executed or cmd
+                cloud_.simulate_kubelet_ready()
+                mgr_.run_until_idle()
+                KubeSchedulerSim(store_, mgr_.cluster).bind_pending()
+                clock_.step(20.0)
+                if executed is not None:
+                    break
+            return executed
+
+        cmd = drive(mgr, clock, cloud, store)
+        assert calls["batch"] >= 1, "the batch prefilter never ran"
+        assert cmd is not None, "no consolidation command produced"
+
+        # parity: an identical cluster with the batch disabled (pure binary
+        # search) must reach the same decision
+        clock2, store2, cloud2, mgr2 = build_underutilized()
+        mgr2.provisioner.simulate_batch = lambda scenarios: None
+        cmd2 = drive(mgr2, clock2, cloud2, store2)
+        assert cmd2 is not None
+        assert cmd.reason == cmd2.reason
+        assert sorted(c.name for c in cmd.candidates) == sorted(
+            c.name for c in cmd2.candidates
+        )
+        assert len(cmd.replacements) == len(cmd2.replacements)
