@@ -44,6 +44,12 @@ class Candidate:
         """Sort key: cheaper-to-disrupt-per-dollar first (types.go:145)."""
         return self.price / self.disruption_cost if self.disruption_cost else self.price
 
+    @property
+    def owned_by_static(self) -> bool:
+        """Static pools are disrupted only by StaticDrift's
+        replace-then-delete (types.go:147, staticdrift.go:51)."""
+        return self.nodepool.is_static
+
 
 def _pod_eviction_cost(pod: Pod) -> float:
     cost = 1.0

@@ -16,6 +16,7 @@ from karpenter_tpu.controllers.disruption.candidates import (
     build_disruption_budgets,
 )
 from karpenter_tpu.controllers.disruption.methods import (
+    StaticDrift,
     Command,
     Drift,
     Emptiness,
@@ -51,6 +52,7 @@ class DisruptionController:
         self._pending: Optional[_PendingValidation] = None
         self.methods = [
             Emptiness(clock),
+            StaticDrift(store, cloud),
             Drift(self._simulate),
             MultiNodeConsolidation(
                 self._simulate, clock, spot_to_spot_enabled, simulate_batch=self._simulate_batch
@@ -225,6 +227,11 @@ class DisruptionController:
             # (validation.go re-builds candidates from live state)
             c.state_node = sn
             c.reschedulable_pods = fresh
+        if all(c.owned_by_static for c in command.candidates):
+            # static replace-then-delete: the replacement is a template
+            # clone, not a pod placement — no re-simulation applies
+            # (queue.go:286 static special case)
+            return True
         if command.replacements or any(c.reschedulable_pods for c in command.candidates):
             results, unscheduled = self._simulate(command.candidates)
             if results is None or unscheduled:

@@ -87,7 +87,8 @@ class Emptiness:
         empty = [
             c
             for c in candidates
-            if not c.reschedulable_pods
+            if not c.owned_by_static
+            and not c.reschedulable_pods
             and _consolidatable(
                 c,
                 self.clock,
@@ -111,7 +112,8 @@ class Drift:
         drifted = [
             c
             for c in candidates
-            if c.state_node.node_claim is not None
+            if not c.owned_by_static
+            and c.state_node.node_claim is not None
             and c.state_node.node_claim.conditions.is_true(COND_DRIFTED)
         ]
         chosen = _within_budget(drifted, budgets)
@@ -128,6 +130,59 @@ class Drift:
                 reason=self.reason,
                 results=results,
             )
+        return Command(reason=self.reason)
+
+
+class StaticDrift:
+    """Replace-then-delete for drifted static-pool nodes
+    (staticdrift.go:49-107): the replacement claim comes straight from the
+    pool template (no pod placement — the pool holds a fixed replica
+    count), created BEFORE the old node is removed so capacity never dips
+    below replicas."""
+
+    reason = REASON_DRIFTED
+
+    def __init__(self, store, cloud):
+        self.store = store
+        self.cloud = cloud
+
+    def compute(self, candidates: list["Candidate"], budgets: dict[str, int]) -> Command:
+        from karpenter_tpu.controllers.provisioning.nodeclaimtemplate import build_template
+
+        drifted = [
+            c
+            for c in candidates
+            if c.owned_by_static
+            and c.state_node.node_claim is not None
+            and c.state_node.node_claim.conditions.is_true(COND_DRIFTED)
+        ]
+        for c in drifted:
+            pool = c.nodepool
+            if budgets.get(pool.name, 0) <= 0:
+                continue
+            claims = [
+                cl
+                for cl in self.store.nodeclaims()
+                if cl.nodepool_name == pool.name and not cl.metadata.deleting
+            ]
+            # wait out in-progress scale-down (staticdrift.go:74-77)
+            if len(claims) > (pool.spec.replicas or 0):
+                continue
+            # node limit guards the temporary replicas+1 overlap
+            # (staticdrift.go:68-88 ReserveNodeCount)
+            limit = (pool.spec.limits.resources.get("nodes") if pool.spec.limits else None)
+            if limit is not None and len(claims) + 1 > limit:
+                continue
+            template = build_template(pool, self.cloud.get_instance_types(pool))
+            replacement = SimClaim(
+                template=template,
+                requirements=template.requirements.copy(),
+                used=dict(template.daemon_requests),
+                instance_types=list(template.instance_types),
+                pods=[],
+                slot=0,
+            )
+            return Command(candidates=[c], replacements=[replacement], reason=self.reason)
         return Command(reason=self.reason)
 
 
@@ -154,7 +209,8 @@ class _ConsolidationBase:
         return [
             c
             for c in candidates
-            if _consolidatable(c, self.clock, (CONSOLIDATION_WHEN_EMPTY_OR_UNDERUTILIZED,))
+            if not c.owned_by_static
+            and _consolidatable(c, self.clock, (CONSOLIDATION_WHEN_EMPTY_OR_UNDERUTILIZED,))
         ]
 
     # -- computeConsolidation (consolidation.go:159-343) --------------------

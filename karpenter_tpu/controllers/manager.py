@@ -247,13 +247,22 @@ class Manager:
             NodePoolStatusController,
         )
 
+        from karpenter_tpu.controllers.status_controllers import HydrationController
+
         out = {
+            "hydrated": HydrationController(self.store).reconcile(),
             "expired": self.expiration.reconcile(),
             "garbage_collected": self.garbage_collection.reconcile(),
             "repaired": self.health.reconcile(),
             "static_delta": self.static_capacity.reconcile(),
             "inconsistent": ConsistencyController(self.store, self.clock).reconcile(),
         }
+        # re-drive deleting claims whose drain is blocked on TGP expiry —
+        # the event-driven loop won't see a clock advance (the requeue
+        # analog of termination/controller.go's retry)
+        for claim in self.store.nodeclaims():
+            if claim.metadata.deleting:
+                self._dirty_claims.add(claim.name)
         self.run_until_idle()
         # nodepool usage/limit gauges (controllers/metrics/nodepool analog):
         # the status controller just computed usage into pool.status; clear

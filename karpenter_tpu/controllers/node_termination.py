@@ -1,11 +1,20 @@
 """Node termination: taint -> drain -> delete instance -> drop finalizer.
 
 Counterpart of reference pkg/controllers/node/termination
-(controller.go:93-191, terminator/terminator.go:96-138): eviction happens
-in priority groups (non-critical first, critical last). Evictions here are
-immediate — terminationGracePeriod enforcement (terminator.go:140-176,
-force-deleting pods whose graceful eviction would overrun the period) is
-not modeled yet because the harness has no graceful pod shutdown to race.
+(controller.go:93-191, terminator/terminator.go:96-176): eviction happens
+in priority groups (non-critical first, critical last); pods that refuse
+disruption (do-not-disrupt annotation, PDB-blocked) are NOT evicted by the
+normal drain — they block the node's finalization until the claim's
+terminationGracePeriod forces them out:
+
+  * node termination time T = finalize start + claim TGP, stamped as an
+    annotation (lifecycle/controller.go:289);
+  * a blocked pod is preemptively deleted at T - pod.TGP so it still gets
+    its full grace before the machine dies, with the delete's grace
+    clamped to the node's remaining life (DeleteExpiringPods,
+    terminator.go:140-176);
+  * once now >= T the controller stops waiting for drain/volumes entirely
+    (controller.go:244-258).
 
 Evicted pods return to Pending/Unschedulable, so the provisioner
 reschedules them — the harness analog of the kube eviction API.
@@ -13,6 +22,9 @@ reschedules them — the harness analog of the kube eviction API.
 
 from __future__ import annotations
 
+from typing import Optional
+
+from karpenter_tpu.models import labels as l
 from karpenter_tpu.models.node import Node
 from karpenter_tpu.models.pod import Pod
 from karpenter_tpu.models.taints import DISRUPTED_NO_SCHEDULE_TAINT
@@ -21,38 +33,78 @@ from karpenter_tpu.utils.clock import Clock
 
 CRITICAL_PRIORITY_THRESHOLD = 2_000_000_000  # system-cluster-critical
 
+# annotation carrying the node's forced-termination wall time
+# (lifecycle/controller.go:289 TerminationTimestampAnnotationKey)
+TERMINATION_TS_ANNOTATION = l.GROUP + "/nodeclaim-termination-timestamp"
+
 
 class Terminator:
-    """Priority-grouped drainer (terminator/terminator.go:96-138)."""
+    """Priority-grouped drainer with TGP enforcement
+    (terminator/terminator.go:96-176)."""
 
     def __init__(self, store: ObjectStore, clock: Clock):
         self.store = store
         self.clock = clock
 
-    def drain(self, node: Node) -> int:
-        """Evict every evictable pod on the node; returns how many moved.
+    def _blocked(self, pod: Pod, pdb_blocked: frozenset) -> bool:
+        """Pods the voluntary drain must not evict: do-not-disrupt opt-outs
+        and PDB-protected pods (the eviction queue's 429 path,
+        terminator/eviction.go:93-222)."""
+        if pod.metadata.annotations.get(l.DO_NOT_DISRUPT_ANNOTATION_KEY) == "true":
+            return True
+        return pod.uid in pdb_blocked
 
-        Non-critical pods are evicted before critical ones so critical
-        workloads keep running while replacements come up.
-        """
+    def drain(
+        self, node: Node, node_termination_time: Optional[float] = None
+    ) -> tuple[int, list[Pod]]:
+        """Evict every evictable pod; preemptively delete blocked pods whose
+        grace window is due. Returns (pods moved, pods still blocking)."""
+        from karpenter_tpu.models.pdb import blocked_pod_uids
+
         pods = [
             p
             for p in self.store.pods()
             if p.spec.node_name == node.name and not p.is_terminal()
         ]
+        pdb_blocked = frozenset(
+            blocked_pod_uids(self.store.list(ObjectStore.PDBS), self.store.pods())
+        )
+        # Non-critical pods are evicted before critical ones so critical
+        # workloads keep running while replacements come up.
         pods.sort(key=lambda p: (p.spec.priority >= CRITICAL_PRIORITY_THRESHOLD, p.name))
         evicted = 0
+        remaining: list[Pod] = []
+        now = self.clock.now()
         for pod in pods:
-            self._evict(pod)
-            evicted += 1
-        return evicted
+            if not self._blocked(pod, pdb_blocked):
+                self._evict(pod)
+                evicted += 1
+                continue
+            # DeleteExpiringPods (terminator.go:140-166): delete at
+            # T - pod.TGP so the pod still gets its full grace, clamped to
+            # the node's remaining life (min 1s — never force from etcd)
+            if node_termination_time is not None:
+                delete_time = node_termination_time - pod.spec.termination_grace_period_seconds
+                if now >= delete_time:
+                    grace = max(node_termination_time - now, 1.0)
+                    self._evict(pod, grace_seconds=grace)
+                    evicted += 1
+                    continue
+            remaining.append(pod)
+        return evicted, remaining
 
-    def _evict(self, pod: Pod) -> None:
+    def _evict(self, pod: Pod, grace_seconds: Optional[float] = None) -> None:
         """The eviction-API analog: unbind and mark unschedulable so the
-        provisioner picks the pod up again."""
+        provisioner picks the pod up again. grace_seconds records the
+        clamped TGP of a preemptive delete (observability only — the
+        harness has no in-container shutdown to race)."""
         pod.spec.node_name = ""
         pod.status.phase = "Pending"
         pod.status.conditions["PodScheduled"] = "Unschedulable"
+        if grace_seconds is not None:
+            pod.metadata.annotations[l.GROUP + "/preemptive-delete-grace-seconds"] = repr(
+                grace_seconds
+            )
         self.store.update(ObjectStore.PODS, pod)
 
 
@@ -64,9 +116,12 @@ class NodeTerminationController:
         self.clock = clock
         self.terminator = Terminator(store, clock)
 
-    def prepare(self, node: Node) -> int:
-        """Taint + drain (controller.go:93-138). Returns pods evicted."""
+    def prepare(
+        self, node: Node, node_termination_time: Optional[float] = None
+    ) -> tuple[int, list[Pod]]:
+        """Taint + drain (controller.go:93-138). Returns (pods evicted,
+        pods still blocking the drain)."""
         if not any(t.match(DISRUPTED_NO_SCHEDULE_TAINT) for t in node.spec.taints):
             node.spec.taints.append(DISRUPTED_NO_SCHEDULE_TAINT)
             self.store.update(ObjectStore.NODES, node)
-        return self.terminator.drain(node)
+        return self.terminator.drain(node, node_termination_time)

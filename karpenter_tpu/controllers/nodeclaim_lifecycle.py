@@ -133,20 +133,44 @@ class NodeClaimLifecycleController:
     # -- finalize (controller.go:198) -------------------------------------------
 
     def _finalize(self, claim: NodeClaim) -> None:
+        from karpenter_tpu.controllers.node_termination import TERMINATION_TS_ANNOTATION
         from karpenter_tpu.models import labels as labels_mod
         from karpenter_tpu.utils import metrics
 
+        # stamp the forced-termination wall time ONCE at finalize start
+        # (lifecycle/controller.go:289): claims without a TGP wait for the
+        # drain forever, exactly like the reference
+        termination_time = None
+        tgp = claim.spec.termination_grace_period_seconds
+        if tgp is not None:
+            stamped = claim.metadata.annotations.get(TERMINATION_TS_ANNOTATION)
+            if stamped is None:
+                termination_time = self.clock.now() + tgp
+                # repr keeps full float precision — %g would truncate epoch
+                # timestamps to 6 significant digits
+                claim.metadata.annotations[TERMINATION_TS_ANNOTATION] = repr(termination_time)
+                self.store.update(ObjectStore.NODECLAIMS, claim)
+            else:
+                termination_time = float(stamped)
+        # drain first: taint + evict pods so they reschedule (the node
+        # termination flow, termination/controller.go:93-191); pods that
+        # refuse disruption block finalization until the TGP forces them
+        node = self._node_for(claim)
+        if node is not None:
+            _, blocking = self.terminator.prepare(node, termination_time)
+            grace_elapsed = (
+                termination_time is not None and self.clock.now() >= termination_time
+            )
+            if blocking and not grace_elapsed:
+                # requeue: the drain is incomplete and the grace period (if
+                # any) hasn't expired — the instance must keep running
+                return
         metrics.NODECLAIMS_TERMINATED.inc(
             reason=claim.metadata.annotations.get(
                 "karpenter.sh/termination-reason", "deleted"
             ),
             nodepool=claim.metadata.labels.get(labels_mod.NODEPOOL_LABEL_KEY, ""),
         )
-        # drain first: taint + evict pods so they reschedule (the node
-        # termination flow, termination/controller.go:93-191)
-        node = self._node_for(claim)
-        if node is not None:
-            self.terminator.prepare(node)
         # then instance termination (the provider owns the node object in
         # simulated clouds); the store node is only force-dropped if the
         # provider had already lost the instance

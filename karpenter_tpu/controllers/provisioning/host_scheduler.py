@@ -133,21 +133,8 @@ def finalize_reserved(claim: SimClaim) -> None:
 
 
 def normalize_volume_reqs(volume_reqs: Optional[dict]) -> dict:
-    """uid -> list[Requirements] alternatives. Accepts legacy single
-    Requirement / Requirements values for convenience."""
-    out: dict = {}
-    for uid, v in (volume_reqs or {}).items():
-        if v is None:
-            continue
-        if isinstance(v, Requirement):
-            rs = Requirements()
-            rs.add(v)
-            out[uid] = [rs]
-        elif isinstance(v, Requirements):
-            out[uid] = [v]
-        else:
-            out[uid] = list(v)
-    return out
+    """uid -> non-empty list[Requirements] alternatives (drops None/empty)."""
+    return {uid: list(v) for uid, v in (volume_reqs or {}).items() if v}
 
 
 def ffd_sort(pods: list[Pod]) -> list[Pod]:

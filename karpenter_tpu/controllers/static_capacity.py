@@ -36,7 +36,9 @@ class StaticCapacityController:
             claims = [
                 c
                 for c in self.store.nodeclaims()
-                if c.nodepool_name == pool.name and not c.metadata.deleting
+                if c.nodepool_name == pool.name
+                and not c.metadata.deleting
+                and not self._pending_disruption(c)
             ]
             want = pool.spec.replicas or 0
             if len(claims) < want:
@@ -44,6 +46,14 @@ class StaticCapacityController:
             elif len(claims) > want:
                 delta -= self._scale_down(claims, len(claims) - want)
         return delta
+
+    def _pending_disruption(self, claim: NodeClaim) -> bool:
+        """A StaticDrift candidate awaiting replace-then-delete still holds
+        a replica slot; counting it would make this controller delete the
+        fresh replacement (the reference tracks this via NodePoolState's
+        nodesPendingDisruption, staticdrift.go:72-77)."""
+        sn = self.cluster.node_by_provider_id(claim.status.provider_id or "")
+        return sn is not None and sn.marked_for_deletion
 
     def _scale_up(self, pool: NodePool, count: int) -> int:
         template = build_template(pool, self.cloud.get_instance_types(pool))

@@ -22,6 +22,44 @@ from karpenter_tpu.utils.clock import Clock
 CAPACITY_TOLERANCE = 0.10  # relative mismatch that flags inconsistency
 
 
+def node_class_label_key(ref: dict) -> str:
+    """group + lowercase kind, the label hydration backfills
+    (labels.go:173-175 NodeClassLabelKey)."""
+    return f"{ref.get('group', '')}/{str(ref.get('kind', '')).lower()}"
+
+
+class HydrationController:
+    """Upgrade backfill (nodeclaim/hydration + node/hydration): stamps the
+    nodeclass label derived from spec.nodeClassRef onto pre-existing
+    NodeClaims and their Nodes so newer-version selectors keep matching."""
+
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def reconcile(self) -> int:
+        hydrated = 0
+        for claim in self.store.nodeclaims():
+            ref = claim.spec.node_class_ref
+            if not ref or not ref.get("kind"):
+                continue
+            key = node_class_label_key(ref)
+            value = ref.get("name", "")
+            if claim.metadata.labels.get(key) != value:
+                claim.metadata.labels[key] = value
+                self.store.update(ObjectStore.NODECLAIMS, claim)
+                hydrated += 1
+            node = (
+                self.store.node_by_provider_id(claim.status.provider_id)
+                if claim.status.provider_id
+                else None
+            )
+            if node is not None and node.metadata.labels.get(key) != value:
+                node.metadata.labels[key] = value
+                self.store.update(ObjectStore.NODES, node)
+                hydrated += 1
+        return hydrated
+
+
 class ConsistencyController:
     """Detects claim<->node capacity drift (consistency/controller.go)."""
 

@@ -77,6 +77,9 @@ class PodSpec:
     restart_policy: str = "Always"
     # Names of ResourceClaims (DRA) this pod consumes (pod.spec.resourceClaims)
     resource_claims: list[str] = field(default_factory=list)
+    # pod.spec.terminationGracePeriodSeconds (k8s defaults to 30s); drives
+    # the TGP-clamped preemptive delete during drain (terminator.go:140-176)
+    termination_grace_period_seconds: float = 30.0
 
 
 @dataclass

@@ -135,7 +135,7 @@ def _volume_alternatives(pvc, classes_by_name: dict) -> list[Requirements]:
 def _compatible(a: Optional[Requirements], b: Optional[Requirements]) -> bool:
     if a is None or b is None:
         return True
-    return a.intersects(b) is None
+    return a.intersects_ok(b)
 
 
 def _merge(a: Optional[Requirements], b: Requirements) -> Requirements:

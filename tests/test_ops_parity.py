@@ -89,8 +89,18 @@ class TestOperator:
         opts = Options(feature_gates=FeatureGates.parse("SpotToSpotConsolidation=true"))
         op = Operator.new(clock=clock, options=opts)
         # feature gate propagated into the consolidation methods
-        assert op.manager.disruption.methods[2].spot_to_spot_enabled
-        assert op.manager.disruption.methods[3].spot_to_spot_enabled
+        from karpenter_tpu.controllers.disruption.methods import (
+            MultiNodeConsolidation,
+            SingleNodeConsolidation,
+        )
+
+        consolidators = [
+            m
+            for m in op.manager.disruption.methods
+            if isinstance(m, (MultiNodeConsolidation, SingleNodeConsolidation))
+        ]
+        assert len(consolidators) == 2
+        assert all(m.spot_to_spot_enabled for m in consolidators)
         pool = NodePool()
         pool.metadata.name = "default"
         op.store.create(ObjectStore.NODEPOOLS, pool)

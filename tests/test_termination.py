@@ -1,0 +1,222 @@
+"""Termination-grace-period drain, StaticDrift replace-then-delete, and
+upgrade hydration.
+
+Mirrors reference terminator.go:140-176 (DeleteExpiringPods: blocked pods
+preemptively deleted at node-expiry minus pod TGP, grace clamped to the
+node's remaining life), termination/controller.go:244-258 (grace elapsed
+stops all waiting), disruption/staticdrift.go (replacement before delete,
+never below replicas), and nodeclaim/hydration (nodeclass label backfill).
+"""
+
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.controllers.manager import KubeSchedulerSim, Manager
+from karpenter_tpu.controllers.node_termination import TERMINATION_TS_ANNOTATION
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodepool import Budget, NodePool
+from karpenter_tpu.models.pod import make_pod
+from karpenter_tpu.state.store import ObjectStore
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def build_env(catalog_size=50):
+    clock = FakeClock()
+    store = ObjectStore(clock)
+    cloud = KwokCloudProvider(store, catalog=instance_types(catalog_size))
+    mgr = Manager(store, cloud, clock)
+    return clock, store, cloud, mgr
+
+
+def provision_bound_pod(store, cloud, mgr, pod):
+    store.create(ObjectStore.PODS, pod)
+    mgr.run_until_idle()
+    cloud.simulate_kubelet_ready()
+    mgr.run_until_idle()
+    KubeSchedulerSim(store, mgr.cluster).bind_pending()
+    mgr.run_until_idle()
+    assert pod.spec.node_name
+
+
+class TestTGPDrain:
+    def _env_with_blocked_pod(self, claim_tgp, pod_tgp):
+        clock, store, cloud, mgr = build_env()
+        pool = NodePool()
+        pool.metadata.name = "default"
+        pool.spec.template.spec.termination_grace_period_seconds = claim_tgp
+        store.create(ObjectStore.NODEPOOLS, pool)
+        pod = make_pod("stubborn", cpu=0.5)
+        pod.metadata.annotations[l.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        pod.spec.termination_grace_period_seconds = pod_tgp
+        provision_bound_pod(store, cloud, mgr, pod)
+        return clock, store, cloud, mgr, pod
+
+    def test_blocked_pod_deleted_at_expiry_minus_tgp(self):
+        """The do-not-disrupt pod survives the initial drain, then is
+        preemptively deleted exactly when node-expiry - pod TGP passes,
+        with the delete's grace clamped to the node's remaining life."""
+        clock, store, cloud, mgr, pod = self._env_with_blocked_pod(
+            claim_tgp=300.0, pod_tgp=120.0
+        )
+        claim = store.nodeclaims()[0]
+        assert claim.spec.termination_grace_period_seconds == 300.0
+        store.delete(ObjectStore.NODECLAIMS, claim.name)
+        mgr.run_until_idle()
+        # drain started: termination time stamped, pod still bound
+        claim = store.get(ObjectStore.NODECLAIMS, claim.name)
+        assert claim is not None, "claim finalized despite blocked pod"
+        stamped = float(claim.metadata.annotations[TERMINATION_TS_ANNOTATION])
+        assert stamped == clock.now() + 300.0
+        pod = store.get(ObjectStore.PODS, "stubborn")
+        assert pod.spec.node_name, "blocked pod was evicted before its window"
+
+        # just before T - pod_tgp: still bound
+        clock.step(300.0 - 120.0 - 1.0)
+        mgr.run_maintenance()
+        pod = store.get(ObjectStore.PODS, "stubborn")
+        assert pod.spec.node_name
+
+        # past T - pod_tgp: deleted with grace clamped to remaining life
+        clock.step(2.0)
+        before = clock.now()
+        mgr.run_maintenance()
+        pod = store.get(ObjectStore.PODS, "stubborn")
+        assert not pod.spec.node_name, "pod not preemptively deleted"
+        grace = float(pod.metadata.annotations[l.GROUP + "/preemptive-delete-grace-seconds"])
+        # recorded when the drain ran; the maintenance pass may advance the
+        # fake clock a batch window past `before`
+        assert stamped - before - 2.0 <= grace <= stamped - before
+        assert grace <= 120.0
+        # with the node drained, finalization completes
+        mgr.run_maintenance()
+        assert store.get(ObjectStore.NODECLAIMS, claim.name) is None
+
+    def test_pod_tgp_longer_than_claim_tgp_deletes_immediately(self):
+        """pod TGP > claim TGP: the delete window opened before the drain
+        began, so the pod goes immediately with grace = full node life."""
+        clock, store, cloud, mgr, pod = self._env_with_blocked_pod(
+            claim_tgp=300.0, pod_tgp=600.0
+        )
+        claim = store.nodeclaims()[0]
+        store.delete(ObjectStore.NODECLAIMS, claim.name)
+        mgr.run_until_idle()
+        pod = store.get(ObjectStore.PODS, "stubborn")
+        assert not pod.spec.node_name
+        grace = float(pod.metadata.annotations[l.GROUP + "/preemptive-delete-grace-seconds"])
+        assert abs(grace - 300.0) < 1e-6
+
+    def test_no_tgp_blocks_forever(self):
+        """Without a claim TGP the drain never forces the blocked pod and
+        the instance keeps running (reference retries indefinitely)."""
+        clock, store, cloud, mgr, pod = self._env_with_blocked_pod(
+            claim_tgp=None, pod_tgp=30.0
+        )
+        claim = store.nodeclaims()[0]
+        store.delete(ObjectStore.NODECLAIMS, claim.name)
+        mgr.run_until_idle()
+        clock.step(7200.0)
+        mgr.run_maintenance()
+        pod = store.get(ObjectStore.PODS, "stubborn")
+        assert pod.spec.node_name
+        assert store.get(ObjectStore.NODECLAIMS, claim.name) is not None
+
+    def test_grace_elapsed_forces_finalization(self):
+        """Past the node termination time the controller stops waiting even
+        if something is still blocking (controller.go:244-258)."""
+        clock, store, cloud, mgr, pod = self._env_with_blocked_pod(
+            claim_tgp=300.0, pod_tgp=1.0
+        )
+        claim = store.nodeclaims()[0]
+        store.delete(ObjectStore.NODECLAIMS, claim.name)
+        mgr.run_until_idle()
+        clock.step(301.0)
+        mgr.run_maintenance()
+        assert store.get(ObjectStore.NODECLAIMS, claim.name) is None
+
+    def test_unblocked_pods_drain_instantly(self):
+        clock, store, cloud, mgr = build_env()
+        store.create(ObjectStore.NODEPOOLS, NodePool())
+        pod = make_pod("plain", cpu=0.5)
+        provision_bound_pod(store, cloud, mgr, pod)
+        claim = store.nodeclaims()[0]
+        store.delete(ObjectStore.NODECLAIMS, claim.name)
+        mgr.run_until_idle()
+        assert store.get(ObjectStore.NODECLAIMS, claim.name) is None
+        pod = store.get(ObjectStore.PODS, "plain")
+        assert not pod.spec.node_name
+
+
+class TestStaticDrift:
+    def _static_env(self, replicas=2):
+        clock, store, cloud, mgr = build_env()
+        pool = NodePool()
+        pool.metadata.name = "static"
+        pool.spec.replicas = replicas
+        pool.spec.disruption.budgets = [Budget(nodes="100%")]
+        store.create(ObjectStore.NODEPOOLS, pool)
+        mgr.run_maintenance()
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        assert len(store.nodes()) == replicas
+        return clock, store, cloud, mgr, pool
+
+    def test_replace_then_delete_never_below_replicas(self):
+        clock, store, cloud, mgr, pool = self._static_env(replicas=2)
+        # operator changes the template -> hash drift on both claims
+        pool.spec.template.labels["team"] = "new"
+        store.update(ObjectStore.NODEPOOLS, pool)
+        assert mgr.mark_drift() >= 1
+        drifted = [
+            c.name for c in store.nodeclaims() if c.conditions.is_true("Drifted")
+        ]
+        assert len(drifted) == 2
+
+        min_live = 2
+        for _ in range(12):
+            clock.step(20.0)
+            mgr.run_disruption_once()
+            cloud.simulate_kubelet_ready()
+            mgr.run_until_idle()
+            live = [c for c in store.nodeclaims() if not c.metadata.deleting]
+            min_live = min(min_live, len(live))
+            mgr.run_maintenance()
+        # the drift cycle replaced every drifted claim without ever
+        # dropping below the pool's replica count
+        assert min_live >= 2, f"static pool dipped to {min_live} live claims"
+        live = [c for c in store.nodeclaims() if not c.metadata.deleting]
+        assert len(live) == 2
+        assert not any(c.name in drifted for c in live), "drifted claims survived"
+        # replacements carry the new template hash (no re-drift loop)
+        mgr.mark_drift()
+        assert not any(c.conditions.is_true("Drifted") for c in store.nodeclaims())
+
+    def test_static_pools_skip_normal_disruption(self):
+        """Emptiness/consolidation never touch static nodes even when idle
+        long past consolidateAfter (consolidation.go:102, emptiness.go:43)."""
+        clock, store, cloud, mgr, pool = self._static_env(replicas=1)
+        clock.step(3600.0)
+        for _ in range(3):
+            cmd = mgr.run_disruption_once()
+            assert cmd is None
+            clock.step(20.0)
+        assert len([c for c in store.nodeclaims() if not c.metadata.deleting]) == 1
+
+
+class TestHydration:
+    def test_nodeclass_label_backfilled(self):
+        clock, store, cloud, mgr = build_env()
+        store.create(ObjectStore.NODEPOOLS, NodePool())
+        pod = make_pod("p", cpu=0.5)
+        provision_bound_pod(store, cloud, mgr, pod)
+        claim = store.nodeclaims()[0]
+        # simulate a pre-upgrade object: ref present, label absent
+        claim.spec.node_class_ref = {"group": "karpenter.kwok.sh", "kind": "KWOKNodeClass", "name": "default"}
+        claim.metadata.labels.pop("karpenter.kwok.sh/kwoknodeclass", None)
+        store.update(ObjectStore.NODECLAIMS, claim)
+        out = mgr.run_maintenance()
+        assert out["hydrated"] >= 1
+        claim = store.get(ObjectStore.NODECLAIMS, claim.name)
+        assert claim.metadata.labels["karpenter.kwok.sh/kwoknodeclass"] == "default"
+        node = store.node_by_provider_id(claim.status.provider_id)
+        assert node.metadata.labels["karpenter.kwok.sh/kwoknodeclass"] == "default"
+        # idempotent: second pass is a no-op
+        assert mgr.run_maintenance()["hydrated"] == 0

@@ -18,18 +18,12 @@ from karpenter_tpu.state.store import ObjectStore
 from karpenter_tpu.utils.clock import FakeClock
 
 
-from karpenter_tpu.testing import FakeCandidate, build_bound_cluster
+from karpenter_tpu.testing import FakeCandidate, build_bound_cluster, node_candidates
 
 
-def build_cluster(n_small_pods=6, extra_pod_cpu=None, pod_cpu=2.0):
+def build_cluster(n_small_pods=6, pod_cpu=2.0):
     """Shared fixture: several 4-cpu nodes, each carrying bound pods."""
     return build_bound_cluster(n_pods=n_small_pods, pod_cpu=pod_cpu)
-
-
-def node_candidates(store, mgr):
-    from karpenter_tpu.testing import node_candidates as nc
-
-    return nc(store)
 
 
 def sequential_signal(provisioner, candidates):
@@ -48,7 +42,7 @@ def sequential_signal(provisioner, candidates):
 class TestWhatIfBatch:
     def test_differential_vs_sequential(self):
         clock, store, cloud, mgr = build_cluster()
-        candidates = node_candidates(store, mgr)
+        candidates = node_candidates(store)
         assert len(candidates) >= 3
         # all prefixes plus each single candidate — the exact scenario mix
         # the consolidation methods submit
@@ -69,7 +63,7 @@ class TestWhatIfBatch:
         # with the sequential path — including the n_new > 1 signal the
         # consolidation filter rejects.
         clock, store, cloud, mgr = build_cluster(n_small_pods=8)
-        candidates = node_candidates(store, mgr)
+        candidates = node_candidates(store)
         scenarios = [candidates]
         signals = mgr.provisioner.simulate_batch(scenarios)
         want = sequential_signal(mgr.provisioner, candidates)
@@ -104,7 +98,7 @@ class TestWhatIfBatch:
         mgr.run_until_idle()
         KubeSchedulerSim(store, mgr.cluster).bind_pending()
         mgr.run_until_idle()
-        candidates = node_candidates(store, mgr)
+        candidates = node_candidates(store)
         assert len(candidates) >= 2
         signals = mgr.provisioner.simulate_batch([[c] for c in candidates])
         if signals is not None:
