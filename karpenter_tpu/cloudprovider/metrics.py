@@ -1,0 +1,69 @@
+"""SPI metrics decorator: wraps every CloudProvider call in duration and
+error instrumentation.
+
+Counterpart of reference pkg/cloudprovider/metrics/cloudprovider.go — the
+decorator-pattern seam a remote (gRPC) provider shim would occupy: callers
+see an unchanged CloudProvider while every crossing is measured.
+"""
+
+from __future__ import annotations
+
+import time
+
+from karpenter_tpu.cloudprovider.spi import CloudProvider
+from karpenter_tpu.utils.metrics import CLOUDPROVIDER_DURATION, CLOUDPROVIDER_ERRORS
+
+
+class MetricsCloudProvider(CloudProvider):
+    """Forwarding decorator; `inner` is the wrapped provider."""
+
+    def __init__(self, inner: CloudProvider):
+        self.inner = inner
+
+    @property
+    def name(self) -> str:
+        return self.inner.name
+
+    def _call(self, method: str, *args, **kwargs):
+        start = time.perf_counter()
+        try:
+            return getattr(self.inner, method)(*args, **kwargs)
+        except Exception as e:
+            CLOUDPROVIDER_ERRORS.inc(
+                controller="",
+                method=method,
+                provider=self.inner.name,
+                error=type(e).__name__,
+            )
+            raise
+        finally:
+            CLOUDPROVIDER_DURATION.observe(
+                time.perf_counter() - start,
+                controller="",
+                method=method,
+                provider=self.inner.name,
+            )
+
+    def create(self, node_claim):
+        return self._call("create", node_claim)
+
+    def delete(self, node_claim) -> None:
+        return self._call("delete", node_claim)
+
+    def get(self, provider_id: str):
+        return self._call("get", provider_id)
+
+    def list(self):
+        return self._call("list")
+
+    def get_instance_types(self, node_pool):
+        return self._call("get_instance_types", node_pool)
+
+    def is_drifted(self, node_claim):
+        return self._call("is_drifted", node_claim)
+
+    def repair_policies(self):
+        return self._call("repair_policies")
+
+    def get_supported_node_classes(self):
+        return self._call("get_supported_node_classes")

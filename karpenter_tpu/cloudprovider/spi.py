@@ -67,5 +67,14 @@ class CloudProvider(abc.ABC):
     @abc.abstractmethod
     def name(self) -> str: ...
 
+    @property
+    def unwrapped(self):
+        """The innermost provider: decorators (objects exposing `inner`)
+        unwrap recursively; leaf providers return themselves."""
+        inner = getattr(self, "inner", None)
+        if inner is None:
+            return self
+        return getattr(inner, "unwrapped", inner)
+
     def get_supported_node_classes(self) -> list[str]:
         return []

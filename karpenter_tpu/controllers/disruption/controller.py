@@ -100,6 +100,14 @@ class DisruptionController:
             command = self._pending.command
             self._pending = None
             if self._validate(command):
+                from karpenter_tpu.utils.logging import get_logger
+
+                get_logger().with_values(controller="disruption").info(
+                    "disrupting nodes",
+                    reason=command.reason,
+                    nodes=[c.name for c in command.candidates],
+                    replacements=len(command.replacements),
+                )
                 self.queue.start(command)
                 return command
             return None

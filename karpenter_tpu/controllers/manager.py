@@ -87,6 +87,10 @@ class Manager:
         from karpenter_tpu.state.cost import ClusterCost, NodePoolHealth
 
         self.static_capacity = StaticCapacityController(store, self.cluster, cloud, self.clock)
+        from karpenter_tpu.controllers.metrics_state import PodMetricsController
+
+        # stateful: owns the bound/startup latency dedup sets
+        self._pod_metrics = PodMetricsController(store, self.clock)
         self.cost = ClusterCost()
         self.pool_health = NodePoolHealth()
         self.disruption.cost_ledger = self.cost
@@ -269,6 +273,15 @@ class Manager:
         # the whole family first so series for vanished pools/resources
         # don't linger at stale values
         NodePoolStatusController(self.store, self.cluster, self.clock).reconcile()
+        # per-object state gauges (controllers/metrics/{pod,node} analogs)
+        from karpenter_tpu.controllers.metrics_state import (
+            NodeMetricsController,
+            StatusConditionMetricsController,
+        )
+
+        self._pod_metrics.reconcile()
+        NodeMetricsController(self.store, self.cluster).reconcile()
+        StatusConditionMetricsController(self.store).reconcile()
         from karpenter_tpu.utils import metrics
 
         metrics.NODEPOOL_USAGE.values.clear()

@@ -1,0 +1,132 @@
+"""Per-object state metric controllers: pod + node gauges.
+
+Counterparts of reference pkg/controllers/metrics/pod/controller.go
+(karpenter_pods_state, startup/bound durations) and
+pkg/controllers/metrics/node/controller.go (allocatable, total pod
+requests, utilization). The reference recomputes gauges per reconcile
+event; this harness recomputes the whole family per maintenance pass,
+clearing first so series for vanished objects don't linger.
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.store import ObjectStore
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils import resources as res
+from karpenter_tpu.utils.clock import Clock
+
+
+class PodMetricsController:
+    """karpenter_pods_state + startup/bound latency summaries
+    (metrics/pod/controller.go:61-170)."""
+
+    def __init__(self, store: ObjectStore, clock: Clock):
+        self.store = store
+        self.clock = clock
+        self._bound_seen: set[str] = set()
+        self._started_seen: set[str] = set()
+
+    def reconcile(self) -> None:
+        metrics.POD_STATE.values.clear()
+        now = self.clock.now()
+        pods = self.store.pods()
+        # uids are never reused — prune deleted pods so the dedup sets
+        # don't grow with total pods ever seen
+        live = {p.uid for p in pods}
+        self._bound_seen &= live
+        self._started_seen &= live
+        for pod in pods:
+            node = None
+            if pod.spec.node_name:
+                node = self.store.get(ObjectStore.NODES, pod.spec.node_name)
+            metrics.POD_STATE.set(
+                1.0,
+                name=pod.name,
+                namespace=pod.metadata.namespace,
+                node=pod.spec.node_name,
+                nodepool=(
+                    node.metadata.labels.get(l.NODEPOOL_LABEL_KEY, "") if node else ""
+                ),
+                phase=pod.status.phase,
+                scheduled=str(bool(pod.spec.node_name)).lower(),
+            )
+            # latency summaries observed once per pod at the transition
+            if pod.spec.node_name and pod.uid not in self._bound_seen:
+                self._bound_seen.add(pod.uid)
+                metrics.POD_BOUND_DURATION.observe(
+                    max(now - pod.metadata.creation_timestamp, 0.0)
+                )
+            if (
+                pod.status.phase == "Running"
+                or (pod.spec.node_name and pod.status.start_time is not None)
+            ) and pod.uid not in self._started_seen:
+                self._started_seen.add(pod.uid)
+                start = (
+                    pod.status.start_time
+                    if pod.status.start_time is not None
+                    else now
+                )
+                metrics.POD_STARTUP_DURATION.observe(
+                    max(start - pod.metadata.creation_timestamp, 0.0)
+                )
+
+
+class NodeMetricsController:
+    """karpenter_nodes_* resource gauges
+    (metrics/node/controller.go:70-140)."""
+
+    def __init__(self, store: ObjectStore, cluster: Cluster):
+        self.store = store
+        self.cluster = cluster
+
+    def reconcile(self) -> None:
+        metrics.NODE_ALLOCATABLE.values.clear()
+        metrics.NODE_TOTAL_POD_REQUESTS.values.clear()
+        metrics.NODE_UTILIZATION.values.clear()
+        for sn in self.cluster.nodes():
+            node = sn.node
+            if node is None:
+                continue
+            pool = node.metadata.labels.get(l.NODEPOOL_LABEL_KEY, "")
+            alloc = dict(node.status.allocatable)
+            requested: dict[str, float] = {}
+            for pod in sn.pods.values():
+                if not pod.is_terminal():
+                    requested = res.merge(requested, pod.total_requests())
+            for rname, qty in alloc.items():
+                metrics.NODE_ALLOCATABLE.set(
+                    qty, node_name=node.name, nodepool=pool, resource_type=rname
+                )
+                req = requested.get(rname, 0.0)
+                metrics.NODE_TOTAL_POD_REQUESTS.set(
+                    req, node_name=node.name, nodepool=pool, resource_type=rname
+                )
+                if qty > 0:
+                    metrics.NODE_UTILIZATION.set(
+                        100.0 * req / qty,
+                        node_name=node.name,
+                        nodepool=pool,
+                        resource_type=rname,
+                    )
+
+
+class StatusConditionMetricsController:
+    """operator_status_condition_count gauges over claims and pools
+    (operatorpkg status.NewController analog, controllers.go:140-158)."""
+
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def reconcile(self) -> None:
+        metrics.STATUS_CONDITION_COUNT.values.clear()
+        for kind, objs in (
+            ("NodeClaim", self.store.nodeclaims()),
+            ("NodePool", self.store.nodepools()),
+        ):
+            for obj in objs:
+                for cond in obj.conditions.all():
+                    key = dict(kind=kind, type=cond.type, status=cond.status)
+                    cur = metrics.STATUS_CONDITION_COUNT.get(**key)
+                    metrics.STATUS_CONDITION_COUNT.set(cur + 1.0, **key)

@@ -56,6 +56,9 @@ class Provisioner:
         self.device_allocation = None
         self._scheduler_cache: Optional[tuple[tuple, TPUScheduler]] = None
         self._buffer_pods: dict[tuple[str, int], list[Pod]] = {}
+        from karpenter_tpu.utils.logging import ChangeMonitor
+
+        self._log_monitor = ChangeMonitor(clock=clock)
 
     # -- pod collection (provisioner.go:350-385) -------------------------------
 
@@ -562,15 +565,53 @@ class Provisioner:
     def reconcile(self):
         """SchedulingResult | None (nothing to do) | GATED (retry later)."""
         pods = self.pending_pods()
+        from karpenter_tpu.utils import metrics
+
         if not pods:
+            # drained queue: zero the families so dashboards don't read a
+            # stale backlog (the reference gauges follow the live queue)
+            metrics.SCHEDULER_QUEUE_DEPTH.set(0.0)
+            metrics.SCHEDULER_UNFINISHED_WORK.set(0.0)
+            metrics.SCHEDULER_IGNORED_PODS.set(0.0)
+            metrics.PENDING_PODS_BY_ZONE.values.clear()
             return None
         if not self.cluster.synced():
             return self.GATED
         scheduler = self._build_scheduler()
         if scheduler is None:
             return self.GATED
-        from karpenter_tpu.utils import metrics
 
+        # queue families (scheduling/metrics.go:52-100): depth = this
+        # batch; unfinished work = oldest waiting pod's age; pending by
+        # effective zone from each pod's zone restriction
+        metrics.SCHEDULER_QUEUE_DEPTH.set(float(len(pods)))
+        metrics.SCHEDULER_IGNORED_PODS.set(
+            float(
+                sum(
+                    1
+                    for p in self.store.pods()
+                    if p.is_pending() and not p.spec.node_name and not p.is_provisionable()
+                )
+            )
+        )
+        now = self.clock.now()
+        metrics.SCHEDULER_UNFINISHED_WORK.set(
+            max((now - p.metadata.creation_timestamp for p in pods), default=0.0)
+        )
+        metrics.PENDING_PODS_BY_ZONE.values.clear()
+        for p in pods:
+            from karpenter_tpu.scheduling import Requirements
+
+            reqs = Requirements.from_pod(p)
+            zones = (
+                sorted(reqs.get(l.LABEL_TOPOLOGY_ZONE).values)
+                if reqs.has(l.LABEL_TOPOLOGY_ZONE)
+                else []
+            )
+            zone = ",".join(zones) if zones else "any"
+            metrics.PENDING_PODS_BY_ZONE.set(
+                metrics.PENDING_PODS_BY_ZONE.get(zone=zone) + 1.0, zone=zone
+            )
         with metrics.SCHEDULING_DURATION.time():
             # regular provisioning disables reserved-capacity fallback
             # (provisioner.go:389 DisableReservedCapacityFallback): a pod
@@ -590,6 +631,20 @@ class Provisioner:
                 dra_problem=self._build_dra_problem(pods),
             )
         metrics.SCHEDULING_UNSCHEDULABLE.set(float(len(result.unschedulable)))
+        # solve summary, deduped like the reference's ChangeMonitor-guarded
+        # provisioner logs (provisioner.go:226-256)
+        from karpenter_tpu.utils.logging import get_logger
+
+        summary = {
+            "pods": len(pods),
+            "new_claims": len(result.claims),
+            "existing_placements": len(result.existing_assignments),
+            "unschedulable": len(result.unschedulable),
+        }
+        if self._log_monitor.has_changed("solve", summary):
+            get_logger().with_values(controller="provisioner").info(
+                "computed new nodes to fit pods", **summary
+            )
         self.create_node_claims(result)
         # nominate pods placed on existing nodes so the kube-scheduler (sim)
         # binds them and the next pass doesn't re-provision

@@ -826,7 +826,48 @@ class TPUScheduler:
     ) -> ops_solver.SolveResult:
         """Dispatch the scan, chunking large pod batches: one compiled
         executable per chunk shape, bounded per-dispatch transfers, and the
-        SolverState carried across calls — bit-identical to a single scan."""
+        SolverState carried across calls — bit-identical to a single scan.
+
+        Profiling: every dispatch runs under a jax.profiler trace
+        annotation; set KTPU_PROFILE_DIR to capture a full device trace of
+        one solve (the xprof analog of the reference's pprof handlers,
+        operator.go:205-219)."""
+        import os
+
+        import jax
+
+        profile_dir = os.environ.get("KTPU_PROFILE_DIR")
+        ctx = (
+            jax.profiler.trace(profile_dir)
+            if profile_dir
+            else jax.profiler.TraceAnnotation("ktpu_solve")
+        )
+        with ctx:
+            return self._run_solve_inner(
+                pt, tol, it_allow, exist_ok, pod_ports, pod_port_conf,
+                exist_tensors, template_tensors, topo_tensors, pod_topo,
+                zone_kid=zone_kid, ct_kid=ct_kid, n_claims=n_claims,
+                topo_kids=topo_kids,
+            )
+
+    def _run_solve_inner(
+        self,
+        pt,
+        tol,
+        it_allow,
+        exist_ok,
+        pod_ports,
+        pod_port_conf,
+        exist_tensors,
+        template_tensors,
+        topo_tensors,
+        pod_topo,
+        *,
+        zone_kid,
+        ct_kid,
+        n_claims,
+        topo_kids,
+    ) -> ops_solver.SolveResult:
         from karpenter_tpu.ops import kernels
 
         P_pad = pt.valid.shape[0]

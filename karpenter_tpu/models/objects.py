@@ -90,6 +90,11 @@ class ConditionSet:
         if existing is not None and existing.status == status:
             existing.reason, existing.message = reason, message
             return False
+        # status-condition auto-metrics (operatorpkg status.NewController
+        # analog, reference controllers.go:140-158)
+        from karpenter_tpu.utils.metrics import STATUS_CONDITION_TRANSITIONS
+
+        STATUS_CONDITION_TRANSITIONS.inc(type=ctype, status=status)
         self._conditions[ctype] = StatusCondition(
             type=ctype,
             status=status,

@@ -52,7 +52,12 @@ class Operator:
         options = options or Options.from_env()
         store = ObjectStore(clock)
         inner = KwokCloudProvider(store, catalog=catalog)
-        cloud = OverlayCloudProvider(inner, store)
+        from karpenter_tpu.cloudprovider.metrics import MetricsCloudProvider
+
+        # decorator chain mirrors kwok/main.go:36-37 + the metrics
+        # decorator (cloudprovider/metrics/cloudprovider.go) — the seam a
+        # remote-solver shim would occupy
+        cloud = MetricsCloudProvider(OverlayCloudProvider(inner, store))
         manager = Manager(store, cloud, clock, options=options)
         return Operator(store=store, cloud=cloud, manager=manager, options=options)
 
@@ -93,7 +98,7 @@ def _demo() -> None:
     for i in range(60):
         op.store.create(ObjectStore.PODS, make_pod(f"demo-{i}", cpu=0.5, memory="512Mi"))
     op.tick()
-    op.cloud.inner.simulate_kubelet_ready()
+    op.cloud.unwrapped.simulate_kubelet_ready()
     op.tick()
     print(f"nodes: {len(op.store.nodes())}, claims: {len(op.store.nodeclaims())}, "
           f"bound: {sum(1 for p in op.store.pods() if p.spec.node_name)}/60")
@@ -107,7 +112,7 @@ def _demo() -> None:
     clock.step(60.0)
     for _ in range(8):
         op.tick()
-        op.cloud.inner.simulate_kubelet_ready()
+        op.cloud.unwrapped.simulate_kubelet_ready()
         clock.step(20.0)
     op.tick()
     cpu = sum(n.status.capacity["cpu"] for n in op.store.nodes())

@@ -1,0 +1,97 @@
+"""Structured JSON logging + log-noise governor.
+
+Counterparts of reference pkg/operator/logging (zap JSON logger with
+level control and a NopLogger for simulations) and
+pkg/utils/pretty.ChangeMonitor (suppress repeat log lines until the
+payload changes or a TTL lapses).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Optional
+
+_LEVELS = {"debug": 10, "info": 20, "warn": 30, "error": 40}
+
+
+class Logger:
+    """Minimal zap-style JSON line logger. `with_values` returns a child
+    carrying bound key/values; `nop()` silences simulation code paths
+    (disruption/helpers.go:114 NopLogger)."""
+
+    def __init__(self, level: str = "info", stream=None, _bound: Optional[dict] = None, _nop: bool = False):
+        self.level = _LEVELS.get(level, 20)
+        self.stream = stream if stream is not None else sys.stderr
+        self._bound = dict(_bound or {})
+        self._nop = _nop
+
+    @staticmethod
+    def nop() -> "Logger":
+        return Logger(_nop=True)
+
+    def with_values(self, **kv) -> "Logger":
+        child = Logger(stream=self.stream, _nop=self._nop)
+        child.level = self.level
+        child._bound = {**self._bound, **kv}
+        return child
+
+    def _emit(self, level: str, msg: str, kv: dict) -> None:
+        if self._nop or _LEVELS[level] < self.level:
+            return
+        record = {
+            "level": level,
+            "time": time.time(),
+            "message": msg,
+            **self._bound,
+            **kv,
+        }
+        self.stream.write(json.dumps(record, default=str) + "\n")
+
+    def debug(self, msg: str, **kv) -> None:
+        self._emit("debug", msg, kv)
+
+    def info(self, msg: str, **kv) -> None:
+        self._emit("info", msg, kv)
+
+    def warn(self, msg: str, **kv) -> None:
+        self._emit("warn", msg, kv)
+
+    def error(self, msg: str, **kv) -> None:
+        self._emit("error", msg, kv)
+
+
+class ChangeMonitor:
+    """Log-dedup governor (pretty.ChangeMonitor): has_changed(key, value)
+    is True only when the value differs from the last sighting or the
+    entry aged past the TTL — callers skip logging otherwise."""
+
+    def __init__(self, ttl_seconds: float = 24 * 3600.0, clock=None):
+        self.ttl = ttl_seconds
+        self._clock = clock
+        self._seen: dict[str, tuple[str, float]] = {}
+
+    def _now(self) -> float:
+        return self._clock.now() if self._clock is not None else time.time()
+
+    def has_changed(self, key: str, value) -> bool:
+        rendered = json.dumps(value, sort_keys=True, default=str)
+        now = self._now()
+        prev = self._seen.get(key)
+        if prev is not None and prev[0] == rendered and now - prev[1] < self.ttl:
+            return False
+        self._seen[key] = (rendered, now)
+        return True
+
+
+# process-wide default logger; operators may swap it (operator/logging)
+DEFAULT = Logger(level="warn")
+
+
+def get_logger() -> Logger:
+    return DEFAULT
+
+
+def set_level(level: str) -> None:
+    DEFAULT.level = _LEVELS.get(level, 20)

@@ -191,3 +191,70 @@ NODEPOOL_USAGE = REGISTRY.gauge(
 NODEPOOL_LIMIT = REGISTRY.gauge(
     "karpenter_nodepool_limit", "Per-pool resource limits", ("nodepool", "resource_type")
 )
+# scheduler queue families (provisioning/scheduling/metrics.go:39-100)
+SCHEDULER_QUEUE_DEPTH = REGISTRY.gauge(
+    "karpenter_scheduler_queue_depth", "Pods waiting in the scheduling queue"
+)
+SCHEDULER_UNFINISHED_WORK = REGISTRY.gauge(
+    "karpenter_scheduler_unfinished_work_seconds",
+    "Age of the oldest pod still waiting to be scheduled",
+)
+SCHEDULER_IGNORED_PODS = REGISTRY.gauge(
+    "karpenter_scheduler_ignored_pods_count", "Pods excluded from scheduling"
+)
+PENDING_PODS_BY_ZONE = REGISTRY.gauge(
+    "karpenter_scheduler_pending_pods_by_effective_zone_count",
+    "Pending pods grouped by their effective zone restriction",
+    ("zone",),
+)
+# pod state families (controllers/metrics/pod/controller.go:61-170)
+POD_STATE = REGISTRY.gauge(
+    "karpenter_pods_state",
+    "Current pod state",
+    ("name", "namespace", "node", "nodepool", "phase", "scheduled"),
+)
+POD_STARTUP_DURATION = REGISTRY.histogram(
+    "karpenter_pods_startup_duration_seconds", "Pod creation until running"
+)
+POD_BOUND_DURATION = REGISTRY.histogram(
+    "karpenter_pods_bound_duration_seconds", "Pod creation until bound to a node"
+)
+# node state families (controllers/metrics/node/controller.go:70-140)
+NODE_ALLOCATABLE = REGISTRY.gauge(
+    "karpenter_nodes_allocatable",
+    "Node allocatable by resource",
+    ("node_name", "nodepool", "resource_type"),
+)
+NODE_TOTAL_POD_REQUESTS = REGISTRY.gauge(
+    "karpenter_nodes_total_pod_requests",
+    "Summed pod requests per node",
+    ("node_name", "nodepool", "resource_type"),
+)
+NODE_UTILIZATION = REGISTRY.gauge(
+    "karpenter_nodes_utilization_percent",
+    "Requested over allocatable per node",
+    ("node_name", "nodepool", "resource_type"),
+)
+# status-condition auto-metrics (operatorpkg status controller analog,
+# reference controllers.go:140-158)
+STATUS_CONDITION_COUNT = REGISTRY.gauge(
+    "operator_status_condition_count",
+    "Objects per condition type/status",
+    ("kind", "type", "status"),
+)
+STATUS_CONDITION_TRANSITIONS = REGISTRY.counter(
+    "operator_status_condition_transitions_total",
+    "Condition transitions",
+    ("type", "status"),
+)
+# cloudprovider SPI decorator families (cloudprovider/metrics/cloudprovider.go)
+CLOUDPROVIDER_DURATION = REGISTRY.histogram(
+    "karpenter_cloudprovider_duration_seconds",
+    "SPI method wall time",
+    ("controller", "method", "provider"),
+)
+CLOUDPROVIDER_ERRORS = REGISTRY.counter(
+    "karpenter_cloudprovider_errors_total",
+    "SPI method errors",
+    ("controller", "method", "provider", "error"),
+)

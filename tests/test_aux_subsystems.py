@@ -256,3 +256,142 @@ class TestNodeOverlay:
             assert all(of.price == 5.0 for of in it.offerings)  # heaviest wins
             assert it.capacity["example.com/gpu"] == 4.0
             assert it.is_capacity_overlay_applied
+
+
+class TestObservability:
+    """Round-2 observability surface: SPI metrics decorator, per-object
+    state gauges, status-condition auto-metrics, queue families, logging."""
+
+    def _env(self):
+        from karpenter_tpu.cloudprovider.fake import instance_types
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.cloudprovider.metrics import MetricsCloudProvider
+        from karpenter_tpu.controllers.manager import KubeSchedulerSim, Manager
+        from karpenter_tpu.models.nodepool import NodePool
+        from karpenter_tpu.models.pod import make_pod
+        from karpenter_tpu.state.store import ObjectStore
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        store = ObjectStore(clock)
+        cloud = MetricsCloudProvider(KwokCloudProvider(store, catalog=instance_types(16)))
+        mgr = Manager(store, cloud, clock)
+        store.create(ObjectStore.NODEPOOLS, NodePool())
+        store.create(ObjectStore.PODS, make_pod("p", cpu=0.5))
+        mgr.run_until_idle()
+        cloud.unwrapped.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        KubeSchedulerSim(store, mgr.cluster).bind_pending()
+        mgr.run_until_idle()
+        return clock, store, cloud, mgr
+
+    def test_spi_decorator_measures_calls(self):
+        from karpenter_tpu.utils import metrics
+
+        before = metrics.CLOUDPROVIDER_DURATION.totals.get(
+            ("", "create", "kwok"), 0
+        )
+        clock, store, cloud, mgr = self._env()
+        assert (
+            metrics.CLOUDPROVIDER_DURATION.totals.get(("", "create", "kwok"), 0)
+            > before
+        )
+        assert cloud.name == "kwok"
+
+    def test_spi_decorator_counts_errors(self):
+        from karpenter_tpu.cloudprovider import errors
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+        from karpenter_tpu.cloudprovider.metrics import MetricsCloudProvider
+        from karpenter_tpu.models.nodeclaim import NodeClaim
+        from karpenter_tpu.utils import metrics
+
+        fake = FakeCloudProvider()
+        fake.next_create_err = errors.InsufficientCapacityError("no capacity")
+        wrapped = MetricsCloudProvider(fake)
+        before = metrics.CLOUDPROVIDER_ERRORS.get(
+            method="create", provider="fake", error="InsufficientCapacityError"
+        )
+        try:
+            wrapped.create(NodeClaim())
+        except errors.InsufficientCapacityError:
+            pass
+        assert (
+            metrics.CLOUDPROVIDER_ERRORS.get(
+                method="create", provider="fake", error="InsufficientCapacityError"
+            )
+            == before + 1
+        )
+
+    def test_state_gauges_populated(self):
+        from karpenter_tpu.utils import metrics
+
+        clock, store, cloud, mgr = self._env()
+        mgr.run_maintenance()
+        node = store.nodes()[0]
+        assert metrics.NODE_ALLOCATABLE.get(
+            node_name=node.name, nodepool="default", resource_type="cpu"
+        ) > 0
+        assert metrics.NODE_TOTAL_POD_REQUESTS.get(
+            node_name=node.name, nodepool="default", resource_type="cpu"
+        ) >= 0.5
+        util = metrics.NODE_UTILIZATION.get(
+            node_name=node.name, nodepool="default", resource_type="cpu"
+        )
+        assert 0.0 < util <= 100.0
+        assert metrics.POD_STATE.get(
+            name="p", namespace="default", node=node.name, nodepool="default",
+            phase="Pending", scheduled="true",
+        ) == 1.0 or any(
+            k for k in metrics.POD_STATE.values if k[0] == "p"
+        )
+        assert metrics.POD_BOUND_DURATION.totals[()] >= 1
+        # status-condition gauges cover claim conditions
+        assert metrics.STATUS_CONDITION_COUNT.get(
+            kind="NodeClaim", type="Launched", status="True"
+        ) >= 1.0
+
+    def test_scheduler_queue_metrics(self):
+        from karpenter_tpu.utils import metrics
+
+        clock, store, cloud, mgr = self._env()
+        # queue drained after a successful pass
+        assert metrics.SCHEDULER_QUEUE_DEPTH.get() >= 1.0
+        assert metrics.PENDING_PODS_BY_ZONE.get(zone="any") >= 1.0
+
+    def test_condition_transitions_counted(self):
+        from karpenter_tpu.models.objects import ConditionSet
+        from karpenter_tpu.utils import metrics
+
+        before = metrics.STATUS_CONDITION_TRANSITIONS.get(type="TestCond", status="True")
+        cs = ConditionSet()
+        cs.set_true("TestCond")
+        cs.set_true("TestCond")  # no transition
+        cs.set_false("TestCond")
+        assert metrics.STATUS_CONDITION_TRANSITIONS.get(type="TestCond", status="True") == before + 1
+        assert metrics.STATUS_CONDITION_TRANSITIONS.get(type="TestCond", status="False") >= 1
+
+    def test_logger_and_change_monitor(self):
+        import io
+        import json as _json
+
+        from karpenter_tpu.utils.clock import FakeClock
+        from karpenter_tpu.utils.logging import ChangeMonitor, Logger
+
+        buf = io.StringIO()
+        log = Logger(level="info", stream=buf).with_values(controller="provisioner")
+        log.debug("hidden")
+        log.info("solved", pods=5)
+        lines = [l for l in buf.getvalue().splitlines() if l]
+        assert len(lines) == 1
+        rec = _json.loads(lines[0])
+        assert rec["message"] == "solved" and rec["controller"] == "provisioner"
+        assert Logger.nop() is not None  # nop never raises
+        Logger.nop().error("dropped")
+
+        clock = FakeClock()
+        cm = ChangeMonitor(ttl_seconds=60.0, clock=clock)
+        assert cm.has_changed("k", {"a": 1})
+        assert not cm.has_changed("k", {"a": 1})
+        assert cm.has_changed("k", {"a": 2})
+        clock.step(61.0)
+        assert cm.has_changed("k", {"a": 2})  # TTL re-log

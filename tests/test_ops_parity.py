@@ -106,7 +106,7 @@ class TestOperator:
         op.store.create(ObjectStore.NODEPOOLS, pool)
         op.store.create(ObjectStore.PODS, make_pod("p", cpu=0.5))
         op.tick()
-        op.cloud.inner.simulate_kubelet_ready()
+        op.cloud.unwrapped.simulate_kubelet_ready()
         op.tick()
         assert len(op.store.nodes()) == 1
         assert all(p.spec.node_name for p in op.store.pods())

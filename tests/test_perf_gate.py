@@ -1,0 +1,35 @@
+"""The MinPodsPerSec-style performance gate, run as a normal test.
+
+Counterpart of the reference's scheduling benchmark assertion
+(scheduling_benchmark_test.go:58,211-214: MinPodsPerSec = 100). The CI
+environment is an 8-virtual-device CPU mesh (conftest.py), far slower than
+the TPU the headline bench runs on, so the gate here asserts the
+reference's own floor — 100 pods/sec — on a reference-mix workload sized
+for CPU. bench.py measures the real headline on hardware.
+"""
+
+import time
+
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.controllers.provisioning import TPUScheduler, build_templates
+from karpenter_tpu.models.nodepool import NodePool
+
+MIN_PODS_PER_SEC = 100.0  # the reference gate (:58)
+
+
+def test_reference_mix_meets_min_pods_per_sec():
+    import bench
+
+    pods = bench.mixed_pods(512)
+    pool = NodePool()
+    pool.metadata.name = "default"
+    templates = build_templates([(pool, instance_types(400))])
+    sched = TPUScheduler(templates, pod_pad=len(pods), max_claims=128)
+    result = sched.solve(pods)  # cold: compile dominates, not gated
+    assert not result.unschedulable
+    t0 = time.perf_counter()
+    result = sched.solve(pods)
+    wall = time.perf_counter() - t0
+    assert not result.unschedulable
+    rate = len(pods) / wall
+    assert rate >= MIN_PODS_PER_SEC, f"{rate:.1f} pods/sec < {MIN_PODS_PER_SEC}"
