@@ -137,13 +137,40 @@ def normalize_volume_reqs(volume_reqs: Optional[dict]) -> dict:
     return {uid: list(v) for uid, v in (volume_reqs or {}).items() if v}
 
 
+def pod_content_sig(pod: Pod) -> tuple:
+    """Canonical content signature for pod-kind grouping, cached on the pod
+    object (pod specs are immutable post-construction, matching Kubernetes;
+    the preference-relaxation ladder derives NEW pod copies and drops the
+    cache). Two pods with equal signatures produce identical rows in every
+    encoded problem tensor."""
+    s = pod.__dict__.get("_ktpu_sig")
+    if s is None:
+        s = (
+            repr(pod.spec),
+            tuple(sorted(pod.metadata.labels.items())),
+            pod.metadata.namespace,  # topology groups are per-namespace
+        )
+        pod.__dict__["_ktpu_sig"] = s
+    return s
+
+
 def ffd_sort(pods: list[Pod]) -> list[Pod]:
-    """CPU+memory descending (queue.go:72-90); stable on ties."""
+    """CPU+memory descending (queue.go:72-90), ties grouped by pod kind in
+    first-appearance order (the reference's sort is unstable on ties, so
+    any tie order is within its semantics; grouping makes identical pods
+    contiguous, which the kind-level batch placement path relies on).
+    Shared by both engines so their pod orders are identical."""
+    first_rank: dict[tuple, int] = {}
+    for p in pods:
+        first_rank.setdefault(pod_content_sig(p), len(first_rank))
     return sorted(
         pods,
-        key=lambda p: -(
-            p.spec.requests.get(res.CPU, 0.0)
-            + p.spec.requests.get(res.MEMORY, 0.0) / (4.0 * 2**30)
+        key=lambda p: (
+            -(
+                p.spec.requests.get(res.CPU, 0.0)
+                + p.spec.requests.get(res.MEMORY, 0.0) / (4.0 * 2**30)
+            ),
+            first_rank[pod_content_sig(p)],
         ),
     )
 

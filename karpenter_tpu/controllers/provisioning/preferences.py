@@ -38,6 +38,7 @@ def strip_preferences(pod: Pod) -> Pod:
     affinity, preferred pod (anti)affinity and ScheduleAnyway spread
     constraints up front — required OR terms and tolerations untouched."""
     relaxed = copy.copy(pod)
+    relaxed.__dict__.pop("_ktpu_sig", None)  # content changes: drop kind-sig cache
     relaxed.spec = copy.deepcopy(pod.spec)
     if relaxed.spec.node_affinity is not None:
         relaxed.spec.node_affinity.preferred = []
@@ -105,6 +106,7 @@ def relax_pod(pod: Pod, applied: int) -> Pod:
         return pod
     steps = rungs(pod)[:applied]
     relaxed = copy.copy(pod)
+    relaxed.__dict__.pop("_ktpu_sig", None)  # content changes: drop kind-sig cache
     relaxed.spec = copy.deepcopy(pod.spec)
     na = relaxed.spec.node_affinity
 

@@ -802,6 +802,487 @@ def solve_whatif(
     )
 
 
+# ---------------------------------------------------------------------------
+# Kind-level batch placement (the north-star path)
+# ---------------------------------------------------------------------------
+#
+# Real workloads are deployment-shaped: P pods collapse to a few hundred
+# distinct KINDS (identical spec+labels). The per-pod scan places one pod
+# per step; this path places a whole kind per step with closed-form
+# water-fill mathematics, matching the per-pod cascade exactly:
+#
+#   tier 1  identical pods fill existing nodes in index order until each
+#           node's capacity (resources, ports, hostname-topology) runs out
+#           — the earliest-feasible-node-per-pod loop IS a cumsum fill.
+#   tier 2  fewest-pods-first with earliest-slot tie-break over claims with
+#           per-claim capacities IS water-filling: raise a level L over pod
+#           counts; at the boundary level the remainder goes to eligible
+#           claims in slot order.
+#   tier 3  once in-flight capacity is exhausted, each new claim is filled
+#           to capacity before the next opens (the fresh claim always has
+#           the fewest pods), so opens are ceil(rem / per-claim-capacity).
+#
+# Hostname topology groups (TSC-hostname, anti-affinity) fold in as
+# per-slot capacity clamps: hostname spread's global min is always 0 (a
+# new node is always creatable), so a slot at count c takes at most
+# skew - self - c + 1 more recording pods; anti-affinity slots take 1.
+# Vocab-key (zonal) groups narrow requirements per placement and stay on
+# the per-pod scan — the host only routes kinds with no vg interaction
+# (and no minValues/reservations/finite budgets) here.
+#
+# Accumulation convention: a batch of c identical pods charges
+# used + c*req in ONE f32 multiply-add (the host decode mirrors this
+# exactly). This is closer to the reference's infinite-precision
+# resource.Quantity arithmetic than c sequential f32 adds, but differs
+# from the per-pod engines at float rounding boundaries; quantities that
+# are f32-product-exact (milli-CPU counts, Mi memory, powers of two) are
+# bit-identical across all engines.
+
+COUNT_CAP = jnp.int32(2**22)  # "unbounded" per-candidate fill cap
+
+
+class FillYs(NamedTuple):
+    """Per-segment fill record (the decode expands these to per-pod
+    assignments host-side)."""
+
+    fill_e: jnp.ndarray  # [E] i32 — pods landed per existing node
+    fill_c: jnp.ndarray  # [N] i32 — pods landed per claim slot
+    open_start: jnp.ndarray  # [] i32 — n_open before this segment
+    n_opened: jnp.ndarray  # [] i32 — new claims opened (contiguous slots)
+    tmpl: jnp.ndarray  # [] i32 — template of opened claims (-1 = none)
+    leftover: jnp.ndarray  # [] i32 — pods that failed to place
+    status: jnp.ndarray  # [] i32 — NO_CLAIM / NO_ROOM for the leftover
+
+
+def _count_cap_seq(used: jnp.ndarray, req: jnp.ndarray, limit: jnp.ndarray) -> jnp.ndarray:
+    """[...] i32 — max c >= 0 with used + c*req <= limit elementwise over
+    the trailing resource axis (resources with zero request always pass).
+
+    Product convention (see module comment): the check is the f32
+    multiply-add, with a +/-1 correction around the float division
+    estimate so the result is exactly consistent with the check.
+    """
+    pos = req > 0.0
+    safe = jnp.where(pos, req, 1.0)
+    head = limit - used
+    est = jnp.min(jnp.where(pos, head / safe, jnp.inf), axis=-1)
+    est = jnp.floor(jnp.where(jnp.isfinite(est), est, jnp.float32(COUNT_CAP)))
+    c0 = jnp.clip(est, 0.0, jnp.float32(COUNT_CAP)).astype(jnp.int32)
+
+    def ok(c):
+        t = used + c[..., None].astype(jnp.float32) * req
+        return jnp.all((t <= limit) | ~pos, axis=-1)
+
+    up = ok(c0 + 1)
+    mid = ok(c0)
+    return jnp.where(mid, jnp.where(up, c0 + 1, c0), jnp.maximum(c0 - 1, 0))
+
+
+def _hg_slot_caps(
+    topo: TopologyTensors,
+    counts: jnp.ndarray,  # [NGh, S]
+    slots: jnp.ndarray,  # [C] i32
+    applies: jnp.ndarray,  # [NGh] bool
+    records: jnp.ndarray,  # [NGh] bool
+    self_sel: jnp.ndarray,  # [NGh] bool
+) -> jnp.ndarray:
+    """[C] i32 — how many MORE pods of this kind each slot admits under the
+    hostname groups (hg_evaluate's per-pod checks solved for the max count).
+    Empty-group affinity bootstrap is excluded host-side."""
+    cnt = counts[:, slots].T  # [C, NGh]
+    rec = records[None, :]
+    self_ = self_sel[None, :].astype(jnp.int32)
+    skew = topo.hg_skew[None, :]
+    inf = COUNT_CAP.astype(jnp.int32)
+    spread = jnp.where(
+        rec,
+        skew - self_ - cnt + 1,
+        jnp.where(cnt + self_ <= skew, inf, 0),
+    )
+    anti = jnp.where(cnt == 0, jnp.where(rec, 1, inf), 0)
+    aff = jnp.where(cnt > 0, inf, 0)
+    t = topo.hg_type[None, :]
+    cap = jnp.where(
+        t == topo_ops.TYPE_SPREAD,
+        spread,
+        jnp.where(t == topo_ops.TYPE_AFFINITY, aff, anti),
+    )
+    gate = (applies & topo.hg_valid)[None, :]
+    cap = jnp.where(gate, cap, inf)
+    return jnp.clip(jnp.min(cap, axis=-1), 0, COUNT_CAP)
+
+
+def _fits_off_counted(
+    used: jnp.ndarray,  # [B, R] — base usage per candidate row
+    counts: jnp.ndarray,  # [B, T, GR] i32 — candidate fill counts
+    req: jnp.ndarray,  # [R]
+    it: InstanceTypeTensors,
+    off: jnp.ndarray,  # [B, T, GR] bool — offering-available per group
+) -> jnp.ndarray:
+    """[B, T, GR] bool — used + counts*req fits the group's allocatable.
+    Written as a static loop over the (small) resource axis so no
+    [B, T, GR, R] intermediate materializes."""
+    R = req.shape[0]
+    okc = off & it.group_valid[None, :, :]
+    cf = counts.astype(jnp.float32)
+    for r in range(R):
+        t = used[:, None, None, r] + cf * req[r]
+        okc &= (t <= it.alloc[None, :, :, r]) | (req[r] <= 0.0)
+    return okc
+
+
+def _claim_fill_caps(
+    used: jnp.ndarray,  # [B, R]
+    viable: jnp.ndarray,  # [B, T] bool — surviving instance types per row
+    req: jnp.ndarray,  # [R]
+    it: InstanceTypeTensors,
+    off: jnp.ndarray,  # [B, T, GR] bool
+) -> jnp.ndarray:
+    """[B] i32 — max pods addable per candidate row: the best (type, group)
+    among the row's viable types (fits-per-count is monotone, so the max
+    count over viable cells equals the per-pod loop's stopping point)."""
+    R = req.shape[0]
+    pos = req > 0.0
+    safe = jnp.where(pos, req, 1.0)
+    okc = off & it.group_valid[None, :, :] & viable[:, :, None]
+    est = jnp.full(okc.shape, jnp.float32(COUNT_CAP))
+    for r in range(R):  # static unroll over the small resource axis
+        head = it.alloc[None, :, :, r] - used[:, None, None, r]
+        ratio = jnp.where(pos[r], head / safe[r], jnp.inf)
+        est = jnp.minimum(est, ratio)
+    c0 = jnp.clip(
+        jnp.floor(jnp.where(jnp.isfinite(est), est, jnp.float32(COUNT_CAP))),
+        0.0,
+        jnp.float32(COUNT_CAP),
+    ).astype(jnp.int32)
+
+    def ok(c):
+        acc = okc
+        cf = c.astype(jnp.float32)
+        for r in range(R):
+            t = used[:, None, None, r] + cf * req[r]
+            acc = acc & ((t <= it.alloc[None, :, :, r]) | (req[r] <= 0.0))
+        return acc
+
+    up = ok(c0 + 1)
+    mid = ok(c0)
+    c = jnp.where(mid, jnp.where(up, c0 + 1, c0), jnp.maximum(c0 - 1, 0))
+    c = jnp.where(okc, c, 0)
+    return jnp.max(jnp.max(c, axis=-1), axis=-1)  # [B]
+
+
+def _water_fill(
+    p: jnp.ndarray,  # [N] i32 — current pod counts
+    f: jnp.ndarray,  # [N] i32 — per-claim additional capacity
+    rem: jnp.ndarray,  # [] i32 — pods to place
+) -> jnp.ndarray:
+    """[N] i32 — distribute rem pods by fewest-pods-first with
+    earliest-slot tie-break (the per-pod argmin over (pods, slot) loop in
+    closed form): raise a water level L over the counts; claims fill to
+    min(f, L-1-p); the remainder at level L goes to eligible claims in
+    slot order."""
+    f = jnp.minimum(f, rem)  # keeps int32 sums safe and levels tight
+    total = jnp.sum(f)
+
+    def placed(L):
+        return jnp.sum(jnp.minimum(f, jnp.maximum(0, L - p)))
+
+    # smallest L with placed(L) >= rem (search space: counts are < 2^22)
+    def body(_, lohi):
+        lo, hi = lohi
+        mid = (lo + hi) // 2
+        geq = placed(mid) >= rem
+        return jnp.where(geq, lo, mid + 1), jnp.where(geq, mid, hi)
+
+    lo, hi = jax.lax.fori_loop(
+        0, 24, body, (jnp.int32(0), jnp.max(p) + rem + 1)
+    )
+    L = lo
+    base = jnp.minimum(f, jnp.maximum(0, (L - 1) - p))
+    r0 = rem - jnp.sum(base)
+    elig = (f > 0) & (p + base == L - 1) & (base < f)
+    rank = jnp.cumsum(elig.astype(jnp.int32)) - elig.astype(jnp.int32)
+    extra = (elig & (rank < r0)).astype(jnp.int32)
+    fill = base + extra
+    return jnp.where(total <= rem, f, fill)
+
+
+class FillXs(NamedTuple):
+    """Per-segment (pod kind) inputs to the fill scan."""
+
+    reqs: ReqSetTensors  # [B, K, V]
+    requests: jnp.ndarray  # [B, R]
+    tmpl_ok: jnp.ndarray  # [B, G]
+    it_allow: jnp.ndarray  # [B, T]
+    exist_ok: jnp.ndarray  # [B, E]
+    ports: jnp.ndarray  # [B, NP]
+    port_conf: jnp.ndarray  # [B, NP]
+    count: jnp.ndarray  # [B] i32 — pods of this kind (0 = padding row)
+    hg_applies: jnp.ndarray  # [B, NGh]
+    hg_records: jnp.ndarray  # [B, NGh]
+    hg_self: jnp.ndarray  # [B, NGh]
+
+
+def _make_fill_step(
+    exist: ExistingNodes,
+    it: InstanceTypeTensors,
+    templates: Templates,
+    well_known: jnp.ndarray,
+    topo: TopologyTensors,
+    zone_kid: int,
+    ct_kid: int,
+    n_claims: int,
+):
+    N = n_claims
+    E = exist.avail.shape[0]
+    G = templates.its.shape[0]
+    no_wk = jnp.zeros_like(well_known)
+
+    def _off_for(comb, B):
+        """[B, T, GR] bool — offering available in a (zone, ct) the
+        combined requirements admit (the offering half of fits_off)."""
+        zmask = comb.mask[:, zone_kid, :]
+        cmask = comb.mask[:, ct_kid, :]
+        Z = it.zc_avail.shape[2]
+        C = it.zc_avail.shape[3]
+        return (
+            jnp.einsum(
+                "tgzc,nz,nc->ntg",
+                it.zc_avail.astype(jnp.bfloat16),
+                zmask[:, :Z].astype(jnp.bfloat16),
+                cmask[:, :C].astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            )
+            > 0
+        )
+
+    def step(state: SolverState, xs: FillXs):
+        count = xs.count
+        requests = xs.requests
+        self_conf = jnp.any(xs.ports & xs.port_conf)
+
+        # ---- tier 1: fill existing nodes in index order -------------------
+        pod_e = _broadcast_pod(xs.reqs, E)
+        comb_e = kernels.intersect_sets(state.exist_reqs, pod_e)
+        compat_e = kernels.compatible_elemwise(state.exist_reqs, pod_e, no_wk)
+        ports_ok_e = ~jnp.any(xs.port_conf[None, :] & state.exist_ports, axis=-1)
+        cap_res_e = _count_cap_seq(state.exist_used, requests[None, :], exist.avail)
+        cap_topo_e = _hg_slot_caps(
+            topo,
+            state.hg_counts,
+            jnp.arange(E, dtype=jnp.int32),
+            xs.hg_applies,
+            xs.hg_records,
+            xs.hg_self,
+        )
+        cap_e = jnp.minimum(cap_res_e, cap_topo_e)
+        cap_e = jnp.where(self_conf, jnp.minimum(cap_e, 1), cap_e)
+        feas_e = exist.valid & xs.exist_ok & compat_e & ports_ok_e
+        cap_e = jnp.where(feas_e, cap_e, 0)
+        cap_e = jnp.minimum(cap_e, count)
+        before = jnp.cumsum(cap_e) - cap_e
+        fill_e = jnp.clip(count - before, 0, cap_e)
+        rem = count - jnp.sum(fill_e)
+
+        landed_e = fill_e > 0
+        new_exist_used = state.exist_used + fill_e[:, None].astype(jnp.float32) * requests[None, :]
+        new_exist_reqs = kernels.select_set(landed_e, comb_e, state.exist_reqs)
+        new_exist_ports = state.exist_ports | (landed_e[:, None] & xs.ports[None, :])
+
+        # ---- tier 2: water-fill in-flight claims --------------------------
+        pod_b = _broadcast_pod(xs.reqs, N)
+        comb = kernels.intersect_sets(state.reqs, pod_b)
+        claim_ok = kernels.compatible_elemwise(state.reqs, pod_b, well_known)
+        it_compat = kernels.intersects(it.reqs, comb).T  # [N, T]
+        off_n = _off_for(comb, N)
+        allow_t = xs.it_allow[None, :]
+        viable = state.its & it_compat & allow_t
+        cap_res_n = _claim_fill_caps(state.used, viable, requests, it, off_n)
+        cap_topo_n = _hg_slot_caps(
+            topo,
+            state.hg_counts,
+            E + jnp.arange(N, dtype=jnp.int32),
+            xs.hg_applies,
+            xs.hg_records,
+            xs.hg_self,
+        )
+        ports_ok_n = ~jnp.any(xs.port_conf[None, :] & state.claim_ports, axis=-1)
+        tol = xs.tmpl_ok[state.template]
+        feas_n = state.open & claim_ok & tol & ports_ok_n
+        f_n = jnp.minimum(cap_res_n, cap_topo_n)
+        f_n = jnp.where(self_conf, jnp.minimum(f_n, 1), f_n)
+        f_n = jnp.where(feas_n, f_n, 0)
+        fill_c2 = _water_fill(state.pods, f_n, rem)
+        rem2 = rem - jnp.sum(fill_c2)
+
+        landed_n = fill_c2 > 0
+        used2 = state.used + fill_c2[:, None].astype(jnp.float32) * requests[None, :]
+        fits_final = jnp.any(
+            _fits_off_counted(state.used, jnp.broadcast_to(fill_c2[:, None, None], off_n.shape), requests, it, off_n),
+            axis=-1,
+        )  # [N, T]
+        its2 = jnp.where(landed_n[:, None], viable & fits_final, state.its)
+        reqs2 = kernels.select_set(landed_n, comb, state.reqs)
+        pods2 = state.pods + fill_c2
+        ports2 = state.claim_ports | (landed_n[:, None] & xs.ports[None, :])
+
+        # ---- tier 3: open new claims, each filled to capacity -------------
+        pod_g = _broadcast_pod(xs.reqs, G)
+        comb0 = kernels.intersect_sets(templates.reqs, pod_g)
+        tmpl_compat = kernels.compatible_elemwise(templates.reqs, pod_g, well_known)
+        it_compat0 = kernels.intersects(it.reqs, comb0).T  # [G, T]
+        off_g = _off_for(comb0, G)
+        # the one-pod fits check mirrors the per-pod step's fits_off0
+        fits_off0 = jnp.any(
+            _fits_off_counted(
+                templates.daemon_requests,
+                jnp.ones(off_g.shape, dtype=jnp.int32),
+                requests,
+                it,
+                off_g,
+            ),
+            axis=-1,
+        )
+        cap_ok = jnp.all(it.cap[None, :, :] <= state.budget[:, None, :], axis=-1)
+        its0 = templates.its & it_compat0 & fits_off0 & allow_t & cap_ok
+        cap_topo_fresh = _hg_slot_caps(
+            topo,
+            state.hg_counts,
+            jnp.broadcast_to(E + state.n_open, (1,)).astype(jnp.int32),
+            xs.hg_applies,
+            xs.hg_records,
+            xs.hg_self,
+        )[0]
+        tmpl_feas = (
+            templates.valid
+            & tmpl_compat
+            & xs.tmpl_ok
+            & jnp.any(its0, axis=-1)
+            & (state.nodes_budget >= 1.0)
+        )
+        g = jnp.argmax(tmpl_feas)
+        any_template = jnp.any(tmpl_feas) & (cap_topo_fresh > 0)
+        f_new0 = _claim_fill_caps(
+            templates.daemon_requests, its0, requests, it, off_g
+        )[g]
+        f_new = jnp.minimum(f_new0, cap_topo_fresh)
+        f_new = jnp.where(self_conf, jnp.minimum(f_new, 1), f_new)
+        f_new = jnp.where(any_template, jnp.maximum(f_new, 0), 0)
+        slots_avail = jnp.maximum(N - state.n_open, 0)
+        want = jnp.where(
+            f_new > 0, (rem2 + f_new - 1) // jnp.maximum(f_new, 1), 0
+        )
+        n_new = jnp.minimum(want, slots_avail)
+        idx = jnp.arange(N, dtype=jnp.int32)
+        i_new = idx - state.n_open
+        is_new = (i_new >= 0) & (i_new < n_new)
+        c_new = jnp.where(is_new, jnp.clip(rem2 - i_new * f_new, 0, f_new), 0)
+        placed3 = jnp.sum(c_new)
+        leftover = rem2 - placed3
+        status = jnp.where(any_template, jnp.int32(NO_ROOM), jnp.int32(NO_CLAIM))
+
+        used3 = jnp.where(
+            is_new[:, None],
+            templates.daemon_requests[g][None, :]
+            + c_new[:, None].astype(jnp.float32) * requests[None, :],
+            used2,
+        )
+        off_new = jnp.broadcast_to(off_g[g][None], (N,) + off_g.shape[1:])
+        fits_new = jnp.any(
+            _fits_off_counted(
+                jnp.broadcast_to(templates.daemon_requests[g][None, :], (N, requests.shape[0])),
+                jnp.broadcast_to(c_new[:, None, None], off_new.shape),
+                requests,
+                it,
+                off_new,
+            ),
+            axis=-1,
+        )  # [N, T]
+        its3 = jnp.where(is_new[:, None], its0[g][None, :] & fits_new, its2)
+        reqs3 = kernels.select_set(is_new, _broadcast_pod(kernels.take_set(comb0, g), N), reqs2)
+        template3 = jnp.where(is_new, g.astype(jnp.int32), state.template)
+        open3 = state.open | is_new
+        pods3 = jnp.where(is_new, c_new, pods2)
+        ports3 = jnp.where(
+            (is_new & (c_new > 0))[:, None], ports2 | xs.ports[None, :], ports2
+        )
+        new_n_open = state.n_open + n_new
+
+        # hostname-group count commits for every landed pod
+        fill_all_slots = jnp.concatenate([fill_e, jnp.where(is_new, c_new, fill_c2)])
+        S = state.hg_counts.shape[1]
+        pad = S - fill_all_slots.shape[0]
+        fill_slots = jnp.pad(fill_all_slots, (0, pad))
+        rec = (xs.hg_records & topo.hg_valid).astype(jnp.int32)
+        new_hg_counts = state.hg_counts + rec[:, None] * fill_slots[None, :]
+
+        # budget bookkeeping (the host only routes kinds here when every
+        # candidate template budget is unlimited, so these stay +inf)
+        max_cap = jnp.max(jnp.where(its0[g][:, None], it.cap, -jnp.inf), axis=0)
+        max_cap = jnp.where(jnp.isfinite(max_cap), max_cap, 0.0)
+        new_budget = state.budget.at[g].add(-max_cap * n_new.astype(jnp.float32))
+        new_nodes_budget = state.nodes_budget.at[g].add(-n_new.astype(jnp.float32))
+
+        ys = FillYs(
+            fill_e=fill_e,
+            fill_c=jnp.where(is_new, c_new, fill_c2),
+            open_start=state.n_open,
+            n_opened=n_new,
+            tmpl=jnp.where(n_new > 0, g.astype(jnp.int32), jnp.int32(-1)),
+            leftover=leftover,
+            status=status,
+        )
+        return (
+            SolverState(
+                exist_reqs=new_exist_reqs,
+                exist_used=new_exist_used,
+                reqs=reqs3,
+                used=used3,
+                its=its3,
+                template=template3,
+                open=open3,
+                pods=pods3,
+                n_open=new_n_open,
+                budget=new_budget,
+                nodes_budget=new_nodes_budget,
+                vg_counts=state.vg_counts,
+                hg_counts=new_hg_counts,
+                exist_ports=new_exist_ports,
+                claim_ports=ports3,
+                res_cap=state.res_cap,
+                held=state.held,
+            ),
+            ys,
+        )
+
+    return step
+
+
+_FILL_STATIC = ("zone_kid", "ct_kid", "n_claims")
+
+
+@functools.partial(jax.jit, static_argnames=_FILL_STATIC)
+def solve_fill(
+    state: SolverState,
+    xs: FillXs,
+    exist: ExistingNodes,
+    it: InstanceTypeTensors,
+    templates: Templates,
+    well_known: jnp.ndarray,
+    topo: TopologyTensors,
+    zone_kid: int,
+    ct_kid: int,
+    n_claims: int,
+) -> tuple[SolverState, FillYs]:
+    """Scan kind-level batch placement over B segments, threading the same
+    SolverState the per-pod scan uses — the host interleaves the two
+    dispatches freely (vg-topology kinds per-pod, everything else here)."""
+    step = _make_fill_step(
+        exist, it, templates, well_known, topo, zone_kid, ct_kid, n_claims
+    )
+    return jax.lax.scan(step, state, xs)
+
+
 def _apply_topo(reqs: ReqSetTensors, upd: jnp.ndarray, touched: jnp.ndarray) -> ReqSetTensors:
     """AND the topology domain masks into candidate requirements: touched
     keys become concrete finite sets (requirements.Add of an In set)."""

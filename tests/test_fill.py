@@ -1,0 +1,283 @@
+"""Differential tests for the kind-level batch placement path (solve_fill).
+
+Every case runs the SAME workload through the TPU engine (which routes
+batchable kinds through the fill scan) and the per-pod host oracle, then
+compares pod->slot assignments, claim pod lists, viable instance types and
+node counts. Workloads use f32-product-exact quantities (powers of two)
+so the fill kernel's one-multiply-add accumulation is bit-identical to
+the oracle's sequential merge (see ops/solver.py batch placement notes).
+
+Reference parity: scheduler.go:582-612 (3-tier cascade), queue.go:72-90
+(FFD order), topologygroup.go:229+ (hostname spread min=0 semantics).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.controllers.provisioning import TPUScheduler, build_templates
+from karpenter_tpu.controllers.provisioning.host_scheduler import (
+    ExistingSimNode,
+    HostScheduler,
+)
+from karpenter_tpu.controllers.provisioning.topology import (
+    Topology,
+    build_universe_domains,
+)
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import (
+    HostPort,
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+    make_pod,
+)
+from karpenter_tpu.scheduling import Operator, Requirement, Requirements
+
+
+def _templates(n_types=20):
+    pool = NodePool()
+    pool.metadata.name = "default"
+    return build_templates([(pool, instance_types(n_types))])
+
+
+def _compare(templates, pods, existing=None, max_claims=64, expect_unschedulable=0):
+    """Run both engines and assert identical packings."""
+    sched = TPUScheduler(templates, max_claims=max_claims)
+    stats = {"fill": 0, "pods": 0}
+    orig = sched._run_solve_inner
+
+    def wrapped(enc):
+        state, outputs = orig(enc)
+        for o in outputs:
+            stats[o[0]] += 1
+        return state, outputs
+
+    sched._run_solve_inner = wrapped
+    r_dev = sched.solve(pods, existing_nodes=[n.clone() for n in (existing or [])])
+    universe = build_universe_domains(templates, existing or [])
+    host = HostScheduler(
+        templates,
+        existing_nodes=[n.clone() for n in (existing or [])],
+        topology=Topology.build(list(pods), universe),
+    )
+    r_host = host.solve(list(pods))
+    assert len(r_dev.claims) == len(r_host.claims)
+    for cd, ch in zip(r_dev.claims, r_host.claims):
+        assert [p.uid for p in cd.pods] == [p.uid for p in ch.pods]
+        assert sorted(i.name for i in cd.instance_types) == sorted(
+            i.name for i in ch.instance_types
+        )
+        assert cd.used == ch.used, (cd.slot, cd.used, ch.used)
+        assert cd.hostname == ch.hostname
+    assert r_dev.assignments == r_host.assignments
+    assert r_dev.existing_assignments == r_host.existing_assignments
+    assert [p.uid for p, _ in r_dev.unschedulable] == [
+        p.uid for p, _ in r_host.unschedulable
+    ]
+    assert len(r_dev.unschedulable) == expect_unschedulable
+    return r_dev, stats
+
+
+def _pods(n, cpu=0.5, mem="1Gi", prefix="p", **kw):
+    return [make_pod(f"{prefix}-{i}", cpu=cpu, memory=mem, **kw) for i in range(n)]
+
+
+class TestFillParity:
+    def test_identical_pods_pack(self):
+        tmpl = _templates()
+        r, stats = _compare(tmpl, _pods(64))
+        assert stats["fill"] >= 1 and stats["pods"] == 0
+        assert r.node_count >= 1
+
+    def test_two_kinds_water_fill(self):
+        # big pods open claims; small pods water-fill the remainders
+        tmpl = _templates()
+        pods = _pods(8, cpu=2.0, mem="4Gi", prefix="big") + _pods(
+            40, cpu=0.25, mem="256Mi", prefix="small"
+        )
+        r, stats = _compare(tmpl, pods)
+        assert stats["fill"] >= 1 and stats["pods"] == 0
+
+    def test_selector_kinds(self):
+        tmpl = _templates()
+        pods = []
+        zones = ("test-zone-1", "test-zone-2")
+        for i in range(48):
+            sel = {}
+            if i % 3 == 1:
+                sel[l.LABEL_TOPOLOGY_ZONE] = zones[i % 2]
+            if i % 3 == 2:
+                sel[l.CAPACITY_TYPE_LABEL_KEY] = l.CAPACITY_TYPE_ON_DEMAND
+            pods.append(make_pod(f"s-{i}", cpu=0.5, memory="1Gi", node_selector=sel))
+        _compare(tmpl, pods)
+
+    def test_existing_nodes_tier1_fill(self):
+        tmpl = _templates()
+        reqs = Requirements()
+        reqs.add(Requirement.new(l.LABEL_HOSTNAME, Operator.IN, "node-a"))
+        reqs.add(Requirement.new(l.LABEL_TOPOLOGY_ZONE, Operator.IN, "test-zone-1"))
+        reqs.add(
+            Requirement.new(l.CAPACITY_TYPE_LABEL_KEY, Operator.IN, l.CAPACITY_TYPE_ON_DEMAND)
+        )
+        node = ExistingSimNode(
+            name="node-a",
+            index=0,
+            requirements=reqs,
+            available={"cpu": 4.0, "memory": float(8 * 2**30), "pods": 110.0},
+        )
+        # 8 pods of 0.5 cpu: node takes 8; 16 more overflow to new claims
+        r, stats = _compare(tmpl, _pods(24, cpu=0.5, mem="512Mi"), existing=[node])
+        assert stats["fill"] >= 1
+        assert len(r.existing_assignments) == 8
+
+    def test_hostname_spread_one_per_node(self):
+        tmpl = _templates()
+        pods = []
+        for i in range(12):
+            p = make_pod(f"h-{i}", cpu=0.25, memory="256Mi")
+            p.metadata.labels = {"spread": "host"}
+            p.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=l.LABEL_HOSTNAME,
+                    label_selector={"spread": "host"},
+                )
+            ]
+            pods.append(p)
+        r, stats = _compare(tmpl, pods)
+        assert stats["fill"] >= 1 and stats["pods"] == 0  # hg kinds batch
+        assert r.node_count == 12  # maxSkew=1, fresh domain always at 0
+
+    def test_hostname_spread_skew2(self):
+        tmpl = _templates()
+        pods = []
+        for i in range(12):
+            p = make_pod(f"h2-{i}", cpu=0.25, memory="256Mi")
+            p.metadata.labels = {"spread": "host2"}
+            p.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=2,
+                    topology_key=l.LABEL_HOSTNAME,
+                    label_selector={"spread": "host2"},
+                )
+            ]
+            pods.append(p)
+        r, stats = _compare(tmpl, pods)
+        assert stats["fill"] >= 1
+        assert r.node_count == 6  # two per node at skew 2
+
+    def test_anti_affinity_one_per_node(self):
+        tmpl = _templates()
+        pods = []
+        for i in range(10):
+            p = make_pod(f"a-{i}", cpu=0.25, memory="256Mi")
+            p.metadata.labels = {"app": "nginx"}
+            p.spec.pod_anti_affinity = [
+                PodAffinityTerm(
+                    topology_key=l.LABEL_HOSTNAME, label_selector={"app": "nginx"}
+                )
+            ]
+            pods.append(p)
+        r, stats = _compare(tmpl, pods)
+        assert stats["fill"] >= 1 and stats["pods"] == 0
+        assert r.node_count == 10
+
+    def test_hostport_self_conflict_one_per_node(self):
+        tmpl = _templates()
+        pods = _pods(6, cpu=0.25, mem="256Mi", host_ports=[HostPort(port=8080)])
+        r, stats = _compare(tmpl, pods)
+        assert stats["fill"] >= 1
+        assert r.node_count == 6
+
+    def test_no_claim_impossible_selector(self):
+        tmpl = _templates()
+        pods = _pods(5, node_selector={l.LABEL_TOPOLOGY_ZONE: "nonexistent-zone"})
+        _compare(tmpl, pods, expect_unschedulable=5)
+
+    def test_no_room_slots_exhausted(self):
+        tmpl = _templates(1)  # single 1-cpu type (alloc ~0.918 cpu)
+        # pods too big to share a node: each needs its own claim; 4 slots
+        pods = _pods(8, cpu=0.5, mem="256Mi")
+        r, stats = _compare(tmpl, pods, max_claims=4, expect_unschedulable=4)
+        reasons = {reason for _, reason in r.unschedulable}
+        assert reasons == {"claim-slot capacity exhausted; raise max_claims"}
+
+    def test_vg_kinds_interleave_with_fill(self):
+        # zonal TSC pods (per-pod scan) interleaved with identical generic
+        # pods (fill scan) at the same FFD size
+        tmpl = _templates()
+        pods = []
+        for i in range(30):
+            p = make_pod(f"m-{i}", cpu=0.5, memory="1Gi")
+            if i % 2 == 0:
+                p.metadata.labels = {"spread": "zonal"}
+                p.spec.topology_spread_constraints = [
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=l.LABEL_TOPOLOGY_ZONE,
+                        label_selector={"spread": "zonal"},
+                    )
+                ]
+            pods.append(p)
+        r, stats = _compare(tmpl, pods)
+        assert stats["fill"] >= 1 and stats["pods"] >= 1
+
+    def test_fill_then_per_pod_lands_on_fill_claims(self):
+        # generic pods open claims via fill; a later zonal-TSC kind (same
+        # size class ordering puts it after) must still see those claims
+        tmpl = _templates()
+        pods = _pods(16, cpu=1.0, mem="1Gi", prefix="g")
+        for i in range(4):
+            p = make_pod(f"z-{i}", cpu=0.5, memory="512Mi")
+            p.metadata.labels = {"spread": "zonal"}
+            p.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=l.LABEL_TOPOLOGY_ZONE,
+                    label_selector={"spread": "zonal"},
+                )
+            ]
+            pods.append(p)
+        _compare(tmpl, pods)
+
+
+class TestFillUnits:
+    def test_water_fill_matches_bruteforce(self):
+        import jax.numpy as jnp
+
+        from karpenter_tpu.ops.solver import _water_fill
+
+        rng = np.random.default_rng(7)
+        for _ in range(50):
+            n = 16
+            p = rng.integers(0, 6, n).astype(np.int32)
+            f = rng.integers(0, 5, n).astype(np.int32)
+            rem = int(rng.integers(0, 25))
+            got = np.asarray(_water_fill(jnp.asarray(p), jnp.asarray(f), jnp.int32(rem)))
+            # brute force: repeatedly place on argmin (count, slot) with capacity
+            cnt = p.copy()
+            cap = f.copy()
+            fill = np.zeros(n, dtype=np.int32)
+            for _ in range(rem):
+                cands = np.flatnonzero(cap > 0)
+                if len(cands) == 0:
+                    break
+                j = cands[np.lexsort((cands, cnt[cands]))[0]]
+                fill[j] += 1
+                cnt[j] += 1
+                cap[j] -= 1
+            assert (got == fill).all(), (p, f, rem, got, fill)
+
+    def test_count_cap_product_convention(self):
+        import jax.numpy as jnp
+
+        from karpenter_tpu.ops.solver import _count_cap_seq
+
+        used = jnp.asarray([[0.0, 0.0], [1.0, 0.0]], dtype=jnp.float32)
+        req = jnp.asarray([0.5, 0.0], dtype=jnp.float32)
+        limit = jnp.asarray([[4.0, 1.0], [4.0, 1.0]], dtype=jnp.float32)
+        got = np.asarray(_count_cap_seq(used, req[None, :], limit))
+        assert got.tolist() == [8, 6]
