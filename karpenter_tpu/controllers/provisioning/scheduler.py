@@ -48,6 +48,14 @@ class DivergenceError(RuntimeError):
     to the host oracle (never aborts provisioning)."""
 
 
+# NO_ROOM is a device-shape artifact with no reference analog: the Go
+# scheduler always opens another node (scheduler.go:582-612). solve()
+# recovers by doubling the claim-slot capacity and re-solving, so this
+# reason only ever surfaces if recovery is impossible (it never is — the
+# cap grows to one slot per pod).
+NO_ROOM_REASON = "claim-slot capacity exhausted; raise max_claims"
+
+
 def _next_pow2(n: int, floor: int = 8) -> int:
     out = floor
     while out < n:
@@ -93,6 +101,7 @@ class TPUScheduler:
         self.catalog: list[InstanceType] = list(seen.values())
         self._it_index = {name: i for i, name in enumerate(seen)}
         self.max_claims = max_claims
+        self._n_claims_override: Optional[int] = None
         self.pod_pad = pod_pad
         import os
 
@@ -342,15 +351,27 @@ class TPUScheduler:
         self._reserved_in_use = reserved_in_use or {}
 
         def solve_round(current: list[Pod]) -> SchedulingResult:
-            if topology_factory is not None:
-                topo = topology_factory(current)
-            elif topology is not None:
-                topo = _copy.deepcopy(topology)
-            else:
-                topo = None
-            return self._solve_once(
-                current, [n.clone() for n in base_existing], budgets, topo
-            )
+            # NO_ROOM recovery: the reference never fails a pod because the
+            # solver ran out of claim slots (scheduler.go:582-612 always
+            # opens another node) — double the slot capacity and re-solve
+            # from scratch until every pod had a real chance at a slot.
+            while True:
+                if topology_factory is not None:
+                    topo = topology_factory(current)
+                elif topology is not None:
+                    topo = _copy.deepcopy(topology)
+                else:
+                    topo = None
+                result = self._solve_once(
+                    current, [n.clone() for n in base_existing], budgets, topo
+                )
+                cap = _next_pow2(max(len(current), 1))
+                used = self._n_claims_override or self.max_claims or cap
+                if used >= cap or not any(
+                    reason == NO_ROOM_REASON for _, reason in result.unschedulable
+                ):
+                    return result
+                self._n_claims_override = min(used * 2, cap)
 
         prev_mode = self.reserved_mode
         if reserved_mode is not None:
@@ -609,7 +630,7 @@ class TPUScheduler:
         # identical kinds contiguously, so each run of identical pods is
         # ONE segment for the kind-level batch placement path.
         P = len(pods_sorted)
-        n_claims = self.max_claims or _next_pow2(max(P, 1))
+        n_claims = self._n_claims_override or self.max_claims or _next_pow2(max(P, 1))
         kind_of = np.empty(max(P, 1), dtype=np.int64)
         kind_of[:] = 0
         reps: list[Pod] = []
@@ -1025,7 +1046,7 @@ class TPUScheduler:
 
         def decode_pod(pod: Pod, slot: int) -> None:
             if slot == ops_solver.NO_ROOM:
-                unschedulable.append((pod, "claim-slot capacity exhausted; raise max_claims"))
+                unschedulable.append((pod, NO_ROOM_REASON))
                 return
             if slot < 0:
                 unschedulable.append((pod, "no compatible in-flight claim or template"))
@@ -1148,7 +1169,7 @@ class TPUScheduler:
                         topo.record(p, claim.requirements)
             # leftovers failed with a uniform reason
             reason = (
-                "claim-slot capacity exhausted; raise max_claims"
+                NO_ROOM_REASON
                 if status == ops_solver.NO_ROOM
                 else "no compatible in-flight claim or template"
             )
