@@ -13,6 +13,8 @@ exactly on featured-covered problems.
 
 from __future__ import annotations
 
+import numpy as np
+
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -137,19 +139,67 @@ def normalize_volume_reqs(volume_reqs: Optional[dict]) -> dict:
     return {uid: list(v) for uid, v in (volume_reqs or {}).items() if v}
 
 
-def pod_content_sig(pod: Pod) -> tuple:
+def _canon_terms(terms) -> tuple:
+    """Affinity/TSC term lists with their label_selector dicts sorted by
+    key, so content-equal pods built with different key order share a
+    kind; every other term field rides along positionally."""
+    import dataclasses
+
+    out = []
+    for t in terms:
+        row = []
+        for f in dataclasses.fields(t):
+            v = getattr(t, f.name)
+            if isinstance(v, dict):
+                v = tuple(sorted(v.items()))
+            elif isinstance(v, list):
+                v = tuple(v)
+            row.append(v)
+        out.append(tuple(row))
+    return tuple(out)
+
+
+# Content-sig intern table: the full canonical tuples are large, and
+# hashing them on every dict lookup dominates encode time at 100k pods.
+# Interning returns a small int whose hash is free; the table is bounded
+# by the number of DISTINCT pod contents ever seen (deployment-shaped
+# workloads keep it tiny).
+_SIG_IDS: dict[tuple, int] = {}
+
+
+def pod_content_sig(pod: Pod) -> int:
     """Canonical content signature for pod-kind grouping, cached on the pod
     object (pod specs are immutable post-construction, matching Kubernetes;
     the preference-relaxation ladder derives NEW pod copies and drops the
     cache). Two pods with equal signatures produce identical rows in every
-    encoded problem tensor."""
+    encoded problem tensor. Dict-typed fields are canonicalized by sorted
+    key (insertion order must not split kinds); list-typed fields keep
+    their order (it is semantically meaningful for relaxation ladders).
+    Returns an interned int token: equal token <=> equal content."""
     s = pod.__dict__.get("_ktpu_sig")
     if s is None:
+        sp = pod.spec
         s = (
-            repr(pod.spec),
+            tuple(sorted(sp.requests.items())),
+            tuple(sorted(sp.limits.items())),
+            tuple(sorted(sp.node_selector.items())),
+            repr(sp.node_affinity),
+            _canon_terms(sp.pod_affinity),
+            _canon_terms(sp.pod_anti_affinity),
+            _canon_terms(sp.preferred_pod_affinity),
+            _canon_terms(sp.preferred_pod_anti_affinity),
+            _canon_terms(sp.topology_spread_constraints),
+            repr(sp.tolerations),
+            repr(sp.host_ports),
+            sp.node_name,
+            sp.priority,
+            tuple(sp.pvc_names),
+            tuple(sp.resource_claims),
+            sp.termination_grace_period_seconds,
             tuple(sorted(pod.metadata.labels.items())),
             pod.metadata.namespace,  # topology groups are per-namespace
         )
+        s = _SIG_IDS.setdefault(s, len(_SIG_IDS))
         pod.__dict__["_ktpu_sig"] = s
     return s
 
@@ -159,20 +209,24 @@ def ffd_sort(pods: list[Pod]) -> list[Pod]:
     first-appearance order (the reference's sort is unstable on ties, so
     any tie order is within its semantics; grouping makes identical pods
     contiguous, which the kind-level batch placement path relies on).
-    Shared by both engines so their pod orders are identical."""
-    first_rank: dict[tuple, int] = {}
-    for p in pods:
-        first_rank.setdefault(pod_content_sig(p), len(first_rank))
-    return sorted(
-        pods,
-        key=lambda p: (
-            -(
-                p.spec.requests.get(res.CPU, 0.0)
-                + p.spec.requests.get(res.MEMORY, 0.0) / (4.0 * 2**30)
-            ),
-            first_rank[pod_content_sig(p)],
-        ),
-    )
+    Shared by both engines so their pod orders are identical. One pass
+    collects keys into arrays and np.lexsort does the ordering (both
+    lexsort and the previous sorted() are stable, so the order is
+    unchanged — this is purely the vectorized form)."""
+    n = len(pods)
+    sizes = np.empty(n, dtype=np.float64)
+    ranks = np.empty(n, dtype=np.int64)
+    first_rank: dict[int, int] = {}
+    for i, p in enumerate(pods):
+        s = pod_content_sig(p)
+        r = first_rank.get(s)
+        if r is None:
+            r = first_rank[s] = len(first_rank)
+        ranks[i] = r
+        req = p.spec.requests
+        sizes[i] = req.get(res.CPU, 0.0) + req.get(res.MEMORY, 0.0) / (4.0 * 2**30)
+    order = np.lexsort((ranks, -sizes))
+    return [pods[i] for i in order]
 
 
 def filter_instance_types(

@@ -19,6 +19,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from karpenter_tpu.cloudprovider.instancetype import InstanceType
@@ -61,6 +62,57 @@ def _next_pow2(n: int, floor: int = 8) -> int:
     while out < n:
         out *= 2
     return out
+
+
+def _gather_pod_chunk(
+    reqs_k, strict_k, requests_k, tol_k, it_allow_k, exist_ok_k, ports_k,
+    conf_k, pod_topo_k, kid, n_valid,
+):
+    """One fused device dispatch for a per-pod chunk's kind->pod gathers.
+
+    Un-jitted, each chunk paid ~45 eager op dispatches (take_set +
+    take_pod_topology + 6 indexings); jitted, the whole materialization is
+    one cached executable per (chunk, tensor) shape class."""
+    from karpenter_tpu.ops.kernels import take_set
+
+    pt = ops_solver.PodTensors(
+        reqs=take_set(reqs_k, kid),
+        strict_reqs=take_set(strict_k, kid),
+        requests=requests_k[kid],
+        valid=jnp.arange(kid.shape[0]) < n_valid,
+    )
+    ptopo = topo_ops.take_pod_topology(pod_topo_k, kid)
+    return (
+        pt, tol_k[kid], it_allow_k[kid], exist_ok_k[kid], ports_k[kid],
+        conf_k[kid], ptopo,
+    )
+
+
+def _gather_fill_xs(
+    reqs_k, requests_k, tol_k, it_allow_k, exist_ok_k, ports_k, conf_k,
+    pod_topo_k, kid, counts,
+):
+    """Fused gather building FillXs for a batchable segment run."""
+    from karpenter_tpu.ops.kernels import take_set
+
+    ptopo = topo_ops.take_pod_topology(pod_topo_k, kid)
+    return ops_solver.FillXs(
+        reqs=take_set(reqs_k, kid),
+        requests=requests_k[kid],
+        tmpl_ok=tol_k[kid],
+        it_allow=it_allow_k[kid],
+        exist_ok=exist_ok_k[kid],
+        ports=ports_k[kid],
+        port_conf=conf_k[kid],
+        count=counts,
+        hg_applies=ptopo.hg_applies,
+        hg_records=ptopo.hg_records,
+        hg_self=ptopo.hg_self,
+    )
+
+
+_gather_pod_chunk = jax.jit(_gather_pod_chunk)
+_gather_fill_xs = jax.jit(_gather_fill_xs)
 
 
 def _merge_scaled(base: dict, req: dict, c: int) -> dict:
@@ -620,31 +672,60 @@ class TPUScheduler:
             self.encoder.vocab.add_key(g.key)
             for d in g.domains:
                 self.encoder.vocab.add_value(g.key, d)
-        pods_sorted = ffd_sort(list(pods))
-        # ---- pod-kind dedup -------------------------------------------------
+        # ---- FFD sort + pod-kind dedup (one fused pass) ---------------------
         # Every per-pod encoding below is a pure function of pod CONTENT
         # (spec + labels + volume restriction), so it is computed once per
         # distinct kind and gathered per pod. Real workloads are
         # deployment-shaped (P >> kinds), which turns the O(P) python
-        # encode loops into O(kinds) + device gathers — and ffd_sort groups
-        # identical kinds contiguously, so each run of identical pods is
-        # ONE segment for the kind-level batch placement path.
-        P = len(pods_sorted)
+        # encode loops into O(kinds) + device gathers — and the FFD order
+        # groups identical kinds contiguously, so each run of identical
+        # pods is ONE segment for the kind-level batch placement path.
+        #
+        # The sort and the dedup share ONE signature pass: interned content
+        # sigs + size keys collect into arrays, np.lexsort orders them
+        # (identical to host_scheduler.ffd_sort — both sorts are stable on
+        # the same keys), and np.unique factorizes kinds. The volume-
+        # restricted case (rare; multi-alternative routes to the host
+        # anyway) refines kinds with the per-pod volume signature.
+        pods_list = list(pods)
+        P = len(pods_list)
         n_claims = self._n_claims_override or self.max_claims or _next_pow2(max(P, 1))
-        kind_of = np.empty(max(P, 1), dtype=np.int64)
-        kind_of[:] = 0
-        reps: list[Pod] = []
-        sig_to_kind: dict = {}
-        for i, p in enumerate(pods_sorted):
-            s = self._kind_sig(p)
-            k = sig_to_kind.get(s)
-            if k is None:
-                k = len(reps)
-                sig_to_kind[s] = k
-                reps.append(p)
-            kind_of[i] = k
-        if not reps:
-            reps.append(Pod())  # degenerate empty solve
+        from karpenter_tpu.controllers.provisioning.host_scheduler import (
+            pod_content_sig,
+        )
+
+        sig = np.empty(max(P, 1), dtype=np.int64)
+        sizes = np.empty(max(P, 1), dtype=np.float64)
+        sig[:] = 0
+        sizes[:] = 0.0
+        if self._volume_reqs:
+            vol_ids: dict = {}
+            for i, p in enumerate(pods_list):
+                s = self._kind_sig(p)
+                sig[i] = vol_ids.setdefault(s, len(vol_ids))
+                req = p.spec.requests
+                sizes[i] = req.get(res.CPU, 0.0) + req.get(res.MEMORY, 0.0) / (4.0 * 2**30)
+        else:
+            for i, p in enumerate(pods_list):
+                sig[i] = pod_content_sig(p)
+                req = p.spec.requests
+                sizes[i] = req.get(res.CPU, 0.0) + req.get(res.MEMORY, 0.0) / (4.0 * 2**30)
+        if P:
+            # first-appearance rank in ORIGINAL order = ffd_sort's tie key
+            _, first0, inv0 = np.unique(sig[:P], return_index=True, return_inverse=True)
+            ranks = np.argsort(np.argsort(first0))[inv0]
+            order = np.lexsort((ranks, -sizes[:P]))
+            pods_sorted = [pods_list[i] for i in order]
+            # kind ids numbered by first appearance in the SORTED sequence
+            sig_sorted = sig[:P][order]
+            _, first1, inv1 = np.unique(sig_sorted, return_index=True, return_inverse=True)
+            r1 = np.argsort(np.argsort(first1))
+            kind_of = r1[inv1]
+            reps = [pods_sorted[int(first1[u])] for u in np.argsort(r1)]
+        else:
+            pods_sorted = []
+            kind_of = np.zeros(1, dtype=np.int64)
+            reps = [Pod()]  # degenerate empty solve
 
         for p in reps:
             self.encoder.observe_pod(p)
@@ -794,11 +875,13 @@ class TPUScheduler:
         # enforced minValues, reservations, finite pool budgets, or an
         # initially-empty hostname-affinity group (bootstrap is ordered).
         segments: list[tuple[int, int, int]] = []
-        for i in range(P):
-            if segments and kind_of[i] == segments[-1][2]:
-                segments[-1] = (segments[-1][0], i + 1, segments[-1][2])
-            else:
-                segments.append((i, i + 1, int(kind_of[i])))
+        if P:
+            ko = kind_of[:P]
+            starts = np.concatenate(([0], np.flatnonzero(ko[1:] != ko[:-1]) + 1))
+            ends = np.concatenate((starts[1:], [P]))
+            segments = [
+                (int(lo), int(hi), int(ko[lo])) for lo, hi in zip(starts, ends)
+            ]
         vga_np = np.asarray(pod_topo_k.vg_applies)
         vgr_np = np.asarray(pod_topo_k.vg_records)
         hga_np = np.asarray(pod_topo_k.hg_applies)
@@ -853,28 +936,14 @@ class TPUScheduler:
         )
 
     def _materialize_pods(self, enc: dict, kind_idx: np.ndarray, n_valid: int):
-        """Gather kind-level tensors into per-pod rows (device-side gathers;
-        nothing P-sized is built on the host). kind_idx is already padded to
-        the dispatch length; rows beyond n_valid are masked invalid."""
-        from karpenter_tpu.ops.kernels import take_set
-
-        kid = jnp.asarray(kind_idx)
-        L = len(kind_idx)
-        pt = ops_solver.PodTensors(
-            reqs=take_set(enc["reqs_k"], kid),
-            strict_reqs=take_set(enc["strict_k"], kid),
-            requests=enc["requests_k"][kid],
-            valid=jnp.asarray(np.arange(L) < n_valid),
-        )
-        ptopo = topo_ops.take_pod_topology(enc["pod_topo_k"], kid)
-        return (
-            pt,
-            enc["tol_k"][kid],
-            enc["it_allow_k"][kid],
-            enc["exist_ok_k"][kid],
-            enc["ports_k"][kid],
-            enc["conf_k"][kid],
-            ptopo,
+        """Gather kind-level tensors into per-pod rows (one fused jitted
+        device dispatch; nothing P-sized is built on the host). kind_idx is
+        already padded to the dispatch length; rows beyond n_valid are
+        masked invalid."""
+        return _gather_pod_chunk(
+            enc["reqs_k"], enc["strict_k"], enc["requests_k"], enc["tol_k"],
+            enc["it_allow_k"], enc["exist_ok_k"], enc["ports_k"], enc["conf_k"],
+            enc["pod_topo_k"], jnp.asarray(kind_idx), n_valid,
         )
 
     def _run_solve(self, enc: dict):
@@ -943,22 +1012,11 @@ class TPUScheduler:
                 for j, (lo, hi, k) in enumerate(segs):
                     kind_ids[j] = k
                     counts[j] = hi - lo
-                kid = jnp.asarray(kind_ids)
-                from karpenter_tpu.ops.kernels import take_set
-
-                ptopo = topo_ops.take_pod_topology(enc["pod_topo_k"], kid)
-                xs = ops_solver.FillXs(
-                    reqs=take_set(enc["reqs_k"], kid),
-                    requests=enc["requests_k"][kid],
-                    tmpl_ok=enc["tol_k"][kid],
-                    it_allow=enc["it_allow_k"][kid],
-                    exist_ok=enc["exist_ok_k"][kid],
-                    ports=enc["ports_k"][kid],
-                    port_conf=enc["conf_k"][kid],
-                    count=jnp.asarray(counts),
-                    hg_applies=ptopo.hg_applies,
-                    hg_records=ptopo.hg_records,
-                    hg_self=ptopo.hg_self,
+                xs = _gather_fill_xs(
+                    enc["reqs_k"], enc["requests_k"], enc["tol_k"],
+                    enc["it_allow_k"], enc["exist_ok_k"], enc["ports_k"],
+                    enc["conf_k"], enc["pod_topo_k"], jnp.asarray(kind_ids),
+                    jnp.asarray(counts),
                 )
                 state, ys = ops_solver.solve_fill(
                     state, xs, exist_tensors, self.it_tensors, template_tensors,
@@ -986,38 +1044,117 @@ class TPUScheduler:
                     outputs.append(("pods", clo, clo + L, res.assignment))
         return state, outputs
 
-    def _decode(self, pods_sorted: list[Pod], state: ops_solver.SolverState, outputs: list, enc: dict) -> SchedulingResult:
-        """Replay assignments host-side to rebuild exact claim objects.
+    def _decode(
+        self,
+        pods_sorted: list[Pod],
+        state: ops_solver.SolverState,
+        outputs: list,
+        enc: dict,
+    ) -> SchedulingResult:
+        """Claim-level decode straight from device state (no per-pod host
+        requirement replay).
 
-        The device decides WHO goes WHERE; the host re-derives each claim's
-        Requirements with the oracle-grade Python algebra, so emitted
-        NodeClaims carry exact reference semantics. Per-pod segments replay
-        pod by pod (incl. topology narrowing + count recording); fill
-        segments replay once per (kind, slot) group — requirement
-        intersection is idempotent across identical pods, and resource
-        accumulation uses the same one-multiply-add convention as the
-        device fill kernel.
+        The device decides WHO goes WHERE, and its SolverState carries the
+        exact narrowed requirement masks, f32 resource usage, viable-type
+        sets and reservation holds for every claim slot. Decode:
+
+          1. fetches everything in ONE batched transfer per dtype
+             (kernels.fetch_tree) — per-array np.asarray pays a full
+             round trip per read, ruinous over a tunneled TPU;
+          2. replays only the cheap pod->slot bookkeeping host-side (list
+             appends in scan order, preserving the oracle's claim and pod
+             ordering — queue.go:72-90 / scheduler.go:598 semantics);
+          3. reconstructs each claim's Requirements at CLAIM granularity:
+             template requirements (carrying minValues) + each distinct pod
+             KIND's requirements (requirement intersection is idempotent
+             across content-identical pods) + the device's vg-topology
+             narrowing read back from the claim's requirement masks (vg
+             narrowing always yields finite In sets over vocab domains,
+             exactly the domains topology.go:226-250 would have chosen —
+             bit-parity is enforced by the differential suites).
+
+        Usage comes from the device carry, which accumulated in the same
+        f32 order as the host oracle: per-pod adds for scan segments, one
+        multiply-add per fill batch (see _merge_scaled).
         """
+        from karpenter_tpu.controllers.provisioning.host_scheduler import (
+            finalize_min_values,
+            finalize_reserved,
+        )
+        from karpenter_tpu.ops.kernels import fetch_tree
+        from karpenter_tpu.scheduling import hostports as hpmod
+
+        # Fetch ONLY what decode reads, with the claim axis sliced to the
+        # opened-slot prefix (tier-3 allocates slots contiguously from the
+        # n_open counter, so every referenced slot is < n_open; the 256
+        # bucket keeps slice executables cached across solves). This halves
+        # the bytes on the wire vs fetching the whole SolverState.
+        n_open_i = int(np.asarray(state.n_open))
+        S = min(enc["n_claims"], max(256, -(-n_open_i // 256) * 256))
+        fetched = fetch_tree(
+            dict(
+                template=state.template[:S],
+                its=state.its[:S],
+                used=state.used[:S],
+                held=state.held[:S],
+                c_mask=state.reqs.mask[:S],
+                c_inf=state.reqs.inf[:S],
+                c_def=state.reqs.defined[:S],
+                e_mask=state.exist_reqs.mask,
+                e_inf=state.exist_reqs.inf,
+                e_def=state.exist_reqs.defined,
+                outputs=[
+                    o
+                    if o[0] == "pods"
+                    else (o[0], o[1], o[2]._replace(fill_c=o[2].fill_c[:, :S]))
+                    for o in outputs
+                ],
+            )
+        )
+        outputs = fetched["outputs"]
         E = enc["E"]
-        kind_records = enc["kind_records"]
         kind_of = enc["kind_of"]
-        claim_template = np.asarray(state.template)
-        # The device already computed each claim's viable-type set
-        # (compat × fits × offering × budget); read it instead of paying an
-        # O(claims × types) host recomputation. This is exact, not
-        # approximate: resource quantities are float32-quantized at every
-        # model boundary and accumulated in the same order on both sides
-        # (utils/resources.py), so device fits == host fits bit-for-bit —
-        # the differential suite compares the sets directly.
-        its_mask = np.asarray(state.its)
-        topo = self.topology
-        hostname_seq = 0
+        reps: list[Pod] = enc["reps"]
+        vocab = self.encoder.vocab
+        topo_kids = enc["topo_kids"]
 
         claims: list[SimClaim] = []
         slot_to_claim: dict[int, SimClaim] = {}
+        claim_kinds: dict[int, dict[int, int]] = {}  # slot -> kind -> count
+        node_kinds: dict[int, dict[int, int]] = {}
         unschedulable: list[tuple[Pod, str]] = []
         assignments: dict[str, int] = {}
         existing_assignments: dict[str, str] = {}
+        hostname_seq = 0
+
+        # per-kind memos: every pod of a kind is content-identical, so its
+        # requirements / totals / port keys are computed once
+        U = len(reps)
+        kind_reqs_c: list = [None] * U
+        kind_total_c: list = [None] * U
+        kind_ports_c: list = [None] * U
+
+        def kind_reqs(k: int) -> Requirements:
+            r = kind_reqs_c[k]
+            if r is None:
+                r = kind_reqs_c[k] = self._pod_reqs(reps[k])
+            return r
+
+        def kind_total(k: int) -> dict:
+            t = kind_total_c[k]
+            if t is None:
+                t = kind_total_c[k] = reps[k].total_requests()
+            return t
+
+        def kind_ports(k: int) -> list[tuple]:
+            p = kind_ports_c[k]
+            if p is None:
+                p = kind_ports_c[k] = [
+                    hpmod.port_key(h) for h in reps[k].spec.host_ports
+                ]
+            return p
+
+        claim_template = fetched["template"]
 
         def ensure_claim(slot: int) -> SimClaim:
             nonlocal hostname_seq
@@ -1027,65 +1164,50 @@ class TPUScheduler:
                 hostname_seq += 1
                 hostname = hostname_placeholder(hostname_seq)
                 requirements = tmpl.requirements.copy()
-                requirements.add(Requirement.new(l.LABEL_HOSTNAME, Operator.IN, hostname))
+                requirements.add(
+                    Requirement.new(l.LABEL_HOSTNAME, Operator.IN, hostname)
+                )
                 claim = SimClaim(
                     template=tmpl,
                     requirements=requirements,
-                    used=dict(tmpl.daemon_requests),
-                    instance_types=[],  # filled from the device mask below
+                    used={},  # finalized from the device carry below
+                    instance_types=[],  # finalized from the device mask below
                     pods=[],
                     slot=slot,
                     hostname=hostname,
                 )
                 slot_to_claim[slot] = claim
                 claims.append(claim)
-                topo.register(l.LABEL_HOSTNAME, hostname)
+                claim_kinds[slot] = {}
             return claim
 
-        from karpenter_tpu.scheduling import hostports as hpmod
-
-        def decode_pod(pod: Pod, slot: int) -> None:
+        def decode_pod(i: int, slot: int) -> None:
+            pod = pods_sorted[i]
             if slot == ops_solver.NO_ROOM:
                 unschedulable.append((pod, NO_ROOM_REASON))
                 return
             if slot < 0:
-                unschedulable.append((pod, "no compatible in-flight claim or template"))
+                unschedulable.append(
+                    (pod, "no compatible in-flight claim or template")
+                )
                 return
-            pod_reqs = self._pod_reqs(pod)
-            strict = Requirements.from_pod(pod, include_preferred=False)
+            k = int(kind_of[i])
             if slot < E:
-                # tier 1: existing node (host replay of the commit)
                 node = self.existing_nodes[slot]
-                base = node.requirements.copy()
-                base.add(*pod_reqs.values())
-                tightened = topo.add_requirements(pod, strict, base)
-                if tightened is None:
-                    raise DivergenceError(
-                        f"device/host divergence: topology rejected pod {pod.name} "
-                        f"on existing node {node.name}"
-                    )
-                node.requirements = tightened
-                node.used = res.merge(node.used, pod.total_requests())
+                node.used = res.merge(node.used, kind_total(k))
                 node.pods.append(pod)
-                node.host_ports.extend(hpmod.port_key(h) for h in pod.spec.host_ports)
-                topo.record(pod, tightened)
-                existing_assignments[pod.uid] = node.name
+                node.host_ports.extend(kind_ports(k))
+                nk = node_kinds.setdefault(slot, {})
+                nk[k] = nk.get(k, 0) + 1
+                existing_assignments[pod.metadata.uid] = node.name
                 return
             slot -= E
-            assignments[pod.uid] = slot
+            assignments[pod.metadata.uid] = slot
             claim = ensure_claim(slot)
-            combined = claim.requirements.copy()
-            combined.add(*pod_reqs.values())
-            tightened = topo.add_requirements(pod, strict, combined)
-            if tightened is None:
-                raise DivergenceError(
-                    f"device/host divergence: topology rejected pod {pod.name} "
-                    f"on claim slot {slot}"
-                )
-            claim.requirements = tightened
-            claim.used = res.merge(claim.used, pod.total_requests())
             claim.pods.append(pod)
-            topo.record(pod, tightened)
+            claim.host_ports.extend(kind_ports(k))
+            ck = claim_kinds[slot]
+            ck[k] = ck.get(k, 0) + 1
 
         def decode_fill_segment(seg, j, fe, fc, scalars):
             lo, hi, kind = seg
@@ -1094,31 +1216,24 @@ class TPUScheduler:
                 return
             open_start = int(scalars["open_start"][j])
             n_opened = int(scalars["n_opened"][j])
-            leftover = int(scalars["leftover"][j])
             status = int(scalars["status"][j])
-            pod0 = seg_pods[0]
-            pod_reqs = self._pod_reqs(pod0)
-            req_d = pod0.total_requests()
-            # topology count commits apply only to recording kinds
-            # (hostname groups only — batchable kinds never touch vg groups)
-            records = bool(kind_records[kind])
-            port_keys = [hpmod.port_key(h) for h in pod0.spec.host_ports]
+            req_d = kind_total(kind)
+            port_keys = kind_ports(kind)
             pos = 0
 
             # tier 1: existing nodes in index order
             for e in np.flatnonzero(fe[j]):
                 c = int(fe[j][e])
                 node = self.existing_nodes[int(e)]
-                node.requirements.add(*pod_reqs.values())
                 node.used = _merge_scaled(node.used, req_d, c)
                 batch = seg_pods[pos : pos + c]
                 pos += c
                 node.pods.extend(batch)
+                node.host_ports.extend(port_keys * c)
+                nk = node_kinds.setdefault(int(e), {})
+                nk[kind] = nk.get(kind, 0) + c
                 for p in batch:
-                    existing_assignments[p.uid] = node.name
-                    node.host_ports.extend(port_keys)
-                    if records:
-                        topo.record(p, node.requirements)
+                    existing_assignments[p.metadata.uid] = node.name
             # tier 2: water-fill order over in-flight claims
             new_lo, new_hi = open_start, open_start + n_opened
             t2 = [
@@ -1137,36 +1252,33 @@ class TPUScheduler:
                     slots_rep.append(np.full(c, s, dtype=np.int64))
                 levels = np.concatenate(levels)
                 slots_rep = np.concatenate(slots_rep)
-                order = np.argsort(levels * (enc["n_claims"] + 1) + slots_rep, kind="stable")
+                order = np.argsort(
+                    levels * (enc["n_claims"] + 1) + slots_rep, kind="stable"
+                )
                 for claim_slot in slots_rep[order]:
                     p = seg_pods[pos]
                     pos += 1
                     s = int(claim_slot)
-                    assignments[p.uid] = s
+                    assignments[p.metadata.uid] = s
                     slot_to_claim[s].pods.append(p)
                 for s in t2:
-                    claim = slot_to_claim[s]
                     c = int(fc[j][s])
-                    claim.requirements.add(*pod_reqs.values())
-                    claim.used = _merge_scaled(claim.used, req_d, c)
+                    claim = slot_to_claim[s]
                     claim.host_ports.extend(port_keys * c)
-                    if records:
-                        for p in claim.pods[len(claim.pods) - c :]:
-                            topo.record(p, claim.requirements)
+                    ck = claim_kinds[s]
+                    ck[kind] = ck.get(kind, 0) + c
             # tier 3: new claims in slot order, each filled to capacity
             for s in range(new_lo, new_hi):
                 c = int(fc[j][s])
                 claim = ensure_claim(s)
-                claim.requirements.add(*pod_reqs.values())
-                claim.used = _merge_scaled(claim.used, req_d, c)
                 batch = seg_pods[pos : pos + c]
                 pos += c
                 claim.pods.extend(batch)
                 claim.host_ports.extend(port_keys * c)
+                ck = claim_kinds[s]
+                ck[kind] = ck.get(kind, 0) + c
                 for p in batch:
-                    assignments[p.uid] = s
-                    if records:
-                        topo.record(p, claim.requirements)
+                    assignments[p.metadata.uid] = s
             # leftovers failed with a uniform reason
             reason = (
                 NO_ROOM_REASON
@@ -1179,34 +1291,63 @@ class TPUScheduler:
         for out in outputs:
             if out[0] == "pods":
                 _, lo, hi, assignment = out
-                arr = np.asarray(assignment)
                 for i in range(lo, hi):
-                    decode_pod(pods_sorted[i], int(arr[i - lo]))
+                    decode_pod(i, int(assignment[i - lo]))
             else:
                 _, segs, ys = out
-                fe = np.asarray(ys.fill_e)
-                fc = np.asarray(ys.fill_c)
                 scalars = {
-                    "open_start": np.asarray(ys.open_start),
-                    "n_opened": np.asarray(ys.n_opened),
-                    "leftover": np.asarray(ys.leftover),
-                    "status": np.asarray(ys.status),
+                    "open_start": ys.open_start,
+                    "n_opened": ys.n_opened,
+                    "status": ys.status,
                 }
                 for j, seg in enumerate(segs):
-                    decode_fill_segment(seg, j, fe, fc, scalars)
-        # viable instance types come straight from the device solver state
-        # (the device carried budget bookkeeping too, so no host replay of
-        # subtractMax is needed); keep them in the TEMPLATE's catalog order
-        # so cheapest_launch tie-breaks identically to the host oracle
-        held = np.asarray(state.held)
-        from karpenter_tpu.controllers.provisioning.host_scheduler import (
-            finalize_reserved,
-        )
+                    decode_fill_segment(seg, j, ys.fill_e, ys.fill_c, scalars)
 
+        # ---- finalization from device state --------------------------------
+        def fold_narrowing(reqs: Requirements, mask_r, inf_r, def_r, what: str):
+            """Intersect the device's vg-topology narrowing into host reqs.
+
+            For a key the device never narrowed, the mask equals the
+            host-side intersection already rebuilt from template+kind reqs,
+            so the extra add is an exact no-op; for a narrowed key it lands
+            precisely on the device-chosen domain set."""
+            for kid in topo_kids:
+                if not def_r[kid] or inf_r[kid]:
+                    continue
+                key = vocab.keys[kid]
+                vals = [
+                    v
+                    for vi, v in enumerate(vocab.values[kid])
+                    if mask_r[kid, vi]
+                ]
+                if not vals:
+                    raise DivergenceError(
+                        f"device narrowed {key} to the empty set on {what}"
+                    )
+                reqs.add(Requirement.new(key, Operator.IN, *vals))
+
+        its_mask = fetched["its"]
+        held = fetched["held"]
+        used_np = fetched["used"]
+        c_mask, c_inf, c_def = fetched["c_mask"], fetched["c_inf"], fetched["c_def"]
+        rids = self.encoder._resource_ids
         for claim in claims:
-            viable = {
-                self.catalog[t].name for t in np.nonzero(its_mask[claim.slot])[0]
-            }
+            s = claim.slot
+            kinds = claim_kinds[s]
+            reqs = claim.requirements
+            for k in kinds:
+                reqs.add(*kind_reqs(k).values())
+            fold_narrowing(reqs, c_mask[s], c_inf[s], c_def[s], f"claim slot {s}")
+            # usage from the device carry (daemon overhead folded in on open)
+            keys = set(claim.template.daemon_requests)
+            for k in kinds:
+                keys.update(kind_total(k))
+            vec = used_np[s]
+            claim.used = {name: float(vec[rids[name]]) for name in keys}
+            # viable instance types straight from the device solver state
+            # (the device carried budget bookkeeping too); TEMPLATE catalog
+            # order so cheapest_launch tie-breaks identically to the host
+            viable = {self.catalog[t].name for t in np.nonzero(its_mask[s])[0]}
             claim.instance_types = [
                 it for it in claim.template.instance_types if it.name in viable
             ]
@@ -1214,15 +1355,22 @@ class TPUScheduler:
             if self._rid_names:
                 claim.reserved_ids = frozenset(
                     self._rid_names[r]
-                    for r in np.nonzero(held[claim.slot][: len(self._rid_names)])[0]
+                    for r in np.nonzero(held[s][: len(self._rid_names)])[0]
                 )
             finalize_reserved(claim)
             if self.min_values_policy == "BestEffort":
-                from karpenter_tpu.controllers.provisioning.host_scheduler import (
-                    finalize_min_values,
-                )
-
                 finalize_min_values(claim)
+
+        e_mask, e_inf, e_def = fetched["e_mask"], fetched["e_inf"], fetched["e_def"]
+        for e, kinds in node_kinds.items():
+            node = self.existing_nodes[e]
+            for k in kinds:
+                node.requirements.add(*kind_reqs(k).values())
+            fold_narrowing(
+                node.requirements, e_mask[e], e_inf[e], e_def[e],
+                f"existing node {node.name}",
+            )
+
         return SchedulingResult(
             claims=claims,
             unschedulable=unschedulable,

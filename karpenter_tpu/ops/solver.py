@@ -856,11 +856,20 @@ class FillYs(NamedTuple):
 
 def _count_cap_seq(used: jnp.ndarray, req: jnp.ndarray, limit: jnp.ndarray) -> jnp.ndarray:
     """[...] i32 — max c >= 0 with used + c*req <= limit elementwise over
-    the trailing resource axis (resources with zero request always pass).
+    the trailing resource axis.
+
+    The per-resource pass condition is TOTAL-based — `(t <= limit) |
+    (t == 0.0)` — matching the per-pod engine's _fits_and_offering and the
+    reference's resources.fits (a zero total passes even against negative
+    headroom from daemon overhead; a zero REQUEST alone does not).
 
     Product convention (see module comment): the check is the f32
     multiply-add, with a +/-1 correction around the float division
-    estimate so the result is exactly consistent with the check.
+    estimate, and the returned count re-verified against the check itself
+    (zero on failure) so the result can never overcommit. The +/-1 window
+    is exact whenever the quotient is below 2^23 — always, in practice:
+    every pod requests pods=1 and allocatable pods is O(hundreds), so the
+    binding quotient never approaches the f32 integer cliff.
     """
     pos = req > 0.0
     safe = jnp.where(pos, req, 1.0)
@@ -871,11 +880,16 @@ def _count_cap_seq(used: jnp.ndarray, req: jnp.ndarray, limit: jnp.ndarray) -> j
 
     def ok(c):
         t = used + c[..., None].astype(jnp.float32) * req
-        return jnp.all((t <= limit) | ~pos, axis=-1)
+        return jnp.all((t <= limit) | (t == 0.0), axis=-1)
 
     up = ok(c0 + 1)
     mid = ok(c0)
-    return jnp.where(mid, jnp.where(up, c0 + 1, c0), jnp.maximum(c0 - 1, 0))
+    dn = ok(jnp.maximum(c0 - 1, 0))
+    return jnp.where(
+        mid,
+        jnp.where(up, c0 + 1, c0),
+        jnp.where(dn, jnp.maximum(c0 - 1, 0), 0),
+    )
 
 
 def _hg_slot_caps(
@@ -921,13 +935,16 @@ def _fits_off_counted(
 ) -> jnp.ndarray:
     """[B, T, GR] bool — used + counts*req fits the group's allocatable.
     Written as a static loop over the (small) resource axis so no
-    [B, T, GR, R] intermediate materializes."""
+    [B, T, GR, R] intermediate materializes. The pass condition is
+    total-based (`t == 0.0`), mirroring _fits_and_offering — a zero REQUEST
+    with nonzero existing usage must still be checked against allocatable
+    (e.g. daemon overhead exceeding capacity on an unrequested resource)."""
     R = req.shape[0]
     okc = off & it.group_valid[None, :, :]
     cf = counts.astype(jnp.float32)
     for r in range(R):
         t = used[:, None, None, r] + cf * req[r]
-        okc &= (t <= it.alloc[None, :, :, r]) | (req[r] <= 0.0)
+        okc &= (t <= it.alloc[None, :, :, r]) | (t == 0.0)
     return okc
 
 
@@ -961,12 +978,19 @@ def _claim_fill_caps(
         cf = c.astype(jnp.float32)
         for r in range(R):
             t = used[:, None, None, r] + cf * req[r]
-            acc = acc & ((t <= it.alloc[None, :, :, r]) | (req[r] <= 0.0))
+            acc = acc & ((t <= it.alloc[None, :, :, r]) | (t == 0.0))
         return acc
 
     up = ok(c0 + 1)
     mid = ok(c0)
-    c = jnp.where(mid, jnp.where(up, c0 + 1, c0), jnp.maximum(c0 - 1, 0))
+    dn = ok(jnp.maximum(c0 - 1, 0))
+    # re-verified against the check itself (zero on failure) — see
+    # _count_cap_seq for why the +/-1 window is exact in practice
+    c = jnp.where(
+        mid,
+        jnp.where(up, c0 + 1, c0),
+        jnp.where(dn, jnp.maximum(c0 - 1, 0), 0),
+    )
     c = jnp.where(okc, c, 0)
     return jnp.max(jnp.max(c, axis=-1), axis=-1)  # [B]
 
