@@ -64,13 +64,14 @@ class DisruptionController:
 
     # -- simulation hook ------------------------------------------------------
 
-    def _simulate(self, candidates: list[Candidate]):
+    def _simulate(self, candidates: list[Candidate], deadline=None):
         """SimulateScheduling (helpers.go:53-154): schedule pending pods +
         candidates' pods against the cluster minus the candidates. Returns
-        (results, unscheduled candidate-pod uids)."""
+        (results, unscheduled candidate-pod uids). deadline comes from the
+        calling method's timeout (1m multi-node / 3m single-node)."""
         excluded = {c.name for c in candidates}
         extra = [p for c in candidates for p in c.reschedulable_pods]
-        result = self.provisioner.simulate(excluded, extra)
+        result = self.provisioner.simulate(excluded, extra, deadline=deadline)
         if result is None:
             return None, set()
         extra_uids = {p.uid for p in extra}

@@ -28,6 +28,10 @@ MAX_MULTI_NODE_BATCH = 100
 # consolidation.go:47-48 spot-churn guards
 MIN_INSTANCE_TYPES_FOR_SPOT_TO_SPOT = 15
 MAX_SPOT_TO_SPOT_LAUNCH_FLEXIBILITY = 15
+# multinodeconsolidation.go:35 — expire the prefix search, return the last
+# valid command; singlenodeconsolidation.go:33 — abandon the candidate walk
+MULTI_NODE_CONSOLIDATION_TIMEOUT_SECONDS = 60.0
+SINGLE_NODE_CONSOLIDATION_TIMEOUT_SECONDS = 180.0
 
 # simulate(candidates) -> (SchedulingResult, unscheduled_candidate_pod_uids)
 SimulateFn = Callable[[list[Candidate]], tuple[Optional[SchedulingResult], set[str]]]
@@ -215,8 +219,24 @@ class _ConsolidationBase:
 
     # -- computeConsolidation (consolidation.go:159-343) --------------------
 
-    def compute_consolidation(self, candidates: list[Candidate]) -> Command:
-        results, unscheduled = self.simulate(candidates)
+    def _call_simulate(self, candidates: list[Candidate], deadline: Optional[float]):
+        """Pass the method deadline through when the simulate fn takes one
+        (the reference's SimulateScheduling inherits the method context)."""
+        if not hasattr(self, "_sim_takes_deadline"):
+            import inspect
+
+            params = inspect.signature(self.simulate).parameters
+            self._sim_takes_deadline = "deadline" in params or any(
+                p.kind == p.VAR_KEYWORD for p in params.values()
+            )
+        if self._sim_takes_deadline:
+            return self.simulate(candidates, deadline=deadline)
+        return self.simulate(candidates)
+
+    def compute_consolidation(
+        self, candidates: list[Candidate], deadline: Optional[float] = None
+    ) -> Command:
+        results, unscheduled = self._call_simulate(candidates, deadline)
         if results is None or unscheduled:
             return Command(reason=self.reason)
         if len(results.claims) == 0:
@@ -295,9 +315,12 @@ class SingleNodeConsolidation(_ConsolidationBase):
     """Per-candidate simulation, cheapest-savings first
     (singlenodeconsolidation.go:33-146). With the batched prefilter, every
     candidate's what-if runs as one device dispatch and only batch-feasible
-    candidates pay a sequential confirmation."""
+    candidates pay a sequential confirmation. The walk is bounded by the
+    3-minute method deadline (singlenodeconsolidation.go:33,60-68):
+    candidates not reached before it expires wait for the next pass."""
 
     def compute(self, candidates: list[Candidate], budgets: dict[str, int]) -> Command:
+        deadline = self.clock.now() + SINGLE_NODE_CONSOLIDATION_TIMEOUT_SECONDS
         eligible = _within_budget(
             sorted(self.eligible(candidates), key=lambda c: c.savings_ratio), budgets
         )
@@ -316,7 +339,12 @@ class SingleNodeConsolidation(_ConsolidationBase):
                     c for c, n in feasible if n > 1
                 ]
         for c in eligible:
-            cmd = self.compute_consolidation([c])
+            if self.clock.now() >= deadline:
+                from karpenter_tpu.utils.metrics import CONSOLIDATION_TIMEOUTS
+
+                CONSOLIDATION_TIMEOUTS.inc(method="single-node")
+                break
+            cmd = self.compute_consolidation([c], deadline)
             if not cmd.is_empty:
                 return cmd
         return Command(reason=self.reason)
@@ -327,6 +355,7 @@ class MultiNodeConsolidation(_ConsolidationBase):
     (multinodeconsolidation.go:52-191)."""
 
     def compute(self, candidates: list[Candidate], budgets: dict[str, int]) -> Command:
+        deadline = self.clock.now() + MULTI_NODE_CONSOLIDATION_TIMEOUT_SECONDS
         eligible = _within_budget(
             sorted(self.eligible(candidates), key=lambda c: c.savings_ratio), budgets
         )[:MAX_MULTI_NODE_BATCH]
@@ -339,8 +368,18 @@ class MultiNodeConsolidation(_ConsolidationBase):
 
         def compute_prefix(n: int) -> Command:
             if n not in prefix_memo:
-                prefix_memo[n] = self.compute_consolidation(eligible[:n])
+                prefix_memo[n] = self.compute_consolidation(eligible[:n], deadline)
             return prefix_memo[n]
+
+        def timed_out() -> bool:
+            # multinodeconsolidation.go:142-153: on deadline, return the
+            # last valid command instead of discarding the pass's work
+            if self.clock.now() >= deadline:
+                from karpenter_tpu.utils.metrics import CONSOLIDATION_TIMEOUTS
+
+                CONSOLIDATION_TIMEOUTS.inc(method="multi-node")
+                return True
+            return False
 
         if self.simulate_batch is not None:
             signals = self.simulate_batch([eligible[:n] for n in range(1, len(eligible) + 1)])
@@ -366,6 +405,8 @@ class MultiNodeConsolidation(_ConsolidationBase):
                 )
                 confirm_budget = max(2, len(eligible).bit_length())
                 for n in ordered[:confirm_budget]:
+                    if timed_out():
+                        return Command(reason=self.reason)
                     cmd = compute_prefix(n)
                     if not cmd.is_empty and self._replacement_improves(cmd, eligible[:n]):
                         return cmd
@@ -379,6 +420,8 @@ class MultiNodeConsolidation(_ConsolidationBase):
         lo, hi = 1, len(eligible)
         best = Command(reason=self.reason)
         while lo <= hi:
+            if timed_out():
+                return best  # last valid command
             mid = (lo + hi) // 2
             cmd = compute_prefix(mid)
             if not cmd.is_empty and self._replacement_improves(cmd, eligible[:mid]):

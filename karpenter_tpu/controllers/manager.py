@@ -55,6 +55,7 @@ class Manager:
             reserved_capacity_enabled=self.options.feature_gates.reserved_capacity,
             min_values_policy=self.options.min_values_policy,
             dynamic_resources_enabled=self.options.feature_gates.dynamic_resources,
+            solve_timeout_seconds=self.options.solve_timeout_seconds,
         )
         self.device_allocation = None
         if self.options.feature_gates.dynamic_resources:
@@ -237,11 +238,22 @@ class Manager:
     def run_disruption_once(self):
         """One disruption poll (the 10s singleton loop's body) followed by
         an orchestration-queue pass and a drain of resulting work."""
+        self._last_disruption_poll = self.clock.now()
         command = self.disruption.reconcile()
         self.run_until_idle()
         self.disruption.queue.process()
         self.run_until_idle()
         return command
+
+    def maybe_run_disruption(self):
+        """Poll-paced disruption (controller.go:71, options
+        disruption_poll_seconds): a no-op until the interval elapses."""
+        last = getattr(self, "_last_disruption_poll", None)
+        if last is not None and (
+            self.clock.now() - last < self.options.disruption_poll_seconds
+        ):
+            return None
+        return self.run_disruption_once()
 
     def run_maintenance(self) -> dict:
         """One pass of the periodic housekeeping controllers (GC,

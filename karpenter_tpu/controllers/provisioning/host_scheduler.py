@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import numpy as np
 
+import time as _time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -29,6 +30,11 @@ from karpenter_tpu.scheduling.taints import tolerates_all
 if False:  # typing-only import to avoid a cycle
     from karpenter_tpu.controllers.provisioning.topology import Topology
 from karpenter_tpu.utils import resources as res
+
+# Unschedulable reason stamped on pods the Solve deadline cut off
+# (provisioner.go:415: the 1m context expires and the queue drains with
+# ctx.Err() per remaining pod).
+SOLVE_TIMEOUT_REASON = "scheduling timeout exceeded"
 
 
 @dataclass
@@ -304,6 +310,8 @@ class HostScheduler:
         reserved_in_use: Optional[dict[str, int]] = None,
         dra_problem=None,
         pod_volumes: Optional[dict] = None,
+        deadline: Optional[float] = None,
+        now=None,
     ):
         """budgets: nodepool -> remaining resources (limits minus current
         usage; may include the synthetic 'nodes' count). Absent pool =
@@ -327,6 +335,11 @@ class HostScheduler:
         self.min_values_policy = min_values_policy
         self.reserved_in_use = reserved_in_use or {}
         self.dra_problem = dra_problem  # scheduling.dra.integration.DRAProblem
+        # Solve deadline (provisioner.go:415 1m context): checked at the top
+        # of every pod iteration like the reference's ctx.Err() poll, so an
+        # expired solve fails the REMAINING queue, not the placed prefix.
+        self.deadline = deadline
+        self.now = now if now is not None else _time.monotonic
         self._dra = None
         self._rm = None
         self._hostname_seq = 0
@@ -668,7 +681,10 @@ class HostScheduler:
             self._hostname_seq = 0
             return self._solve_once(current)
 
-        return prefs.run_with_relaxation(list(pods), solve_round)
+        def should_stop() -> bool:
+            return self.deadline is not None and self.now() >= self.deadline
+
+        return prefs.run_with_relaxation(list(pods), solve_round, should_stop)
 
     def _solve_once(self, pods: list[Pod]) -> SchedulingResult:
         self._rm = self._build_rm()
@@ -677,7 +693,16 @@ class HostScheduler:
         unschedulable: list[tuple[Pod, str]] = []
         assignments: dict[str, int] = {}
         existing_assignments: dict[str, str] = {}
+        expired = False
         for pod in ffd_sort(pods):
+            expired = expired or (
+                self.deadline is not None and self.now() >= self.deadline
+            )
+            if expired:
+                # deadline hit mid-queue: remaining pods fail with the
+                # timeout error, placed prefix stands (reference ctx poll)
+                unschedulable.append((pod, SOLVE_TIMEOUT_REASON))
+                continue
             if self._dra is not None:
                 err = self._dra.pod_error(pod)
                 if err is not None:

@@ -144,18 +144,23 @@ def relax_pod(pod: Pod, applied: int) -> Pod:
     return relaxed
 
 
-def run_with_relaxation(pods: list[Pod], solve_round):
+def run_with_relaxation(pods: list[Pod], solve_round, should_stop=None):
     """The outer relax-and-retry loop shared by both engines: each failing
     pod sheds one rung per round and the whole problem re-solves.
 
     solve_round(current_pods) -> SchedulingResult; it must be safe to call
-    repeatedly (fresh state per call).
+    repeatedly (fresh state per call). should_stop() is polled after each
+    round — when it reports True (the Solve deadline expired,
+    provisioner.go:415) the current result is returned without further
+    relaxation, mirroring the reference's context-cancelled Solve loop.
     """
     originals = {p.uid: p for p in pods}
     applied = {p.uid: 0 for p in pods}
     current = list(pods)
     while True:
         result = solve_round(current)
+        if should_stop is not None and should_stop():
+            return result
         relaxed_any = False
         for p, _reason in result.unschedulable:
             orig = originals.get(p.uid)

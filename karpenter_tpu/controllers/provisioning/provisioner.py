@@ -43,6 +43,7 @@ class Provisioner:
         reserved_capacity_enabled: bool = True,
         min_values_policy: str = "Strict",
         dynamic_resources_enabled: bool = False,
+        solve_timeout_seconds: float = 60.0,
     ):
         self.store = store
         self.cluster = cluster
@@ -52,6 +53,9 @@ class Provisioner:
         self.reserved_capacity_enabled = reserved_capacity_enabled
         self.min_values_policy = min_values_policy
         self.dynamic_resources_enabled = dynamic_resources_enabled
+        # Solve timeout (provisioner.go:415, options solve_timeout_seconds):
+        # a deadline on the injected clock so fake-clock tests can expire it
+        self.solve_timeout_seconds = solve_timeout_seconds
         # DeviceAllocationController; wired by the manager when DRA is on
         self.device_allocation = None
         self._scheduler_cache: Optional[tuple[tuple, TPUScheduler]] = None
@@ -206,10 +210,14 @@ class Provisioner:
                         out[rid] = out.get(rid, 0) + 1
         return out
 
-    def simulate(self, excluded_node_names: set[str], extra_pods: list[Pod]):
+    def simulate(
+        self, excluded_node_names: set[str], extra_pods: list[Pod], deadline=None
+    ):
         """Consolidation what-if (disruption helpers.go:53-154): schedule
         pending + displaced pods against the cluster minus the excluded
-        nodes. Pure simulation: no claims created, no nominations."""
+        nodes. Pure simulation: no claims created, no nominations. deadline
+        is the CALLING disruption method's (the reference inherits the
+        method context, not the 1m Solve timeout)."""
         scheduler = self._build_scheduler()
         if scheduler is None or not self.cluster.synced():
             return None
@@ -239,6 +247,8 @@ class Provisioner:
             pod_volumes=self._pod_volumes(pods, volctx),
             reserved_in_use=self._reserved_in_use(),
             dra_problem=dra_problem,
+            deadline=deadline,
+            now=self.clock.now,
         )
 
     def simulate_batch(self, scenarios: "list[list]") -> "Optional[list[tuple[bool, int]]]":
@@ -629,6 +639,8 @@ class Provisioner:
                 reserved_mode="strict",
                 reserved_in_use=self._reserved_in_use(),
                 dra_problem=self._build_dra_problem(pods),
+                deadline=self.clock.now() + self.solve_timeout_seconds,
+                now=self.clock.now,
             )
         metrics.SCHEDULING_UNSCHEDULABLE.set(float(len(result.unschedulable)))
         # solve summary, deduped like the reference's ChangeMonitor-guarded

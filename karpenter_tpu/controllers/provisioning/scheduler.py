@@ -328,6 +328,8 @@ class TPUScheduler:
         reserved_in_use: Optional[dict[str, int]] = None,
         dra_problem=None,
         pod_volumes: Optional[dict] = None,
+        deadline: Optional[float] = None,
+        now=None,
     ) -> SchedulingResult:
         """Solve with the preference relaxation ladder (preferences.go:38):
         each failing pod sheds ONE preference per round (shared loop in
@@ -340,10 +342,12 @@ class TPUScheduler:
         else a fresh build from the current pods.
         """
         import copy as _copy
+        import time as _time
 
         from karpenter_tpu.controllers.provisioning import preferences as prefs
 
         norm_vol = normalize_volume_reqs(volume_reqs)
+        now_fn = now if now is not None else _time.monotonic
 
         def host_solve(reason: str) -> SchedulingResult:
             from karpenter_tpu.utils.metrics import SOLVER_HOST_FALLBACKS
@@ -363,6 +367,8 @@ class TPUScheduler:
                 reserved_in_use=reserved_in_use,
                 dra_problem=dra_problem,
                 pod_volumes=pod_volumes,
+                deadline=deadline,
+                now=now_fn,
             )
             return host.solve(list(pods))
 
@@ -425,11 +431,16 @@ class TPUScheduler:
                     return result
                 self._n_claims_override = min(used * 2, cap)
 
+        def should_stop() -> bool:
+            # the device dispatch is atomic — the Solve deadline
+            # (provisioner.go:415) is enforced between relaxation rounds
+            return deadline is not None and now_fn() >= deadline
+
         prev_mode = self.reserved_mode
         if reserved_mode is not None:
             self.reserved_mode = reserved_mode
         try:
-            return prefs.run_with_relaxation(list(pods), solve_round)
+            return prefs.run_with_relaxation(list(pods), solve_round, should_stop)
         except DivergenceError:
             # the reference never aborts a Solve — a device/host decode
             # divergence re-solves the whole problem on the exact oracle

@@ -28,9 +28,10 @@ from karpenter_tpu.utils.options import Options
 class Operator:
     """Everything a provider binary wires together (operator.go:126).
 
-    Option consumption status: batch windows, the spot-to-spot gate and
-    the preference policy are wired; min_values_policy and the solve/poll
-    timeouts land with the in-solve minValues work (STATUS.md round-2).
+    All Options are consumed: batch windows and the disruption poll pace
+    the Manager loops, solve_timeout_seconds bounds every Solve
+    (provisioner.go:415), preference/minValues policies and the feature
+    gates select scheduler behavior.
     """
 
     store: ObjectStore
@@ -67,7 +68,7 @@ class Operator:
         from karpenter_tpu.controllers.manager import KubeSchedulerSim
 
         self.manager.run_until_idle()
-        self.manager.run_disruption_once()
+        self.manager.maybe_run_disruption()  # paced by disruption_poll_seconds
         self.manager.run_maintenance()
         KubeSchedulerSim(self.store, self.manager.cluster).bind_pending()
 

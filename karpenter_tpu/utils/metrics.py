@@ -179,6 +179,11 @@ SOLVER_HOST_FALLBACKS = REGISTRY.counter(
     "Solves routed to the host oracle instead of the device kernel",
     ("reason",),
 )
+CONSOLIDATION_TIMEOUTS = REGISTRY.counter(
+    "karpenter_consolidation_timeouts_total",
+    "Consolidation passes that hit their method deadline",
+    ("method",),
+)
 DISRUPTION_EVAL_DURATION = REGISTRY.histogram(
     "karpenter_disruption_evaluation_duration_seconds", "Disruption pass wall time", ("method",)
 )

@@ -1,0 +1,206 @@
+"""Timeout semantics, fake-clock driven.
+
+Reference degraded behaviors reproduced here:
+- provisioner.go:415 — the 1m Solve deadline fails the REMAINING queue,
+  the placed prefix stands, and no further relaxation rounds run.
+- multinodeconsolidation.go:35,142-153 — the 1m prefix search returns the
+  last VALID command instead of discarding the pass's work.
+- singlenodeconsolidation.go:33 — the 3m candidate walk stops; unreached
+  candidates wait for the next poll.
+"""
+
+from dataclasses import dataclass, field
+
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.controllers.disruption.methods import (
+    MULTI_NODE_CONSOLIDATION_TIMEOUT_SECONDS,
+    SINGLE_NODE_CONSOLIDATION_TIMEOUT_SECONDS,
+    MultiNodeConsolidation,
+    SingleNodeConsolidation,
+)
+from karpenter_tpu.controllers.provisioning import TPUScheduler, build_templates
+from karpenter_tpu.controllers.provisioning.host_scheduler import (
+    SOLVE_TIMEOUT_REASON,
+    HostScheduler,
+    SchedulingResult,
+)
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import NodeAffinity, PreferredSchedulingTerm, make_pod
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def default_pool() -> NodePool:
+    pool = NodePool()
+    pool.metadata.name = "default"
+    return pool
+
+
+# -- fake candidates (just the attribute surface methods.py touches) ---------
+
+
+@dataclass
+class _FakeStatus:
+    last_pod_event_time: float | None = None
+
+
+@dataclass
+class _FakeMeta:
+    creation_timestamp: float = 0.0
+    labels: dict = field(default_factory=lambda: {
+        l.CAPACITY_TYPE_LABEL_KEY: l.CAPACITY_TYPE_ON_DEMAND
+    })
+
+
+@dataclass
+class _FakeClaim:
+    status: _FakeStatus = field(default_factory=_FakeStatus)
+    metadata: _FakeMeta = field(default_factory=_FakeMeta)
+
+
+@dataclass
+class _FakeStateNode:
+    node_claim: _FakeClaim = field(default_factory=_FakeClaim)
+    node: object = None
+
+
+def _consolidatable_pool() -> NodePool:
+    pool = default_pool()
+    pool.spec.disruption.consolidation_policy = "WhenEmptyOrUnderutilized"
+    pool.spec.disruption.consolidate_after_seconds = 0.0
+    return pool
+
+
+@dataclass
+class _FakeCandidate:
+    name: str
+    savings_ratio: float
+    price: float = 1.0
+    owned_by_static: bool = False
+    nodepool: NodePool = field(default_factory=_consolidatable_pool)
+    state_node: _FakeStateNode = field(default_factory=_FakeStateNode)
+    reschedulable_pods: list = field(default_factory=list)
+    instance_type: object = None
+
+
+def _ok_result():
+    """A delete-consolidation verdict: everything fits without new claims."""
+    return SchedulingResult(claims=[], unschedulable=[], assignments={})
+
+
+class TestMultiNodeTimeout:
+    def test_returns_last_valid_command_on_deadline(self):
+        clock = FakeClock(start=0.0)
+        calls = []
+
+        def simulate(candidates, deadline=None):
+            calls.append(len(candidates))
+            # each what-if burns 40s of the 60s budget
+            clock.step(40.0)
+            return _ok_result(), set()
+
+        method = MultiNodeConsolidation(simulate, clock)
+        cands = [_FakeCandidate(f"n-{i}", savings_ratio=i) for i in range(4)]
+        cmd = method.compute(cands, budgets={"default": 100})
+        # binary search: mid=2 valid (t=40), mid=3 valid (t=80 > 60s
+        # deadline) -> next iteration times out and returns the LAST VALID
+        # prefix rather than an empty command
+        assert not cmd.is_empty
+        assert len(cmd.candidates) == 3
+        assert calls == [2, 3]
+        assert clock.now() < MULTI_NODE_CONSOLIDATION_TIMEOUT_SECONDS * 2
+
+    def test_full_search_without_deadline_pressure(self):
+        clock = FakeClock(start=0.0)
+
+        def simulate(candidates, deadline=None):
+            clock.step(1.0)  # fast what-ifs: the search completes
+            return _ok_result(), set()
+
+        method = MultiNodeConsolidation(simulate, clock)
+        cands = [_FakeCandidate(f"n-{i}", savings_ratio=i) for i in range(4)]
+        cmd = method.compute(cands, budgets={"default": 100})
+        assert len(cmd.candidates) == 4  # the whole batch consolidates
+
+    def test_simulate_receives_method_deadline(self):
+        clock = FakeClock(start=100.0)
+        seen = []
+
+        def simulate(candidates, deadline=None):
+            seen.append(deadline)
+            return _ok_result(), set()
+
+        method = MultiNodeConsolidation(simulate, clock)
+        cands = [_FakeCandidate(f"n-{i}", savings_ratio=i) for i in range(2)]
+        method.compute(cands, budgets={"default": 100})
+        assert seen and all(
+            d == 100.0 + MULTI_NODE_CONSOLIDATION_TIMEOUT_SECONDS for d in seen
+        )
+
+
+class TestSingleNodeTimeout:
+    def test_walk_stops_at_deadline(self):
+        clock = FakeClock(start=0.0)
+        calls = []
+
+        def simulate(candidates, deadline=None):
+            calls.append(candidates[0].name)
+            clock.step(200.0)  # each candidate overruns the 3m budget
+            # two replacement claims -> not a valid single-node command
+            from karpenter_tpu.controllers.provisioning.host_scheduler import SimClaim
+
+            claims = [
+                SimClaim(template=None, requirements=None, used={}, instance_types=[],
+                         pods=[], slot=i)
+                for i in range(2)
+            ]
+            return SchedulingResult(claims=claims, unschedulable=[], assignments={}), set()
+
+        method = SingleNodeConsolidation(simulate, clock)
+        cands = [_FakeCandidate(f"n-{i}", savings_ratio=i) for i in range(5)]
+        cmd = method.compute(cands, budgets={"default": 100})
+        assert cmd.is_empty
+        # only the first candidate was evaluated; the rest wait for the
+        # next 10s poll instead of stalling the controller for 16m
+        assert calls == ["n-0"]
+        assert clock.now() >= SINGLE_NODE_CONSOLIDATION_TIMEOUT_SECONDS
+
+
+class TestSolveTimeout:
+    def test_host_deadline_fails_remaining_queue(self):
+        templates = build_templates([(default_pool(), instance_types(16))])
+        t = {"v": 0.0}
+
+        def now() -> float:
+            t["v"] += 50.0
+            return t["v"]
+
+        host = HostScheduler(templates, deadline=120.0, now=now)
+        pods = [make_pod(f"p-{i}", cpu=0.5) for i in range(3)]
+        result = host.solve(pods)
+        # pods 1+2 placed (t=50,100 < 120); pod 3 hit the expired deadline
+        placed = sum(len(c.pods) for c in result.claims)
+        assert placed == 2
+        assert [r for _, r in result.unschedulable] == [SOLVE_TIMEOUT_REASON]
+
+    def test_tpu_deadline_stops_relaxation(self):
+        templates = build_templates([(default_pool(), instance_types(16))])
+        pod = make_pod("p", cpu=0.5)
+        pod.spec.node_affinity = NodeAffinity(
+            preferred=[
+                PreferredSchedulingTerm(
+                    10,
+                    [{"key": l.LABEL_TOPOLOGY_ZONE, "operator": "In",
+                      "values": ["zone-nowhere"]}],
+                )
+            ]
+        )
+        clock = FakeClock(start=0.0)
+        sched = TPUScheduler(templates)
+        # expired before round 2: the pod would be rescued by shedding the
+        # preference, but the deadline stops the ladder after round 1
+        result = sched.solve([pod], deadline=clock.now() - 1.0, now=clock.now)
+        assert len(result.unschedulable) == 1
+        # same problem with headroom relaxes and schedules
+        result2 = sched.solve([pod], deadline=clock.now() + 3600.0, now=clock.now)
+        assert not result2.unschedulable

@@ -146,9 +146,9 @@ class TestWhatIfBatch:
             calls["batch"] += 1
             return orig_batch(scenarios)
 
-        def counting_seq(excluded, extra):
+        def counting_seq(excluded, extra, deadline=None):
             calls["seq"] += 1
-            return orig_seq(excluded, extra)
+            return orig_seq(excluded, extra, deadline=deadline)
 
         monkeypatch.setattr(mgr.provisioner, "simulate_batch", counting_batch)
         monkeypatch.setattr(mgr.provisioner, "simulate", counting_seq)
