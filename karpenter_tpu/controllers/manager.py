@@ -56,6 +56,7 @@ class Manager:
             min_values_policy=self.options.min_values_policy,
             dynamic_resources_enabled=self.options.feature_gates.dynamic_resources,
             solve_timeout_seconds=self.options.solve_timeout_seconds,
+            solver_endpoint=self.options.solver_endpoint,
         )
         self.device_allocation = None
         if self.options.feature_gates.dynamic_resources:
@@ -261,11 +262,13 @@ class Manager:
         from karpenter_tpu.controllers.status_controllers import (
             ConsistencyController,
             NodePoolStatusController,
+            NodePoolValidationController,
         )
 
         from karpenter_tpu.controllers.status_controllers import HydrationController
 
         out = {
+            "invalid_pools": NodePoolValidationController(self.store, self.clock).reconcile(),
             "hydrated": HydrationController(self.store).reconcile(),
             "expired": self.expiration.reconcile(),
             "garbage_collected": self.garbage_collection.reconcile(),

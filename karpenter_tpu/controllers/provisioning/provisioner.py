@@ -44,6 +44,7 @@ class Provisioner:
         min_values_policy: str = "Strict",
         dynamic_resources_enabled: bool = False,
         solve_timeout_seconds: float = 60.0,
+        solver_endpoint: str = "",
     ):
         self.store = store
         self.cluster = cluster
@@ -56,6 +57,9 @@ class Provisioner:
         # Solve timeout (provisioner.go:415, options solve_timeout_seconds):
         # a deadline on the injected clock so fake-clock tests can expire it
         self.solve_timeout_seconds = solve_timeout_seconds
+        # Remote solver service address (rpc/client.RemoteScheduler);
+        # empty = in-process TPUScheduler
+        self.solver_endpoint = solver_endpoint
         # DeviceAllocationController; wired by the manager when DRA is on
         self.device_allocation = None
         self._scheduler_cache: Optional[tuple[tuple, TPUScheduler]] = None
@@ -108,7 +112,20 @@ class Provisioner:
     # -- scheduling --------------------------------------------------------------
 
     def _ready_pools(self) -> list[NodePool]:
-        return [p for p in self.store.nodepools() if not p.is_static]
+        """Non-static pools that pass runtime validation
+        (provisioner.go:268-289 lists Ready pools). The condition is
+        authoritative once the validation controller has stamped it; an
+        UNSET condition is validated inline so the first reconcile after a
+        pool appears can't race an invalid pool into a launch."""
+        from karpenter_tpu.models.nodepool import CONDITION_VALIDATION_SUCCEEDED
+        from karpenter_tpu.models.validation import validate_nodepool
+
+        def schedulable(p: NodePool) -> bool:
+            if p.conditions.has(CONDITION_VALIDATION_SUCCEEDED):
+                return not p.conditions.is_false(CONDITION_VALIDATION_SUCCEEDED)
+            return not validate_nodepool(p)
+
+        return [p for p in self.store.nodepools() if not p.is_static and schedulable(p)]
 
     def _volume_context(self) -> tuple[dict, dict]:
         """(pvcs, storage classes) by name, scanned ONCE per solve entry
@@ -169,8 +186,11 @@ class Provisioner:
             build_universe_domains,
         )
 
+        base = (
+            scheduler.universe_base() if hasattr(scheduler, "universe_base") else None
+        )
         universe = build_universe_domains(
-            scheduler.templates, self._existing_sim_nodes(excluded_nodes)
+            scheduler.templates, self._existing_sim_nodes(excluded_nodes), template_base=base
         )
         return Topology.build(pods, universe, self._bound_pods(excluded_nodes))
 
@@ -249,6 +269,11 @@ class Provisioner:
             dra_problem=dra_problem,
             deadline=deadline,
             now=self.clock.now,
+            bound_pods=(
+                self._bound_pods(excluded_node_names)
+                if getattr(scheduler, "wants_bound_pods", False)
+                else None
+            ),
         )
 
     def simulate_batch(self, scenarios: "list[list]") -> "Optional[list[tuple[bool, int]]]":
@@ -371,27 +396,96 @@ class Provisioner:
             }
         return budgets
 
-    def _daemon_overhead(self, template) -> dict[str, float]:
-        """Requests of daemonset pods that would schedule on this template's
-        nodes (scheduler.go:963-1043; approximated per-template rather than
-        per instance-type group)."""
+    def _daemon_pod_compatible(self, template, it, pod) -> bool:
+        """isDaemonPodCompatible (scheduler.go:1020-1043): template taints
+        tolerated (a PreferNoSchedule toleration is implicit — daemons
+        ignore that preference), then strict pod requirements compatible
+        with the template AND intersecting the instance type, retried with
+        required node-affinity OR terms dropped front-first (the only
+        relaxation daemon scheduling considers)."""
         from karpenter_tpu.models import labels as l
+        from karpenter_tpu.models.taints import (
+            PREFER_NO_SCHEDULE,
+            TOLERATION_OP_EXISTS,
+            Toleration,
+        )
         from karpenter_tpu.scheduling import Requirements
+        from karpenter_tpu.scheduling.requirements import node_selector_requirement
         from karpenter_tpu.scheduling.taints import tolerates_all
+
+        tols = list(pod.spec.tolerations) + [
+            Toleration(operator=TOLERATION_OP_EXISTS, effect=PREFER_NO_SCHEDULE)
+        ]
+        if tolerates_all(template.taints, tols) is not None:
+            return False
+        na = pod.spec.node_affinity
+        terms = list(na.required) if na is not None else []
+        for term_idx in range(max(1, len(terms))):
+            reqs = Requirements.from_labels(dict(pod.spec.node_selector or {}))
+            if terms:
+                reqs.add(
+                    *(
+                        node_selector_requirement(
+                            m["key"], m["operator"], m.get("values", ())
+                        )
+                        for m in terms[term_idx].match_expressions
+                    )
+                )
+            # Intersects (not Compatible) against the instance type: custom
+            # daemonset keys absent from the catalog must not disqualify it
+            if (
+                template.requirements.compatible(reqs, l.WELL_KNOWN_LABELS) is None
+                and it.requirements.intersects(reqs) is None
+            ):
+                return True
+        return False
+
+    def _apply_daemon_overhead(self, templates):
+        """buildDaemonOverheadGroups (scheduler.go:963-1043): per template,
+        group instance types by their compatible-daemonset SET and emit one
+        virtual template per group, so a nodeSelector'd daemonset never
+        overcharges instance types it would not land on. Both engines and
+        the RPC wire consume the split list unchanged — the group concept
+        never leaks past this function. Group order follows first
+        instance-type appearance (deterministic; the reference iterates an
+        unordered Go map, so any fixed order is a valid refinement).
+        Daemonset host ports are not modeled (harness daemonsets declare
+        none)."""
+        from dataclasses import replace
+
         from karpenter_tpu.utils import resources as res
 
-        total: dict[str, float] = {}
-        for ds in self.store.list(self.store.DAEMONSETS):
-            pod = ds.as_pod()
-            if tolerates_all(template.taints, pod.spec.tolerations) is not None:
-                continue
-            # strict (required-only) requirements with well-known labels
-            # allowed undefined, matching getDaemonOverhead
-            pod_reqs = Requirements.from_pod(pod, include_preferred=False)
-            if template.requirements.compatible(pod_reqs, l.WELL_KNOWN_LABELS) is not None:
-                continue
-            total = res.merge(total, pod.total_requests())
-        return total
+        daemon_pods = [ds.as_pod() for ds in self.store.list(self.store.DAEMONSETS)]
+        if not daemon_pods:
+            for t in templates:
+                t.daemon_requests = {}
+            return templates
+        out = []
+        for t in templates:
+            groups: dict[frozenset, list] = {}
+            order: list[frozenset] = []
+            for it in t.instance_types:
+                key = frozenset(
+                    i
+                    for i, p in enumerate(daemon_pods)
+                    if self._daemon_pod_compatible(t, it, p)
+                )
+                if key not in groups:
+                    groups[key] = []
+                    order.append(key)
+                groups[key].append(it)
+            for key in order:
+                overhead: dict[str, float] = {}
+                for i in sorted(key):
+                    overhead = res.merge(overhead, daemon_pods[i].total_requests())
+                if len(order) == 1:
+                    t.daemon_requests = overhead
+                    out.append(t)
+                else:
+                    out.append(
+                        replace(t, instance_types=groups[key], daemon_requests=overhead)
+                    )
+        return out
 
     def _build_scheduler(self) -> Optional[TPUScheduler]:
         pools = self._ready_pools()
@@ -401,9 +495,14 @@ class Provisioner:
         templates = build_templates(pool_catalogs)
         if not templates:
             return None
-        for t in templates:
-            t.daemon_requests = self._daemon_overhead(t)
-        # full-content signature: any template/catalog/daemonset change invalidates
+        # PRE-split full-content signature: any template/catalog/daemonset
+        # change invalidates. Computed before the daemon-overhead grouping
+        # so a cache hit skips the O(templates x types x daemonsets)
+        # compatibility matrix entirely.
+        from karpenter_tpu.controllers.provisioning.host_scheduler import (
+            pod_content_sig,
+        )
+
         sig = tuple(
             sorted(
                 (
@@ -413,19 +512,40 @@ class Provisioner:
                     tuple(sorted(t.labels.items())),
                     tuple((x.key, x.value, x.effect) for x in t.taints),
                     tuple(it.name for it in t.instance_types),
-                    tuple(sorted(t.daemon_requests.items())),
                 )
                 for t in templates
+            )
+        ) + tuple(
+            sorted(
+                (ds.name, pod_content_sig(ds.as_pod()))
+                for ds in self.store.list(self.store.DAEMONSETS)
             )
         )
         if self._scheduler_cache is not None and self._scheduler_cache[0] == sig:
             return self._scheduler_cache[1]
-        sched = TPUScheduler(
-            templates,
-            reserved_capacity_enabled=self.reserved_capacity_enabled,
-            min_values_policy=self.min_values_policy,
-        )
+        templates = self._apply_daemon_overhead(templates)
+        if self.solver_endpoint:
+            from karpenter_tpu.rpc.client import RemoteScheduler
+
+            sched = RemoteScheduler(
+                self.solver_endpoint,
+                templates,
+                reserved_capacity_enabled=self.reserved_capacity_enabled,
+                min_values_policy=self.min_values_policy,
+            )
+        else:
+            sched = TPUScheduler(
+                templates,
+                reserved_capacity_enabled=self.reserved_capacity_enabled,
+                min_values_policy=self.min_values_policy,
+            )
+        # close the REPLACED RemoteScheduler's channel only after the new
+        # scheduler is successfully built — a failed rebuild must not leave
+        # a closed channel live in the cache
+        old = self._scheduler_cache[1] if self._scheduler_cache is not None else None
         self._scheduler_cache = (sig, sched)
+        if old is not None and hasattr(old, "close"):
+            old.close()
         return sched
 
     # -- claim creation (provisioner.go:169-221, :460-506) -----------------------
@@ -641,6 +761,11 @@ class Provisioner:
                 dra_problem=self._build_dra_problem(pods),
                 deadline=self.clock.now() + self.solve_timeout_seconds,
                 now=self.clock.now,
+                bound_pods=(
+                    self._bound_pods()
+                    if getattr(scheduler, "wants_bound_pods", False)
+                    else None
+                ),
             )
         metrics.SCHEDULING_UNSCHEDULABLE.set(float(len(result.unschedulable)))
         # solve summary, deduped like the reference's ChangeMonitor-guarded

@@ -139,6 +139,9 @@ class TPUScheduler:
         reserved_capacity_enabled: bool = True,
         min_values_policy: str = "Strict",
     ):
+        from karpenter_tpu.utils.accel import enable_persistent_compile_cache
+
+        enable_persistent_compile_cache()  # restarts skip the cold compile
         self.reserved_mode = reserved_mode
         self.reserved_capacity_enabled = reserved_capacity_enabled
         self.min_values_policy = min_values_policy
@@ -169,6 +172,17 @@ class TPUScheduler:
         self._vocab_sig: Optional[tuple] = None
 
     # -- encoding ----------------------------------------------------------
+
+    def universe_base(self) -> dict:
+        """Cached template/catalog half of the topology domain universe
+        (immutable per scheduler; the O(T x K) catalog scan runs once)."""
+        if not hasattr(self, "_universe_base"):
+            from karpenter_tpu.controllers.provisioning.topology import (
+                template_universe_domains,
+            )
+
+            self._universe_base = template_universe_domains(self.templates)
+        return self._universe_base
 
     def _sig(self) -> tuple:
         v = self.encoder.vocab
@@ -330,6 +344,8 @@ class TPUScheduler:
         pod_volumes: Optional[dict] = None,
         deadline: Optional[float] = None,
         now=None,
+        bound_pods=None,  # data form of topology seeding; the in-process
+        # engine uses topology_factory (the RPC client ships bound_pods)
     ) -> SchedulingResult:
         """Solve with the preference relaxation ladder (preferences.go:38):
         each failing pod sheds ONE preference per round (shared loop in
@@ -671,7 +687,9 @@ class TPUScheduler:
         self.existing_nodes = existing_nodes or []
         self.budgets = {k: dict(v) for k, v in (budgets or {}).items()}
         if topology is None:
-            universe = build_universe_domains(self.templates, self.existing_nodes)
+            universe = build_universe_domains(
+                self.templates, self.existing_nodes, template_base=self.universe_base()
+            )
             topology = Topology.build(list(pods), universe)
         self.topology = topology
         for node in self.existing_nodes:
@@ -701,9 +719,7 @@ class TPUScheduler:
         pods_list = list(pods)
         P = len(pods_list)
         n_claims = self._n_claims_override or self.max_claims or _next_pow2(max(P, 1))
-        from karpenter_tpu.controllers.provisioning.host_scheduler import (
-            pod_content_sig,
-        )
+        from karpenter_tpu.controllers.provisioning.host_scheduler import pod_ffd_key
 
         sig = np.empty(max(P, 1), dtype=np.int64)
         sizes = np.empty(max(P, 1), dtype=np.float64)
@@ -718,9 +734,7 @@ class TPUScheduler:
                 sizes[i] = req.get(res.CPU, 0.0) + req.get(res.MEMORY, 0.0) / (4.0 * 2**30)
         else:
             for i, p in enumerate(pods_list):
-                sig[i] = pod_content_sig(p)
-                req = p.spec.requests
-                sizes[i] = req.get(res.CPU, 0.0) + req.get(res.MEMORY, 0.0) / (4.0 * 2**30)
+                sig[i], sizes[i] = pod_ffd_key(p)
         if P:
             # first-appearance rank in ORIGINAL order = ffd_sort's tie key
             _, first0, inv0 = np.unique(sig[:P], return_index=True, return_inverse=True)

@@ -1,0 +1,12 @@
+"""gRPC control/solver split (SURVEY.md §2.9).
+
+- solver.proto / solver_pb2.py — the wire contract (typed Solve hot path,
+  JSON-config Configure cold path)
+- service.py — the solver-side server hosting a TPUScheduler
+- client.py  — RemoteScheduler, the Provisioner-facing drop-in
+- codec.py   — canonical template/catalog JSON for Configure
+"""
+
+from karpenter_tpu.rpc import solver_pb2  # noqa: F401
+from karpenter_tpu.rpc.client import RemoteScheduler  # noqa: F401
+from karpenter_tpu.rpc.service import SolverService, serve  # noqa: F401

@@ -1,0 +1,238 @@
+"""RemoteScheduler: the control plane's view of the solver service.
+
+Drop-in for TPUScheduler at the Provisioner seam — same solve() surface,
+same SchedulingResult out — but the work happens across the wire
+(solver.proto). This is the reference's decorator pattern
+(pkg/cloudprovider/metrics/cloudprovider.go) applied to the scheduler
+boundary: the Provisioner neither knows nor cares whether the solver is
+in-process or remote.
+
+Split of labor:
+- Remote: the full relaxation ladder, NO_ROOM recovery, device dispatch,
+  host-oracle fallbacks for volume alternatives / CSI limits — everything
+  TPUScheduler.solve does, running next to the TPU.
+- Local: DRA solves (the allocator holds live object-store references —
+  see solver.proto header) run on a local HostScheduler, mirroring the
+  device engine's own DRA routing.
+- whatif_batch returns None: disruption methods fall back to sequential
+  simulates, which DO ride the remote solver.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+import grpc
+
+from karpenter_tpu.controllers.provisioning.host_scheduler import (
+    HostScheduler,
+    SchedulingResult,
+    normalize_volume_reqs,
+)
+from karpenter_tpu.controllers.provisioning.nodeclaimtemplate import ClaimTemplate
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.rpc import solver_pb2 as pb
+from karpenter_tpu.rpc import convert
+from karpenter_tpu.rpc.codec import encode_templates
+from karpenter_tpu.rpc.service import SERVICE_NAME
+
+_RPC_OPTIONS = [
+    ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+    ("grpc.max_send_message_length", 256 * 1024 * 1024),
+]
+
+# Every call carries a gRPC deadline — an unreachable or hung solver must
+# never block the control plane indefinitely (the whole point of the Solve
+# timeout work). Solve gets the request's own budget plus slack for the
+# server's cold XLA compile (~20-70s per shape class, bench cold_s).
+CONFIGURE_TIMEOUT_SECONDS = 120.0
+HEALTH_TIMEOUT_SECONDS = 10.0
+SOLVE_COMPILE_SLACK_SECONDS = 600.0
+DEFAULT_SOLVE_BUDGET_SECONDS = 600.0
+
+
+class RemoteScheduler:
+    """One instance per template/catalog set, like TPUScheduler; Configure
+    happens eagerly at construction so the first Solve pays no config RTT."""
+
+    # the Provisioner materializes bound_pods (topology count seeding) only
+    # for schedulers that ship it across a wire — the in-process engine
+    # reads the cluster through its topology_factory instead
+    wants_bound_pods = True
+
+    def __init__(
+        self,
+        endpoint: str,
+        templates: list[ClaimTemplate],
+        max_claims: Optional[int] = None,
+        pod_pad: Optional[int] = None,
+        reserved_mode: str = "fallback",
+        reserved_capacity_enabled: bool = True,
+        min_values_policy: str = "Strict",
+        channel: Optional[grpc.Channel] = None,
+    ):
+        self.templates = templates
+        self.reserved_mode = reserved_mode
+        self.reserved_capacity_enabled = reserved_capacity_enabled
+        self.min_values_policy = min_values_policy
+        self._catalog = {}
+        for t in templates:
+            for it in t.instance_types:
+                self._catalog.setdefault(it.name, it)
+        self._channel = channel or grpc.insecure_channel(endpoint, options=_RPC_OPTIONS)
+        self._configure = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/Configure",
+            request_serializer=pb.ConfigureRequest.SerializeToString,
+            response_deserializer=pb.ConfigureResponse.FromString,
+        )
+        self._solve = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/Solve",
+            request_serializer=pb.SolveRequest.SerializeToString,
+            response_deserializer=pb.SolveResponse.FromString,
+        )
+        self._health = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/Health",
+            request_serializer=pb.HealthRequest.SerializeToString,
+            response_deserializer=pb.HealthResponse.FromString,
+        )
+        req = pb.ConfigureRequest(
+            templates_json=encode_templates(templates),
+            reserved_mode=reserved_mode,
+            reserved_capacity_enabled=reserved_capacity_enabled,
+            min_values_policy=min_values_policy,
+        )
+        if max_claims is not None:
+            req.max_claims = max_claims
+        if pod_pad is not None:
+            req.pod_pad = pod_pad
+        self._configure_request = req
+        self._reconfigure()
+        self.last_timings: dict = {}
+
+    def _reconfigure(self) -> None:
+        self._config_version = self._configure(
+            self._configure_request, timeout=CONFIGURE_TIMEOUT_SECONDS
+        ).config_version
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def health(self) -> pb.HealthResponse:
+        return self._health(pb.HealthRequest(), timeout=HEALTH_TIMEOUT_SECONDS)
+
+    # -- the TPUScheduler surface -----------------------------------------
+
+    def solve(
+        self,
+        pods: Sequence[Pod],
+        existing_nodes=None,
+        budgets=None,
+        topology=None,
+        topology_factory=None,
+        volume_reqs=None,
+        reserved_mode=None,
+        reserved_in_use=None,
+        dra_problem=None,
+        pod_volumes=None,
+        deadline=None,
+        now=None,
+        bound_pods=None,
+    ) -> SchedulingResult:
+        if dra_problem is not None and any(p.spec.resource_claims for p in pods):
+            # DRA never crosses the wire (allocator holds store refs);
+            # mirror the device engine's host routing, locally.
+            from karpenter_tpu.utils.metrics import SOLVER_HOST_FALLBACKS
+
+            SOLVER_HOST_FALLBACKS.inc(reason="dra")
+            host = HostScheduler(
+                self.templates,
+                existing_nodes=[n.clone() for n in (existing_nodes or [])],
+                budgets=budgets,
+                topology=(
+                    topology_factory(list(pods))
+                    if topology_factory is not None
+                    else topology
+                ),
+                volume_reqs=normalize_volume_reqs(volume_reqs),
+                reserved_mode=reserved_mode if reserved_mode is not None else self.reserved_mode,
+                reserved_capacity_enabled=self.reserved_capacity_enabled,
+                min_values_policy=self.min_values_policy,
+                reserved_in_use=reserved_in_use,
+                dra_problem=dra_problem,
+                pod_volumes=pod_volumes,
+                deadline=deadline,
+                now=now,
+            )
+            return host.solve(list(pods))
+
+        t0 = time.perf_counter()
+        req = pb.SolveRequest(config_version=self._config_version)
+        pods = list(pods)
+        for p in pods:
+            req.pods.append(convert.pod_to_pb(p))
+        for n in existing_nodes or []:
+            req.existing_nodes.append(convert.existing_to_pb(n))
+        for pool, res_map in (budgets or {}).items():
+            req.budgets[pool].resources.update(res_map)
+        for bp, labels in bound_pods or []:
+            b = req.bound_pods.add()
+            b.pod.CopyFrom(convert.pod_to_pb(bp))
+            b.node_labels.update(labels)
+        for uid, alts in normalize_volume_reqs(volume_reqs).items():
+            va = req.volume_reqs.add()
+            va.pod_uid = uid
+            for alt in alts:
+                rs = va.alternatives.add()
+                rs.requirements.extend(convert.reqs_to_pb(alt))
+        for uid, vols in (pod_volumes or {}).items():
+            req.pod_volumes.append(convert.volumes_to_pb(uid, vols))
+        if reserved_mode is not None:
+            req.reserved_mode = reserved_mode
+        for rid, n in (reserved_in_use or {}).items():
+            req.reserved_in_use[rid] = n
+        if deadline is not None:
+            # wall deadlines don't cross machines: ship the REMAINING
+            # budget; the server re-anchors it on its own monotonic clock
+            now_fn = now if now is not None else time.monotonic
+            req.timeout_seconds = max(deadline - now_fn(), 0.0)
+        rpc_timeout = (
+            req.timeout_seconds if deadline is not None else DEFAULT_SOLVE_BUDGET_SECONDS
+        ) + SOLVE_COMPILE_SLACK_SECONDS
+        t_encode = time.perf_counter()
+        try:
+            resp = self._solve(req, timeout=rpc_timeout)
+        except grpc.RpcError as err:
+            if err.code() != grpc.StatusCode.FAILED_PRECONDITION:
+                raise
+            # the solver restarted (or another Configure superseded ours):
+            # re-Configure against the live server and retry once, with the
+            # caller's REMAINING budget (the first attempt + Configure may
+            # have consumed most of it)
+            self._reconfigure()
+            req.config_version = self._config_version
+            if deadline is not None:
+                remaining = max(deadline - now_fn(), 0.0)
+                req.timeout_seconds = remaining
+                rpc_timeout = remaining + SOLVE_COMPILE_SLACK_SECONDS
+            resp = self._solve(req, timeout=rpc_timeout)
+        t_rpc = time.perf_counter()
+        result = convert.result_from_pb(
+            resp,
+            self.templates,
+            self._catalog,
+            {p.uid: p for p in pods},
+            existing_nodes,
+        )
+        t_end = time.perf_counter()
+        self.last_timings = {
+            "encode_s": t_encode - t0,
+            "device_s": t_rpc - t_encode,  # wire + remote solve
+            "decode_s": t_end - t_rpc,
+        }
+        return result
+
+    def whatif_batch(self, *args, **kwargs):
+        """Not offered remotely (v1): callers fall back to sequential
+        simulates, which ride the remote Solve path."""
+        return None

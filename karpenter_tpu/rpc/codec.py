@@ -1,0 +1,214 @@
+"""Canonical JSON codec for the RPC cold config path.
+
+Serializes the template/catalog set (list[ClaimTemplate], including every
+InstanceType with offerings, overrides and overheads) for the Configure
+RPC. The solve hot path is typed protobuf (solver.proto); this is the
+rarely-crossed config plane, so a readable canonical JSON keyed by the
+dataclass fields is the right altitude.
+
+The codec is lossless for everything scheduling consumes. DRA device
+templates (InstanceType.dra_slices / dra_attribute_bindings) are NOT
+serialized — DRA solves never cross the wire (see solver.proto header).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Optional
+
+from karpenter_tpu.cloudprovider.instancetype import (
+    InstanceType,
+    InstanceTypeOverhead,
+    Offering,
+)
+from karpenter_tpu.controllers.provisioning.nodeclaimtemplate import ClaimTemplate
+from karpenter_tpu.models.taints import Taint
+from karpenter_tpu.scheduling import Requirement, Requirements
+
+# -- requirements (internal compressed form, lossless) -----------------------
+
+
+def requirement_to_dict(r: Requirement) -> dict:
+    out: dict = {"key": r.key}
+    if r.complement:
+        out["complement"] = True
+    if r.values:
+        out["values"] = sorted(r.values)
+    if r.gte is not None:
+        out["gte"] = r.gte
+    if r.lte is not None:
+        out["lte"] = r.lte
+    if r.min_values is not None:
+        out["minValues"] = r.min_values
+    return out
+
+
+def requirement_from_dict(d: dict) -> Requirement:
+    return Requirement(
+        key=d["key"],
+        complement=bool(d.get("complement", False)),
+        values=frozenset(d.get("values", ())),
+        gte=d.get("gte"),
+        lte=d.get("lte"),
+        min_values=d.get("minValues"),
+    )
+
+
+def requirements_to_list(reqs: Requirements) -> list[dict]:
+    return [requirement_to_dict(r) for r in sorted(reqs.values(), key=lambda r: r.key)]
+
+
+def requirements_from_list(items: list[dict]) -> Requirements:
+    return Requirements(*(requirement_from_dict(d) for d in items))
+
+
+# -- catalog -----------------------------------------------------------------
+
+
+def _num(v: float):
+    """inf-safe float for JSON (offering prices can be inf in tests)."""
+    if v == math.inf:
+        return "inf"
+    if v == -math.inf:
+        return "-inf"
+    return v
+
+
+def _denum(v) -> float:
+    if v == "inf":
+        return math.inf
+    if v == "-inf":
+        return -math.inf
+    return float(v)
+
+
+def _overhead_to_dict(o: InstanceTypeOverhead) -> dict:
+    return {
+        "kubeReserved": o.kube_reserved,
+        "systemReserved": o.system_reserved,
+        "evictionThreshold": o.eviction_threshold,
+    }
+
+
+def _overhead_from_dict(d: dict) -> InstanceTypeOverhead:
+    return InstanceTypeOverhead(
+        kube_reserved=dict(d.get("kubeReserved", {})),
+        system_reserved=dict(d.get("systemReserved", {})),
+        eviction_threshold=dict(d.get("evictionThreshold", {})),
+    )
+
+
+def offering_to_dict(o: Offering) -> dict:
+    out: dict = {
+        "requirements": requirements_to_list(o.requirements),
+        "price": _num(o.price),
+        "available": o.available,
+    }
+    if o.reservation_capacity:
+        out["reservationCapacity"] = o.reservation_capacity
+    if o.capacity_override:
+        out["capacityOverride"] = o.capacity_override
+    if o.overhead_override is not None:
+        out["overheadOverride"] = _overhead_to_dict(o.overhead_override)
+    return out
+
+
+def offering_from_dict(d: dict) -> Offering:
+    return Offering(
+        requirements=requirements_from_list(d["requirements"]),
+        price=_denum(d["price"]),
+        available=bool(d.get("available", True)),
+        reservation_capacity=int(d.get("reservationCapacity", 0)),
+        capacity_override=dict(d.get("capacityOverride", {})),
+        overhead_override=(
+            _overhead_from_dict(d["overheadOverride"])
+            if "overheadOverride" in d
+            else None
+        ),
+    )
+
+
+def instance_type_to_dict(it: InstanceType) -> dict:
+    return {
+        "name": it.name,
+        "requirements": requirements_to_list(it.requirements),
+        "offerings": [offering_to_dict(o) for o in it.offerings],
+        "capacity": it.capacity,
+        "overhead": _overhead_to_dict(it.overhead),
+    }
+
+
+def instance_type_from_dict(d: dict) -> InstanceType:
+    return InstanceType(
+        name=d["name"],
+        requirements=requirements_from_list(d["requirements"]),
+        offerings=[offering_from_dict(o) for o in d["offerings"]],
+        capacity=dict(d["capacity"]),
+        overhead=_overhead_from_dict(d["overhead"]),
+    )
+
+
+def _taint_to_dict(t: Taint) -> dict:
+    return {"key": t.key, "value": t.value, "effect": t.effect}
+
+
+def _taint_from_dict(d: dict) -> Taint:
+    return Taint(key=d["key"], value=d.get("value", ""), effect=d["effect"])
+
+
+def template_to_dict(t: ClaimTemplate, it_index: dict[str, int]) -> dict:
+    return {
+        "nodepoolName": t.nodepool_name,
+        "weight": t.weight,
+        "requirements": requirements_to_list(t.requirements),
+        "instanceTypes": [it_index[it.name] for it in t.instance_types],
+        "taints": [_taint_to_dict(x) for x in t.taints],
+        "startupTaints": [_taint_to_dict(x) for x in t.startup_taints],
+        "labels": t.labels,
+        "daemonRequests": t.daemon_requests,
+        "isStatic": t.is_static,
+        "expireAfterSeconds": t.expire_after_seconds,
+        "terminationGracePeriodSeconds": t.termination_grace_period_seconds,
+        "nodepoolHash": t.nodepool_hash,
+    }
+
+
+def encode_templates(templates: list[ClaimTemplate]) -> bytes:
+    """list[ClaimTemplate] -> canonical JSON. The instance-type catalog is
+    deduped by name (templates share catalog objects; identity matters for
+    the scheduler's union-catalog memoization)."""
+    catalog: dict[str, InstanceType] = {}
+    for t in templates:
+        for it in t.instance_types:
+            catalog.setdefault(it.name, it)
+    it_index = {name: i for i, name in enumerate(catalog)}
+    doc = {
+        "catalog": [instance_type_to_dict(it) for it in catalog.values()],
+        "templates": [template_to_dict(t, it_index) for t in templates],
+    }
+    return json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
+
+
+def decode_templates(data: bytes) -> list[ClaimTemplate]:
+    doc = json.loads(data.decode())
+    catalog = [instance_type_from_dict(d) for d in doc["catalog"]]
+    out = []
+    for td in doc["templates"]:
+        out.append(
+            ClaimTemplate(
+                nodepool_name=td["nodepoolName"],
+                weight=td["weight"],
+                requirements=requirements_from_list(td["requirements"]),
+                instance_types=[catalog[i] for i in td["instanceTypes"]],
+                taints=[_taint_from_dict(x) for x in td["taints"]],
+                startup_taints=[_taint_from_dict(x) for x in td["startupTaints"]],
+                labels=dict(td["labels"]),
+                daemon_requests=dict(td["daemonRequests"]),
+                is_static=bool(td["isStatic"]),
+                expire_after_seconds=td["expireAfterSeconds"],
+                termination_grace_period_seconds=td["terminationGracePeriodSeconds"],
+                nodepool_hash=td["nodepoolHash"],
+            )
+        )
+    return out

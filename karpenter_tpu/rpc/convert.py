@@ -1,0 +1,357 @@
+"""Typed proto <-> model conversion for the solve hot path.
+
+Faithfulness matters more than brevity here: pod kind-dedup
+(host_scheduler.pod_content_sig) hashes spec content, so every field the
+signature covers must round-trip exactly — a lossy convert would split or
+merge pod kinds across the wire and change packing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from karpenter_tpu.controllers.provisioning.host_scheduler import (
+    ExistingSimNode,
+    SchedulingResult,
+    SimClaim,
+)
+from karpenter_tpu.models.objects import ObjectMeta
+from karpenter_tpu.models.pod import (
+    HostPort,
+    NodeAffinity,
+    NodeSelectorTerm,
+    Pod,
+    PodAffinityTerm,
+    PodSpec,
+    PreferredSchedulingTerm,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.models.taints import Taint, Toleration
+from karpenter_tpu.rpc import solver_pb2 as pb
+from karpenter_tpu.rpc.codec import (
+    requirement_from_dict,
+    requirement_to_dict,
+)
+from karpenter_tpu.scheduling import Requirement, Requirements
+from karpenter_tpu.scheduling.volumes import VolumeUsage
+
+# -- requirements ------------------------------------------------------------
+
+
+def req_to_pb(r: Requirement) -> pb.Requirement:
+    d = requirement_to_dict(r)
+    out = pb.Requirement(key=d["key"], complement=d.get("complement", False))
+    out.values.extend(d.get("values", ()))
+    if "gte" in d:
+        out.gte = d["gte"]
+    if "lte" in d:
+        out.lte = d["lte"]
+    if "minValues" in d:
+        out.min_values = d["minValues"]
+    return out
+
+
+def req_from_pb(m: pb.Requirement) -> Requirement:
+    d: dict = {"key": m.key, "complement": m.complement, "values": list(m.values)}
+    if m.HasField("gte"):
+        d["gte"] = m.gte
+    if m.HasField("lte"):
+        d["lte"] = m.lte
+    if m.HasField("min_values"):
+        d["minValues"] = m.min_values
+    return requirement_from_dict(d)
+
+
+def reqs_to_pb(reqs: Requirements) -> list[pb.Requirement]:
+    return [req_to_pb(r) for r in sorted(reqs.values(), key=lambda r: r.key)]
+
+
+def reqs_from_pb(items) -> Requirements:
+    return Requirements(*(req_from_pb(m) for m in items))
+
+
+# -- pods --------------------------------------------------------------------
+
+
+def _terms_to_pb(terms: list[PodAffinityTerm], out) -> None:
+    for t in terms:
+        m = out.add()
+        m.topology_key = t.topology_key
+        m.label_selector.update(t.label_selector)
+        m.namespaces.extend(t.namespaces)
+
+
+def _terms_from_pb(items) -> list[PodAffinityTerm]:
+    return [
+        PodAffinityTerm(
+            topology_key=m.topology_key,
+            label_selector=dict(m.label_selector),
+            namespaces=list(m.namespaces),
+        )
+        for m in items
+    ]
+
+
+def pod_to_pb(pod: Pod) -> pb.Pod:
+    m = pb.Pod(
+        name=pod.metadata.name,
+        namespace=pod.metadata.namespace,
+        uid=pod.metadata.uid,
+        creation_timestamp=pod.metadata.creation_timestamp,
+        priority=pod.spec.priority,
+        node_name=pod.spec.node_name,
+        termination_grace_period_seconds=pod.spec.termination_grace_period_seconds,
+    )
+    m.labels.update(pod.metadata.labels)
+    m.annotations.update(pod.metadata.annotations)
+    m.requests.update(pod.spec.requests)
+    m.limits.update(pod.spec.limits)
+    m.node_selector.update(pod.spec.node_selector)
+    m.resource_claims.extend(pod.spec.resource_claims)
+    na = pod.spec.node_affinity
+    if na is not None:
+        for term in na.required:
+            t = m.node_affinity.required.add()
+            for e in term.match_expressions:
+                x = t.match_expressions.add()
+                x.key, x.operator = e["key"], e["operator"]
+                x.values.extend(e.get("values", ()))
+        for pref in na.preferred:
+            t = m.node_affinity.preferred.add()
+            t.weight = pref.weight
+            for e in pref.match_expressions:
+                x = t.match_expressions.add()
+                x.key, x.operator = e["key"], e["operator"]
+                x.values.extend(e.get("values", ()))
+    _terms_to_pb(pod.spec.pod_affinity, m.pod_affinity)
+    _terms_to_pb(pod.spec.pod_anti_affinity, m.pod_anti_affinity)
+    _terms_to_pb(pod.spec.preferred_pod_affinity, m.preferred_pod_affinity)
+    _terms_to_pb(pod.spec.preferred_pod_anti_affinity, m.preferred_pod_anti_affinity)
+    for tol in pod.spec.tolerations:
+        t = m.tolerations.add()
+        t.key, t.operator, t.value, t.effect = tol.key, tol.operator, tol.value, tol.effect
+        if tol.toleration_seconds is not None:
+            t.toleration_seconds = tol.toleration_seconds
+    for tsc in pod.spec.topology_spread_constraints:
+        t = m.topology_spread_constraints.add()
+        t.max_skew = tsc.max_skew
+        t.topology_key = tsc.topology_key
+        t.when_unsatisfiable = tsc.when_unsatisfiable
+        t.label_selector.update(tsc.label_selector)
+        if tsc.min_domains is not None:
+            t.min_domains = tsc.min_domains
+        t.node_affinity_policy = tsc.node_affinity_policy
+        t.node_taints_policy = tsc.node_taints_policy
+    for hp in pod.spec.host_ports:
+        h = m.host_ports.add()
+        h.port, h.protocol, h.host_ip = hp.port, hp.protocol, hp.host_ip
+    m.pvc_names.extend(pod.spec.pvc_names)
+    return m
+
+
+def _expr_from_pb(x) -> dict:
+    d = {"key": x.key, "operator": x.operator}
+    if x.values:
+        d["values"] = list(x.values)
+    return d
+
+
+def pod_from_pb(m: pb.Pod) -> Pod:
+    spec = PodSpec(
+        requests=dict(m.requests),
+        limits=dict(m.limits),
+        node_name=m.node_name,
+        resource_claims=list(m.resource_claims),
+        termination_grace_period_seconds=m.termination_grace_period_seconds,
+        node_selector=dict(m.node_selector),
+        pod_affinity=_terms_from_pb(m.pod_affinity),
+        pod_anti_affinity=_terms_from_pb(m.pod_anti_affinity),
+        preferred_pod_affinity=_terms_from_pb(m.preferred_pod_affinity),
+        preferred_pod_anti_affinity=_terms_from_pb(m.preferred_pod_anti_affinity),
+        tolerations=[
+            Toleration(
+                key=t.key,
+                operator=t.operator,
+                value=t.value,
+                effect=t.effect,
+                toleration_seconds=(
+                    t.toleration_seconds if t.HasField("toleration_seconds") else None
+                ),
+            )
+            for t in m.tolerations
+        ],
+        topology_spread_constraints=[
+            TopologySpreadConstraint(
+                max_skew=t.max_skew,
+                topology_key=t.topology_key,
+                when_unsatisfiable=t.when_unsatisfiable,
+                label_selector=dict(t.label_selector),
+                min_domains=t.min_domains if t.HasField("min_domains") else None,
+                node_affinity_policy=t.node_affinity_policy,
+                node_taints_policy=t.node_taints_policy,
+            )
+            for t in m.topology_spread_constraints
+        ],
+        host_ports=[
+            HostPort(port=h.port, protocol=h.protocol, host_ip=h.host_ip)
+            for h in m.host_ports
+        ],
+        priority=m.priority,
+        pvc_names=list(m.pvc_names),
+    )
+    if m.HasField("node_affinity"):
+        spec.node_affinity = NodeAffinity(
+            required=[
+                NodeSelectorTerm(
+                    match_expressions=[_expr_from_pb(x) for x in t.match_expressions]
+                )
+                for t in m.node_affinity.required
+            ],
+            preferred=[
+                PreferredSchedulingTerm(
+                    weight=t.weight,
+                    match_expressions=[_expr_from_pb(x) for x in t.match_expressions],
+                )
+                for t in m.node_affinity.preferred
+            ],
+        )
+    pod = Pod(
+        metadata=ObjectMeta(
+            name=m.name,
+            namespace=m.namespace,
+            uid=m.uid,
+            labels=dict(m.labels),
+            annotations=dict(m.annotations),
+            creation_timestamp=m.creation_timestamp,
+        ),
+        spec=spec,
+    )
+    pod.status.conditions["PodScheduled"] = "Unschedulable"
+    return pod
+
+
+# -- volumes / existing nodes ------------------------------------------------
+
+
+def volumes_to_pb(pod_uid: str, vols: dict) -> pb.PodVolumes:
+    m = pb.PodVolumes(pod_uid=pod_uid)
+    for driver in sorted(vols):
+        d = m.volumes.add()
+        d.driver = driver
+        d.pvc_ids.extend(sorted(vols[driver]))
+    return m
+
+
+def volumes_from_pb(m: pb.PodVolumes) -> dict:
+    return {d.driver: set(d.pvc_ids) for d in m.volumes}
+
+
+def existing_to_pb(n: ExistingSimNode) -> pb.ExistingNode:
+    m = pb.ExistingNode(name=n.name)
+    m.requirements.extend(reqs_to_pb(n.requirements))
+    m.available.update(n.available)
+    for t in n.taints:
+        x = m.taints.add()
+        x.key, x.value, x.effect = t.key, t.value, t.effect
+    if n.volume_usage is not None:
+        m.volume_usage.limits.update(n.volume_usage.limits)
+        for uid in sorted(n.volume_usage.pod_volumes):
+            m.volume_usage.pod_volumes.append(
+                volumes_to_pb(uid, n.volume_usage.pod_volumes[uid])
+            )
+    return m
+
+
+def existing_from_pb(m: pb.ExistingNode, index: int) -> ExistingSimNode:
+    usage = None
+    if m.HasField("volume_usage"):
+        usage = VolumeUsage()
+        for driver, count in m.volume_usage.limits.items():
+            usage.add_limit(driver, count)
+        for pv in m.volume_usage.pod_volumes:
+            usage.add(pv.pod_uid, volumes_from_pb(pv))
+    return ExistingSimNode(
+        name=m.name,
+        index=index,
+        requirements=reqs_from_pb(m.requirements),
+        available=dict(m.available),
+        taints=[Taint(key=t.key, value=t.value, effect=t.effect) for t in m.taints],
+        volume_usage=usage,
+    )
+
+
+# -- result ------------------------------------------------------------------
+
+
+def result_to_pb(result: SchedulingResult, templates: list) -> pb.SolveResponse:
+    tmpl_idx = {id(t): i for i, t in enumerate(templates)}
+    resp = pb.SolveResponse()
+    for c in result.claims:
+        m = resp.claims.add()
+        m.template_index = tmpl_idx[id(c.template)]
+        m.requirements.extend(reqs_to_pb(c.requirements))
+        m.used.update(c.used)
+        m.instance_type_names.extend(it.name for it in c.instance_types)
+        m.pod_uids.extend(p.uid for p in c.pods)
+        m.slot = c.slot
+        m.hostname = c.hostname
+        for ip, port, proto in c.host_ports:
+            h = m.host_ports.add()
+            h.host_ip, h.port, h.protocol = ip, port, proto
+        m.reserved_ids.extend(sorted(c.reserved_ids))
+        m.min_values_relaxed = c.min_values_relaxed
+    for pod, reason in result.unschedulable:
+        u = resp.unschedulable.add()
+        u.pod_uid, u.reason = pod.uid, reason
+    for uid, node_name in result.existing_assignments.items():
+        a = resp.existing_assignments.add()
+        a.pod_uid, a.node_name = uid, node_name
+    resp.assignments.update(result.assignments)
+    return resp
+
+
+def result_from_pb(
+    resp: pb.SolveResponse,
+    templates: list,
+    catalog: dict[str, object],
+    pods_by_uid: dict[str, Pod],
+    existing_nodes: Optional[list[ExistingSimNode]] = None,
+) -> SchedulingResult:
+    """Rebuild a SchedulingResult against the CLIENT's template/catalog
+    objects (identity matters downstream: create_node_claims reads
+    template fields, cheapest_launch walks instance types)."""
+    claims = []
+    for m in resp.claims:
+        claims.append(
+            SimClaim(
+                template=templates[m.template_index],
+                requirements=reqs_from_pb(m.requirements),
+                used=dict(m.used),
+                instance_types=[catalog[n] for n in m.instance_type_names],
+                pods=[pods_by_uid[u] for u in m.pod_uids],
+                slot=m.slot,
+                hostname=m.hostname,
+                host_ports=[(h.host_ip, h.port, h.protocol) for h in m.host_ports],
+                reserved_ids=frozenset(m.reserved_ids),
+                min_values_relaxed=m.min_values_relaxed,
+            )
+        )
+    existing = [n.clone() for n in (existing_nodes or [])]
+    by_name = {n.name: n for n in existing}
+    existing_assignments = {}
+    for a in resp.existing_assignments:
+        existing_assignments[a.pod_uid] = a.node_name
+        node = by_name.get(a.node_name)
+        if node is not None and a.pod_uid in pods_by_uid:
+            node.pods.append(pods_by_uid[a.pod_uid])
+    return SchedulingResult(
+        claims=claims,
+        unschedulable=[
+            (pods_by_uid[u.pod_uid], u.reason)
+            for u in resp.unschedulable
+            if u.pod_uid in pods_by_uid
+        ],
+        assignments=dict(resp.assignments),
+        existing=existing,
+        existing_assignments=existing_assignments,
+    )

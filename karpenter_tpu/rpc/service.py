@@ -1,0 +1,186 @@
+"""The solver service: hosts a TPUScheduler behind gRPC.
+
+Control/solver split (SURVEY.md §2.9): this process sits next to the TPU;
+the control plane (Provisioner + controllers) talks to it over DCN via
+solver.proto. The service is STATELESS between solves — each request
+carries the full cluster-side problem; only the Configure'd
+template/catalog set (the cold config) persists, exactly like the
+reference scheduler consumes a per-loop instance-type snapshot
+(provisioner.go:293).
+
+Run standalone:  python -m karpenter_tpu.rpc.service --port 18632
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from karpenter_tpu.rpc import solver_pb2 as pb
+from karpenter_tpu.rpc import convert
+from karpenter_tpu.rpc.codec import decode_templates
+
+SERVICE_NAME = "karpenter_tpu.solver.v1.Solver"
+
+
+class SolverService:
+    """RPC method implementations. Holds the Configure'd scheduler."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # Serializes solves: TPUScheduler.solve mutates instance state
+        # (reserved_mode swap, _n_claims_override) and the device is a
+        # serial resource anyway — overlapping RPCs (client retries, two
+        # control planes) must queue, not interleave.
+        self._solve_lock = threading.Lock()
+        self._scheduler = None
+        self._version = 0
+
+    # -- rpc handlers ------------------------------------------------------
+
+    def Configure(self, request: pb.ConfigureRequest, context) -> pb.ConfigureResponse:
+        from karpenter_tpu.controllers.provisioning.scheduler import TPUScheduler
+
+        templates = decode_templates(request.templates_json)
+        sched = TPUScheduler(
+            templates,
+            max_claims=request.max_claims if request.HasField("max_claims") else None,
+            pod_pad=request.pod_pad if request.HasField("pod_pad") else None,
+            reserved_mode=request.reserved_mode or "fallback",
+            reserved_capacity_enabled=request.reserved_capacity_enabled,
+            min_values_policy=request.min_values_policy or "Strict",
+        )
+        with self._lock:
+            self._version += 1
+            self._scheduler = sched
+            version = self._version
+        return pb.ConfigureResponse(config_version=version)
+
+    def Solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
+        with self._lock:
+            sched, version = self._scheduler, self._version
+        if sched is None or request.config_version != version:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"config_version {request.config_version} != live {version}; re-Configure",
+            )
+        pods = [convert.pod_from_pb(m) for m in request.pods]
+        existing = [
+            convert.existing_from_pb(m, i) for i, m in enumerate(request.existing_nodes)
+        ]
+        budgets = {
+            pool: dict(rm.resources) for pool, rm in request.budgets.items()
+        } or None
+        bound = [
+            (convert.pod_from_pb(b.pod), dict(b.node_labels)) for b in request.bound_pods
+        ]
+        volume_reqs = {
+            va.pod_uid: [convert.reqs_from_pb(rs.requirements) for rs in va.alternatives]
+            for va in request.volume_reqs
+        } or None
+        pod_volumes = {
+            pv.pod_uid: convert.volumes_from_pb(pv) for pv in request.pod_volumes
+        } or None
+
+        def topology_factory(current_pods):
+            from karpenter_tpu.controllers.provisioning.topology import (
+                Topology,
+                build_universe_domains,
+            )
+
+            universe = build_universe_domains(
+                sched.templates, existing, template_base=sched.universe_base()
+            )
+            return Topology.build(current_pods, universe, bound)
+
+        deadline = None
+        if request.HasField("timeout_seconds"):
+            deadline = time.monotonic() + request.timeout_seconds
+        with self._solve_lock:
+            result = sched.solve(
+                pods,
+                existing,
+                budgets,
+                topology_factory=topology_factory,
+                volume_reqs=volume_reqs,
+                reserved_mode=request.reserved_mode or None,
+                reserved_in_use=dict(request.reserved_in_use) or None,
+                pod_volumes=pod_volumes,
+                deadline=deadline,
+            )
+        return convert.result_to_pb(result, sched.templates)
+
+    def Health(self, request: pb.HealthRequest, context) -> pb.HealthResponse:
+        import jax
+
+        with self._lock:
+            version = self._version
+        return pb.HealthResponse(
+            ready=self._scheduler is not None,
+            platform=jax.devices()[0].platform,
+            config_version=version,
+        )
+
+
+def _handlers(service: SolverService) -> grpc.GenericRpcHandler:
+    """Hand-wired method handlers (no grpc_tools codegen in this image —
+    protoc emits messages only; the service table is built directly)."""
+    rpcs = {
+        "Configure": grpc.unary_unary_rpc_method_handler(
+            service.Configure,
+            request_deserializer=pb.ConfigureRequest.FromString,
+            response_serializer=pb.ConfigureResponse.SerializeToString,
+        ),
+        "Solve": grpc.unary_unary_rpc_method_handler(
+            service.Solve,
+            request_deserializer=pb.SolveRequest.FromString,
+            response_serializer=pb.SolveResponse.SerializeToString,
+        ),
+        "Health": grpc.unary_unary_rpc_method_handler(
+            service.Health,
+            request_deserializer=pb.HealthRequest.FromString,
+            response_serializer=pb.HealthResponse.SerializeToString,
+        ),
+    }
+    return grpc.method_handlers_generic_handler(SERVICE_NAME, rpcs)
+
+
+def serve(
+    address: str = "127.0.0.1:0", max_workers: int = 4
+) -> tuple[grpc.Server, str]:
+    """Start a solver server; returns (server, bound address). Solves are
+    serialized through SolverService._solve_lock, so the worker pool only
+    needs to cover Configure/Health overlap."""
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=[
+            # north-star problems serialize ~10s of MB of pods
+            ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+            ("grpc.max_send_message_length", 256 * 1024 * 1024),
+        ],
+    )
+    server.add_generic_rpc_handlers((_handlers(SolverService()),))
+    port = server.add_insecure_port(address)
+    host = address.rsplit(":", 1)[0]
+    server.start()
+    return server, f"{host}:{port}"
+
+
+def main() -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="karpenter-tpu solver service")
+    parser.add_argument("--port", type=int, default=18632)
+    parser.add_argument("--host", default="0.0.0.0")
+    args = parser.parse_args()
+    server, addr = serve(f"{args.host}:{args.port}")
+    print(f"solver listening on {addr}", flush=True)
+    server.wait_for_termination()
+
+
+if __name__ == "__main__":
+    main()

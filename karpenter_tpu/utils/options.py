@@ -54,6 +54,14 @@ class Options:
     disruption_poll_seconds: float = 10.0  # disruption/controller.go:71
     preference_policy: str = "Respect"  # Respect | Ignore (options.go:33-45)
     min_values_policy: str = "Strict"  # Strict | BestEffort
+    # host:port of a remote solver service (rpc/service.py); empty = solve
+    # in-process. The control/solver split of SURVEY.md §2.9.
+    solver_endpoint: str = ""
+    # operator runtime (operator.go:126-243): 0 disables the probe server;
+    # -1 binds an ephemeral port (tests read Operator.health_port back)
+    health_probe_port: int = 0
+    enable_profiling: bool = False  # operator.go:205
+    leader_elect: bool = False  # single-process harness default; HA sets it
     feature_gates: FeatureGates = field(default_factory=FeatureGates)
 
     @staticmethod
@@ -68,6 +76,18 @@ class Options:
             opts.preference_policy = env[prefix + "PREFERENCE_POLICY"]
         if prefix + "MIN_VALUES_POLICY" in env:
             opts.min_values_policy = env[prefix + "MIN_VALUES_POLICY"]
+        if prefix + "SOLVER_ENDPOINT" in env:
+            opts.solver_endpoint = env[prefix + "SOLVER_ENDPOINT"]
+        if prefix + "HEALTH_PROBE_PORT" in env:
+            opts.health_probe_port = int(env[prefix + "HEALTH_PROBE_PORT"])
+        if prefix + "ENABLE_PROFILING" in env:
+            opts.enable_profiling = env[prefix + "ENABLE_PROFILING"].lower() in (
+                "true", "1", "yes",
+            )
+        if prefix + "LEADER_ELECT" in env:
+            opts.leader_elect = env[prefix + "LEADER_ELECT"].lower() in (
+                "true", "1", "yes",
+            )
         if prefix + "FEATURE_GATES" in env:
             opts.feature_gates = FeatureGates.parse(env[prefix + "FEATURE_GATES"])
         return opts

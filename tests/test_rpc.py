@@ -1,0 +1,304 @@
+"""The gRPC control/solver split (rpc/): wire codec fidelity, remote/local
+solve parity, and the full provisioning pipeline through the socket.
+
+The reference seam being reproduced is the CloudProvider decorator
+(pkg/cloudprovider/metrics/cloudprovider.go) — here crossed for real at
+the Scheduler boundary (SURVEY.md §2.9: control plane over DCN, solver
+next to the accelerator)."""
+
+import pytest
+
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.controllers.manager import KubeSchedulerSim, Manager
+from karpenter_tpu.controllers.provisioning import TPUScheduler, build_templates
+from karpenter_tpu.controllers.provisioning.host_scheduler import pod_content_sig
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodepool import Budget, NodePool
+from karpenter_tpu.models.pod import (
+    HostPort,
+    NodeAffinity,
+    PodAffinityTerm,
+    PreferredSchedulingTerm,
+    TopologySpreadConstraint,
+    make_pod,
+)
+from karpenter_tpu.models.taints import Toleration
+from karpenter_tpu.rpc import RemoteScheduler, serve
+from karpenter_tpu.rpc import convert
+from karpenter_tpu.rpc.codec import decode_templates, encode_templates
+from karpenter_tpu.state.store import ObjectStore
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.options import Options
+
+
+@pytest.fixture(scope="module")
+def solver_server():
+    server, addr = serve("127.0.0.1:0")
+    yield addr
+    server.stop(0)
+
+
+def default_pool(name="default") -> NodePool:
+    pool = NodePool()
+    pool.metadata.name = name
+    return pool
+
+
+def diverse_pods(n):
+    """The reference benchmark's fifths: generic / TSC-zone / TSC-host /
+    affinity / anti-affinity (scheduling_benchmark_test.go:259-272)."""
+    pods = []
+    for i in range(n):
+        p = make_pod(f"p-{i}", cpu=0.5, memory="512Mi")
+        kind = i % 5
+        if kind == 1:
+            p.metadata.labels = {"spread": "zonal"}
+            p.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=l.LABEL_TOPOLOGY_ZONE,
+                    label_selector={"spread": "zonal"},
+                )
+            ]
+        elif kind == 2:
+            p.metadata.labels = {"spread": "host"}
+            p.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=l.LABEL_HOSTNAME,
+                    label_selector={"spread": "host"},
+                )
+            ]
+        elif kind == 3:
+            p.metadata.labels = {"aff": "group"}
+            p.spec.pod_affinity = [
+                PodAffinityTerm(
+                    topology_key=l.LABEL_TOPOLOGY_ZONE, label_selector={"aff": "group"}
+                )
+            ]
+        elif kind == 4:
+            p.metadata.labels = {"app": "nginx"}
+            p.spec.pod_anti_affinity = [
+                PodAffinityTerm(
+                    topology_key=l.LABEL_HOSTNAME, label_selector={"app": "nginx"}
+                )
+            ]
+        pods.append(p)
+    return pods
+
+
+class TestCodec:
+    def test_template_catalog_roundtrip(self):
+        pool = default_pool()
+        pool.spec.weight = 7
+        pool.spec.template.labels["team"] = "infra"
+        pool.spec.template.spec.taints = []
+        templates = build_templates([(pool, instance_types(24))])
+        templates[0].daemon_requests = {"cpu": 0.25, "pods": 1.0}
+        data = encode_templates(templates)
+        back = decode_templates(data)
+        assert len(back) == len(templates)
+        t0, b0 = templates[0], back[0]
+        assert b0.nodepool_name == t0.nodepool_name
+        assert b0.weight == t0.weight
+        assert b0.labels == t0.labels
+        assert b0.daemon_requests == t0.daemon_requests
+        assert str(b0.requirements) == str(t0.requirements)
+        assert [it.name for it in b0.instance_types] == [
+            it.name for it in t0.instance_types
+        ]
+        # offerings survive with prices, zones and availability
+        it0, ib0 = t0.instance_types[0], b0.instance_types[0]
+        assert it0.capacity == ib0.capacity
+        assert [(o.zone, o.capacity_type, o.price, o.available) for o in it0.offerings] == [
+            (o.zone, o.capacity_type, o.price, o.available) for o in ib0.offerings
+        ]
+        assert it0.allocatable() == ib0.allocatable()
+        # the encoding is canonical: same input -> same bytes
+        assert encode_templates(templates) == data
+
+    def test_pod_roundtrip_preserves_kind_signature(self):
+        pods = diverse_pods(5)
+        # enrich the generic pod with the remaining spec surface
+        pods[0].spec.node_selector = {l.LABEL_TOPOLOGY_ZONE: "test-zone-1"}
+        pods[0].spec.tolerations = [
+            Toleration(key="dedicated", operator="Equal", value="gpu", effect="NoSchedule")
+        ]
+        pods[0].spec.host_ports = [HostPort(port=8080, protocol="TCP")]
+        pods[0].spec.node_affinity = NodeAffinity(
+            preferred=[
+                PreferredSchedulingTerm(
+                    5, [{"key": "x", "operator": "In", "values": ["a", "b"]}]
+                )
+            ]
+        )
+        for pod in pods:
+            back = convert.pod_from_pb(convert.pod_to_pb(pod))
+            assert back.uid == pod.uid
+            assert back.metadata.labels == pod.metadata.labels
+            # the kind signature drives dedup/batching and packing order —
+            # it must survive the wire bit-for-bit
+            assert pod_content_sig(back) == pod_content_sig(pod)
+
+
+class TestSolveParity:
+    def _parity(self, addr, templates, pods, **kwargs):
+        remote = RemoteScheduler(addr, templates)
+        local = TPUScheduler(templates)
+        r = remote.solve(pods, **kwargs)
+        s = local.solve(pods, **kwargs)
+        assert len(r.claims) == len(s.claims)
+        assert r.assignments == s.assignments
+        assert r.existing_assignments == s.existing_assignments
+        assert sorted(reason for _, reason in r.unschedulable) == sorted(
+            reason for _, reason in s.unschedulable
+        )
+        assert abs(r.total_price() - s.total_price()) < 1e-9
+        for rc, sc in zip(r.claims, s.claims):
+            assert rc.template.nodepool_name == sc.template.nodepool_name
+            assert [it.name for it in rc.instance_types] == [
+                it.name for it in sc.instance_types
+            ]
+            assert sorted(p.uid for p in rc.pods) == sorted(p.uid for p in sc.pods)
+            assert rc.used == sc.used
+        return r
+
+    def test_selector_pods(self, solver_server):
+        templates = build_templates([(default_pool(), instance_types(32))])
+        pods = [
+            make_pod(
+                f"p-{i}",
+                cpu=0.5,
+                node_selector=(
+                    {l.LABEL_TOPOLOGY_ZONE: f"test-zone-{1 + i % 3}"} if i % 2 else {}
+                ),
+            )
+            for i in range(16)
+        ]
+        self._parity(solver_server, templates, pods)
+
+    def test_reference_mix_topology(self, solver_server):
+        """TSC + affinity + anti-affinity cross the wire: the server builds
+        topology from shipped pods (no client callback crosses)."""
+        templates = build_templates([(default_pool(), instance_types(32))])
+        self._parity(solver_server, templates, diverse_pods(20))
+
+    def test_budgets_and_weights(self, solver_server):
+        heavy, light = default_pool("heavy"), default_pool("light")
+        heavy.spec.weight = 90
+        light.spec.weight = 10
+        templates = build_templates(
+            [(heavy, instance_types(16)), (light, instance_types(16))]
+        )
+        pods = [make_pod(f"p-{i}", cpu=1.0) for i in range(8)]
+        self._parity(
+            solver_server, templates, pods, budgets={"heavy": {"nodes": 1.0}}
+        )
+
+    def test_unschedulable_reason_crosses(self, solver_server):
+        templates = build_templates([(default_pool(), instance_types(8))])
+        pods = [make_pod("impossible", cpu=10_000.0)]
+        r = self._parity(solver_server, templates, pods)
+        assert len(r.unschedulable) == 1
+        assert r.unschedulable[0][0].uid == pods[0].uid
+
+    def test_relaxation_happens_server_side(self, solver_server):
+        templates = build_templates([(default_pool(), instance_types(16))])
+        pod = make_pod("p", cpu=0.5)
+        pod.spec.node_affinity = NodeAffinity(
+            preferred=[
+                PreferredSchedulingTerm(
+                    10,
+                    [{"key": l.LABEL_TOPOLOGY_ZONE, "operator": "In",
+                      "values": ["zone-nowhere"]}],
+                )
+            ]
+        )
+        r = RemoteScheduler(solver_server, templates).solve([pod])
+        assert not r.unschedulable  # the ladder ran remotely
+
+    def test_stale_config_recovers(self, solver_server):
+        """A superseded Configure (another client generation, or a solver
+        restart) invalidates the config version; the client re-Configures
+        and retries instead of leaving provisioning permanently broken."""
+        templates = build_templates([(default_pool(), instance_types(8))])
+        first = RemoteScheduler(solver_server, templates)
+        stale = first._config_version
+        RemoteScheduler(solver_server, templates)  # supersedes `first`
+        result = first.solve([make_pod("p", cpu=0.5)])
+        assert len(result.claims) == 1
+        assert first._config_version > stale  # re-Configure happened
+
+
+class TestPipelineThroughSocket:
+    def test_kwok_provisioning_e2e(self, solver_server):
+        """The full pipeline — batcher, provisioner, lifecycle, binding —
+        with every solve crossing the wire."""
+        clock = FakeClock()
+        store = ObjectStore(clock)
+        cloud = KwokCloudProvider(store, catalog=instance_types(32))
+        opts = Options(solver_endpoint=solver_server)
+        mgr = Manager(store, cloud, clock, options=opts)
+        pool = default_pool()
+        pool.spec.disruption.budgets = [Budget(nodes="100%")]
+        store.create(ObjectStore.NODEPOOLS, pool)
+        for i in range(12):
+            store.create(ObjectStore.PODS, make_pod(f"p-{i}", cpu=1.0, memory="1Gi"))
+        mgr.run_until_idle()
+        from karpenter_tpu.rpc.client import RemoteScheduler as RS
+
+        assert isinstance(mgr.provisioner._scheduler_cache[1], RS)
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        KubeSchedulerSim(store, mgr.cluster).bind_pending()
+        bound = sum(1 for p in store.pods() if p.spec.node_name)
+        assert bound == 12
+        assert len(store.nodes()) >= 1
+
+    def test_consolidation_through_socket(self, solver_server):
+        """Disruption what-ifs ride the remote Solve (whatif_batch declines
+        remotely and methods fall back to sequential simulates)."""
+        clock = FakeClock()
+        store = ObjectStore(clock)
+        cloud = KwokCloudProvider(store, catalog=instance_types(64))
+        opts = Options(solver_endpoint=solver_server)
+        mgr = Manager(store, cloud, clock, options=opts)
+        pool = default_pool()
+        pool.spec.disruption.consolidate_after_seconds = 0.0
+        pool.spec.disruption.consolidation_policy = "WhenEmptyOrUnderutilized"
+        pool.spec.disruption.budgets = [Budget(nodes="100%")]
+        pool.spec.template.spec.requirements = [
+            {
+                "key": l.CAPACITY_TYPE_LABEL_KEY,
+                "operator": "In",
+                "values": [l.CAPACITY_TYPE_ON_DEMAND],
+            }
+        ]
+        store.create(ObjectStore.NODEPOOLS, pool)
+        for i in range(8):
+            store.create(ObjectStore.PODS, make_pod(f"p-{i}", cpu=1.5, memory="1Gi"))
+        mgr.run_until_idle()
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        KubeSchedulerSim(store, mgr.cluster).bind_pending()
+        mgr.run_until_idle()
+        cpu_before = sum(n.status.capacity["cpu"] for n in store.nodes())
+        for pod in list(store.pods()):
+            if pod.name not in ("p-0", "p-1"):
+                pod.status.phase = "Succeeded"
+                store.update(ObjectStore.PODS, pod)
+                store.delete(ObjectStore.PODS, pod.name)
+        mgr.run_until_idle()
+        clock.step(60.0)
+        executed = None
+        for _ in range(8):
+            cmd = mgr.run_disruption_once()
+            executed = executed or cmd
+            cloud.simulate_kubelet_ready()
+            mgr.run_until_idle()
+            KubeSchedulerSim(store, mgr.cluster).bind_pending()
+            clock.step(20.0)
+        assert executed is not None
+        cpu_after = sum(n.status.capacity["cpu"] for n in store.nodes())
+        assert cpu_after < cpu_before
