@@ -54,6 +54,43 @@ def force_cpu() -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
+_cache_enabled = False
+
+
+def enable_persistent_compile_cache(path: "str | None" = None) -> str:
+    """Point XLA's persistent compilation cache at a durable directory so a
+    process restart (or any shape-class revisit across processes) skips the
+    20-70s cold compile — without this, the first batch after a restart
+    would blow most of the reference's 1m Solve window
+    (provisioner.go:415). Idempotent; returns the cache dir.
+
+    Shape discipline upstream keeps this cache small: every solve pads pods
+    and claim slots to power-of-two buckets and the label vocab to
+    power-of-two K/V pads (scheduler.py), so the distinct shape classes —
+    and therefore cache entries — grow logarithmically with problem size.
+    """
+    global _cache_enabled
+    import jax
+
+    path = path or os.environ.get(
+        "KTPU_COMPILE_CACHE",
+        os.path.join(
+            os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+            "karpenter_tpu",
+            "xla_cache",
+        ),
+    )
+    if not _cache_enabled:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache every kernel, not just the slow ones — the solve path is a
+        # handful of executables and the reads are cheap
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        _cache_enabled = True
+    return path
+
+
 def force_cpu_if_unavailable(timeout: float = DEFAULT_PROBE_TIMEOUT) -> str | None:
     """CPU-fallback stanza: probes for an accelerator and forces the CPU
     platform when none is usable. Returns the probe failure mode
