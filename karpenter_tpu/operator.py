@@ -38,6 +38,9 @@ class Operator:
     cloud: object
     manager: object
     options: Options = field(default_factory=Options)
+    elector: object = None  # LeaderElector when leader_elect is on
+    health_server: object = None
+    health_port: int = 0
 
     @staticmethod
     def new(
@@ -60,17 +63,47 @@ class Operator:
         # remote-solver shim would occupy
         cloud = MetricsCloudProvider(OverlayCloudProvider(inner, store))
         manager = Manager(store, cloud, clock, options=options)
-        return Operator(store=store, cloud=cloud, manager=manager, options=options)
+        op = Operator(store=store, cloud=cloud, manager=manager, options=options)
+        if options.leader_elect:
+            import uuid
+
+            from karpenter_tpu.utils.runtime import LeaderElector
+
+            op.elector = LeaderElector(store, identity=f"op-{uuid.uuid4().hex[:8]}", clock=clock)
+        if options.health_probe_port:
+            from karpenter_tpu.utils.runtime import HealthConfig, serve_health
+
+            op.health_server, op.health_port = serve_health(
+                HealthConfig(
+                    # readiness = state convergence, the reference's cache-
+                    # sync + CRD-presence gate (operator.go:225-243); the
+                    # in-memory store IS the CRD layer here
+                    ready_checks={"cluster-synced": manager.cluster.synced},
+                    enable_profiling=options.enable_profiling,
+                ),
+                port=options.health_probe_port if options.health_probe_port > 0 else 0,
+            )
+        return op
 
     def tick(self) -> None:
         """One steady-state iteration: reconcile work, a disruption poll,
-        housekeeping, and harness binding."""
+        housekeeping, and harness binding. With leader election on, a
+        non-leader tick only runs the election round — reconcilers stay
+        idle until the lease is held (operator.go:171-181)."""
         from karpenter_tpu.controllers.manager import KubeSchedulerSim
 
+        if self.elector is not None and not self.elector.try_acquire_or_renew():
+            return
         self.manager.run_until_idle()
         self.manager.maybe_run_disruption()  # paced by disruption_poll_seconds
         self.manager.run_maintenance()
         KubeSchedulerSim(self.store, self.manager.cluster).bind_pending()
+
+    def shutdown(self) -> None:
+        if self.elector is not None:
+            self.elector.release()
+        if self.health_server is not None:
+            self.health_server.shutdown()
 
 
 def _demo() -> None:

@@ -1,0 +1,199 @@
+"""Operator runtime surfaces: leader election, health probes, profiling.
+
+Counterpart of reference pkg/operator/operator.go:126-243:
+- lease-based leader election with release-on-cancel (operator.go:171-181)
+- health/readyz endpoints gated on state convergence (operator.go:225-243)
+- profiling handlers behind --enable-profiling (operator.go:205-219) — the
+  Python analog of net/http/pprof: live thread dumps and on-demand
+  cProfile windows (plus the JAX profiler for device traces, utils/
+  profiling hooks).
+
+Everything runs against the injected clock so fake-clock tests can expire
+leases deterministically.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from karpenter_tpu.models.objects import ObjectMeta
+from karpenter_tpu.utils.clock import Clock
+
+LEASES = "leases"  # coordination.k8s.io/v1 Lease analog
+
+# client-go leaderelection defaults the reference inherits
+LEASE_DURATION_SECONDS = 15.0
+RENEW_DEADLINE_SECONDS = 10.0
+RETRY_PERIOD_SECONDS = 2.0
+
+
+@dataclass
+class Lease:
+    metadata: ObjectMeta
+    holder: str = ""
+    renew_time: float = 0.0
+    lease_duration_seconds: float = LEASE_DURATION_SECONDS
+
+
+class LeaderElector:
+    """Lease-based single-active-replica election (operator.go:171-181).
+
+    Not scale-out: the solver is stateless behind the leader (SURVEY §2.9),
+    so HA is one active control plane + warm standbys racing for the lease.
+    """
+
+    def __init__(
+        self,
+        store,
+        identity: str,
+        clock: Optional[Clock] = None,
+        lease_name: str = "karpenter-leader-election",
+        lease_duration: float = LEASE_DURATION_SECONDS,
+    ):
+        self.store = store
+        self.identity = identity
+        self.clock = clock or store.clock
+        self.lease_name = lease_name
+        self.lease_duration = lease_duration
+        self._leading = False
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leading
+
+    def try_acquire_or_renew(self) -> bool:
+        """One election round; call every RETRY_PERIOD_SECONDS. Returns
+        leadership after the round."""
+        now = self.clock.now()
+        lease = self.store.get(LEASES, self.lease_name)
+        if lease is None:
+            self.store.create(
+                LEASES,
+                Lease(
+                    metadata=ObjectMeta(name=self.lease_name),
+                    holder=self.identity,
+                    renew_time=now,
+                    lease_duration_seconds=self.lease_duration,
+                ),
+            )
+            self._leading = True
+            return True
+        if lease.holder == self.identity:
+            lease.renew_time = now
+            self.store.update(LEASES, lease)
+            self._leading = True
+            return True
+        if not lease.holder or now - lease.renew_time > lease.lease_duration_seconds:
+            # released (empty holder) or expired: take over
+            lease.holder = self.identity
+            lease.renew_time = now
+            self.store.update(LEASES, lease)
+            self._leading = True
+            return True
+        self._leading = False
+        return False
+
+    def release(self) -> None:
+        """Release-on-cancel (operator.go:176): a clean shutdown hands the
+        lease over immediately instead of stalling failover a full TTL."""
+        lease = self.store.get(LEASES, self.lease_name)
+        if lease is not None and lease.holder == self.identity:
+            lease.holder = ""
+            lease.renew_time = 0.0
+            self.store.update(LEASES, lease)
+        self._leading = False
+
+
+@dataclass
+class HealthConfig:
+    ready_checks: dict[str, Callable[[], bool]] = field(default_factory=dict)
+    enable_profiling: bool = False  # operator.go:205 --enable-profiling
+
+
+class _Handler(BaseHTTPRequestHandler):
+    config: HealthConfig  # injected by serve_health
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _send(self, code: int, body: str, ctype: str = "text/plain") -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        path = self.path.split("?", 1)[0]
+        if path == "/healthz":
+            self._send(200, "ok")
+        elif path == "/readyz":
+            # readiness = every registered check green (cache sync + CRD
+            # presence in the reference, operator.go:225-243)
+            failed = {
+                name: False for name, fn in self.config.ready_checks.items() if not fn()
+            }
+            if failed:
+                self._send(503, json.dumps({"failed": sorted(failed)}))
+            else:
+                self._send(200, "ok")
+        elif path == "/metrics":
+            from karpenter_tpu.utils.metrics import REGISTRY
+
+            self._send(200, REGISTRY.expose(), ctype="text/plain; version=0.0.4")
+        elif path == "/debug/pprof/threads":
+            if not self.config.enable_profiling:
+                self._send(404, "profiling disabled")
+                return
+            import traceback
+
+            out = io.StringIO()
+            for tid, frame in sys_current_frames().items():
+                out.write(f"--- thread {tid} ---\n")
+                traceback.print_stack(frame, file=out)
+            self._send(200, out.getvalue())
+        elif path == "/debug/pprof/profile":
+            if not self.config.enable_profiling:
+                self._send(404, "profiling disabled")
+                return
+            import cProfile
+            import pstats
+            import time as _t
+            from urllib.parse import parse_qs, urlparse
+
+            seconds = float(
+                parse_qs(urlparse(self.path).query).get("seconds", ["1"])[0]
+            )
+            prof = cProfile.Profile()
+            prof.enable()
+            _t.sleep(min(seconds, 30.0))
+            prof.disable()
+            out = io.StringIO()
+            pstats.Stats(prof, stream=out).sort_stats("cumulative").print_stats(40)
+            self._send(200, out.getvalue())
+        else:
+            self._send(404, "not found")
+
+
+def sys_current_frames():
+    import sys
+
+    return sys._current_frames()
+
+
+def serve_health(
+    config: HealthConfig, host: str = "127.0.0.1", port: int = 0
+) -> tuple[ThreadingHTTPServer, int]:
+    """Start the health/metrics/profiling server on a daemon thread;
+    returns (server, bound port)."""
+    handler = type("BoundHandler", (_Handler,), {"config": config})
+    server = ThreadingHTTPServer((host, port), handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, server.server_address[1]

@@ -1,0 +1,124 @@
+"""Operator runtime: leader election, health/readyz probes, profiling
+endpoints (reference pkg/operator/operator.go:126-243)."""
+
+import json
+import urllib.request
+
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.state.store import ObjectStore
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.options import Options
+from karpenter_tpu.utils.runtime import (
+    LEASES,
+    HealthConfig,
+    LeaderElector,
+    serve_health,
+)
+
+
+class TestLeaderElection:
+    def test_first_contender_acquires(self):
+        clock = FakeClock()
+        store = ObjectStore(clock)
+        a = LeaderElector(store, "a", clock)
+        assert a.try_acquire_or_renew()
+        assert a.is_leader
+        assert store.get(LEASES, a.lease_name).holder == "a"
+
+    def test_second_contender_waits_then_takes_over_on_expiry(self):
+        clock = FakeClock()
+        store = ObjectStore(clock)
+        a = LeaderElector(store, "a", clock)
+        b = LeaderElector(store, "b", clock)
+        assert a.try_acquire_or_renew()
+        assert not b.try_acquire_or_renew()  # lease held
+        clock.step(10.0)
+        a.try_acquire_or_renew()  # renewal extends the lease
+        clock.step(10.0)
+        assert not b.try_acquire_or_renew()  # renewed 10s ago, not expired
+        clock.step(6.0)  # now 16s past the last renewal > 15s duration
+        assert b.try_acquire_or_renew(), "expired lease not taken over"
+        assert not a.try_acquire_or_renew(), "deposed leader kept leading"
+        assert not a.is_leader
+
+    def test_release_on_cancel_hands_over_immediately(self):
+        # start=0.0 pins the empty-holder check: with now <= lease_duration
+        # the expiry test alone can never fire, so a released lease must be
+        # recognized by its empty holder, not by expiry
+        clock = FakeClock(start=0.0)
+        store = ObjectStore(clock)
+        a = LeaderElector(store, "a", clock)
+        b = LeaderElector(store, "b", clock)
+        assert a.try_acquire_or_renew()
+        a.release()  # clean shutdown (operator.go:176)
+        assert b.try_acquire_or_renew(), "failover waited a full TTL"
+
+    def test_operator_tick_gated_on_leadership(self):
+        clock = FakeClock()
+        op = Operator.new(clock=clock, options=Options(leader_elect=True))
+        # steal the lease first so the operator's elector loses the race
+        rival = LeaderElector(op.store, "rival", clock)
+        assert rival.try_acquire_or_renew()
+        from karpenter_tpu.models.pod import make_pod
+
+        op.store.create(ObjectStore.PODS, make_pod("p", cpu=0.5))
+        op.tick()  # not leader: no reconcile runs
+        assert not op.store.nodeclaims(), "non-leader provisioned"
+        rival.release()
+        op.tick()  # acquires and reconciles
+        assert op.elector.is_leader
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+class TestHealthServer:
+    def test_endpoints(self):
+        ready = {"v": False}
+        server, port = serve_health(
+            HealthConfig(ready_checks={"gate": lambda: ready["v"]})
+        )
+        try:
+            assert _get(port, "/healthz") == (200, "ok")
+            try:
+                _get(port, "/readyz")
+                raise AssertionError("readyz green while the gate is red")
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                assert "gate" in json.loads(e.read().decode())["failed"]
+            ready["v"] = True
+            assert _get(port, "/readyz") == (200, "ok")
+            status, body = _get(port, "/metrics")
+            assert status == 200 and "karpenter_" in body
+            # profiling is opt-in (operator.go:205 --enable-profiling)
+            try:
+                _get(port, "/debug/pprof/threads")
+                raise AssertionError("profiling reachable while disabled")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            server.shutdown()
+
+    def test_profiling_endpoints_when_enabled(self):
+        server, port = serve_health(HealthConfig(enable_profiling=True))
+        try:
+            status, body = _get(port, "/debug/pprof/threads")
+            assert status == 200 and "thread" in body
+            status, body = _get(port, "/debug/pprof/profile?seconds=0.1")
+            assert status == 200 and "cumulative" in body
+        finally:
+            server.shutdown()
+
+    def test_operator_wires_probe_server(self):
+        clock = FakeClock()
+        op = Operator.new(clock=clock, options=Options(health_probe_port=-1))
+        try:
+            assert op.health_port > 0
+            assert _get(op.health_port, "/healthz") == (200, "ok")
+            # an empty cluster state mirror is synced trivially -> ready
+            status, _ = _get(op.health_port, "/readyz")
+            assert status == 200
+        finally:
+            op.shutdown()
