@@ -13,6 +13,7 @@ from karpenter_tpu.models.nodeclaim import COND_CONSISTENT_STATE_FOUND, COND_REG
 from karpenter_tpu.models.nodepool import (
     CONDITION_NODECLASS_READY,
     CONDITION_READY,
+    CONDITION_VALIDATION_SUCCEEDED,
     NODEPOOL_HASH_VERSION,
 )
 from karpenter_tpu.state.cluster import Cluster
@@ -95,6 +96,37 @@ class ConsistencyController:
         return flagged
 
 
+class NodePoolValidationController:
+    """Runtime validation the CRD schema can't express
+    (pkg/controllers/nodepool/validation/controller.go:61-84): flips
+    ValidationSucceeded per pool; a False gates the pool out of
+    provisioning via the Ready root condition."""
+
+    def __init__(self, store: ObjectStore, clock: Clock):
+        self.store = store
+        self.clock = clock
+
+    def reconcile(self) -> int:
+        from karpenter_tpu.models.validation import validate_nodepool
+
+        flagged = 0
+        for pool in self.store.nodepools():
+            errs = validate_nodepool(pool)
+            if errs:
+                pool.conditions.set_false(
+                    CONDITION_VALIDATION_SUCCEEDED,
+                    "NodePoolValidationFailed",
+                    "; ".join(errs[:5]),
+                    now=self.clock.now(),
+                )
+                flagged += 1
+            else:
+                pool.conditions.set_true(
+                    CONDITION_VALIDATION_SUCCEEDED, now=self.clock.now()
+                )
+        return flagged
+
+
 class NodePoolStatusController:
     """Usage into status.resources + Ready condition + hash annotation
     (nodepool/{counter,readiness,hash})."""
@@ -110,9 +142,15 @@ class NodePoolStatusController:
             pool.status.resources = usage
             pool.status.node_count = int(usage.get("nodes", 0))
             # the harness has no NodeClass objects: class readiness is
-            # vacuously true, making the pool Ready
+            # vacuously true; Ready is the root condition over class
+            # readiness AND runtime validation (operatorpkg status roots)
             pool.conditions.set_true(CONDITION_NODECLASS_READY, "NoNodeClass", now=self.clock.now())
-            pool.conditions.set_true(CONDITION_READY, "Ready", now=self.clock.now())
+            if pool.conditions.is_false(CONDITION_VALIDATION_SUCCEEDED):
+                pool.conditions.set_false(
+                    CONDITION_READY, "NodePoolValidationFailed", now=self.clock.now()
+                )
+            else:
+                pool.conditions.set_true(CONDITION_READY, "Ready", now=self.clock.now())
             pool.metadata.annotations[l.NODEPOOL_HASH_ANNOTATION_KEY] = pool.static_hash()
             pool.metadata.annotations[l.NODEPOOL_HASH_VERSION_ANNOTATION_KEY] = (
                 NODEPOOL_HASH_VERSION

@@ -69,6 +69,11 @@ class ConditionSet:
     def is_true(self, *ctypes: str) -> bool:
         return all((c := self._conditions.get(t)) is not None and c.is_true for t in ctypes)
 
+    def is_false(self, ctype: str) -> bool:
+        """Explicitly False (unset/Unknown is NOT false)."""
+        c = self._conditions.get(ctype)
+        return c is not None and c.status == CONDITION_FALSE
+
     def has(self, ctype: str) -> bool:
         return ctype in self._conditions
 

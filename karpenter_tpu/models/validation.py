@@ -1,0 +1,132 @@
+"""NodePool runtime validation — the checks CRD schema/CEL can't express.
+
+Counterpart of reference pkg/apis/v1/nodepool_validation.go:28-58 +
+nodeclaim_validation.go:66-150 (RuntimeValidate): label syntax and
+restricted-domain rules, taint syntax + duplicate key/effect detection
+across taints and startupTaints, requirement operator/key/value checks.
+Consumed by the nodepool.validation controller
+(pkg/controllers/nodepool/validation/controller.go:61-84), which flips the
+ValidationSucceeded condition and thereby gates pool readiness.
+"""
+
+from __future__ import annotations
+
+import re
+
+from karpenter_tpu.models import labels as l
+
+_NAME_RE = re.compile(r"^[A-Za-z0-9]([-A-Za-z0-9_.]*[A-Za-z0-9])?$")
+_DNS1123_RE = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*$")
+
+SUPPORTED_OPERATORS = frozenset(
+    {"In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt", "Gte", "Lte"}
+)
+_VALID_EFFECTS = frozenset({"NoSchedule", "PreferNoSchedule", "NoExecute", ""})
+
+
+def _qualified_name_errors(key: str) -> list[str]:
+    """k8s validation.IsQualifiedName: optional DNS-subdomain prefix +
+    63-char name part."""
+    errs = []
+    parts = key.split("/")
+    if len(parts) > 2 or not key:
+        return [f"{key!r} is not a qualified name"]
+    if len(parts) == 2:
+        prefix, name = parts
+        if not prefix or len(prefix) > 253 or not _DNS1123_RE.match(prefix):
+            errs.append(f"prefix of {key!r} is not a valid DNS subdomain")
+    else:
+        name = parts[0]
+    if not name or len(name) > 63 or not _NAME_RE.match(name):
+        errs.append(f"name part of {key!r} must be 1-63 alphanumerics/-/_/.")
+    return errs
+
+
+def _label_value_errors(value: str) -> list[str]:
+    if value == "":
+        return []
+    if len(value) > 63 or not _NAME_RE.match(value):
+        return [f"label value {value!r} must be <=63 alphanumerics/-/_/."]
+    return []
+
+
+def _restricted_label_error(key: str) -> str | None:
+    """IsRestrictedLabel (labels.go:138-148): well-known keys pass; the
+    karpenter.sh domain (and subdomains) is reserved otherwise."""
+    if key in l.WELL_KNOWN_LABELS:
+        return None
+    if l.is_restricted_label(key):
+        return (
+            f"using label {key} is not allowed as it might interfere with "
+            "the internal provisioning logic"
+        )
+    return None
+
+
+def validate_nodepool(pool) -> list[str]:
+    """All runtime-validation errors for a NodePool; empty = valid."""
+    errs: list[str] = []
+    tmpl = pool.spec.template
+
+    # validateLabels (nodepool_validation.go:33-49)
+    for key, value in tmpl.labels.items():
+        if key == l.NODEPOOL_LABEL_KEY:
+            errs.append(f"invalid key name {key!r} in labels, restricted")
+        errs.extend(_qualified_name_errors(key))
+        errs.extend(_label_value_errors(value))
+        restricted = _restricted_label_error(key)
+        if restricted:
+            errs.append(restricted)
+
+    # validateTaints incl. duplicate key/effect across BOTH lists
+    # (nodeclaim_validation.go:66-101)
+    seen: set[tuple[str, str]] = set()
+    for field_name, taints in (
+        ("taints", tmpl.spec.taints),
+        ("startupTaints", tmpl.spec.startup_taints),
+    ):
+        for taint in taints:
+            if not taint.key:
+                errs.append(f"missing taint key in {field_name}")
+            else:
+                errs.extend(_qualified_name_errors(taint.key))
+            if taint.value:
+                errs.extend(_qualified_name_errors(taint.value))
+            if taint.effect not in _VALID_EFFECTS:
+                errs.append(f"invalid effect {taint.effect!r} in {field_name}")
+            pair = (taint.key, taint.effect)
+            if pair in seen:
+                errs.append(
+                    f"duplicate taint Key/Effect pair {taint.key}={taint.effect}"
+                )
+            seen.add(pair)
+
+    # validateRequirements + NodePoolKeyDoesNotExist
+    # (nodeclaim_validation.go:108-150, nodepool_validation.go:51-57)
+    for r in tmpl.spec.requirements:
+        key = r.get("key", "")
+        key = l.NORMALIZED_LABELS.get(key, key)
+        if key == l.NODEPOOL_LABEL_KEY:
+            errs.append(f"invalid key: {key!r} in requirements, restricted")
+        op = r.get("operator", "")
+        if op not in SUPPORTED_OPERATORS:
+            errs.append(f"key {key} has an unsupported operator {op}")
+        restricted = _restricted_label_error(key)
+        if restricted:
+            errs.append(restricted)
+        errs.extend(_qualified_name_errors(key))
+        for value in r.get("values", ()):
+            errs.extend(_label_value_errors(value))
+        if op in ("Gt", "Lt", "Gte", "Lte"):
+            values = r.get("values", ())
+            if len(values) != 1 or not str(values[0]).lstrip("-").isdigit():
+                errs.append(f"key {key}: {op} requires a single integer value")
+        min_values = r.get("minValues")
+        if min_values is not None:
+            if op != "In":
+                errs.append(f"key {key}: minValues requires operator In")
+            elif min_values > len(r.get("values", ())):
+                errs.append(
+                    f"key {key}: minValues {min_values} exceeds the value count"
+                )
+    return errs

@@ -1,0 +1,137 @@
+"""NodePool runtime validation (nodepool_validation.go:28-58,
+nodeclaim_validation.go:66-150, validation controller:61-84)."""
+
+from karpenter_tpu.controllers.status_controllers import (
+    NodePoolStatusController,
+    NodePoolValidationController,
+)
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodepool import (
+    CONDITION_READY,
+    CONDITION_VALIDATION_SUCCEEDED,
+    NodePool,
+)
+from karpenter_tpu.models.taints import Taint
+from karpenter_tpu.models.validation import validate_nodepool
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.store import ObjectStore
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def pool_named(name="default") -> NodePool:
+    pool = NodePool()
+    pool.metadata.name = name
+    return pool
+
+
+class TestValidateNodePool:
+    def test_clean_pool_passes(self):
+        assert validate_nodepool(pool_named()) == []
+
+    def test_nodepool_label_restricted(self):
+        pool = pool_named()
+        pool.spec.template.labels[l.NODEPOOL_LABEL_KEY] = "x"
+        assert any("restricted" in e for e in validate_nodepool(pool))
+
+    def test_restricted_domain_label(self):
+        pool = pool_named()
+        pool.spec.template.labels["karpenter.sh/custom"] = "x"
+        assert any("not allowed" in e for e in validate_nodepool(pool))
+
+    def test_well_known_label_allowed(self):
+        pool = pool_named()
+        pool.spec.template.labels[l.CAPACITY_TYPE_LABEL_KEY] = "spot"
+        assert validate_nodepool(pool) == []
+
+    def test_bad_label_syntax(self):
+        pool = pool_named()
+        pool.spec.template.labels["-bad-"] = "v"
+        assert any("name part" in e for e in validate_nodepool(pool))
+        pool2 = pool_named()
+        pool2.spec.template.labels["ok"] = "bad value with spaces"
+        assert any("label value" in e for e in validate_nodepool(pool2))
+
+    def test_duplicate_taint_across_lists(self):
+        pool = pool_named()
+        pool.spec.template.spec.taints = [Taint(key="a", effect="NoSchedule")]
+        pool.spec.template.spec.startup_taints = [Taint(key="a", effect="NoSchedule")]
+        assert any("duplicate taint" in e for e in validate_nodepool(pool))
+
+    def test_invalid_taint_effect(self):
+        pool = pool_named()
+        pool.spec.template.spec.taints = [Taint(key="a", effect="Nope")]
+        assert any("invalid effect" in e for e in validate_nodepool(pool))
+
+    def test_unsupported_operator(self):
+        pool = pool_named()
+        pool.spec.template.spec.requirements = [
+            {"key": "x", "operator": "Matches", "values": ["a"]}
+        ]
+        assert any("unsupported operator" in e for e in validate_nodepool(pool))
+
+    def test_gt_requires_single_integer(self):
+        pool = pool_named()
+        pool.spec.template.spec.requirements = [
+            {"key": "cpu-count", "operator": "Gt", "values": ["abc"]}
+        ]
+        assert any("single integer" in e for e in validate_nodepool(pool))
+
+    def test_min_values_exceeding_values(self):
+        pool = pool_named()
+        pool.spec.template.spec.requirements = [
+            {"key": "x", "operator": "In", "values": ["a"], "minValues": 3}
+        ]
+        assert any("minValues" in e for e in validate_nodepool(pool))
+
+    def test_requirement_on_nodepool_key_restricted(self):
+        pool = pool_named()
+        pool.spec.template.spec.requirements = [
+            {"key": l.NODEPOOL_LABEL_KEY, "operator": "In", "values": ["p"]}
+        ]
+        assert any("restricted" in e for e in validate_nodepool(pool))
+
+
+class TestValidationController:
+    def _env(self):
+        clock = FakeClock()
+        store = ObjectStore(clock)
+        return clock, store
+
+    def test_flips_condition_and_gates_ready(self):
+        clock, store = self._env()
+        bad = pool_named("bad")
+        bad.spec.template.labels["karpenter.sh/custom"] = "x"
+        good = pool_named("good")
+        store.create(ObjectStore.NODEPOOLS, bad)
+        store.create(ObjectStore.NODEPOOLS, good)
+        assert NodePoolValidationController(store, clock).reconcile() == 1
+        assert bad.conditions.is_false(CONDITION_VALIDATION_SUCCEEDED)
+        assert good.conditions.is_true(CONDITION_VALIDATION_SUCCEEDED)
+        NodePoolStatusController(store, Cluster(clock), clock).reconcile()
+        assert bad.conditions.is_false(CONDITION_READY)
+        assert good.conditions.is_true(CONDITION_READY)
+
+    def test_invalid_pool_excluded_from_provisioning(self):
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+        from karpenter_tpu.controllers.provisioning.provisioner import Provisioner
+
+        clock, store = self._env()
+        bad = pool_named("bad")
+        bad.spec.template.labels["karpenter.sh/custom"] = "x"
+        store.create(ObjectStore.NODEPOOLS, bad)
+        NodePoolValidationController(store, clock).reconcile()
+        prov = Provisioner(store, Cluster(clock), FakeCloudProvider(), clock)
+        assert prov._ready_pools() == []
+
+    def test_fixing_the_pool_restores_readiness(self):
+        clock, store = self._env()
+        pool = pool_named()
+        pool.spec.template.labels["karpenter.sh/custom"] = "x"
+        store.create(ObjectStore.NODEPOOLS, pool)
+        ctrl = NodePoolValidationController(store, clock)
+        ctrl.reconcile()
+        assert pool.conditions.is_false(CONDITION_VALIDATION_SUCCEEDED)
+        del pool.spec.template.labels["karpenter.sh/custom"]
+        store.update(ObjectStore.NODEPOOLS, pool)
+        ctrl.reconcile()
+        assert pool.conditions.is_true(CONDITION_VALIDATION_SUCCEEDED)
