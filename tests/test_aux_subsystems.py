@@ -76,6 +76,68 @@ class TestDaemonOverhead:
         assert claims
         assert claims[0].spec.requests.get("cpu", 0) < 2.0  # daemon not added
 
+    def test_per_instance_type_signature_groups(self):
+        """A nodeSelector'd daemonset charges ONLY the instance types it
+        can land on (buildDaemonOverheadGroups scheduler.go:963-1043): the
+        template splits into per-group virtual templates, so a pod placed
+        on a non-matching type is not billed the daemon's requests."""
+        from karpenter_tpu.models import labels as l
+
+        clock, store, cloud, mgr = build_env(catalog_size=16)
+        ds = DaemonSet()
+        ds.metadata.name = "amd-only-agent"
+        ds.pod_template = PodSpec(
+            requests={res.CPU: 0.5},
+            node_selector={l.LABEL_ARCH: l.ARCH_ARM64},
+        )
+        store.create(ObjectStore.DAEMONSETS, ds)
+        templates = mgr.provisioner._build_scheduler().templates
+        # the split produced one group charging the daemon (arm64 types)
+        # and one charging nothing (the rest of the catalog)
+        charged = [t for t in templates if t.daemon_requests.get(res.CPU)]
+        uncharged = [t for t in templates if not t.daemon_requests.get(res.CPU)]
+        assert charged and uncharged
+        for t in charged:
+            for it in t.instance_types:
+                assert l.ARCH_ARM64 in it.requirements.get(l.LABEL_ARCH).values
+        for t in uncharged:
+            for it in t.instance_types:
+                assert l.ARCH_ARM64 not in it.requirements.get(l.LABEL_ARCH).values
+        # an amd64-pinned pod schedules WITHOUT the daemon overhead
+        pod = make_pod("p", cpu=0.25, node_selector={l.LABEL_ARCH: l.ARCH_AMD64})
+        provision(mgr, store, cloud, [pod])
+        claims = store.nodeclaims()
+        assert claims
+        assert claims[0].spec.requests.get("cpu", 0) < 0.5 + 0.25
+
+    def test_or_term_relaxation_reaches_later_terms(self):
+        """Daemon compatibility retries dropped OR terms
+        (scheduler.go:1035-1041 removeRequiredNodeAffinityTerm): a daemon
+        whose FIRST term matches nothing but whose second matches the pool
+        still charges overhead."""
+        from karpenter_tpu.models import labels as l
+        from karpenter_tpu.models.pod import NodeAffinity, NodeSelectorTerm
+
+        clock, store, cloud, mgr = build_env(catalog_size=8)
+        ds = DaemonSet()
+        ds.metadata.name = "fallback-agent"
+        tmpl = PodSpec(requests={res.CPU: 0.5})
+        tmpl.node_affinity = NodeAffinity(
+            required=[
+                NodeSelectorTerm(
+                    match_expressions=[
+                        {"key": l.LABEL_TOPOLOGY_ZONE, "operator": "In",
+                         "values": ["zone-nowhere"]}
+                    ]
+                ),
+                NodeSelectorTerm(match_expressions=[]),  # matches anything
+            ]
+        )
+        ds.pod_template = tmpl
+        store.create(ObjectStore.DAEMONSETS, ds)
+        templates = mgr.provisioner._build_scheduler().templates
+        assert all(t.daemon_requests.get(res.CPU) == 0.5 for t in templates)
+
 
 class TestPDB:
     def test_blocked_pods(self):
