@@ -33,8 +33,11 @@ MAX_SPOT_TO_SPOT_LAUNCH_FLEXIBILITY = 15
 MULTI_NODE_CONSOLIDATION_TIMEOUT_SECONDS = 60.0
 SINGLE_NODE_CONSOLIDATION_TIMEOUT_SECONDS = 180.0
 
-# simulate(candidates) -> (SchedulingResult, unscheduled_candidate_pod_uids)
-SimulateFn = Callable[[list[Candidate]], tuple[Optional[SchedulingResult], set[str]]]
+# simulate(candidates, deadline=None) ->
+#   (SchedulingResult, unscheduled_candidate_pod_uids)
+# deadline is the calling method's (1m multi-node / 3m single-node); the
+# reference's SimulateScheduling inherits the method context the same way.
+SimulateFn = Callable[..., tuple[Optional[SchedulingResult], set[str]]]
 
 
 @dataclass
@@ -219,24 +222,10 @@ class _ConsolidationBase:
 
     # -- computeConsolidation (consolidation.go:159-343) --------------------
 
-    def _call_simulate(self, candidates: list[Candidate], deadline: Optional[float]):
-        """Pass the method deadline through when the simulate fn takes one
-        (the reference's SimulateScheduling inherits the method context)."""
-        if not hasattr(self, "_sim_takes_deadline"):
-            import inspect
-
-            params = inspect.signature(self.simulate).parameters
-            self._sim_takes_deadline = "deadline" in params or any(
-                p.kind == p.VAR_KEYWORD for p in params.values()
-            )
-        if self._sim_takes_deadline:
-            return self.simulate(candidates, deadline=deadline)
-        return self.simulate(candidates)
-
     def compute_consolidation(
         self, candidates: list[Candidate], deadline: Optional[float] = None
     ) -> Command:
-        results, unscheduled = self._call_simulate(candidates, deadline)
+        results, unscheduled = self.simulate(candidates, deadline=deadline)
         if results is None or unscheduled:
             return Command(reason=self.reason)
         if len(results.claims) == 0:

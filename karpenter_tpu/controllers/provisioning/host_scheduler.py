@@ -210,6 +210,22 @@ def pod_content_sig(pod: Pod) -> int:
     return s
 
 
+def pod_ffd_key(pod: Pod) -> tuple[int, float]:
+    """(content sig, FFD size) fused and cached together — the per-pod work
+    of the solve's hot sort loop collapses to one dict lookup on warm
+    paths (same invalidation contract as pod_content_sig: relaxation
+    copies drop the cache)."""
+    key = pod.__dict__.get("_ktpu_ffd")
+    if key is None:
+        req = pod.spec.requests
+        key = (
+            pod_content_sig(pod),
+            req.get(res.CPU, 0.0) + req.get(res.MEMORY, 0.0) / (4.0 * 2**30),
+        )
+        pod.__dict__["_ktpu_ffd"] = key
+    return key
+
+
 def ffd_sort(pods: list[Pod]) -> list[Pod]:
     """CPU+memory descending (queue.go:72-90), ties grouped by pod kind in
     first-appearance order (the reference's sort is unstable on ties, so
@@ -224,13 +240,12 @@ def ffd_sort(pods: list[Pod]) -> list[Pod]:
     ranks = np.empty(n, dtype=np.int64)
     first_rank: dict[int, int] = {}
     for i, p in enumerate(pods):
-        s = pod_content_sig(p)
+        s, size = pod_ffd_key(p)
         r = first_rank.get(s)
         if r is None:
             r = first_rank[s] = len(first_rank)
         ranks[i] = r
-        req = p.spec.requests
-        sizes[i] = req.get(res.CPU, 0.0) + req.get(res.MEMORY, 0.0) / (4.0 * 2**30)
+        sizes[i] = size
     order = np.lexsort((ranks, -sizes))
     return [pods[i] for i in order]
 

@@ -39,6 +39,7 @@ def strip_preferences(pod: Pod) -> Pod:
     constraints up front — required OR terms and tolerations untouched."""
     relaxed = copy.copy(pod)
     relaxed.__dict__.pop("_ktpu_sig", None)  # content changes: drop kind-sig cache
+    relaxed.__dict__.pop("_ktpu_ffd", None)
     relaxed.spec = copy.deepcopy(pod.spec)
     if relaxed.spec.node_affinity is not None:
         relaxed.spec.node_affinity.preferred = []
@@ -107,6 +108,7 @@ def relax_pod(pod: Pod, applied: int) -> Pod:
     steps = rungs(pod)[:applied]
     relaxed = copy.copy(pod)
     relaxed.__dict__.pop("_ktpu_sig", None)  # content changes: drop kind-sig cache
+    relaxed.__dict__.pop("_ktpu_ffd", None)
     relaxed.spec = copy.deepcopy(pod.spec)
     na = relaxed.spec.node_affinity
 

@@ -188,12 +188,11 @@ class TopologyGroup:
         return Requirement.new(self.key, Operator.IN, *options)
 
 
-def build_universe_domains(templates, existing_nodes=()) -> dict[str, set[str]]:
-    """key -> all REACHABLE domains (topology.go:105-145 buildDomainGroups):
-    template In-requirement values, plus instance-type domain values that
-    the template's requirements admit (NotIn exclusions and filtered-out
-    instance-type domains must NOT enter the universe — a permanently-zero
-    domain would pin the spread global min at 0)."""
+def template_universe_domains(templates) -> dict[str, set[str]]:
+    """The template/catalog half of the domain universe — O(templates x
+    instance-types x requirement-keys), so callers cache it per template
+    set (it is immutable for a scheduler's lifetime) and merge the
+    per-solve existing-node half on top."""
     domains: dict[str, set[str]] = defaultdict(set)
     for t in templates:
         for r in t.requirements:
@@ -205,11 +204,26 @@ def build_universe_domains(templates, existing_nodes=()) -> dict[str, set[str]]:
                     continue
                 tmpl_req = t.requirements.get(r.key)
                 domains[r.key].update(v for v in r.values if tmpl_req.has(v))
+    return dict(domains)
+
+
+def build_universe_domains(
+    templates, existing_nodes=(), template_base: "dict | None" = None
+) -> dict[str, set[str]]:
+    """key -> all REACHABLE domains (topology.go:105-145 buildDomainGroups):
+    template In-requirement values, plus instance-type domain values that
+    the template's requirements admit (NotIn exclusions and filtered-out
+    instance-type domains must NOT enter the universe — a permanently-zero
+    domain would pin the spread global min at 0). template_base: a cached
+    template_universe_domains(templates) result to skip the catalog scan."""
+    if template_base is None:
+        template_base = template_universe_domains(templates)
+    domains: dict[str, set[str]] = {k: set(v) for k, v in template_base.items()}
     for n in existing_nodes:
         for r in n.requirements:
             if r.operator() is Operator.IN:
-                domains[r.key].update(r.values)
-    return dict(domains)
+                domains.setdefault(r.key, set()).update(r.values)
+    return domains
 
 
 class Topology:
