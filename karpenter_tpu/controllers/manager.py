@@ -57,6 +57,7 @@ class Manager:
             dynamic_resources_enabled=self.options.feature_gates.dynamic_resources,
             solve_timeout_seconds=self.options.solve_timeout_seconds,
             solver_endpoint=self.options.solver_endpoint,
+            mesh_devices=self.options.mesh_devices,
         )
         self.device_allocation = None
         if self.options.feature_gates.dynamic_resources:

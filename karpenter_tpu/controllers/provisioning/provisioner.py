@@ -45,6 +45,7 @@ class Provisioner:
         dynamic_resources_enabled: bool = False,
         solve_timeout_seconds: float = 60.0,
         solver_endpoint: str = "",
+        mesh_devices: int = 0,
     ):
         self.store = store
         self.cluster = cluster
@@ -60,6 +61,7 @@ class Provisioner:
         # Remote solver service address (rpc/client.RemoteScheduler);
         # empty = in-process TPUScheduler
         self.solver_endpoint = solver_endpoint
+        self.mesh_devices = mesh_devices  # 0 = single device
         # DeviceAllocationController; wired by the manager when DRA is on
         self.device_allocation = None
         self._scheduler_cache: Optional[tuple[tuple, TPUScheduler]] = None
@@ -534,10 +536,16 @@ class Provisioner:
                 min_values_policy=self.min_values_policy,
             )
         else:
+            mesh = None
+            if self.mesh_devices:
+                from karpenter_tpu.parallel import make_mesh
+
+                mesh = make_mesh(self.mesh_devices)
             sched = TPUScheduler(
                 templates,
                 reserved_capacity_enabled=self.reserved_capacity_enabled,
                 min_values_policy=self.min_values_policy,
+                mesh=mesh,
             )
         # close the REPLACED RemoteScheduler's channel only after the new
         # scheduler is successfully built — a failed rebuild must not leave

@@ -138,10 +138,16 @@ class TPUScheduler:
         reserved_mode: str = "fallback",
         reserved_capacity_enabled: bool = True,
         min_values_policy: str = "Strict",
+        mesh=None,
     ):
         from karpenter_tpu.utils.accel import enable_persistent_compile_cache
 
         enable_persistent_compile_cache()  # restarts skip the cold compile
+        # Multi-chip: a jax.sharding.Mesh with an "it" axis shards the
+        # catalog (and every [.., T] mask) across devices; GSPMD inserts
+        # the ICI collectives inside the same solve kernels the
+        # single-device path compiles (SURVEY §2.9). None = single device.
+        self.mesh = mesh
         self.reserved_mode = reserved_mode
         self.reserved_capacity_enabled = reserved_capacity_enabled
         self.min_values_policy = min_values_policy
@@ -203,13 +209,19 @@ class TPUScheduler:
                 enc.vocab, [it.requirements for it in self.catalog], k_pad, v_pad, enc.skip_keys
             )
         )
+        if self.mesh is not None:
+            # shard the catalog over the mesh's "it" axis; padded types are
+            # invalid/match-nothing so results stay bit-identical
+            from karpenter_tpu.parallel.mesh import shard_instance_types
+
+            itt = shard_instance_types(itt, self.mesh)
         self.it_tensors = itt
-        T = len(self.catalog)
+        self._T_pad = int(itt.alloc.shape[0])
         G = len(self.templates)
         tmpl_reqs = encode_requirements(
             enc.vocab, [t.requirements for t in self.templates], k_pad, v_pad, enc.skip_keys
         )
-        its = np.zeros((G, T), dtype=bool)
+        its = np.zeros((G, self._T_pad), dtype=bool)
         daemon = np.zeros((G, enc.n_resources), dtype=np.float32)
         for g, t in enumerate(self.templates):
             for it in t.instance_types:
@@ -242,7 +254,7 @@ class TPUScheduler:
                 mv_key[g, m] = k
                 mv_min[g, m] = v
         J = max(len(mv_keys_named), 1)
-        mv_it_values = np.zeros((T, J, v_pad), dtype=bool)
+        mv_it_values = np.zeros((self._T_pad, J, v_pad), dtype=bool)
         for j, key_name in enumerate(mv_keys_named):
             kid = enc.vocab.key_to_id.get(key_name)
             if kid is None:
@@ -793,6 +805,12 @@ class TPUScheduler:
             self.encoder.vocab, rep_req_sets, k_pad, v_pad, self.encoder.skip_keys
         )
         it_allow_k = self.encoder.it_allow_mask(rep_req_sets, self.catalog)
+        if it_allow_k.shape[1] != self._T_pad:  # sharded catalog padding
+            it_allow_k = np.pad(
+                it_allow_k,
+                ((0, 0), (0, self._T_pad - it_allow_k.shape[1])),
+                constant_values=False,
+            )
         # hostname selectors can never match a not-yet-named new node
         for u, rq in enumerate(rep_req_sets):
             if not self.encoder.hostname_allows(rq, None):
@@ -992,6 +1010,11 @@ class TPUScheduler:
             else jax.profiler.TraceAnnotation("ktpu_solve")
         )
         with ctx:
+            if self.mesh is not None:
+                # GSPMD propagates the catalog's "it" sharding through the
+                # same jitted kernels; collectives ride ICI (SURVEY §2.9)
+                with self.mesh:
+                    return self._run_solve_inner(enc)
             return self._run_solve_inner(enc)
 
     def _run_solve_inner(self, enc: dict):
@@ -1372,7 +1395,11 @@ class TPUScheduler:
             # viable instance types straight from the device solver state
             # (the device carried budget bookkeeping too); TEMPLATE catalog
             # order so cheapest_launch tie-breaks identically to the host
-            viable = {self.catalog[t].name for t in np.nonzero(its_mask[s])[0]}
+            viable = {
+                self.catalog[t].name
+                for t in np.nonzero(its_mask[s])[0]
+                if t < len(self.catalog)  # sharded-catalog padding is never viable
+            }
             claim.instance_types = [
                 it for it in claim.template.instance_types if it.name in viable
             ]

@@ -13,6 +13,7 @@ Run standalone:  python -m karpenter_tpu.rpc.service --port 18632
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from concurrent import futures
@@ -46,6 +47,14 @@ class SolverService:
         from karpenter_tpu.controllers.provisioning.scheduler import TPUScheduler
 
         templates = decode_templates(request.templates_json)
+        mesh = None
+        mesh_devices = int(os.environ.get("KTPU_MESH_DEVICES", "0"))
+        if mesh_devices:
+            # the solver process owns the accelerators; its mesh size is a
+            # deployment property (env), not a per-client setting
+            from karpenter_tpu.parallel import make_mesh
+
+            mesh = make_mesh(mesh_devices)
         sched = TPUScheduler(
             templates,
             max_claims=request.max_claims if request.HasField("max_claims") else None,
@@ -53,6 +62,7 @@ class SolverService:
             reserved_mode=request.reserved_mode or "fallback",
             reserved_capacity_enabled=request.reserved_capacity_enabled,
             min_values_policy=request.min_values_policy or "Strict",
+            mesh=mesh,
         )
         with self._lock:
             self._version += 1

@@ -57,6 +57,9 @@ class Options:
     # host:port of a remote solver service (rpc/service.py); empty = solve
     # in-process. The control/solver split of SURVEY.md §2.9.
     solver_endpoint: str = ""
+    # devices for the solver's (dp x it) mesh; 0 = single device. The
+    # catalog shards over "it" and GSPMD rides ICI (SURVEY §2.9).
+    mesh_devices: int = 0
     # operator runtime (operator.go:126-243): 0 disables the probe server;
     # -1 binds an ephemeral port (tests read Operator.health_port back)
     health_probe_port: int = 0
@@ -78,6 +81,8 @@ class Options:
             opts.min_values_policy = env[prefix + "MIN_VALUES_POLICY"]
         if prefix + "SOLVER_ENDPOINT" in env:
             opts.solver_endpoint = env[prefix + "SOLVER_ENDPOINT"]
+        if prefix + "MESH_DEVICES" in env:
+            opts.mesh_devices = int(env[prefix + "MESH_DEVICES"])
         if prefix + "HEALTH_PROBE_PORT" in env:
             opts.health_probe_port = int(env[prefix + "HEALTH_PROBE_PORT"])
         if prefix + "ENABLE_PROFILING" in env:

@@ -86,3 +86,70 @@ def test_dryrun_entry():
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
+
+
+class TestProductionMeshPath:
+    """VERDICT r3 #7: the MAIN TPUScheduler.solve shards over the mesh —
+    not a parallel twin. Bit-parity with the single-device solve on the
+    reference workload mix, through the full encode/dispatch/decode."""
+
+    def _mixed_pods(self, n=64):
+        from karpenter_tpu.models import labels as l
+        from karpenter_tpu.models.pod import (
+            PodAffinityTerm,
+            TopologySpreadConstraint,
+            make_pod,
+        )
+
+        rng = np.random.default_rng(1)
+        pods = []
+        for i in range(n):
+            p = make_pod(
+                f"p-{i}",
+                cpu=float(rng.choice([0.25, 0.5, 1.0])),
+                memory=f"{rng.choice([0.5, 1.0])}Gi",
+            )
+            if i % 4 == 1:
+                p.metadata.labels = {"spread": "zonal"}
+                p.spec.topology_spread_constraints = [
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=l.LABEL_TOPOLOGY_ZONE,
+                        label_selector={"spread": "zonal"},
+                    )
+                ]
+            elif i % 4 == 2:
+                p.metadata.labels = {"app": "web"}
+                p.spec.pod_anti_affinity = [
+                    PodAffinityTerm(
+                        topology_key=l.LABEL_HOSTNAME, label_selector={"app": "web"}
+                    )
+                ]
+            pods.append(p)
+        return pods
+
+    def test_scheduler_mesh_bit_parity(self):
+        from karpenter_tpu.cloudprovider.fake import instance_types
+        from karpenter_tpu.controllers.provisioning import (
+            TPUScheduler,
+            build_templates,
+        )
+        from karpenter_tpu.models.nodepool import NodePool
+
+        pool = NodePool()
+        pool.metadata.name = "default"
+        templates = build_templates([(pool, instance_types(50))])
+        pods = self._mixed_pods()
+        single = TPUScheduler(templates).solve(pods)
+        meshed = TPUScheduler(templates, mesh=make_mesh(8)).solve(pods)
+        assert not meshed.unschedulable
+        assert meshed.assignments == single.assignments
+        assert meshed.existing_assignments == single.existing_assignments
+        assert len(meshed.claims) == len(single.claims)
+        assert abs(meshed.total_price() - single.total_price()) < 1e-9
+        for a, b in zip(meshed.claims, single.claims):
+            assert [it.name for it in a.instance_types] == [
+                it.name for it in b.instance_types
+            ]
+            assert a.used == b.used
+            assert str(a.requirements) == str(b.requirements)
