@@ -66,7 +66,7 @@ def _next_pow2(n: int, floor: int = 8) -> int:
 
 def _gather_pod_chunk(
     reqs_k, strict_k, requests_k, tol_k, it_allow_k, exist_ok_k, ports_k,
-    conf_k, pod_topo_k, kid, n_valid,
+    conf_k, vols_k, pod_topo_k, kid, n_valid,
 ):
     """One fused device dispatch for a per-pod chunk's kind->pod gathers.
 
@@ -84,13 +84,13 @@ def _gather_pod_chunk(
     ptopo = topo_ops.take_pod_topology(pod_topo_k, kid)
     return (
         pt, tol_k[kid], it_allow_k[kid], exist_ok_k[kid], ports_k[kid],
-        conf_k[kid], ptopo,
+        conf_k[kid], vols_k[kid], ptopo,
     )
 
 
 def _gather_fill_xs(
     reqs_k, requests_k, tol_k, it_allow_k, exist_ok_k, ports_k, conf_k,
-    pod_topo_k, kid, counts,
+    vols_k, pod_topo_k, kid, counts,
 ):
     """Fused gather building FillXs for a batchable segment run."""
     from karpenter_tpu.ops.kernels import take_set
@@ -104,6 +104,7 @@ def _gather_fill_xs(
         exist_ok=exist_ok_k[kid],
         ports=ports_k[kid],
         port_conf=conf_k[kid],
+        vols=vols_k[kid],
         count=counts,
         hg_applies=ptopo.hg_applies,
         hg_records=ptopo.hg_records,
@@ -168,6 +169,7 @@ class TPUScheduler:
 
         self.solve_chunk = int(os.environ.get("KTPU_SOLVE_CHUNK", "2048"))
         self._volume_reqs: dict = {}
+        self._pod_vols: dict = {}
         self._reserved_in_use: dict[str, int] = {}
 
         self.encoder = ProblemEncoder()
@@ -338,6 +340,10 @@ class TPUScheduler:
                 + [False] * (e_pad - len(self.existing_nodes))
             ),
             ports=jnp.zeros((e_pad, 1), dtype=bool),  # re-filled per solve
+            # inert defaults; _encode replaces them when CSI limits bind
+            vols=jnp.zeros((e_pad, 1), dtype=bool),
+            vol_limits=jnp.full((e_pad, 1), np.inf, dtype=jnp.float32),
+            vol_driver=jnp.zeros((1, 1), dtype=bool),
         )
 
     # -- solving -----------------------------------------------------------
@@ -411,13 +417,6 @@ class TPUScheduler:
             # try-each-alternative loop (nodeclaim.go:149-161); the device
             # kernel folds exactly one restriction per pod
             return host_solve("volume_alternatives")
-        if pod_volumes and any(
-            n.volume_usage is not None and n.volume_usage.limits
-            for n in (existing_nodes or [])
-        ):
-            # CSI attach limits count DISTINCT pvc ids across co-resident
-            # pods (volumeusage.go:201-208) — host-exact for now
-            return host_solve("volume_limits")
         if norm_vol and existing_nodes:
             # the host checks volume requirements against existing nodes
             # with well-known-label leniency (existingnode.go:150); the
@@ -434,6 +433,9 @@ class TPUScheduler:
 
         base_existing = list(existing_nodes or [])
         self._volume_reqs = norm_vol
+        # CSI attach limits ride the device scan (distinct-PVC popcounts
+        # over a (driver, pvc) column vocabulary — volumeusage.go:201-208)
+        self._pod_vols = pod_volumes or {}
         self._reserved_in_use = reserved_in_use or {}
 
         def solve_round(current: list[Pod]) -> SchedulingResult:
@@ -565,6 +567,7 @@ class TPUScheduler:
         import numpy as _np
 
         self._volume_reqs = normalize_volume_reqs(volume_reqs)
+        self._pod_vols = {}  # what-ifs with CSI limits are declined below
         if any(len(alts) > 1 for alts in self._volume_reqs.values()):
             # multi-alternative volume topologies need the host's
             # try-each loop — decline, callers simulate sequentially
@@ -597,7 +600,7 @@ class TPUScheduler:
         P_pad = _next_pow2(max(P, 1), 1)
         kidx = _np.zeros(P_pad, dtype=_np.int64)
         kidx[:P] = enc["kind_of"][:P]
-        pt, tol, it_allow, exist_ok, pod_ports, pod_port_conf, pod_topo = (
+        pt, tol, it_allow, exist_ok, pod_ports, pod_port_conf, _pod_vols, pod_topo = (
             self._materialize_pods(enc, kidx, P)
         )
         base_valid = _np.asarray(pt.valid)
@@ -896,6 +899,97 @@ class TPUScheduler:
                 exist_ports0[e, port_index[key]] = True
         exist_tensors = exist_tensors._replace(ports=jnp.asarray(exist_ports0))
 
+        # ---- CSI attach limits (volumeusage.go:187-229) --------------------
+        # A (driver, pvc) column vocabulary shared by node usage and pod
+        # volumes; distinct-PVC counting is a per-driver popcount over the
+        # union mask. Active only when some node publishes limits AND some
+        # pod carries volumes — otherwise the inert 1x1 tensors keep the
+        # common hot path's compile shapes unchanged.
+        limited = any(
+            n.volume_usage is not None and n.volume_usage.limits
+            for n in self.existing_nodes
+        )
+        pod_vols_map = self._pod_vols if limited else {}
+        if pod_vols_map and any(pod_vols_map.get(p.uid) for p in reps):
+            # only drivers SOME node caps get columns: unlimited drivers
+            # always compare against +inf, so their PVCs would inflate NV
+            # (and the per-step [E,NV]x[NV,ND] einsum) for nothing
+            limited_drivers = {
+                driver
+                for n in self.existing_nodes
+                if n.volume_usage is not None
+                for driver in n.volume_usage.limits
+            }
+            col_index: dict[tuple, int] = {}
+            drv_index: dict[str, int] = {}
+
+            def vol_col(driver: str, pvc: str) -> "int | None":
+                if driver not in limited_drivers:
+                    return None
+                drv_index.setdefault(driver, len(drv_index))
+                return col_index.setdefault((driver, pvc), len(col_index))
+
+            for n in self.existing_nodes:
+                vu = n.volume_usage
+                if vu is None:
+                    continue
+                for driver in vu.limits:
+                    drv_index.setdefault(driver, len(drv_index))
+                for vols in vu.pod_volumes.values():
+                    for driver, pvcs in vols.items():
+                        for pvc in pvcs:
+                            vol_col(driver, pvc)
+            for p in reps:
+                for driver, pvcs in (pod_vols_map.get(p.uid) or {}).items():
+                    for pvc in pvcs:
+                        vol_col(driver, pvc)
+            # one extra MARKER column (no driver: contributes to no count)
+            # flags "this pod carries volumes" even when they all belong to
+            # unlimited drivers — the host rejects ANY volume-carrying pod
+            # on a node over a shrunk cap (exceedsLimits unions the node's
+            # resident volumes), so the check must RUN for those pods
+            marker = len(col_index)
+            NV = _next_pow2(max(len(col_index) + 1, 1), 1)
+            ND = _next_pow2(max(len(drv_index), 1), 1)
+            vol_driver0 = np.zeros((NV, ND), dtype=bool)
+            for (driver, _pvc), c in col_index.items():
+                vol_driver0[c, drv_index[driver]] = True
+            exist_vols0 = np.zeros((E, NV), dtype=bool)
+            vol_limits0 = np.full((E, ND), np.inf, dtype=np.float32)
+            for e, n in enumerate(self.existing_nodes):
+                vu = n.volume_usage
+                if vu is None:
+                    continue
+                for driver, cap in vu.limits.items():
+                    vol_limits0[e, drv_index[driver]] = float(cap)
+                for vols in vu.pod_volumes.values():
+                    for driver, pvcs in vols.items():
+                        for pvc in pvcs:
+                            c = col_index.get((driver, pvc))
+                            if c is not None:
+                                exist_vols0[e, c] = True
+            pod_vols_k = np.zeros((U, NV), dtype=bool)
+            for u, p in enumerate(reps):
+                vols = pod_vols_map.get(p.uid)
+                if vols:
+                    pod_vols_k[u, marker] = True
+                for driver, pvcs in (vols or {}).items():
+                    for pvc in pvcs:
+                        c = col_index.get((driver, pvc))
+                        if c is not None:
+                            pod_vols_k[u, c] = True
+        else:
+            NV, ND = 1, 1
+            vol_driver0 = np.zeros((1, 1), dtype=bool)
+            exist_vols0 = np.zeros((E, 1), dtype=bool)
+            vol_limits0 = np.full((E, 1), np.inf, dtype=np.float32)
+            pod_vols_k = np.zeros((U, 1), dtype=bool)
+        exist_tensors = exist_tensors._replace(
+            vols=jnp.asarray(exist_vols0),
+            vol_limits=jnp.asarray(vol_limits0),
+            vol_driver=jnp.asarray(vol_driver0),
+        )
+
         zone_kid, ct_kid = self.encoder.zone_ct_key_ids()
         # static set of vocab keys topology groups narrow — the solver
         # handles these with exact per-key corrections so topology-mixed
@@ -959,6 +1053,7 @@ class TPUScheduler:
             exist_ok_k=jnp.asarray(exist_ok_k),
             ports_k=jnp.asarray(pod_ports_k),
             conf_k=jnp.asarray(pod_port_conf_k),
+            vols_k=jnp.asarray(pod_vols_k),
             pod_topo_k=pod_topo_k,
             kind_of=kind_of,
             segments=segments,
@@ -986,7 +1081,7 @@ class TPUScheduler:
         return _gather_pod_chunk(
             enc["reqs_k"], enc["strict_k"], enc["requests_k"], enc["tol_k"],
             enc["it_allow_k"], enc["exist_ok_k"], enc["ports_k"], enc["conf_k"],
-            enc["pod_topo_k"], jnp.asarray(kind_idx), n_valid,
+            enc["vols_k"], enc["pod_topo_k"], jnp.asarray(kind_idx), n_valid,
         )
 
     def _run_solve(self, enc: dict):
@@ -1063,8 +1158,8 @@ class TPUScheduler:
                 xs = _gather_fill_xs(
                     enc["reqs_k"], enc["requests_k"], enc["tol_k"],
                     enc["it_allow_k"], enc["exist_ok_k"], enc["ports_k"],
-                    enc["conf_k"], enc["pod_topo_k"], jnp.asarray(kind_ids),
-                    jnp.asarray(counts),
+                    enc["conf_k"], enc["vols_k"], enc["pod_topo_k"],
+                    jnp.asarray(kind_ids), jnp.asarray(counts),
                 )
                 state, ys = ops_solver.solve_fill(
                     state, xs, exist_tensors, self.it_tensors, template_tensors,
@@ -1080,11 +1175,11 @@ class TPUScheduler:
                     L_pad = _next_pow2(L, 8)
                     kidx = np.zeros(L_pad, dtype=np.int64)
                     kidx[:L] = kind_of[clo : clo + L]
-                    pt, tol, it_allow, exist_ok, ports, conf, ptopo = (
+                    pt, tol, it_allow, exist_ok, ports, conf, vols, ptopo = (
                         self._materialize_pods(enc, kidx, L)
                     )
                     res = ops_solver.solve_from(
-                        state, pt, tol, it_allow, exist_ok, ports, conf,
+                        state, pt, tol, it_allow, exist_ok, ports, conf, vols,
                         exist_tensors, self.it_tensors, template_tensors,
                         self.well_known, topo_tensors, ptopo, **common,
                     )
@@ -1139,26 +1234,34 @@ class TPUScheduler:
         # the bytes on the wire vs fetching the whole SolverState.
         n_open_i = int(np.asarray(state.n_open))
         S = min(enc["n_claims"], max(256, -(-n_open_i // 256) * 256))
-        fetched = fetch_tree(
-            dict(
-                template=state.template[:S],
-                its=state.its[:S],
-                used=state.used[:S],
-                held=state.held[:S],
-                c_mask=state.reqs.mask[:S],
-                c_inf=state.reqs.inf[:S],
-                c_def=state.reqs.defined[:S],
-                e_mask=state.exist_reqs.mask,
-                e_inf=state.exist_reqs.inf,
-                e_def=state.exist_reqs.defined,
-                outputs=[
-                    o
-                    if o[0] == "pods"
-                    else (o[0], o[1], o[2]._replace(fill_c=o[2].fill_c[:, :S]))
-                    for o in outputs
-                ],
-            )
+        to_fetch = dict(
+            template=state.template[:S],
+            its=state.its[:S],
+            used=state.used[:S],
+            held=state.held[:S],
+            outputs=[
+                o
+                if o[0] == "pods"
+                else (o[0], o[1], o[2]._replace(fill_c=o[2].fill_c[:, :S]))
+                for o in outputs
+            ],
         )
+        # requirement masks are read ONLY for vg-topology narrowing
+        # (fold_narrowing), and only at the topology keys' rows — gather
+        # those rows on device (K_pad -> len(topo_kids)) or skip the
+        # fetch entirely for topology-free problems. At the north star
+        # this removes the single largest wire payload (~[S, K, V] bool).
+        tk = list(enc["topo_kids"])
+        if tk:
+            to_fetch.update(
+                c_mask=state.reqs.mask[:S][:, tk, :],
+                c_inf=state.reqs.inf[:S][:, tk],
+                c_def=state.reqs.defined[:S][:, tk],
+                e_mask=state.exist_reqs.mask[:, tk, :],
+                e_inf=state.exist_reqs.inf[:, tk],
+                e_def=state.exist_reqs.defined[:, tk],
+            )
+        fetched = fetch_tree(to_fetch)
         outputs = fetched["outputs"]
         E = enc["E"]
         kind_of = enc["kind_of"]
@@ -1355,18 +1458,19 @@ class TPUScheduler:
         def fold_narrowing(reqs: Requirements, mask_r, inf_r, def_r, what: str):
             """Intersect the device's vg-topology narrowing into host reqs.
 
-            For a key the device never narrowed, the mask equals the
-            host-side intersection already rebuilt from template+kind reqs,
-            so the extra add is an exact no-op; for a narrowed key it lands
-            precisely on the device-chosen domain set."""
-            for kid in topo_kids:
-                if not def_r[kid] or inf_r[kid]:
+            Rows are PRE-GATHERED to the topo_kids axis (row j = key
+            topo_kids[j]). For a key the device never narrowed, the mask
+            equals the host-side intersection already rebuilt from
+            template+kind reqs, so the extra add is an exact no-op; for a
+            narrowed key it lands precisely on the device-chosen set."""
+            for j, kid in enumerate(topo_kids):
+                if not def_r[j] or inf_r[j]:
                     continue
                 key = vocab.keys[kid]
                 vals = [
                     v
                     for vi, v in enumerate(vocab.values[kid])
-                    if mask_r[kid, vi]
+                    if mask_r[j, vi]
                 ]
                 if not vals:
                     raise DivergenceError(
@@ -1377,7 +1481,6 @@ class TPUScheduler:
         its_mask = fetched["its"]
         held = fetched["held"]
         used_np = fetched["used"]
-        c_mask, c_inf, c_def = fetched["c_mask"], fetched["c_inf"], fetched["c_def"]
         rids = self.encoder._resource_ids
         for claim in claims:
             s = claim.slot
@@ -1385,7 +1488,14 @@ class TPUScheduler:
             reqs = claim.requirements
             for k in kinds:
                 reqs.add(*kind_reqs(k).values())
-            fold_narrowing(reqs, c_mask[s], c_inf[s], c_def[s], f"claim slot {s}")
+            if topo_kids:
+                fold_narrowing(
+                    reqs,
+                    fetched["c_mask"][s],
+                    fetched["c_inf"][s],
+                    fetched["c_def"][s],
+                    f"claim slot {s}",
+                )
             # usage from the device carry (daemon overhead folded in on open)
             keys = set(claim.template.daemon_requests)
             for k in kinds:
@@ -1413,15 +1523,26 @@ class TPUScheduler:
             if self.min_values_policy == "BestEffort":
                 finalize_min_values(claim)
 
-        e_mask, e_inf, e_def = fetched["e_mask"], fetched["e_inf"], fetched["e_def"]
         for e, kinds in node_kinds.items():
             node = self.existing_nodes[e]
             for k in kinds:
                 node.requirements.add(*kind_reqs(k).values())
-            fold_narrowing(
-                node.requirements, e_mask[e], e_inf[e], e_def[e],
-                f"existing node {node.name}",
-            )
+            if topo_kids:
+                fold_narrowing(
+                    node.requirements,
+                    fetched["e_mask"][e],
+                    fetched["e_inf"][e],
+                    fetched["e_def"][e],
+                    f"existing node {node.name}",
+                )
+        # attach-tracking parity with the host oracle's can_add_existing
+        if self._pod_vols:
+            by_name = {n.name: n for n in self.existing_nodes}
+            for uid, node_name in existing_assignments.items():
+                vols = self._pod_vols.get(uid)
+                node = by_name.get(node_name)
+                if vols and node is not None and node.volume_usage is not None:
+                    node.volume_usage.add(uid, vols)
 
         return SchedulingResult(
             claims=claims,

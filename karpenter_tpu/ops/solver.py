@@ -77,6 +77,12 @@ class ExistingNodes(NamedTuple):
     avail: jnp.ndarray  # [E, R] f32 — remaining schedulable resources
     valid: jnp.ndarray  # [E] bool
     ports: jnp.ndarray  # [E, NP] bool — host ports already in use
+    # CSI attach limits (volumeusage.go:201-208): distinct-PVC columns over
+    # a (driver, pvc) vocabulary; resident volumes seed vols, per-driver
+    # limits are +inf when the node publishes none
+    vols: jnp.ndarray  # [E, NV] bool — PVCs already attached
+    vol_limits: jnp.ndarray  # [E, ND] f32 — per-driver attach caps
+    vol_driver: jnp.ndarray  # [NV, ND] bool — column -> driver one-hot
 
 
 class SolverState(NamedTuple):
@@ -102,6 +108,9 @@ class SolverState(NamedTuple):
     # host ports in use (hostportusage.go:35-97)
     exist_ports: jnp.ndarray  # [E, NP] bool
     claim_ports: jnp.ndarray  # [N, NP] bool
+    # distinct PVCs attached per existing node (volumeusage.go:187-229);
+    # claims have no CSINode, so no claim-side twin exists
+    exist_vols: jnp.ndarray  # [E, NV] bool
     # reserved-capacity twin (reservationmanager.go:28-115)
     res_cap: jnp.ndarray  # [RID] i32 — remaining capacity per reservation id
     held: jnp.ndarray  # [N, RID] bool — reservations each claim holds
@@ -250,6 +259,7 @@ def _make_step(
             exist_ok_e,
             ports_p,
             port_conf_p,
+            vols_p,
             pod_valid,
             vg_applies,
             vg_records,
@@ -278,6 +288,19 @@ def _make_step(
             topo, state.hg_counts, jnp.arange(E, dtype=jnp.int32), hg_applies, hg_self
         )
         ports_ok_e = ~jnp.any(port_conf_p[None, :] & state.exist_ports, axis=-1)  # [E]
+        # CSI attach limits: distinct PVCs per driver after the add must
+        # stay within each node's published caps (volumeusage.go:201-208)
+        newv_e = state.exist_vols | vols_p[None, :]  # [E, NV]
+        vcount_e = jnp.einsum(
+            "ev,vd->ed",
+            newv_e.astype(jnp.bfloat16),
+            exist.vol_driver.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        # volume-free pods skip the check entirely (the host gates on
+        # `if pod_vols` — a node already OVER a shrunk cap still takes
+        # podless-volume adds, volumeusage.go exceedsLimits call sites)
+        vols_ok_e = jnp.all(vcount_e <= exist.vol_limits, axis=-1) | ~jnp.any(vols_p)
         feas_e = (
             exist.valid
             & exist_ok_e
@@ -286,6 +309,7 @@ def _make_step(
             & topo_e
             & topo_eh
             & ports_ok_e
+            & vols_ok_e
             & pod_valid
         )
         pick_e = jnp.argmin(jnp.where(feas_e, jnp.arange(E, dtype=jnp.int32), BIG))
@@ -473,6 +497,11 @@ def _make_step(
             state.exist_ports.at[pick_e].set(state.exist_ports[pick_e] | ports_p),
             state.exist_ports,
         )
+        new_exist_vols = jnp.where(
+            upd_exist,
+            state.exist_vols.at[pick_e].set(state.exist_vols[pick_e] | vols_p),
+            state.exist_vols,
+        )
 
         # claim updates (tier 2 or 3)
         upd_claim = (found | can_open) & ~found_e
@@ -567,6 +596,7 @@ def _make_step(
                 hg_counts=new_hg_counts,
                 exist_ports=new_exist_ports,
                 claim_ports=new_claim_ports,
+                exist_vols=new_exist_vols,
                 res_cap=new_res_cap,
                 held=new_held,
             ),
@@ -608,6 +638,7 @@ def initial_state(
         hg_counts=topo.hg_counts0,
         exist_ports=exist.ports,
         claim_ports=jnp.zeros((N, n_ports), dtype=bool),
+        exist_vols=exist.vols,
         res_cap=(
             jnp.asarray(res_cap0, dtype=jnp.int32)
             if res_cap0 is not None
@@ -617,7 +648,10 @@ def initial_state(
     )
 
 
-def _xs(pods, pod_tmpl_ok, pod_it_allow, pod_exist_ok, pod_ports, pod_port_conf, pod_topo):
+def _xs(
+    pods, pod_tmpl_ok, pod_it_allow, pod_exist_ok, pod_ports, pod_port_conf,
+    pod_topo, pod_vols,
+):
     return (
         pods.reqs,
         pods.requests,
@@ -626,6 +660,7 @@ def _xs(pods, pod_tmpl_ok, pod_it_allow, pod_exist_ok, pod_ports, pod_port_conf,
         pod_exist_ok,
         pod_ports,
         pod_port_conf,
+        pod_vols,
         pods.valid,
         pod_topo.vg_applies,
         pod_topo.vg_records,
@@ -658,6 +693,7 @@ def solve(
     pod_exist_ok: jnp.ndarray,  # [P, E] bool — static checks vs existing nodes
     pod_ports: jnp.ndarray,  # [P, NP] bool — the pod's own host-port keys
     pod_port_conf: jnp.ndarray,  # [P, NP] bool — keys the pod CONFLICTS with (wildcard-expanded)
+    pod_vols: jnp.ndarray,  # [P, NV] bool — the pod's distinct (driver, pvc) columns
     exist: ExistingNodes,
     it: InstanceTypeTensors,
     templates: Templates,
@@ -682,7 +718,10 @@ def solve(
         exist, it, templates, well_known, topo, zone_kid, ct_kid, n_claims,
         mv_active, topo_kids, rid_kid, res_vid, res_active, res_strict,
     )
-    xs = _xs(pods, pod_tmpl_ok, pod_it_allow, pod_exist_ok, pod_ports, pod_port_conf, pod_topo)
+    xs = _xs(
+        pods, pod_tmpl_ok, pod_it_allow, pod_exist_ok, pod_ports,
+        pod_port_conf, pod_topo, pod_vols,
+    )
     state, assignment = jax.lax.scan(step, state, xs)
     return SolveResult(assignment=assignment, claims=state)
 
@@ -696,6 +735,7 @@ def solve_from(
     pod_exist_ok: jnp.ndarray,
     pod_ports: jnp.ndarray,
     pod_port_conf: jnp.ndarray,
+    pod_vols: jnp.ndarray,
     exist: ExistingNodes,
     it: InstanceTypeTensors,
     templates: Templates,
@@ -720,7 +760,10 @@ def solve_from(
         exist, it, templates, well_known, topo, zone_kid, ct_kid, n_claims,
         mv_active, topo_kids, rid_kid, res_vid, res_active, res_strict,
     )
-    xs = _xs(pods, pod_tmpl_ok, pod_it_allow, pod_exist_ok, pod_ports, pod_port_conf, pod_topo)
+    xs = _xs(
+        pods, pod_tmpl_ok, pod_it_allow, pod_exist_ok, pod_ports,
+        pod_port_conf, pod_topo, pod_vols,
+    )
     state, assignment = jax.lax.scan(step, state, xs)
     return SolveResult(assignment=assignment, claims=state)
 
@@ -792,6 +835,9 @@ def solve_whatif(
             pod_ports[idx],
             pod_port_conf[idx],
             topo_ops.take_pod_topology(pod_topo, idx),
+            # what-ifs with CSI limits are declined upstream
+            # (whatif_batch gate), so vols are inert zeros here
+            jnp.zeros((idx.shape[0], exist.vols.shape[1]), dtype=bool),
         )
         state, assignment = jax.lax.scan(step, state, xs)
         n_unsched = jnp.sum(count & valid & (assignment < 0)).astype(jnp.int32)
@@ -1041,6 +1087,10 @@ class FillXs(NamedTuple):
     exist_ok: jnp.ndarray  # [B, E]
     ports: jnp.ndarray  # [B, NP]
     port_conf: jnp.ndarray  # [B, NP]
+    # distinct (driver, pvc) columns — IDENTICAL for every pod of a kind
+    # (same pvc_names -> same PVCs), so a batch of c pods attaches the
+    # set once: the check is count-independent
+    vols: jnp.ndarray  # [B, NV]
     count: jnp.ndarray  # [B] i32 — pods of this kind (0 = padding row)
     hg_applies: jnp.ndarray  # [B, NGh]
     hg_records: jnp.ndarray  # [B, NGh]
@@ -1101,7 +1151,19 @@ def _make_fill_step(
         )
         cap_e = jnp.minimum(cap_res_e, cap_topo_e)
         cap_e = jnp.where(self_conf, jnp.minimum(cap_e, 1), cap_e)
-        feas_e = exist.valid & xs.exist_ok & compat_e & ports_ok_e
+        # CSI attach limits: a kind's pods share one PVC set, so the check
+        # is count-independent — the node admits the kind iff the union
+        # stays within every driver cap (volumeusage.go:201-208)
+        newv_e = state.exist_vols | xs.vols[None, :]
+        vcount_e = jnp.einsum(
+            "ev,vd->ed",
+            newv_e.astype(jnp.bfloat16),
+            exist.vol_driver.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        # volume-free kinds skip the check (host parity — see per-pod step)
+        vols_ok_e = jnp.all(vcount_e <= exist.vol_limits, axis=-1) | ~jnp.any(xs.vols)
+        feas_e = exist.valid & xs.exist_ok & compat_e & ports_ok_e & vols_ok_e
         cap_e = jnp.where(feas_e, cap_e, 0)
         cap_e = jnp.minimum(cap_e, count)
         before = jnp.cumsum(cap_e) - cap_e
@@ -1112,6 +1174,7 @@ def _make_fill_step(
         new_exist_used = state.exist_used + fill_e[:, None].astype(jnp.float32) * requests[None, :]
         new_exist_reqs = kernels.select_set(landed_e, comb_e, state.exist_reqs)
         new_exist_ports = state.exist_ports | (landed_e[:, None] & xs.ports[None, :])
+        new_exist_vols = state.exist_vols | (landed_e[:, None] & xs.vols[None, :])
 
         # ---- tier 2: water-fill in-flight claims --------------------------
         pod_b = _broadcast_pod(xs.reqs, N)
@@ -1273,6 +1336,7 @@ def _make_fill_step(
                 hg_counts=new_hg_counts,
                 exist_ports=new_exist_ports,
                 claim_ports=ports3,
+                exist_vols=new_exist_vols,
                 res_cap=state.res_cap,
                 held=state.held,
             ),

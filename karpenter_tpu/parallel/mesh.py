@@ -97,6 +97,7 @@ def sharded_solve(
     pod_exist_ok,
     pod_ports,
     pod_port_conf,
+    pod_vols,
     exist,
     it_sharded: InstanceTypeTensors,
     templates,
@@ -138,6 +139,7 @@ def sharded_solve(
         pod_exist_ok,
         pod_ports,
         pod_port_conf,
+        pod_vols,
         exist,
         it_sharded,
         tmpl,

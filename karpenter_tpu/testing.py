@@ -1,11 +1,25 @@
-"""Shared simulation fixtures for tests and benchmarks.
+"""Test fixtures + expectations DSL.
 
-The analog of the reference's pkg/test fixture package: canonical small
-clusters built through the real kwok provider + manager loop, so tests and
-benchmarks measure the same bootstrap the parity suites pin.
+The analog of the reference's pkg/test (object factories, envtest
+environment) and pkg/test/expectations/expectations.go: drive a full
+schedule→launch→bind cycle and assert on the resulting cluster, skew
+distributions, metrics and resource budgets — against the real kwok
+provider + manager loop, so tests measure the same pipeline the parity
+suites pin.
+
+Reference map:
+- Env / env()                 <- test.NewEnvironment (environment.go:141)
+- expect_provisioned          <- ExpectProvisioned (expectations.go:324-410)
+- expect_not_provisioned      <- ExpectNotScheduled
+- make_nodes_initialized      <- ExpectMakeNodesInitialized (:749)
+- expect_skew                 <- ExpectSkew (:929)
+- expect_metric / _at_least   <- metric assertions (:887-909)
+- measure_resources           <- test/suites/performance/thresholds.go:28-43
 """
 
 from __future__ import annotations
+
+from contextlib import contextmanager
 
 
 class FakeCandidate:
@@ -16,6 +30,181 @@ class FakeCandidate:
         self.reschedulable_pods = pods
 
 
+class Env:
+    """A self-contained test environment: fake clock, in-memory store,
+    kwok cloud, manager — the envtest-equivalent harness."""
+
+    def __init__(self, catalog_size: int = 32, catalog=None, options=None):
+        from karpenter_tpu.cloudprovider.fake import instance_types
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.controllers.manager import Manager
+        from karpenter_tpu.state.store import ObjectStore
+        from karpenter_tpu.utils.clock import FakeClock
+
+        self.clock = FakeClock()
+        self.store = ObjectStore(self.clock)
+        self.cloud = KwokCloudProvider(
+            self.store, catalog=catalog or instance_types(catalog_size)
+        )
+        self.mgr = Manager(self.store, self.cloud, self.clock, options=options)
+
+    # -- factories (pkg/test/{pods,nodepool}.go) ---------------------------
+
+    def nodepool(self, name: str = "default", **overrides):
+        from karpenter_tpu.models.nodepool import Budget, NodePool
+        from karpenter_tpu.state.store import ObjectStore
+
+        pool = NodePool()
+        pool.metadata.name = name
+        pool.spec.disruption.budgets = [Budget(nodes="100%")]
+        for key, value in overrides.items():
+            setattr(pool.spec, key, value)
+        self.store.create(ObjectStore.NODEPOOLS, pool)
+        return pool
+
+    def pods(self, n: int = 1, prefix: str = "p", **make_pod_kwargs):
+        from karpenter_tpu.models.pod import make_pod
+
+        return [make_pod(f"{prefix}-{i}", **make_pod_kwargs) for i in range(n)]
+
+    # -- cycle drivers ------------------------------------------------------
+
+    def run(self, rounds: int = 1) -> None:
+        """Reconcile + kubelet heartbeat + bind, `rounds` times."""
+        from karpenter_tpu.controllers.manager import KubeSchedulerSim
+
+        for _ in range(rounds):
+            self.mgr.run_until_idle()
+            self.cloud.simulate_kubelet_ready()
+            self.mgr.run_until_idle()
+            KubeSchedulerSim(self.store, self.mgr.cluster).bind_pending()
+            self.mgr.run_until_idle()
+
+
+def env(**kwargs) -> Env:
+    return Env(**kwargs)
+
+
+# -- expectations ------------------------------------------------------------
+
+
+def expect_provisioned(e: Env, *pods):
+    """Create the pods, drive a full cycle, assert every pod bound to a
+    Ready node; returns the nodes the pods landed on
+    (ExpectProvisioned, expectations.go:324-410)."""
+    from karpenter_tpu.state.store import ObjectStore
+
+    for p in pods:
+        e.store.create(ObjectStore.PODS, p)
+    e.run(rounds=2)
+    nodes = []
+    for p in pods:
+        live = e.store.get(ObjectStore.PODS, p.name)
+        assert live is not None and live.spec.node_name, (
+            f"pod {p.name} not scheduled"
+        )
+        node = e.store.get(ObjectStore.NODES, live.spec.node_name)
+        assert node is not None, f"pod {p.name} bound to a vanished node"
+        nodes.append(node)
+    return nodes
+
+
+def expect_not_provisioned(e: Env, *pods):
+    """Create the pods, drive a cycle, assert they remain unbound."""
+    from karpenter_tpu.state.store import ObjectStore
+
+    for p in pods:
+        e.store.create(ObjectStore.PODS, p)
+    e.run(rounds=2)
+    for p in pods:
+        live = e.store.get(ObjectStore.PODS, p.name)
+        assert live is not None and not live.spec.node_name, (
+            f"pod {p.name} unexpectedly scheduled to {live.spec.node_name}"
+        )
+
+
+def make_nodes_initialized(e: Env) -> int:
+    """Fake the kubelet: all kwok nodes Ready (ExpectMakeNodesInitialized)."""
+    flipped = e.cloud.simulate_kubelet_ready()
+    e.mgr.run_until_idle()
+    return flipped
+
+
+def expect_skew(e: Env, topology_key: str, label_selector: dict) -> dict:
+    """domain -> count of bound selector-matched pods over nodes' domains;
+    assert on it with max(...)-min(...) (ExpectSkew, expectations.go:929)."""
+    from karpenter_tpu.state.store import ObjectStore
+
+    counts: dict[str, int] = {}
+    # every reachable domain participates, even at zero
+    for node in e.store.nodes():
+        domain = node.metadata.labels.get(topology_key)
+        if domain is not None:
+            counts.setdefault(domain, 0)
+    for pod in e.store.pods():
+        if not pod.spec.node_name or pod.is_terminal():
+            continue
+        if any(pod.metadata.labels.get(k) != v for k, v in label_selector.items()):
+            continue
+        node = e.store.get(ObjectStore.NODES, pod.spec.node_name)
+        if node is None:
+            continue
+        domain = node.metadata.labels.get(topology_key)
+        if domain is not None:
+            counts[domain] = counts.get(domain, 0) + 1
+    return counts
+
+
+def expect_max_skew(e: Env, topology_key: str, label_selector: dict, max_skew: int):
+    counts = expect_skew(e, topology_key, label_selector)
+    populated = [c for c in counts.values()]
+    assert populated, f"no domains for {topology_key}"
+    skew = max(populated) - min(populated)
+    assert skew <= max_skew, f"skew {skew} > {max_skew}: {counts}"
+    return counts
+
+
+def expect_metric(name: str, value: float, **labels) -> None:
+    from karpenter_tpu.utils.metrics import REGISTRY
+
+    got = REGISTRY._families[name].get(**labels)
+    assert got == value, f"{name}{labels} = {got}, want {value}"
+
+
+def expect_metric_at_least(name: str, value: float, **labels) -> float:
+    from karpenter_tpu.utils.metrics import REGISTRY
+
+    got = REGISTRY._families[name].get(**labels)
+    assert got >= value, f"{name}{labels} = {got}, want >= {value}"
+    return got
+
+
+# -- resource budgets (performance/thresholds.go:28-43) ----------------------
+
+
+@contextmanager
+def measure_resources(result: dict):
+    """Measure peak-RSS growth (MB) and CPU seconds across the block —
+    the in-process analog of the e2e suite's controller memory/CPU
+    thresholds. Fills result with {"rss_mb": ..., "cpu_s": ...}."""
+    import resource
+    import time
+
+    gc_rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+    cpu0 = time.process_time()
+    yield result
+    result["cpu_s"] = time.process_time() - cpu0
+    result["rss_mb"] = (
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0 - gc_rss0
+    )
+
+
+def current_rss_mb() -> float:
+    import resource
+
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
 def build_bound_cluster(n_pods: int = 6, pod_cpu: float = 2.0, catalog=None):
     """A cluster of kwok nodes with bound pods pinned to the 4-cpu type
     (2-cpu pods: one node per pod, so consolidation has work to find).
@@ -23,21 +212,16 @@ def build_bound_cluster(n_pods: int = 6, pod_cpu: float = 2.0, catalog=None):
     Returns (clock, store, cloud, mgr) with every pod bound.
     """
     from karpenter_tpu.cloudprovider.fake import new_instance_type
-    from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
-    from karpenter_tpu.controllers.manager import KubeSchedulerSim, Manager
+    from karpenter_tpu.controllers.manager import KubeSchedulerSim
     from karpenter_tpu.models import labels as l
-    from karpenter_tpu.models.nodepool import NodePool
     from karpenter_tpu.models.pod import make_pod
     from karpenter_tpu.state.store import ObjectStore
-    from karpenter_tpu.utils.clock import FakeClock
 
-    clock = FakeClock()
-    store = ObjectStore(clock)
     if catalog is None:
         catalog = [new_instance_type("n-4x", cpu=4), new_instance_type("n-8x", cpu=8)]
-    cloud = KwokCloudProvider(store, catalog=catalog)
-    mgr = Manager(store, cloud, clock)
-    store.create(ObjectStore.NODEPOOLS, NodePool())
+    e = Env(catalog=catalog)
+    clock, store, cloud, mgr = e.clock, e.store, e.cloud, e.mgr
+    e.nodepool()
     for i in range(n_pods):
         store.create(
             ObjectStore.PODS,

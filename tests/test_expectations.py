@@ -1,0 +1,107 @@
+"""The expectations DSL (pkg/test/expectations analog) exercised on real
+scenarios, plus the in-process resource-budget suite
+(test/suites/performance/thresholds.go:28-43 analog)."""
+
+from karpenter_tpu import testing as T
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.pod import TopologySpreadConstraint, make_pod
+
+
+class TestExpectations:
+    def test_expect_provisioned_returns_nodes(self):
+        e = T.env()
+        e.nodepool()
+        nodes = T.expect_provisioned(e, *e.pods(5, cpu=1.0))
+        assert len(nodes) == 5
+        assert all(n.status.ready for n in nodes)
+        T.expect_metric_at_least(
+            "karpenter_nodeclaims_created_total",
+            1.0,
+            reason="provisioning",
+            nodepool="default",
+            min_values_relaxed="false",
+        )
+
+    def test_expect_not_provisioned(self):
+        e = T.env()
+        e.nodepool()
+        impossible = make_pod("huge", cpu=100000.0)
+        T.expect_not_provisioned(e, impossible)
+
+    def test_expect_skew_zonal_spread(self):
+        e = T.env()
+        e.nodepool()
+        pods = []
+        for i in range(9):
+            p = make_pod(f"s-{i}", cpu=1.0)
+            p.metadata.labels = {"spread": "zonal"}
+            p.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=l.LABEL_TOPOLOGY_ZONE,
+                    label_selector={"spread": "zonal"},
+                )
+            ]
+            pods.append(p)
+        T.expect_provisioned(e, *pods)
+        counts = T.expect_max_skew(
+            e, l.LABEL_TOPOLOGY_ZONE, {"spread": "zonal"}, max_skew=1
+        )
+        assert sum(counts.values()) == 9
+
+    def test_expect_metric_failure_raises(self):
+        import pytest
+
+        with pytest.raises(AssertionError):
+            T.expect_metric("karpenter_nodes_created_total", -1.0, nodepool="nope")
+
+
+class TestResourceBudgets:
+    """The e2e performance suite's controller memory/CPU thresholds,
+    in-process: solves must fit a bounded footprint and repeated solves
+    must not leak (basic_test.go:50-59's <260MB controller analog, scaled
+    for the JAX runtime this process carries)."""
+
+    def test_solve_path_memory_and_cpu_budget(self):
+        from karpenter_tpu.cloudprovider.fake import instance_types
+        from karpenter_tpu.controllers.provisioning import (
+            TPUScheduler,
+            build_templates,
+        )
+        from karpenter_tpu.models.nodepool import NodePool
+
+        pool = NodePool()
+        pool.metadata.name = "default"
+        templates = build_templates([(pool, instance_types(100))])
+        pods = [make_pod(f"p-{i}", cpu=0.5) for i in range(512)]
+        sched = TPUScheduler(templates, pod_pad=512, max_claims=64)
+        sched.solve(pods)  # cold: compile + caches (unbudgeted)
+        budget = {}
+        with T.measure_resources(budget):
+            for _ in range(3):
+                result = sched.solve(pods)
+        assert not result.unschedulable
+        # warm solves: bounded growth and bounded host CPU
+        assert budget["rss_mb"] < 256, f"warm-solve RSS grew {budget['rss_mb']:.0f}MB"
+        assert budget["cpu_s"] < 30.0, f"warm solves burned {budget['cpu_s']:.1f}s CPU"
+
+    def test_repeated_solves_do_not_leak(self):
+        from karpenter_tpu.cloudprovider.fake import instance_types
+        from karpenter_tpu.controllers.provisioning import (
+            TPUScheduler,
+            build_templates,
+        )
+        from karpenter_tpu.models.nodepool import NodePool
+
+        pool = NodePool()
+        pool.metadata.name = "default"
+        templates = build_templates([(pool, instance_types(50))])
+        pods = [make_pod(f"p-{i}", cpu=0.5) for i in range(128)]
+        sched = TPUScheduler(templates, pod_pad=128, max_claims=32)
+        for _ in range(3):
+            sched.solve(pods)  # settle caches
+        before = T.current_rss_mb()
+        for _ in range(10):
+            sched.solve(pods)
+        growth = T.current_rss_mb() - before
+        assert growth < 64, f"10 warm solves leaked {growth:.0f}MB"

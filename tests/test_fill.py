@@ -284,3 +284,65 @@ class TestFillUnits:
         limit = jnp.asarray([[4.0, 1.0], [4.0, 1.0]], dtype=jnp.float32)
         got = np.asarray(_count_cap_seq(used, req[None, :], limit))
         assert got.tolist() == [8, 6]
+
+
+class TestCrossConventionFloats:
+    """VERDICT r3 weak #9: quantities that are NOT f32-product-exact
+    (0.3 CPU) crossing the two accumulation conventions — the fill
+    kernel's one-multiply-add (used + c*req) vs the per-pod engines'
+    sequential merge — must never produce divergent PLACEMENTS, phantom
+    unschedulables, or infeasibility when one engine's claims feed the
+    other engine's tier-1 path."""
+
+    def test_non_exact_quantities_place_identically(self):
+        tmpl = _templates()
+        pods = _pods(9, cpu=0.3, mem="300Mi")
+        sched = TPUScheduler(tmpl, max_claims=16)
+        r_dev = sched.solve(pods)
+        universe = build_universe_domains(tmpl, [])
+        host = HostScheduler(tmpl, topology=Topology.build(list(pods), universe))
+        r_host = host.solve(list(pods))
+        assert not r_dev.unschedulable and not r_host.unschedulable
+        assert r_dev.assignments == r_host.assignments
+        assert len(r_dev.claims) == len(r_host.claims)
+        for cd, ch in zip(r_dev.claims, r_host.claims):
+            assert [p.uid for p in cd.pods] == [p.uid for p in ch.pods]
+            # used may differ in ulps across conventions — never more
+            for k in set(cd.used) | set(ch.used):
+                assert abs(cd.used.get(k, 0.0) - ch.used.get(k, 0.0)) <= max(
+                    1e-4, 1e-6 * abs(ch.used.get(k, 0.0))
+                ), (k, cd.used, ch.used)
+
+    def test_fill_claims_replay_through_per_pod_tier1(self):
+        """Claims opened by the fill kernel become existing nodes (the
+        post-launch cluster state); MORE non-exact pods then solve against
+        that f32 usage on BOTH engines — the consolidation-what-if shape
+        of the cross-convention risk."""
+        tmpl = _templates()
+        first = _pods(6, cpu=0.3, mem="256Mi")
+        sched = TPUScheduler(tmpl, max_claims=16)
+        r = sched.solve(first)
+        assert not r.unschedulable
+        existing = []
+        for c in r.claims:
+            it, _price = c.cheapest_launch()
+            alloc = it.allocatable()
+            avail = {k: alloc.get(k, 0.0) - c.used.get(k, 0.0) for k in alloc}
+            existing.append(
+                ExistingSimNode(
+                    name=f"node-{c.slot}",
+                    index=len(existing),
+                    requirements=Requirements.from_labels(
+                        {
+                            l.LABEL_INSTANCE_TYPE: it.name,
+                            l.LABEL_TOPOLOGY_ZONE: it.offerings[0].zone,
+                            l.CAPACITY_TYPE_LABEL_KEY: it.offerings[0].capacity_type,
+                            l.LABEL_ARCH: "amd64",
+                            l.LABEL_HOSTNAME: f"node-{c.slot}",
+                        }
+                    ),
+                    available=avail,
+                )
+            )
+        second = _pods(4, cpu=0.3, mem="256Mi", prefix="q")
+        _compare(tmpl, second, existing=existing)

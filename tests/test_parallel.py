@@ -31,7 +31,7 @@ def test_sharded_solve_matches_unsharded():
     import __graft_entry__ as ge
 
     fn, args, meta = ge._build_entry(n_pods=32, n_types=12)
-    it = args[7]  # InstanceTypeTensors position in the solve signature
+    it = args[8]  # InstanceTypeTensors position in the solve signature
     ref = jax.jit(fn)(*args)
     ref_assignment = np.asarray(ref.assignment)
 
@@ -39,7 +39,7 @@ def test_sharded_solve_matches_unsharded():
     with mesh:
         it_sharded = shard_instance_types(it, mesh)
         sharded_args = list(args)
-        sharded_args[7] = it_sharded
+        sharded_args[8] = it_sharded
         out = sharded_solve(*sharded_args, **meta)
         out_assignment = np.asarray(out.assignment)
 
@@ -63,7 +63,7 @@ def test_sharded_solve_enforces_min_values():
         n_pods=24, n_types=12, min_values=("karpenter-tpu.sh/instance-family", 2)
     )
     assert meta["mv_active"]
-    it = args[7]
+    it = args[8]
     ref = jax.jit(fn)(*args)
     ref_assignment = np.asarray(ref.assignment)
 
@@ -71,7 +71,7 @@ def test_sharded_solve_enforces_min_values():
     with mesh:
         it_sharded = shard_instance_types(it, mesh)
         sharded_args = list(args)
-        sharded_args[7] = it_sharded
+        sharded_args[8] = it_sharded
         out = sharded_solve(*sharded_args, **meta)
         out_assignment = np.asarray(out.assignment)
 

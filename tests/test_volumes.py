@@ -149,11 +149,33 @@ class TestAttachLimits:
         ).solve(pods)
         assert len(result.existing_assignments) == 3
 
-    def test_device_engine_routes_to_host_on_limits(self):
-        """The device kernel declines attach-limited problems; results
-        match the host oracle exactly (it IS the host oracle)."""
+    def _parity(self, templates, pods, nodes_factory, pod_volumes):
+        """Device vs host on an attach-limited problem — the device must
+        solve it IN TENSOR (no host fallback) with identical results."""
         from karpenter_tpu.utils.metrics import SOLVER_HOST_FALLBACKS
 
+        before = SOLVER_HOST_FALLBACKS.get(reason="volume_limits")
+        host = HostScheduler(
+            templates, existing_nodes=nodes_factory(), pod_volumes=pod_volumes
+        ).solve(list(pods))
+        tpu = TPUScheduler(templates).solve(
+            pods, existing_nodes=nodes_factory(), pod_volumes=pod_volumes
+        )
+        assert SOLVER_HOST_FALLBACKS.get(reason="volume_limits") == before, (
+            "attach limits fell back to the host"
+        )
+        assert tpu.existing_assignments == host.existing_assignments
+        assert tpu.assignments == host.assignments
+        assert len(tpu.claims) == len(host.claims)
+        assert [p.uid for p, _ in tpu.unschedulable] == [
+            p.uid for p, _ in host.unschedulable
+        ]
+        return tpu, host
+
+    def test_device_solves_limits_in_tensor(self):
+        """VERDICT r3 #9: distinct-PVC attach caps ride the device scan
+        (per-driver popcounts over a (driver, pvc) column vocabulary) —
+        SOLVER_HOST_FALLBACKS{volume_limits} stays flat."""
         templates = build_templates([(default_pool(), instance_types(8))])
         pods = []
         pod_volumes = {}
@@ -163,23 +185,106 @@ class TestAttachLimits:
             pods.append(p)
             pod_volumes[p.uid] = {"ebs": {f"vol-{i}"}}
 
-        def node():
+        def nodes():
             n = make_existing("node-a", 0, cpu_avail=8.0)
             u = VolumeUsage()
             u.add_limit("ebs", 1)
             n.volume_usage = u
-            return n
+            return [n]
 
-        before = SOLVER_HOST_FALLBACKS.get(reason="volume_limits")
-        host = HostScheduler(
-            templates, existing_nodes=[node()], pod_volumes=pod_volumes
-        ).solve(list(pods))
-        tpu = TPUScheduler(templates).solve(
-            pods, existing_nodes=[node()], pod_volumes=pod_volumes
-        )
-        assert SOLVER_HOST_FALLBACKS.get(reason="volume_limits") == before + 1
-        assert len(tpu.claims) == len(host.claims) == 1
-        assert tpu.existing_assignments == host.existing_assignments
+        tpu, host = self._parity(templates, pods, nodes, pod_volumes)
+        assert len(tpu.claims) == 1  # second pod forced onto a new claim
+
+    def test_device_shared_pvc_dedups(self):
+        """Pods of one kind share PVCs: the union counts each once, so a
+        whole batch lands on a 1-attachment node (fill path)."""
+        templates = build_templates([(default_pool(), instance_types(8))])
+        pods = []
+        pod_volumes = {}
+        for i in range(4):
+            p = make_pod(f"p-{i}", cpu=0.25)
+            p.spec.pvc_names = ["shared"]
+            pods.append(p)
+            pod_volumes[p.uid] = {"ebs": {"shared"}}
+
+        def nodes():
+            n = make_existing("node-a", 0, cpu_avail=8.0)
+            u = VolumeUsage()
+            u.add_limit("ebs", 1)
+            n.volume_usage = u
+            return [n]
+
+        tpu, _host = self._parity(templates, pods, nodes, pod_volumes)
+        assert len(tpu.existing_assignments) == 4
+        assert not tpu.claims
+
+    def test_device_resident_volumes_seed_usage(self):
+        """A node's RESIDENT pods' volumes count against the cap before any
+        new pod lands (cluster.go:845-857 populateVolumeLimits)."""
+        templates = build_templates([(default_pool(), instance_types(8))])
+        p = make_pod("p", cpu=0.25)
+        p.spec.pvc_names = ["new-vol"]
+        pod_volumes = {p.uid: {"ebs": {"new-vol"}}}
+
+        def nodes():
+            n = make_existing("node-a", 0, cpu_avail=8.0)
+            u = VolumeUsage()
+            u.add_limit("ebs", 2)
+            u.add("resident-1", {"ebs": {"old-1"}})
+            u.add("resident-2", {"ebs": {"old-2"}})
+            n.volume_usage = u
+            return [n]
+
+        tpu, _host = self._parity(templates, [p], nodes, pod_volumes)
+        assert not tpu.existing_assignments  # cap already saturated
+        assert len(tpu.claims) == 1
+
+    def test_over_cap_node_still_takes_volume_free_pods(self):
+        """A node whose resident distinct-PVC count already exceeds a
+        shrunk cap: volume-free pods still land there (the host gates the
+        check on `if pod_vols`), while ANY volume-carrying pod is refused
+        — even one whose volumes belong to unlimited drivers (the union
+        check sees the over-cap driver regardless)."""
+        templates = build_templates([(default_pool(), instance_types(8))])
+        free = make_pod("p-free", cpu=0.25)
+        nfs = make_pod("p-nfs", cpu=0.25)
+        nfs.spec.pvc_names = ["n1"]
+        pod_volumes = {nfs.uid: {"nfs": {"n1"}}}  # nfs publishes NO limit
+
+        def nodes():
+            n = make_existing("node-a", 0, cpu_avail=8.0)
+            u = VolumeUsage()
+            u.add_limit("ebs", 1)  # shrank after attach:
+            u.add("resident-1", {"ebs": {"old-1"}})
+            u.add("resident-2", {"ebs": {"old-2"}})
+            n.volume_usage = u
+            return [n]
+
+        tpu, _host = self._parity(templates, [free, nfs], nodes, pod_volumes)
+        assert tpu.existing_assignments == {free.uid: "node-a"}
+        assert len(tpu.claims) == 1  # the nfs pod opens a claim
+
+    def test_device_multi_driver_limits(self):
+        """Per-driver caps are independent: an ebs-saturated node still
+        takes nfs volumes, and vice versa."""
+        templates = build_templates([(default_pool(), instance_types(8))])
+        pe = make_pod("p-ebs", cpu=0.25)
+        pe.spec.pvc_names = ["e1"]
+        pn = make_pod("p-nfs", cpu=0.25)
+        pn.spec.pvc_names = ["n1"]
+        pod_volumes = {pe.uid: {"ebs": {"e1"}}, pn.uid: {"nfs": {"n1"}}}
+
+        def nodes():
+            n = make_existing("node-a", 0, cpu_avail=8.0)
+            u = VolumeUsage()
+            u.add_limit("ebs", 0)  # saturated
+            u.add_limit("nfs", 1)
+            n.volume_usage = u
+            return [n]
+
+        tpu, _host = self._parity(templates, [pe, pn], nodes, pod_volumes)
+        assert list(tpu.existing_assignments) == [pn.uid]
+        assert len(tpu.claims) == 1  # the ebs pod opens a claim
 
 
 class TestAlternatives:
