@@ -226,6 +226,23 @@ def pod_ffd_key(pod: Pod) -> tuple[int, float]:
     return key
 
 
+def gather_ffd_keys(pods: list, sigs: np.ndarray, sizes: np.ndarray) -> None:
+    """Fill sigs/sizes (len >= len(pods)) with each pod's FFD key: the C
+    gather reads the warm caches in one pass, then only the -1 sentinel
+    misses (new pods) pay the Python path — which also populates their
+    caches for the next solve. Shared by ffd_sort and the encode."""
+    from karpenter_tpu import native
+
+    n = len(pods)
+    if native.ffd_keys is not None and n and isinstance(pods, list):
+        if native.ffd_keys(pods, sigs[:n], sizes[:n]):
+            for i in np.flatnonzero(sigs[:n] == -1):
+                sigs[i], sizes[i] = pod_ffd_key(pods[i])
+        return
+    for i, p in enumerate(pods):
+        sigs[i], sizes[i] = pod_ffd_key(p)
+
+
 def ffd_sort(pods: list[Pod]) -> list[Pod]:
     """CPU+memory descending (queue.go:72-90), ties grouped by pod kind in
     first-appearance order (the reference's sort is unstable on ties, so
@@ -237,15 +254,11 @@ def ffd_sort(pods: list[Pod]) -> list[Pod]:
     unchanged — this is purely the vectorized form)."""
     n = len(pods)
     sizes = np.empty(n, dtype=np.float64)
-    ranks = np.empty(n, dtype=np.int64)
-    first_rank: dict[int, int] = {}
-    for i, p in enumerate(pods):
-        s, size = pod_ffd_key(p)
-        r = first_rank.get(s)
-        if r is None:
-            r = first_rank[s] = len(first_rank)
-        ranks[i] = r
-        sizes[i] = size
+    sigs = np.empty(n, dtype=np.int64)
+    gather_ffd_keys(list(pods), sigs, sizes)
+    # first-appearance rank per sig (vectorized; stable like the dict walk)
+    _, first, inv = np.unique(sigs, return_index=True, return_inverse=True)
+    ranks = np.argsort(np.argsort(first))[inv]
     order = np.lexsort((ranks, -sizes))
     return [pods[i] for i in order]
 

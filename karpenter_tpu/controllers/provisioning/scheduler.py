@@ -734,7 +734,9 @@ class TPUScheduler:
         pods_list = list(pods)
         P = len(pods_list)
         n_claims = self._n_claims_override or self.max_claims or _next_pow2(max(P, 1))
-        from karpenter_tpu.controllers.provisioning.host_scheduler import pod_ffd_key
+        from karpenter_tpu.controllers.provisioning.host_scheduler import (
+            gather_ffd_keys,
+        )
 
         sig = np.empty(max(P, 1), dtype=np.int64)
         sizes = np.empty(max(P, 1), dtype=np.float64)
@@ -748,8 +750,7 @@ class TPUScheduler:
                 req = p.spec.requests
                 sizes[i] = req.get(res.CPU, 0.0) + req.get(res.MEMORY, 0.0) / (4.0 * 2**30)
         else:
-            for i, p in enumerate(pods_list):
-                sig[i], sizes[i] = pod_ffd_key(p)
+            gather_ffd_keys(pods_list, sig, sizes)
         if P:
             # first-appearance rank in ORIGINAL order = ffd_sort's tie key
             _, first0, inv0 = np.unique(sig[:P], return_index=True, return_inverse=True)

@@ -1,0 +1,55 @@
+"""Native (C) fast paths, lazily built with the system toolchain.
+
+`ffd_keys` is the C gather for the encode hot loop; `None` when the
+extension is unavailable (missing compiler, failed build) — every caller
+keeps a pure-Python fallback, so this is strictly an accelerator.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+ffd_keys = None
+
+
+def _so_path() -> str:
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    return os.path.join(os.path.dirname(__file__), f"_ktpu_native{suffix}")
+
+
+def _build() -> bool:
+    src = os.path.join(os.path.dirname(__file__), "ffdkeys.c")
+    include = sysconfig.get_paths()["include"]
+    cc = os.environ.get("CC", "gcc")
+    try:
+        subprocess.run(
+            [cc, "-O2", "-shared", "-fPIC", f"-I{include}", src, "-o", _so_path()],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:  # noqa: BLE001 — any build failure -> Python fallback
+        return False
+
+
+def _load() -> None:
+    global ffd_keys
+    if not os.path.exists(_so_path()) and not _build():
+        return
+    try:
+        sys.path.insert(0, os.path.dirname(__file__))
+        try:
+            import _ktpu_native  # noqa: PLC0415
+        finally:
+            sys.path.pop(0)
+        ffd_keys = _ktpu_native.ffd_keys
+    except Exception:  # noqa: BLE001
+        ffd_keys = None
+
+
+if os.environ.get("KTPU_DISABLE_NATIVE") != "1":
+    _load()

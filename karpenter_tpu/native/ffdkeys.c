@@ -1,0 +1,94 @@
+/* _ktpu_native: C fast paths for the scheduler's host-side hot loops.
+ *
+ * The solve pipeline's remaining host cost at the 100k-pod north star is
+ * pure Python loop overhead: one pass over every pod object reading the
+ * cached (content-sig, FFD-size) tuple into numpy buffers
+ * (scheduler._encode / host_scheduler.ffd_sort). This module does that
+ * pass with direct C-API calls — no bytecode dispatch, no boxing — and
+ * falls back to the Python implementation for any pod missing the cache
+ * (the caller re-runs those through pod_ffd_key).
+ *
+ * Built lazily by karpenter_tpu/native/__init__.py with the baked-in gcc;
+ * everything degrades to the pure-Python loop when the build is
+ * unavailable. (The reference is pure Go — SURVEY.md notes the only
+ * native-code obligation is the solver runtime itself; this is that.)
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+/* ffd_keys(pods, sig_buf, size_buf) -> n_missing
+ *
+ * pods:     list of Pod objects
+ * sig_buf:  writable int64 buffer, len >= len(pods)
+ * size_buf: writable float64 buffer, len >= len(pods)
+ *
+ * For every pod with a cached `_ktpu_ffd == (int, float)` in its
+ * __dict__, writes sig/size; positions without a cache entry are left
+ * untouched and counted (caller fills them via the Python path, which
+ * also populates the cache for next time).
+ */
+static PyObject *
+ffd_keys(PyObject *self, PyObject *args)
+{
+    PyObject *pods;
+    Py_buffer sig_buf, size_buf;
+    if (!PyArg_ParseTuple(args, "O!w*w*", &PyList_Type, &pods, &sig_buf, &size_buf))
+        return NULL;
+
+    Py_ssize_t n = PyList_GET_SIZE(pods);
+    if (sig_buf.len < (Py_ssize_t)(n * sizeof(long long)) ||
+        size_buf.len < (Py_ssize_t)(n * sizeof(double))) {
+        PyBuffer_Release(&sig_buf);
+        PyBuffer_Release(&size_buf);
+        PyErr_SetString(PyExc_ValueError, "output buffers too small");
+        return NULL;
+    }
+    long long *sig = (long long *)sig_buf.buf;
+    double *size = (double *)size_buf.buf;
+
+    Py_ssize_t missing = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *pod = PyList_GET_ITEM(pods, i);        /* borrowed */
+        PyObject **dictp = _PyObject_GetDictPtr(pod);
+        PyObject *entry = NULL;
+        if (dictp != NULL && *dictp != NULL) {
+            entry = PyDict_GetItemString(*dictp, "_ktpu_ffd"); /* borrowed */
+        }
+        if (entry == NULL || !PyTuple_Check(entry) || PyTuple_GET_SIZE(entry) != 2) {
+            missing++;
+            sig[i] = -1; /* sentinel: caller fills via the Python path */
+            continue;
+        }
+        PyObject *s = PyTuple_GET_ITEM(entry, 0);
+        PyObject *z = PyTuple_GET_ITEM(entry, 1);
+        long long sv = PyLong_AsLongLong(s);
+        double zv = PyFloat_AsDouble(z);
+        if ((sv == -1 || zv == -1.0) && PyErr_Occurred()) {
+            PyErr_Clear();
+            missing++;
+            sig[i] = -1;
+            continue;
+        }
+        sig[i] = sv;
+        size[i] = zv;
+    }
+    PyBuffer_Release(&sig_buf);
+    PyBuffer_Release(&size_buf);
+    return PyLong_FromSsize_t(missing);
+}
+
+static PyMethodDef Methods[] = {
+    {"ffd_keys", ffd_keys, METH_VARARGS,
+     "Gather cached (sig, size) FFD keys from pods into numpy buffers."},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_ktpu_native", NULL, -1, Methods,
+};
+
+PyMODINIT_FUNC
+PyInit__ktpu_native(void)
+{
+    return PyModule_Create(&moduledef);
+}
