@@ -182,6 +182,19 @@ class Provisioner:
                     out.append((pod, sn.node.metadata.labels))
         return out
 
+    def _bound_pods_named(self) -> list[tuple]:
+        """(pod, node labels, node NAME) triples — the remote WhatIf ships
+        these so the server can drop each scenario's excluded nodes from
+        the topology seed by name."""
+        out = []
+        for sn in self.cluster.nodes():
+            if sn.node is None:
+                continue
+            for pod in sn.pods.values():
+                if not pod.is_terminal():
+                    out.append((pod, sn.node.metadata.labels, sn.name))
+        return out
+
     def _build_topology(self, pods, scheduler, excluded_nodes: Optional[set[str]] = None):
         from karpenter_tpu.controllers.provisioning.topology import (
             Topology,
@@ -324,6 +337,11 @@ class Provisioner:
             lambda ps, excluded: self._build_topology(ps, scheduler, excluded),
             volume_reqs=self._volume_requirements(all_pods, volctx),
             reserved_in_use=self._reserved_in_use(),
+            bound_pods=(
+                self._bound_pods_named()
+                if getattr(scheduler, "wants_bound_pods", False)
+                else None
+            ),
         )
 
     def _existing_sim_nodes(

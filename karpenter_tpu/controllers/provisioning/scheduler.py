@@ -551,6 +551,8 @@ class TPUScheduler:
         topology_factory,
         volume_reqs: Optional[dict] = None,
         reserved_in_use: Optional[dict[str, int]] = None,
+        bound_pods=None,  # data form for the RPC client; the in-process
+        # engine seeds topology through topology_factory
     ) -> Optional[list[tuple[bool, int]]]:
         """Batched disruption what-ifs: evaluate S candidate exclusion sets
         in ONE vmapped device dispatch instead of S sequential re-solves

@@ -14,8 +14,10 @@ Split of labor:
 - Local: DRA solves (the allocator holds live object-store references —
   see solver.proto header) run on a local HostScheduler, mirroring the
   device engine's own DRA routing.
-- whatif_batch returns None: disruption methods fall back to sequential
-  simulates, which DO ride the remote solver.
+- whatif_batch crosses the wire too (the WhatIf RPC): scenarios'
+  topology seeds rebuild server-side from shipped bound pods; the client
+  returns None (sequential-simulate fallback) when bound pods are
+  unavailable or the server declines/predates the RPC.
 """
 
 from __future__ import annotations
@@ -91,6 +93,11 @@ class RemoteScheduler:
             request_serializer=pb.SolveRequest.SerializeToString,
             response_deserializer=pb.SolveResponse.FromString,
         )
+        self._whatif = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/WhatIf",
+            request_serializer=pb.WhatIfRequest.SerializeToString,
+            response_deserializer=pb.WhatIfResponse.FromString,
+        )
         self._health = self._channel.unary_unary(
             f"/{SERVICE_NAME}/Health",
             request_serializer=pb.HealthRequest.SerializeToString,
@@ -122,6 +129,25 @@ class RemoteScheduler:
         return self._health(pb.HealthRequest(), timeout=HEALTH_TIMEOUT_SECONDS)
 
     # -- the TPUScheduler surface -----------------------------------------
+
+    @staticmethod
+    def _encode_common(req, pods, existing_nodes, budgets, volume_reqs, reserved_in_use):
+        """The request fields Solve and WhatIf share — one encoding to
+        keep the two wire paths from drifting."""
+        for p in pods:
+            req.pods.append(convert.pod_to_pb(p))
+        for n in existing_nodes or []:
+            req.existing_nodes.append(convert.existing_to_pb(n))
+        for pool, res_map in (budgets or {}).items():
+            req.budgets[pool].resources.update(res_map)
+        for uid, alts in normalize_volume_reqs(volume_reqs).items():
+            va = req.volume_reqs.add()
+            va.pod_uid = uid
+            for alt in alts:
+                rs = va.alternatives.add()
+                rs.requirements.extend(convert.reqs_to_pb(alt))
+        for rid, n in (reserved_in_use or {}).items():
+            req.reserved_in_use[rid] = n
 
     def solve(
         self,
@@ -169,28 +195,17 @@ class RemoteScheduler:
         t0 = time.perf_counter()
         req = pb.SolveRequest(config_version=self._config_version)
         pods = list(pods)
-        for p in pods:
-            req.pods.append(convert.pod_to_pb(p))
-        for n in existing_nodes or []:
-            req.existing_nodes.append(convert.existing_to_pb(n))
-        for pool, res_map in (budgets or {}).items():
-            req.budgets[pool].resources.update(res_map)
-        for bp, labels in bound_pods or []:
+        self._encode_common(req, pods, existing_nodes, budgets, volume_reqs, reserved_in_use)
+        for entry in bound_pods or []:
             b = req.bound_pods.add()
-            b.pod.CopyFrom(convert.pod_to_pb(bp))
-            b.node_labels.update(labels)
-        for uid, alts in normalize_volume_reqs(volume_reqs).items():
-            va = req.volume_reqs.add()
-            va.pod_uid = uid
-            for alt in alts:
-                rs = va.alternatives.add()
-                rs.requirements.extend(convert.reqs_to_pb(alt))
+            b.pod.CopyFrom(convert.pod_to_pb(entry[0]))
+            b.node_labels.update(entry[1])
+            if len(entry) > 2:
+                b.node_name = entry[2]
         for uid, vols in (pod_volumes or {}).items():
             req.pod_volumes.append(convert.volumes_to_pb(uid, vols))
         if reserved_mode is not None:
             req.reserved_mode = reserved_mode
-        for rid, n in (reserved_in_use or {}).items():
-            req.reserved_in_use[rid] = n
         if deadline is not None:
             # wall deadlines don't cross machines: ship the REMAINING
             # budget; the server re-anchors it on its own monotonic clock
@@ -232,7 +247,62 @@ class RemoteScheduler:
         }
         return result
 
-    def whatif_batch(self, *args, **kwargs):
-        """Not offered remotely (v1): callers fall back to sequential
-        simulates, which ride the remote Solve path."""
-        return None
+    def whatif_batch(
+        self,
+        pods,
+        existing_nodes,
+        budgets,
+        scenarios,
+        topology_factory=None,
+        volume_reqs=None,
+        reserved_in_use=None,
+        bound_pods=None,
+    ):
+        """Batched what-ifs over the wire: the scenarios' topology seeds
+        rebuild SERVER-side from the shipped bound pods (excluding each
+        scenario's nodes by name), so no callback crosses. Returns None —
+        sequential-simulate fallback — when bound pods weren't provided
+        or the server declines (same cases as the in-process prefilter)."""
+        if bound_pods is None:
+            return None
+        req = pb.WhatIfRequest(config_version=self._config_version)
+        self._encode_common(req, pods, existing_nodes, budgets, volume_reqs, reserved_in_use)
+        from karpenter_tpu.models import labels as l
+
+        for entry in bound_pods:
+            bp, labels = entry[0], entry[1]
+            name = entry[2] if len(entry) > 2 else labels.get(l.LABEL_HOSTNAME, "")
+            if not name:
+                # can't exclude this pod's node by name server-side —
+                # verdicts would be unsound; decline to sequential
+                return None
+            b = req.bound_pods.add()
+            b.pod.CopyFrom(convert.pod_to_pb(bp))
+            b.node_labels.update(labels)
+            b.node_name = name
+        for excluded, active, counted in scenarios:
+            s = req.scenarios.add()
+            s.excluded_nodes.extend(sorted(excluded))
+            s.active_pod_uids.extend(sorted(active))
+            s.counted_pod_uids.extend(sorted(counted))
+        try:
+            resp = self._whatif(
+                req,
+                timeout=DEFAULT_SOLVE_BUDGET_SECONDS + SOLVE_COMPILE_SLACK_SECONDS,
+            )
+        except grpc.RpcError as err:
+            if err.code() == grpc.StatusCode.UNIMPLEMENTED:
+                # older solver without the WhatIf handler: sequential
+                # fallback, exactly the pre-RPC behavior
+                return None
+            if err.code() != grpc.StatusCode.FAILED_PRECONDITION:
+                raise
+            self._reconfigure()
+            req.config_version = self._config_version
+            resp = self._whatif(
+                req,
+                timeout=DEFAULT_SOLVE_BUDGET_SECONDS + SOLVE_COMPILE_SLACK_SECONDS,
+            )
+        if resp.declined:
+            return None
+        return [(v.feasible, v.new_claims) for v in resp.verdicts]

@@ -124,6 +124,76 @@ class SolverService:
             )
         return convert.result_to_pb(result, sched.templates)
 
+    def WhatIf(self, request: pb.WhatIfRequest, context) -> pb.WhatIfResponse:
+        """Batched consolidation what-ifs over the wire: S exclusion
+        scenarios in ONE device dispatch (TPUScheduler.whatif_batch).
+        Declines exactly when the in-process prefilter would (multi-alt
+        volumes, CSI limits, per-scenario group-structure divergence) —
+        callers fall back to sequential Solve RPCs."""
+        with self._lock:
+            sched, version = self._scheduler, self._version
+        if sched is None or request.config_version != version:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"config_version {request.config_version} != live {version}; re-Configure",
+            )
+        pods = [convert.pod_from_pb(m) for m in request.pods]
+        existing = [
+            convert.existing_from_pb(m, i) for i, m in enumerate(request.existing_nodes)
+        ]
+        budgets = {
+            pool: dict(rm.resources) for pool, rm in request.budgets.items()
+        } or None
+        bound = [
+            (convert.pod_from_pb(b.pod), dict(b.node_labels), b.node_name)
+            for b in request.bound_pods
+        ]
+        volume_reqs = {
+            va.pod_uid: [convert.reqs_from_pb(rs.requirements) for rs in va.alternatives]
+            for va in request.volume_reqs
+        } or None
+        scenarios = [
+            (set(s.excluded_nodes), set(s.active_pod_uids), set(s.counted_pod_uids))
+            for s in request.scenarios
+        ]
+
+        def topology_factory(current_pods, excluded):
+            from karpenter_tpu.controllers.provisioning.topology import (
+                Topology,
+                build_universe_domains,
+            )
+
+            # the scenario's excluded nodes leave the domain UNIVERSE too
+            # (local parity: _build_topology -> _existing_sim_nodes(excluded));
+            # a domain only an excluded node carries would otherwise pin
+            # the spread global min at a permanently-zero domain
+            surviving = [n for n in existing if n.name not in excluded]
+            universe = build_universe_domains(
+                sched.templates, surviving, template_base=sched.universe_base()
+            )
+            keep = [(p, labels) for p, labels, name in bound if name not in excluded]
+            return Topology.build(current_pods, universe, keep)
+
+        with self._solve_lock:
+            out = sched.whatif_batch(
+                pods,
+                existing,
+                budgets,
+                scenarios,
+                topology_factory,
+                volume_reqs=volume_reqs,
+                reserved_in_use=dict(request.reserved_in_use) or None,
+            )
+        resp = pb.WhatIfResponse()
+        if out is None:
+            resp.declined = True
+        else:
+            for ok, n_new in out:
+                v = resp.verdicts.add()
+                v.feasible = bool(ok)
+                v.new_claims = int(n_new)
+        return resp
+
     def Health(self, request: pb.HealthRequest, context) -> pb.HealthResponse:
         import jax
 
@@ -149,6 +219,11 @@ def _handlers(service: SolverService) -> grpc.GenericRpcHandler:
             service.Solve,
             request_deserializer=pb.SolveRequest.FromString,
             response_serializer=pb.SolveResponse.SerializeToString,
+        ),
+        "WhatIf": grpc.unary_unary_rpc_method_handler(
+            service.WhatIf,
+            request_deserializer=pb.WhatIfRequest.FromString,
+            response_serializer=pb.WhatIfResponse.SerializeToString,
         ),
         "Health": grpc.unary_unary_rpc_method_handler(
             service.Health,

@@ -302,3 +302,78 @@ class TestPipelineThroughSocket:
         assert executed is not None
         cpu_after = sum(n.status.capacity["cpu"] for n in store.nodes())
         assert cpu_after < cpu_before
+
+
+class TestWhatIfOverRPC:
+    def test_remote_whatif_matches_local_prefilter(self, solver_server):
+        """The batched consolidation prefilter crosses the wire: remote
+        verdicts == the in-process whatif_batch on the same cluster."""
+        from karpenter_tpu.testing import build_bound_cluster, node_candidates
+
+        clock, store, cloud, mgr = build_bound_cluster(n_pods=6, pod_cpu=2.0)
+        prov = mgr.provisioner
+        candidates = node_candidates(store)
+        scenarios = [[c] for c in candidates]
+        local = prov.simulate_batch(scenarios)
+        assert local is not None
+
+        # point the SAME provisioner at the remote solver and re-ask
+        prov.solver_endpoint = solver_server
+        prov._scheduler_cache = None
+        remote_sched = prov._build_scheduler()
+        from karpenter_tpu.rpc.client import RemoteScheduler as RS
+
+        assert isinstance(remote_sched, RS)
+        remote = prov.simulate_batch(scenarios)
+        assert remote is not None, "remote WhatIf declined unexpectedly"
+        assert remote == local
+
+    def test_disruption_uses_remote_whatif(self, solver_server):
+        """End-to-end: the disruption controller's batched prefilter rides
+        the WhatIf RPC (no sequential-only fallback) and consolidation
+        still shrinks the cluster."""
+        from karpenter_tpu.controllers.manager import KubeSchedulerSim
+        from karpenter_tpu.models.pod import make_pod
+
+        clock = FakeClock()
+        store = ObjectStore(clock)
+        cloud = KwokCloudProvider(store, catalog=instance_types(64))
+        opts = Options(solver_endpoint=solver_server)
+        mgr = Manager(store, cloud, clock, options=opts)
+        pool = default_pool()
+        pool.spec.disruption.consolidate_after_seconds = 0.0
+        pool.spec.disruption.consolidation_policy = "WhenEmptyOrUnderutilized"
+        pool.spec.disruption.budgets = [Budget(nodes="100%")]
+        pool.spec.template.spec.requirements = [
+            {
+                "key": l.CAPACITY_TYPE_LABEL_KEY,
+                "operator": "In",
+                "values": [l.CAPACITY_TYPE_ON_DEMAND],
+            }
+        ]
+        store.create(ObjectStore.NODEPOOLS, pool)
+        for i in range(8):
+            store.create(ObjectStore.PODS, make_pod(f"p-{i}", cpu=1.5, memory="1Gi"))
+        mgr.run_until_idle()
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        KubeSchedulerSim(store, mgr.cluster).bind_pending()
+        mgr.run_until_idle()
+        cpu_before = sum(n.status.capacity["cpu"] for n in store.nodes())
+        for pod in list(store.pods()):
+            if pod.name not in ("p-0", "p-1"):
+                pod.status.phase = "Succeeded"
+                store.update(ObjectStore.PODS, pod)
+                store.delete(ObjectStore.PODS, pod.name)
+        mgr.run_until_idle()
+        clock.step(60.0)
+        executed = None
+        for _ in range(8):
+            cmd = mgr.run_disruption_once()
+            executed = executed or cmd
+            cloud.simulate_kubelet_ready()
+            mgr.run_until_idle()
+            KubeSchedulerSim(store, mgr.cluster).bind_pending()
+            clock.step(20.0)
+        assert executed is not None
+        assert sum(n.status.capacity["cpu"] for n in store.nodes()) < cpu_before
