@@ -529,9 +529,11 @@ class TPUScheduler:
         pods_sorted, enc = self._encode(pods, existing_nodes, budgets, topology)
         _t_encode_done = _time.perf_counter()
         state, outputs = self._run_solve(enc)
-        state.n_open.block_until_ready()
+        # one round trip both synchronizes the device (timing split) and
+        # fetches the scalar decode needs to size its claim-prefix slice
+        n_open_i = int(np.asarray(state.n_open))
         _t_device_done = _time.perf_counter()
-        out = self._decode(pods_sorted, state, outputs, enc)
+        out = self._decode(pods_sorted, state, outputs, enc, n_open_i)
         _t_end = _time.perf_counter()
         # phase timings for profiling/bench (VERDICT: expose the device vs
         # host split so optimization work isn't flying blind)
@@ -857,7 +859,9 @@ class TPUScheduler:
             [n.name for n in self.existing_nodes],
         )
         topo_tensors = topo_ops.pad_to_v(topo_tensors, v_pad)
-        pod_topo_k = topo_ops.encode_pod_topology(self.topology, vg, hg, reps, strict_reqs_k)
+        pod_topo_k, pod_topo_host = topo_ops.encode_pod_topology(
+            self.topology, vg, hg, reps, strict_reqs_k
+        )
         # toleration matrix [U, G] host-side: taint sets are static per template
         tol_k = np.zeros((U, len(self.templates)), dtype=bool)
         for u, p in enumerate(reps):
@@ -997,16 +1001,11 @@ class TPUScheduler:
         # static set of vocab keys topology groups narrow — the solver
         # handles these with exact per-key corrections so topology-mixed
         # workloads stay on the fast incremental tier-2 path
+        # host-side: the group list IS the source vg_key/vg_valid were
+        # built from (encode_topology), and each device read costs a
+        # ~100ms round trip over a tunneled TPU
         topo_kids = tuple(
-            sorted(
-                {
-                    int(k)
-                    for k, valid in zip(
-                        np.asarray(topo_tensors.vg_key), np.asarray(topo_tensors.vg_valid)
-                    )
-                    if valid
-                }
-            )
+            sorted({self.encoder.vocab.key_to_id[g.key] for g in vg})
         )
 
         # ---- segments + kind batchability ---------------------------------
@@ -1022,10 +1021,10 @@ class TPUScheduler:
             segments = [
                 (int(lo), int(hi), int(ko[lo])) for lo, hi in zip(starts, ends)
             ]
-        vga_np = np.asarray(pod_topo_k.vg_applies)
-        vgr_np = np.asarray(pod_topo_k.vg_records)
-        hga_np = np.asarray(pod_topo_k.hg_applies)
-        hgr_np = np.asarray(pod_topo_k.hg_records)
+        vga_np = pod_topo_host["vga"]
+        vgr_np = pod_topo_host["vgr"]
+        hga_np = pod_topo_host["hga"]
+        hgr_np = pod_topo_host["hgr"]
         from karpenter_tpu.controllers.provisioning.topology import TopologyType
 
         empty_aff = np.zeros(hga_np.shape[1], dtype=bool)
@@ -1196,6 +1195,7 @@ class TPUScheduler:
         state: ops_solver.SolverState,
         outputs: list,
         enc: dict,
+        n_open_i: "int | None" = None,
     ) -> SchedulingResult:
         """Claim-level decode straight from device state (no per-pod host
         requirement replay).
@@ -1235,7 +1235,8 @@ class TPUScheduler:
         # n_open counter, so every referenced slot is < n_open; the 256
         # bucket keeps slice executables cached across solves). This halves
         # the bytes on the wire vs fetching the whole SolverState.
-        n_open_i = int(np.asarray(state.n_open))
+        if n_open_i is None:  # direct _decode callers (tests)
+            n_open_i = int(np.asarray(state.n_open))
         S = min(enc["n_claims"], max(256, -(-n_open_i // 256) * 256))
         to_fetch = dict(
             template=state.template[:S],

@@ -215,7 +215,11 @@ def encode_topology(topology, encoder, e_slots: int, n_slots: int, existing_name
     return tensors, vg, hg
 
 
-def encode_pod_topology(topology, vg, hg, pods, strict_tensors: ReqSetTensors) -> PodTopology:
+def encode_pod_topology(topology, vg, hg, pods, strict_tensors: ReqSetTensors):
+    """Returns (PodTopology, host numpy twins {vga, vgr, hga, hgr}) — the
+    twins are the pre-put arrays (free to expose), read host-side for
+    batchability classification where a device round trip would cost
+    ~100ms over a tunneled TPU."""
     P = strict_tensors.mask.shape[0]
     NGv, NGh = len(vg), len(hg)
     NGv_pad = _pow2(max(NGv, 1), 1)
@@ -248,7 +252,7 @@ def encode_pod_topology(topology, vg, hg, pods, strict_tensors: ReqSetTensors) -
                 hga[i, j] = own
                 hgr[i, j] = sel
             hgs[i, j] = sel
-    return PodTopology(
+    pt = PodTopology(
         vg_applies=jnp.asarray(vga),
         vg_records=jnp.asarray(vgr),
         vg_self=jnp.asarray(vgs),
@@ -257,6 +261,7 @@ def encode_pod_topology(topology, vg, hg, pods, strict_tensors: ReqSetTensors) -
         hg_self=jnp.asarray(hgs),
         strict_mask=strict_tensors.mask,
     )
+    return pt, {"vga": vga, "vgr": vgr, "hga": hga, "hgr": hgr}
 
 
 def take_pod_topology(pt: PodTopology, idx) -> PodTopology:
