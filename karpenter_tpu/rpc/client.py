@@ -83,26 +83,29 @@ class RemoteScheduler:
             for it in t.instance_types:
                 self._catalog.setdefault(it.name, it)
         self._channel = channel or grpc.insecure_channel(endpoint, options=_RPC_OPTIONS)
-        self._configure = self._channel.unary_unary(
-            f"/{SERVICE_NAME}/Configure",
-            request_serializer=pb.ConfigureRequest.SerializeToString,
-            response_deserializer=pb.ConfigureResponse.FromString,
-        )
-        self._solve = self._channel.unary_unary(
-            f"/{SERVICE_NAME}/Solve",
-            request_serializer=pb.SolveRequest.SerializeToString,
-            response_deserializer=pb.SolveResponse.FromString,
-        )
-        self._whatif = self._channel.unary_unary(
-            f"/{SERVICE_NAME}/WhatIf",
-            request_serializer=pb.WhatIfRequest.SerializeToString,
-            response_deserializer=pb.WhatIfResponse.FromString,
-        )
-        self._health = self._channel.unary_unary(
-            f"/{SERVICE_NAME}/Health",
-            request_serializer=pb.HealthRequest.SerializeToString,
-            response_deserializer=pb.HealthResponse.FromString,
-        )
+
+        def timed_stub(method, req_cls, resp_cls):
+            # every crossing (including retries) records into the duration
+            # histogram — the decorator-seam observability parity
+            # (cloudprovider/metrics/cloudprovider.go wraps every SPI call)
+            stub = self._channel.unary_unary(
+                f"/{SERVICE_NAME}/{method}",
+                request_serializer=req_cls.SerializeToString,
+                response_deserializer=resp_cls.FromString,
+            )
+
+            def call(request, **kwargs):
+                from karpenter_tpu.utils.metrics import SOLVER_RPC_DURATION
+
+                with SOLVER_RPC_DURATION.time(method=method):
+                    return stub(request, **kwargs)
+
+            return call
+
+        self._configure = timed_stub("Configure", pb.ConfigureRequest, pb.ConfigureResponse)
+        self._solve = timed_stub("Solve", pb.SolveRequest, pb.SolveResponse)
+        self._whatif = timed_stub("WhatIf", pb.WhatIfRequest, pb.WhatIfResponse)
+        self._health = timed_stub("Health", pb.HealthRequest, pb.HealthResponse)
         req = pb.ConfigureRequest(
             templates_json=encode_templates(templates),
             reserved_mode=reserved_mode,

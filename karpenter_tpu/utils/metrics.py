@@ -179,6 +179,11 @@ SOLVER_HOST_FALLBACKS = REGISTRY.counter(
     "Solves routed to the host oracle instead of the device kernel",
     ("reason",),
 )
+SOLVER_RPC_DURATION = REGISTRY.histogram(
+    "karpenter_solver_rpc_duration_seconds",
+    "Control-plane -> solver-service RPC wall time",
+    ("method",),
+)
 CONSOLIDATION_TIMEOUTS = REGISTRY.counter(
     "karpenter_consolidation_timeouts_total",
     "Consolidation passes that hit their method deadline",

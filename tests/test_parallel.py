@@ -153,3 +153,24 @@ class TestProductionMeshPath:
             ]
             assert a.used == b.used
             assert str(a.requirements) == str(b.requirements)
+
+    def test_meshed_whatif_batch_matches_single_device(self):
+        """The batched consolidation prefilter on a MESHED scheduler: the
+        sharded catalog flows through solve_whatif's vmapped dispatch with
+        verdicts identical to the single-device scheduler."""
+        from karpenter_tpu.testing import build_bound_cluster, node_candidates
+
+        clock, store, cloud, mgr = build_bound_cluster(n_pods=5, pod_cpu=2.0)
+        prov = mgr.provisioner
+        candidates = node_candidates(store)
+        scenarios = [[c] for c in candidates]
+        single = prov.simulate_batch(scenarios)
+        assert single is not None
+        # rebuild the provisioner's scheduler over the 8-device mesh
+        prov.mesh_devices = 8
+        prov._scheduler_cache = None
+        meshed_sched = prov._build_scheduler()
+        assert meshed_sched.mesh is not None
+        meshed = prov.simulate_batch(scenarios)
+        assert meshed is not None
+        assert meshed == single

@@ -377,3 +377,39 @@ class TestWhatIfOverRPC:
             clock.step(20.0)
         assert executed is not None
         assert sum(n.status.capacity["cpu"] for n in store.nodes()) < cpu_before
+
+
+class TestMeshedRemoteSolver:
+    def test_service_with_mesh_matches_local(self, monkeypatch):
+        """The production multi-chip deployment: a solver service whose
+        scheduler shards over the device mesh (KTPU_MESH_DEVICES env of
+        the SOLVER process), answering a control plane over the wire with
+        results bit-identical to a local single-device solve."""
+        monkeypatch.setenv("KTPU_MESH_DEVICES", "8")
+        server, addr = serve("127.0.0.1:0")
+        try:
+            templates = build_templates([(default_pool(), instance_types(32))])
+            remote = RemoteScheduler(addr, templates)
+            pods = diverse_pods(24)
+            r = remote.solve(pods)
+            s = TPUScheduler(templates).solve(pods)
+            assert not r.unschedulable
+            assert r.assignments == s.assignments
+            assert len(r.claims) == len(s.claims)
+            assert abs(r.total_price() - s.total_price()) < 1e-9
+        finally:
+            server.stop(0)
+
+
+def test_rpc_durations_are_measured(solver_server):
+    """The decorator-seam observability parity (cloudprovider/metrics):
+    every RPC crossing records into the duration histogram."""
+    from karpenter_tpu.utils.metrics import REGISTRY
+
+    templates = build_templates([(default_pool(), instance_types(8))])
+    remote = RemoteScheduler(solver_server, templates)
+    remote.solve([make_pod("p", cpu=0.5)])
+    exposition = REGISTRY.expose()
+    assert 'karpenter_solver_rpc_duration_seconds' in exposition
+    assert 'method="Configure"' in exposition
+    assert 'method="Solve"' in exposition
