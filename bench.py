@@ -336,8 +336,11 @@ def main() -> None:
     # would dominate the bench wall-clock).
     if on_tpu:
         try:
+            # warm_runs=2: the first warm solve may re-size the claims axis
+            # to the observed need (a one-time recompile, served from the
+            # persistent cache thereafter); best-of reflects steady state
             detail["northstar_100000x1000"] = run_stage(
-                selector_pods(100_000), 1000, 4096, warm_runs=1
+                selector_pods(100_000), 1000, 4096, warm_runs=2
             )
             detail["northstar_density_10000_sample"] = {
                 k: v

@@ -37,6 +37,17 @@ class UnevaluatedNodePoolError(CloudProviderError):
     pass
 
 
+def instance_types_or_none(cloud, pool):
+    """get_instance_types, absorbing the overlay store's unevaluated gate
+    (reference store.go:64-65): callers skip the pool for this pass; the
+    nodeoverlay controller's next reconcile — triggered synchronously by
+    the pool event — lifts the gate."""
+    try:
+        return cloud.get_instance_types(pool)
+    except UnevaluatedNodePoolError:
+        return None
+
+
 def is_insufficient_capacity(err: Exception) -> bool:
     return isinstance(err, InsufficientCapacityError)
 

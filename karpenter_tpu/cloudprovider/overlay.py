@@ -16,7 +16,7 @@ from typing import Optional
 from karpenter_tpu.cloudprovider.instancetype import InstanceType, Offering, adjusted_price
 from karpenter_tpu.cloudprovider.spi import CloudProvider
 from karpenter_tpu.models import labels as l
-from karpenter_tpu.models.objects import ObjectMeta
+from karpenter_tpu.models.objects import ConditionSet, ObjectMeta
 from karpenter_tpu.scheduling import Requirements
 from karpenter_tpu.scheduling.requirements import node_selector_requirement
 
@@ -28,6 +28,9 @@ class NodeOverlay:
     weight: int = 0  # heaviest wins on conflict
     price: Optional[str] = None  # absolute / "+N" / "-N" / "±N%"
     capacity: dict[str, float] = field(default_factory=dict)
+    # ValidationSucceeded set by the nodeoverlay controller
+    # (controller.go:271-281): False(RuntimeValidation) / False(Conflict)
+    conditions: ConditionSet = field(default_factory=ConditionSet)
 
     @property
     def name(self) -> str:
@@ -41,6 +44,21 @@ class NodeOverlay:
             )
         )
         return it.requirements.is_compatible(reqs, l.WELL_KNOWN_LABELS)
+
+
+def pool_base_reqs(pool) -> Requirements:
+    """The nodepool half of the overlay-matching surface: nodepool label +
+    template labels (controller.go getOverlaidOfferings:332-344). Shared by
+    the nodeoverlay controller's validation and OverlayStore.apply so the
+    two can never disagree about which overlays match."""
+    from karpenter_tpu.scheduling.requirements import Requirement
+
+    reqs = Requirements(
+        Requirement.new(l.NODEPOOL_LABEL_KEY, "In", pool.metadata.name)
+    )
+    for k, v in (pool.spec.template.labels or {}).items():
+        reqs.add(Requirement.new(k, "In", v))
+    return reqs
 
 
 class OverlayStore:
@@ -60,11 +78,13 @@ class OverlayStore:
             for o in self.overlays
         ]
 
-    def _price_overlay_for(self, it: InstanceType, offering: Offering) -> Optional[NodeOverlay]:
+    def _price_overlay_for(
+        self, it: InstanceType, offering: Offering, ctx: Optional[Requirements] = None
+    ) -> Optional[NodeOverlay]:
         """The heaviest price overlay compatible with THIS offering — price
         updates are keyed per offering (store.go:155-167), so a spot-only
         overlay never reprices on-demand offerings of the same type."""
-        combined = it.requirements.copy()
+        combined = (ctx if ctx is not None else it.requirements).copy()
         combined.add(*offering.requirements.values())
         for o, reqs in zip(self.overlays, self._overlay_reqs):
             if o.price is None:
@@ -73,24 +93,32 @@ class OverlayStore:
                 return o
         return None
 
-    def _merged_capacity(self, it: InstanceType) -> dict[str, float]:
+    def _merged_capacity(self, it: InstanceType, ctx: Requirements) -> dict[str, float]:
         """Capacity keys merge across ALL matching overlays, heaviest
         winning per key (store.go:199-207)."""
         merged: dict[str, float] = {}
         # lightest first so heavier overlays overwrite per key
         for o, reqs in reversed(list(zip(self.overlays, self._overlay_reqs))):
-            if o.capacity and it.requirements.is_compatible(reqs, l.WELL_KNOWN_LABELS):
+            if o.capacity and ctx.is_compatible(reqs, l.WELL_KNOWN_LABELS):
                 merged.update(o.capacity)
         return merged
 
-    def apply(self, its: list[InstanceType]) -> list[InstanceType]:
+    def apply(self, its: list[InstanceType], pool=None) -> list[InstanceType]:
+        """Overlay a catalog; `pool` adds the nodepool-context requirements
+        (nodepool label + template labels) overlays may select on
+        (controller.go getOverlaidOfferings:332-344)."""
+        pool_reqs = pool_base_reqs(pool) if pool is not None else None
         out = []
         for it in its:
-            merged_capacity = self._merged_capacity(it)
+            ctx = it.requirements
+            if pool_reqs is not None:
+                ctx = pool_reqs.copy()
+                ctx.add(*it.requirements.values())
+            merged_capacity = self._merged_capacity(it, ctx)
             new_offerings = []
             any_price = False
             for of in it.offerings:
-                po = self._price_overlay_for(it, of)
+                po = self._price_overlay_for(it, of, ctx)
                 new_of = Offering(
                     requirements=of.requirements,
                     price=adjusted_price(of.price, po.price) if po is not None else of.price,
@@ -121,22 +149,46 @@ class OverlayStore:
 
 class OverlayCloudProvider(CloudProvider):
     """Decorator applying the overlay store on GetInstanceTypes
-    (pkg/cloudprovider/overlay/cloudprovider.go; wiring kwok/main.go:36)."""
+    (pkg/cloudprovider/overlay/cloudprovider.go; wiring kwok/main.go:36).
 
-    def __init__(self, inner: CloudProvider, store):
+    Two modes:
+    - evaluated (controller-managed, the reference's): the nodeoverlay
+      controller publishes validated + conflict-free overlays and the set
+      of evaluated pools; a pool the controller has not evaluated yet
+      raises UnevaluatedNodePoolError (store.go:64-65, 84-85).
+    - direct (no controller wired, e.g. bare-harness tests): every stored
+      overlay applies immediately with weight precedence, ungated.
+    """
+
+    def __init__(self, inner: CloudProvider, store, evaluated_store=None):
         self.inner = inner
         self.object_store = store
+        # set by Manager when the nodeoverlay controller is wired
+        self.evaluated_store = evaluated_store
 
     @property
     def name(self) -> str:
         return self.inner.name
 
     def get_instance_types(self, node_pool):
+        if self.evaluated_store is not None:
+            from karpenter_tpu.cloudprovider.errors import UnevaluatedNodePoolError
+
+            current = self.evaluated_store.current()
+            if current is None or node_pool.metadata.name not in current.evaluated_pools:
+                raise UnevaluatedNodePoolError(
+                    f"node pool {node_pool.metadata.name!r} has not been "
+                    "evaluated by the nodeoverlay controller yet"
+                )
+            its = self.inner.get_instance_types(node_pool)
+            if not current.active:
+                return its
+            return OverlayStore(current.active).apply(its, pool=node_pool)
         its = self.inner.get_instance_types(node_pool)
         overlays = self.object_store.list(self.object_store.NODE_OVERLAYS)
         if not overlays:
             return its
-        return OverlayStore(overlays).apply(its)
+        return OverlayStore(overlays).apply(its, pool=node_pool)
 
     # everything else passes through
     def create(self, node_claim):

@@ -113,11 +113,13 @@ class DisruptionController:
                 return command
             return None
 
+        from karpenter_tpu.cloudprovider.errors import instance_types_or_none
+
         pools = {p.name: p for p in self.store.nodepools()}
         its = {
             it.name: it
             for p in pools.values()
-            for it in self.cloud.get_instance_types(p)
+            for it in instance_types_or_none(self.cloud, p) or ()
         }
         from karpenter_tpu.models.pdb import blocked_pod_uids
 

@@ -180,7 +180,12 @@ class StaticDrift:
             limit = (pool.spec.limits.resources.get("nodes") if pool.spec.limits else None)
             if limit is not None and len(claims) + 1 > limit:
                 continue
-            template = build_template(pool, self.cloud.get_instance_types(pool))
+            from karpenter_tpu.cloudprovider.errors import instance_types_or_none
+
+            pool_its = instance_types_or_none(self.cloud, pool)
+            if pool_its is None:
+                continue  # unevaluated pool: skip this candidate's pool pass
+            template = build_template(pool, pool_its)
             replacement = SimClaim(
                 template=template,
                 requirements=template.requirements.copy(),

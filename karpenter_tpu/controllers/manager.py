@@ -26,6 +26,20 @@ from karpenter_tpu.utils import resources as res
 from karpenter_tpu.utils.clock import Clock
 
 
+def _find_overlay_provider(cloud):
+    """Walk the decorator chain (metrics -> overlay -> provider) for the
+    overlay decorator the nodeoverlay controller manages."""
+    from karpenter_tpu.cloudprovider.overlay import OverlayCloudProvider
+
+    seen = 0
+    while cloud is not None and seen < 8:
+        if isinstance(cloud, OverlayCloudProvider):
+            return cloud
+        cloud = getattr(cloud, "inner", None)
+        seen += 1
+    return None
+
+
 class Manager:
     def __init__(
         self,
@@ -103,6 +117,24 @@ class Manager:
         self._dirty_claims: set[str] = set()
         self._claim_by_pid: dict[str, str] = {}  # provider_id -> claim name
         self._gated_passes = 0
+        # nodeoverlay runtime controller: wired only when the cloud chain
+        # carries the overlay decorator (nodeoverlay/controller.go:62-140)
+        self.nodeoverlay = None
+        overlay_cp = _find_overlay_provider(cloud)
+        if overlay_cp is not None:
+            from karpenter_tpu.controllers.nodeoverlay import (
+                EvaluatedOverlayStore,
+                NodeOverlayController,
+            )
+
+            evaluated = EvaluatedOverlayStore()
+            overlay_cp.evaluated_store = evaluated
+            self.nodeoverlay = NodeOverlayController(
+                store, overlay_cp.inner, self.clock, evaluated
+            )
+            # first evaluation lifts the UnevaluatedNodePoolError gate for
+            # pools already present; later pools re-trigger via informers
+            self.nodeoverlay.reconcile()
         self._wire_informers()
 
     # -- informers (state/informer/*.go) ---------------------------------------
@@ -112,13 +144,22 @@ class Manager:
         self.store.watch(ObjectStore.NODES, self._on_node)
         self.store.watch(ObjectStore.NODECLAIMS, self._on_nodeclaim)
         self.store.watch(ObjectStore.NODEPOOLS, self._on_nodepool)
-        # overlay changes reprice the catalog: drop the price cache
-        self.store.watch(
-            ObjectStore.NODE_OVERLAYS, lambda e, o: self._catalog_by_name.clear()
-        )
+        # overlay changes reprice the catalog: drop the price cache and
+        # revalidate (controller.go:146 watches NodeOverlay events)
+        self.store.watch(ObjectStore.NODE_OVERLAYS, self._on_overlay)
+
+    def _on_overlay(self, event: EventType, overlay) -> None:
+        self._catalog_by_name.clear()
+        if self.nodeoverlay is not None:
+            self.nodeoverlay.reconcile()
 
     def _on_nodepool(self, event: EventType, pool) -> None:
         self._catalog_by_name = {}  # pool changes can reshape the catalog
+        if self.nodeoverlay is not None:
+            # evaluate the new/changed pool BEFORE provisioning sees it, so
+            # the unevaluated gate lifts within the same event turn
+            # (controller.go:147 watches NodePool events)
+            self.nodeoverlay.reconcile()
         # a new/changed pool may unblock gated provisioning
         if any(p.is_provisionable() for p in self.store.pods()):
             self.batcher.trigger()
@@ -150,10 +191,12 @@ class Manager:
 
         name = claim.metadata.labels.get(l.LABEL_INSTANCE_TYPE, "")
         if name not in self._catalog_by_name:
+            from karpenter_tpu.cloudprovider.errors import instance_types_or_none
+
             # rebuild on miss: pools/overlays may have changed the catalog
             self._catalog_by_name = {}
             for pool in self.store.nodepools():
-                for it in self.cloud.get_instance_types(pool):
+                for it in instance_types_or_none(self.cloud, pool) or ():
                     self._catalog_by_name.setdefault(it.name, it)
         it = self._catalog_by_name.get(name)
         if it is None:
@@ -269,6 +312,12 @@ class Manager:
         from karpenter_tpu.controllers.status_controllers import HydrationController
 
         out = {
+            # the 6h overlay revalidation requeue (controller.go:140)
+            "overlay_eval": (
+                self.nodeoverlay.maybe_reconcile()
+                if self.nodeoverlay is not None
+                else None
+            ),
             "invalid_pools": NodePoolValidationController(self.store, self.clock).reconcile(),
             "hydrated": HydrationController(self.store).reconcile(),
             "expired": self.expiration.reconcile(),

@@ -1,0 +1,267 @@
+"""NodeOverlay runtime controller: validation, conflict detection, and
+atomic swap of the evaluated overlay store.
+
+Counterpart of reference pkg/controllers/nodeoverlay/controller.go:62-300 +
+store.go:45-288: one reconcile revalidates EVERY overlay against every
+nodepool's (pre-overlay) catalog, surfaces runtime-validation failures and
+weight-ties as status conditions, and publishes the surviving overlays +
+the evaluated-pool set atomically. Until a pool appears in an evaluated
+store, the overlay decorator refuses its catalog with
+UnevaluatedNodePoolError (store.go:64-65,84-85) and provisioning skips the
+pool. Reconciles re-run every 6 hours (controller.go:140) and immediately
+on overlay / nodepool events (manager wiring, controller.go:146-152).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from karpenter_tpu.cloudprovider.instancetype import InstanceType
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.scheduling import Requirements
+from karpenter_tpu.scheduling.requirements import node_selector_requirement
+from karpenter_tpu.state.store import ObjectStore
+
+CONDITION_VALIDATION_SUCCEEDED = "ValidationSucceeded"
+REQUEUE_SECONDS = 6 * 3600.0  # controller.go:140
+
+
+@dataclass
+class EvaluatedOverlays:
+    """One immutable evaluation result (internalInstanceTypeStore):
+    the surviving overlays in weight order + the pools they were
+    evaluated against. Swapped atomically into the shared store."""
+
+    active: list = field(default_factory=list)  # valid, conflict-free
+    evaluated_pools: frozenset = frozenset()
+
+
+class EvaluatedOverlayStore:
+    """Shared seam between the controller (writer) and the overlay
+    cloud-provider decorator (reader) — store.go:45-100. `None` current
+    value means the controller has not completed a single evaluation,
+    so EVERY pool is unevaluated."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._current: EvaluatedOverlays | None = None
+
+    def swap(self, evaluated: EvaluatedOverlays) -> None:
+        with self._lock:
+            self._current = evaluated
+
+    def current(self) -> EvaluatedOverlays | None:
+        with self._lock:
+            return self._current
+
+    def reset(self) -> None:  # store.go:288 (tests)
+        with self._lock:
+            self._current = None
+
+
+def runtime_validate(overlay) -> str | None:
+    """types.go RuntimeValidate: price strings must parse, capacity
+    values must be non-negative, requirement operators must construct.
+    Returns an error string, or None when valid."""
+    if overlay.price is not None:
+        p = overlay.price
+        try:
+            float(p[:-1] if p.endswith("%") else p)
+        except (ValueError, TypeError):
+            return f"invalid price {p!r}: not absolute, ±delta, or ±percent"
+        if p.endswith("%") and not p.startswith(("+", "-")):
+            return f"invalid price {p!r}: percent adjustments need a sign"
+        if not p.startswith(("+", "-")) and float(p) < 0:
+            return f"invalid price {p!r}: absolute price must be >= 0"
+    for res_name, qty in overlay.capacity.items():
+        if qty < 0:
+            return f"invalid capacity {res_name}={qty}: must be >= 0"
+    try:
+        for r in overlay.requirements:
+            node_selector_requirement(r["key"], r["operator"], r.get("values", ()))
+    except (KeyError, ValueError) as err:
+        return f"invalid requirement: {err}"
+    return None
+
+
+def _pool_context_reqs(pool, it: InstanceType) -> Requirements:
+    """The requirement surface an overlay matches against: the shared
+    nodepool base (overlay.pool_base_reqs — validation and application
+    must agree) + the instance type's own requirements."""
+    from karpenter_tpu.cloudprovider.overlay import pool_base_reqs
+
+    reqs = pool_base_reqs(pool)
+    reqs.add(*it.requirements.values())
+    return reqs
+
+
+class NodeOverlayController:
+    """The reconcile loop (controller.go:73-140)."""
+
+    def __init__(self, store: ObjectStore, inner_cloud, clock, evaluated_store: EvaluatedOverlayStore):
+        self.store = store
+        self.inner = inner_cloud  # PRE-overlay provider: evaluation must
+        # see the raw catalog, not its own last output
+        self.clock = clock
+        self.evaluated = evaluated_store
+        self._next_requeue = 0.0
+
+    # -- scheduling --------------------------------------------------------
+
+    def maybe_reconcile(self) -> dict | None:
+        """Periodic entry point (the 6h RequeueAfter)."""
+        if self.clock.now() < self._next_requeue:
+            return None
+        return self.reconcile()
+
+    def reconcile(self) -> dict:
+        overlays = sorted(
+            self.store.list(ObjectStore.NODE_OVERLAYS),
+            key=lambda o: (-o.weight, o.name),  # OrderByWeight
+        )
+        pools = self.store.nodepools()
+        if not overlays:
+            # nothing to validate: publish the evaluated-pool set without
+            # building a single catalog (pool events land on the
+            # provisioning-critical path)
+            self.evaluated.swap(
+                EvaluatedOverlays(
+                    active=[],
+                    evaluated_pools=frozenset(p.metadata.name for p in pools),
+                )
+            )
+            self._next_requeue = self.clock.now() + REQUEUE_SECONDS
+            return {
+                "overlays": 0,
+                "active": 0,
+                "conflicted": 0,
+                "invalid": 0,
+                "evaluated_pools": len(pools),
+            }
+        pool_its = {}
+        for p in pools:
+            # a single broken pool must not block overlays on healthy ones
+            # (controller.go:92-101)
+            try:
+                pool_its[p.metadata.name] = (p, self.inner.get_instance_types(p))
+            except Exception:  # noqa: BLE001 — provider errors skip the pool
+                continue
+
+        invalid: dict[str, str] = {}
+        conflicted: list[str] = []
+        active: list = []
+        # conflict tracking, assuming weight-descending processing order
+        # (store.go:212-288): price per (pool, it, offering-key), capacity
+        # per (pool, it) tracking the LOWEST weight that touched it
+        price_seen: dict[tuple, int] = {}  # -> lowest weight so far
+        cap_seen: dict[tuple, tuple] = {}  # -> (lowest weight, its resource keys)
+
+        for o in overlays:
+            err = runtime_validate(o)
+            if err is not None:
+                invalid[o.name] = err
+                continue
+            reqs = Requirements(
+                *(
+                    node_selector_requirement(r["key"], r["operator"], r.get("values", ()))
+                    for r in o.requirements
+                )
+            )
+            touches = []  # deferred writes: validate-all-then-store
+            conflict = False
+            for pool_name, (pool, its) in pool_its.items():
+                for it in its:
+                    ctx = _pool_context_reqs(pool, it)
+                    if not ctx.is_compatible(reqs, l.WELL_KNOWN_LABELS):
+                        continue
+                    offerings = [
+                        of
+                        for of in it.offerings
+                        if _offering_compatible(it, of, reqs)
+                    ]
+                    if not offerings:
+                        continue
+                    if o.price is not None:
+                        for of in offerings:
+                            key = (pool_name, it.name, _offering_key(of))
+                            if price_seen.get(key) == o.weight:
+                                conflict = True  # store.go:267-287
+                            touches.append(("price", key))
+                    if o.capacity:
+                        key = (pool_name, it.name)
+                        prev = cap_seen.get(key)
+                        if (
+                            prev is not None
+                            and prev[0] == o.weight
+                            and any(r in prev[1] for r in o.capacity)
+                        ):
+                            conflict = True  # store.go:212-238
+                        touches.append(("cap", key))
+                if conflict:
+                    break
+            if conflict:
+                conflicted.append(o.name)
+                continue
+            # atomic store phase (controller.go:174-179)
+            for kind, key in touches:
+                if kind == "price":
+                    price_seen[key] = o.weight
+                else:
+                    cap_seen[key] = (o.weight, frozenset(o.capacity))
+            active.append(o)
+
+        self._update_statuses(overlays, invalid, conflicted)
+        self.evaluated.swap(
+            EvaluatedOverlays(
+                active=active,
+                evaluated_pools=frozenset(pool_its),
+            )
+        )
+        self._next_requeue = self.clock.now() + REQUEUE_SECONDS
+        return {
+            "overlays": len(overlays),
+            "active": len(active),
+            "conflicted": len(conflicted),
+            "invalid": len(invalid),
+            "evaluated_pools": len(pool_its),
+        }
+
+    def _update_statuses(self, overlays, invalid, conflicted) -> None:
+        now = self.clock.now()
+        for o in overlays:
+            if o.name in invalid:
+                o.conditions.set_false(
+                    CONDITION_VALIDATION_SUCCEEDED,
+                    "RuntimeValidation",
+                    invalid[o.name],
+                    now=now,
+                )
+            elif o.name in conflicted:
+                o.conditions.set_false(
+                    CONDITION_VALIDATION_SUCCEEDED,
+                    "Conflict",
+                    "conflict with another overlay",
+                    now=now,
+                )
+            else:
+                o.conditions.set_true(
+                    CONDITION_VALIDATION_SUCCEEDED, "Validated", now=now
+                )
+
+
+def _offering_key(of) -> tuple:
+    """Stable identity for an offering's requirement surface
+    (of.Requirements.String() in store.go:240-258)."""
+    return tuple(
+        sorted(
+            (r.key, r.complement, tuple(sorted(r.values)))
+            for r in of.requirements.values()
+        )
+    )
+
+
+def _offering_compatible(it: InstanceType, of, overlay_reqs: Requirements) -> bool:
+    combined = it.requirements.copy()
+    combined.add(*of.requirements.values())
+    return combined.is_compatible(overlay_reqs, l.WELL_KNOWN_LABELS)

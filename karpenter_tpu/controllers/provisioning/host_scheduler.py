@@ -167,10 +167,27 @@ def _canon_terms(terms) -> tuple:
 
 # Content-sig intern table: the full canonical tuples are large, and
 # hashing them on every dict lookup dominates encode time at 100k pods.
-# Interning returns a small int whose hash is free; the table is bounded
-# by the number of DISTINCT pod contents ever seen (deployment-shaped
-# workloads keep it tiny).
+# Interning returns a small int whose hash is free. Deployment-shaped
+# workloads keep the table tiny, but long-running control planes see
+# unbounded distinct contents (pod-template-hash churn), so the table is
+# evicted past a bound. The token COUNTER never resets: tokens stay
+# process-unique, so a pre-eviction token cached on a live pod can never
+# alias a post-eviction content (equal contents merely stop deduping
+# across an eviction — a perf, not correctness, event).
 _SIG_IDS: dict[tuple, int] = {}
+_SIG_LIMIT = 1 << 18
+_sig_next = 0
+
+
+def _intern_sig(s: tuple) -> int:
+    global _sig_next
+    tok = _SIG_IDS.get(s)
+    if tok is None:
+        if len(_SIG_IDS) >= _SIG_LIMIT:
+            _SIG_IDS.clear()
+        tok = _SIG_IDS[s] = _sig_next
+        _sig_next += 1
+    return tok
 
 
 def pod_content_sig(pod: Pod) -> int:
@@ -205,7 +222,7 @@ def pod_content_sig(pod: Pod) -> int:
             tuple(sorted(pod.metadata.labels.items())),
             pod.metadata.namespace,  # topology groups are per-namespace
         )
-        s = _SIG_IDS.setdefault(s, len(_SIG_IDS))
+        s = _intern_sig(s)
         pod.__dict__["_ktpu_sig"] = s
     return s
 

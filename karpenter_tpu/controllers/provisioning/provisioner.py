@@ -220,8 +220,12 @@ class Provisioner:
             return None  # keep the no-DRA hot path free of catalog fetches
         from karpenter_tpu.scheduling.dra.integration import DRAProblem
 
+        from karpenter_tpu.cloudprovider.errors import instance_types_or_none
+
         catalogs = {
-            p.name: self.cloud.get_instance_types(p) for p in self.store.nodepools()
+            p.name: its
+            for p in self.store.nodepools()
+            if (its := instance_types_or_none(self.cloud, p)) is not None
         }
         return DRAProblem.build(self.store, pods, catalogs, extra_deleting_uids)
 
@@ -511,7 +515,13 @@ class Provisioner:
         pools = self._ready_pools()
         if not pools:
             return None
-        pool_catalogs = [(p, self.cloud.get_instance_types(p)) for p in pools]
+        from karpenter_tpu.cloudprovider.errors import instance_types_or_none
+
+        pool_catalogs = [
+            (p, its)
+            for p in pools
+            if (its := instance_types_or_none(self.cloud, p)) is not None
+        ]
         templates = build_templates(pool_catalogs)
         if not templates:
             return None

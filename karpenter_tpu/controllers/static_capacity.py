@@ -56,7 +56,12 @@ class StaticCapacityController:
         return sn is not None and sn.marked_for_deletion
 
     def _scale_up(self, pool: NodePool, count: int) -> int:
-        template = build_template(pool, self.cloud.get_instance_types(pool))
+        from karpenter_tpu.cloudprovider.errors import instance_types_or_none
+
+        pool_its = instance_types_or_none(self.cloud, pool)
+        if pool_its is None:
+            return 0  # unevaluated pool: retry after the overlay reconcile
+        template = build_template(pool, pool_its)
         created = 0
         for _ in range(count):
             requirements = []

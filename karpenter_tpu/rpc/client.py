@@ -49,6 +49,10 @@ _RPC_OPTIONS = [
 # timeout work). Solve gets the request's own budget plus slack for the
 # server's cold XLA compile (~20-70s per shape class, bench cold_s).
 CONFIGURE_TIMEOUT_SECONDS = 120.0
+# FAILED_PRECONDITION (config superseded / solver restart) retries per
+# call: the server holds one active configuration, so concurrent clients
+# with different configs ping-pong — bound it so contention surfaces
+RECONFIGURE_RETRIES = 3
 HEALTH_TIMEOUT_SECONDS = 10.0
 SOLVE_COMPILE_SLACK_SECONDS = 600.0
 DEFAULT_SOLVE_BUDGET_SECONDS = 600.0
@@ -218,22 +222,28 @@ class RemoteScheduler:
             req.timeout_seconds if deadline is not None else DEFAULT_SOLVE_BUDGET_SECONDS
         ) + SOLVE_COMPILE_SLACK_SECONDS
         t_encode = time.perf_counter()
-        try:
-            resp = self._solve(req, timeout=rpc_timeout)
-        except grpc.RpcError as err:
-            if err.code() != grpc.StatusCode.FAILED_PRECONDITION:
-                raise
-            # the solver restarted (or another Configure superseded ours):
-            # re-Configure against the live server and retry once, with the
-            # caller's REMAINING budget (the first attempt + Configure may
-            # have consumed most of it)
-            self._reconfigure()
-            req.config_version = self._config_version
-            if deadline is not None:
-                remaining = max(deadline - now_fn(), 0.0)
-                req.timeout_seconds = remaining
-                rpc_timeout = remaining + SOLVE_COMPILE_SLACK_SECONDS
-            resp = self._solve(req, timeout=rpc_timeout)
+        for attempt in range(RECONFIGURE_RETRIES + 1):
+            try:
+                resp = self._solve(req, timeout=rpc_timeout)
+                break
+            except grpc.RpcError as err:
+                if (
+                    err.code() != grpc.StatusCode.FAILED_PRECONDITION
+                    or attempt == RECONFIGURE_RETRIES
+                ):
+                    raise
+                # the solver restarted (or another client's Configure
+                # superseded ours): re-Configure against the live server
+                # and retry with the caller's REMAINING budget. The loop is
+                # bounded so two clients ping-ponging Configures surface an
+                # RpcError instead of livelocking (the server holds ONE
+                # active configuration; see service.Configure).
+                self._reconfigure()
+                req.config_version = self._config_version
+                if deadline is not None:
+                    remaining = max(deadline - now_fn(), 0.0)
+                    req.timeout_seconds = remaining
+                    rpc_timeout = remaining + SOLVE_COMPILE_SLACK_SECONDS
         t_rpc = time.perf_counter()
         result = convert.result_from_pb(
             resp,
@@ -288,24 +298,25 @@ class RemoteScheduler:
             s.excluded_nodes.extend(sorted(excluded))
             s.active_pod_uids.extend(sorted(active))
             s.counted_pod_uids.extend(sorted(counted))
-        try:
-            resp = self._whatif(
-                req,
-                timeout=DEFAULT_SOLVE_BUDGET_SECONDS + SOLVE_COMPILE_SLACK_SECONDS,
-            )
-        except grpc.RpcError as err:
-            if err.code() == grpc.StatusCode.UNIMPLEMENTED:
-                # older solver without the WhatIf handler: sequential
-                # fallback, exactly the pre-RPC behavior
-                return None
-            if err.code() != grpc.StatusCode.FAILED_PRECONDITION:
-                raise
-            self._reconfigure()
-            req.config_version = self._config_version
-            resp = self._whatif(
-                req,
-                timeout=DEFAULT_SOLVE_BUDGET_SECONDS + SOLVE_COMPILE_SLACK_SECONDS,
-            )
+        for attempt in range(RECONFIGURE_RETRIES + 1):
+            try:
+                resp = self._whatif(
+                    req,
+                    timeout=DEFAULT_SOLVE_BUDGET_SECONDS + SOLVE_COMPILE_SLACK_SECONDS,
+                )
+                break
+            except grpc.RpcError as err:
+                if err.code() == grpc.StatusCode.UNIMPLEMENTED:
+                    # older solver without the WhatIf handler: sequential
+                    # fallback, exactly the pre-RPC behavior
+                    return None
+                if (
+                    err.code() != grpc.StatusCode.FAILED_PRECONDITION
+                    or attempt == RECONFIGURE_RETRIES
+                ):
+                    raise
+                self._reconfigure()
+                req.config_version = self._config_version
         if resp.declined:
             return None
         return [(v.feasible, v.new_claims) for v in resp.verdicts]

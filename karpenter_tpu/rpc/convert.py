@@ -250,6 +250,10 @@ def existing_to_pb(n: ExistingSimNode) -> pb.ExistingNode:
     m = pb.ExistingNode(name=n.name)
     m.requirements.extend(reqs_to_pb(n.requirements))
     m.available.update(n.available)
+    m.used.update(n.used)
+    for ip, port, proto in n.host_ports:
+        h = m.host_ports.add()
+        h.host_ip, h.port, h.protocol = ip, port, proto
     for t in n.taints:
         x = m.taints.add()
         x.key, x.value, x.effect = t.key, t.value, t.effect
@@ -276,6 +280,8 @@ def existing_from_pb(m: pb.ExistingNode, index: int) -> ExistingSimNode:
         requirements=reqs_from_pb(m.requirements),
         available=dict(m.available),
         taints=[Taint(key=t.key, value=t.value, effect=t.effect) for t in m.taints],
+        used=dict(m.used),
+        host_ports=[(h.host_ip, h.port, h.protocol) for h in m.host_ports],
         volume_usage=usage,
     )
 

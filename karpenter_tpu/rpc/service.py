@@ -250,7 +250,11 @@ def serve(
     )
     server.add_generic_rpc_handlers((_handlers(SolverService()),))
     port = server.add_insecure_port(address)
-    host = address.rsplit(":", 1)[0]
+    # host:port split that survives bracketed IPv6 literals ("[::1]:0")
+    if address.startswith("["):
+        host = address[: address.index("]") + 1]
+    else:
+        host = address.rsplit(":", 1)[0]
     server.start()
     return server, f"{host}:{port}"
 

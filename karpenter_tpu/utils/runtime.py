@@ -162,20 +162,41 @@ class _Handler(BaseHTTPRequestHandler):
             if not self.config.enable_profiling:
                 self._send(404, "profiling disabled")
                 return
-            import cProfile
-            import pstats
             import time as _t
             from urllib.parse import parse_qs, urlparse
 
             seconds = float(
                 parse_qs(urlparse(self.path).query).get("seconds", ["1"])[0]
             )
-            prof = cProfile.Profile()
-            prof.enable()
-            _t.sleep(min(seconds, 30.0))
-            prof.disable()
+            # sampling profiler over ALL threads (a cProfile here would
+            # only see this handler thread sleeping): collapse each
+            # thread's stack to a ;-joined frame path every 10ms, report
+            # sample counts — the wall-clock analog of pprof's CPU profile
+            deadline = _t.monotonic() + min(seconds, 30.0)
+            own = threading.get_ident()
+            samples: dict[str, int] = {}
+            n = 0
+            while _t.monotonic() < deadline:
+                for tid, frame in sys_current_frames().items():
+                    if tid == own:
+                        continue
+                    parts = []
+                    f = frame
+                    while f is not None:
+                        code = f.f_code
+                        parts.append(
+                            f"{code.co_filename.rsplit('/', 1)[-1]}:"
+                            f"{f.f_lineno}:{code.co_name}"
+                        )
+                        f = f.f_back
+                    key = ";".join(reversed(parts))
+                    samples[key] = samples.get(key, 0) + 1
+                n += 1
+                _t.sleep(0.01)
             out = io.StringIO()
-            pstats.Stats(prof, stream=out).sort_stats("cumulative").print_stats(40)
+            out.write(f"# {n} sampling rounds, 10ms interval\n")
+            for key, count in sorted(samples.items(), key=lambda kv: -kv[1])[:100]:
+                out.write(f"{count} {key}\n")
             self._send(200, out.getvalue())
         else:
             self._send(404, "not found")

@@ -1,0 +1,232 @@
+"""NodeOverlay runtime controller: validation, conflict detection, the
+unevaluated-pool gate, and the 6h revalidation requeue.
+
+Reference: pkg/controllers/nodeoverlay/controller.go:62-300 (reconcile,
+conflict rules, status conditions), store.go:45-288 (evaluated store,
+UnevaluatedNodePoolError on unevaluated pools), suite_test.go.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from karpenter_tpu.cloudprovider import UnevaluatedNodePoolError
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.cloudprovider.overlay import NodeOverlay, OverlayCloudProvider
+from karpenter_tpu.controllers.manager import Manager
+from karpenter_tpu.controllers.nodeoverlay import (
+    CONDITION_VALIDATION_SUCCEEDED,
+    REQUEUE_SECONDS,
+    EvaluatedOverlayStore,
+    NodeOverlayController,
+)
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import make_pod
+from karpenter_tpu.state.store import ObjectStore
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def _overlay(name, weight=0, price=None, capacity=None, requirements=None):
+    o = NodeOverlay(
+        requirements=requirements or [],
+        weight=weight,
+        price=price,
+        capacity=capacity or {},
+    )
+    o.metadata.name = name
+    return o
+
+
+def _pool(name="default"):
+    pool = NodePool()
+    pool.metadata.name = name
+    return pool
+
+
+def _env(n_types=4):
+    clock = FakeClock()
+    store = ObjectStore(clock)
+    inner = KwokCloudProvider(store, catalog=instance_types(n_types))
+    cloud = OverlayCloudProvider(inner, store)
+    evaluated = EvaluatedOverlayStore()
+    cloud.evaluated_store = evaluated
+    ctrl = NodeOverlayController(store, inner, clock, evaluated)
+    return clock, store, inner, cloud, ctrl
+
+
+class TestUnevaluatedGate:
+    def test_gate_until_first_evaluation_then_unblocks(self):
+        _clock, store, _inner, cloud, ctrl = _env()
+        pool = _pool()
+        store.create(ObjectStore.NODEPOOLS, pool)
+        # before the controller has ever evaluated: the catalog is refused
+        # (store.go:64-65) — the error type exists to be RAISED
+        with pytest.raises(UnevaluatedNodePoolError):
+            cloud.get_instance_types(pool)
+        out = ctrl.reconcile()
+        assert out["evaluated_pools"] == 1
+        assert cloud.get_instance_types(pool)
+
+    def test_new_pool_is_gated_until_revalidated(self):
+        _clock, store, _inner, cloud, ctrl = _env()
+        store.create(ObjectStore.NODEPOOLS, _pool("a"))
+        ctrl.reconcile()
+        late = _pool("late")
+        store.create(ObjectStore.NODEPOOLS, late)
+        with pytest.raises(UnevaluatedNodePoolError):
+            cloud.get_instance_types(late)
+        ctrl.reconcile()
+        assert cloud.get_instance_types(late)
+
+
+class TestConflictDetection:
+    def test_equal_weight_price_overlays_conflict(self):
+        _clock, store, _inner, _cloud, ctrl = _env()
+        store.create(ObjectStore.NODEPOOLS, _pool())
+        a = _overlay("a-first", weight=5, price="+10%")
+        b = _overlay("b-second", weight=5, price="-10%")
+        for o in (a, b):
+            store.create(ObjectStore.NODE_OVERLAYS, o)
+        out = ctrl.reconcile()
+        # name tie-break: a-first wins, b-second conflicts
+        # (store.go:267-287 — equal lowestWeight on a touched offering)
+        assert out["active"] == 1 and out["conflicted"] == 1
+        assert a.conditions.is_true(CONDITION_VALIDATION_SUCCEEDED)
+        assert b.conditions.is_false(CONDITION_VALIDATION_SUCCEEDED)
+        assert b.conditions.get(CONDITION_VALIDATION_SUCCEEDED).reason == "Conflict"
+
+    def test_different_weights_do_not_conflict_heaviest_wins(self):
+        _clock, store, _inner, cloud, ctrl = _env()
+        pool = _pool()
+        store.create(ObjectStore.NODEPOOLS, pool)
+        heavy = _overlay("heavy", weight=10, price="5.0")
+        light = _overlay("light", weight=1, price="9.0")
+        for o in (heavy, light):
+            store.create(ObjectStore.NODE_OVERLAYS, o)
+        out = ctrl.reconcile()
+        assert out["conflicted"] == 0 and out["active"] == 2
+        for it in cloud.get_instance_types(pool):
+            assert all(of.price == 5.0 for of in it.offerings)
+
+    def test_equal_weight_capacity_conflict_needs_overlapping_resources(self):
+        _clock, store, _inner, _cloud, ctrl = _env()
+        store.create(ObjectStore.NODEPOOLS, _pool())
+        gpus = _overlay("a-gpus", weight=3, capacity={"example.com/gpu": 4.0})
+        clash = _overlay("b-clash", weight=3, capacity={"example.com/gpu": 2.0})
+        tpus = _overlay("c-tpus", weight=3, capacity={"example.com/tpu": 8.0})
+        for o in (gpus, tpus, clash):
+            store.create(ObjectStore.NODE_OVERLAYS, o)
+        out = ctrl.reconcile()
+        # b-clash overlaps a-gpus' resource at the same weight -> conflict;
+        # c-tpus touches a disjoint resource -> coexists (store.go:212-238:
+        # the conflict needs a key overlap with the LAST same-weight entry)
+        assert out["conflicted"] == 1
+        assert gpus.conditions.is_true(CONDITION_VALIDATION_SUCCEEDED)
+        assert tpus.conditions.is_true(CONDITION_VALIDATION_SUCCEEDED)
+        assert clash.conditions.is_false(CONDITION_VALIDATION_SUCCEEDED)
+
+    def test_non_overlapping_selectors_never_conflict(self):
+        _clock, store, _inner, _cloud, ctrl = _env(n_types=8)
+        store.create(ObjectStore.NODEPOOLS, _pool())
+        spot = _overlay(
+            "spot",
+            weight=5,
+            price="-50%",
+            requirements=[
+                {
+                    "key": l.CAPACITY_TYPE_LABEL_KEY,
+                    "operator": "In",
+                    "values": [l.CAPACITY_TYPE_SPOT],
+                }
+            ],
+        )
+        od = _overlay(
+            "od",
+            weight=5,
+            price="+50%",
+            requirements=[
+                {
+                    "key": l.CAPACITY_TYPE_LABEL_KEY,
+                    "operator": "In",
+                    "values": [l.CAPACITY_TYPE_ON_DEMAND],
+                }
+            ],
+        )
+        for o in (spot, od):
+            store.create(ObjectStore.NODE_OVERLAYS, o)
+        out = ctrl.reconcile()
+        # same weight, but they touch DISJOINT offerings — no conflict
+        assert out["conflicted"] == 0 and out["active"] == 2
+
+
+class TestRuntimeValidation:
+    def test_invalid_price_sets_runtime_validation_condition(self):
+        _clock, store, _inner, cloud, ctrl = _env()
+        pool = _pool()
+        store.create(ObjectStore.NODEPOOLS, pool)
+        bad = _overlay("bad", price="banana")
+        good = _overlay("good", price="+100%")
+        for o in (bad, good):
+            store.create(ObjectStore.NODE_OVERLAYS, o)
+        out = ctrl.reconcile()
+        assert out["invalid"] == 1 and out["active"] == 1
+        assert bad.conditions.is_false(CONDITION_VALIDATION_SUCCEEDED)
+        assert (
+            bad.conditions.get(CONDITION_VALIDATION_SUCCEEDED).reason
+            == "RuntimeValidation"
+        )
+        # the invalid overlay is NOT applied; the valid one is
+        base = {it.name: it for it in ctrl.inner.get_instance_types(pool)}
+        for it in cloud.get_instance_types(pool):
+            for of, of0 in zip(it.offerings, base[it.name].offerings):
+                assert of.price == pytest.approx(of0.price * 2)
+
+    def test_negative_capacity_rejected(self):
+        _clock, store, _inner, _cloud, ctrl = _env()
+        store.create(ObjectStore.NODEPOOLS, _pool())
+        bad = _overlay("neg", capacity={"example.com/gpu": -1.0})
+        store.create(ObjectStore.NODE_OVERLAYS, bad)
+        out = ctrl.reconcile()
+        assert out["invalid"] == 1
+        assert bad.conditions.is_false(CONDITION_VALIDATION_SUCCEEDED)
+
+
+class TestManagerWiring:
+    def _managed(self):
+        clock = FakeClock()
+        store = ObjectStore(clock)
+        inner = KwokCloudProvider(store, catalog=instance_types(4))
+        cloud = OverlayCloudProvider(inner, store)
+        mgr = Manager(store, cloud, clock)
+        return clock, store, inner, cloud, mgr
+
+    def test_manager_wires_controller_and_lifts_gate(self):
+        _clock, store, _inner, cloud, mgr = self._managed()
+        assert mgr.nodeoverlay is not None
+        assert cloud.evaluated_store is mgr.nodeoverlay.evaluated
+        pool = _pool()
+        store.create(ObjectStore.NODEPOOLS, pool)  # _on_nodepool revalidates
+        assert cloud.get_instance_types(pool)
+
+    def test_provisioning_follows_overlay_price_through_the_gate(self):
+        _clock, store, _inner, _cloud, mgr = self._managed()
+        store.create(ObjectStore.NODEPOOLS, _pool())
+        o = _overlay("pricey", price="1000.0")
+        store.create(ObjectStore.NODE_OVERLAYS, o)  # _on_overlay revalidates
+        store.create(ObjectStore.PODS, make_pod("p-1", cpu=0.5))
+        mgr.batcher.trigger()
+        mgr.run_until_idle()
+        claims = store.nodeclaims()
+        assert claims, "provisioning stayed gated after overlay evaluation"
+
+    def test_six_hour_requeue(self):
+        clock, store, _inner, _cloud, mgr = self._managed()
+        store.create(ObjectStore.NODEPOOLS, _pool())
+        ctrl = mgr.nodeoverlay
+        before = ctrl._next_requeue
+        assert ctrl.maybe_reconcile() is None  # inside the window: no-op
+        clock.step(REQUEUE_SECONDS + 1.0)
+        assert ctrl.maybe_reconcile() is not None
+        assert ctrl._next_requeue > before

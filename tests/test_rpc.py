@@ -141,6 +141,29 @@ class TestCodec:
             # it must survive the wire bit-for-bit
             assert pod_content_sig(back) == pod_content_sig(pod)
 
+    def test_existing_node_roundtrip_carries_used_and_host_ports(self):
+        # a remote Solve must see in-use host ports and resources on
+        # existing nodes exactly like the in-process engine
+        # (scheduler.py existing-node seeding; existingnode.go:32-75)
+        from karpenter_tpu.controllers.provisioning.host_scheduler import (
+            ExistingSimNode,
+        )
+        from karpenter_tpu.scheduling.requirements import Requirements
+
+        node = ExistingSimNode(
+            name="n-1",
+            index=0,
+            requirements=Requirements.from_labels(
+                {l.LABEL_HOSTNAME: "n-1", l.LABEL_TOPOLOGY_ZONE: "test-zone-1"}
+            ),
+            available={"cpu": 3.5, "memory": 2.0 * 2**30, "pods": 100.0},
+            used={"cpu": 0.5, "pods": 10.0},
+            host_ports=[("", 8080, "TCP"), ("0.0.0.0", 443, "TCP")],
+        )
+        back = convert.existing_from_pb(convert.existing_to_pb(node), 0)
+        assert back.used == node.used
+        assert back.host_ports == node.host_ports
+
 
 class TestSolveParity:
     def _parity(self, addr, templates, pods, **kwargs):
