@@ -1,23 +1,68 @@
-"""Capacity buffers: headroom reservation via virtual pods.
+"""Capacity buffers: headroom reservation via virtual pods + the buffer
+status controller.
 
 Counterpart of reference pkg/apis/autoscaling/v1beta1 CapacityBuffer +
-pkg/controllers/capacitybuffer and the virtual-pod injection in
-provisioning (buffers.go:72-190): a buffer asks for N replicas of a pod
-template to be schedulable at all times; the provisioner injects synthetic
-pods so capacity stays warm, and real pods displace them naturally
-(virtual pods never bind, so their nodes always look available to the
-kube-scheduler).
+pkg/controllers/capacitybuffer/controller.go (template resolution, replica
+computation, ReadyForProvisioning) + the provisioning-side Provisioning
+condition and virtual-pod injection (buffers.go:39-380): a buffer asks for
+N replicas of a pod template to be schedulable at all times; the
+provisioner injects synthetic pods so capacity stays warm, real pods
+displace them naturally (virtual pods never bind, so their nodes always
+look available to the kube-scheduler), and the buffer's status reports
+whether the headroom currently fits existing capacity.
 """
 
 from __future__ import annotations
 
 import copy
+import math
 from dataclasses import dataclass, field
+from typing import Optional
 
-from karpenter_tpu.models.objects import ObjectMeta
+from karpenter_tpu.models.objects import ConditionSet, ObjectMeta
 from karpenter_tpu.models.pod import Pod, PodSpec
+from karpenter_tpu.state.store import ObjectStore
 
 BUFFER_POD_ANNOTATION = "karpenter.sh/capacity-buffer"
+
+# conditions (v1beta1/constants.go + buffers.go:303-355)
+COND_READY_FOR_PROVISIONING = "ReadyForProvisioning"
+COND_PROVISIONING = "Provisioning"
+
+RECONCILE_SECONDS = 30.0  # controller.go:103 RequeueAfter
+
+
+@dataclass
+class PodTemplate:
+    """A core/v1 PodTemplate the buffer's podTemplateRef resolves against
+    (apps.ResolvePodTemplateRef)."""
+
+    metadata: ObjectMeta = field(default_factory=lambda: ObjectMeta(name="template"))
+    spec: PodSpec = field(default_factory=PodSpec)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class Scalable:
+    """A scale-subresource target the buffer's scalableRef resolves
+    against (apps.ResolveScalableRef): replicas + the pod shape."""
+
+    metadata: ObjectMeta = field(default_factory=lambda: ObjectMeta(name="scalable"))
+    replicas: int = 0
+    pod_spec: PodSpec = field(default_factory=PodSpec)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class CapacityBufferStatus:
+    replicas: Optional[int] = None  # resolved desired replica count
+    pod_template_generation: Optional[int] = None
 
 
 @dataclass
@@ -27,24 +72,84 @@ class CapacityBuffer:
     metadata: ObjectMeta = field(default_factory=lambda: ObjectMeta(name="buffer"))
     pod_template: PodSpec = field(default_factory=PodSpec)
     replicas: int = 0
+    # refs resolved by the buffer controller (controller.go:146-176)
+    pod_template_ref: Optional[str] = None
+    scalable_ref: Optional[str] = None
+    percentage: Optional[int] = None  # of the scalable's replicas
+    limits: dict[str, float] = field(default_factory=dict)
+    status: CapacityBufferStatus = field(default_factory=CapacityBufferStatus)
+    conditions: ConditionSet = field(default_factory=ConditionSet)
 
     @property
     def name(self) -> str:
         return self.metadata.name
 
 
-def virtual_pods(buffers: list[CapacityBuffer]) -> list[Pod]:
-    """Synthetic pods injected into a Solve (buffers.go:72-190); marked so
+def _limit_replicas(limits: dict[str, float], spec: PodSpec) -> Optional[int]:
+    """floor(limit/request) minimum over overlapping resources
+    (helpers.go:32-56); None when limits constrain nothing."""
+    requests = spec.requests or {}
+    best = None
+    for res_name, limit in limits.items():
+        req = requests.get(res_name, 0.0)
+        if req <= 0.0:
+            continue
+        n = int(math.floor(limit / req))
+        best = n if best is None else min(best, n)
+    return best
+
+
+def _percentage_replicas(scalable_replicas: int, percentage: int) -> int:
+    """ceil(replicas * pct / 100), floored at 1 when both positive
+    (helpers.go:59-68)."""
+    n = int(math.ceil(scalable_replicas * percentage / 100.0))
+    if n < 1 and percentage > 0 and scalable_replicas > 0:
+        n = 1
+    return n
+
+
+def resolved_replicas(buffer: CapacityBuffer) -> int:
+    """The buffer's effective replica count: controller-resolved status
+    when present, else the inline spec (bare-harness compatibility — the
+    same fallback posture as the overlay decorator's direct mode)."""
+    if buffer.conditions.is_true(COND_READY_FOR_PROVISIONING):
+        return buffer.status.replicas or 0
+    if buffer.conditions.is_false(COND_READY_FOR_PROVISIONING):
+        return 0  # resolution failed: no headroom until it recovers
+    return buffer.replicas
+
+
+def resolved_pod_spec(
+    buffer: CapacityBuffer, store: Optional[ObjectStore]
+) -> Optional[PodSpec]:
+    """The pod shape to replicate, following refs through the store
+    (controller.go:146-176): podTemplateRef > scalableRef > inline."""
+    if buffer.pod_template_ref is not None and store is not None:
+        tmpl = store.get(ObjectStore.POD_TEMPLATES, buffer.pod_template_ref)
+        return tmpl.spec if tmpl is not None else None
+    if buffer.scalable_ref is not None and store is not None:
+        s = store.get(ObjectStore.SCALABLES, buffer.scalable_ref)
+        return s.pod_spec if s is not None else None
+    return buffer.pod_template
+
+
+def virtual_pods(
+    buffers: list[CapacityBuffer], store: Optional[ObjectStore] = None
+) -> list[Pod]:
+    """Synthetic pods injected into a Solve (buffers.go:63-190); marked so
     nomination and binding skip them (scheduler.go:305-344)."""
     out = []
     for buffer in buffers:
-        for i in range(buffer.replicas):
+        spec = resolved_pod_spec(buffer, store)
+        if spec is None:
+            continue
+        for i in range(resolved_replicas(buffer)):
             pod = Pod(
                 metadata=ObjectMeta(
                     name=f"buffer-{buffer.name}-{i}",
                     annotations={BUFFER_POD_ANNOTATION: buffer.name},
                 ),
-                spec=copy.deepcopy(buffer.pod_template),
+                spec=copy.deepcopy(spec),
             )
             pod.status.conditions["PodScheduled"] = "Unschedulable"
             out.append(pod)
@@ -53,3 +158,171 @@ def virtual_pods(buffers: list[CapacityBuffer]) -> list[Pod]:
 
 def is_buffer_pod(pod: Pod) -> bool:
     return BUFFER_POD_ANNOTATION in pod.metadata.annotations
+
+
+def buffer_of(pod: Pod) -> Optional[str]:
+    return pod.metadata.annotations.get(BUFFER_POD_ANNOTATION)
+
+
+class CapacityBufferController:
+    """Resolve each buffer's pod shape, compute the target replica count,
+    stamp ReadyForProvisioning, and trigger provisioning
+    (capacitybuffer/controller.go:70-103). Reconciles every 30s and on
+    buffer / pod-template / scalable events (manager wiring)."""
+
+    def __init__(self, store: ObjectStore, clock, trigger=None):
+        self.store = store
+        self.clock = clock
+        self.trigger = trigger  # the batcher (ProvisionerTrigger analog)
+        self._next = 0.0
+        # last resolved (replicas, spec-content) per buffer: the periodic
+        # requeue only triggers provisioning when something CHANGED, so an
+        # idle cluster doesn't re-solve every 30s
+        self._last_sig: dict[str, tuple] = {}
+
+    def maybe_reconcile(self) -> Optional[dict]:
+        if self.clock.now() < self._next:
+            return None
+        return self.reconcile()
+
+    def reconcile(self) -> dict:
+        now = self.clock.now()
+        resolved = 0
+        failed = 0
+        changed = 0
+        buffers = self.store.list(ObjectStore.CAPACITY_BUFFERS)
+        self._last_sig = {
+            k: v for k, v in self._last_sig.items() if k in {b.name for b in buffers}
+        }
+        for cb in buffers:
+            spec = None
+            candidates: list[int] = []
+            if cb.pod_template_ref is not None:
+                tmpl = self.store.get(ObjectStore.POD_TEMPLATES, cb.pod_template_ref)
+                if tmpl is None:
+                    cb.conditions.set_false(
+                        COND_READY_FOR_PROVISIONING,
+                        "PodTemplateNotFound",
+                        f"pod template {cb.pod_template_ref!r} not found",
+                        now=now,
+                    )
+                    failed += 1
+                    continue
+                spec = tmpl.spec
+                cb.status.pod_template_generation = getattr(
+                    tmpl.metadata, "generation", None
+                )
+            elif cb.scalable_ref is not None:
+                s = self.store.get(ObjectStore.SCALABLES, cb.scalable_ref)
+                if s is None:
+                    cb.conditions.set_false(
+                        COND_READY_FOR_PROVISIONING,
+                        "ScalableRefNotFound",
+                        f"scalable {cb.scalable_ref!r} not found",
+                        now=now,
+                    )
+                    failed += 1
+                    continue
+                spec = s.pod_spec
+                if cb.percentage is not None and s.replicas > 0:
+                    candidates.append(_percentage_replicas(s.replicas, cb.percentage))
+            else:
+                spec = cb.pod_template
+
+            # replicas = max(fixed, percentage), bounded by limits; with
+            # no size constraint, limits alone determine the count
+            # (controller.go computeReplicas:185-215)
+            if cb.replicas:
+                candidates.append(cb.replicas)
+            desired = max(candidates) if candidates else 0
+            if cb.limits and spec is not None:
+                lim = _limit_replicas(cb.limits, spec)
+                if lim is not None:
+                    desired = min(desired, lim) if candidates else lim
+            cb.status.replicas = desired
+            cb.conditions.set_true(
+                COND_READY_FOR_PROVISIONING,
+                "Resolved",
+                "Pod template resolved successfully",
+                now=now,
+            )
+            resolved += 1
+            sig = (desired, hash(repr(spec)))
+            if self._last_sig.get(cb.name) != sig:
+                self._last_sig[cb.name] = sig
+                changed += 1
+        if changed and self.trigger is not None:
+            self.trigger.trigger()
+        self._next = now + RECONCILE_SECONDS
+        return {"resolved": resolved, "failed": failed}
+
+
+def update_provisioning_statuses(store: ObjectStore, result, clock) -> dict[str, int]:
+    """Post-solve Provisioning conditions + per-node buffer pod counts
+    (buffers.go:140-380 computeProvisioningCondition /
+    bufferPodCountsFromResults): headroom fully on existing capacity sets
+    True (FitsExistingCapacity); headroom that opened new claims or failed
+    sets False (RequiresNewCapacity). Returns node_name -> buffer pod
+    count so the emptiness path won't delete nodes hosting headroom."""
+    now = clock.now()
+    buffers = store.list(ObjectStore.CAPACITY_BUFFERS)
+    if not buffers:
+        return {}
+    by_buffer: dict[str, dict[str, int]] = {}
+
+    def bucket(name: str) -> dict[str, int]:
+        return by_buffer.setdefault(name, {"new": 0, "existing": 0, "failed": 0})
+
+    node_counts: dict[str, int] = {}
+    for claim in result.claims:
+        for p in claim.pods:
+            b = buffer_of(p)
+            if b is not None:
+                bucket(b)["new"] += 1
+    for node in result.existing or []:
+        for p in node.pods:
+            b = buffer_of(p)
+            if b is not None:
+                bucket(b)["existing"] += 1
+                node_counts[node.name] = node_counts.get(node.name, 0) + 1
+    for p, _reason in result.unschedulable:
+        b = buffer_of(p)
+        if b is not None:
+            bucket(b)["failed"] += 1
+    for cb in buffers:
+        if cb.conditions.is_false(COND_READY_FOR_PROVISIONING):
+            cb.conditions.set_false(
+                COND_PROVISIONING,
+                "NotReadyForProvisioning",
+                "Buffer is not ReadyForProvisioning",
+                now=now,
+            )
+            continue
+        desired = resolved_replicas(cb)
+        if desired == 0:
+            cb.conditions.set_false(
+                COND_PROVISIONING,
+                "BufferEmpty",
+                "Buffer has zero desired replicas",
+                now=now,
+            )
+            continue
+        s = by_buffer.get(cb.name)
+        if s is None:
+            continue  # nothing observed this cycle: leave unchanged
+        if s["new"] > 0 or s["failed"] > 0:
+            cb.conditions.set_false(
+                COND_PROVISIONING,
+                "RequiresNewCapacity",
+                f"{s['new']}/{desired} virtual pods required new capacity, "
+                f"{s['failed']} failed",
+                now=now,
+            )
+        elif s["existing"] == desired:
+            cb.conditions.set_true(
+                COND_PROVISIONING,
+                "FitsExistingCapacity",
+                f"All {desired} virtual pods fit on existing capacity",
+                now=now,
+            )
+    return node_counts

@@ -51,7 +51,7 @@ class DisruptionController:
         self.queue = OrchestrationQueue(store, cluster, provisioner, clock)
         self._pending: Optional[_PendingValidation] = None
         self.methods = [
-            Emptiness(clock),
+            Emptiness(clock, cluster, store),
             StaticDrift(store, cloud),
             Drift(self._simulate),
             MultiNodeConsolidation(

@@ -83,19 +83,39 @@ def _consolidatable(c: Candidate, clock, policy_filter: tuple[str, ...]) -> bool
 
 
 class Emptiness:
-    """Delete nodes with zero reschedulable pods (emptiness.go:42-121)."""
+    """Delete nodes with zero reschedulable pods (emptiness.go:42-121).
+    Nodes hosting virtual buffer headroom are not empty
+    (cluster.bufferPodCounts, buffers.go:145-150)."""
 
     reason = REASON_EMPTY
 
-    def __init__(self, clock):
+    def __init__(self, clock, cluster=None, store=None):
         self.clock = clock
+        self.cluster = cluster
+        self.store = store
 
     def compute(self, candidates: list[Candidate], budgets: dict[str, int]) -> Command:
+        buffered = (
+            self.cluster.buffer_pod_counts if self.cluster is not None else {}
+        )
+        if buffered is None:
+            # no provisioning pass since restart: headroom placement is
+            # unknown — with live buffers, deleting "empty" nodes could
+            # reap warm capacity, so defer until a solve records counts
+            from karpenter_tpu.controllers.capacity_buffer import resolved_replicas
+
+            if self.store is not None and any(
+                resolved_replicas(b) > 0
+                for b in self.store.list(self.store.CAPACITY_BUFFERS)
+            ):
+                return Command(candidates=[], reason=self.reason)
+            buffered = {}
         empty = [
             c
             for c in candidates
             if not c.owned_by_static
             and not c.reschedulable_pods
+            and not buffered.get(c.name)
             and _consolidatable(
                 c,
                 self.clock,

@@ -104,8 +104,14 @@ class Manager:
         from karpenter_tpu.state.cost import ClusterCost, NodePoolHealth
 
         self.static_capacity = StaticCapacityController(store, self.cluster, cloud, self.clock)
+        from karpenter_tpu.controllers.capacity_buffer import CapacityBufferController
         from karpenter_tpu.controllers.metrics_state import PodMetricsController
 
+        # buffer status controller: template resolution + replica targets
+        # + ReadyForProvisioning (capacitybuffer/controller.go)
+        self.capacity_buffer = CapacityBufferController(
+            store, self.clock, trigger=self.batcher
+        )
         # stateful: owns the bound/startup latency dedup sets
         self._pod_metrics = PodMetricsController(store, self.clock)
         self.cost = ClusterCost()
@@ -147,6 +153,17 @@ class Manager:
         # overlay changes reprice the catalog: drop the price cache and
         # revalidate (controller.go:146 watches NodeOverlay events)
         self.store.watch(ObjectStore.NODE_OVERLAYS, self._on_overlay)
+        # buffer / template / scalable events re-resolve replica targets
+        # and trigger a provisioning pass (controller.go:106-118)
+        for kind in (
+            ObjectStore.CAPACITY_BUFFERS,
+            ObjectStore.POD_TEMPLATES,
+            ObjectStore.SCALABLES,
+        ):
+            self.store.watch(kind, self._on_buffer_event)
+
+    def _on_buffer_event(self, event: EventType, obj) -> None:
+        self.capacity_buffer.reconcile()
 
     def _on_overlay(self, event: EventType, overlay) -> None:
         self._catalog_by_name.clear()
@@ -318,6 +335,9 @@ class Manager:
                 if self.nodeoverlay is not None
                 else None
             ),
+            # the 30s buffer-resolution requeue (capacitybuffer
+            # controller.go:103)
+            "buffers": self.capacity_buffer.maybe_reconcile(),
             "invalid_pools": NodePoolValidationController(self.store, self.clock).reconcile(),
             "hydrated": HydrationController(self.store).reconcile(),
             "expired": self.expiration.reconcile(),

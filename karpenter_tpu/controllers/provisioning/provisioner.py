@@ -94,7 +94,11 @@ class Provisioner:
         """Synthetic headroom pods, cached per (buffer, replicas) so their
         uids are stable across reconciles (a fresh uid every pass would
         defeat nomination and double-provision the headroom)."""
-        from karpenter_tpu.controllers.capacity_buffer import virtual_pods
+        from karpenter_tpu.controllers.capacity_buffer import (
+            resolved_pod_spec,
+            resolved_replicas,
+            virtual_pods,
+        )
 
         out: list[Pod] = []
         buffers = self.store.list(self.store.CAPACITY_BUFFERS)
@@ -102,12 +106,18 @@ class Provisioner:
         # drop cache entries for deleted buffers and stale generations
         self._buffer_pods = {k: v for k, v in self._buffer_pods.items() if k[0] in live}
         for buffer in buffers:
-            key = (buffer.name, buffer.replicas)
+            # controller-resolved status when stamped; inline spec in the
+            # bare harness (capacity_buffer.resolved_replicas). The key
+            # carries the resolved SPEC content too — a re-pointed or
+            # edited PodTemplate with an unchanged replica count must
+            # regenerate the headroom pods
+            spec = resolved_pod_spec(buffer, self.store)
+            key = (buffer.name, resolved_replicas(buffer), hash(repr(spec)))
             if key not in self._buffer_pods:
                 self._buffer_pods = {
                     k: v for k, v in self._buffer_pods.items() if k[0] != buffer.name
                 }
-                self._buffer_pods[key] = virtual_pods([buffer])
+                self._buffer_pods[key] = virtual_pods([buffer], self.store)
             out.extend(self._buffer_pods[key])
         return out
 
@@ -346,6 +356,9 @@ class Provisioner:
                 if getattr(scheduler, "wants_bound_pods", False)
                 else None
             ),
+            # displaced pods re-attach their PVCs against surviving nodes'
+            # CSI caps inside the batched solve (volumeusage.go:201-208)
+            pod_volumes=self._pod_volumes(all_pods, volctx),
         )
 
     def _existing_sim_nodes(
@@ -826,4 +839,13 @@ class Provisioner:
             sn = self.cluster.node_by_name(node_name)
             if sn is not None:
                 sn.nominate(self.clock.now())
+        # buffer Provisioning conditions + the emptiness guard's per-node
+        # headroom counts (buffers.go:140-158)
+        from karpenter_tpu.controllers.capacity_buffer import (
+            update_provisioning_statuses,
+        )
+
+        self.cluster.buffer_pod_counts = update_provisioning_statuses(
+            self.store, result, self.clock
+        )
         return result

@@ -112,8 +112,91 @@ def _gather_fill_xs(
     )
 
 
+def _gather_kind_xs(
+    reqs_k, strict_k, requests_k, tol_k, it_allow_k, exist_ok_k, ports_k,
+    conf_k, vols_k, pod_topo_k, kid, counts,
+):
+    """Fused gather building KindXs for a kind-scan segment run."""
+    from karpenter_tpu.ops.kernels import take_set
+
+    ptopo = topo_ops.take_pod_topology(pod_topo_k, kid)
+    return ops_solver.KindXs(
+        reqs=take_set(reqs_k, kid),
+        strict_mask=strict_k.mask[kid],
+        requests=requests_k[kid],
+        tmpl_ok=tol_k[kid],
+        it_allow=it_allow_k[kid],
+        exist_ok=exist_ok_k[kid],
+        ports=ports_k[kid],
+        port_conf=conf_k[kid],
+        vols=vols_k[kid],
+        count=counts,
+        vg_applies=ptopo.vg_applies,
+        vg_records=ptopo.vg_records,
+        vg_self=ptopo.vg_self,
+        hg_applies=ptopo.hg_applies,
+        hg_records=ptopo.hg_records,
+        hg_self=ptopo.hg_self,
+    )
+
+
 _gather_pod_chunk = jax.jit(_gather_pod_chunk)
 _gather_fill_xs = jax.jit(_gather_fill_xs)
+_gather_kind_xs = jax.jit(_gather_kind_xs)
+
+
+def _make_fetch_prep(specs: tuple, tk: tuple):
+    """Build the jitted decode-fetch prep for one output-structure
+    signature: slices every output to its live rows, narrows fill grids to
+    int16, gathers the topology-key requirement rows, and emits ONE flat
+    list (state reads first, outputs in order, fill_max, topo masks).
+    The caller caches the jitted function per (specs, tk) so repeated
+    solves with the same shape reuse one executable."""
+
+    def _prep(state, flat):
+        proc = [state.template, state.its, state.used, state.held, state.n_open]
+        i = 0
+        maxes = []
+        for spec in specs:
+            if spec[0] == "pods":
+                proc.append(flat[i])
+                i += 1
+            elif spec[0] == "kscan":
+                proc.append(flat[i][: spec[1]])
+                i += 1
+            else:
+                B = spec[1]
+                fc, fe, os_, no_, st_ = flat[i : i + 5]
+                i += 5
+                maxes.append(jnp.max(fc))
+                if fe.size:
+                    maxes.append(jnp.max(fe))
+                proc.extend(
+                    [
+                        fc[:B].astype(jnp.int16),
+                        fe[:B].astype(jnp.int16),
+                        os_[:B],
+                        no_[:B],
+                        st_[:B],
+                    ]
+                )
+        if maxes:
+            proc.append(jnp.max(jnp.stack(maxes)))
+        if tk:
+            kid = list(tk)
+            proc.extend(
+                [
+                    state.reqs.mask[:, kid, :],
+                    state.reqs.inf[:, kid],
+                    state.reqs.defined[:, kid],
+                    state.exist_reqs.mask[:, kid, :],
+                    state.exist_reqs.inf[:, kid],
+                    state.exist_reqs.defined[:, kid],
+                ]
+            )
+        return proc
+
+    return _prep
 
 
 def _merge_scaled(base: dict, req: dict, c: int) -> dict:
@@ -165,6 +248,7 @@ class TPUScheduler:
         self.max_claims = max_claims
         self._n_claims_override: Optional[int] = None
         self._tmpl_it_idx: dict = {}
+        self._fetch_prep_cache: dict = {}
         # warm-start sizing of the claims axis: the device scan's per-step
         # cost is linear in n_claims, so steady-state solves shrink the
         # axis to a bucket above the last solve's observed need (NO_ROOM
@@ -584,6 +668,7 @@ class TPUScheduler:
         reserved_in_use: Optional[dict[str, int]] = None,
         bound_pods=None,  # data form for the RPC client; the in-process
         # engine seeds topology through topology_factory
+        pod_volumes: Optional[dict] = None,
     ) -> Optional[list[tuple[bool, int]]]:
         """Batched disruption what-ifs: evaluate S candidate exclusion sets
         in ONE vmapped device dispatch instead of S sequential re-solves
@@ -600,14 +685,18 @@ class TPUScheduler:
         import numpy as _np
 
         self._volume_reqs = normalize_volume_reqs(volume_reqs)
-        self._pod_vols = {}  # what-ifs with CSI limits are declined below
+        # a NO_ROOM escalation from an interleaved solve() must not shrink
+        # the what-if's claims axis — scenarios displace extra pods and can
+        # need MORE slots than the last live solve
+        self._n_claims_override = None
+        # CSI attach limits ride the batched path: displaced pods carry
+        # their (driver, pvc) columns and surviving nodes keep their
+        # attach-usage seeds (exist.vols) — the same tensorized check the
+        # live solve runs
+        self._pod_vols = pod_volumes or {}
         if any(len(alts) > 1 for alts in self._volume_reqs.values()):
             # multi-alternative volume topologies need the host's
             # try-each loop — decline, callers simulate sequentially
-            return None
-        if any(
-            n.volume_usage is not None and n.volume_usage.limits for n in existing_nodes
-        ) and any(p.spec.pvc_names for p in pods):
             return None
         if self._volume_reqs and existing_nodes:
             # same undefined-key parity guard as solve()
@@ -633,7 +722,7 @@ class TPUScheduler:
         P_pad = _next_pow2(max(P, 1), 1)
         kidx = _np.zeros(P_pad, dtype=_np.int64)
         kidx[:P] = enc["kind_of"][:P]
-        pt, tol, it_allow, exist_ok, pod_ports, pod_port_conf, _pod_vols, pod_topo = (
+        pt, tol, it_allow, exist_ok, pod_ports, pod_port_conf, pod_vols, pod_topo = (
             self._materialize_pods(enc, kidx, P)
         )
         base_valid = _np.asarray(pt.valid)
@@ -701,6 +790,7 @@ class TPUScheduler:
             exist_ok,
             pod_ports,
             pod_port_conf,
+            pod_vols,
             enc["exist_tensors"],
             self.it_tensors,
             enc["template_tensors"],
@@ -1083,6 +1173,28 @@ class TPUScheduler:
                     and not vgr_np[u].any()
                     and not (hga_np[u] & empty_aff).any()
                 )
+        # vg-topology kinds whose every applying/recording group shares ONE
+        # narrow vocab key ride the same-kind batched scan instead of the
+        # per-pod scan (ops/solver.py solve_kind_scan — the reference
+        # benchmark's zonal TSC / zone-affinity fifths are exactly this
+        # shape); -1 = ineligible, stay per-pod
+        kscan_key = np.full(U, -1, dtype=np.int64)
+        if allow_fill and vg:
+            vkeys = [self.encoder.vocab.key_to_id[g.key] for g in vg]
+            for u in range(U):
+                if batchable[u]:
+                    continue
+                js = [
+                    j
+                    for j in range(len(vg))
+                    if vga_np[u, j] or vgr_np[u, j]
+                ]
+                keys = {vkeys[j] for j in js}
+                if len(keys) != 1:
+                    continue
+                kid_ = next(iter(keys))
+                if len(self.encoder.vocab.values[kid_]) <= ops_solver.KSCAN_D:
+                    kscan_key[u] = kid_
         kind_records = hgr_np.any(axis=1)  # decode must commit topo counts
 
         return pods_sorted, dict(
@@ -1099,6 +1211,7 @@ class TPUScheduler:
             kind_of=kind_of,
             segments=segments,
             batchable=batchable,
+            kscan_key=kscan_key,
             kind_records=kind_records,
             reps=reps,
             exist_tensors=exist_tensors,
@@ -1178,17 +1291,29 @@ class TPUScheduler:
             exist_tensors, self.it_tensors, template_tensors, topo_tensors,
             n_claims, int(enc["ports_k"].shape[1]), self._res_cap0,
         )
-        # group consecutive segments into maximal same-mode runs
-        runs: list[tuple[bool, list]] = []
+        # group consecutive segments into maximal same-mode runs; kind-scan
+        # runs additionally split per topology key (the key is a static
+        # kernel argument)
+        kscan_key = enc["kscan_key"]
+
+        def _seg_mode(seg):
+            k = seg[2]
+            if batchable[k]:
+                return ("fill",)
+            if kscan_key[k] >= 0:
+                return ("kscan", int(kscan_key[k]))
+            return ("perpod",)
+
+        runs: list[tuple[tuple, list]] = []
         for seg in enc["segments"]:
-            b = bool(batchable[seg[2]])
-            if runs and runs[-1][0] == b:
+            m = _seg_mode(seg)
+            if runs and runs[-1][0] == m:
                 runs[-1][1].append(seg)
             else:
-                runs.append((b, [seg]))
+                runs.append((m, [seg]))
         outputs: list[tuple] = []
-        for is_batch, segs in runs:
-            if is_batch:
+        for mode, segs in runs:
+            if mode[0] == "fill":
                 B = len(segs)
                 B_pad = _next_pow2(B, 8)
                 kind_ids = np.zeros(B_pad, dtype=np.int64)
@@ -1209,6 +1334,34 @@ class TPUScheduler:
                     n_claims=n_claims,
                 )
                 outputs.append(("fill", segs, ys))
+            elif mode[0] == "kscan":
+                # exact B: a padded segment would run the full-width
+                # precompute for nothing (the inner loop already has a
+                # dynamic trip count); runs are small, so the executable
+                # variants stay few
+                B = len(segs)
+                kind_ids = np.zeros(B, dtype=np.int64)
+                counts = np.zeros(B, dtype=np.int32)
+                for j, (lo, hi, k) in enumerate(segs):
+                    kind_ids[j] = k
+                    counts[j] = hi - lo
+                maxc = _next_pow2(int(counts.max()), 64)
+                xs = _gather_kind_xs(
+                    enc["reqs_k"], enc["strict_k"], enc["requests_k"],
+                    enc["tol_k"], enc["it_allow_k"], enc["exist_ok_k"],
+                    enc["ports_k"], enc["conf_k"], enc["vols_k"],
+                    enc["pod_topo_k"], jnp.asarray(kind_ids),
+                    jnp.asarray(counts),
+                )
+                state, ys = ops_solver.solve_kind_scan(
+                    state, xs, exist_tensors, self.it_tensors, template_tensors,
+                    self.well_known, topo_tensors,
+                    zone_kid=enc["zone_kid"], ct_kid=enc["ct_kid"],
+                    n_claims=n_claims, key_kid=mode[1],
+                    n_domains=len(self.encoder.vocab.values[mode[1]]),
+                    maxc=maxc,
+                )
+                outputs.append(("kscan", segs, ys))
             else:
                 lo, hi = segs[0][0], segs[-1][1]
                 for clo in range(lo, hi, chunk):
@@ -1288,82 +1441,96 @@ class TPUScheduler:
         # counts ride as int16 — bounded by per-claim pod capacity
         # (allocatable `pods` is O(hundreds), _count_cap_seq) — and the
         # fetched fill_max scalar guards the narrowing loudly.
-        def _slim_fill(o):
-            kind, segs, ys = o
-            B = len(segs)
-            return (
-                kind,
-                segs,
-                {
-                    "fill_c": ys.fill_c[:B].astype(jnp.int16),
-                    "fill_e": ys.fill_e[:B].astype(jnp.int16),
-                    "open_start": ys.open_start[:B],
-                    "n_opened": ys.n_opened[:B],
-                    "status": ys.status[:B],
-                },
-            )
-
-        fill_outs = [o for o in outputs if o[0] != "pods"]
-        to_fetch = dict(
-            template=state.template,
-            its=state.its,
-            used=state.used,
-            held=state.held,
-            n_open=state.n_open,
-            outputs=[
-                o if o[0] == "pods" else _slim_fill(o) for o in outputs
-            ],
-            fill_max=(
-                jnp.max(
-                    jnp.stack(
-                        [jnp.max(o[2].fill_c) for o in fill_outs]
-                        + [
-                            jnp.max(o[2].fill_e)
-                            for o in fill_outs
-                            if o[2].fill_e.size
-                        ]
-                    )
+        #
+        # The slicing/casting ("slimming") of every output runs INSIDE a
+        # cached jitted prep: done eagerly it costs one tunneled dispatch
+        # PER OP, and interleaved fill/kscan solves produce hundreds of
+        # slim ops (~0.7s of pure dispatch latency at the 16k mix).
+        #
+        # Requirement masks are read ONLY for vg-topology narrowing
+        # (fold_narrowing), and only at the topology keys' rows — gathered
+        # on device (K_pad -> len(topo_kids)), or skipped entirely for
+        # topology-free problems.
+        tk = tuple(enc["topo_kids"])
+        flat: list = []  # device arrays, in recipe order
+        specs: list = []  # static twin of `outputs` for the prep closure
+        for o in outputs:
+            if o[0] == "pods":
+                flat.append(o[3])
+                specs.append(("pods",))
+            elif o[0] == "kscan":
+                flat.append(o[2].assignment)
+                specs.append(("kscan", len(o[1])))
+            else:
+                ys = o[2]
+                flat.extend(
+                    [ys.fill_c, ys.fill_e, ys.open_start, ys.n_opened, ys.status]
                 )
-                if fill_outs
-                else None
-            ),
-        )
-        # requirement masks are read ONLY for vg-topology narrowing
-        # (fold_narrowing), and only at the topology keys' rows — gather
-        # those rows on device (K_pad -> len(topo_kids)) or skip the
-        # fetch entirely for topology-free problems. At the north star
-        # this removes the single largest wire payload (~[S, K, V] bool).
-        tk = list(enc["topo_kids"])
-        if tk:
-            to_fetch.update(
-                c_mask=state.reqs.mask[:, tk, :],
-                c_inf=state.reqs.inf[:, tk],
-                c_def=state.reqs.defined[:, tk],
-                e_mask=state.exist_reqs.mask[:, tk, :],
-                e_inf=state.exist_reqs.inf[:, tk],
-                e_def=state.exist_reqs.defined[:, tk],
+                specs.append(("fill", len(o[1])))
+        key = (tuple(specs), tk)
+        prep = self._fetch_prep_cache.get(key)
+        if prep is None:
+            if len(self._fetch_prep_cache) >= 512:
+                # output structures track workload shape: bound the cache
+                # like kernels._PACK_CACHE so a long-running control plane
+                # with churning workloads can't pin executables forever
+                self._fetch_prep_cache.clear()
+            prep = self._fetch_prep_cache[key] = jax.jit(
+                _make_fetch_prep(tuple(specs), tk)
             )
-        fetched = fetch_tree(to_fetch)
+        fetched_flat = fetch_tree(prep(state, flat))
         import time as _time
 
         self._t_fetch_done = _time.perf_counter()
+        # unflatten along the same recipe
+        it_f = iter(fetched_flat)
+        fetched = dict(
+            template=next(it_f),
+            its=next(it_f),
+            used=next(it_f),
+            held=next(it_f),
+            n_open=next(it_f),
+        )
+        new_outputs = []
+        any_fill = False
+        for o, spec in zip(outputs, specs):
+            if spec[0] == "pods":
+                new_outputs.append((o[0], o[1], o[2], next(it_f)))
+            elif spec[0] == "kscan":
+                new_outputs.append((o[0], o[1], next(it_f)))
+            else:
+                any_fill = True
+                new_outputs.append(
+                    (
+                        o[0],
+                        o[1],
+                        {
+                            "fill_c": next(it_f),
+                            "fill_e": next(it_f),
+                            "open_start": next(it_f),
+                            "n_opened": next(it_f),
+                            "status": next(it_f),
+                        },
+                    )
+                )
+        fill_max = next(it_f) if any_fill else None
+        if tk:
+            for name in ("c_mask", "c_inf", "c_def", "e_mask", "e_inf", "e_def"):
+                fetched[name] = next(it_f)
         n_open_i = int(fetched["n_open"])
         self._last_n_open = n_open_i
-        if (
-            fetched.get("fill_max") is not None
-            and int(fetched["fill_max"]) >= 2**15
-        ):
+        if fill_max is not None and int(fill_max) >= 2**15:
             # a fill count overflowed the int16 wire narrowing (a claim
             # admitted >32k identical pods) — refetch those grids at full
             # width; correctness over the wire win on this exotic shape
-            for i, o in enumerate(fetched["outputs"]):
-                if o[0] == "pods":
+            for i, o in enumerate(new_outputs):
+                if o[0] != "fill":
                     continue
                 ys = outputs[i][2]
                 B = len(o[1])
                 o[2]["fill_c"] = np.asarray(ys.fill_c[:B])
                 o[2]["fill_e"] = np.asarray(ys.fill_e[:B])
-        outputs = fetched["outputs"]
+        outputs = new_outputs
         E = enc["E"]
         kind_of = enc["kind_of"]
         reps: list[Pod] = enc["reps"]
@@ -1643,6 +1810,12 @@ class TPUScheduler:
                 _, lo, hi, assignment = out
                 for i in range(lo, hi):
                     decode_pod(i, int(assignment[i - lo]))
+            elif out[0] == "kscan":
+                _, segs, assign = out
+                for j, (lo, hi, _kind) in enumerate(segs):
+                    row = assign[j]
+                    for i in range(lo, hi):
+                        decode_pod(i, int(row[i - lo]))
             else:
                 decode_fill_output(out[1], out[2])
 

@@ -237,6 +237,35 @@ def value_allowed(r: ReqSetTensors, key_id: int, value_ids: jnp.ndarray) -> jnp.
     return r.mask[..., key_id, :][..., value_ids]
 
 
+def _pack_wire(arrs):
+    """Device-side packer (jit-compiled per leaf-shape signature): ravel
+    every leaf, concatenate per dtype in first-appearance order, bools
+    packbits to bits, everything else bitcasts to bytes, one uint8 wire."""
+    import jax
+
+    by_dtype: dict = {}
+    for a in arrs:
+        by_dtype.setdefault(a.dtype, []).append(a)
+    wire_parts = []
+    for dtype, parts in by_dtype.items():
+        buf = (
+            jnp.concatenate([p.ravel() for p in parts])
+            if len(parts) > 1
+            else parts[0].ravel()
+        )
+        if dtype == jnp.bool_:
+            wire_parts.append(jnp.packbits(buf))
+        else:
+            wire_parts.append(jax.lax.bitcast_convert_type(buf, jnp.uint8).ravel())
+    return (
+        jnp.concatenate(wire_parts) if len(wire_parts) > 1 else wire_parts[0]
+    )
+
+
+_PACK_CACHE: dict = {}
+_PACK_CACHE_LIMIT = 512
+
+
 def fetch_tree(tree):
     """Batched device->host transfer of an arbitrary pytree.
 
@@ -245,39 +274,34 @@ def fetch_tree(tree):
     leaf is flattened into ONE uint8 wire buffer: bools packbits to bits
     (8x fewer bytes — they dominate decode payloads), other dtypes bitcast
     to bytes. One transfer, host-side re-slicing/unpacking at memory speed.
-    Non-array leaves (ints, None, host numpy) pass through untouched.
+    The packing itself is jit-compiled per leaf-shape signature — done
+    eagerly it costs one tunneled dispatch PER OP, and interleaved solves
+    fetch hundreds of leaves. Non-array leaves pass through untouched.
     """
     import jax
     import numpy as np
 
     leaves, treedef = jax.tree.flatten(tree)
-    by_dtype: dict = {}
-    for i, x in enumerate(leaves):
-        if isinstance(x, jax.Array):
-            by_dtype.setdefault(x.dtype, []).append(i)
+    dev_idx = [i for i, x in enumerate(leaves) if isinstance(x, jax.Array)]
     out = list(leaves)
-    wire_parts = []
-    groups = []  # (dtype, idxs, parts, n_elems, n_wire_bytes)
-    for dtype, idxs in by_dtype.items():
-        parts = [leaves[i] for i in idxs]
-        buf = (
-            jnp.concatenate([p.ravel() for p in parts])
-            if len(parts) > 1
-            else parts[0].ravel()
-        )
-        n = int(buf.size)
-        if dtype == jnp.bool_:
-            dev = jnp.packbits(buf)
-        else:
-            dev = jax.lax.bitcast_convert_type(buf, jnp.uint8).ravel()
-        wire_parts.append(dev)
-        groups.append((np.dtype(dtype), idxs, parts, n, int(dev.size)))
-    if wire_parts:
-        wire = np.asarray(
-            jnp.concatenate(wire_parts) if len(wire_parts) > 1 else wire_parts[0]
-        )
+    if dev_idx:
+        arrs = [leaves[i] for i in dev_idx]
+        sig = tuple((a.shape, str(a.dtype)) for a in arrs)
+        packer = _PACK_CACHE.get(sig)
+        if packer is None:
+            if len(_PACK_CACHE) >= _PACK_CACHE_LIMIT:
+                _PACK_CACHE.clear()
+            packer = _PACK_CACHE[sig] = jax.jit(_pack_wire)
+        wire = np.asarray(packer(arrs))
+        # group layout mirrors _pack_wire exactly: dtype groups in
+        # first-appearance order
+        by_dtype: dict = {}
+        for i in dev_idx:
+            by_dtype.setdefault(np.dtype(leaves[i].dtype), []).append(i)
         woff = 0
-        for dtype, idxs, parts, n, nbytes in groups:
+        for dtype, idxs in by_dtype.items():
+            n = sum(leaves[i].size for i in idxs)
+            nbytes = -(-n // 8) if dtype == np.bool_ else n * dtype.itemsize
             seg = wire[woff : woff + nbytes]
             woff += nbytes
             if dtype == np.bool_:
@@ -285,7 +309,8 @@ def fetch_tree(tree):
             else:
                 host = seg.view(dtype)[:n]
             off = 0
-            for i, p in zip(idxs, parts):
+            for i in idxs:
+                p = leaves[i]
                 out[i] = host[off : off + p.size].reshape(p.shape)
                 off += p.size
     return jax.tree.unflatten(treedef, out)

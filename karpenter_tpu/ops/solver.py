@@ -782,6 +782,7 @@ def solve_whatif(
     pod_exist_ok: jnp.ndarray,
     pod_ports: jnp.ndarray,
     pod_port_conf: jnp.ndarray,
+    pod_vols: jnp.ndarray,  # [P, NV] — displaced pods carry their PVCs
     exist: ExistingNodes,
     it: InstanceTypeTensors,
     templates: Templates,
@@ -835,9 +836,11 @@ def solve_whatif(
             pod_ports[idx],
             pod_port_conf[idx],
             topo_ops.take_pod_topology(pod_topo, idx),
-            # what-ifs with CSI limits are declined upstream
-            # (whatif_batch gate), so vols are inert zeros here
-            jnp.zeros((idx.shape[0], exist.vols.shape[1]), dtype=bool),
+            # CSI attach limits ride the what-if exactly like the live
+            # solve: displaced pods re-attach their distinct-PVC columns
+            # against each surviving node's caps (volumeusage.go:201-208 x
+            # multinodeconsolidation.go:136-183)
+            pod_vols[idx],
         )
         state, assignment = jax.lax.scan(step, state, xs)
         n_unsched = jnp.sum(count & valid & (assignment < 0)).astype(jnp.int32)
@@ -1383,3 +1386,658 @@ def _apply_topo(reqs: ReqSetTensors, upd: jnp.ndarray, touched: jnp.ndarray) -> 
         lte=jnp.where(inf, reqs.lte, INT_MAX),
         defined=reqs.defined | touched[None, :],
     )
+
+
+# ---------------------------------------------------------------------------
+# Same-kind batched placement for vocab-key (zonal) topology kinds
+# ---------------------------------------------------------------------------
+# The per-pod scan pays O(N·K·V + N·T·K·V) PER POD; for a run of identical
+# pods everything but the topology counts, per-claim narrowed domain sets,
+# and capacities is invariant. The kind scan hoists the invariant work to
+# one full-width precompute PER SEGMENT and replays the pod loop as a tiny
+# inner scan over a compact [*, D] domain representation (D = the vocab
+# width of the ONE topology key the kind interacts with — zones in
+# practice). Decisions replicate the per-pod step exactly:
+#   tier 1 earliest existing node, tier 2 fewest-pods/earliest-slot,
+#   tier 3 first weight-ordered feasible template; spread narrows to the
+#   single (min count, sorted-name rank) domain (topologygroup.go:229-298),
+#   affinity to the compatible counted set or rank-min bootstrap
+#   (:324-381), anti-affinity to zero-count domains (:404-440); count
+#   commits only for single-valued/anti finite sets (topology.go:190-212).
+# Routing preconditions (host-enforced in the scheduler): every vg group
+# the kind applies to or records into shares ONE vocab key with <= KSCAN_D
+# values, and the usual fill exclusions (minValues enforced, reservations,
+# finite budgets) hold. Hostname groups need no exclusion — hg counts ride
+# the inner carry exactly like the per-pod step.
+
+KSCAN_D = 16  # max domain width a kind-scan key may have
+
+
+class KindXs(NamedTuple):
+    """Per-segment (pod kind) inputs to the kind scan."""
+
+    reqs: ReqSetTensors  # [B, K, V]
+    strict_mask: jnp.ndarray  # [B, K, V]
+    requests: jnp.ndarray  # [B, R]
+    tmpl_ok: jnp.ndarray  # [B, G]
+    it_allow: jnp.ndarray  # [B, T]
+    exist_ok: jnp.ndarray  # [B, E]
+    ports: jnp.ndarray  # [B, NP]
+    port_conf: jnp.ndarray  # [B, NP]
+    vols: jnp.ndarray  # [B, NV]
+    count: jnp.ndarray  # [B] i32 — pods of this kind (0 = padding row)
+    vg_applies: jnp.ndarray  # [B, NGv]
+    vg_records: jnp.ndarray  # [B, NGv]
+    vg_self: jnp.ndarray  # [B, NGv]
+    hg_applies: jnp.ndarray  # [B, NGh]
+    hg_records: jnp.ndarray  # [B, NGh]
+    hg_self: jnp.ndarray  # [B, NGh]
+
+
+def _cap_res_grid(
+    used: jnp.ndarray,  # [B, R]
+    req: jnp.ndarray,  # [R]
+    it: InstanceTypeTensors,
+) -> jnp.ndarray:
+    """[B, T, GR] i32 — max count per (type, allocatable-group) cell with
+    used + c*req within alloc (same ±1-corrected estimate and total-based
+    pass rule as _claim_fill_caps; viability/offering masks apply later)."""
+    R = req.shape[0]
+    pos = req > 0.0
+    safe = jnp.where(pos, req, 1.0)
+    est = jnp.full((used.shape[0],) + it.alloc.shape[:2], jnp.float32(COUNT_CAP))
+    for r in range(R):
+        head = it.alloc[None, :, :, r] - used[:, None, None, r]
+        est = jnp.minimum(est, jnp.where(pos[r], head / safe[r], jnp.inf))
+    c0 = jnp.clip(jnp.floor(est), 0.0, jnp.float32(COUNT_CAP)).astype(jnp.int32)
+
+    def ok(c):
+        acc = it.group_valid[None]
+        cf = c.astype(jnp.float32)
+        for r in range(R):
+            t = used[:, None, None, r] + cf * req[r]
+            acc = acc & ((t <= it.alloc[None, :, :, r]) | (t == 0.0))
+        return acc
+
+    up = ok(c0 + 1)
+    mid = ok(c0)
+    dn = ok(jnp.maximum(c0 - 1, 0))
+    c = jnp.where(
+        mid,
+        jnp.where(up, c0 + 1, c0),
+        jnp.where(dn, jnp.maximum(c0 - 1, 0), 0),
+    )
+    return jnp.where(it.group_valid[None], c, 0)
+
+
+def _kscan_admit(it: InstanceTypeTensors, key_kid: int, D: int) -> jnp.ndarray:
+    """[T, D] bool — the per-key intersects() term between each instance
+    type's requirement at key_kid and the single-value set {d}: a finite
+    single value makes the inf and both-lenient terms vacuous, leaving
+    ~defined | mask-hit."""
+    return ~it.reqs.defined[:, key_kid, None] | it.reqs.mask[:, key_kid, :D]
+
+
+def _kscan_capd(
+    grid: jnp.ndarray,  # [B, T, GR] i32 — resource caps
+    viable: jnp.ndarray,  # [B, T] bool
+    ct_mask: jnp.ndarray,  # [B, V]
+    zmask_full: jnp.ndarray,  # [B, V] — zone mask (non-zone-key case)
+    it: InstanceTypeTensors,
+    key_kid: int,
+    zone_kid: int,
+    D: int,
+) -> jnp.ndarray:
+    """[B, D] i32 — max pods addable per candidate row IF placed in domain
+    d of key_kid: max over (type, group) cells admitted by the domain with
+    an available offering there. Quantifier exchange makes the per-domain
+    max exactly the per-pod engine's any((fits & off), T) at each count."""
+    C = it.zc_avail.shape[3]
+    admit = _kscan_admit(it, key_kid, D)
+    cols = []
+    if key_kid == zone_kid:
+        for d in range(D):
+            off_d = (
+                jnp.einsum(
+                    "tgc,nc->ntg",
+                    it.zc_avail[:, :, d, :].astype(jnp.bfloat16),
+                    ct_mask[:, :C].astype(jnp.bfloat16),
+                    preferred_element_type=jnp.float32,
+                )
+                > 0
+            )
+            m = viable[:, :, None] & admit[None, :, d, None] & off_d
+            cols.append(jnp.max(jnp.where(m, grid, 0), axis=(1, 2)))
+    else:
+        Z = it.zc_avail.shape[2]
+        off = (
+            jnp.einsum(
+                "tgzc,nz,nc->ntg",
+                it.zc_avail.astype(jnp.bfloat16),
+                zmask_full[:, :Z].astype(jnp.bfloat16),
+                ct_mask[:, :C].astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            )
+            > 0
+        )
+        base = viable[:, :, None] & off
+        for d in range(D):
+            m = base & admit[None, :, d, None]
+            cols.append(jnp.max(jnp.where(m, grid, 0), axis=(1, 2)))
+    return jnp.stack(cols, axis=-1)
+
+
+def _kscan_fits_final(
+    grid: jnp.ndarray,  # [B, T, GR] i32
+    placed: jnp.ndarray,  # [B] i32
+    zset: jnp.ndarray,  # [B, D] bool — final narrowed domains
+    ct_mask: jnp.ndarray,  # [B, V]
+    zmask_full: jnp.ndarray,  # [B, V]
+    it: InstanceTypeTensors,
+    key_kid: int,
+    zone_kid: int,
+    D: int,
+) -> jnp.ndarray:
+    """[B, T] bool — fits_off at the final count within the final narrowed
+    domains (the AND over every landing's fits_off: both terms are
+    monotone, so the sequential conjunction equals the final check). The
+    per-key it-compat effect of narrowing is NOT included — callers fold
+    it via kernels.per_key_ok_at on the written-back requirements."""
+    C = it.zc_avail.shape[3]
+    Z = it.zc_avail.shape[2]
+    fits = grid >= placed[:, None, None]
+    if key_kid == zone_kid:
+        # narrowing IS the zone mask: an un-narrowed complement row keeps
+        # its all-true mask, so no special inf route is needed
+        zm = zset[:, :Z] if Z <= D else jnp.pad(zset, ((0, 0), (0, Z - D)))
+    else:
+        zm = zmask_full[:, :Z]
+    off = (
+        jnp.einsum(
+            "tgzc,nz,nc->ntg",
+            it.zc_avail.astype(jnp.bfloat16),
+            zm.astype(jnp.bfloat16),
+            ct_mask[:, :C].astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        > 0
+    )
+    return jnp.any(fits & off, axis=-1)
+
+
+def _make_kind_step(
+    exist: ExistingNodes,
+    it: InstanceTypeTensors,
+    templates: Templates,
+    well_known: jnp.ndarray,
+    topo: TopologyTensors,
+    zone_kid: int,
+    ct_kid: int,
+    n_claims: int,
+    key_kid: int,
+    D: int,
+    maxc: int,
+):
+    N = n_claims
+    E = exist.avail.shape[0]
+    G = templates.its.shape[0]
+    no_wk = jnp.zeros_like(well_known)
+    i32 = jnp.int32
+
+    def seg_step(state: SolverState, xs: KindXs):
+        count = xs.count
+        requests = xs.requests
+        self_conf = jnp.any(xs.ports & xs.port_conf)
+        pd = xs.strict_mask[key_kid, :D]  # [D] pod strict domains
+        key_touched = jnp.any(xs.vg_applies & topo.vg_valid)
+
+        # ---- per-segment invariants (one full-width pass) -----------------
+        # tier 2: claims
+        pod_b = _broadcast_pod(xs.reqs, N)
+        comb = kernels.intersect_sets(state.reqs, pod_b)
+        claim_ok = kernels.compatible_elemwise(state.reqs, pod_b, well_known)
+        it_compat = kernels.intersects(it.reqs, comb).T  # [N, T]
+        viable0 = state.its & it_compat & xs.it_allow[None, :]
+        tol = xs.tmpl_ok[state.template]
+        ports_ok_n = ~jnp.any(xs.port_conf[None, :] & state.claim_ports, axis=-1)
+        static_n0 = claim_ok & tol & ports_ok_n
+        ct_n = comb.mask[:, ct_kid, :]
+        zfull_n = comb.mask[:, zone_kid, :]
+        grid_n = _cap_res_grid(state.used, requests, it)  # [N, T, GR]
+        capd_n0 = _kscan_capd(
+            grid_n, viable0, ct_n, zfull_n, it, key_kid, zone_kid, D
+        )
+
+        # tier 1: existing nodes
+        pod_e = _broadcast_pod(xs.reqs, E)
+        comb_e = kernels.intersect_sets(state.exist_reqs, pod_e)
+        compat_e = kernels.compatible_elemwise(state.exist_reqs, pod_e, no_wk)
+        ports_ok_e = ~jnp.any(xs.port_conf[None, :] & state.exist_ports, axis=-1)
+        newv_e = state.exist_vols | xs.vols[None, :]
+        vcount_e = jnp.einsum(
+            "ev,vd->ed",
+            newv_e.astype(jnp.bfloat16),
+            exist.vol_driver.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        vols_ok_e = jnp.all(vcount_e <= exist.vol_limits, axis=-1) | ~jnp.any(xs.vols)
+        cap_e = _count_cap_seq(state.exist_used, requests[None, :], exist.avail)
+        static_e = exist.valid & xs.exist_ok & compat_e & ports_ok_e & vols_ok_e
+        cap_e = jnp.where(static_e, cap_e, 0)
+        cap_e = jnp.where(self_conf, jnp.minimum(cap_e, 1), cap_e)
+
+        # tier 3: fresh templates
+        pod_g = _broadcast_pod(xs.reqs, G)
+        comb0 = kernels.intersect_sets(templates.reqs, pod_g)
+        tmpl_compat = kernels.compatible_elemwise(templates.reqs, pod_g, well_known)
+        it_compat0 = kernels.intersects(it.reqs, comb0).T  # [G, T]
+        its0 = templates.its & it_compat0 & xs.it_allow[None, :]
+        static_g = templates.valid & tmpl_compat & xs.tmpl_ok
+        ct_g = comb0.mask[:, ct_kid, :]
+        zfull_g = comb0.mask[:, zone_kid, :]
+        grid_g = _cap_res_grid(templates.daemon_requests, requests, it)
+        capd_g = _kscan_capd(
+            grid_g, its0, ct_g, zfull_g, it, key_kid, zone_kid, D
+        )
+        capd_g = jnp.where(self_conf, jnp.minimum(capd_g, 1), capd_g)
+        z0_g = comb0.mask[:, key_kid, :D]
+        zinf_g = comb0.inf[:, key_kid]
+
+        # vg group geometry for THIS kind (every gated group shares key_kid)
+        gate = xs.vg_applies & topo.vg_valid  # [NGv]
+        recs = xs.vg_records & topo.vg_valid
+        selfs = xs.vg_self
+        dom = topo.vg_domains[:, :D]
+        rank = topo.vg_rank[:, :D]
+        skew = topo.vg_skew
+        mind = topo.vg_min_domains
+        in_universe = dom & pd[None, :]
+        supported = jnp.sum(in_universe, axis=-1).astype(i32)
+        is_anti = topo.vg_type == topo_ops.TYPE_ANTI
+        self_add = selfs.astype(i32)
+
+        def eval_candidates(zs, cnt):
+            """(feasible [C], newz [C, D]) — vg_evaluate on the compact
+            domain columns (exact: D covers every vocab value of the key)."""
+            masked = jnp.where(in_universe, cnt, topo_ops.BIG_I32)
+            minc = jnp.min(masked, axis=-1)
+            minc = jnp.where((mind > 0) & (supported < mind), 0, minc)
+            minc = jnp.where(minc == topo_ops.BIG_I32, 0, minc)
+            eff = cnt + self_add[:, None]
+            ok_skew = (eff - minc[:, None]) <= skew[:, None]
+            opts = dom & pd[None, :] & (cnt > 0)
+            group_empty = ~jnp.any(cnt > 0, axis=-1)
+            no_compat = ~jnp.any(pd[None, :] & (cnt > 0), axis=-1)
+            bootstrap = selfs & (group_empty | no_compat)
+            cnt_zero = cnt == 0
+
+            valid_sp = dom[None] & zs[:, None, :] & ok_skew[None]
+            sp_key = jnp.where(
+                valid_sp, eff[None] * topo_ops.RANK_BASE + rank[None], topo_ops.BIG_I32
+            )
+            sp_mask = topo_ops._onehot_rows(valid_sp, jnp.argmin(sp_key, axis=-1))
+            any_sp = jnp.any(valid_sp, axis=-1)
+
+            opts_c = opts[None] & zs[:, None, :]
+            any_opts = jnp.any(opts_c, axis=-1, keepdims=True)
+            boot_space = (dom & pd[None, :])[None] & zs[:, None, :]
+            boot_idx = jnp.argmin(
+                jnp.where(boot_space, rank[None], topo_ops.BIG_I32), axis=-1
+            )
+            boot_mask = topo_ops._onehot_rows(boot_space, boot_idx)
+            aff_mask = jnp.where(
+                any_opts, opts_c, boot_mask & bootstrap[None, :, None]
+            )
+            any_aff = jnp.any(aff_mask, axis=-1)
+
+            anti_mask = boot_space & cnt_zero[None]
+            any_anti = jnp.any(anti_mask, axis=-1)
+
+            t = topo.vg_type[None, :]
+            narrowed = jnp.where(
+                (t == topo_ops.TYPE_SPREAD)[..., None],
+                sp_mask,
+                jnp.where((t == topo_ops.TYPE_AFFINITY)[..., None], aff_mask, anti_mask),
+            )
+            ok = jnp.where(
+                t == topo_ops.TYPE_SPREAD,
+                any_sp,
+                jnp.where(t == topo_ops.TYPE_AFFINITY, any_aff, any_anti),
+            )
+            feasible = jnp.all(~gate[None, :] | ok, axis=-1)
+            upd = jnp.all(~gate[None, :, None] | narrowed, axis=1)  # [C, D]
+            return feasible, zs & upd
+
+        # carry only what a landing actually mutates; everything else is
+        # derivable from (pl_n, n_open) against segment-start state — the
+        # while-loop body's HLO count is the inner-loop cost driver:
+        #   zinf: collapses to comb.inf & ~key_touched on ANY landing, so
+        #     the winner's post-commit value never needs per-slot state
+        #   open/static/tol for fresh slots: true exactly on
+        #     [n_open0, n_open) (tier 3 opens contiguously)
+        #   total pods: state.pods + pl_n
+        zin0 = comb.inf[:, key_kid]
+        zie0 = comb_e.inf[:, key_kid]
+        n_open0 = state.n_open
+        arange_n = jnp.arange(N, dtype=i32)
+        carry0 = dict(
+            zn=comb.mask[:, key_kid, :D],
+            ze=comb_e.mask[:, key_kid, :D],
+            capd=capd_n0,
+            pl_n=jnp.zeros(N, dtype=i32),
+            pl_e=jnp.zeros(E, dtype=i32),
+            tmpl_n=state.template,
+            cnt=state.vg_counts[:, :D],
+            hgc=state.hg_counts,
+            n_open=state.n_open,
+        )
+
+        def pod_step(c, i):
+            valid = i < count
+            # ONE fused topology/hg evaluation over every candidate tier —
+            # the inner loop runs per pod, so HLO count per iteration is
+            # the cost driver
+            zs_all = jnp.concatenate([c["ze"], c["zn"], z0_g], axis=0)
+            f_topo, newz = eval_candidates(zs_all, c["cnt"])
+            slots_all = jnp.concatenate(
+                [
+                    jnp.arange(E, dtype=i32),
+                    E + jnp.arange(N, dtype=i32),
+                    jnp.broadcast_to(E + c["n_open"], (G,)).astype(i32),
+                ]
+            )
+            hg_ok = topo_ops.hg_evaluate(
+                topo, c["hgc"], slots_all, xs.hg_applies, xs.hg_self
+            )
+
+            # tier 1: earliest feasible existing node
+            feas_e = (c["pl_e"] < cap_e) & f_topo[:E] & hg_ok[:E] & valid
+            pick_e = jnp.argmin(jnp.where(feas_e, jnp.arange(E, dtype=i32), BIG))
+            found_e = jnp.any(feas_e)
+            newz_e = newz[:E]
+
+            # tier 2: fewest pods, earliest slot
+            newz_n = newz[E : E + N]
+            lim_n = jnp.where(self_conf, jnp.minimum(c["capd"], 1), c["capd"])
+            fits_n = jnp.any(newz_n & (lim_n > c["pl_n"][:, None]), axis=-1)
+            fresh_here = (arange_n >= n_open0) & (arange_n < c["n_open"])
+            open_n = state.open | fresh_here
+            stat_n = static_n0 | fresh_here
+            feas_n = (
+                open_n & stat_n & f_topo[E : E + N] & fits_n
+                & hg_ok[E : E + N] & valid & ~found_e
+            )
+            order = (state.pods + c["pl_n"]) * i32(N) + arange_n
+            pick = jnp.argmin(jnp.where(feas_n, order, BIG))
+            found = jnp.any(feas_n)
+
+            # tier 3: first weight-ordered feasible template
+            newz_g = newz[E + N :]
+            fits_g = jnp.any(newz_g & (capd_g >= 1), axis=-1)
+            tmpl_feas = static_g & f_topo[E + N :] & fits_g & hg_ok[E + N :]
+            g = jnp.argmax(tmpl_feas)
+            any_t = jnp.any(tmpl_feas) & valid & ~found_e & ~found
+            can_open = any_t & (c["n_open"] < N)
+
+            place = found_e | found | can_open
+            cslot = jnp.where(found, pick, c["n_open"])
+            slot = jnp.where(found_e, pick_e, E + cslot)
+            assignment = jnp.where(
+                place,
+                slot.astype(i32),
+                jnp.where(any_t, i32(NO_ROOM), i32(NO_CLAIM)),
+            )
+
+            # winner's narrowed set + commits
+            win_z = jnp.where(
+                found_e,
+                newz_e[pick_e],
+                jnp.where(found, newz_n[pick], newz_g[g]),
+            )
+            win_zinf_old = jnp.where(
+                found_e,
+                zie0[pick_e],
+                jnp.where(found, zin0[pick], zinf_g[g]),
+            )
+            win_zinf = win_zinf_old & ~key_touched
+            single = jnp.sum(win_z) == 1
+            do = recs & ~win_zinf & (is_anti | single)
+            delta = (do[:, None] & win_z[None, :]).astype(i32)
+            cnt2 = jnp.where(place, c["cnt"] + delta, c["cnt"])
+            slot_h = jnp.where(found_e, pick_e, E + cslot).astype(i32)
+            hgc2 = jnp.where(
+                place,
+                topo_ops.hg_commit(c["hgc"], slot_h, xs.hg_records, topo.hg_valid),
+                c["hgc"],
+            )
+
+            upd_claim = (found | can_open) & ~found_e
+            opened = can_open & ~found
+            zn2 = jnp.where(
+                upd_claim, c["zn"].at[cslot].set(win_z), c["zn"]
+            )
+            ze2 = jnp.where(
+                found_e, c["ze"].at[pick_e].set(win_z), c["ze"]
+            )
+            capd2 = jnp.where(
+                opened, c["capd"].at[cslot].set(capd_g[g]), c["capd"]
+            )
+            pl_n2 = jnp.where(upd_claim, c["pl_n"].at[cslot].add(1), c["pl_n"])
+            pl_e2 = jnp.where(found_e, c["pl_e"].at[pick_e].add(1), c["pl_e"])
+            tmpl2 = jnp.where(
+                opened, c["tmpl_n"].at[cslot].set(g.astype(i32)), c["tmpl_n"]
+            )
+            n_open2 = c["n_open"] + jnp.where(opened, 1, 0).astype(i32)
+
+            return (
+                dict(
+                    zn=zn2, ze=ze2, capd=capd2,
+                    pl_n=pl_n2, pl_e=pl_e2,
+                    tmpl_n=tmpl2, cnt=cnt2, hgc=hgc2,
+                    n_open=n_open2,
+                ),
+                assignment,
+            )
+
+        # dynamic trip count: segments rarely fill the maxc bucket, and
+        # padded iterations are pure waste at one pod per step
+        assignment0 = jnp.full(maxc, i32(NO_CLAIM))
+
+        def while_cond(loop):
+            i, _c, _a = loop
+            return i < count
+
+        def while_body(loop):
+            i, c, assign = loop
+            c2, a = pod_step(c, i)
+            return i + 1, c2, assign.at[i].set(a)
+
+        _, carry, assignment = jax.lax.while_loop(
+            while_cond, while_body, (i32(0), carry0, assignment0)
+        )
+
+        # ---- segment-end writeback into the full SolverState --------------
+        pl_n = carry["pl_n"]
+        pl_e = carry["pl_e"]
+        landed_n = pl_n > 0
+        landed_e = pl_e > 0
+        opened_here = landed_n & ~state.open
+        tmpl_n = carry["tmpl_n"]
+        zset_f = carry["zn"]
+        zinf_f = zin0 & ~(key_touched & landed_n)
+
+        # usage: one multiply-add per (segment, candidate) — the batch
+        # placement convention (see the fill kernel's module comment)
+        base_used = jnp.where(
+            opened_here[:, None], templates.daemon_requests[tmpl_n], state.used
+        )
+        new_used = jnp.where(
+            landed_n[:, None],
+            base_used + pl_n[:, None].astype(jnp.float32) * requests[None, :],
+            state.used,
+        )
+        new_exist_used = (
+            state.exist_used
+            + pl_e[:, None].astype(jnp.float32) * requests[None, :]
+        )
+
+        # requirements: claim ∩ pod (template ∩ pod for fresh claims) with
+        # the key row narrowed to the carried domain set (_apply_topo
+        # semantics: touched keys become finite In sets)
+        base_reqs = kernels.select_set(
+            opened_here, kernels.take_set(comb0, tmpl_n), comb
+        )
+        km = jnp.zeros_like(base_reqs.mask[:, key_kid, :])
+        km = km.at[:, :D].set(zset_f)
+        km = km | (
+            base_reqs.mask[:, key_kid, :]
+            & jnp.concatenate(
+                [jnp.zeros((N, D), dtype=bool),
+                 jnp.ones((N, km.shape[1] - D), dtype=bool)],
+                axis=1,
+            )
+        )
+        narrowed_mark = landed_n & key_touched
+        new_mask = base_reqs.mask.at[:, key_kid, :].set(km)
+        new_inf_k = jnp.where(landed_n, zinf_f, base_reqs.inf[:, key_kid])
+        new_inf = base_reqs.inf.at[:, key_kid].set(new_inf_k)
+        new_def = base_reqs.defined.at[:, key_kid].set(
+            base_reqs.defined[:, key_kid] | narrowed_mark
+        )
+        new_gte = base_reqs.gte.at[:, key_kid].set(
+            jnp.where(new_inf_k, base_reqs.gte[:, key_kid], INT_MIN)
+        )
+        new_lte = base_reqs.lte.at[:, key_kid].set(
+            jnp.where(new_inf_k, base_reqs.lte[:, key_kid], INT_MAX)
+        )
+        final_reqs = ReqSetTensors(
+            mask=new_mask, inf=new_inf, excl=base_reqs.excl.at[:, key_kid].set(
+                base_reqs.excl[:, key_kid] & new_inf_k
+            ),
+            gte=new_gte, lte=new_lte, defined=new_def,
+        )
+        new_reqs = kernels.select_set(landed_n, final_reqs, state.reqs)
+
+        # viable instance types at the final count within the final domains
+        viable_base = kernels_select_bool(
+            opened_here, its0[tmpl_n], viable0
+        )
+        ok_key = kernels.per_key_ok_at(it.reqs, final_reqs, key_kid)  # [N, T]
+        grid_final = jnp.where(
+            opened_here[:, None, None], grid_g[tmpl_n], grid_n
+        )
+        ct_final = jnp.where(opened_here[:, None], ct_g[tmpl_n], ct_n)
+        zf_final = jnp.where(opened_here[:, None], zfull_g[tmpl_n], zfull_n)
+        fits_f = _kscan_fits_final(
+            grid_final, pl_n, zset_f, ct_final, zf_final, it,
+            key_kid, zone_kid, D,
+        )
+        new_its = jnp.where(
+            landed_n[:, None], viable_base & ok_key & fits_f, state.its
+        )
+
+        new_ports = state.claim_ports | (landed_n[:, None] & xs.ports[None, :])
+        new_eports = state.exist_ports | (landed_e[:, None] & xs.ports[None, :])
+        new_evols = state.exist_vols | (landed_e[:, None] & xs.vols[None, :])
+
+        # existing-node requirements writeback (same key-row treatment)
+        ekm = jnp.zeros_like(comb_e.mask[:, key_kid, :])
+        ekm = ekm.at[:, :D].set(carry["ze"])
+        ekm = ekm | (
+            comb_e.mask[:, key_kid, :]
+            & jnp.concatenate(
+                [jnp.zeros((E, D), dtype=bool),
+                 jnp.ones((E, ekm.shape[1] - D), dtype=bool)],
+                axis=1,
+            )
+        )
+        e_inf_k = zie0 & ~(key_touched & landed_e)
+        e_marked = landed_e & key_touched
+        final_ereqs = ReqSetTensors(
+            mask=comb_e.mask.at[:, key_kid, :].set(ekm),
+            inf=comb_e.inf.at[:, key_kid].set(e_inf_k),
+            excl=comb_e.excl.at[:, key_kid].set(
+                comb_e.excl[:, key_kid] & e_inf_k
+            ),
+            gte=comb_e.gte.at[:, key_kid].set(
+                jnp.where(e_inf_k, comb_e.gte[:, key_kid], INT_MIN)
+            ),
+            lte=comb_e.lte.at[:, key_kid].set(
+                jnp.where(e_inf_k, comb_e.lte[:, key_kid], INT_MAX)
+            ),
+            defined=comb_e.defined.at[:, key_kid].set(
+                comb_e.defined[:, key_kid] | e_marked
+            ),
+        )
+        new_ereqs = kernels.select_set(landed_e, final_ereqs, state.exist_reqs)
+
+        new_vg = state.vg_counts.at[:, :D].set(carry["cnt"])
+
+        ys = KindYs(assignment=assignment.astype(jnp.int32))
+        return (
+            SolverState(
+                exist_reqs=new_ereqs,
+                exist_used=new_exist_used,
+                reqs=new_reqs,
+                used=new_used,
+                its=new_its,
+                template=jnp.where(opened_here, tmpl_n, state.template),
+                open=state.open
+                | ((arange_n >= n_open0) & (arange_n < carry["n_open"])),
+                pods=state.pods + pl_n,
+                n_open=carry["n_open"],
+                budget=state.budget,
+                nodes_budget=state.nodes_budget,
+                vg_counts=new_vg,
+                hg_counts=carry["hgc"],
+                exist_ports=new_eports,
+                claim_ports=new_ports,
+                exist_vols=new_evols,
+                res_cap=state.res_cap,
+                held=state.held,
+            ),
+            ys,
+        )
+
+    return seg_step
+
+
+class KindYs(NamedTuple):
+    """Per-segment kind-scan record: each pod's chosen slot in E-space
+    (existing < E, claims E+slot) or NO_ROOM / NO_CLAIM."""
+
+    assignment: jnp.ndarray  # [MAXC] i32
+
+
+def kernels_select_bool(cond, a, b):
+    """jnp.where over a [N]-cond against [N, T] operands."""
+    return jnp.where(cond[:, None], a, b)
+
+
+_KSCAN_STATIC = ("zone_kid", "ct_kid", "n_claims", "key_kid", "n_domains", "maxc")
+
+
+@functools.partial(jax.jit, static_argnames=_KSCAN_STATIC)
+def solve_kind_scan(
+    state: SolverState,
+    xs: KindXs,
+    exist: ExistingNodes,
+    it: InstanceTypeTensors,
+    templates: Templates,
+    well_known: jnp.ndarray,
+    topo: TopologyTensors,
+    zone_kid: int,
+    ct_kid: int,
+    n_claims: int,
+    key_kid: int,
+    n_domains: int,
+    maxc: int,
+) -> tuple[SolverState, KindYs]:
+    """Scan same-kind batched placement for vocab-key topology kinds over B
+    segments, threading the same SolverState as the fill and per-pod scans
+    (the host interleaves all three dispatches freely)."""
+    step = _make_kind_step(
+        exist, it, templates, well_known, topo, zone_kid, ct_kid,
+        n_claims, key_kid, n_domains, maxc,
+    )
+    return jax.lax.scan(step, state, xs)

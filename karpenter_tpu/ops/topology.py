@@ -364,12 +364,14 @@ def vg_pod_precompute(
 
 
 def _onehot_rows(space: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
-    """[C, NG, V] one-hot of idx per (c, j), zeroed where space is empty."""
-    C, NG = idx.shape
-    out = jnp.zeros_like(space).at[
-        jnp.arange(C)[:, None], jnp.arange(NG)[None, :], idx
-    ].set(True)
-    return out & jnp.any(space, axis=-1, keepdims=True)
+    """[C, NG, V] one-hot of idx per (c, j), zeroed where space is empty.
+
+    Broadcast-compare against an iota instead of a scatter: XLA lowers
+    gather/scatter on TPU to serialized loops, and this runs inside the
+    solver's per-pod step (and the kind scan's per-pod inner loop)."""
+    V = space.shape[-1]
+    oh = jnp.arange(V, dtype=idx.dtype)[None, None, :] == idx[:, :, None]
+    return oh & jnp.any(space, axis=-1, keepdims=True)
 
 
 def vg_evaluate(

@@ -270,6 +270,7 @@ class RemoteScheduler:
         volume_reqs=None,
         reserved_in_use=None,
         bound_pods=None,
+        pod_volumes=None,
     ):
         """Batched what-ifs over the wire: the scenarios' topology seeds
         rebuild SERVER-side from the shipped bound pods (excluding each
@@ -298,6 +299,8 @@ class RemoteScheduler:
             s.excluded_nodes.extend(sorted(excluded))
             s.active_pod_uids.extend(sorted(active))
             s.counted_pod_uids.extend(sorted(counted))
+        for uid, vols in (pod_volumes or {}).items():
+            req.pod_volumes.append(convert.volumes_to_pb(uid, vols))
         for attempt in range(RECONFIGURE_RETRIES + 1):
             try:
                 resp = self._whatif(

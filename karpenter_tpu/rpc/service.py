@@ -128,8 +128,8 @@ class SolverService:
         """Batched consolidation what-ifs over the wire: S exclusion
         scenarios in ONE device dispatch (TPUScheduler.whatif_batch).
         Declines exactly when the in-process prefilter would (multi-alt
-        volumes, CSI limits, per-scenario group-structure divergence) —
-        callers fall back to sequential Solve RPCs."""
+        volumes, per-scenario group-structure divergence) — callers fall
+        back to sequential Solve RPCs. CSI attach limits ride the batch."""
         with self._lock:
             sched, version = self._scheduler, self._version
         if sched is None or request.config_version != version:
@@ -183,6 +183,11 @@ class SolverService:
                 topology_factory,
                 volume_reqs=volume_reqs,
                 reserved_in_use=dict(request.reserved_in_use) or None,
+                pod_volumes={
+                    pv.pod_uid: convert.volumes_from_pb(pv)
+                    for pv in request.pod_volumes
+                }
+                or None,
             )
         resp = pb.WhatIfResponse()
         if out is None:

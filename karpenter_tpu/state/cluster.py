@@ -112,6 +112,12 @@ class Cluster:
         # from prior passes (cluster.go:472 MarkPodSchedulingDecisions) so
         # the provisioner doesn't double-provision for in-flight claims
         self._pod_nominations: dict[str, tuple[str, float]] = {}
+        # node name -> virtual buffer pods placed there by the last solve
+        # (cluster.go UpdateBufferPodCounts): the emptiness path must not
+        # delete nodes that merely host headroom. None = no provisioning
+        # pass observed yet (e.g. fresh restart): with buffers present,
+        # emptiness can't tell headroom nodes apart and must defer
+        self.buffer_pod_counts: "dict[str, int] | None" = None
 
     # -- sync gate (cluster.go:134) -----------------------------------------
 

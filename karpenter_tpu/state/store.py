@@ -140,6 +140,8 @@ class ObjectStore:
     RESOURCE_CLAIMS = "resourceclaims"
     RESOURCE_SLICES = "resourceslices"
     DEVICE_CLASSES = "deviceclasses"
+    POD_TEMPLATES = "podtemplates"  # CapacityBuffer podTemplateRef targets
+    SCALABLES = "scalables"  # CapacityBuffer scalableRef targets
 
     def pods(self) -> list:
         return self.list(self.PODS)

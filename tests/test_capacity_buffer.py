@@ -1,0 +1,199 @@
+"""CapacityBuffer status controller: template/scalable resolution, replica
+computation, ReadyForProvisioning + Provisioning conditions, and the
+emptiness guard for headroom nodes.
+
+Reference: pkg/controllers/capacitybuffer/controller.go (resolution,
+computeReplicas, 30s requeue), helpers.go:32-68 (limit/percentage math),
+pkg/controllers/provisioning/buffers.go:140-380 (Provisioning condition,
+bufferPodCountsFromResults, emptiness protection).
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.controllers.capacity_buffer import (
+    COND_PROVISIONING,
+    COND_READY_FOR_PROVISIONING,
+    CapacityBuffer,
+    CapacityBufferController,
+    PodTemplate,
+    Scalable,
+    resolved_replicas,
+)
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import PodSpec, make_pod
+from karpenter_tpu.state.store import ObjectStore
+from karpenter_tpu.utils import resources as res
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def _env():
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+    from karpenter_tpu.controllers.manager import Manager
+
+    clock = FakeClock()
+    store = ObjectStore(clock)
+    cloud = KwokCloudProvider(store, catalog=instance_types(10))
+    mgr = Manager(store, cloud, clock)
+    pool = NodePool()
+    pool.metadata.name = "default"
+    store.create(ObjectStore.NODEPOOLS, pool)
+    return clock, store, cloud, mgr
+
+
+def _buffer(name, **kwargs):
+    b = CapacityBuffer(**kwargs)
+    b.metadata.name = name
+    return b
+
+
+class TestReplicaResolution:
+    def test_inline_template_fixed_replicas(self):
+        clock = FakeClock()
+        store = ObjectStore(clock)
+        ctrl = CapacityBufferController(store, clock)
+        b = _buffer("warm", replicas=3, pod_template=PodSpec(requests={res.CPU: 1.0}))
+        store.create(ObjectStore.CAPACITY_BUFFERS, b)
+        out = ctrl.reconcile()
+        assert out == {"resolved": 1, "failed": 0}
+        assert b.conditions.is_true(COND_READY_FOR_PROVISIONING)
+        assert b.status.replicas == 3 and resolved_replicas(b) == 3
+
+    def test_pod_template_ref_resolution_and_not_found(self):
+        clock = FakeClock()
+        store = ObjectStore(clock)
+        ctrl = CapacityBufferController(store, clock)
+        b = _buffer("warm", replicas=2, pod_template_ref="tmpl")
+        store.create(ObjectStore.CAPACITY_BUFFERS, b)
+        out = ctrl.reconcile()
+        assert out["failed"] == 1
+        assert b.conditions.is_false(COND_READY_FOR_PROVISIONING)
+        assert (
+            b.conditions.get(COND_READY_FOR_PROVISIONING).reason
+            == "PodTemplateNotFound"
+        )
+        assert resolved_replicas(b) == 0  # failed resolution: no headroom
+        tmpl = PodTemplate(spec=PodSpec(requests={res.CPU: 0.5}))
+        tmpl.metadata.name = "tmpl"
+        store.create(ObjectStore.POD_TEMPLATES, tmpl)
+        ctrl.reconcile()
+        assert b.conditions.is_true(COND_READY_FOR_PROVISIONING)
+        assert resolved_replicas(b) == 2
+
+    def test_scalable_percentage_is_ceil_with_floor_one(self):
+        clock = FakeClock()
+        store = ObjectStore(clock)
+        ctrl = CapacityBufferController(store, clock)
+        s = Scalable(replicas=10, pod_spec=PodSpec(requests={res.CPU: 1.0}))
+        s.metadata.name = "deploy"
+        store.create(ObjectStore.SCALABLES, s)
+        # ceil(10 * 25 / 100) = 3 (helpers.go:59-68)
+        b = _buffer("pct", scalable_ref="deploy", percentage=25)
+        # 1% of 10 -> ceil(0.1) floored at 1
+        tiny = _buffer("tiny", scalable_ref="deploy", percentage=1)
+        # max(fixed, percentage): fixed 5 beats 3
+        both = _buffer("both", scalable_ref="deploy", percentage=25, replicas=5)
+        for x in (b, tiny, both):
+            store.create(ObjectStore.CAPACITY_BUFFERS, x)
+        ctrl.reconcile()
+        assert b.status.replicas == 3
+        assert tiny.status.replicas == 1
+        assert both.status.replicas == 5
+
+    def test_limits_bound_the_replica_count(self):
+        clock = FakeClock()
+        store = ObjectStore(clock)
+        ctrl = CapacityBufferController(store, clock)
+        spec = PodSpec(requests={res.CPU: 2.0})
+        # floor(5/2) = 2 bounds the fixed 4 (helpers.go:32-56)
+        capped = _buffer("capped", replicas=4, pod_template=spec, limits={res.CPU: 5.0})
+        # limits alone determine the count when no size constraint is set
+        only = _buffer("only-limits", pod_template=spec, limits={res.CPU: 6.0})
+        for x in (capped, only):
+            store.create(ObjectStore.CAPACITY_BUFFERS, x)
+        ctrl.reconcile()
+        assert capped.status.replicas == 2
+        assert only.status.replicas == 3
+
+    def test_thirty_second_requeue(self):
+        clock = FakeClock()
+        store = ObjectStore(clock)
+        ctrl = CapacityBufferController(store, clock)
+        ctrl.reconcile()
+        assert ctrl.maybe_reconcile() is None
+        clock.step(31.0)
+        assert ctrl.maybe_reconcile() is not None
+
+
+class TestProvisioningCondition:
+    def test_headroom_lifecycle_requires_new_then_fits_existing(self):
+        from karpenter_tpu.controllers.manager import KubeSchedulerSim
+
+        clock, store, cloud, mgr = _env()
+        b = _buffer(
+            "warm", replicas=2, pod_template=PodSpec(requests={res.CPU: 1.0})
+        )
+        store.create(ObjectStore.CAPACITY_BUFFERS, b)  # event: resolve+trigger
+        assert b.conditions.is_true(COND_READY_FOR_PROVISIONING)
+        clock.step(2.0)
+        mgr.run_until_idle()
+        claims = store.nodeclaims()
+        assert claims, "no headroom provisioned"
+        # first pass: the headroom needed new claims
+        assert b.conditions.is_false(COND_PROVISIONING)
+        assert b.conditions.get(COND_PROVISIONING).reason == "RequiresNewCapacity"
+        # nodes come up; the next pass places headroom on existing capacity
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        KubeSchedulerSim(store, mgr.cluster).bind_pending()
+        # virtual pods stay nominated to their claims for the nomination
+        # window; the next solve that re-evaluates them comes after expiry
+        clock.step(121.0)
+        mgr.batcher.trigger()
+        clock.step(2.0)
+        mgr.run_until_idle()
+        assert b.conditions.is_true(COND_PROVISIONING)
+        assert b.conditions.get(COND_PROVISIONING).reason == "FitsExistingCapacity"
+
+    def test_real_pods_displace_virtuals_and_emptiness_guard_holds(self):
+        from karpenter_tpu.controllers.manager import KubeSchedulerSim
+
+        clock, store, cloud, mgr = _env()
+        b = _buffer(
+            "warm", replicas=2, pod_template=PodSpec(requests={res.CPU: 1.0})
+        )
+        store.create(ObjectStore.CAPACITY_BUFFERS, b)
+        clock.step(2.0)
+        mgr.run_until_idle()
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        KubeSchedulerSim(store, mgr.cluster).bind_pending()
+        clock.step(121.0)  # past the nomination window
+        mgr.batcher.trigger()
+        clock.step(2.0)
+        mgr.run_until_idle()
+        # headroom nodes host ONLY virtual pods, yet emptiness must not
+        # reap them (buffers.go:145-150 bufferPodCounts)
+        assert mgr.cluster.buffer_pod_counts, "no headroom counts recorded"
+        clock.step(60.0)
+        cmd = mgr.run_disruption_once()
+        assert cmd is None or not cmd.candidates, "emptiness reaped headroom"
+        # real pods arrive and displace the virtual headroom on the nodes
+        for i in range(2):
+            store.create(ObjectStore.PODS, make_pod(f"real-{i}", cpu=1.0))
+        mgr.run_until_idle()
+        KubeSchedulerSim(store, mgr.cluster).bind_pending()
+        bound = [p for p in store.pods() if p.spec.node_name]
+        assert len(bound) == 2, "real pods did not bind onto headroom nodes"
+
+
+class TestBufferEmpty:
+    def test_zero_replicas_reports_buffer_empty(self):
+        clock, store, _cloud, mgr = _env()
+        b = _buffer("empty", replicas=0, pod_template=PodSpec(requests={res.CPU: 1.0}))
+        store.create(ObjectStore.CAPACITY_BUFFERS, b)
+        store.create(ObjectStore.PODS, make_pod("p-0", cpu=0.5))
+        clock.step(2.0)
+        mgr.run_until_idle()
+        assert b.conditions.is_false(COND_PROVISIONING)
+        assert b.conditions.get(COND_PROVISIONING).reason == "BufferEmpty"

@@ -69,6 +69,43 @@ class TestWhatIfBatch:
         want = sequential_signal(mgr.provisioner, candidates)
         assert signals[0] == want
 
+    def test_csi_attach_limits_ride_the_batch(self):
+        # VERDICT r4 #5: CSI-limit scenarios used to decline to sequential
+        # simulation; now displaced pods re-attach their PVC columns inside
+        # the batched solve (volumeusage.go:201-208 x
+        # multinodeconsolidation.go:136-183). Verdicts must match the
+        # sequential path exactly.
+        from karpenter_tpu.scheduling.hostports import (
+            PersistentVolumeClaim,
+            StorageClass,
+        )
+
+        clock, store, cloud, mgr = build_cluster(n_small_pods=4)
+        sc = StorageClass(provisioner="ebs.csi")
+        sc.metadata.name = "standard"
+        store.create(ObjectStore.STORAGE_CLASSES, sc)
+        for i, p in enumerate(sorted(store.pods(), key=lambda p: p.name)):
+            claim = PersistentVolumeClaim(storage_class="standard")
+            claim.metadata.name = f"vol-{i}"
+            store.create(ObjectStore.PVCS, claim)
+            p.spec.pvc_names = [f"vol-{i}"]
+        # every node publishes a TIGHT attach limit, so consolidation onto
+        # a survivor is capacity-feasible but attach-infeasible beyond it
+        for node in store.nodes():
+            node.spec.csi_drivers = {"ebs.csi": 2}
+        candidates = node_candidates(store)
+        assert len(candidates) >= 3
+        scenarios = [candidates[:n] for n in range(1, len(candidates) + 1)]
+        scenarios += [[c] for c in candidates]
+        signals = mgr.provisioner.simulate_batch(scenarios)
+        assert signals is not None, "CSI-limit scenarios must not decline"
+        for scen, got in zip(scenarios, signals):
+            want = sequential_signal(mgr.provisioner, scen)
+            assert want is not None
+            assert got == want, (
+                f"scenario {[c.name for c in scen]}: batch {got} vs sequential {want}"
+            )
+
     def test_anti_affinity_bound_pods_fall_back_to_sequential(self):
         # Inverse anti-affinity groups derive from bound pods, which differ
         # per exclusion set; the shared batch encoding can't represent that,
