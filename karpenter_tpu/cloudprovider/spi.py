@@ -63,6 +63,18 @@ class CloudProvider(abc.ABC):
     def repair_policies(self) -> list[RepairPolicy]:
         return []
 
+    def registration_hooks(self) -> list:
+        """NodeLifecycleHook analogs (types.go:103-118): objects exposing
+        `name` and `registered(node_claim) -> bool`. Registration completes
+        — and the unregistered NoExecute taint drops — only once EVERY
+        hook reports ready (registration.go:96-105); until then the claim
+        stays gated with its node labels/taints synced. Decorators forward
+        to their inner provider automatically."""
+        inner = getattr(self, "inner", None)
+        if inner is not None:
+            return inner.registration_hooks()
+        return []
+
     @property
     @abc.abstractmethod
     def name(self) -> str: ...

@@ -119,18 +119,40 @@ def resolved_replicas(buffer: CapacityBuffer) -> int:
     return buffer.replicas
 
 
+def resolve_buffer(
+    buffer: CapacityBuffer, store: Optional[ObjectStore]
+) -> tuple[Optional[PodSpec], Optional[int], Optional[str]]:
+    """THE resolution walk (controller.go:146-176), shared by the status
+    controller, the virtual-pod factory and the provisioner's cache key so
+    they can never disagree: podTemplateRef > scalableRef > inline.
+    Returns (spec, scalable_replicas, failure_reason)."""
+    if buffer.pod_template_ref is not None:
+        tmpl = (
+            store.get(ObjectStore.POD_TEMPLATES, buffer.pod_template_ref)
+            if store is not None
+            else None
+        )
+        if tmpl is None:
+            return None, None, "PodTemplateNotFound"
+        return tmpl.spec, None, None
+    if buffer.scalable_ref is not None:
+        s = (
+            store.get(ObjectStore.SCALABLES, buffer.scalable_ref)
+            if store is not None
+            else None
+        )
+        if s is None:
+            return None, None, "ScalableRefNotFound"
+        return s.pod_spec, s.replicas, None
+    return buffer.pod_template, None, None
+
+
 def resolved_pod_spec(
     buffer: CapacityBuffer, store: Optional[ObjectStore]
 ) -> Optional[PodSpec]:
-    """The pod shape to replicate, following refs through the store
-    (controller.go:146-176): podTemplateRef > scalableRef > inline."""
-    if buffer.pod_template_ref is not None and store is not None:
-        tmpl = store.get(ObjectStore.POD_TEMPLATES, buffer.pod_template_ref)
-        return tmpl.spec if tmpl is not None else None
-    if buffer.scalable_ref is not None and store is not None:
-        s = store.get(ObjectStore.SCALABLES, buffer.scalable_ref)
-        return s.pod_spec if s is not None else None
-    return buffer.pod_template
+    """The pod shape to replicate, or None when a ref doesn't resolve."""
+    spec, _replicas, _reason = resolve_buffer(buffer, store)
+    return spec
 
 
 def virtual_pods(
@@ -191,43 +213,32 @@ class CapacityBufferController:
         failed = 0
         changed = 0
         buffers = self.store.list(ObjectStore.CAPACITY_BUFFERS)
-        self._last_sig = {
-            k: v for k, v in self._last_sig.items() if k in {b.name for b in buffers}
-        }
+        live = {b.name for b in buffers}
+        removed = len(self._last_sig.keys() - live)
+        # a deleted buffer must trigger a pass too: its headroom counts
+        # need recomputing (or clearing) so emptiness can reclaim nodes
+        changed += removed
+        self._last_sig = {k: v for k, v in self._last_sig.items() if k in live}
         for cb in buffers:
-            spec = None
-            candidates: list[int] = []
-            if cb.pod_template_ref is not None:
-                tmpl = self.store.get(ObjectStore.POD_TEMPLATES, cb.pod_template_ref)
-                if tmpl is None:
-                    cb.conditions.set_false(
-                        COND_READY_FOR_PROVISIONING,
-                        "PodTemplateNotFound",
-                        f"pod template {cb.pod_template_ref!r} not found",
-                        now=now,
-                    )
-                    failed += 1
-                    continue
-                spec = tmpl.spec
-                cb.status.pod_template_generation = getattr(
-                    tmpl.metadata, "generation", None
+            spec, scalable_replicas, reason = resolve_buffer(cb, self.store)
+            if reason is not None:
+                cb.conditions.set_false(
+                    COND_READY_FOR_PROVISIONING,
+                    reason,
+                    f"{reason}: {cb.pod_template_ref or cb.scalable_ref!r}",
+                    now=now,
                 )
-            elif cb.scalable_ref is not None:
-                s = self.store.get(ObjectStore.SCALABLES, cb.scalable_ref)
-                if s is None:
-                    cb.conditions.set_false(
-                        COND_READY_FOR_PROVISIONING,
-                        "ScalableRefNotFound",
-                        f"scalable {cb.scalable_ref!r} not found",
-                        now=now,
-                    )
-                    failed += 1
-                    continue
-                spec = s.pod_spec
-                if cb.percentage is not None and s.replicas > 0:
-                    candidates.append(_percentage_replicas(s.replicas, cb.percentage))
-            else:
-                spec = cb.pod_template
+                failed += 1
+                continue
+            candidates: list[int] = []
+            if (
+                cb.percentage is not None
+                and scalable_replicas is not None
+                and scalable_replicas > 0
+            ):
+                candidates.append(
+                    _percentage_replicas(scalable_replicas, cb.percentage)
+                )
 
             # replicas = max(fixed, percentage), bounded by limits; with
             # no size constraint, limits alone determine the count

@@ -161,9 +161,19 @@ class Manager:
             ObjectStore.SCALABLES,
         ):
             self.store.watch(kind, self._on_buffer_event)
+        self.store.watch(ObjectStore.RESOURCE_SLICES, self._on_resource_slice)
 
     def _on_buffer_event(self, event: EventType, obj) -> None:
         self.capacity_buffer.reconcile()
+
+    def _on_resource_slice(self, event: EventType, obj) -> None:
+        # a driver publishing its pool can unblock initialization
+        # (initialization.go:148-178 draDriverPoolsPublished)
+        from karpenter_tpu.models.nodeclaim import COND_INITIALIZED
+
+        for claim in self.store.nodeclaims():
+            if not claim.conditions.is_true(COND_INITIALIZED):
+                self._dirty_claims.add(claim.name)
 
     def _on_overlay(self, event: EventType, overlay) -> None:
         self._catalog_by_name.clear()

@@ -84,8 +84,19 @@ class NodeClaimLifecycleController:
         node = self._node_for(claim)
         if node is None:
             return False
-        # sync labels/taints from the claim, drop the unregistered taint
-        node.metadata.labels.update(claim.metadata.labels)
+        # sync labels/annotations/taints from the claim onto the node
+        # (registration.go:207-221 syncNode): claim taints + startup
+        # taints merge in unless the provider opted out of taint syncing
+        synced = self._sync_node(claim, node)
+        # provider registration hooks gate completion (registration.go:
+        # 96-105 checkRegistrationHooks + types.go:103-118): until every
+        # hook is ready the node stays synced but UNREGISTERED (the
+        # NoExecute taint keeps workloads off)
+        hooks = self.cloud.registration_hooks()
+        if any(not h.registered(claim) for h in hooks):
+            if synced:  # write back only on change (idempotent reconciler)
+                self.store.update(ObjectStore.NODES, node)
+            return False
         node.metadata.labels[l.NODE_REGISTERED_LABEL_KEY] = "true"
         node.spec.taints = [
             t for t in node.spec.taints if not t.match(UNREGISTERED_NO_EXECUTE_TAINT)
@@ -94,6 +105,27 @@ class NodeClaimLifecycleController:
         self.store.update(ObjectStore.NODES, node)
         claim.conditions.set_true(COND_REGISTERED, "Registered", now=self.clock.now())
         return True
+
+    @staticmethod
+    def _sync_node(claim: NodeClaim, node) -> bool:
+        """registration.go:207-221: labels/annotations always sync; taints
+        merge (no duplicates) unless karpenter.sh/do-not-sync-taints.
+        Returns True when anything actually changed."""
+        changed = False
+        for src, dst in (
+            (claim.metadata.labels, node.metadata.labels),
+            (claim.metadata.annotations, node.metadata.annotations),
+        ):
+            for k, v in src.items():
+                if dst.get(k) != v:
+                    dst[k] = v
+                    changed = True
+        if node.metadata.labels.get(l.DO_NOT_SYNC_TAINTS_LABEL_KEY) != "true":
+            for t in list(claim.spec.taints) + list(claim.spec.startup_taints):
+                if not any(existing.match(t) for existing in node.spec.taints):
+                    node.spec.taints.append(t)
+                    changed = True
+        return changed
 
     # -- initialization (initialization.go:56-263) -----------------------------
 
@@ -115,6 +147,25 @@ class NodeClaimLifecycleController:
         ]
         if blocking:
             return False
+        # requested resources registered (initialization.go:130-146): the
+        # kubelet zeroes extended resources on startup, so a requested
+        # resource with zero allocatable means its device plugin hasn't
+        # registered yet — initialization must wait
+        for res_name, qty in claim.spec.requests.items():
+            if qty > 0 and not node.status.allocatable.get(res_name, 0.0):
+                return False
+        # DRA driver pools published (initialization.go:148-178): every
+        # driver recorded on the claim must have a ResourceSlice pinned to
+        # this node before workloads can rely on its devices
+        drivers = claim.metadata.annotations.get(l.DRA_DRIVERS_ANNOTATION_KEY)
+        if drivers:
+            published = {
+                s.driver
+                for s in self.store.list(ObjectStore.RESOURCE_SLICES)
+                if s.node_name == node.name
+            }
+            if any(d and d not in published for d in drivers.split(",")):
+                return False
         node.metadata.labels[l.NODE_INITIALIZED_LABEL_KEY] = "true"
         self.store.update(ObjectStore.NODES, node)
         claim.conditions.set_true(COND_INITIALIZED, "Initialized", now=self.clock.now())
@@ -127,6 +178,15 @@ class NodeClaimLifecycleController:
             return
         age = self.clock.now() - claim.metadata.creation_timestamp
         if age > LAUNCH_TTL_SECONDS:
+            # stamp the reason BEFORE deleting so the informer's DELETED
+            # event (and anything reading the final object) can tell a
+            # liveness reap from an operator delete (liveness.go:87-93)
+            claim.conditions.set_false(
+                COND_REGISTERED,
+                "LivenessTimeout",
+                f"registration did not complete within {LAUNCH_TTL_SECONDS:.0f}s",
+                self.clock.now(),
+            )
             claim.metadata.finalizers = []
             self.store.delete(ObjectStore.NODECLAIMS, claim.name)
 
@@ -165,6 +225,45 @@ class NodeClaimLifecycleController:
                 # requeue: the drain is incomplete and the grace period (if
                 # any) hasn't expired — the instance must keep running
                 return
+            # await volume detachment (termination/controller.go:236-277):
+            # the attach-detach controller deletes VolumeAttachments as
+            # drained pods' volumes unmount; terminating the instance
+            # first would strand writes. Attachments whose volume is held
+            # ONLY by a non-drainable pod never detach and must not block
+            # (filterVolumeAttachments). The TGP overrides the wait.
+            blocked_pvcs = {
+                pvc
+                for p in blocking
+                for pvc in p.spec.pvc_names
+            }
+            pending = [
+                va
+                for va in self.store.list(ObjectStore.VOLUME_ATTACHMENTS)
+                if va.node_name == node.name and va.pvc_name not in blocked_pvcs
+            ]
+            if pending and not grace_elapsed:
+                from karpenter_tpu.models.nodeclaim import COND_VOLUMES_DETACHED
+
+                claim.conditions.set_unknown(
+                    COND_VOLUMES_DETACHED,
+                    "AwaitingVolumeDetachment",
+                    f"{len(pending)} volume attachments pending",
+                    self.clock.now(),
+                )
+                return
+            from karpenter_tpu.models.nodeclaim import COND_VOLUMES_DETACHED
+
+            if pending:
+                claim.conditions.set_false(
+                    COND_VOLUMES_DETACHED,
+                    "TerminationGracePeriodElapsed",
+                    "TerminationGracePeriodElapsed",
+                    self.clock.now(),
+                )
+            else:
+                claim.conditions.set_true(
+                    COND_VOLUMES_DETACHED, "VolumesDetached", now=self.clock.now()
+                )
         metrics.NODECLAIMS_TERMINATED.inc(
             reason=claim.metadata.annotations.get(
                 "karpenter.sh/termination-reason", "deleted"

@@ -643,14 +643,21 @@ class Provisioner:
     def _register_device_allocations(self, dra_round, sim: SimClaim, claim: NodeClaim) -> None:
         """Hand the winning round's per-claim allocation metadata to the
         deviceallocation controller, keyed to the real NodeClaim (the
-        simulation knows it only by placeholder hostname)."""
+        simulation knows it only by placeholder hostname). The claim is
+        annotated with the allocated DRA driver set (labels.go:56-59) so
+        initialization waits for those drivers' ResourceSlices
+        (initialization.go:148-178 — without the annotation the node
+        would flip Initialized before its devices exist)."""
         from karpenter_tpu.controllers.device_allocation import PendingAllocation
 
+        drivers: set[str] = set()
         for claim_key, meta in dra_round.allocator.claim_allocation_metadata.items():
             if meta.nodeclaim_id != sim.hostname:
                 continue
             claim_name = claim_key.split("/", 1)[1]
             pod_uids = [p.uid for p in sim.pods if claim_name in p.spec.resource_claims]
+            for results in meta.devices.values():
+                drivers.update(r.device_id.driver for r in results)
             self.device_allocation.register(
                 PendingAllocation(
                     claim_name=claim_name,
@@ -664,6 +671,11 @@ class Provisioner:
                     },
                 )
             )
+        if drivers:
+            claim.metadata.annotations[l.DRA_DRIVERS_ANNOTATION_KEY] = ",".join(
+                sorted(drivers)
+            )
+            self.store.update(ObjectStore.NODECLAIMS, claim)
 
     def _register_existing_device_allocations(self, result: SchedulingResult) -> None:
         """Claims allocated against existing nodes collapse immediately —
@@ -753,6 +765,11 @@ class Provisioner:
             metrics.SCHEDULER_UNFINISHED_WORK.set(0.0)
             metrics.SCHEDULER_IGNORED_PODS.set(0.0)
             metrics.PENDING_PODS_BY_ZONE.values.clear()
+            if not self.store.list(self.store.CAPACITY_BUFFERS):
+                # no buffers -> no headroom anywhere: clear the emptiness
+                # guard so ex-headroom nodes of a deleted buffer don't
+                # stay protected forever (no solve runs to recompute it)
+                self.cluster.buffer_pod_counts = {}
             return None
         if not self.cluster.synced():
             return self.GATED

@@ -36,6 +36,9 @@ DO_NOT_SYNC_TAINTS_LABEL_KEY = GROUP + "/do-not-sync-taints"
 
 # annotations
 DO_NOT_DISRUPT_ANNOTATION_KEY = GROUP + "/do-not-disrupt"
+# comma-separated DRA driver names whose device pools must publish before
+# the claim initializes (labels.go:56-59)
+DRA_DRIVERS_ANNOTATION_KEY = GROUP + "/requested-dra-drivers"
 NODEPOOL_HASH_ANNOTATION_KEY = GROUP + "/nodepool-hash"
 NODEPOOL_HASH_VERSION_ANNOTATION_KEY = GROUP + "/nodepool-hash-version"
 NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION_KEY = GROUP + "/nodeclaim-termination-timestamp"

@@ -35,3 +35,22 @@ class Node:
     @property
     def name(self) -> str:
         return self.metadata.name
+
+
+@dataclass
+class VolumeAttachment:
+    """storage.k8s.io/v1 VolumeAttachment (the subset termination awaits —
+    termination/controller.go:236-277): the attach-detach controller
+    deletes these as volumes unmount from a draining node. `pvc_name`
+    stands in for the Pod -> PVC -> PV <- VolumeAttachment join the
+    reference walks (filterVolumeAttachments): the harness PVC is the
+    volume identity."""
+
+    metadata: ObjectMeta = field(default_factory=lambda: ObjectMeta(name="attachment"))
+    node_name: str = ""
+    attacher: str = ""
+    pvc_name: str = ""
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name

@@ -9,12 +9,12 @@ in-process or remote.
 
 Split of labor:
 - Remote: the full relaxation ladder, NO_ROOM recovery, device dispatch,
-  host-oracle fallbacks for volume alternatives / CSI limits — everything
-  TPUScheduler.solve does, running next to the TPU.
-- Local: DRA solves (the allocator holds live object-store references —
-  see solver.proto header) run on a local HostScheduler, mirroring the
-  device engine's own DRA routing.
-- whatif_batch crosses the wire too (the WhatIf RPC): scenarios'
+  host-oracle fallbacks for volume alternatives — everything
+  TPUScheduler.solve does, running next to the TPU. DRA solves cross the
+  wire too: the DRAProblem is a self-contained snapshot, the server's
+  host engine runs the allocation DFS, and the winning round's per-claim
+  metadata ships back (rpc/dra_codec.py).
+- whatif_batch crosses the wire as well (the WhatIf RPC): scenarios'
   topology seeds rebuild server-side from shipped bound pods; the client
   returns None (sequential-simulate fallback) when bound pods are
   unavailable or the server declines/predates the RPC.
@@ -28,7 +28,6 @@ from typing import Optional, Sequence
 import grpc
 
 from karpenter_tpu.controllers.provisioning.host_scheduler import (
-    HostScheduler,
     SchedulingResult,
     normalize_volume_reqs,
 )
@@ -172,35 +171,16 @@ class RemoteScheduler:
         now=None,
         bound_pods=None,
     ) -> SchedulingResult:
-        if dra_problem is not None and any(p.spec.resource_claims for p in pods):
-            # DRA never crosses the wire (allocator holds store refs);
-            # mirror the device engine's host routing, locally.
-            from karpenter_tpu.utils.metrics import SOLVER_HOST_FALLBACKS
-
-            SOLVER_HOST_FALLBACKS.inc(reason="dra")
-            host = HostScheduler(
-                self.templates,
-                existing_nodes=[n.clone() for n in (existing_nodes or [])],
-                budgets=budgets,
-                topology=(
-                    topology_factory(list(pods))
-                    if topology_factory is not None
-                    else topology
-                ),
-                volume_reqs=normalize_volume_reqs(volume_reqs),
-                reserved_mode=reserved_mode if reserved_mode is not None else self.reserved_mode,
-                reserved_capacity_enabled=self.reserved_capacity_enabled,
-                min_values_policy=self.min_values_policy,
-                reserved_in_use=reserved_in_use,
-                dra_problem=dra_problem,
-                pod_volumes=pod_volumes,
-                deadline=deadline,
-                now=now,
-            )
-            return host.solve(list(pods))
-
         t0 = time.perf_counter()
         req = pb.SolveRequest(config_version=self._config_version)
+        if dra_problem is not None and any(p.spec.resource_claims for p in pods):
+            # the DRAProblem is a self-contained snapshot (slices, classes,
+            # claims, allocation seeds) — it crosses the wire and the
+            # SERVER's host engine runs the allocation DFS
+            # (rpc/dra_codec.py; allocator.go:231-296)
+            from karpenter_tpu.rpc.dra_codec import encode_dra_problem
+
+            req.dra_problem_json = encode_dra_problem(dra_problem)
         pods = list(pods)
         self._encode_common(req, pods, existing_nodes, budgets, volume_reqs, reserved_in_use)
         for entry in bound_pods or []:
@@ -252,6 +232,10 @@ class RemoteScheduler:
             {p.uid: p for p in pods},
             existing_nodes,
         )
+        if resp.dra_metadata_json:
+            from karpenter_tpu.rpc.dra_codec import RemoteDRARound, decode_dra_metadata
+
+            result.dra = RemoteDRARound(decode_dra_metadata(resp.dra_metadata_json))
         t_end = time.perf_counter()
         self.last_timings = {
             "encode_s": t_encode - t0,

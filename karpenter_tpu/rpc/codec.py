@@ -6,9 +6,10 @@ RPC. The solve hot path is typed protobuf (solver.proto); this is the
 rarely-crossed config plane, so a readable canonical JSON keyed by the
 dataclass fields is the right altitude.
 
-The codec is lossless for everything scheduling consumes. DRA device
-templates (InstanceType.dra_slices / dra_attribute_bindings) are NOT
-serialized — DRA solves never cross the wire (see solver.proto header).
+The codec is lossless for everything scheduling consumes, including the
+DRA device templates (InstanceType.dra_slices / dra_attribute_bindings)
+the remote host solve allocates from (rpc/dra_codec.py carries the rest
+of the DRA wire surface: problems in, allocation metadata out).
 """
 
 from __future__ import annotations
@@ -130,22 +131,47 @@ def offering_from_dict(d: dict) -> Offering:
 
 
 def instance_type_to_dict(it: InstanceType) -> dict:
-    return {
+    out = {
         "name": it.name,
         "requirements": requirements_to_list(it.requirements),
         "offerings": [offering_to_dict(o) for o in it.offerings],
         "capacity": it.capacity,
         "overhead": _overhead_to_dict(it.overhead),
     }
+    # DRA device templates: the remote host solve needs per-IT potential
+    # slices and attribute-binding declarations to allocate template
+    # devices exactly like the in-process engine (rpc/dra_codec.py)
+    if getattr(it, "dra_slices", None):
+        from karpenter_tpu.rpc import dra_codec
+
+        out["draSlices"] = [dra_codec.slice_to_dict(s) for s in it.dra_slices]
+    if getattr(it, "dra_attribute_bindings", None):
+        from karpenter_tpu.rpc import dra_codec
+
+        out["draBindings"] = [
+            dra_codec.binding_decl_to_dict(b) for b in it.dra_attribute_bindings
+        ]
+    return out
 
 
 def instance_type_from_dict(d: dict) -> InstanceType:
+    dra_slices = None
+    dra_bindings = None
+    if "draSlices" in d or "draBindings" in d:
+        from karpenter_tpu.rpc import dra_codec
+
+        dra_slices = [dra_codec.slice_from_dict(s) for s in d.get("draSlices", [])]
+        dra_bindings = [
+            dra_codec.binding_decl_from_dict(b) for b in d.get("draBindings", [])
+        ]
     return InstanceType(
         name=d["name"],
         requirements=requirements_from_list(d["requirements"]),
         offerings=[offering_from_dict(o) for o in d["offerings"]],
         capacity=dict(d["capacity"]),
         overhead=_overhead_from_dict(d["overhead"]),
+        dra_slices=dra_slices,
+        dra_attribute_bindings=dra_bindings,
     )
 
 

@@ -107,6 +107,14 @@ class SolverService:
             )
             return Topology.build(current_pods, universe, bound)
 
+        dra_problem = None
+        if request.dra_problem_json:
+            # snapshot in, metadata out: the server's host engine runs the
+            # allocation DFS against the shipped state (rpc/dra_codec.py)
+            from karpenter_tpu.rpc.dra_codec import decode_dra_problem
+
+            dra_problem = decode_dra_problem(request.dra_problem_json, sched.templates)
+
         deadline = None
         if request.HasField("timeout_seconds"):
             deadline = time.monotonic() + request.timeout_seconds
@@ -120,9 +128,17 @@ class SolverService:
                 reserved_mode=request.reserved_mode or None,
                 reserved_in_use=dict(request.reserved_in_use) or None,
                 pod_volumes=pod_volumes,
+                dra_problem=dra_problem,
                 deadline=deadline,
             )
-        return convert.result_to_pb(result, sched.templates)
+        resp = convert.result_to_pb(result, sched.templates)
+        if result.dra is not None:
+            from karpenter_tpu.rpc.dra_codec import encode_dra_metadata
+
+            resp.dra_metadata_json = encode_dra_metadata(
+                result.dra.allocator.claim_allocation_metadata
+            )
+        return resp
 
     def WhatIf(self, request: pb.WhatIfRequest, context) -> pb.WhatIfResponse:
         """Batched consolidation what-ifs over the wire: S exclusion

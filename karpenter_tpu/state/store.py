@@ -141,6 +141,7 @@ class ObjectStore:
     RESOURCE_SLICES = "resourceslices"
     DEVICE_CLASSES = "deviceclasses"
     POD_TEMPLATES = "podtemplates"  # CapacityBuffer podTemplateRef targets
+    VOLUME_ATTACHMENTS = "volumeattachments"
     SCALABLES = "scalables"  # CapacityBuffer scalableRef targets
 
     def pods(self) -> list:

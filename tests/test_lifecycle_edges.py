@@ -1,0 +1,231 @@
+"""Lifecycle edges: registration hooks + taint sync, initialization's
+resource/DRA readiness checks, liveness condition stamping, and the
+volume-detach await in finalization.
+
+Reference: pkg/controllers/nodeclaim/lifecycle/registration.go:59-221
+(hooks gate + syncNode), initialization.go:56-263 (requested resources
+registered, DRA pools published), liveness.go:59-113, and
+pkg/controllers/node/termination/controller.go:236-277
+(awaitVolumeDetachment incl. the non-drainable filter and TGP override).
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.controllers.manager import KubeSchedulerSim, Manager
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.node import VolumeAttachment
+from karpenter_tpu.models.nodeclaim import (
+    COND_INITIALIZED,
+    COND_REGISTERED,
+    COND_VOLUMES_DETACHED,
+)
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import make_pod
+from karpenter_tpu.models.taints import UNREGISTERED_NO_EXECUTE_TAINT, Taint
+from karpenter_tpu.state.store import ObjectStore
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def _env(catalog=None):
+    clock = FakeClock()
+    store = ObjectStore(clock)
+    cloud = KwokCloudProvider(store, catalog=catalog or instance_types(8))
+    mgr = Manager(store, cloud, clock)
+    pool = NodePool()
+    pool.metadata.name = "default"
+    store.create(ObjectStore.NODEPOOLS, pool)
+    return clock, store, cloud, mgr
+
+
+class _Hook:
+    """A NodeLifecycleHook analog the fake provider can carry."""
+
+    name = "test-hook"
+
+    def __init__(self):
+        self.ready = False
+
+    def registered(self, claim) -> bool:
+        return self.ready
+
+
+class TestRegistrationHooks:
+    def test_hook_gates_registration_until_ready(self):
+        clock, store, cloud, mgr = _env()
+        hook = _Hook()
+        cloud.registration_hooks = lambda: [hook]
+        store.create(ObjectStore.PODS, make_pod("p", cpu=0.5))
+        mgr.run_until_idle()
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        claim = store.nodeclaims()[0]
+        node = store.node_by_provider_id(claim.status.provider_id)
+        # hook not ready: labels synced, claim NOT registered, taint kept
+        assert not claim.conditions.is_true(COND_REGISTERED)
+        assert any(
+            t.match(UNREGISTERED_NO_EXECUTE_TAINT) for t in node.spec.taints
+        ), "unregistered taint must stay while hooks gate"
+        hook.ready = True
+        mgr._dirty_claims.add(claim.name)
+        mgr.run_until_idle()
+        claim = store.nodeclaims()[0]
+        node = store.node_by_provider_id(claim.status.provider_id)
+        assert claim.conditions.is_true(COND_REGISTERED)
+        assert not any(
+            t.match(UNREGISTERED_NO_EXECUTE_TAINT) for t in node.spec.taints
+        )
+
+    def test_hooks_forward_through_decorators(self):
+        from karpenter_tpu.cloudprovider.metrics import MetricsCloudProvider
+        from karpenter_tpu.cloudprovider.overlay import OverlayCloudProvider
+
+        clock = FakeClock()
+        store = ObjectStore(clock)
+        inner = KwokCloudProvider(store, catalog=instance_types(4))
+        hook = _Hook()
+        inner.registration_hooks = lambda: [hook]
+        cloud = MetricsCloudProvider(OverlayCloudProvider(inner, store))
+        assert cloud.registration_hooks() == [hook]
+
+    def test_claim_taints_sync_onto_node(self):
+        clock, store, cloud, mgr = _env()
+        pod = make_pod("p", cpu=0.5)
+        pod.spec.tolerations = []
+        pool = store.nodepools()[0]
+        pool.spec.template.spec.taints = [
+            Taint(key="dedicated", value="batch", effect="NoSchedule")
+        ]
+        store.update(ObjectStore.NODEPOOLS, pool)
+        from karpenter_tpu.models.pod import Toleration
+
+        pod.spec.tolerations = [
+            Toleration(key="dedicated", operator="Equal", value="batch", effect="NoSchedule")
+        ]
+        store.create(ObjectStore.PODS, pod)
+        mgr.run_until_idle()
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        claim = store.nodeclaims()[0]
+        node = store.node_by_provider_id(claim.status.provider_id)
+        assert claim.conditions.is_true(COND_REGISTERED)
+        # registration.go:213-216: claim taints merge onto the node even
+        # when the provider fabricated it without them
+        assert any(
+            t.key == "dedicated" and t.value == "batch" for t in node.spec.taints
+        )
+
+
+class TestInitializationChecks:
+    def test_requested_extended_resource_blocks_until_registered(self):
+        clock, store, cloud, mgr = _env()
+        store.create(ObjectStore.PODS, make_pod("p", cpu=0.5))
+        mgr.run_until_idle()
+        claim = store.nodeclaims()[0]
+        claim.spec.requests["example.com/gpu"] = 2.0
+        store.update(ObjectStore.NODECLAIMS, claim)
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        claim = store.nodeclaims()[0]
+        assert claim.conditions.is_true(COND_REGISTERED)
+        # the kubelet zeroes extended resources until the device plugin
+        # registers (initialization.go:130-146)
+        assert not claim.conditions.is_true(COND_INITIALIZED)
+        node = store.node_by_provider_id(claim.status.provider_id)
+        node.status.allocatable["example.com/gpu"] = 2.0
+        store.update(ObjectStore.NODES, node)
+        mgr.run_until_idle()
+        assert store.nodeclaims()[0].conditions.is_true(COND_INITIALIZED)
+
+    def test_dra_driver_pools_block_until_published(self):
+        from karpenter_tpu.scheduling.dra.types import ResourceSlice
+
+        clock, store, cloud, mgr = _env()
+        store.create(ObjectStore.PODS, make_pod("p", cpu=0.5))
+        mgr.run_until_idle()
+        claim = store.nodeclaims()[0]
+        claim.metadata.annotations[l.DRA_DRIVERS_ANNOTATION_KEY] = "gpu.example.com"
+        store.update(ObjectStore.NODECLAIMS, claim)
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        claim = store.nodeclaims()[0]
+        assert claim.conditions.is_true(COND_REGISTERED)
+        assert not claim.conditions.is_true(COND_INITIALIZED)
+        node = store.node_by_provider_id(claim.status.provider_id)
+        store.create(
+            ObjectStore.RESOURCE_SLICES,
+            ResourceSlice(driver="gpu.example.com", pool="p0", node_name=node.name),
+        )
+        mgr.run_until_idle()
+        assert store.nodeclaims()[0].conditions.is_true(COND_INITIALIZED)
+
+
+class TestLivenessReason:
+    def test_liveness_reap_stamps_condition(self):
+        clock, store, cloud, mgr = _env()
+        # a never-ready hook keeps registration gated (the kwok provider
+        # fabricates the node immediately, so without the gate the claim
+        # registers on the first pass and liveness never applies)
+        cloud.registration_hooks = lambda: [_Hook()]
+        store.create(ObjectStore.PODS, make_pod("p", cpu=0.5))
+        mgr.run_until_idle()
+        reaped = []
+        store.watch(
+            ObjectStore.NODECLAIMS,
+            lambda e, c: reaped.append(c) if e.value == "Deleted" else None,
+        )
+        clock.step(6 * 60.0)
+        for c in store.nodeclaims():
+            mgr._dirty_claims.add(c.name)
+        mgr.run_until_idle()
+        assert reaped, "liveness did not reap the unregistered claim"
+        cond = reaped[0].conditions.get(COND_REGISTERED)
+        assert cond is not None and cond.reason == "LivenessTimeout"
+
+
+class TestVolumeDetachAwait:
+    def _bound_node(self):
+        clock, store, cloud, mgr = _env()
+        store.create(ObjectStore.PODS, make_pod("p", cpu=0.5))
+        mgr.run_until_idle()
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        KubeSchedulerSim(store, mgr.cluster).bind_pending()
+        claim = store.nodeclaims()[0]
+        node = store.node_by_provider_id(claim.status.provider_id)
+        return clock, store, cloud, mgr, claim, node
+
+    def test_termination_waits_for_attachments(self):
+        clock, store, cloud, mgr, claim, node = self._bound_node()
+        va = VolumeAttachment(node_name=node.name, attacher="ebs.csi", pvc_name="vol-1")
+        va.metadata.name = "va-1"
+        store.create(ObjectStore.VOLUME_ATTACHMENTS, va)
+        store.delete(ObjectStore.NODECLAIMS, claim.name)
+        mgr.run_until_idle()
+        claim = store.get(ObjectStore.NODECLAIMS, claim.name)
+        assert claim is not None, "instance terminated before volumes detached"
+        cond = claim.conditions.get(COND_VOLUMES_DETACHED)
+        assert cond is not None and cond.reason == "AwaitingVolumeDetachment"
+        # the attach-detach controller finishes its cleanup
+        store.delete(ObjectStore.VOLUME_ATTACHMENTS, "va-1")
+        mgr._dirty_claims.add(claim.name)
+        mgr.run_until_idle()
+        assert store.get(ObjectStore.NODECLAIMS, claim.name) is None
+
+    def test_tgp_overrides_the_wait(self):
+        clock, store, cloud, mgr, claim, node = self._bound_node()
+        claim.spec.termination_grace_period_seconds = 30.0
+        store.update(ObjectStore.NODECLAIMS, claim)
+        va = VolumeAttachment(node_name=node.name, attacher="ebs.csi", pvc_name="vol-1")
+        va.metadata.name = "va-1"
+        store.create(ObjectStore.VOLUME_ATTACHMENTS, va)
+        store.delete(ObjectStore.NODECLAIMS, claim.name)
+        mgr.run_until_idle()
+        assert store.get(ObjectStore.NODECLAIMS, claim.name) is not None
+        clock.step(31.0)
+        mgr._dirty_claims.add(claim.name)
+        mgr.run_until_idle()
+        # grace elapsed: termination proceeds despite the attachment
+        # (controller.go:270-276, VolumesDetached False/GracePeriodElapsed)
+        assert store.get(ObjectStore.NODECLAIMS, claim.name) is None

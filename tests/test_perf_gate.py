@@ -69,3 +69,82 @@ def test_tpu_regime_gate():
     assert rate >= TPU_MIN_PODS_PER_SEC, (
         f"TPU regime regression: {rate:.1f} pods/sec < {TPU_MIN_PODS_PER_SEC}"
     )
+
+
+# VERDICT r4 #7: the north star and the 16k reference mix moved by integer
+# factors between rounds with no gate catching it. Both are pinned here at
+# ratcheted thresholds (best observed r5: north star 0.81s wall; 16k mix
+# 18.1k pods/sec best / ~8k worst over tunnel variance), plus a
+# cold-compile ceiling so a persistent-cache key bust fails loudly instead
+# of looking like a CI hang.
+NORTHSTAR_MAX_WALL_S = 1.1  # ratchet toward the 0.5s BASELINE target
+MIXED_16K_MIN_PODS_PER_SEC = 7000.0  # ratchet from the 4,092 r4 number
+WARM_CACHE_COLD_COMPILE_MAX_S = 60.0  # observed ~6s with a warm cache
+
+
+def _tpu_or_skip():
+    import jax
+
+    if jax.devices()[0].platform == "cpu":
+        pytest.skip("TPU-regime gate needs an accelerator")
+
+
+def test_northstar_wall_gate():
+    """100k selector pods x 1000 types, warm, best-of-2 (the claims-axis
+    warm-sizing recompile is absorbed by the first warm run)."""
+    _tpu_or_skip()
+    import bench
+
+    pods = bench.selector_pods(100_000)
+    templates = bench.make_templates(1000)
+    sched = TPUScheduler(templates, pod_pad=len(pods), max_claims=4096)
+    assert not sched.solve(pods).unschedulable  # cold
+    best = None
+    for _ in range(2):
+        t0 = time.perf_counter()
+        result = sched.solve(pods)
+        wall = time.perf_counter() - t0
+        best = wall if best is None or wall < best else best
+    assert not result.unschedulable
+    assert best <= NORTHSTAR_MAX_WALL_S, (
+        f"north-star regression: {best:.3f}s > {NORTHSTAR_MAX_WALL_S}s"
+    )
+
+
+def test_mixed_16k_throughput_gate():
+    """The reference benchmark mix (3/5 topology-bearing pods) at 16384 x
+    400 — the kind-scan path's headline; best-of-3 to ride out tunnel
+    variance."""
+    _tpu_or_skip()
+    import bench
+
+    pods = bench.mixed_pods(16384)
+    templates = bench.make_templates(400)
+    sched = TPUScheduler(templates, pod_pad=len(pods), max_claims=4096)
+    assert not sched.solve(pods).unschedulable  # cold
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        result = sched.solve(pods)
+        wall = time.perf_counter() - t0
+        best = wall if best is None or wall < best else best
+    assert not result.unschedulable
+    rate = len(pods) / best
+    assert rate >= MIXED_16K_MIN_PODS_PER_SEC, (
+        f"16k ref-mix regression: {rate:.1f} pods/sec < {MIXED_16K_MIN_PODS_PER_SEC}"
+    )
+
+
+def test_warm_cache_cold_compile_ceiling():
+    """A fresh process with the persistent XLA cache populated must reach
+    its first solve inside the ceiling — a silent cache-key bust otherwise
+    reads as a CI hang (VERDICT r4 weak #8)."""
+    _tpu_or_skip()
+    import bench
+
+    out = bench.run_restart_stage(2048, 400, 256, on_tpu=True)
+    assert isinstance(out, dict), f"restart probe failed: {out}"
+    assert out["cold_s"] <= WARM_CACHE_COLD_COMPILE_MAX_S, (
+        f"cold compile {out['cold_s']}s > {WARM_CACHE_COLD_COMPILE_MAX_S}s: "
+        "persistent compile cache key bust?"
+    )
