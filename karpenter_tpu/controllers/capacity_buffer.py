@@ -230,6 +230,11 @@ class CapacityBufferController:
                 )
                 failed += 1
                 continue
+            if cb.pod_template_ref is not None:
+                tmpl = self.store.get(ObjectStore.POD_TEMPLATES, cb.pod_template_ref)
+                cb.status.pod_template_generation = getattr(
+                    tmpl.metadata, "generation", None
+                )
             candidates: list[int] = []
             if (
                 cb.percentage is not None

@@ -162,6 +162,16 @@ class Manager:
         ):
             self.store.watch(kind, self._on_buffer_event)
         self.store.watch(ObjectStore.RESOURCE_SLICES, self._on_resource_slice)
+        self.store.watch(ObjectStore.VOLUME_ATTACHMENTS, self._on_volume_attachment)
+
+    def _on_volume_attachment(self, event: EventType, va) -> None:
+        # the attach-detach controller deleting an attachment can unblock a
+        # terminating claim's volume-detach await
+        # (termination/controller.go:236-277)
+        if event is EventType.DELETED:
+            for claim in self.store.nodeclaims():
+                if claim.metadata.deleting:
+                    self._dirty_claims.add(claim.name)
 
     def _on_buffer_event(self, event: EventType, obj) -> None:
         self.capacity_buffer.reconcile()

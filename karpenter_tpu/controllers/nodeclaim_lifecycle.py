@@ -228,18 +228,16 @@ class NodeClaimLifecycleController:
             # await volume detachment (termination/controller.go:236-277):
             # the attach-detach controller deletes VolumeAttachments as
             # drained pods' volumes unmount; terminating the instance
-            # first would strand writes. Attachments whose volume is held
-            # ONLY by a non-drainable pod never detach and must not block
-            # (filterVolumeAttachments). The TGP overrides the wait.
-            blocked_pvcs = {
-                pvc
-                for p in blocking
-                for pvc in p.spec.pvc_names
-            }
+            # first would strand writes. The reference additionally
+            # filters out attachments held ONLY by non-drainable pods
+            # (filterVolumeAttachments) — vacuous in this harness, where
+            # eviction is synchronous: any pod still blocking the drain
+            # returned above, so every pod reaching this point has been
+            # evicted. The TGP overrides the wait.
             pending = [
                 va
                 for va in self.store.list(ObjectStore.VOLUME_ATTACHMENTS)
-                if va.node_name == node.name and va.pvc_name not in blocked_pvcs
+                if va.node_name == node.name
             ]
             if pending and not grace_elapsed:
                 from karpenter_tpu.models.nodeclaim import COND_VOLUMES_DETACHED

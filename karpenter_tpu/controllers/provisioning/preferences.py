@@ -156,13 +156,20 @@ def run_with_relaxation(pods: list[Pod], solve_round, should_stop=None):
     provisioner.go:415) the current result is returned without further
     relaxation, mirroring the reference's context-cancelled Solve loop.
     """
-    originals = {p.uid: p for p in pods}
-    applied = {p.uid: 0 for p in pods}
+    # the per-pod bookkeeping is built lazily: the all-scheduled happy
+    # path (the north star) must not pay two 100k-entry dicts up front
+    originals = None
+    applied: dict = {}
     current = list(pods)
     while True:
         result = solve_round(current)
         if should_stop is not None and should_stop():
             return result
+        if not result.unschedulable:
+            return result
+        if originals is None:
+            originals = {p.uid: p for p in pods}
+            applied = {p.uid: 0 for p in pods}
         relaxed_any = False
         for p, _reason in result.unschedulable:
             orig = originals.get(p.uid)

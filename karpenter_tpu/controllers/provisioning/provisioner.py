@@ -650,14 +650,24 @@ class Provisioner:
         would flip Initialized before its devices exist)."""
         from karpenter_tpu.controllers.device_allocation import PendingAllocation
 
+        # the launched instance type is unknown until collapse, so per
+        # resource claim only drivers that EVERY surviving IT's allocation
+        # uses may gate initialization — a union could name a driver the
+        # chosen IT never publishes and wedge the node uninitialized
+        # forever; across claims the sets union (all must publish)
         drivers: set[str] = set()
         for claim_key, meta in dra_round.allocator.claim_allocation_metadata.items():
             if meta.nodeclaim_id != sim.hostname:
                 continue
             claim_name = claim_key.split("/", 1)[1]
             pod_uids = [p.uid for p in sim.pods if claim_name in p.spec.resource_claims]
+            claim_drivers: Optional[set[str]] = None
             for results in meta.devices.values():
-                drivers.update(r.device_id.driver for r in results)
+                per_it = {r.device_id.driver for r in results}
+                claim_drivers = (
+                    per_it if claim_drivers is None else (claim_drivers & per_it)
+                )
+            drivers |= claim_drivers or set()
             self.device_allocation.register(
                 PendingAllocation(
                     claim_name=claim_name,

@@ -236,6 +236,40 @@ class RemoteScheduler:
             from karpenter_tpu.rpc.dra_codec import RemoteDRARound, decode_dra_metadata
 
             result.dra = RemoteDRARound(decode_dra_metadata(resp.dra_metadata_json))
+        elif req.dra_problem_json:
+            # a DRA-aware server always returns at least "{}" here; empty
+            # bytes mean the server predates field 11 and SILENTLY solved
+            # without any allocator — fall back to the local host engine
+            # rather than placing claim pods with no device constraints
+            from karpenter_tpu.utils.metrics import SOLVER_HOST_FALLBACKS
+
+            SOLVER_HOST_FALLBACKS.inc(reason="dra_server_predates")
+            from karpenter_tpu.controllers.provisioning.host_scheduler import (
+                HostScheduler,
+            )
+
+            host = HostScheduler(
+                self.templates,
+                existing_nodes=[n.clone() for n in (existing_nodes or [])],
+                budgets=budgets,
+                topology=(
+                    topology_factory(list(pods))
+                    if topology_factory is not None
+                    else topology
+                ),
+                volume_reqs=normalize_volume_reqs(volume_reqs),
+                reserved_mode=(
+                    reserved_mode if reserved_mode is not None else self.reserved_mode
+                ),
+                reserved_capacity_enabled=self.reserved_capacity_enabled,
+                min_values_policy=self.min_values_policy,
+                reserved_in_use=reserved_in_use,
+                dra_problem=dra_problem,
+                pod_volumes=pod_volumes,
+                deadline=deadline,
+                now=now,
+            )
+            return host.solve(list(pods))
         t_end = time.perf_counter()
         self.last_timings = {
             "encode_s": t_encode - t0,

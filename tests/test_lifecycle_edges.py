@@ -207,9 +207,9 @@ class TestVolumeDetachAwait:
         assert claim is not None, "instance terminated before volumes detached"
         cond = claim.conditions.get(COND_VOLUMES_DETACHED)
         assert cond is not None and cond.reason == "AwaitingVolumeDetachment"
-        # the attach-detach controller finishes its cleanup
+        # the attach-detach controller finishes its cleanup; the manager's
+        # VOLUME_ATTACHMENTS informer re-drives the deleting claim
         store.delete(ObjectStore.VOLUME_ATTACHMENTS, "va-1")
-        mgr._dirty_claims.add(claim.name)
         mgr.run_until_idle()
         assert store.get(ObjectStore.NODECLAIMS, claim.name) is None
 
