@@ -139,21 +139,26 @@ def host_solve(templates, pods):
 
 def run_stage(pods, n_types, max_claims, warm_runs=2, host_parity=False):
     from karpenter_tpu.controllers.provisioning import TPUScheduler
+    from karpenter_tpu.envelope.sampler import measured
 
-    templates = make_templates(n_types)
-    sched = TPUScheduler(templates, pod_pad=len(pods), max_claims=max_claims)
-    t0 = time.perf_counter()
-    result = sched.solve(pods)  # cold: compile + run
-    cold_s = time.perf_counter() - t0
-    assert not result.unschedulable, f"{len(result.unschedulable)} unschedulable"
-    best, timings = None, dict(sched.last_timings)
-    for _ in range(warm_runs):
+    # host resource envelope over the whole stage (cold solve included):
+    # fills host_rss_mb (P95 of the RSS series) + cpu_s + avg_cores
+    envelope = {}
+    with measured(envelope, stage=f"stage_{len(pods)}x{n_types}"):
+        templates = make_templates(n_types)
+        sched = TPUScheduler(templates, pod_pad=len(pods), max_claims=max_claims)
         t0 = time.perf_counter()
-        result = sched.solve(pods)
-        wall = time.perf_counter() - t0
-        if best is None or wall < best:
-            best, timings = wall, dict(sched.last_timings)
-    best = best if best is not None else cold_s
+        result = sched.solve(pods)  # cold: compile + run
+        cold_s = time.perf_counter() - t0
+        assert not result.unschedulable, f"{len(result.unschedulable)} unschedulable"
+        best, timings = None, dict(sched.last_timings)
+        for _ in range(warm_runs):
+            t0 = time.perf_counter()
+            result = sched.solve(pods)
+            wall = time.perf_counter() - t0
+            if best is None or wall < best:
+                best, timings = wall, dict(sched.last_timings)
+        best = best if best is not None else cold_s
     out = {
         "pods": len(pods),
         "types": n_types,
@@ -165,6 +170,7 @@ def run_stage(pods, n_types, max_claims, warm_runs=2, host_parity=False):
         "decode_s": round(timings["decode_s"], 4),
         "nodes": result.node_count,
         "total_price_per_hour": round(result.total_price(), 2),
+        **envelope,
     }
     if host_parity:
         # density on the record: the north star is throughput AT Go-FFD
@@ -181,35 +187,52 @@ def run_stage(pods, n_types, max_claims, warm_runs=2, host_parity=False):
     return out
 
 
+# The whatif-batch regression floor (VERDICT r5 weak #4: 22x -> 13.8x slid
+# with no gate noticing). tests/test_perf_gate.py asserts the same number
+# on TPU hardware; the bench records it so the JSON shows gate status.
+WHATIF_MIN_SPEEDUP_X = 10.0
+
+
 def run_whatif_stage(n_candidates, seq_sample=8):
     """Batched vs sequential consolidation what-ifs (the §2.6 tensorization:
     one vmapped dispatch vs N sequential re-solves)."""
+    from karpenter_tpu.envelope.sampler import measured
     from karpenter_tpu.testing import FakeCandidate, build_bound_cluster
 
-    _clock, store, _cloud, mgr = build_bound_cluster(n_pods=n_candidates, pod_cpu=2.0)
-    by_node: dict[str, list] = {}
-    for p in store.pods():
-        if p.spec.node_name:
-            by_node.setdefault(p.spec.node_name, []).append(p)
-    candidates = [FakeCandidate(name, pods) for name, pods in sorted(by_node.items())]
-    scenarios = [[c] for c in candidates]
-    prov = mgr.provisioner
-    warm = prov.simulate_batch(scenarios)
-    assert warm is not None, "batch path gated"
-    prov.simulate({candidates[0].name}, candidates[0].reschedulable_pods)
-    t0 = time.perf_counter()
-    signals = prov.simulate_batch(scenarios)
-    t_batch = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    for c in candidates[:seq_sample]:
-        prov.simulate({c.name}, c.reschedulable_pods)
-    t_seq = (time.perf_counter() - t0) * (len(candidates) / seq_sample)
+    envelope = {}
+    with measured(envelope, stage=f"whatif_{n_candidates}"):
+        _clock, store, _cloud, mgr = build_bound_cluster(
+            n_pods=n_candidates, pod_cpu=2.0
+        )
+        by_node: dict[str, list] = {}
+        for p in store.pods():
+            if p.spec.node_name:
+                by_node.setdefault(p.spec.node_name, []).append(p)
+        candidates = [
+            FakeCandidate(name, pods) for name, pods in sorted(by_node.items())
+        ]
+        scenarios = [[c] for c in candidates]
+        prov = mgr.provisioner
+        warm = prov.simulate_batch(scenarios)
+        assert warm is not None, "batch path gated"
+        prov.simulate({candidates[0].name}, candidates[0].reschedulable_pods)
+        t0 = time.perf_counter()
+        signals = prov.simulate_batch(scenarios)
+        t_batch = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for c in candidates[:seq_sample]:
+            prov.simulate({c.name}, c.reschedulable_pods)
+        t_seq = (time.perf_counter() - t0) * (len(candidates) / seq_sample)
+    speedup = round(t_seq / t_batch, 1) if t_batch > 0 else float("inf")
     return {
         "candidates": len(candidates),
         "batch_s": round(t_batch, 3),
         "sequential_s_extrapolated": round(t_seq, 3),
-        "speedup_x": round(t_seq / t_batch, 1) if t_batch > 0 else float("inf"),
+        "speedup_x": speedup,
+        "gate_min_speedup_x": WHATIF_MIN_SPEEDUP_X,
+        "gate_ok": speedup >= WHATIF_MIN_SPEEDUP_X,
         "feasible": sum(1 for ok, _ in signals if ok),
+        **envelope,
     }
 
 
@@ -229,10 +252,15 @@ def run_restart_stage(n_pods, n_types, max_claims, on_tpu=True):
         )
         + "from bench import selector_pods, make_templates\n"
         "from karpenter_tpu.controllers.provisioning import TPUScheduler\n"
+        # the child reports ITS OWN envelope — the restart cost in memory,
+        # not just wall (read post-solve, so the compile peak is included)
+        "from karpenter_tpu.envelope.sampler import read_cpu_seconds, read_rss_bytes\n"
         f"pods = selector_pods({n_pods})\n"
         f"sched = TPUScheduler(make_templates({n_types}), pod_pad={n_pods}, max_claims={max_claims})\n"
         "t0 = time.perf_counter(); r = sched.solve(pods)\n"
-        "print(json.dumps({'cold_s': round(time.perf_counter() - t0, 2)}))\n"
+        "print(json.dumps({'cold_s': round(time.perf_counter() - t0, 2),\n"
+        "                  'host_rss_mb': round(read_rss_bytes() / 2**20, 1),\n"
+        "                  'cpu_s': round(read_cpu_seconds(), 3)}))\n"
     )
     out = subprocess.run(
         [sys.executable, "-c", child], capture_output=True, text=True, timeout=900
@@ -245,23 +273,27 @@ def run_restart_stage(n_pods, n_types, max_claims, on_tpu=True):
 def run_rpc_stage(pods, n_types, local_wall_s):
     """The control/solver gRPC split's overhead: the same warm solve
     through an in-process server on loopback (SURVEY §2.9; rpc/)."""
+    from karpenter_tpu.envelope.sampler import measured
     from karpenter_tpu.rpc import RemoteScheduler, serve
 
+    envelope = {}
     server, addr = serve("127.0.0.1:0")
     try:
-        remote = RemoteScheduler(addr, make_templates(n_types))
-        remote.solve(pods)  # warm (server-side compile reuses the cache)
-        best = None
-        for _ in range(2):
-            t0 = time.perf_counter()
-            result = remote.solve(pods)
-            wall = time.perf_counter() - t0
-            best = wall if best is None or wall < best else best
-        assert not result.unschedulable
+        with measured(envelope, stage=f"rpc_{len(pods)}x{n_types}"):
+            remote = RemoteScheduler(addr, make_templates(n_types))
+            remote.solve(pods)  # warm (server-side compile reuses the cache)
+            best = None
+            for _ in range(2):
+                t0 = time.perf_counter()
+                result = remote.solve(pods)
+                wall = time.perf_counter() - t0
+                best = wall if best is None or wall < best else best
+            assert not result.unschedulable
         return {
             "wall_s": round(best, 4),
             "overhead_ms": round((best - local_wall_s) * 1000.0, 1),
             "pods_per_sec": round(len(pods) / best, 1),
+            **envelope,
         }
     finally:
         server.stop(0)
@@ -325,7 +357,8 @@ def main() -> None:
                     mixed_pods(4096), 400, 1024, warm_runs=0, host_parity=True
                 ).items()
                 if k in ("nodes", "host_nodes", "total_price_per_hour",
-                         "host_price_per_hour", "density_parity", "host_wall_s")
+                         "host_price_per_hour", "density_parity", "host_wall_s",
+                         "host_rss_mb", "cpu_s")
             }
         except Exception as e:  # noqa: BLE001
             detail["mixed_density_4096_sample"] = f"failed: {repr(e)[:300]}"
@@ -348,7 +381,8 @@ def main() -> None:
                     selector_pods(10_000), 1000, 1024, warm_runs=0, host_parity=True
                 ).items()
                 if k in ("nodes", "host_nodes", "total_price_per_hour",
-                         "host_price_per_hour", "density_parity", "host_wall_s")
+                         "host_price_per_hour", "density_parity", "host_wall_s",
+                         "host_rss_mb", "cpu_s")
             }
         except Exception as e:  # noqa: BLE001
             detail["northstar_100000x1000"] = f"failed: {repr(e)[:300]}"
@@ -378,16 +412,24 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         detail["restart_warm_cache_2048x400"] = f"failed: {repr(e)[:300]}"
 
-    # the TPU-regime regression gate (VERDICT r3 #4): the reference's
-    # 100 pods/sec floor scaled to the accelerated regime; the same
-    # threshold is enforced as a test when a TPU is attached
-    # (tests/test_perf_gate.py)
+    # the TPU-regime regression gate (VERDICT r3 #4, ratcheted to round-5
+    # reality per VERDICT r5 directive #3): the same threshold is enforced
+    # as a test when a TPU is attached (tests/test_perf_gate.py)
     if on_tpu:
         detail["tpu_regime_gate"] = {
-            "threshold_pods_per_sec": 1500.0,
+            "threshold_pods_per_sec": 8000.0,
             "measured": detail["selectors_2048x400"]["pods_per_sec"],
-            "ok": detail["selectors_2048x400"]["pods_per_sec"] >= 1500.0,
+            "ok": detail["selectors_2048x400"]["pods_per_sec"] >= 8000.0,
         }
+
+    # whole-process envelope: where the control plane + solver client ended
+    # up after every stage (the e2e thresholds' analog of a final scrape)
+    from karpenter_tpu.envelope.sampler import read_cpu_seconds, read_rss_bytes
+
+    detail["host_envelope"] = {
+        "final_rss_mb": round(read_rss_bytes() / 2**20, 1),
+        "total_cpu_s": round(read_cpu_seconds(), 1),
+    }
 
     print(
         json.dumps(

@@ -31,6 +31,7 @@ def build_cluster(n_nodes: int):
 
 
 def main() -> None:
+    from karpenter_tpu.envelope.sampler import measured
     from karpenter_tpu.testing import FakeCandidate
     from karpenter_tpu.utils import accel
 
@@ -38,30 +39,38 @@ def main() -> None:
     if platform == "cpu":
         accel.force_cpu()
 
-    store, mgr = build_cluster(N_CANDIDATES)
-    by_node: dict[str, list] = {}
-    for p in store.pods():
-        if p.spec.node_name:
-            by_node.setdefault(p.spec.node_name, []).append(p)
-    candidates = [FakeCandidate(name, pods) for name, pods in sorted(by_node.items())]
-    scenarios = [[c] for c in candidates]
-    prov = mgr.provisioner
+    # host resource envelope around the whole bench: host_rss_mb/cpu_s land
+    # in the detail like every bench.py stage (envelope/sampler.py)
+    envelope = {}
+    with measured(envelope, stage="whatif_bench"):
+        store, mgr = build_cluster(N_CANDIDATES)
+        by_node: dict[str, list] = {}
+        for p in store.pods():
+            if p.spec.node_name:
+                by_node.setdefault(p.spec.node_name, []).append(p)
+        candidates = [
+            FakeCandidate(name, pods) for name, pods in sorted(by_node.items())
+        ]
+        scenarios = [[c] for c in candidates]
+        prov = mgr.provisioner
 
-    # warm both paths (compile cache) before timing
-    warm = prov.simulate_batch(scenarios)
-    assert warm is not None, "batch path gated"
-    prov.simulate({candidates[0].name}, candidates[0].reschedulable_pods)
+        # warm both paths (compile cache) before timing
+        warm = prov.simulate_batch(scenarios)
+        assert warm is not None, "batch path gated"
+        prov.simulate({candidates[0].name}, candidates[0].reschedulable_pods)
 
-    t0 = time.perf_counter()
-    signals = prov.simulate_batch(scenarios)
-    t_batch = time.perf_counter() - t0
-    assert signals is not None and len(signals) == len(scenarios)
+        t0 = time.perf_counter()
+        signals = prov.simulate_batch(scenarios)
+        t_batch = time.perf_counter() - t0
+        assert signals is not None and len(signals) == len(scenarios)
 
-    t0 = time.perf_counter()
-    for c in candidates[:SEQUENTIAL_SAMPLE]:
-        prov.simulate({c.name}, c.reschedulable_pods)
-    t_seq_sample = time.perf_counter() - t0
-    t_seq = t_seq_sample * (len(candidates) / SEQUENTIAL_SAMPLE)
+        t0 = time.perf_counter()
+        for c in candidates[:SEQUENTIAL_SAMPLE]:
+            prov.simulate({c.name}, c.reschedulable_pods)
+        t_seq_sample = time.perf_counter() - t0
+        t_seq = t_seq_sample * (len(candidates) / SEQUENTIAL_SAMPLE)
+
+    from bench import WHATIF_MIN_SPEEDUP_X
 
     speedup = t_seq / t_batch if t_batch > 0 else float("inf")
     print(
@@ -78,6 +87,9 @@ def main() -> None:
                     "sequential_sample": SEQUENTIAL_SAMPLE,
                     "platform": platform,
                     "feasible": sum(1 for ok, _ in signals if ok),
+                    "gate_min_speedup_x": WHATIF_MIN_SPEEDUP_X,
+                    "gate_ok": speedup >= WHATIF_MIN_SPEEDUP_X,
+                    **envelope,
                 },
             }
         )

@@ -163,6 +163,15 @@ class Manager:
             self.store.watch(kind, self._on_buffer_event)
         self.store.watch(ObjectStore.RESOURCE_SLICES, self._on_resource_slice)
         self.store.watch(ObjectStore.VOLUME_ATTACHMENTS, self._on_volume_attachment)
+        # daemonset informer (state/informer/daemonset.go): overhead groups
+        # are rebuilt per solve, so correctness never depended on this —
+        # the watch exists so pods that now fit differently get a pass NOW
+        # instead of waiting for the next unrelated trigger
+        self.store.watch(ObjectStore.DAEMONSETS, self._on_daemonset)
+
+    def _on_daemonset(self, event: EventType, ds) -> None:
+        if any(p.is_provisionable() for p in self.store.pods()):
+            self.batcher.trigger()
 
     def _on_volume_attachment(self, event: EventType, va) -> None:
         # the attach-detach controller deleting an attachment can unblock a
@@ -189,6 +198,11 @@ class Manager:
         self._catalog_by_name.clear()
         if self.nodeoverlay is not None:
             self.nodeoverlay.reconcile()
+        # pricing informer analog (state/informer/pricing.go): an overlay
+        # price change must re-derive every live claim's ledger price —
+        # Balanced scoring divides by pool_cost, and a stale denominator
+        # approves/rejects moves against prices that no longer exist
+        self._reprice_claims()
 
     def _on_nodepool(self, event: EventType, pool) -> None:
         self._catalog_by_name = {}  # pool changes can reshape the catalog
@@ -197,9 +211,22 @@ class Manager:
             # the unevaluated gate lifts within the same event turn
             # (controller.go:147 watches NodePool events)
             self.nodeoverlay.reconcile()
+        # pool template/requirement changes reshape offerings and therefore
+        # the prices the ledger carries (pricing.go re-sync analog)
+        self._reprice_claims()
         # a new/changed pool may unblock gated provisioning
         if any(p.is_provisionable() for p in self.store.pods()):
             self.batcher.trigger()
+
+    def _reprice_claims(self) -> None:
+        """Re-derive every launched claim's hourly price into ClusterCost
+        from the CURRENT catalog (informer/pricing.go: a pricing change
+        re-syncs state without waiting for claim churn)."""
+        for claim in self.store.nodeclaims():
+            if claim.status.provider_id and claim.nodepool_name:
+                self.cost.set_claim(
+                    claim.nodepool_name, claim.name, self._claim_price(claim)
+                )
 
     def _on_pod(self, event: EventType, pod) -> None:
         if event is EventType.DELETED:

@@ -1,0 +1,40 @@
+"""Resource-envelope harness: host RSS/CPU sampling + scenario e2e suite.
+
+The "other half" of the performance story (reference
+test/suites/performance): throughput is measured by bench.py, the
+control plane's resource footprint by this package. Three parts:
+
+- sampler.py — background thread reading /proc/self/statm RSS +
+  getrusage CPU per named stage (P50/P95/max RSS, CPU-seconds,
+  average cores), exported as ktpu_host_rss_bytes /
+  ktpu_cpu_seconds_total gauges and the /debug/envelope endpoint
+- spec.py — Envelope(max_wall_s, max_rss_mb_p95, max_cpu_cores)
+  assertions mirroring thresholds.go
+- scenarios.py — scale-out / consolidation / drift / hostname-spread
+  e2e scenarios on the kwok provider + fake clock
+"""
+
+from karpenter_tpu.envelope.sampler import (
+    ResourceSampler,
+    StageStats,
+    measured,
+    percentile,
+    read_cpu_seconds,
+    read_rss_bytes,
+)
+from karpenter_tpu.envelope.scenarios import SCENARIOS, ScenarioResult, run_scenario
+from karpenter_tpu.envelope.spec import Envelope, EnvelopeExceeded
+
+__all__ = [
+    "SCENARIOS",
+    "Envelope",
+    "EnvelopeExceeded",
+    "ResourceSampler",
+    "ScenarioResult",
+    "StageStats",
+    "measured",
+    "percentile",
+    "read_cpu_seconds",
+    "read_rss_bytes",
+    "run_scenario",
+]

@@ -1,0 +1,327 @@
+"""Host resource sampler: background RSS/CPU series per named stage.
+
+The measurement half of the resource-envelope subsystem (the analog of
+the reference e2e performance suite's controller memory/CPU thresholds,
+test/suites/performance/thresholds.go:28-43: the suite scrapes the
+controller pod's RSS and CPU around each scenario and asserts P95/avg
+ceilings). Here the control plane, solver client and harness share one
+process, so the sampler reads the process's own counters:
+
+- RSS from ``/proc/self/statm`` (live VmRSS, NOT the ru_maxrss high-water
+  mark — a one-time XLA compile spike would make every later assertion
+  vacuous; same rationale as testing.measure_resources)
+- CPU from ``resource.getrusage(RUSAGE_SELF)`` user+system time, which
+  covers ALL threads (XLA's thread pool included), unlike
+  time.process_time on some platforms
+
+A daemon thread ticks every ``interval_s`` (default 100 ms) and appends
+the reading to every currently-open stage, so P50/P95/max RSS and
+average-cores come from a real time series rather than two endpoint
+snapshots. Stages are re-entrant and nest freely::
+
+    sampler = ResourceSampler()
+    with sampler:                       # or .start()/.stop()
+        with sampler.stage("encode"):
+            ...
+        with sampler.stage("solve"):
+            with sampler.stage("solve/device"):
+                ...
+    sampler.stats["solve"].rss_mb_p95
+
+Every tick also publishes ``ktpu_host_rss_bytes`` / ``ktpu_cpu_seconds_total``
+through utils/metrics.py, and the last-started sampler registers itself as
+the process-global one the ``--enable-profiling`` ``/debug/envelope``
+endpoint snapshots (utils/runtime.py).
+
+Optional ``trace_python_alloc=True`` adds a tracemalloc peak per stage —
+~2-4x slower, so it stays behind the flag (the reference equivalently
+keeps pprof heap profiles behind --enable-profiling).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Optional
+
+DEFAULT_INTERVAL_S = 0.1
+
+_PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def read_rss_bytes() -> int:
+    """Live resident set size (VmRSS) of this process in bytes."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * _PAGE_SIZE
+    except OSError:  # non-Linux: the high-water mark is all there is
+        import resource
+
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+
+def read_cpu_seconds() -> float:
+    """User + system CPU seconds across ALL threads of this process."""
+    import resource
+
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    return ru.ru_utime + ru.ru_stime
+
+
+def percentile(series, q: float) -> float:
+    """Nearest-rank percentile (the reference thresholds use P95 the same
+    way: the sample at ceil(q*n), no interpolation — thresholds.go:36)."""
+    values = sorted(series)
+    if not values:
+        return math.nan
+    rank = max(1, math.ceil(q * len(values)))
+    return float(values[min(rank, len(values)) - 1])
+
+
+@dataclass
+class StageStats:
+    """One closed stage's resource envelope measurements."""
+
+    name: str
+    wall_s: float
+    cpu_s: float
+    avg_cores: float  # cpu_s / wall_s
+    rss_mb_p50: float
+    rss_mb_p95: float
+    rss_mb_max: float
+    samples: int  # RSS readings backing the percentiles
+    tracemalloc_peak_mb: Optional[float] = None
+
+    def as_dict(self) -> dict:
+        out = {
+            "wall_s": round(self.wall_s, 4),
+            "cpu_s": round(self.cpu_s, 4),
+            "avg_cores": round(self.avg_cores, 3),
+            "rss_mb_p50": round(self.rss_mb_p50, 1),
+            "rss_mb_p95": round(self.rss_mb_p95, 1),
+            "rss_mb_max": round(self.rss_mb_max, 1),
+            "samples": self.samples,
+        }
+        if self.tracemalloc_peak_mb is not None:
+            out["tracemalloc_peak_mb"] = round(self.tracemalloc_peak_mb, 2)
+        return out
+
+
+@dataclass
+class _OpenStage:
+    name: str
+    start_wall: float
+    start_cpu: float
+    rss_bytes: list[int] = field(default_factory=list)
+
+
+# last-started sampler; the /debug/envelope endpoint snapshots it
+_GLOBAL: Optional["ResourceSampler"] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def global_sampler() -> Optional["ResourceSampler"]:
+    with _GLOBAL_LOCK:
+        return _GLOBAL
+
+
+class ResourceSampler:
+    def __init__(
+        self,
+        interval_s: float = DEFAULT_INTERVAL_S,
+        trace_python_alloc: bool = False,
+        series_capacity: int = 1200,
+    ):
+        self.interval_s = interval_s
+        self.trace_python_alloc = trace_python_alloc
+        self.stats: dict[str, StageStats] = {}  # last closed run per name
+        # cumulative CPU seconds the sampling itself consumed (thread CPU
+        # time, not wall: a tick blocked on the GIL behind a busy workload
+        # is time the WORKLOAD ran, not sampling overhead)
+        self.overhead_s = 0.0
+        self._lock = threading.Lock()
+        self._open: list[_OpenStage] = []  # stack order; all receive ticks
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # recent (monotonic_t, rss_bytes, cpu_s) for the live endpoint
+        self.series: deque[tuple[float, int, float]] = deque(maxlen=series_capacity)
+        # one persistent handle, seek(0)+read per tick (procfs allows it):
+        # keeps the tick at two syscalls instead of open/read/close
+        try:
+            self._statm = open("/proc/self/statm")
+        except OSError:
+            self._statm = None
+        from karpenter_tpu.utils import metrics as _metrics  # bind once
+
+        self._metrics = _metrics
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ResourceSampler":
+        global _GLOBAL
+        if self._thread is not None:
+            return self
+        if self.trace_python_alloc:
+            import tracemalloc
+
+            if not tracemalloc.is_tracing():
+                tracemalloc.start()
+        if self._statm is None:
+            try:
+                self._statm = open("/proc/self/statm")
+            except OSError:
+                pass
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="ktpu-envelope-sampler", daemon=True
+        )
+        self._thread.start()
+        with _GLOBAL_LOCK:
+            _GLOBAL = self
+        return self
+
+    def stop(self) -> None:
+        global _GLOBAL
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self._thread = None
+        if self._statm is not None:
+            self._statm.close()
+            self._statm = None
+        with _GLOBAL_LOCK:
+            if _GLOBAL is self:
+                _GLOBAL = None
+
+    def __enter__(self) -> "ResourceSampler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- the tick ----------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.tick()
+
+    def tick(self) -> None:
+        """One sample; public so threadless tests can drive it directly."""
+        c0 = time.thread_time()
+        now = time.perf_counter()
+        if self._statm is not None:
+            self._statm.seek(0)
+            rss = int(self._statm.read().split()[1]) * _PAGE_SIZE
+        else:
+            rss = read_rss_bytes()
+        cpu = read_cpu_seconds()
+        with self._lock:
+            self.series.append((now, rss, cpu))
+            for stage in self._open:
+                stage.rss_bytes.append(rss)
+        self._metrics.HOST_RSS_BYTES.set(float(rss))
+        self._metrics.HOST_CPU_SECONDS.set(cpu)
+        self.overhead_s += time.thread_time() - c0
+
+    # -- stages ------------------------------------------------------------
+
+    @contextmanager
+    def stage(self, name: str):
+        if self.trace_python_alloc:
+            import tracemalloc
+
+            if not tracemalloc.is_tracing():
+                tracemalloc.start()
+            tracemalloc.reset_peak()
+        record = _OpenStage(
+            name=name,
+            start_wall=time.perf_counter(),
+            start_cpu=read_cpu_seconds(),
+            rss_bytes=[read_rss_bytes()],
+        )
+        with self._lock:
+            self._open.append(record)
+        try:
+            yield self
+        finally:
+            end_wall = time.perf_counter()
+            end_cpu = read_cpu_seconds()
+            record.rss_bytes.append(read_rss_bytes())
+            with self._lock:
+                self._open.remove(record)
+            peak_mb = None
+            if self.trace_python_alloc:
+                import tracemalloc
+
+                peak_mb = tracemalloc.get_traced_memory()[1] / 2**20
+            self.stats[name] = _close(record, end_wall, end_cpu, peak_mb)
+
+    def snapshot(self) -> dict:
+        """Live view for the /debug/envelope endpoint."""
+        with self._lock:
+            series = list(self.series)[-120:]
+            open_names = [s.name for s in self._open]
+        return {
+            "interval_s": self.interval_s,
+            "overhead_s": round(self.overhead_s, 6),
+            "rss_mb": round(read_rss_bytes() / 2**20, 1),
+            "cpu_s": round(read_cpu_seconds(), 3),
+            "open_stages": open_names,
+            "stages": {name: st.as_dict() for name, st in self.stats.items()},
+            "series": [
+                {"t": round(t, 3), "rss_mb": round(r / 2**20, 1), "cpu_s": round(c, 3)}
+                for t, r, c in series
+            ],
+        }
+
+
+def _close(record: _OpenStage, end_wall: float, end_cpu: float, peak_mb) -> StageStats:
+    wall = max(end_wall - record.start_wall, 1e-9)
+    cpu = max(end_cpu - record.start_cpu, 0.0)
+    rss_mb = [b / 2**20 for b in record.rss_bytes]
+    return StageStats(
+        name=record.name,
+        wall_s=wall,
+        cpu_s=cpu,
+        avg_cores=cpu / wall,
+        rss_mb_p50=percentile(rss_mb, 0.50),
+        rss_mb_p95=percentile(rss_mb, 0.95),
+        rss_mb_max=max(rss_mb),
+        samples=len(rss_mb),
+        tracemalloc_peak_mb=peak_mb,
+    )
+
+
+@contextmanager
+def measured(
+    result: dict,
+    stage: str = "stage",
+    sampler: Optional[ResourceSampler] = None,
+    interval_s: float = 0.05,
+):
+    """Run a block under a stage and fill ``result`` with the envelope
+    fields every bench stage dict must carry: ``host_rss_mb`` (P95 of the
+    absolute RSS series over the stage) and ``cpu_s`` (CPU-seconds spent in
+    it), plus ``avg_cores``. Borrows ``sampler`` when given; otherwise
+    spins up (and tears down) a transient one."""
+    own = sampler is None
+    s = sampler if sampler is not None else ResourceSampler(interval_s=interval_s)
+    if own:
+        s.start()
+    try:
+        with s.stage(stage):
+            yield result
+    finally:
+        if own:
+            s.stop()
+        stats = s.stats.get(stage)
+        if stats is not None:
+            result["host_rss_mb"] = round(stats.rss_mb_p95, 1)
+            result["cpu_s"] = round(stats.cpu_s, 3)
+            result["avg_cores"] = round(stats.avg_cores, 3)

@@ -1,0 +1,321 @@
+"""Declarative e2e scenarios with resource-envelope assertions.
+
+Counterpart of the reference e2e performance suite
+(test/suites/performance/basic_test.go:50-81): scale-out, consolidation,
+drift and hostname-spread each run end-to-end and must land inside an
+Envelope (wall, P95 RSS, CPU). The reference drives a real cluster via
+KWOK nodes and scrapes the controller pod; here the same lifecycle runs
+through the in-process harness — kwok provider + fake clock + Manager +
+KubeSchedulerSim (controllers/manager.py) — while the envelope sampler
+watches this process's RSS/CPU.
+
+The fake clock means wall-clock here is pure compute (solves, reconciles,
+binds), not the reference's instance-boot waits, so the wall ceilings are
+tighter than the reference's 2 min while the RSS/CPU ceilings carry the
+JAX-runtime context (spec.py explains the growth-above-baseline form).
+
+Usage::
+
+    from karpenter_tpu.envelope import run_scenario
+    result = run_scenario("scale_out")      # asserts the default envelope
+    result.stats.rss_mb_p95, result.detail["nodes"]
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from karpenter_tpu.envelope.sampler import ResourceSampler, StageStats, read_rss_bytes
+from karpenter_tpu.envelope.spec import Envelope
+
+
+def _harness(catalog_size: int = 64, consolidate_after: float = 0.0):
+    """The kwok + fake-clock stack every scenario runs on (the same shape
+    tests/test_disruption.py builds): one pool, open disruption budgets,
+    pinned on-demand so consolidation replacements aren't spot-gated."""
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+    from karpenter_tpu.controllers.manager import Manager
+    from karpenter_tpu.models import labels as l
+    from karpenter_tpu.models.nodepool import Budget, NodePool
+    from karpenter_tpu.state.store import ObjectStore
+    from karpenter_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    store = ObjectStore(clock)
+    cloud = KwokCloudProvider(store, catalog=instance_types(catalog_size))
+    mgr = Manager(store, cloud, clock)
+    pool = NodePool()
+    pool.metadata.name = "default"
+    pool.spec.disruption.consolidate_after_seconds = consolidate_after
+    pool.spec.disruption.consolidation_policy = "WhenEmptyOrUnderutilized"
+    pool.spec.disruption.budgets = [Budget(nodes="100%")]
+    pool.spec.template.spec.requirements = [
+        {
+            "key": l.CAPACITY_TYPE_LABEL_KEY,
+            "operator": "In",
+            "values": [l.CAPACITY_TYPE_ON_DEMAND],
+        }
+    ]
+    store.create(ObjectStore.NODEPOOLS, pool)
+    return clock, store, cloud, mgr
+
+
+def _settle(mgr, store, cloud, rounds: int = 4) -> None:
+    from karpenter_tpu.controllers.manager import KubeSchedulerSim
+
+    for _ in range(rounds):
+        mgr.run_until_idle()
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        KubeSchedulerSim(store, mgr.cluster).bind_pending()
+        if all(p.spec.node_name for p in store.pods()):
+            break
+        mgr.batcher.trigger()
+
+
+def _provision(mgr, store, cloud, pods) -> None:
+    from karpenter_tpu.state.store import ObjectStore
+
+    for p in pods:
+        store.create(ObjectStore.PODS, p)
+    _settle(mgr, store, cloud)
+
+
+def _delete_pods(store, mgr, predicate) -> None:
+    from karpenter_tpu.state.store import ObjectStore
+
+    for pod in list(store.pods()):
+        if predicate(pod):
+            pod.status.phase = "Succeeded"
+            store.update(ObjectStore.PODS, pod)
+            store.delete(ObjectStore.PODS, pod.name)
+    mgr.run_until_idle()
+
+
+def _disruption_cycles(clock, store, cloud, mgr, polls: int = 8, step: float = 20.0):
+    """Poll disruption through its 15s validation window, re-binding the
+    churn each round (the loop every disruption e2e drives)."""
+    from karpenter_tpu.controllers.manager import KubeSchedulerSim
+
+    executed = None
+    for _ in range(polls):
+        cmd = mgr.run_disruption_once()
+        executed = executed or cmd
+        cloud.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        KubeSchedulerSim(store, mgr.cluster).bind_pending()
+        clock.step(step)
+    return executed
+
+
+# -- scenarios (basic_test.go:50-81 rows) ------------------------------------
+
+
+def scale_out(n_pods: int = 500) -> dict:
+    """500 pending pods -> nodes launched, registered, Ready, every pod
+    bound (basic_test.go:50-59 'scale out')."""
+    from karpenter_tpu.models import labels as l
+    from karpenter_tpu.models.pod import make_pod
+
+    clock, store, cloud, mgr = _harness(catalog_size=64)
+    zones = ("test-zone-1", "test-zone-2", "test-zone-3", "test-zone-4")
+    pods = []
+    for i in range(n_pods):
+        sel = {}
+        if i % 5 == 1:
+            sel[l.LABEL_TOPOLOGY_ZONE] = zones[i % len(zones)]
+        if i % 5 == 3:
+            sel[l.CAPACITY_TYPE_LABEL_KEY] = l.CAPACITY_TYPE_ON_DEMAND
+        pods.append(
+            make_pod(
+                f"so-{i}",
+                cpu=(0.25, 0.5, 1.0, 2.0)[i % 4],
+                memory=("512Mi", "1Gi", "2Gi")[i % 3],
+                node_selector=sel,
+            )
+        )
+    _provision(mgr, store, cloud, pods)
+    bound = sum(1 for p in store.pods() if p.spec.node_name)
+    assert bound == n_pods, f"only {bound}/{n_pods} pods bound"
+    ready = sum(1 for n in store.nodes() if n.status.ready)
+    assert ready == len(store.nodes()) and ready > 0
+    return {"pods": n_pods, "nodes": ready}
+
+
+def consolidation(n_pods: int = 24) -> dict:
+    """Provision, finish half the workload, consolidate: capacity must
+    shrink while every survivor stays bound (basic_test.go 'consolidation',
+    multi-node first per the method cascade)."""
+    from karpenter_tpu.models.pod import make_pod
+
+    clock, store, cloud, mgr = _harness(catalog_size=64)
+    survivors = {f"co-{i}" for i in range(n_pods // 2)}
+    _provision(
+        mgr, store, cloud,
+        [make_pod(f"co-{i}", cpu=1.5, memory="1Gi") for i in range(n_pods)],
+    )
+    cpu_before = sum(n.status.capacity["cpu"] for n in store.nodes())
+    _delete_pods(store, mgr, lambda p: p.name not in survivors)
+    clock.step(60.0)
+    executed = _disruption_cycles(clock, store, cloud, mgr)
+    assert executed is not None, "no consolidation command produced"
+    _settle(mgr, store, cloud)
+    cpu_after = sum(n.status.capacity["cpu"] for n in store.nodes())
+    assert cpu_after < cpu_before, "no capacity reclaimed"
+    stranded = [p.name for p in store.pods() if not p.spec.node_name]
+    assert not stranded, f"pods stranded after consolidation: {stranded}"
+    return {
+        "pods": len(survivors),
+        "cpu_before": cpu_before,
+        "cpu_after": cpu_after,
+        "command_reason": executed.reason,
+    }
+
+
+def drift(n_pods: int = 6) -> dict:
+    """Stamp claims Drifted via a template change and replace them: every
+    original claim gone, every pod re-bound (basic_test.go 'drift')."""
+    from karpenter_tpu.models.pod import make_pod
+    from karpenter_tpu.state.store import ObjectStore
+
+    clock, store, cloud, mgr = _harness(catalog_size=32)
+    _provision(
+        mgr, store, cloud,
+        [make_pod(f"dr-{i}", cpu=1.0) for i in range(n_pods)],
+    )
+    original = {c.name for c in store.nodeclaims()}
+    pool = store.get(ObjectStore.NODEPOOLS, "default")
+    pool.spec.template.labels["drift-round"] = "r2"
+    store.update(ObjectStore.NODEPOOLS, pool)
+    marked = mgr.mark_drift()
+    assert marked >= 1, "template change marked nothing Drifted"
+    clock.step(30.0)
+    replaced = None
+    for _ in range(6 * max(1, len(original))):
+        replaced = _disruption_cycles(clock, store, cloud, mgr, polls=2) or replaced
+        mgr.mark_drift()  # new claims get checked too
+        if not original & {c.name for c in store.nodeclaims()}:
+            break
+    remaining = original & {c.name for c in store.nodeclaims()}
+    assert not remaining, f"drifted claims never replaced: {sorted(remaining)}"
+    _settle(mgr, store, cloud)
+    stranded = [p.name for p in store.pods() if not p.spec.node_name]
+    assert not stranded, f"pods stranded after drift: {stranded}"
+    return {"pods": n_pods, "claims_replaced": len(original), "marked": marked}
+
+
+def hostname_spread(n_pods: int = 20) -> dict:
+    """Hostname topology-spread at maxSkew 1: pods land one-per-domain-step
+    across distinct nodes (basic_test.go 'hostname topology spread')."""
+    from karpenter_tpu.models import labels as l
+    from karpenter_tpu.models.pod import TopologySpreadConstraint, make_pod
+    from karpenter_tpu.state.store import ObjectStore
+
+    clock, store, cloud, mgr = _harness(catalog_size=32)
+    pods = []
+    for i in range(n_pods):
+        p = make_pod(f"hs-{i}", cpu=0.5)
+        p.metadata.labels = {"spread": "host"}
+        p.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(
+                max_skew=1,
+                topology_key=l.LABEL_HOSTNAME,
+                label_selector={"spread": "host"},
+            )
+        ]
+        pods.append(p)
+    _provision(mgr, store, cloud, pods)
+    bound = [p for p in store.pods() if p.spec.node_name]
+    assert len(bound) == n_pods, f"only {len(bound)}/{n_pods} bound"
+    per_node: dict[str, int] = {}
+    for p in bound:
+        per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
+    skew = max(per_node.values()) - min(per_node.values())
+    assert skew <= 1, f"hostname skew {skew} > 1: {per_node}"
+    return {"pods": n_pods, "nodes": len(per_node), "skew": skew}
+
+
+# -- registry + runner --------------------------------------------------------
+
+# Default envelopes, calibrated on the 8-device CPU-mesh CI harness
+# (r6 measurements: scale_out 2.8s wall / +40MB P95 growth / 0.99 avg
+# cores; consolidation 4.8s / +83MB; drift 2.2s / +43MB; hostname_spread
+# 2.7s / +148MB incl. first-compile). Ceilings carry ~6-10x headroom for
+# slower CI and cold-compile variance, and ratchet down over rounds the
+# way the perf gates do. The reference rows these mirror: scale-out
+# < 2 min / < 260MB P95 / < 0.5 cores (basic_test.go:50-59) — its wall
+# covers real instance boots and its process is an otherwise-idle
+# controller pod, hence the different shapes of the same discipline.
+_CORES_CEILING = 6.0  # measured ~1.0: a busy-wait/thread-leak tripwire
+
+SCENARIOS: dict[str, tuple[Callable[[], dict], Envelope]] = {
+    "scale_out": (
+        scale_out,
+        Envelope(max_wall_s=90.0, max_rss_mb_p95=600.0, max_cpu_cores=_CORES_CEILING),
+    ),
+    "consolidation": (
+        consolidation,
+        Envelope(max_wall_s=60.0, max_rss_mb_p95=600.0, max_cpu_cores=_CORES_CEILING),
+    ),
+    "drift": (
+        drift,
+        Envelope(max_wall_s=60.0, max_rss_mb_p95=500.0, max_cpu_cores=_CORES_CEILING),
+    ),
+    "hostname_spread": (
+        hostname_spread,
+        Envelope(max_wall_s=60.0, max_rss_mb_p95=600.0, max_cpu_cores=_CORES_CEILING),
+    ),
+}
+
+
+@dataclass
+class ScenarioResult:
+    name: str
+    detail: dict
+    stats: StageStats
+    envelope: Envelope
+    baseline_rss_mb: float
+
+    def as_dict(self) -> dict:
+        return {
+            "detail": self.detail,
+            "baseline_rss_mb": round(self.baseline_rss_mb, 1),
+            **self.stats.as_dict(),
+        }
+
+
+def run_scenario(
+    name: str,
+    envelope: Optional[Envelope] = None,
+    sampler: Optional[ResourceSampler] = None,
+    check: bool = True,
+    **scenario_kwargs,
+) -> ScenarioResult:
+    """Run one named scenario under the sampler and (by default) assert its
+    envelope. Raises EnvelopeExceeded on breach."""
+    fn, default_env = SCENARIOS[name]
+    env = envelope or default_env
+    own = sampler is None
+    s = sampler if sampler is not None else ResourceSampler(interval_s=0.05)
+    baseline_mb = read_rss_bytes() / 2**20
+    if own:
+        s.start()
+    try:
+        with s.stage(name):
+            detail = fn(**scenario_kwargs)
+    finally:
+        if own:
+            s.stop()
+    stats = s.stats[name]
+    result = ScenarioResult(
+        name=name,
+        detail=detail,
+        stats=stats,
+        envelope=env,
+        baseline_rss_mb=baseline_mb,
+    )
+    if check:
+        env.check(stats, baseline_rss_mb=baseline_mb)
+    return result

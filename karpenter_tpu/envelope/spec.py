@@ -1,0 +1,64 @@
+"""Resource envelopes: declarative wall/RSS/CPU ceilings per scenario.
+
+Counterpart of the reference e2e performance thresholds
+(test/suites/performance/thresholds.go:28-43 and basic_test.go:50-81):
+scale-out must finish < 2 min at < 260 MB P95 RSS and < 0.5 average
+cores, with separate envelopes for consolidation, drift, hostname-spread
+and do-not-disrupt. There the measured process is a dedicated controller
+pod scraped from outside; here the control plane, solver client and test
+harness share one Python process that also carries the JAX runtime, so
+the RSS ceiling is expressed as GROWTH of the P95 RSS above a baseline
+taken at scenario start — an absolute ceiling would mostly measure how
+much of libtpu/XLA happened to be resident before the scenario ran.
+
+CPU has two ceilings: ``max_cpu_cores`` bounds average concurrency
+(cpu_s / wall_s — a busy-wait or runaway thread pool fails it even when
+the wall stays inside budget) and the optional ``max_cpu_s`` bounds total
+compute. Ceilings are deliberately set with headroom over measured
+reality and ratcheted down over rounds, the same discipline
+tests/test_perf_gate.py applies to throughput.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from karpenter_tpu.envelope.sampler import StageStats
+
+
+class EnvelopeExceeded(AssertionError):
+    """A scenario left its resource envelope; message lists every breach."""
+
+
+@dataclass(frozen=True)
+class Envelope:
+    """Ceilings for one scenario (thresholds.go rows)."""
+
+    max_wall_s: float
+    max_rss_mb_p95: float  # P95 RSS growth above the scenario-start baseline
+    max_cpu_cores: float  # average concurrency over the scenario
+    max_cpu_s: Optional[float] = None
+
+    def violations(self, stats: StageStats, baseline_rss_mb: float = 0.0) -> list[str]:
+        out = []
+        if stats.wall_s > self.max_wall_s:
+            out.append(f"wall {stats.wall_s:.2f}s > {self.max_wall_s}s")
+        growth = stats.rss_mb_p95 - baseline_rss_mb
+        if growth > self.max_rss_mb_p95:
+            out.append(
+                f"P95 RSS growth {growth:.1f}MB > {self.max_rss_mb_p95}MB "
+                f"(P95 {stats.rss_mb_p95:.1f}MB over baseline {baseline_rss_mb:.1f}MB)"
+            )
+        if stats.avg_cores > self.max_cpu_cores:
+            out.append(f"avg cores {stats.avg_cores:.2f} > {self.max_cpu_cores}")
+        if self.max_cpu_s is not None and stats.cpu_s > self.max_cpu_s:
+            out.append(f"cpu {stats.cpu_s:.2f}s > {self.max_cpu_s}s")
+        return out
+
+    def check(self, stats: StageStats, baseline_rss_mb: float = 0.0) -> None:
+        breaches = self.violations(stats, baseline_rss_mb)
+        if breaches:
+            raise EnvelopeExceeded(
+                f"scenario {stats.name!r} out of envelope: " + "; ".join(breaches)
+            )

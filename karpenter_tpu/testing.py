@@ -186,31 +186,33 @@ def expect_metric_at_least(name: str, value: float, **labels) -> float:
 def measure_resources(result: dict):
     """Measure CURRENT-RSS growth (MB) and CPU seconds across the block —
     the in-process analog of the e2e suite's controller memory/CPU
-    thresholds. Fills result with {"rss_mb": ..., "cpu_s": ...}.
+    thresholds, now backed by the envelope sampler (envelope/sampler.py:
+    a 50ms background series, so result also carries the P95-growth and
+    average-cores fields the Envelope specs assert). Fills result with
+    {"rss_mb", "cpu_s", "rss_mb_p95", "avg_cores"}.
 
     Uses the live VmRSS (not ru_maxrss): a high-water mark set by an
     excluded warm-up (the XLA compile) would make every later growth
-    assertion vacuous."""
-    import time
+    assertion vacuous; CPU comes from getrusage, which counts ALL threads
+    (XLA's pool included) unlike time.process_time on some platforms."""
+    from karpenter_tpu.envelope.sampler import ResourceSampler
 
     rss0 = current_rss_mb()
-    cpu0 = time.process_time()
-    yield result
-    result["cpu_s"] = time.process_time() - cpu0
+    with ResourceSampler(interval_s=0.05) as sampler:
+        with sampler.stage("measure"):
+            yield result
+    stats = sampler.stats["measure"]
+    result["cpu_s"] = stats.cpu_s
     result["rss_mb"] = current_rss_mb() - rss0
+    result["rss_mb_p95"] = stats.rss_mb_p95 - rss0
+    result["avg_cores"] = stats.avg_cores
 
 
 def current_rss_mb() -> float:
     """Live resident set size (VmRSS), not the high-water mark."""
-    import os
+    from karpenter_tpu.envelope.sampler import read_rss_bytes
 
-    try:
-        with open("/proc/self/statm") as f:
-            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE") / 2**20
-    except OSError:  # non-Linux: fall back to the high-water mark
-        import resource
-
-        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+    return read_rss_bytes() / 2**20
 
 
 def build_bound_cluster(n_pods: int = 6, pod_cpu: float = 2.0, catalog=None):

@@ -257,6 +257,17 @@ STATUS_CONDITION_TRANSITIONS = REGISTRY.counter(
     "Condition transitions",
     ("type", "status"),
 )
+# host resource envelope (envelope/sampler.py ticks these; the analog of
+# the controller pod's container_memory_working_set_bytes /
+# container_cpu_usage_seconds_total the reference e2e thresholds scrape,
+# test/suites/performance/thresholds.go:28-43)
+HOST_RSS_BYTES = REGISTRY.gauge(
+    "ktpu_host_rss_bytes", "Live resident set size of the control-plane process"
+)
+HOST_CPU_SECONDS = REGISTRY.gauge(
+    "ktpu_cpu_seconds_total",
+    "Cumulative user+system CPU seconds of the control-plane process",
+)
 # cloudprovider SPI decorator families (cloudprovider/metrics/cloudprovider.go)
 CLOUDPROVIDER_DURATION = REGISTRY.histogram(
     "karpenter_cloudprovider_duration_seconds",

@@ -158,6 +158,31 @@ class _Handler(BaseHTTPRequestHandler):
                 out.write(f"--- thread {tid} ---\n")
                 traceback.print_stack(frame, file=out)
             self._send(200, out.getvalue())
+        elif path == "/debug/envelope":
+            if not self.config.enable_profiling:
+                self._send(404, "profiling disabled")
+                return
+            # live host-resource series: the running envelope sampler's
+            # snapshot when one is active (bench / scenario runs), else a
+            # one-shot RSS/CPU reading — the in-process analog of scraping
+            # the controller pod's cgroup stats (thresholds.go:28-43)
+            from karpenter_tpu.envelope.sampler import (
+                global_sampler,
+                read_cpu_seconds,
+                read_rss_bytes,
+            )
+
+            sampler = global_sampler()
+            if sampler is not None:
+                body = sampler.snapshot()
+            else:
+                body = {
+                    "rss_mb": round(read_rss_bytes() / 2**20, 1),
+                    "cpu_s": round(read_cpu_seconds(), 3),
+                    "stages": {},
+                    "series": [],
+                }
+            self._send(200, json.dumps(body), ctype="application/json")
         elif path == "/debug/pprof/profile":
             if not self.config.enable_profiling:
                 self._send(404, "profiling disabled")

@@ -1,0 +1,204 @@
+"""Resource-envelope e2e suite + sampler unit tests.
+
+The in-process counterpart of the reference e2e performance suite
+(test/suites/performance/basic_test.go:50-81, thresholds.go:28-43):
+scale-out, consolidation, drift and hostname-spread run end-to-end on the
+kwok provider + fake clock while the envelope sampler watches host RSS and
+CPU, and each scenario must land inside its Envelope (wall, P95 RSS
+growth, average cores). Throughput has its gates in test_perf_gate.py;
+this file pins the footprint.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from karpenter_tpu.envelope import (
+    SCENARIOS,
+    Envelope,
+    EnvelopeExceeded,
+    ResourceSampler,
+    measured,
+    percentile,
+    read_cpu_seconds,
+    read_rss_bytes,
+    run_scenario,
+)
+
+
+def _busy(seconds: float) -> float:
+    """Burn CPU for ~seconds; returns a value so the loop can't be elided."""
+    deadline = time.perf_counter() + seconds
+    acc = 0.0
+    while time.perf_counter() < deadline:
+        acc += sum(i * i for i in range(512))
+    return acc
+
+
+class TestSampler:
+    def test_cpu_series_monotone(self):
+        """getrusage CPU is cumulative: successive readings around real
+        work must be non-decreasing, and busy work must advance them."""
+        readings = [read_cpu_seconds()]
+        for _ in range(3):
+            _busy(0.05)
+            readings.append(read_cpu_seconds())
+        assert readings == sorted(readings)
+        assert readings[-1] > readings[0]
+
+    def test_rss_read_positive(self):
+        assert read_rss_bytes() > 10 * 2**20  # a Python+JAX process
+
+    def test_percentile_math_on_synthetic_series(self):
+        """Nearest-rank percentiles, the exact form the envelopes assert."""
+        series = list(range(1, 101))  # 1..100
+        assert percentile(series, 0.50) == 50
+        assert percentile(series, 0.95) == 95
+        assert percentile(series, 1.00) == 100
+        assert percentile([7.0], 0.95) == 7.0
+        assert percentile([3.0, 1.0, 2.0], 0.5) == 2.0  # unsorted input
+        import math
+
+        assert math.isnan(percentile([], 0.95))
+
+    def test_stage_nesting(self):
+        sampler = ResourceSampler(interval_s=0.02)
+        with sampler:
+            with sampler.stage("outer"):
+                _busy(0.05)
+                with sampler.stage("inner"):
+                    _busy(0.05)
+                _busy(0.05)
+        outer, inner = sampler.stats["outer"], sampler.stats["inner"]
+        assert inner.wall_s < outer.wall_s
+        assert inner.cpu_s <= outer.cpu_s + 1e-6
+        # both stages got their own RSS series (endpoints + thread ticks)
+        assert inner.samples >= 2 and outer.samples > inner.samples
+        assert outer.avg_cores > 0.3  # the block was pure compute
+
+    def test_stats_survive_exceptions(self):
+        sampler = ResourceSampler(interval_s=0.02)
+        with sampler:
+            with pytest.raises(RuntimeError):
+                with sampler.stage("doomed"):
+                    raise RuntimeError("scenario blew up")
+        assert "doomed" in sampler.stats  # the envelope still closed
+
+    def test_sampler_overhead_under_one_percent(self):
+        """The sampler self-times its ticks (thread CPU seconds — a tick
+        parked on the GIL behind the busy loop is time the workload RAN,
+        not sampling cost): over a busy-loop stage the cumulative tick
+        cost must stay under 1% of the stage wall — the guard that keeps
+        envelope measurement from perturbing what it measures (the
+        reference scrapes out-of-process for the same reason)."""
+        sampler = ResourceSampler(interval_s=0.05)
+        with sampler:
+            with sampler.stage("busy"):
+                _busy(0.5)
+        stats = sampler.stats["busy"]
+        assert stats.samples >= 3  # the thread actually ticked
+        assert sampler.overhead_s < 0.01 * stats.wall_s, (
+            f"sampler spent {sampler.overhead_s * 1000:.2f}ms sampling a "
+            f"{stats.wall_s:.2f}s stage"
+        )
+
+    def test_metrics_gauges_published(self):
+        from karpenter_tpu.utils import metrics
+
+        sampler = ResourceSampler(interval_s=0.01)
+        with sampler:
+            time.sleep(0.1)
+        assert metrics.HOST_RSS_BYTES.get() > 0
+        assert metrics.HOST_CPU_SECONDS.get() > 0
+
+    def test_tracemalloc_peak_behind_flag(self):
+        sampler = ResourceSampler(interval_s=0.05, trace_python_alloc=True)
+        with sampler:
+            with sampler.stage("alloc"):
+                blob = [bytes(1024) for _ in range(4096)]  # ~4MB of objects
+        del blob
+        peak = sampler.stats["alloc"].tracemalloc_peak_mb
+        assert peak is not None and peak > 3.0
+        # default-off: no tracemalloc cost on the normal path
+        plain = ResourceSampler(interval_s=0.05)
+        with plain:
+            with plain.stage("alloc"):
+                pass
+        assert plain.stats["alloc"].tracemalloc_peak_mb is None
+
+    def test_measured_fills_bench_keys(self):
+        """The contract every bench.py stage dict rides on."""
+        out = {}
+        with measured(out, stage="unit"):
+            _busy(0.05)
+        assert set(out) >= {"host_rss_mb", "cpu_s", "avg_cores"}
+        assert out["host_rss_mb"] > 0 and out["cpu_s"] > 0
+
+
+class TestEnvelopeSpec:
+    def test_violations_enumerated(self):
+        from karpenter_tpu.envelope.sampler import StageStats
+
+        stats = StageStats(
+            name="x", wall_s=10.0, cpu_s=40.0, avg_cores=4.0,
+            rss_mb_p50=900.0, rss_mb_p95=1000.0, rss_mb_max=1100.0, samples=10,
+        )
+        env = Envelope(max_wall_s=5.0, max_rss_mb_p95=200.0, max_cpu_cores=2.0)
+        breaches = env.violations(stats, baseline_rss_mb=500.0)
+        assert len(breaches) == 3  # wall, rss growth (500 > 200), cores
+        with pytest.raises(EnvelopeExceeded):
+            env.check(stats, baseline_rss_mb=500.0)
+        # inside the envelope: growth 1000-900=100 < 200 etc.
+        ok = Envelope(max_wall_s=20.0, max_rss_mb_p95=200.0, max_cpu_cores=8.0)
+        assert ok.violations(stats, baseline_rss_mb=900.0) == []
+
+    def test_cpu_seconds_ceiling_optional(self):
+        from karpenter_tpu.envelope.sampler import StageStats
+
+        stats = StageStats(
+            name="x", wall_s=1.0, cpu_s=9.0, avg_cores=1.0,
+            rss_mb_p50=0.0, rss_mb_p95=0.0, rss_mb_max=0.0, samples=2,
+        )
+        assert Envelope(10.0, 100.0, 2.0).violations(stats) == []
+        assert Envelope(10.0, 100.0, 2.0, max_cpu_s=5.0).violations(stats)
+
+
+class TestScenarioEnvelopes:
+    """The e2e rows (basic_test.go:50-81): each scenario drives the full
+    kwok + fake-clock lifecycle and must stay inside its envelope."""
+
+    def test_scale_out_envelope(self):
+        result = run_scenario("scale_out")  # asserts the Envelope
+        assert result.detail["pods"] == 500
+        assert result.detail["nodes"] >= 1
+        assert result.stats.samples >= 2
+
+    def test_consolidation_envelope(self):
+        result = run_scenario("consolidation")
+        assert result.detail["cpu_after"] < result.detail["cpu_before"]
+
+    def test_drift_envelope(self):
+        result = run_scenario("drift")
+        assert result.detail["claims_replaced"] >= 1
+
+    def test_hostname_spread_envelope(self):
+        result = run_scenario("hostname_spread")
+        assert result.detail["skew"] <= 1
+
+    def test_registry_covers_reference_rows(self):
+        assert {"scale_out", "consolidation", "drift", "hostname_spread"} <= set(
+            SCENARIOS
+        )
+        for _fn, env in SCENARIOS.values():
+            assert env.max_wall_s <= 120.0  # the reference scale-out bound
+
+    def test_breach_detected(self):
+        """An impossible envelope must fail loudly — proves the assertion
+        path is live, not vacuous."""
+        with pytest.raises(EnvelopeExceeded):
+            run_scenario(
+                "hostname_spread",
+                envelope=Envelope(max_wall_s=1e-9, max_rss_mb_p95=1e9, max_cpu_cores=1e9),
+            )

@@ -269,3 +269,51 @@ class TestRepairRacesWorkload:
         node = store.get(ObjectStore.NODES, pod.spec.node_name)
         assert node is not None
         assert node.metadata.labels.get("team") == "changed"
+
+
+class TestDaemonSetArrival:
+    def test_new_daemonset_provokes_solve_pass(self):
+        """The DAEMONSETS watch (state/informer/daemonset.go analog): a
+        daemonset created while pods sit pending must trigger the batcher
+        and produce a fresh solve pass — without any pod/pool event."""
+        from karpenter_tpu.models.daemonset import DaemonSet
+        from karpenter_tpu.models.pod import PodSpec
+        from karpenter_tpu.utils import resources as res
+
+        clock, store, cloud, mgr = build_env(catalog_size=8)
+        # a pod no 1-cpu shape can hold: it stays provisionable while
+        # every solve pass comes up empty and the batch window resets
+        store.create(ObjectStore.PODS, make_pod("wedged", cpu=64.0))
+        mgr.run_until_idle()
+        assert not store.nodeclaims()
+        assert not mgr.batcher.pending, "batch window should have drained"
+
+        passes = []
+        original = mgr.provisioner.reconcile
+        mgr.provisioner.reconcile = lambda *a, **kw: passes.append(1) or original(*a, **kw)
+
+        # an unrelated daemonset arriving re-triggers provisioning: the
+        # overhead groups changed, so the pending pod deserves a fresh pass
+        ds = DaemonSet()
+        ds.metadata.name = "late-agent"
+        ds.pod_template = PodSpec(requests={res.CPU: 0.1})
+        store.create(ObjectStore.DAEMONSETS, ds)
+        assert mgr.batcher.pending, "daemonset event did not trigger the batcher"
+        mgr.run_until_idle()
+        assert passes, "no solve pass followed the daemonset event"
+
+    def test_daemonset_without_pending_pods_is_quiet(self):
+        """No provisionable pods -> a daemonset event must NOT open a batch
+        window (the informer triggers work, it doesn't invent it)."""
+        from karpenter_tpu.models.daemonset import DaemonSet
+        from karpenter_tpu.models.pod import PodSpec
+        from karpenter_tpu.utils import resources as res
+
+        clock, store, cloud, mgr = build_env()
+        provision(mgr, store, cloud, [make_pod("p", cpu=0.5)])
+        assert not mgr.batcher.pending
+        ds = DaemonSet()
+        ds.metadata.name = "quiet-agent"
+        ds.pod_template = PodSpec(requests={res.CPU: 0.1})
+        store.create(ObjectStore.DAEMONSETS, ds)
+        assert not mgr.batcher.pending

@@ -230,3 +230,78 @@ class TestManagerWiring:
         clock.step(REQUEUE_SECONDS + 1.0)
         assert ctrl.maybe_reconcile() is not None
         assert ctrl._next_requeue > before
+
+
+class TestPricingInformer:
+    """Re-price on pricing change (state/informer/pricing.go analog): an
+    overlay price change must re-derive every live claim's ClusterCost
+    entry — the Balanced-scoring denominator — without any claim churn."""
+
+    def _bound_cluster(self, n_pods=4):
+        from karpenter_tpu.cloudprovider.fake import new_instance_type
+        from karpenter_tpu.controllers.manager import KubeSchedulerSim
+
+        clock = FakeClock()
+        store = ObjectStore(clock)
+        inner = KwokCloudProvider(
+            store,
+            catalog=[new_instance_type("n-4x", cpu=4), new_instance_type("n-8x", cpu=8)],
+        )
+        cloud = OverlayCloudProvider(inner, store)
+        mgr = Manager(store, cloud, clock)
+        pool = _pool()
+        pool.spec.disruption.consolidation_policy = "Balanced"
+        store.create(ObjectStore.NODEPOOLS, pool)
+        for i in range(n_pods):
+            store.create(
+                ObjectStore.PODS,
+                make_pod(f"p-{i}", cpu=2.0, node_selector={l.LABEL_INSTANCE_TYPE: "n-4x"}),
+            )
+        mgr.run_until_idle()
+        inner.simulate_kubelet_ready()
+        mgr.run_until_idle()
+        KubeSchedulerSim(store, mgr.cluster).bind_pending()
+        mgr.run_until_idle()
+        assert all(p.spec.node_name for p in store.pods())
+        return clock, store, mgr
+
+    def test_overlay_price_change_reprices_ledger_without_claim_churn(self):
+        _clock, store, mgr = self._bound_cluster()
+        cost0 = mgr.cost.pool_cost("default")
+        assert cost0 > 0
+        versions = {c.name: c.metadata.resource_version for c in store.nodeclaims()}
+        store.create(ObjectStore.NODE_OVERLAYS, _overlay("surge", price="+900%"))
+        # ledger repriced from the overlaid catalog, claims untouched
+        assert mgr.cost.pool_cost("default") == pytest.approx(10.0 * cost0, rel=1e-6)
+        assert {
+            c.name: c.metadata.resource_version for c in store.nodeclaims()
+        } == versions, "repricing must not churn claims"
+
+    def test_overlay_price_change_flips_balanced_decision(self):
+        """A delete-consolidation of one of four single-pod nodes scores
+        ratio = (savings/poolCost)/(disruption/poolDisruption) = 1.0 with
+        the pre-overlay ledger (approved at k=2), and 0.1 once a +900%
+        overlay reprices the denominator — the decision must flip on the
+        overlay event alone, with zero claim churn (balanced.go:47-130)."""
+        from karpenter_tpu.controllers.disruption.candidates import Candidate
+        from karpenter_tpu.controllers.disruption.methods import Command
+
+        _clock, store, mgr = self._bound_cluster(n_pods=4)
+        pool = store.get(ObjectStore.NODEPOOLS, "default")
+        per_claim = mgr.cost.pool_cost("default") / 4.0
+        sn = mgr.cluster.node_by_name(store.nodes()[0].name)
+        assert sn is not None and sn.pods
+        candidate = Candidate(
+            state_node=sn,
+            nodepool=pool,
+            instance_type=None,
+            price=per_claim,
+            reschedulable_pods=[],
+            disruption_cost=2.0,  # 1.0 node + 1.0 for its single pod
+        )
+        cmd = Command(candidates=[candidate], replacements=[], reason="Underutilized")
+        assert mgr.disruption._balanced_approves(cmd, [candidate])
+        store.create(ObjectStore.NODE_OVERLAYS, _overlay("surge", price="+900%"))
+        assert not mgr.disruption._balanced_approves(cmd, [candidate]), (
+            "Balanced approved against a stale pool cost after repricing"
+        )

@@ -115,6 +115,47 @@ class TestHealthServer:
         finally:
             server.shutdown()
 
+    def test_envelope_endpoint_surfaces_live_series(self):
+        """/debug/envelope (behind --enable-profiling): snapshots the
+        running envelope sampler — stages + recent RSS/CPU series — or a
+        one-shot reading when no sampler is active."""
+        import json as _json
+
+        from karpenter_tpu.envelope.sampler import ResourceSampler
+
+        server, port = serve_health(HealthConfig(enable_profiling=True))
+        try:
+            # no sampler running: one-shot reading
+            status, body = _get(port, "/debug/envelope")
+            assert status == 200
+            out = _json.loads(body)
+            assert out["rss_mb"] > 0 and out["stages"] == {}
+            # live sampler: stages and series appear
+            with ResourceSampler(interval_s=0.01) as sampler:
+                with sampler.stage("probe"):
+                    import time as _t
+
+                    _t.sleep(0.05)
+                status, body = _get(port, "/debug/envelope")
+            out = _json.loads(body)
+            assert status == 200 and "probe" in out["stages"]
+            assert out["series"], "live series empty under a running sampler"
+        finally:
+            server.shutdown()
+
+    def test_envelope_endpoint_gated_without_profiling(self):
+        import urllib.error
+
+        server, port = serve_health(HealthConfig(enable_profiling=False))
+        try:
+            try:
+                _get(port, "/debug/envelope")
+                raise AssertionError("/debug/envelope reachable while disabled")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            server.shutdown()
+
     def test_operator_wires_probe_server(self):
         clock = FakeClock()
         op = Operator.new(clock=clock, options=Options(health_probe_port=-1))
