@@ -69,9 +69,14 @@ class DisruptionController:
         candidates' pods against the cluster minus the candidates. Returns
         (results, unscheduled candidate-pod uids). deadline comes from the
         calling method's timeout (1m multi-node / 3m single-node)."""
+        from karpenter_tpu.tracing.tracer import TRACER
+
         excluded = {c.name for c in candidates}
         extra = [p for c in candidates for p in c.reschedulable_pods]
-        result = self.provisioner.simulate(excluded, extra, deadline=deadline)
+        with TRACER.span(
+            "disruption.simulate", candidates=len(candidates), displaced=len(extra)
+        ):
+            result = self.provisioner.simulate(excluded, extra, deadline=deadline)
         if result is None:
             return None, set()
         extra_uids = {p.uid for p in extra}
@@ -81,16 +86,28 @@ class DisruptionController:
     def _simulate_batch(self, scenarios: list[list[Candidate]]):
         """Batched what-if prefilter: one device dispatch for all candidate
         sets (see Provisioner.simulate_batch); None when unsupported."""
+        from karpenter_tpu.tracing.tracer import TRACER
+
         batch = getattr(self.provisioner, "simulate_batch", None)
         if batch is None:
             return None
-        return batch(scenarios)
+        with TRACER.span("disruption.whatif_batch", scenarios=len(scenarios)):
+            return batch(scenarios)
 
     # -- the loop (controller.go:128-196) --------------------------------------
 
     def reconcile(self) -> Optional[Command]:
+        from karpenter_tpu.tracing.tracer import TRACER
+
         if not self.cluster.synced():
             return None
+        with TRACER.span("disruption.reconcile"):
+            return self._reconcile()
+
+    def _reconcile(self) -> Optional[Command]:
+        from karpenter_tpu.tracing.tracer import TRACER
+        from karpenter_tpu.utils import metrics
+
         self._cleanup_stale_taints()
         self.queue.process()
 
@@ -100,7 +117,11 @@ class DisruptionController:
                 return None
             command = self._pending.command
             self._pending = None
-            if self._validate(command):
+            with TRACER.span(
+                "disruption.validate", nodes=len(command.candidates)
+            ):
+                valid = self._validate(command)
+            if valid:
                 from karpenter_tpu.utils.logging import get_logger
 
                 get_logger().with_values(controller="disruption").info(
@@ -109,8 +130,14 @@ class DisruptionController:
                     nodes=[c.name for c in command.candidates],
                     replacements=len(command.replacements),
                 )
+                metrics.VOLUNTARY_DISRUPTION_DECISIONS.inc(
+                    decision="disrupt", reason=command.reason
+                )
                 self.queue.start(command)
                 return command
+            metrics.VOLUNTARY_DISRUPTION_DECISIONS.inc(
+                decision="invalidated", reason=command.reason
+            )
             return None
 
         from karpenter_tpu.cloudprovider.errors import instance_types_or_none
@@ -126,17 +153,22 @@ class DisruptionController:
         blocked = frozenset(
             blocked_pod_uids(self.store.list(ObjectStore.PDBS), self.store.pods())
         )
-        candidates = build_candidates(self.cluster, pools, its, self.clock, blocked)
+        with TRACER.span("disruption.candidates") as csp:
+            candidates = build_candidates(self.cluster, pools, its, self.clock, blocked)
+            csp.set(candidates=len(candidates))
         if not candidates:
             return None
-        from karpenter_tpu.utils import metrics
 
         for method in self.methods:
             budgets = build_disruption_budgets(pools, self.cluster, method.reason, self.clock)
             method_name = type(method).__name__
             metrics.DISRUPTION_ELIGIBLE_NODES.set(float(len(candidates)), method=method_name)
-            with metrics.DISRUPTION_EVAL_DURATION.time(method=method_name):
-                command = method.compute(candidates, budgets)
+            metrics.VOLUNTARY_DISRUPTION_ELIGIBLE.set(
+                float(len(candidates)), reason=method.reason
+            )
+            with TRACER.span(f"disruption.method.{method_name}"):
+                with metrics.DISRUPTION_EVAL_DURATION.time(method=method_name):
+                    command = method.compute(candidates, budgets)
             if command.is_empty:
                 continue
             # Balanced scoring applies to consolidation only — Drift and
@@ -145,6 +177,9 @@ class DisruptionController:
             if isinstance(
                 method, (MultiNodeConsolidation, SingleNodeConsolidation)
             ) and not self._balanced_approves(command, candidates):
+                metrics.VOLUNTARY_DISRUPTION_DECISIONS.inc(
+                    decision="balanced-rejected", reason=command.reason
+                )
                 continue
             # every method — including Emptiness — waits out the validation
             # delay (emptiness.go:101 validator.Validate): a pod may bind to

@@ -83,6 +83,14 @@ class OrchestrationQueue:
 
     def process(self) -> int:
         """Advance in-flight commands; returns completed count."""
+        if not self.in_flight:
+            return 0
+        from karpenter_tpu.tracing.tracer import TRACER
+
+        with TRACER.span("disruption.queue", in_flight=len(self.in_flight)):
+            return self._process()
+
+    def _process(self) -> int:
         done = 0
         remaining = []
         for item in self.in_flight:

@@ -55,6 +55,11 @@ class Manager:
         self.clock = clock or store.clock
         self.options = options or Options()
         self.cluster = Cluster(self.clock)
+        from karpenter_tpu.utils.events import Recorder
+
+        # the deduped event stream (recorder.go:47-110): the scheduling
+        # explainer and controllers publish domain events through it
+        self.recorder = Recorder(self.clock)
         self.batcher = Batcher(
             self.clock,
             idle=self.options.batch_idle_seconds,
@@ -72,6 +77,7 @@ class Manager:
             solve_timeout_seconds=self.options.solve_timeout_seconds,
             solver_endpoint=self.options.solver_endpoint,
             mesh_devices=self.options.mesh_devices,
+            recorder=self.recorder,
         )
         self.device_allocation = None
         if self.options.feature_gates.dynamic_resources:
@@ -316,20 +322,34 @@ class Manager:
 
     def step(self) -> bool:
         """One pass over all due work; True if anything happened."""
+        from karpenter_tpu.tracing.tracer import TRACER
+
         worked = False
         # nodeclaim lifecycle
         dirty, self._dirty_claims = self._dirty_claims, set()
-        for name in sorted(dirty):
-            claim = self.store.get(ObjectStore.NODECLAIMS, name)
-            if claim is not None:
-                self.lifecycle.reconcile(claim)
-                worked = True
+        if dirty:
+            with TRACER.span("lifecycle.drain", claims=len(dirty)):
+                for name in sorted(dirty):
+                    claim = self.store.get(ObjectStore.NODECLAIMS, name)
+                    if claim is not None:
+                        self.lifecycle.reconcile(claim)
+                        worked = True
         # device allocation collapse (DRA): claims whose NodeClaim launched
         if self.device_allocation is not None:
             worked = bool(self.device_allocation.reconcile_once()) or worked
         # provisioning batch window
         if self.batcher.ready():
-            outcome = self.provisioner.reconcile()
+            from karpenter_tpu.utils import metrics
+
+            window_start = self.batcher.window_start
+            wait = (
+                self.clock.now() - window_start if window_start is not None else 0.0
+            )
+            with TRACER.span("provisioning"):
+                # the debounce window the solve waited out, as a
+                # retroactive child span (measured on the injected clock)
+                TRACER.record_span("batcher.wait", wait)
+                outcome = self.provisioner.reconcile()
             if outcome == Provisioner.GATED:
                 # keep the trigger alive: gating (unsynced cluster, missing
                 # pools) usually clears after other reconciles; give up
@@ -339,6 +359,9 @@ class Manager:
                     self.batcher.reset()
                     self._gated_passes = 0
             else:
+                # one histogram entry per CLOSED window (gated retries
+                # re-enter with the same window open)
+                metrics.BATCH_WINDOW_SECONDS.observe(wait)
                 self._gated_passes = 0
                 self.batcher.reset()
                 worked = worked or outcome is not None
@@ -517,6 +540,14 @@ class KubeSchedulerSim:
         return None
 
     def bind_pending(self) -> int:
+        from karpenter_tpu.tracing.tracer import TRACER
+
+        with TRACER.span("bind.pending") as sp:
+            bound = self._bind_pending()
+            sp.set(bound=bound)
+        return bound
+
+    def _bind_pending(self) -> int:
         bound = 0
         for pod in self.store.pods():
             if not pod.is_pending():

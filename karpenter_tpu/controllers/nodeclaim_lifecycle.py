@@ -38,6 +38,12 @@ class NodeClaimLifecycleController:
         self.terminator = terminator
 
     def reconcile(self, claim: NodeClaim) -> None:
+        from karpenter_tpu.tracing.tracer import TRACER
+
+        with TRACER.span("lifecycle.nodeclaim", claim=claim.name):
+            self._reconcile(claim)
+
+    def _reconcile(self, claim: NodeClaim) -> None:
         if claim.metadata.deleting:
             self._finalize(claim)
             return
@@ -45,14 +51,28 @@ class NodeClaimLifecycleController:
         if l.TERMINATION_FINALIZER not in claim.metadata.finalizers:
             claim.metadata.finalizers.append(l.TERMINATION_FINALIZER)
             changed = True
-        changed |= self._launch(claim)
-        changed |= self._register(claim)
-        changed |= self._initialize(claim)
+        changed |= self._transition(claim, self._launch, COND_LAUNCHED)
+        changed |= self._transition(claim, self._register, COND_REGISTERED)
+        changed |= self._transition(claim, self._initialize, COND_INITIALIZED)
         self._liveness(claim)
         # write back only on transition — unconditional updates would
         # re-trigger the informer forever (idempotent-reconciler discipline)
         if changed and self.store.get(ObjectStore.NODECLAIMS, claim.name) is not None:
             self.store.update(ObjectStore.NODECLAIMS, claim)
+
+    def _transition(self, claim: NodeClaim, sub, condition_type: str) -> bool:
+        """Run one sub-reconciler; when it flips its condition true, record
+        creation -> condition age into the transition-duration histogram
+        (the reference's nodeclaim duration family analog)."""
+        changed = sub(claim)
+        if changed and claim.conditions.is_true(condition_type):
+            from karpenter_tpu.utils import metrics
+
+            metrics.NODECLAIM_TRANSITION_DURATION.observe(
+                max(self.clock.now() - claim.metadata.creation_timestamp, 0.0),
+                condition_type=condition_type,
+            )
+        return changed
 
     # -- launch (launch.go:47-127) -------------------------------------------
 
@@ -281,6 +301,12 @@ class NodeClaimLifecycleController:
             node.metadata.finalizers = []
             self.store.delete(ObjectStore.NODES, node.name)
         self.store.remove_finalizer(ObjectStore.NODECLAIMS, claim.name, l.TERMINATION_FINALIZER)
+        # deletion -> finalizer drop: the claim's full termination wall
+        # time (drain + volume detach + instance delete)
+        if claim.metadata.deletion_timestamp is not None:
+            metrics.NODECLAIM_TERMINATION_DURATION.observe(
+                max(self.clock.now() - claim.metadata.deletion_timestamp, 0.0)
+            )
 
     # -- helpers -----------------------------------------------------------------
 

@@ -34,6 +34,13 @@ class Batcher:
     def pending(self) -> bool:
         return self._window_start is not None
 
+    @property
+    def window_start(self) -> Optional[float]:
+        """When the open debounce window began (injected-clock time);
+        None when no window is open. The manager reads this to record the
+        batcher-wait span and the batch-window histogram."""
+        return self._window_start
+
     def ready(self) -> bool:
         """The window closed: idle elapsed since last trigger, or max hit."""
         if self._window_start is None:

@@ -108,6 +108,9 @@ class SchedulingResult:
     existing_assignments: dict[str, str] = field(default_factory=dict)  # pod uid -> node name
     # the winning round's DRARound (device allocation metadata), when DRA ran
     dra: object = None
+    # relaxation-ladder provenance (explainer): pod uid -> the rung names
+    # the shared ladder shed before this result (empty on the happy path)
+    relaxations: dict = field(default_factory=dict)
 
     @property
     def node_count(self) -> int:

@@ -161,12 +161,23 @@ def run_with_relaxation(pods: list[Pod], solve_round, should_stop=None):
     originals = None
     applied: dict = {}
     current = list(pods)
+
+    def _with_provenance(result):
+        # relaxation-ladder provenance for the explainer: which rungs each
+        # pod shed before the final result (only pods that ever failed a
+        # round have entries, so the happy path attaches nothing)
+        if originals is not None:
+            result.relaxations = {
+                uid: rungs(originals[uid])[:n] for uid, n in applied.items() if n
+            }
+        return result
+
     while True:
         result = solve_round(current)
         if should_stop is not None and should_stop():
-            return result
+            return _with_provenance(result)
         if not result.unschedulable:
-            return result
+            return _with_provenance(result)
         if originals is None:
             originals = {p.uid: p for p in pods}
             applied = {p.uid: 0 for p in pods}
@@ -177,5 +188,5 @@ def run_with_relaxation(pods: list[Pod], solve_round, should_stop=None):
                 applied[p.uid] += 1
                 relaxed_any = True
         if not relaxed_any:
-            return result
+            return _with_provenance(result)
         current = [relax_pod(originals[p.uid], applied[p.uid]) for p in pods]

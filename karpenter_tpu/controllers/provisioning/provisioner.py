@@ -46,6 +46,7 @@ class Provisioner:
         solve_timeout_seconds: float = 60.0,
         solver_endpoint: str = "",
         mesh_devices: int = 0,
+        recorder=None,
     ):
         self.store = store
         self.cluster = cluster
@@ -62,6 +63,9 @@ class Provisioner:
         # empty = in-process TPUScheduler
         self.solver_endpoint = solver_endpoint
         self.mesh_devices = mesh_devices  # 0 = single device
+        # deduped event recorder (events.Recorder); the explainer publishes
+        # FailedScheduling provenance through it when wired
+        self.recorder = recorder
         # DeviceAllocationController; wired by the manager when DRA is on
         self.device_allocation = None
         self._scheduler_cache: Optional[tuple[tuple, TPUScheduler]] = None
@@ -210,14 +214,16 @@ class Provisioner:
             Topology,
             build_universe_domains,
         )
+        from karpenter_tpu.tracing.tracer import TRACER
 
-        base = (
-            scheduler.universe_base() if hasattr(scheduler, "universe_base") else None
-        )
-        universe = build_universe_domains(
-            scheduler.templates, self._existing_sim_nodes(excluded_nodes), template_base=base
-        )
-        return Topology.build(pods, universe, self._bound_pods(excluded_nodes))
+        with TRACER.span("topology.build", pods=len(pods)):
+            base = (
+                scheduler.universe_base() if hasattr(scheduler, "universe_base") else None
+            )
+            universe = build_universe_domains(
+                scheduler.templates, self._existing_sim_nodes(excluded_nodes), template_base=base
+            )
+            return Topology.build(pods, universe, self._bound_pods(excluded_nodes))
 
     def _build_dra_problem(self, pods, extra_deleting_uids=None):
         """Per-loop DRA inputs (DynamicResources gate, off by default like
@@ -600,8 +606,13 @@ class Provisioner:
     # -- claim creation (provisioner.go:169-221, :460-506) -----------------------
 
     def create_node_claims(self, result: SchedulingResult) -> list[NodeClaim]:
+        from karpenter_tpu.tracing.tracer import TRACER
         from karpenter_tpu.utils import metrics
 
+        with TRACER.span("claims.create", claims=len(result.claims)):
+            return self._create_node_claims(result, metrics)
+
+    def _create_node_claims(self, result: SchedulingResult, metrics) -> list[NodeClaim]:
         created = []
         for sim in result.claims:
             claim = self._to_node_claim(sim)
@@ -759,6 +770,39 @@ class Provisioner:
         )
         return claim
 
+    # -- the scheduling explainer ------------------------------------------------
+
+    def _explain_result(self, result, templates) -> None:
+        """Record per-pod decision provenance for the solve's failures:
+        a SchedulingDecision on the live trace, a FailedScheduling event
+        naming the failing requirement + the relaxation rungs attempted,
+        and the ktpu_unschedulable_pods gauge by canonical reason."""
+        from karpenter_tpu.tracing import MAX_EXPLAINED_PODS, TRACER, decision_for
+        from karpenter_tpu.utils import events, metrics
+
+        metrics.UNSCHEDULABLE_PODS.values.clear()
+        if not result.unschedulable:
+            return
+        counts: dict[str, int] = {}
+        for pod, reason in result.unschedulable[:MAX_EXPLAINED_PODS]:
+            decision = decision_for(
+                pod, reason, templates, result.relaxations.get(pod.uid, [])
+            )
+            counts[decision.slug] = counts.get(decision.slug, 0) + 1
+            TRACER.add_decision(decision.as_dict())
+            if self.recorder is not None:
+                self.recorder.publish(
+                    events.failed_scheduling(pod.name, decision.message())
+                )
+        # pods beyond the explainer cap still count toward their reason
+        for pod, reason in result.unschedulable[MAX_EXPLAINED_PODS:]:
+            from karpenter_tpu.tracing import reason_slug
+
+            slug = reason_slug(reason)
+            counts[slug] = counts.get(slug, 0) + 1
+        for slug, n in counts.items():
+            metrics.UNSCHEDULABLE_PODS.set(float(n), reason=slug)
+
     # -- the reconcile pass (provisioner.go:127-165) -------------------------------
 
     GATED = "gated"  # provisioning blocked (no pools / cluster unsynced); retry
@@ -775,6 +819,7 @@ class Provisioner:
             metrics.SCHEDULER_UNFINISHED_WORK.set(0.0)
             metrics.SCHEDULER_IGNORED_PODS.set(0.0)
             metrics.PENDING_PODS_BY_ZONE.values.clear()
+            metrics.UNSCHEDULABLE_PODS.values.clear()
             if not self.store.list(self.store.CAPACITY_BUFFERS):
                 # no buffers -> no headroom anywhere: clear the emptiness
                 # guard so ex-headroom nodes of a deleted buffer don't
@@ -791,6 +836,7 @@ class Provisioner:
         # batch; unfinished work = oldest waiting pod's age; pending by
         # effective zone from each pod's zone restriction
         metrics.SCHEDULER_QUEUE_DEPTH.set(float(len(pods)))
+        metrics.QUEUE_DEPTH_PODS.observe(float(len(pods)))
         metrics.SCHEDULER_IGNORED_PODS.set(
             float(
                 sum(
@@ -818,7 +864,10 @@ class Provisioner:
             metrics.PENDING_PODS_BY_ZONE.set(
                 metrics.PENDING_PODS_BY_ZONE.get(zone=zone) + 1.0, zone=zone
             )
-        with metrics.SCHEDULING_DURATION.time():
+        from karpenter_tpu.tracing.tracer import TRACER
+
+        _solve_span = TRACER.span("solve", pods=len(pods))
+        with _solve_span, metrics.SCHEDULING_DURATION.time():
             # regular provisioning disables reserved-capacity fallback
             # (provisioner.go:389 DisableReservedCapacityFallback): a pod
             # that can't get a reservation retries next loop instead of
@@ -844,6 +893,9 @@ class Provisioner:
                 ),
             )
         metrics.SCHEDULING_UNSCHEDULABLE.set(float(len(result.unschedulable)))
+        # per-pod scheduling explainer: provenance into the deduped event
+        # stream + the trace, and the reasoned unschedulable-pods gauge
+        self._explain_result(result, scheduler.templates)
         # solve summary, deduped like the reference's ChangeMonitor-guarded
         # provisioner logs (provisioner.go:226-256)
         from karpenter_tpu.utils.logging import get_logger

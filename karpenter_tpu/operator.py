@@ -64,6 +64,13 @@ class Operator:
         cloud = MetricsCloudProvider(OverlayCloudProvider(inner, store))
         manager = Manager(store, cloud, clock, options=options)
         op = Operator(store=store, cloud=cloud, manager=manager, options=options)
+        if options.enable_profiling:
+            # --enable-profiling turns on the span tracer alongside the
+            # pprof handlers; KTPU_TRACE_DIR enables it independently
+            # (tracing/tracer.py reads the env at import)
+            from karpenter_tpu.tracing.tracer import TRACER
+
+            TRACER.enable()
         if options.leader_elect:
             import uuid
 
@@ -91,13 +98,17 @@ class Operator:
         non-leader tick only runs the election round — reconcilers stay
         idle until the lease is held (operator.go:171-181)."""
         from karpenter_tpu.controllers.manager import KubeSchedulerSim
+        from karpenter_tpu.tracing.tracer import TRACER
 
         if self.elector is not None and not self.elector.try_acquire_or_renew():
             return
-        self.manager.run_until_idle()
-        self.manager.maybe_run_disruption()  # paced by disruption_poll_seconds
-        self.manager.run_maintenance()
-        KubeSchedulerSim(self.store, self.manager.cluster).bind_pending()
+        # one trace per steady-state tick when tracing is on: provisioning,
+        # disruption, maintenance and binding all nest under it
+        with TRACER.span("operator.tick"):
+            self.manager.run_until_idle()
+            self.manager.maybe_run_disruption()  # paced by disruption_poll_seconds
+            self.manager.run_maintenance()
+            KubeSchedulerSim(self.store, self.manager.cluster).bind_pending()
 
     def shutdown(self) -> None:
         if self.elector is not None:
@@ -154,8 +165,8 @@ def _demo() -> None:
           f"bound: {sum(1 for p in op.store.pods() if p.spec.node_name)}/10")
     print("== metrics ==")
     for line in metrics.REGISTRY.expose().splitlines():
-        if line.startswith("#"):
-            continue
+        if line.startswith("#") or "_bucket{" in line:
+            continue  # demo summary: skip comments + per-bucket series
         value = line.rsplit(" ", 1)[-1]
         if value not in ("0.0", "0"):
             print(" ", line)

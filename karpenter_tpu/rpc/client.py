@@ -98,10 +98,20 @@ class RemoteScheduler:
             )
 
             def call(request, **kwargs):
+                from karpenter_tpu.tracing.tracer import TRACER
                 from karpenter_tpu.utils.metrics import SOLVER_RPC_DURATION
 
-                with SOLVER_RPC_DURATION.time(method=method):
-                    return stub(request, **kwargs)
+                with TRACER.span(f"rpc.{method}"):
+                    # trace-context propagation: the server seeds its
+                    # handler-thread spans from these so a remote Solve's
+                    # server-side spans stitch into the CLIENT's trace
+                    ctx = TRACER.context()
+                    if ctx is not None:
+                        md = list(kwargs.pop("metadata", None) or ())
+                        md += [("ktpu-trace-id", ctx[0]), ("ktpu-span-id", ctx[1])]
+                        kwargs["metadata"] = md
+                    with SOLVER_RPC_DURATION.time(method=method):
+                        return stub(request, **kwargs)
 
             return call
 

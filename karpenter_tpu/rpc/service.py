@@ -41,6 +41,18 @@ class SolverService:
         self._scheduler = None
         self._version = 0
 
+    @staticmethod
+    def _server_span(name: str, context):
+        """Root a server-side span under the client's trace context when
+        it crossed the wire (ktpu-trace-id / ktpu-span-id metadata), so a
+        remote Solve's spans stitch into the caller's trace."""
+        from karpenter_tpu.tracing.tracer import TRACER
+
+        md = dict(context.invocation_metadata() or ())
+        return TRACER.server_span(
+            name, md.get("ktpu-trace-id"), md.get("ktpu-span-id")
+        )
+
     # -- rpc handlers ------------------------------------------------------
 
     def Configure(self, request: pb.ConfigureRequest, context) -> pb.ConfigureResponse:
@@ -71,6 +83,10 @@ class SolverService:
         return pb.ConfigureResponse(config_version=version)
 
     def Solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
+        with self._server_span("rpc.server.Solve", context):
+            return self._solve(request, context)
+
+    def _solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
         with self._lock:
             sched, version = self._scheduler, self._version
         if sched is None or request.config_version != version:
@@ -101,11 +117,13 @@ class SolverService:
                 Topology,
                 build_universe_domains,
             )
+            from karpenter_tpu.tracing.tracer import TRACER
 
-            universe = build_universe_domains(
-                sched.templates, existing, template_base=sched.universe_base()
-            )
-            return Topology.build(current_pods, universe, bound)
+            with TRACER.span("topology.build", pods=len(current_pods)):
+                universe = build_universe_domains(
+                    sched.templates, existing, template_base=sched.universe_base()
+                )
+                return Topology.build(current_pods, universe, bound)
 
         dra_problem = None
         if request.dra_problem_json:
@@ -146,6 +164,10 @@ class SolverService:
         Declines exactly when the in-process prefilter would (multi-alt
         volumes, per-scenario group-structure divergence) — callers fall
         back to sequential Solve RPCs. CSI attach limits ride the batch."""
+        with self._server_span("rpc.server.WhatIf", context):
+            return self._whatif(request, context)
+
+    def _whatif(self, request: pb.WhatIfRequest, context) -> pb.WhatIfResponse:
         with self._lock:
             sched, version = self._scheduler, self._version
         if sched is None or request.config_version != version:

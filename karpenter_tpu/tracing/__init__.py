@@ -1,0 +1,20 @@
+"""Decision-provenance tracing: span tracer + scheduling explainer."""
+
+from karpenter_tpu.tracing.tracer import MAX_TRACES, Span, Tracer, TRACER
+from karpenter_tpu.tracing.explainer import (
+    MAX_EXPLAINED_PODS,
+    SchedulingDecision,
+    decision_for,
+    reason_slug,
+)
+
+__all__ = [
+    "MAX_EXPLAINED_PODS",
+    "MAX_TRACES",
+    "SchedulingDecision",
+    "Span",
+    "TRACER",
+    "Tracer",
+    "decision_for",
+    "reason_slug",
+]

@@ -1,0 +1,152 @@
+"""The scheduling explainer: "why did pod X not land anywhere".
+
+Counterpart of the reference's unschedulable-pod error events — the
+scheduler there surfaces the failed requirement in the FailedScheduling
+event message (scheduler.go:587-612 / events.go PodFailedToScheduleEvent)
+— extended with relaxation-ladder provenance: which preference rungs the
+shared ladder (preferences.py) shed before giving up.
+
+The per-nodepool rejection walk runs POST-HOC over the solve's final
+unschedulable set, never inside the hot loop: it replays the cheap
+template-level gates (taints, requirement compatibility, the
+instance-type triple filter) for the handful of failing pods, so both
+engines — host oracle and device kernel — get identical explanations
+for free, and the all-scheduled happy path pays nothing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.scheduling import Requirements
+from karpenter_tpu.scheduling.taints import tolerates_all
+from karpenter_tpu.utils import resources as res
+
+# canonical reason slugs for the ktpu_unschedulable_pods gauge labels
+# (free-text reasons would explode the label cardinality)
+_SLUGS = (
+    ("scheduling timeout exceeded", "solve_timeout"),
+    ("claim-slot capacity", "no_room"),
+    ("no compatible in-flight claim or template", "incompatible"),
+    ("resourceclaim", "dra"),
+    ("resource claim", "dra"),
+)
+MAX_EXPLAINED_PODS = 512  # bound the post-hoc walk on pathological solves
+MAX_REJECTIONS_IN_MESSAGE = 5
+
+
+def reason_slug(reason: str) -> str:
+    low = reason.lower()
+    for needle, slug in _SLUGS:
+        if needle in low:
+            return slug
+    return "other"
+
+
+@dataclass
+class SchedulingDecision:
+    """One pod's provenance record, attached to the live trace and
+    summarized into the deduped event stream."""
+
+    pod_name: str
+    pod_uid: str
+    reason: str  # the engine's unschedulable reason, verbatim
+    slug: str  # canonical label for the gauge
+    relaxed: list[str] = field(default_factory=list)  # ladder rungs shed
+    rejections: list[dict] = field(default_factory=list)  # per nodepool
+
+    def as_dict(self) -> dict:
+        return {
+            "pod": self.pod_name,
+            "uid": self.pod_uid,
+            "outcome": "unschedulable",
+            "reason": self.reason,
+            "slug": self.slug,
+            "relaxed": list(self.relaxed),
+            "rejections": list(self.rejections),
+        }
+
+    def message(self) -> str:
+        """The FailedScheduling event body: failing requirement first,
+        then the relaxation steps attempted, then per-pool rejections."""
+        parts = [f"Failed to schedule pod: {self.reason}"]
+        if self.relaxed:
+            parts.append("relaxed preferences: " + ", ".join(self.relaxed))
+        shown = self.rejections[:MAX_REJECTIONS_IN_MESSAGE]
+        for r in shown:
+            parts.append(f"nodepool {r['nodepool']} rejected ({r['class']}): {r['detail']}")
+        hidden = len(self.rejections) - len(shown)
+        if hidden > 0:
+            parts.append(f"(+{hidden} more nodepools rejected)")
+        return "; ".join(parts)
+
+
+def decision_for(
+    pod: Pod, reason: str, templates, relaxed: list[str]
+) -> SchedulingDecision:
+    """Replay the template-level gates for one unschedulable pod and name
+    what failed where. Classes, in the order the solve checks them:
+
+    - ``taint``: an untolerated template taint (scheduler.go:695 path)
+    - ``requirement``: pod requirements incompatible with the template
+      (the failing key + value sets, requirements.go:181-197 wording)
+    - ``instance-types``: compatible but zero instance types survive the
+      requests-fit x offering-available triple filter (nodeclaim.go:541)
+    - ``packing``: template-viable — the rejection happened deeper in the
+      solve (topology narrowing, host ports, volume limits, claim slots)
+    """
+    pod_reqs = Requirements.from_pod(pod)
+    rejections: list[dict] = []
+    for tmpl in templates:
+        err = tolerates_all(tmpl.taints, pod.spec.tolerations)
+        if err is not None:
+            rejections.append(
+                {"nodepool": tmpl.nodepool_name, "class": "taint", "detail": err}
+            )
+            continue
+        err = tmpl.requirements.compatible(pod_reqs, l.WELL_KNOWN_LABELS)
+        if err is not None:
+            rejections.append(
+                {"nodepool": tmpl.nodepool_name, "class": "requirement", "detail": err}
+            )
+            continue
+        from karpenter_tpu.controllers.provisioning.host_scheduler import (
+            filter_instance_types,
+        )
+
+        combined = tmpl.requirements.copy()
+        combined.add(*pod_reqs.values())
+        total = res.merge(tmpl.daemon_requests, pod.total_requests())
+        remaining = filter_instance_types(tmpl.instance_types, combined, total)
+        if not remaining:
+            rejections.append(
+                {
+                    "nodepool": tmpl.nodepool_name,
+                    "class": "instance-types",
+                    "detail": (
+                        f"0/{len(tmpl.instance_types)} instance types satisfy "
+                        "requests, offerings and minValues"
+                    ),
+                }
+            )
+            continue
+        rejections.append(
+            {
+                "nodepool": tmpl.nodepool_name,
+                "class": "packing",
+                "detail": (
+                    f"{len(remaining)} instance types viable; rejected deeper in "
+                    "the solve (topology/host ports/volumes/claim slots)"
+                ),
+            }
+        )
+    return SchedulingDecision(
+        pod_name=pod.name,
+        pod_uid=pod.uid,
+        reason=reason,
+        slug=reason_slug(reason),
+        relaxed=list(relaxed),
+        rejections=rejections,
+    )

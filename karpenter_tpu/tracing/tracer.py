@@ -1,0 +1,337 @@
+"""Decision-provenance span tracer.
+
+The Dapper/OpenTelemetry lineage (PAPERS.md) applied to the provisioning
+and disruption hot loops: nested spans with per-span attributes answer
+"where inside the 0.85 s north-star solve did the time go" the same way
+the reference's pprof handlers answer CPU questions — but along the
+pipeline's own stage boundaries (batcher wait -> topology build ->
+encode -> device dispatch -> wire transfer -> decode -> claim
+creation/bind) instead of stack samples.
+
+Design constraints, in order:
+
+- ~zero cost when disabled (the default): ``TRACER.span(...)`` is one
+  attribute check returning a shared no-op context manager; no ids, no
+  clock reads, no allocation.
+- < 1 % of a north-star solve when enabled: spans are coarse (per stage
+  / per dispatch run, never per pod) and a span start+end is two
+  ``perf_counter`` reads, one small allocation, and one short lock hold.
+- bounded memory: a ring of the last ``max_traces`` completed traces,
+  and a per-trace span cap so a runaway loop can't pin unbounded spans.
+
+Trace assembly: a span started with no current span becomes a trace
+root; children inherit the trace id through a ``contextvars.ContextVar``
+(so threads and nested calls both work). A trace is flushed to the ring
+when its last live span ends (a plain refcount — no explicit "root"
+bookkeeping, which also makes server-side fragments work, below).
+
+Cross-process stitching: the gRPC client injects ``ktpu-trace-id`` /
+``ktpu-span-id`` request metadata; the solver service seeds its handler
+thread's context from them (``server_span``), so a remote Solve's
+server-side spans carry the CLIENT's trace id. In-process (tests, the
+bench harness) both sides share one tracer and the trace flushes as a
+single stitched record; across real processes each side exports its
+fragment with the shared trace id and stitching is a group-by-trace-id
+over the JSONL files.
+
+Export: ``/debug/traces`` (utils/runtime.py, behind --enable-profiling)
+serves the ring as JSON; setting ``KTPU_TRACE_DIR`` opts into JSONL
+export (one completed trace per line, per-process file) and implicitly
+enables the tracer.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+MAX_TRACES = 256
+MAX_SPANS_PER_TRACE = 4096
+
+
+@dataclass
+class Span:
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    name: str
+    start: float  # perf_counter seconds (duration math)
+    end: float = 0.0
+    wall_start: float = 0.0  # epoch seconds (export/correlation only)
+    attrs: dict = field(default_factory=dict)
+
+    @property
+    def duration_s(self) -> float:
+        return max(self.end - self.start, 0.0)
+
+    def set(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+    def as_dict(self) -> dict:
+        out = {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "name": self.name,
+            "start": self.start,
+            "duration_s": self.duration_s,
+            "wall_start": self.wall_start,
+        }
+        if self.parent_id:
+            out["parent_id"] = self.parent_id
+        if self.attrs:
+            out["attrs"] = self.attrs
+        return out
+
+
+class _NoopSpan:
+    """Shared disabled-path span: supports the full Span surface."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+    def set(self, **attrs) -> None:
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+class _SpanCtx:
+    __slots__ = ("_tracer", "span", "_token")
+
+    def __init__(self, tracer: "Tracer", span: Span, token) -> None:
+        self._tracer = tracer
+        self.span = span
+        self._token = token
+
+    def __enter__(self) -> Span:
+        return self.span
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None:
+            self.span.attrs.setdefault("error", exc_type.__name__)
+        self._tracer._end(self.span, self._token)
+
+
+class Tracer:
+    def __init__(self, max_traces: int = MAX_TRACES):
+        # KTPU_TRACE_DIR is the opt-in for JSONL export AND implicitly
+        # enables tracing (an exporter with nothing to export is useless)
+        self.enabled = bool(os.environ.get("KTPU_TRACE_DIR"))
+        self._ctx: contextvars.ContextVar[Optional[Span]] = contextvars.ContextVar(
+            "ktpu_current_span", default=None
+        )
+        self._lock = threading.Lock()
+        self._traces: deque[dict] = deque(maxlen=max_traces)
+        self._open: dict[str, list[Span]] = {}  # trace id -> finished spans
+        self._refs: dict[str, int] = {}  # trace id -> live span count
+        self._decisions: dict[str, list[dict]] = {}
+        # process-unique id prefix + a counter: ids must be unique across
+        # the control plane and the solver service for stitching to work
+        self._prefix = os.urandom(4).hex()
+        self._seq = itertools.count(1)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        """Drop all recorded state (tests; never called in production)."""
+        with self._lock:
+            self._traces.clear()
+            self._open.clear()
+            self._refs.clear()
+            self._decisions.clear()
+
+    def _new_id(self) -> str:
+        return f"{self._prefix}{next(self._seq):08x}"
+
+    # -- span creation -----------------------------------------------------
+
+    def span(self, name: str, **attrs):
+        """Start a nested span; use as ``with TRACER.span("encode"):``.
+        A span started with no current span roots a new trace."""
+        if not self.enabled:
+            return _NOOP
+        parent = self._ctx.get()
+        if parent is None:
+            trace_id = self._new_id()
+            parent_id = None
+        else:
+            trace_id = parent.trace_id
+            parent_id = parent.span_id
+        sp = Span(
+            trace_id=trace_id,
+            span_id=self._new_id(),
+            parent_id=parent_id,
+            name=name,
+            start=time.perf_counter(),
+            wall_start=time.time(),
+            attrs=attrs,
+        )
+        with self._lock:
+            self._refs[trace_id] = self._refs.get(trace_id, 0) + 1
+        token = self._ctx.set(sp)
+        return _SpanCtx(self, sp, token)
+
+    def server_span(self, name: str, trace_id: Optional[str], parent_span_id: Optional[str], **attrs):
+        """Root a server-side fragment under a REMOTE parent (the trace
+        context that arrived in request metadata). Falls back to a plain
+        span when no context crossed the wire."""
+        if not self.enabled:
+            return _NOOP
+        if not trace_id:
+            return self.span(name, **attrs)
+        sp = Span(
+            trace_id=trace_id,
+            span_id=self._new_id(),
+            parent_id=parent_span_id or None,
+            name=name,
+            start=time.perf_counter(),
+            wall_start=time.time(),
+            attrs=attrs,
+        )
+        with self._lock:
+            self._refs[trace_id] = self._refs.get(trace_id, 0) + 1
+        token = self._ctx.set(sp)
+        return _SpanCtx(self, sp, token)
+
+    def record_span(self, name: str, duration_s: float, **attrs) -> None:
+        """Record an already-elapsed child span ending now (e.g. the
+        batcher's debounce window, measured on the injected — possibly
+        fake — clock, so it can't be bracketed with perf_counter)."""
+        if not self.enabled:
+            return
+        parent = self._ctx.get()
+        if parent is None:
+            return
+        end = time.perf_counter()
+        sp = Span(
+            trace_id=parent.trace_id,
+            span_id=self._new_id(),
+            parent_id=parent.span_id,
+            name=name,
+            start=end - max(duration_s, 0.0),
+            end=end,
+            wall_start=time.time() - max(duration_s, 0.0),
+            attrs=attrs,
+        )
+        with self._lock:
+            spans = self._open.setdefault(sp.trace_id, [])
+            if len(spans) < MAX_SPANS_PER_TRACE:
+                spans.append(sp)
+
+    # -- context propagation ----------------------------------------------
+
+    def context(self) -> Optional[tuple[str, str]]:
+        """(trace_id, span_id) of the current span, for wire metadata."""
+        cur = self._ctx.get()
+        if cur is None:
+            return None
+        return cur.trace_id, cur.span_id
+
+    def current(self) -> Optional[Span]:
+        return self._ctx.get()
+
+    # -- decisions ---------------------------------------------------------
+
+    def add_decision(self, decision: dict) -> None:
+        """Attach a SchedulingDecision record to the current trace."""
+        if not self.enabled:
+            return
+        cur = self._ctx.get()
+        if cur is None:
+            return
+        with self._lock:
+            ds = self._decisions.setdefault(cur.trace_id, [])
+            if len(ds) < MAX_SPANS_PER_TRACE:
+                ds.append(decision)
+
+    # -- completion / readout ----------------------------------------------
+
+    def _end(self, sp: Span, token) -> None:
+        sp.end = time.perf_counter()
+        self._ctx.reset(token)
+        trace = None
+        with self._lock:
+            spans = self._open.setdefault(sp.trace_id, [])
+            if len(spans) < MAX_SPANS_PER_TRACE:
+                spans.append(sp)
+            n = self._refs.get(sp.trace_id, 1) - 1
+            if n > 0:
+                self._refs[sp.trace_id] = n
+            else:
+                # last live span: the trace is (locally) complete
+                self._refs.pop(sp.trace_id, None)
+                finished = self._open.pop(sp.trace_id, [])
+                decisions = self._decisions.pop(sp.trace_id, [])
+                trace = {
+                    "trace_id": sp.trace_id,
+                    "root": sp.name,
+                    "duration_s": sp.duration_s,
+                    "spans": [s.as_dict() for s in finished],
+                }
+                if decisions:
+                    trace["decisions"] = decisions
+                self._traces.append(trace)
+        if trace is not None:
+            self._export(trace)
+
+    def traces(self) -> list[dict]:
+        """The ring of recently completed traces, oldest first."""
+        with self._lock:
+            return list(self._traces)
+
+    def trace(self, trace_id: str) -> Optional[dict]:
+        """One trace by id, fragments merged (a remote fragment that
+        flushed separately shares the trace id)."""
+        spans: list[dict] = []
+        decisions: list[dict] = []
+        root = None
+        duration = 0.0
+        with self._lock:
+            for t in self._traces:
+                if t["trace_id"] != trace_id:
+                    continue
+                spans.extend(t["spans"])
+                decisions.extend(t.get("decisions", ()))
+                root = root or t["root"]
+                duration = max(duration, t["duration_s"])
+        if not spans:
+            return None
+        out = {"trace_id": trace_id, "root": root, "duration_s": duration, "spans": spans}
+        if decisions:
+            out["decisions"] = decisions
+        return out
+
+    def _export(self, trace: dict) -> None:
+        trace_dir = os.environ.get("KTPU_TRACE_DIR")
+        if not trace_dir:
+            return
+        try:
+            os.makedirs(trace_dir, exist_ok=True)
+            path = os.path.join(trace_dir, f"ktpu-traces-{os.getpid()}.jsonl")
+            with open(path, "a") as f:
+                f.write(json.dumps(trace) + "\n")
+        except OSError:
+            pass  # export must never take down the control plane
+
+
+# the process-global tracer every instrumentation site imports
+TRACER = Tracer()

@@ -100,48 +100,88 @@ class Histogram(_Family):
         return math.inf
 
 
+def _escape_label(value: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote, and newline must be escaped or the exposition is unparsable."""
+    return str(value).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(text: str) -> str:
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 class Registry:
     def __init__(self) -> None:
         self._families: dict[str, _Family] = {}
 
     def counter(self, name, help_text="", label_names=()) -> Counter:
-        return self._get_or_create(Counter, name, help_text, label_names)
+        return self.get_or_register(Counter, name, help_text, label_names)
 
     def gauge(self, name, help_text="", label_names=()) -> Gauge:
-        return self._get_or_create(Gauge, name, help_text, label_names)
+        return self.get_or_register(Gauge, name, help_text, label_names)
 
-    def histogram(self, name, help_text="", label_names=()) -> Histogram:
-        return self._get_or_create(Histogram, name, help_text, label_names)
+    def histogram(self, name, help_text="", label_names=(), buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self.get_or_register(Histogram, name, help_text, label_names, buckets=buckets)
 
-    def _get_or_create(self, cls, name, help_text, label_names):
+    def get_or_register(self, cls, name, help_text="", label_names=(), **kwargs):
+        """Idempotent family registration: a re-register with the same
+        shape returns the EXISTING family (so a second Manager
+        construction in one process shares series instead of silently
+        shadowing or double-counting), while a type or label-set mismatch
+        fails loudly instead of corrupting the exposition."""
         fam = self._families.get(name)
         if fam is None:
-            fam = cls(name, help_text, label_names)
+            fam = cls(name, help_text, tuple(label_names), **kwargs)
             self._families[name] = fam
+            return fam
         if not isinstance(fam, cls):
             raise TypeError(f"metric {name} already registered as {type(fam).__name__}")
+        if tuple(label_names) != fam.label_names:
+            raise ValueError(
+                f"metric {name} re-registered with labels {tuple(label_names)} "
+                f"!= existing {fam.label_names}"
+            )
         return fam
 
+    # pre-rename alias (call sites predating get_or_register)
+    _get_or_create = get_or_register
+
+    def families(self) -> list[_Family]:
+        return list(self._families.values())
+
     def expose(self) -> str:
-        """Prometheus text exposition (scrape endpoint analog)."""
+        """Prometheus text exposition (scrape endpoint analog): escaped
+        label values/help, cumulative le-bucket lines for histograms."""
         lines = []
         for fam in self._families.values():
-            lines.append(f"# HELP {fam.name} {fam.help}")
+            lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
             kind = {Counter: "counter", Gauge: "gauge", Histogram: "histogram"}[type(fam)]
             lines.append(f"# TYPE {fam.name} {kind}")
             if isinstance(fam, (Counter, Gauge)):
                 for key, value in fam.values.items():
                     labels = ",".join(
-                        f'{n}="{v}"' for n, v in zip(fam.label_names, key) if v
+                        f'{n}="{_escape_label(v)}"'
+                        for n, v in zip(fam.label_names, key)
+                        if v
                     )
                     suffix = f"{{{labels}}}" if labels else ""
                     lines.append(f"{fam.name}{suffix} {value}")
             else:
                 for key, total in fam.totals.items():
-                    labels = ",".join(
-                        f'{n}="{v}"' for n, v in zip(fam.label_names, key) if v
-                    )
-                    base = f"{{{labels}}}" if labels else ""
+                    pairs = [
+                        f'{n}="{_escape_label(v)}"'
+                        for n, v in zip(fam.label_names, key)
+                        if v
+                    ]
+                    base = f"{{{','.join(pairs)}}}" if pairs else ""
+                    # cumulative buckets (le is just another label pair)
+                    cum = 0
+                    for i, bound in enumerate(fam.buckets):
+                        cum += fam.counts[key][i]
+                        le = ",".join(pairs + [f'le="{format(bound, ".10g")}"'])
+                        lines.append(f"{fam.name}_bucket{{{le}}} {cum}")
+                    le = ",".join(pairs + ['le="+Inf"'])
+                    lines.append(f"{fam.name}_bucket{{{le}}} {total}")
                     lines.append(f"{fam.name}_count{base} {total}")
                     lines.append(f"{fam.name}_sum{base} {fam.sums[key]}")
         return "\n".join(lines) + "\n"
@@ -278,4 +318,47 @@ CLOUDPROVIDER_ERRORS = REGISTRY.counter(
     "karpenter_cloudprovider_errors_total",
     "SPI method errors",
     ("controller", "method", "provider", "error"),
+)
+# ---- reference-parity gap closers (ktpu_ convention; each help text
+# names its reference analog so dashboards can map families 1:1) --------
+_COUNT_BUCKETS = tuple(float(2**i) for i in range(18))  # 1 .. 131072
+BATCH_WINDOW_SECONDS = REGISTRY.histogram(
+    "ktpu_scheduler_batch_window_seconds",
+    "Batcher debounce wait before a provisioning solve"
+    " (reference karpenter_provisioner_batch_time_seconds)",
+)
+QUEUE_DEPTH_PODS = REGISTRY.histogram(
+    "ktpu_scheduler_queue_depth_pods",
+    "Pods per provisioning solve batch"
+    " (reference karpenter_provisioner_scheduling_queue_depth)",
+    buckets=_COUNT_BUCKETS,
+)
+UNSCHEDULABLE_PODS = REGISTRY.gauge(
+    "ktpu_unschedulable_pods",
+    "Pods the last solve could not place, by canonical failure reason"
+    " (reference karpenter_scheduler_unschedulable_pods_count + error events)",
+    ("reason",),
+)
+VOLUNTARY_DISRUPTION_DECISIONS = REGISTRY.counter(
+    "ktpu_voluntary_disruption_decisions_total",
+    "Disruption command outcomes after validation/scoring"
+    " (reference karpenter_voluntary_disruption_decisions_total)",
+    ("decision", "reason"),
+)
+VOLUNTARY_DISRUPTION_ELIGIBLE = REGISTRY.gauge(
+    "ktpu_voluntary_disruption_eligible_nodes",
+    "Disruptable candidates per disruption reason"
+    " (reference karpenter_voluntary_disruption_eligible_nodes)",
+    ("reason",),
+)
+NODECLAIM_TRANSITION_DURATION = REGISTRY.histogram(
+    "ktpu_nodeclaims_transition_duration_seconds",
+    "NodeClaim creation to lifecycle condition flipping true"
+    " (reference karpenter_nodeclaims_*_duration family)",
+    ("condition_type",),
+)
+NODECLAIM_TERMINATION_DURATION = REGISTRY.histogram(
+    "ktpu_nodeclaims_termination_duration_seconds",
+    "NodeClaim deletion to finalizer removal"
+    " (reference karpenter_nodeclaims_termination_duration_seconds)",
 )

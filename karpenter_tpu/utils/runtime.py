@@ -158,6 +158,21 @@ class _Handler(BaseHTTPRequestHandler):
                 out.write(f"--- thread {tid} ---\n")
                 traceback.print_stack(frame, file=out)
             self._send(200, out.getvalue())
+        elif path == "/debug/traces":
+            if not self.config.enable_profiling:
+                self._send(404, "profiling disabled")
+                return
+            # the ring of recently completed decision-provenance traces
+            # (tracing/tracer.py): nested spans for the provisioning and
+            # disruption pipelines, plus attached SchedulingDecision
+            # records — the span analog of the pprof handlers below
+            from karpenter_tpu.tracing.tracer import TRACER
+
+            self._send(
+                200,
+                json.dumps({"enabled": TRACER.enabled, "traces": TRACER.traces()}),
+                ctype="application/json",
+            )
         elif path == "/debug/envelope":
             if not self.config.enable_profiling:
                 self._send(404, "profiling disabled")

@@ -1,0 +1,180 @@
+"""/metrics exposition correctness + registry registration guards.
+
+PR-2 satellites: label escaping, cumulative histogram buckets,
+_sum/_count consistency, presence of the reference-parity families, the
+idempotent get_or_register guard, and the double-Manager construction
+case that previously relied on registration luck.
+"""
+
+import math
+import re
+
+import pytest
+
+from karpenter_tpu.utils.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    REGISTRY,
+    Registry,
+)
+
+
+def parse_samples(text: str) -> dict:
+    """exposition -> {(name, frozenset(label pairs)): value} with escapes
+    folded back, so assertions read like a Prometheus client."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})? (.+)$", line)
+        assert m, f"unparsable exposition line: {line!r}"
+        name, labels_raw, value = m.groups()
+        labels = {}
+        if labels_raw:
+            for pair in re.findall(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"', labels_raw):
+                k, v = pair
+                labels[k] = (
+                    v.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+                )
+        out[(name, frozenset(labels.items()))] = float(value)
+    return out
+
+
+class TestExpositionCorrectness:
+    def test_label_escaping_round_trips(self):
+        reg = Registry()
+        c = reg.counter("ktpu_test_total", "a counter", ("path",))
+        nasty = 'a"b\\c\nd'
+        c.inc(3.0, path=nasty)
+        text = reg.expose()
+        # raw text must not contain an unescaped quote/newline in a value
+        assert '\\"' in text and "\\n" in text and "\\\\" in text
+        samples = parse_samples(text)
+        assert samples[("ktpu_test_total", frozenset({("path", nasty)}))] == 3.0
+
+    def test_help_escaping(self):
+        reg = Registry()
+        reg.gauge("ktpu_test_gauge", "line one\nline two \\ slash")
+        text = reg.expose()
+        help_line = [l for l in text.splitlines() if l.startswith("# HELP")][0]
+        assert "\n" not in help_line
+        assert "line one\\nline two \\\\ slash" in help_line
+
+    def test_histogram_buckets_cumulative_and_consistent(self):
+        reg = Registry()
+        h = reg.histogram(
+            "ktpu_test_seconds", "h", ("op",), buckets=(0.1, 1.0, 10.0)
+        )
+        values = [0.05, 0.5, 0.5, 5.0, 50.0]
+        for v in values:
+            h.observe(v, op="x")
+        text = reg.expose()
+        samples = parse_samples(text)
+
+        def bucket(le):
+            return samples[("ktpu_test_seconds_bucket", frozenset({("op", "x"), ("le", le)}))]
+
+        cum = [bucket("0.1"), bucket("1"), bucket("10"), bucket("+Inf")]
+        assert cum == [1, 3, 4, 5]
+        assert all(a <= b for a, b in zip(cum, cum[1:])), "buckets not cumulative"
+        count = samples[("ktpu_test_seconds_count", frozenset({("op", "x")}))]
+        total = samples[("ktpu_test_seconds_sum", frozenset({("op", "x")}))]
+        assert count == cum[-1] == len(values)
+        assert total == pytest.approx(sum(values))
+
+    def test_unlabeled_histogram_buckets(self):
+        reg = Registry()
+        h = reg.histogram("ktpu_plain_seconds", "h", buckets=(1.0,))
+        h.observe(0.5)
+        h.observe(2.0)
+        samples = parse_samples(reg.expose())
+        assert samples[("ktpu_plain_seconds_bucket", frozenset({("le", "1")}))] == 1
+        assert samples[("ktpu_plain_seconds_bucket", frozenset({("le", "+Inf")}))] == 2
+
+    def test_reference_parity_families_exposed(self):
+        text = REGISTRY.expose()
+        for family in (
+            "ktpu_scheduler_batch_window_seconds",
+            "ktpu_scheduler_queue_depth_pods",
+            "ktpu_unschedulable_pods",
+            "ktpu_voluntary_disruption_decisions_total",
+            "ktpu_voluntary_disruption_eligible_nodes",
+            "ktpu_nodeclaims_transition_duration_seconds",
+            "ktpu_nodeclaims_termination_duration_seconds",
+        ):
+            assert f"# TYPE {family} " in text, f"{family} not registered"
+
+
+class TestRegistrationGuard:
+    def test_get_or_register_is_idempotent(self):
+        reg = Registry()
+        a = reg.counter("ktpu_x_total", "help", ("k",))
+        b = reg.counter("ktpu_x_total", "different help text ok", ("k",))
+        assert a is b
+        a.inc(k="v")
+        assert b.get(k="v") == 1.0  # one family, one series — no double count
+
+    def test_type_mismatch_raises(self):
+        reg = Registry()
+        reg.counter("ktpu_y_total", "h")
+        with pytest.raises(TypeError):
+            reg.gauge("ktpu_y_total", "h")
+
+    def test_label_mismatch_raises(self):
+        reg = Registry()
+        reg.counter("ktpu_z_total", "h", ("a",))
+        with pytest.raises(ValueError):
+            reg.counter("ktpu_z_total", "h", ("a", "b"))
+
+    def test_generic_get_or_register(self):
+        reg = Registry()
+        h = reg.get_or_register(Histogram, "ktpu_w_seconds", "h", (), buckets=(1.0,))
+        assert reg.get_or_register(Histogram, "ktpu_w_seconds") is h
+        assert reg.get_or_register(Gauge, "ktpu_g", "h").__class__ is Gauge
+        assert reg.get_or_register(Counter, "ktpu_c_total", "h").__class__ is Counter
+
+    def test_second_manager_construction_does_not_double_count(self):
+        """Manager restart in one process (tests do this constantly): the
+        module-level families must be shared, never re-registered into
+        duplicate series or duplicate exposition blocks."""
+        from karpenter_tpu.cloudprovider.fake import instance_types
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.controllers.manager import Manager
+        from karpenter_tpu.models.nodepool import NodePool
+        from karpenter_tpu.models.pod import make_pod
+        from karpenter_tpu.state.store import ObjectStore
+        from karpenter_tpu.utils import metrics
+        from karpenter_tpu.utils.clock import FakeClock
+
+        def build():
+            clock = FakeClock()
+            store = ObjectStore(clock)
+            cloud = KwokCloudProvider(store, catalog=instance_types(8))
+            mgr = Manager(store, cloud, clock)
+            pool = NodePool()
+            pool.metadata.name = "default"
+            store.create(ObjectStore.NODEPOOLS, pool)
+            store.create(ObjectStore.PODS, make_pod("p", cpu=0.5))
+            mgr.run_until_idle()
+            return metrics.NODECLAIMS_CREATED.get(
+                reason="provisioning", nodepool="default", min_values_relaxed="false"
+            )
+
+        first = build()
+        second = build()
+        # the second manager increments the SAME family by exactly one
+        assert second == first + 1.0
+        text = metrics.REGISTRY.expose()
+        type_lines = [l for l in text.splitlines() if l.startswith("# TYPE ")]
+        assert len(type_lines) == len(set(type_lines)), "duplicate family exposition"
+
+
+class TestHistogramSemantics:
+    def test_percentile_and_time_still_work(self):
+        reg = Registry()
+        h = reg.histogram("ktpu_t_seconds", "h", buckets=(0.1, 1.0))
+        with h.time():
+            pass
+        assert h.totals[()] == 1
+        assert not math.isnan(h.percentile(0.5))

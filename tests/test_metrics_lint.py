@@ -1,0 +1,111 @@
+"""Metric-naming lint: every registered family follows the convention.
+
+PR-2 satellite: new families use the ``ktpu_`` prefix, snake_case names,
+and non-empty help text. The pre-existing reference-parity families keep
+their reference names (``karpenter_*`` / ``operator_*``) — those are the
+point of the parity work — but the set is FROZEN below: adding a new
+family under a grandfathered prefix fails this lint, so drift has to be
+a conscious edit of the freeze list, not an accident.
+"""
+
+import re
+
+from karpenter_tpu.utils.metrics import Histogram, REGISTRY
+
+# The reference-parity families shipped before the ktpu_ convention,
+# frozen. New metrics MUST be ktpu_-prefixed (or consciously added here
+# with a reference citation in their help text).
+GRANDFATHERED = frozenset(
+    {
+        "karpenter_nodeclaims_created_total",
+        "karpenter_nodeclaims_terminated_total",
+        "karpenter_nodeclaims_disrupted_total",
+        "karpenter_nodes_created_total",
+        "karpenter_nodes_terminated_total",
+        "karpenter_pods_disruption_initiated_total",
+        "karpenter_scheduler_scheduling_duration_seconds",
+        "karpenter_scheduler_unschedulable_pods_count",
+        "karpenter_solver_host_fallback_total",
+        "karpenter_solver_rpc_duration_seconds",
+        "karpenter_consolidation_timeouts_total",
+        "karpenter_disruption_evaluation_duration_seconds",
+        "karpenter_disruption_eligible_nodes",
+        "karpenter_nodepool_usage",
+        "karpenter_nodepool_limit",
+        "karpenter_scheduler_queue_depth",
+        "karpenter_scheduler_unfinished_work_seconds",
+        "karpenter_scheduler_ignored_pods_count",
+        "karpenter_scheduler_pending_pods_by_effective_zone_count",
+        "karpenter_pods_state",
+        "karpenter_pods_startup_duration_seconds",
+        "karpenter_pods_bound_duration_seconds",
+        "karpenter_nodes_allocatable",
+        "karpenter_nodes_total_pod_requests",
+        "karpenter_nodes_utilization_percent",
+        "operator_status_condition_count",
+        "operator_status_condition_transitions_total",
+        "karpenter_cloudprovider_duration_seconds",
+        "karpenter_cloudprovider_errors_total",
+    }
+)
+
+SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def _families():
+    # importing the modules that register families ensures the walk sees
+    # everything (utils.metrics registers all module-level families at
+    # import; controllers only observe into them)
+    import karpenter_tpu.utils.metrics  # noqa: F401
+
+    return REGISTRY.families()
+
+
+def test_every_family_is_ktpu_prefixed_or_grandfathered():
+    offenders = [
+        f.name
+        for f in _families()
+        if not f.name.startswith("ktpu_") and f.name not in GRANDFATHERED
+    ]
+    assert not offenders, (
+        f"families outside the ktpu_ convention: {offenders}; new metrics "
+        "must be ktpu_-prefixed (see tests/test_metrics_lint.py)"
+    )
+
+
+def test_every_family_has_help_text():
+    missing = [f.name for f in _families() if not f.help.strip()]
+    assert not missing, f"families with empty help text: {missing}"
+
+
+def test_every_family_is_snake_case():
+    bad = [f.name for f in _families() if not SNAKE.match(f.name)]
+    assert not bad, f"non-snake_case family names: {bad}"
+
+
+def test_every_label_is_snake_case():
+    bad = [
+        (f.name, n)
+        for f in _families()
+        for n in f.label_names
+        if not SNAKE.match(n)
+    ]
+    assert not bad, f"non-snake_case label names: {bad}"
+
+
+def test_counters_end_in_total_and_histograms_in_seconds_or_pods():
+    """Unit-suffix discipline for NEW families (grandfathered names keep
+    their reference spellings verbatim)."""
+    from karpenter_tpu.utils.metrics import Counter
+
+    bad = []
+    for f in _families():
+        if f.name in GRANDFATHERED:
+            continue
+        if isinstance(f, Counter) and not f.name.endswith("_total"):
+            bad.append(f.name)
+        if isinstance(f, Histogram) and not f.name.endswith(
+            ("_seconds", "_pods", "_bytes")
+        ):
+            bad.append(f.name)
+    assert not bad, f"suffix-convention offenders: {bad}"
