@@ -172,6 +172,16 @@ def run_stage(pods, n_types, max_claims, warm_runs=2, host_parity=False):
         "total_price_per_hour": round(result.total_price(), 2),
         **envelope,
     }
+    if "pipeline" in timings:
+        # pipelined solve: the headline overlap number plus the per
+        # chunk-group split — each chunk carries its own host_rss_mb /
+        # cpu_s envelope sample (satellite: chunk-group nesting, not just
+        # the per-solve stage envelope above)
+        pl = timings["pipeline"]
+        out["overlap_frac"] = pl["overlap_frac"]
+        out["pipeline"] = pl
+    if timings.get("padding"):
+        out["padding"] = timings["padding"]
     if host_parity:
         # density on the record: the north star is throughput AT Go-FFD
         # packing density, so the oracle's nodes/price sit next to the
@@ -299,7 +309,34 @@ def run_rpc_stage(pods, n_types, local_wall_s):
         server.stop(0)
 
 
+def _print_padding_report(detail: dict) -> None:
+    """--report-padding: per-solve padded-vs-real element waste, one line
+    per (stage, axis). The JSON line still carries the same numbers under
+    each stage's "padding" key; this is the human-readable view."""
+    for stage, st in sorted(detail.items()):
+        if not isinstance(st, dict) or "padding" not in st:
+            continue
+        for axis, w in sorted(st["padding"].items()):
+            print(
+                f"padding {stage:>28s} {axis:>14s}: "
+                f"real={w['real']:>8d} padded={w['padded']:>8d} "
+                f"waste={100.0 * w['waste_frac']:5.1f}%"
+            )
+
+
 def main() -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="karpenter-tpu scheduler bench")
+    parser.add_argument(
+        "--report-padding",
+        action="store_true",
+        help="print per-solve padded-vs-real element waste per stage/axis "
+        "(the same numbers land under each stage's 'padding' key in the "
+        "final JSON line)",
+    )
+    args = parser.parse_args()
+
     from karpenter_tpu.utils.accel import force_cpu_if_unavailable
 
     fallback = force_cpu_if_unavailable()
@@ -430,6 +467,9 @@ def main() -> None:
         "final_rss_mb": round(read_rss_bytes() / 2**20, 1),
         "total_cpu_s": round(read_cpu_seconds(), 1),
     }
+
+    if args.report_padding:
+        _print_padding_report(detail)
 
     print(
         json.dumps(

@@ -217,12 +217,20 @@ class Provisioner:
         from karpenter_tpu.tracing.tracer import TRACER
 
         with TRACER.span("topology.build", pods=len(pods)):
-            base = (
-                scheduler.universe_base() if hasattr(scheduler, "universe_base") else None
-            )
-            universe = build_universe_domains(
-                scheduler.templates, self._existing_sim_nodes(excluded_nodes), template_base=base
-            )
+            # lazy universe: topology-free pod sets short-circuit inside
+            # Topology.build without constructing the domain universe
+            def universe():
+                base = (
+                    scheduler.universe_base()
+                    if hasattr(scheduler, "universe_base")
+                    else None
+                )
+                return build_universe_domains(
+                    scheduler.templates,
+                    self._existing_sim_nodes(excluded_nodes),
+                    template_base=base,
+                )
+
             return Topology.build(pods, universe, self._bound_pods(excluded_nodes))
 
     def _build_dra_problem(self, pods, extra_deleting_uids=None):

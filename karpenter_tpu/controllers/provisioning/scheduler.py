@@ -38,7 +38,7 @@ from karpenter_tpu.models import labels as l
 from karpenter_tpu.models.pod import Pod
 from karpenter_tpu.ops import solver as ops_solver
 from karpenter_tpu.ops import topology as topo_ops
-from karpenter_tpu.ops.encode import ProblemEncoder, encode_requirements
+from karpenter_tpu.ops.encode import PadBucketCache, ProblemEncoder, encode_requirements
 from karpenter_tpu.scheduling import Operator, Requirement, Requirements
 from karpenter_tpu.scheduling.taints import tolerates_all
 from karpenter_tpu.utils import resources as res
@@ -145,58 +145,123 @@ _gather_fill_xs = jax.jit(_gather_fill_xs)
 _gather_kind_xs = jax.jit(_gather_kind_xs)
 
 
+def _slim_outputs(specs: tuple, flat) -> tuple[list, list]:
+    """Shared output slimming for the jitted fetch preps: slices every
+    output to its live rows and narrows fill grids to int16. Returns the
+    processed list plus the per-grid fill maxes (overflow guard)."""
+    proc: list = []
+    maxes: list = []
+    i = 0
+    for spec in specs:
+        if spec[0] == "pods":
+            proc.append(flat[i])
+            i += 1
+        elif spec[0] == "kscan":
+            proc.append(flat[i][: spec[1]])
+            i += 1
+        else:
+            B = spec[1]
+            fc, fe, os_, no_, st_ = flat[i : i + 5]
+            i += 5
+            maxes.append(jnp.max(fc))
+            if fe.size:
+                maxes.append(jnp.max(fe))
+            proc.extend(
+                [
+                    fc[:B].astype(jnp.int16),
+                    fe[:B].astype(jnp.int16),
+                    os_[:B],
+                    no_[:B],
+                    st_[:B],
+                ]
+            )
+    return proc, maxes
+
+
+def _state_reads(state, tk: tuple) -> list:
+    """The final-state reads every decode needs: claim finalization
+    columns, the n_open sync scalar, and (when vg topology narrowed
+    anything) the topo-key requirement rows, pre-gathered on device."""
+    proc = [state.template, state.its, state.used, state.held, state.n_open]
+    if tk:
+        kid = list(tk)
+        proc.extend(
+            [
+                state.reqs.mask[:, kid, :],
+                state.reqs.inf[:, kid],
+                state.reqs.defined[:, kid],
+                state.exist_reqs.mask[:, kid, :],
+                state.exist_reqs.inf[:, kid],
+                state.exist_reqs.defined[:, kid],
+            ]
+        )
+    return proc
+
+
 def _make_fetch_prep(specs: tuple, tk: tuple):
     """Build the jitted decode-fetch prep for one output-structure
     signature: slices every output to its live rows, narrows fill grids to
     int16, gathers the topology-key requirement rows, and emits ONE flat
     list (state reads first, outputs in order, fill_max, topo masks).
-    The caller caches the jitted function per (specs, tk) so repeated
-    solves with the same shape reuse one executable."""
+    The caller caches the jitted function per (specs, tk, pad signature)
+    so repeated solves with the same shape reuse one executable."""
 
     def _prep(state, flat):
         proc = [state.template, state.its, state.used, state.held, state.n_open]
-        i = 0
-        maxes = []
-        for spec in specs:
-            if spec[0] == "pods":
-                proc.append(flat[i])
-                i += 1
-            elif spec[0] == "kscan":
-                proc.append(flat[i][: spec[1]])
-                i += 1
-            else:
-                B = spec[1]
-                fc, fe, os_, no_, st_ = flat[i : i + 5]
-                i += 5
-                maxes.append(jnp.max(fc))
-                if fe.size:
-                    maxes.append(jnp.max(fe))
-                proc.extend(
-                    [
-                        fc[:B].astype(jnp.int16),
-                        fe[:B].astype(jnp.int16),
-                        os_[:B],
-                        no_[:B],
-                        st_[:B],
-                    ]
-                )
+        out, maxes = _slim_outputs(specs, flat)
+        proc.extend(out)
         if maxes:
             proc.append(jnp.max(jnp.stack(maxes)))
         if tk:
-            kid = list(tk)
-            proc.extend(
-                [
-                    state.reqs.mask[:, kid, :],
-                    state.reqs.inf[:, kid],
-                    state.reqs.defined[:, kid],
-                    state.exist_reqs.mask[:, kid, :],
-                    state.exist_reqs.inf[:, kid],
-                    state.exist_reqs.defined[:, kid],
-                ]
-            )
+            proc.extend(_state_reads(state, tk)[5:])
         return proc
 
     return _prep
+
+
+def _make_group_prep(specs: tuple):
+    """Jitted fetch prep for ONE pipeline chunk group: the group's outputs
+    (slimmed exactly like the monolithic prep) plus the post-group
+    template snapshot (claims opened by this group already carry their
+    final template) and the group's own fill-overflow max."""
+
+    def _prep(tmpl, flat):
+        proc = [tmpl]
+        out, maxes = _slim_outputs(specs, flat)
+        proc.extend(out)
+        if maxes:
+            proc.append(jnp.max(jnp.stack(maxes)))
+        return proc
+
+    return _prep
+
+
+def _make_final_prep(tk: tuple):
+    """Jitted fetch prep for the pipelined decode's final state fetch."""
+
+    def _prep(state):
+        return _state_reads(state, tk)
+
+    return _prep
+
+
+def _partition_ranges(weights: Sequence, n_groups: int) -> list[tuple[int, int]]:
+    """Split [0, len(weights)) into <= n_groups contiguous ranges with
+    roughly balanced total weight (the pipelined decode's chunk groups)."""
+    n = len(weights)
+    n_groups = max(min(n_groups, n), 1)
+    total = float(sum(weights)) or 1.0
+    out: list[tuple[int, int]] = []
+    lo = 0
+    acc = 0.0
+    for i, w in enumerate(weights):
+        acc += w
+        if len(out) < n_groups - 1 and acc >= total * (len(out) + 1) / n_groups:
+            out.append((lo, i + 1))
+            lo = i + 1
+    if lo < n:
+        out.append((lo, n))
+    return out
 
 
 def _merge_scaled(base: dict, req: dict, c: int) -> dict:
@@ -260,6 +325,18 @@ class TPUScheduler:
         import os
 
         self.solve_chunk = int(os.environ.get("KTPU_SOLVE_CHUNK", "2048"))
+        # software pipeline (encode/dispatch vs wire/decode overlap): split
+        # large solves into ~K chunk groups; each group's outputs are
+        # fetched and decoded while the device still runs later chunks.
+        # K <= 1 disables; small solves stay on the single-fetch path
+        # (pipelining adds one wire round trip per group, only worth it
+        # when device compute per chunk can hide it).
+        self.pipeline_chunks = int(os.environ.get("KTPU_PIPELINE_CHUNKS", "4"))
+        self.pipeline_min_pods = int(os.environ.get("KTPU_PIPELINE_MIN_PODS", "4096"))
+        # per-chunk streaming sink (gRPC SolveStream); None in-process
+        self._chunk_sink = None
+        # tighter-than-pow2 pad buckets with executable-reuse amortization
+        self._pad_cache = PadBucketCache()
         self._volume_reqs: dict = {}
         self._pod_vols: dict = {}
         self._reserved_in_use: dict[str, int] = {}
@@ -456,6 +533,9 @@ class TPUScheduler:
         now=None,
         bound_pods=None,  # data form of topology seeding; the in-process
         # engine uses topology_factory (the RPC client ships bound_pods)
+        chunk_sink=None,  # pipelined-decode streaming: called with
+        # ("reset", None) when a round (or fallback) restarts the tables
+        # and ("chunk", delta) after each decoded chunk group
     ) -> SchedulingResult:
         """Solve with the preference relaxation ladder (preferences.go:38):
         each failing pod sheds ONE preference per round (shared loop in
@@ -474,10 +554,15 @@ class TPUScheduler:
 
         norm_vol = normalize_volume_reqs(volume_reqs)
         now_fn = now if now is not None else _time.monotonic
+        self._chunk_sink = chunk_sink
 
         def host_solve(reason: str) -> SchedulingResult:
             from karpenter_tpu.utils.metrics import SOLVER_HOST_FALLBACKS
 
+            if chunk_sink is not None:
+                # any streamed chunks came from an abandoned device round;
+                # the consumer must discard them before the full result
+                chunk_sink(("reset", None))
             SOLVER_HOST_FALLBACKS.inc(reason=reason)
             host = HostScheduler(
                 self.templates,
@@ -587,6 +672,7 @@ class TPUScheduler:
             return host_solve("divergence")
         finally:
             self.reserved_mode = prev_mode
+            self._chunk_sink = None
 
     def _kind_sig(self, pod: Pod):
         """Canonical content signature for pod-kind dedup: the cached
@@ -636,8 +722,13 @@ class TPUScheduler:
 
         from karpenter_tpu.tracing.tracer import TRACER
 
+        if self._chunk_sink is not None:
+            # a fresh round invalidates every chunk streamed so far
+            self._chunk_sink(("reset", None))
         self._t_solve_start = _time.perf_counter()
         self._adaptive_claims = True
+        pad_real0 = dict(self._pad_cache.real)
+        pad_padded0 = dict(self._pad_cache.padded)
         try:
             with TRACER.span("solve.encode", pods=len(pods)):
                 pods_sorted, enc = self._encode(pods, existing_nodes, budgets, topology)
@@ -645,25 +736,51 @@ class TPUScheduler:
             self._adaptive_claims = False
         _t_encode_done = _time.perf_counter()
         with TRACER.span("solve.dispatch", n_claims=enc["n_claims"]):
-            state, outputs = self._run_solve(enc)
-        # no separate device sync: over a tunneled TPU every round trip
-        # costs ~70ms of latency, so the decode's single batched fetch is
-        # the one and only synchronization point (it carries n_open too)
+            state, outputs, tmpl_snaps = self._run_solve(enc)
+        # device sync points: the single-fetch path pays exactly one wire
+        # round trip (over a tunneled TPU each costs ~70ms); the pipelined
+        # path pays one per chunk group + a final state fetch, with all
+        # but the drain hidden behind in-flight device compute
         self._t_fetch_done = None
+        self._pipeline_stats = None
         with TRACER.span("solve.decode") as _dsp:
-            out = self._decode(pods_sorted, state, outputs, enc)
+            out = self._decode(pods_sorted, state, outputs, enc, tmpl_snaps)
             _dsp.set(claims=len(out.claims), unschedulable=len(out.unschedulable))
         _t_end = _time.perf_counter()
         # phase timings for profiling/bench (VERDICT: expose the device vs
         # host split so optimization work isn't flying blind). device_s
         # includes the result transfer (they are inseparable without an
-        # extra ~70ms round trip); decode_s is pure host bookkeeping.
+        # extra ~70ms round trip); decode_s is pure host bookkeeping. On
+        # the pipelined path device_s ends at the FIRST chunk fetch, so
+        # decode_s absorbs the (hidden) later-chunk device time — the
+        # honest per-chunk split lives under last_timings["pipeline"].
         _t_device_done = self._t_fetch_done or _t_encode_done
         self.last_timings = {
             "encode_s": _t_encode_done - self._t_solve_start,
             "device_s": _t_device_done - _t_encode_done,
             "decode_s": _t_end - _t_device_done,
         }
+        # per-solve padded-vs-real element accounting (bench --report-padding)
+        padding: dict = {}
+        for kind, real in self._pad_cache.real.items():
+            r = real - pad_real0.get(kind, 0)
+            p = self._pad_cache.padded.get(kind, 0) - pad_padded0.get(kind, 0)
+            if p:
+                padding[kind] = {
+                    "real": r, "padded": p,
+                    "waste_frac": round(1.0 - r / p, 4),
+                }
+        if self._last_n_open is not None:
+            padding["claims_axis"] = {
+                "real": int(self._last_n_open),
+                "padded": int(enc["n_claims"]),
+                "waste_frac": round(
+                    1.0 - self._last_n_open / max(enc["n_claims"], 1), 4
+                ),
+            }
+        self.last_timings["padding"] = padding
+        if self._pipeline_stats is not None:
+            self.last_timings["pipeline"] = self._pipeline_stats
         return out
 
     def whatif_batch(
@@ -834,13 +951,19 @@ class TPUScheduler:
         self.existing_nodes = existing_nodes or []
         self.budgets = {k: dict(v) for k, v in (budgets or {}).items()}
         if topology is None:
-            universe = build_universe_domains(
-                self.templates, self.existing_nodes, template_base=self.universe_base()
+            # lazy universe: Topology.build's topology-free fast path skips
+            # domain-universe construction entirely (the selector-only
+            # north star never pays the existing-node requirement sweep)
+            topology = Topology.build(
+                list(pods),
+                lambda: build_universe_domains(
+                    self.templates, self.existing_nodes, template_base=self.universe_base()
+                ),
             )
-            topology = Topology.build(list(pods), universe)
         self.topology = topology
-        for node in self.existing_nodes:
-            topology.register(l.LABEL_HOSTNAME, node.name)
+        if topology.groups or topology.inverse_groups:
+            for node in self.existing_nodes:
+                topology.register(l.LABEL_HOSTNAME, node.name)
         # topology keys/domains must be in the vocab before pads freeze
         for g in topology.groups + topology.inverse_groups:
             if g.key in self.encoder.skip_keys:
@@ -989,12 +1112,15 @@ class TPUScheduler:
         # topology tensors (counts + per-kind group relations); the hostname
         # slot space gets one spare column so tier-3's fresh-slot read stays
         # in bounds when every claim slot is open
+        # v_pad passed through so the topology-free fast path caches its
+        # empty tensors at the final width (pad_to_v becomes a no-op)
         topo_tensors, vg, hg = topo_ops.encode_topology(
             self.topology,
             self.encoder,
             E,
             n_claims + 1,
             [n.name for n in self.existing_nodes],
+            v_pad=v_pad,
         )
         topo_tensors = topo_ops.pad_to_v(topo_tensors, v_pad)
         pod_topo_k, pod_topo_host = topo_ops.encode_pod_topology(
@@ -1320,10 +1446,40 @@ class TPUScheduler:
                 runs[-1][1].append(seg)
             else:
                 runs.append((m, [seg]))
+        # ---- software pipeline: split big fill runs into ~K chunks -------
+        # Each sub-run is its own dispatch (state threaded -> bit-identical
+        # to one scan) AND its own decode chunk group: while the device
+        # runs chunk i+1, chunk i's outputs cross the wire and decode on
+        # the host. Per-pod runs are already chunked by solve_chunk; kscan
+        # runs keep exact-B shapes (splitting them would mint executables
+        # per split size for little decode overlap).
+        K_pipe = self._pipeline_target(enc)
+        if K_pipe:
+            target = max(-(-enc["P"] // K_pipe), 1)
+            split: list[tuple[tuple, list]] = []
+            for mode, segs in runs:
+                if mode[0] != "fill" or len(segs) <= 1:
+                    split.append((mode, segs))
+                    continue
+                cur: list = []
+                cur_pods = 0
+                for seg in segs:
+                    cur.append(seg)
+                    cur_pods += seg[1] - seg[0]
+                    if cur_pods >= target:
+                        split.append((mode, cur))
+                        cur, cur_pods = [], 0
+                if cur:
+                    split.append((mode, cur))
+            runs = split
+            chunk = min(chunk, max(target, 256))
         from karpenter_tpu.tracing.tracer import TRACER
 
         _trace_on = TRACER.enabled
         outputs: list[tuple] = []
+        tmpl_snaps: list = []  # post-dispatch state.template per output:
+        # the pipelined decode opens claims before the final state lands,
+        # and a slot's template is fixed the moment the claim opens
         for mode, segs in runs:
             if _trace_on:
                 import time as _time
@@ -1331,11 +1487,14 @@ class TPUScheduler:
                 _t_run0 = _time.perf_counter()
             if mode[0] == "fill":
                 B = len(segs)
-                # multiple-of-32 padding above 32: every padded row is a
-                # full fill step (the north star's 210 segments pad to 224
-                # instead of 256 — ~12% of the device scan); the persistent
-                # compile cache absorbs the extra executable variants
-                B_pad = _next_pow2(B, 8) if B <= 32 else -(-B // 32) * 32
+                # bucketed padding: multiple-of-8 up to 32, multiple-of-32
+                # above (every padded row is a full fill step); the
+                # PadBucketCache reuses a previously-compiled bucket when
+                # one covers the request within the pow2 ceiling, so
+                # steady-state shapes converge instead of recompiling
+                B_pad = self._pad_cache.pad(
+                    "fill_segments", B, step=(8 if B <= 32 else 32)
+                )
                 kind_ids = np.zeros(B_pad, dtype=np.int64)
                 counts = np.zeros(B_pad, dtype=np.int32)
                 for j, (lo, hi, k) in enumerate(segs):
@@ -1354,6 +1513,7 @@ class TPUScheduler:
                     n_claims=n_claims,
                 )
                 outputs.append(("fill", segs, ys))
+                tmpl_snaps.append(state.template)
             elif mode[0] == "kscan":
                 # exact B: a padded segment would run the full-width
                 # precompute for nothing (the inner loop already has a
@@ -1365,7 +1525,7 @@ class TPUScheduler:
                 for j, (lo, hi, k) in enumerate(segs):
                     kind_ids[j] = k
                     counts[j] = hi - lo
-                maxc = _next_pow2(int(counts.max()), 64)
+                maxc = self._pad_cache.pad("kscan_cap", int(counts.max()), step=64)
                 xs = _gather_kind_xs(
                     enc["reqs_k"], enc["strict_k"], enc["requests_k"],
                     enc["tol_k"], enc["it_allow_k"], enc["exist_ok_k"],
@@ -1382,11 +1542,14 @@ class TPUScheduler:
                     maxc=maxc,
                 )
                 outputs.append(("kscan", segs, ys))
+                tmpl_snaps.append(state.template)
             else:
                 lo, hi = segs[0][0], segs[-1][1]
                 for clo in range(lo, hi, chunk):
                     L = min(chunk, hi - clo)
-                    L_pad = _next_pow2(L, 8)
+                    # multiple-of-8 bucket instead of pow2: a 1100-pod
+                    # remainder chunk pads to 1104 rows, not 2048
+                    L_pad = self._pad_cache.pad("perpod_pods", L, step=8)
                     kidx = np.zeros(L_pad, dtype=np.int64)
                     kidx[:L] = kind_of[clo : clo + L]
                     pt, tol, it_allow, exist_ok, ports, conf, vols, ptopo = (
@@ -1399,6 +1562,7 @@ class TPUScheduler:
                     )
                     state = res.claims
                     outputs.append(("pods", clo, clo + L, res.assignment))
+                    tmpl_snaps.append(state.template)
             if _trace_on:
                 # per-mode child spans: dispatch cost only — the device
                 # runs async, so the wait shows up under solve.wire
@@ -1407,7 +1571,15 @@ class TPUScheduler:
                     _time.perf_counter() - _t_run0,
                     segments=len(segs),
                 )
-        return state, outputs
+        return state, outputs, tmpl_snaps
+
+    def _pipeline_target(self, enc: dict) -> int:
+        """Chunk-group count for the software pipeline; 0 disables (small
+        solves keep the single-fetch single-round-trip path)."""
+        K = self.pipeline_chunks
+        if K <= 1 or enc["P"] < max(self.pipeline_min_pods, 1):
+            return 0
+        return K
 
     def _template_it_index(self, template):
         """(instance_types, catalog-column indices) for a template, cached —
@@ -1428,6 +1600,7 @@ class TPUScheduler:
         state: ops_solver.SolverState,
         outputs: list,
         enc: dict,
+        tmpl_snaps: Optional[list] = None,
     ) -> SchedulingResult:
         """Claim-level decode straight from device state (no per-pod host
         requirement replay).
@@ -1436,7 +1609,7 @@ class TPUScheduler:
         exact narrowed requirement masks, f32 resource usage, viable-type
         sets and reservation holds for every claim slot. Decode:
 
-          1. fetches everything in ONE batched transfer per dtype
+          1. fetches the dispatch outputs in batched transfers
              (kernels.fetch_tree) — per-array np.asarray pays a full
              round trip per read, ruinous over a tunneled TPU;
           2. replays only the cheap pod->slot bookkeeping host-side (list
@@ -1451,6 +1624,18 @@ class TPUScheduler:
              exactly the domains topology.go:226-250 would have chosen —
              bit-parity is enforced by the differential suites).
 
+        Fetch modes:
+          * single-fetch (small solves): ONE transfer carries every output
+            plus the final-state reads — exactly one wire round trip.
+          * pipelined (>= pipeline_min_pods with >= 2 dispatch chunks):
+            outputs are fetched and decoded in chunk GROUPS while the
+            device still executes later chunks (all dispatches were issued
+            asynchronously before decode starts), so wire latency and host
+            decode hide behind device compute; a final small fetch brings
+            the state reads. `solve.pipeline.chunk[i]` spans attribute the
+            overlap: a chunk's wire+decode time is overlapped whenever
+            later chunks are still in flight (in_flight > 0).
+
         Usage comes from the device carry, which accumulated in the same
         f32 order as the host oracle: per-pod adds for scan segments, one
         multiply-add per fill batch (see _merge_scaled).
@@ -1461,20 +1646,16 @@ class TPUScheduler:
         )
         from karpenter_tpu.ops.kernels import fetch_tree
         from karpenter_tpu.scheduling import hostports as hpmod
+        from karpenter_tpu.tracing.tracer import TRACER
+        import time as _time
 
-        # ONE batched transfer for everything decode reads, n_open scalar
-        # included — it doubles as the device sync, so the solve pays
-        # exactly one ~70ms round-trip latency (every extra round trip
-        # over a tunneled TPU costs that much regardless of size). Fill
-        # counts ride as int16 — bounded by per-claim pod capacity
-        # (allocatable `pods` is O(hundreds), _count_cap_seq) — and the
-        # fetched fill_max scalar guards the narrowing loudly.
-        #
+        # Fill counts ride the wire as int16 — bounded by per-claim pod
+        # capacity (allocatable `pods` is O(hundreds), _count_cap_seq) —
+        # and a fetched fill_max scalar guards the narrowing loudly.
         # The slicing/casting ("slimming") of every output runs INSIDE a
         # cached jitted prep: done eagerly it costs one tunneled dispatch
         # PER OP, and interleaved fill/kscan solves produce hundreds of
         # slim ops (~0.7s of pure dispatch latency at the 16k mix).
-        #
         # Requirement masks are read ONLY for vg-topology narrowing
         # (fold_narrowing), and only at the topology keys' rows — gathered
         # on device (K_pad -> len(topo_kids)), or skipped entirely for
@@ -1482,87 +1663,44 @@ class TPUScheduler:
         tk = tuple(enc["topo_kids"])
         flat: list = []  # device arrays, in recipe order
         specs: list = []  # static twin of `outputs` for the prep closure
+        flat_spans: list = []  # per-output [lo, hi) into flat
+        weights: list = []  # per-output decode weight (pods covered)
         for o in outputs:
+            lo_f = len(flat)
             if o[0] == "pods":
                 flat.append(o[3])
                 specs.append(("pods",))
+                weights.append(o[2] - o[1])
             elif o[0] == "kscan":
                 flat.append(o[2].assignment)
                 specs.append(("kscan", len(o[1])))
+                weights.append(sum(hi - lo for lo, hi, _ in o[1]))
             else:
                 ys = o[2]
                 flat.extend(
                     [ys.fill_c, ys.fill_e, ys.open_start, ys.n_opened, ys.status]
                 )
                 specs.append(("fill", len(o[1])))
-        key = (tuple(specs), tk)
-        prep = self._fetch_prep_cache.get(key)
-        if prep is None:
-            if len(self._fetch_prep_cache) >= 512:
-                # output structures track workload shape: bound the cache
-                # like kernels._PACK_CACHE so a long-running control plane
-                # with churning workloads can't pin executables forever
-                self._fetch_prep_cache.clear()
-            prep = self._fetch_prep_cache[key] = jax.jit(
-                _make_fetch_prep(tuple(specs), tk)
-            )
-        from karpenter_tpu.tracing.tracer import TRACER
+                weights.append(sum(hi - lo for lo, hi, _ in o[1]))
+            flat_spans.append((lo_f, len(flat)))
+        # prep-cache keys carry the pad signature and claims-axis size so
+        # a bucket change rebuilds the jitted prep instead of reusing a
+        # stale executable against resized tensors
+        pad_sig = self._pads() + (enc["n_claims"],)
 
-        with TRACER.span("solve.wire", arrays=len(flat)):
-            # the single device->host transfer: the solve's one round trip
-            fetched_flat = fetch_tree(prep(state, flat))
-        import time as _time
+        def _cached_prep(key, builder):
+            prep = self._fetch_prep_cache.get(key)
+            if prep is None:
+                if len(self._fetch_prep_cache) >= 512:
+                    # output structures track workload shape: bound the
+                    # cache like kernels._PACK_CACHE so a long-running
+                    # control plane with churning workloads can't pin
+                    # executables forever
+                    self._fetch_prep_cache.clear()
+                prep = self._fetch_prep_cache[key] = jax.jit(builder())
+            return prep
 
-        self._t_fetch_done = _time.perf_counter()
-        # unflatten along the same recipe
-        it_f = iter(fetched_flat)
-        fetched = dict(
-            template=next(it_f),
-            its=next(it_f),
-            used=next(it_f),
-            held=next(it_f),
-            n_open=next(it_f),
-        )
-        new_outputs = []
-        any_fill = False
-        for o, spec in zip(outputs, specs):
-            if spec[0] == "pods":
-                new_outputs.append((o[0], o[1], o[2], next(it_f)))
-            elif spec[0] == "kscan":
-                new_outputs.append((o[0], o[1], next(it_f)))
-            else:
-                any_fill = True
-                new_outputs.append(
-                    (
-                        o[0],
-                        o[1],
-                        {
-                            "fill_c": next(it_f),
-                            "fill_e": next(it_f),
-                            "open_start": next(it_f),
-                            "n_opened": next(it_f),
-                            "status": next(it_f),
-                        },
-                    )
-                )
-        fill_max = next(it_f) if any_fill else None
-        if tk:
-            for name in ("c_mask", "c_inf", "c_def", "e_mask", "e_inf", "e_def"):
-                fetched[name] = next(it_f)
-        n_open_i = int(fetched["n_open"])
-        self._last_n_open = n_open_i
-        if fill_max is not None and int(fill_max) >= 2**15:
-            # a fill count overflowed the int16 wire narrowing (a claim
-            # admitted >32k identical pods) — refetch those grids at full
-            # width; correctness over the wire win on this exotic shape
-            for i, o in enumerate(new_outputs):
-                if o[0] != "fill":
-                    continue
-                ys = outputs[i][2]
-                B = len(o[1])
-                o[2]["fill_c"] = np.asarray(ys.fill_c[:B])
-                o[2]["fill_e"] = np.asarray(ys.fill_e[:B])
-        outputs = new_outputs
+        fetched: dict = {}
         E = enc["E"]
         kind_of = enc["kind_of"]
         reps: list[Pod] = enc["reps"]
@@ -1605,7 +1743,11 @@ class TPUScheduler:
                 ]
             return p
 
-        claim_template = fetched["template"]
+        # bound before any decode runs: the final state's template column
+        # on the single-fetch path, the chunk group's post-dispatch
+        # snapshot on the pipelined path (identical for opened slots — a
+        # claim's template is fixed the moment it opens)
+        claim_template = None
 
         def ensure_claim(slot: int) -> SimClaim:
             nonlocal hostname_seq
@@ -1837,7 +1979,7 @@ class TPUScheduler:
                     )
                     unschedulable.append((pods_sorted[lo0 + i], reason))
 
-        for out in outputs:
+        def apply_output(out) -> None:
             if out[0] == "pods":
                 _, lo, hi, assignment = out
                 for i in range(lo, hi):
@@ -1850,6 +1992,241 @@ class TPUScheduler:
                         decode_pod(i, int(row[i - lo]))
             else:
                 decode_fill_output(out[1], out[2])
+
+        def rehydrate(o, spec, it_f):
+            """Rebuild one output from its fetched host arrays (the jitted
+            prep's emission order); returns (output, is_fill)."""
+            if spec[0] == "pods":
+                return (o[0], o[1], o[2], next(it_f)), False
+            if spec[0] == "kscan":
+                return (o[0], o[1], next(it_f)), False
+            return (
+                o[0],
+                o[1],
+                {
+                    "fill_c": next(it_f),
+                    "fill_e": next(it_f),
+                    "open_start": next(it_f),
+                    "n_opened": next(it_f),
+                    "status": next(it_f),
+                },
+            ), True
+
+        def widen_fill(idx_range, new_outs) -> None:
+            # a fill count overflowed the int16 wire narrowing (a claim
+            # admitted >32k identical pods) — refetch those grids at full
+            # width; correctness over the wire win on this exotic shape
+            for i, o in zip(idx_range, new_outs):
+                if o[0] != "fill":
+                    continue
+                ys = outputs[i][2]
+                B = len(o[1])
+                o[2]["fill_c"] = np.asarray(ys.fill_c[:B])
+                o[2]["fill_e"] = np.asarray(ys.fill_e[:B])
+
+        # chunk-sink deltas (gRPC SolveStream): only rows appended since
+        # the previous flush cross the wire
+        sink = self._chunk_sink
+        emitted_claim: dict[int, int] = {}
+        sink_marks = [0, 0]  # existing_assignments, unschedulable
+
+        def flush_chunk() -> None:
+            if sink is None:
+                return
+            delta_claims = []
+            for claim in claims:
+                n0 = emitted_claim.get(claim.slot, 0)
+                if len(claim.pods) > n0:
+                    delta_claims.append(
+                        (claim.slot, [p.uid for p in claim.pods[n0:]])
+                    )
+                    emitted_claim[claim.slot] = len(claim.pods)
+            ea = list(existing_assignments.items())
+            delta_exist = ea[sink_marks[0] :]
+            sink_marks[0] = len(ea)
+            delta_unsched = [(p.uid, r) for p, r in unschedulable[sink_marks[1] :]]
+            sink_marks[1] = len(unschedulable)
+            if delta_claims or delta_exist or delta_unsched:
+                sink(
+                    (
+                        "chunk",
+                        {
+                            "claims": delta_claims,
+                            "existing": delta_exist,
+                            "unsched": delta_unsched,
+                        },
+                    )
+                )
+
+        groups = None
+        if tmpl_snaps is not None and len(outputs) >= 2:
+            K = self._pipeline_target(enc)
+            if K >= 2:
+                groups = _partition_ranges(weights, K)
+                if len(groups) < 2:
+                    groups = None
+
+        if groups is None:
+            # ---- single-fetch path: exactly ONE wire round trip, state
+            # reads included (it doubles as the device sync; every extra
+            # round trip over a tunneled TPU costs ~70ms)
+            prep = _cached_prep(
+                ("full", tuple(specs), tk, pad_sig),
+                lambda: _make_fetch_prep(tuple(specs), tk),
+            )
+            with TRACER.span("solve.wire", arrays=len(flat)):
+                fetched_flat = fetch_tree(prep(state, flat))
+            self._t_fetch_done = _time.perf_counter()
+            it_f = iter(fetched_flat)
+            fetched = dict(
+                template=next(it_f),
+                its=next(it_f),
+                used=next(it_f),
+                held=next(it_f),
+                n_open=next(it_f),
+            )
+            new_outputs = []
+            any_fill = False
+            for o, spec in zip(outputs, specs):
+                out, is_fill = rehydrate(o, spec, it_f)
+                any_fill |= is_fill
+                new_outputs.append(out)
+            fill_max = next(it_f) if any_fill else None
+            if tk:
+                for name in ("c_mask", "c_inf", "c_def", "e_mask", "e_inf", "e_def"):
+                    fetched[name] = next(it_f)
+            if fill_max is not None and int(fill_max) >= 2**15:
+                widen_fill(range(len(new_outputs)), new_outputs)
+            claim_template = fetched["template"]
+            for out in new_outputs:
+                apply_output(out)
+            flush_chunk()
+        else:
+            # ---- pipelined path: fetch + decode chunk group i while the
+            # device executes groups > i (every dispatch was issued
+            # asynchronously before decode started), hiding wire latency
+            # and host decode behind device compute
+            from karpenter_tpu.envelope.sampler import (
+                read_cpu_seconds,
+                read_rss_bytes,
+            )
+
+            G = len(groups)
+            chunk_stats: list[dict] = []
+            with TRACER.span("solve.pipeline", chunks=G) as psp:
+                for gi, (glo, ghi) in enumerate(groups):
+                    in_flight = G - 1 - gi  # chunk groups still on device
+                    cpu0 = read_cpu_seconds()
+                    with TRACER.span(
+                        f"solve.pipeline.chunk[{gi}]", idx=gi, in_flight=in_flight
+                    ) as csp:
+                        sg = tuple(specs[glo:ghi])
+                        prep = _cached_prep(
+                            ("group", sg, pad_sig),
+                            lambda sg=sg: _make_group_prep(sg),
+                        )
+                        f_lo = flat_spans[glo][0]
+                        f_hi = flat_spans[ghi - 1][1]
+                        t0 = _time.perf_counter()
+                        fetched_flat = fetch_tree(
+                            prep(tmpl_snaps[ghi - 1], flat[f_lo:f_hi])
+                        )
+                        t1 = _time.perf_counter()
+                        if self._t_fetch_done is None:
+                            self._t_fetch_done = t1
+                        it_f = iter(fetched_flat)
+                        claim_template = next(it_f)
+                        new_outs = []
+                        any_fill = False
+                        for o, spec in zip(outputs[glo:ghi], specs[glo:ghi]):
+                            out, is_fill = rehydrate(o, spec, it_f)
+                            any_fill |= is_fill
+                            new_outs.append(out)
+                        fill_max = next(it_f) if any_fill else None
+                        if fill_max is not None and int(fill_max) >= 2**15:
+                            widen_fill(range(glo, ghi), new_outs)
+                        for out in new_outs:
+                            apply_output(out)
+                        flush_chunk()
+                        t2 = _time.perf_counter()
+                        stat = {
+                            "idx": gi,
+                            "pods": int(sum(weights[glo:ghi])),
+                            "in_flight": in_flight,
+                            "wire_s": t1 - t0,
+                            "decode_s": t2 - t1,
+                            "host_rss_mb": round(read_rss_bytes() / 2**20, 1),
+                            "cpu_s": round(read_cpu_seconds() - cpu0, 4),
+                        }
+                        csp.set(
+                            wire_s=round(stat["wire_s"], 4),
+                            decode_s=round(stat["decode_s"], 4),
+                            pods=stat["pods"],
+                        )
+                        chunk_stats.append(stat)
+                # the drain: final-state reads (template/its/used/held/
+                # n_open + topo rows) — the pipeline's only exposed round
+                # trip besides chunk 0's device wait
+                prep = _cached_prep(
+                    ("final", tk, pad_sig), lambda: _make_final_prep(tk)
+                )
+                t0 = _time.perf_counter()
+                with TRACER.span("solve.wire", arrays=5 + 6 * bool(tk)):
+                    fetched_flat = fetch_tree(prep(state))
+                t_final = _time.perf_counter() - t0
+                it_f = iter(fetched_flat)
+                fetched = dict(
+                    template=next(it_f),
+                    its=next(it_f),
+                    used=next(it_f),
+                    held=next(it_f),
+                    n_open=next(it_f),
+                )
+                if tk:
+                    for name in ("c_mask", "c_inf", "c_def", "e_mask", "e_inf", "e_def"):
+                        fetched[name] = next(it_f)
+                # overlap attribution: a chunk's wire+decode time is
+                # overlapped exactly when later chunk groups were still in
+                # flight on the device; the last chunk and the final fetch
+                # are the exposed (non-overlapped) remainder. Chunk 0's
+                # wire time is EXCLUDED from both sides — it is dominated
+                # by the wait for the device to finish chunk 0 (the
+                # pipeline fill, i.e. device time observed through the
+                # fetch), not by hideable wire/decode work.
+                def _chunk_cost(s):
+                    w = s["wire_s"] if s["idx"] > 0 else 0.0
+                    return w + s["decode_s"]
+
+                overlapped = sum(
+                    _chunk_cost(s) for s in chunk_stats if s["in_flight"]
+                )
+                total = sum(_chunk_cost(s) for s in chunk_stats) + t_final
+                overlap_frac = round(overlapped / total, 4) if total > 0 else 0.0
+                psp.set(overlap_frac=overlap_frac, final_fetch_s=round(t_final, 4))
+                self._pipeline_stats = {
+                    "n_chunks": G,
+                    "overlap_frac": overlap_frac,
+                    # chunk 0's fetch = device drain of chunk 0 + its
+                    # transfer (the pipeline fill; analogous to the old
+                    # single-fetch device wait)
+                    "sync_wire_s": round(chunk_stats[0]["wire_s"], 4),
+                    "wire_s": round(
+                        sum(s["wire_s"] for s in chunk_stats) + t_final, 4
+                    ),
+                    "host_decode_s": round(
+                        sum(s["decode_s"] for s in chunk_stats), 4
+                    ),
+                    "final_fetch_s": round(t_final, 4),
+                    "chunks": [
+                        {
+                            **s,
+                            "wire_s": round(s["wire_s"], 4),
+                            "decode_s": round(s["decode_s"], 4),
+                        }
+                        for s in chunk_stats
+                    ],
+                }
+        self._last_n_open = int(fetched["n_open"])
 
         # ---- finalization from device state --------------------------------
         def fold_narrowing(reqs: Requirements, mask_r, inf_r, def_r, what: str):

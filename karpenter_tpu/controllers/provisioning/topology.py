@@ -207,6 +207,18 @@ def template_universe_domains(templates) -> dict[str, set[str]]:
     return dict(domains)
 
 
+def pods_declare_topology(pods: Iterable[Pod]) -> bool:
+    """Whether ANY pod carries a TSC / (anti)affinity term — the gate for
+    Topology.build's fast path. One short-circuiting attribute pass; the
+    selector-only north-star workload answers False after three list
+    truthiness checks per pod instead of running the full group loop."""
+    for p in pods:
+        s = p.spec
+        if s.topology_spread_constraints or s.pod_affinity or s.pod_anti_affinity:
+            return True
+    return False
+
+
 def build_universe_domains(
     templates, existing_nodes=(), template_base: "dict | None" = None
 ) -> dict[str, set[str]]:
@@ -239,13 +251,28 @@ class Topology:
     @staticmethod
     def build(
         pods: list[Pod],
-        universe_domains: dict[str, set[str]],
+        universe_domains: "dict[str, set[str]] | callable",
         bound_pods: Optional[list[tuple[Pod, dict[str, str]]]] = None,
     ) -> "Topology":
         """universe_domains: key -> all known domains (from nodepools +
-        instance types + live nodes; buildDomainGroups). bound_pods: pods
-        already placed, with their node's labels — seeds initial counts
-        (topology.go:361-459 countDomains)."""
+        instance types + live nodes; buildDomainGroups), or a zero-arg
+        callable producing it — evaluated only when some pod actually
+        declares topology. bound_pods: pods already placed, with their
+        node's labels — seeds initial counts (topology.go:361-459
+        countDomains).
+
+        Fast path: a topology-free pod set (no TSC / (anti)affinity terms
+        on any pending pod, no anti-affinity on any bound pod) yields an
+        EMPTY Topology without touching the domain universe at all — the
+        group loop, universe construction, and downstream domain-tensor
+        encoding are all skipped (ops/topology.py caches the empty
+        tensors)."""
+        if not pods_declare_topology(pods) and not any(
+            entry[0].spec.pod_anti_affinity for entry in bound_pods or ()
+        ):
+            return Topology()
+        if callable(universe_domains):
+            universe_domains = universe_domains()
         topo = Topology()
         for pod in pods:
             for tsc in pod.spec.topology_spread_constraints:

@@ -57,6 +57,64 @@ INT_MAX = 2**31 - 1
 BASE_RESOURCES = (res.CPU, res.MEMORY, res.PODS, res.EPHEMERAL_STORAGE)
 
 
+def next_pow2(n: int, floor: int = 1) -> int:
+    """Smallest power of two >= n (>= floor)."""
+    out = floor
+    while out < n:
+        out *= 2
+    return out
+
+
+class PadBucketCache:
+    """Recompile-amortization cache for tighter-than-pow2 pad buckets.
+
+    Pow2 padding bounds the number of compiled executables but wastes up
+    to ~2x of every batch axis (a 1100-row per-pod chunk pads to 2048).
+    Multiple-of-`step` buckets are tight but churn more compiled shapes.
+    This cache splits the difference: per axis kind it remembers every
+    bucket it has handed out; a request reuses the smallest cached bucket
+    within the request's pow2 ceiling (no new executable, waste never
+    worse than pow2) and only mints the tight multiple-of-step bucket
+    when nothing cached covers it. Steady-state workloads therefore
+    converge on a few tight shapes instead of recompiling per solve.
+    """
+
+    def __init__(self, limit: int = 256):
+        self._known: dict[str, set[int]] = {}
+        self._limit = limit
+        # padded-vs-real element accounting for bench --report-padding
+        self.real: dict[str, int] = {}
+        self.padded: dict[str, int] = {}
+
+    def pad(self, kind: str, n: int, step: int = 8, floor: Optional[int] = None) -> int:
+        n = max(n, 1)
+        floor = floor if floor is not None else step
+        tight = max(floor, -(-n // step) * step)
+        ceiling = next_pow2(n, floor)
+        known = self._known.setdefault(kind, set())
+        covering = [p for p in known if tight <= p <= ceiling]
+        out = min(covering) if covering else tight
+        if not covering:
+            if len(known) >= self._limit:
+                known.clear()
+            known.add(tight)
+        self.real[kind] = self.real.get(kind, 0) + n
+        self.padded[kind] = self.padded.get(kind, 0) + out
+        return out
+
+    def waste_report(self) -> dict:
+        """Per-axis padded-vs-real rows since construction (cumulative)."""
+        out = {}
+        for kind, real in self.real.items():
+            padded = self.padded.get(kind, real)
+            out[kind] = {
+                "real": real,
+                "padded": padded,
+                "waste_frac": round(1.0 - real / padded, 4) if padded else 0.0,
+            }
+        return out
+
+
 class Vocab:
     """Per-key value vocabulary for one problem instance."""
 

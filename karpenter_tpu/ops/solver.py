@@ -755,7 +755,16 @@ def solve_from(
     """Resume the scan from an explicit carry — the chunked-solve entry:
     the host splits a large pod batch into fixed-size chunks (bounded
     per-dispatch transfers and a single compiled executable) and threads
-    SolverState between calls. Bit-identical to one big scan."""
+    SolverState between calls. Bit-identical to one big scan.
+
+    This is also the software pipeline's dispatch unit (scheduler._decode
+    chunk groups): every chunk is issued asynchronously with the carry
+    threaded through, then fetched + decoded while later chunks still run
+    on device. The pipeline's early claim materialization leans on a
+    carry invariant shared by all three dispatch kernels: a claim slot's
+    `template` entry is written exactly once, when the slot opens, and
+    never rewritten — so a post-chunk `state.template` snapshot is final
+    for every slot the chunk (or any earlier chunk) opened."""
     step = _make_step(
         exist, it, templates, well_known, topo, zone_kid, ct_kid, n_claims,
         mv_active, topo_kids, rid_kid, res_vid, res_active, res_strict,

@@ -133,18 +133,66 @@ def encode_topology_counts(
     return vg_counts0, hg_counts0
 
 
-def encode_topology(topology, encoder, e_slots: int, n_slots: int, existing_names: Sequence[str]):
+_EMPTY_TT_CACHE: dict = {}
+_EMPTY_PT_CACHE: dict = {}
+
+
+def empty_topology_tensors(v_pad: int, s_slots: int) -> TopologyTensors:
+    """The no-groups TopologyTensors (one invalid padding row per family),
+    cached per (v_pad, slot-space) so topology-free solves skip domain-
+    tensor construction AND the per-round host->device uploads entirely.
+    Field-for-field identical to what encode_topology builds when the
+    group lists are empty (skews default to 1, valid bits all False)."""
+    key = (v_pad, s_slots)
+    tt = _EMPTY_TT_CACHE.get(key)
+    if tt is None:
+        if len(_EMPTY_TT_CACHE) >= 64:
+            _EMPTY_TT_CACHE.clear()
+        tt = _EMPTY_TT_CACHE[key] = TopologyTensors(
+            vg_key=jnp.zeros(1, dtype=jnp.int32),
+            vg_type=jnp.zeros(1, dtype=jnp.int32),
+            vg_skew=jnp.ones(1, dtype=jnp.int32),
+            vg_min_domains=jnp.zeros(1, dtype=jnp.int32),
+            vg_domains=jnp.zeros((1, v_pad), dtype=bool),
+            vg_counts0=jnp.zeros((1, v_pad), dtype=jnp.int32),
+            vg_rank=jnp.full((1, v_pad), 2**30, dtype=jnp.int32),
+            vg_valid=jnp.zeros(1, dtype=bool),
+            hg_type=jnp.zeros(1, dtype=jnp.int32),
+            hg_skew=jnp.ones(1, dtype=jnp.int32),
+            hg_counts0=jnp.zeros((1, s_slots), dtype=jnp.int32),
+            hg_extra_nonempty=jnp.zeros(1, dtype=bool),
+            hg_valid=jnp.zeros(1, dtype=bool),
+        )
+    return tt
+
+
+def encode_topology(
+    topology,
+    encoder,
+    e_slots: int,
+    n_slots: int,
+    existing_names: Sequence[str],
+    v_pad: "int | None" = None,
+):
     """Host Topology + ProblemEncoder -> TopologyTensors.
 
     existing_names maps hostname domains to slots [0, E); counts on
-    hostnames outside the slot space set hg_extra_nonempty.
+    hostnames outside the slot space set hg_extra_nonempty. v_pad
+    overrides the domain-axis pad (callers that re-pad to a bucketed
+    vocab width pass it here so the empty fast path caches at the final
+    width and pad_to_v becomes a no-op).
     """
     from karpenter_tpu.controllers.provisioning.topology import TopologyType
 
     vocab = encoder.vocab
     V = max(vocab.max_values, 1)
-    v_pad = _pow2(V)
+    if v_pad is None:
+        v_pad = _pow2(V)
     groups = topology.groups + topology.inverse_groups
+    if not groups:
+        # topology-free fast path: no domains to scatter, nothing varies
+        # per solve — hand back the cached empty tensors
+        return empty_topology_tensors(v_pad, e_slots + n_slots), [], []
     vg = [g for g in groups if g.key != l.LABEL_HOSTNAME]
     hg = [g for g in groups if g.key == l.LABEL_HOSTNAME]
     NGv, NGh = _pow2(max(len(vg), 1), 1), _pow2(max(len(hg), 1), 1)
@@ -222,6 +270,22 @@ def encode_pod_topology(topology, vg, hg, pods, strict_tensors: ReqSetTensors):
     ~100ms over a tunneled TPU."""
     P = strict_tensors.mask.shape[0]
     NGv, NGh = len(vg), len(hg)
+    if NGv == 0 and NGh == 0:
+        # topology-free fast path: every relation mask is all-False; one
+        # shared cached [P, 1] zeros serves all six fields (read-only)
+        cached = _EMPTY_PT_CACHE.get(P)
+        if cached is None:
+            if len(_EMPTY_PT_CACHE) >= 64:
+                _EMPTY_PT_CACHE.clear()
+            z = np.zeros((P, 1), dtype=bool)
+            cached = _EMPTY_PT_CACHE[P] = (z, jnp.asarray(z))
+        z, jz = cached
+        pt = PodTopology(
+            vg_applies=jz, vg_records=jz, vg_self=jz,
+            hg_applies=jz, hg_records=jz, hg_self=jz,
+            strict_mask=strict_tensors.mask,
+        )
+        return pt, {"vga": z, "vgr": z, "hga": z, "hgr": z}
     NGv_pad = _pow2(max(NGv, 1), 1)
     NGh_pad = _pow2(max(NGh, 1), 1)
     vga = np.zeros((P, NGv_pad), dtype=bool)

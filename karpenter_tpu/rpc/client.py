@@ -119,6 +119,21 @@ class RemoteScheduler:
         self._solve = timed_stub("Solve", pb.SolveRequest, pb.SolveResponse)
         self._whatif = timed_stub("WhatIf", pb.WhatIfRequest, pb.WhatIfResponse)
         self._health = timed_stub("Health", pb.HealthRequest, pb.HealthResponse)
+        # streaming Solve: per-chunk partial tables arrive while the
+        # server's pipelined decode still works on later chunks. Frames
+        # are hand-framed bytes (tag + SolveResponse payload) so the
+        # deserializer is the identity. Preferred by default; one
+        # UNIMPLEMENTED (older server) downgrades to unary for the
+        # channel's lifetime. KTPU_RPC_STREAM=0 opts out.
+        import os as _os
+
+        self._solve_stream = self._channel.unary_stream(
+            f"/{SERVICE_NAME}/SolveStream",
+            request_serializer=pb.SolveRequest.SerializeToString,
+            response_deserializer=lambda b: b,
+        )
+        self._stream_ok = _os.environ.get("KTPU_RPC_STREAM", "1") != "0"
+        self.last_stream: dict = {}
         req = pb.ConfigureRequest(
             templates_json=encode_templates(templates),
             reserved_mode=reserved_mode,
@@ -143,6 +158,68 @@ class RemoteScheduler:
 
     def health(self) -> pb.HealthResponse:
         return self._health(pb.HealthRequest(), timeout=HEALTH_TIMEOUT_SECONDS)
+
+    def _consume_stream(self, req, rpc_timeout: float):
+        """Drive one SolveStream call to completion: accumulate the
+        ordered per-pod tables from chunk frames (a reset frame discards
+        them — a relaxation round or host fallback restarted the solve)
+        and return (final SolveResponse, accumulated tables or None when
+        the final frame was FULL). Tracing metadata and the RPC duration
+        histogram mirror the unary stub."""
+        from karpenter_tpu.rpc.service import (
+            FRAME_CHUNK,
+            FRAME_FINAL_FULL,
+            FRAME_RESET,
+        )
+        from karpenter_tpu.tracing.tracer import TRACER
+        from karpenter_tpu.utils.metrics import SOLVER_RPC_DURATION
+
+        claims: dict[int, list[str]] = {}
+        exist: list[tuple[str, str]] = []
+        unsched: list[tuple[str, str]] = []
+        final = None
+        full = False
+        n_frames = n_chunks = n_resets = 0
+        with TRACER.span("rpc.SolveStream"):
+            kwargs: dict = {"timeout": rpc_timeout}
+            ctx = TRACER.context()
+            if ctx is not None:
+                kwargs["metadata"] = [
+                    ("ktpu-trace-id", ctx[0]),
+                    ("ktpu-span-id", ctx[1]),
+                ]
+            with SOLVER_RPC_DURATION.time(method="SolveStream"):
+                for frame in self._solve_stream(req, **kwargs):
+                    n_frames += 1
+                    tag, payload = frame[:1], bytes(frame[1:])
+                    if tag == FRAME_RESET:
+                        n_resets += 1
+                        claims.clear()
+                        exist.clear()
+                        unsched.clear()
+                    elif tag == FRAME_CHUNK:
+                        n_chunks += 1
+                        part = pb.SolveResponse.FromString(payload)
+                        for m in part.claims:
+                            claims.setdefault(m.slot, []).extend(m.pod_uids)
+                        for a in part.existing_assignments:
+                            exist.append((a.pod_uid, a.node_name))
+                        for u in part.unschedulable:
+                            unsched.append((u.pod_uid, u.reason))
+                    else:  # FINAL_SLIM / FINAL_FULL
+                        final = pb.SolveResponse.FromString(payload)
+                        full = tag == FRAME_FINAL_FULL
+        if final is None:
+            raise RuntimeError("SolveStream ended without a final frame")
+        self.last_stream = {
+            "frames": n_frames,
+            "chunks": n_chunks,
+            "resets": n_resets,
+            "full": full,
+        }
+        if full:
+            return final, None
+        return final, {"claims": claims, "existing": exist, "unsched": unsched}
 
     # -- the TPUScheduler surface -----------------------------------------
 
@@ -212,9 +289,21 @@ class RemoteScheduler:
             req.timeout_seconds if deadline is not None else DEFAULT_SOLVE_BUDGET_SECONDS
         ) + SOLVE_COMPILE_SLACK_SECONDS
         t_encode = time.perf_counter()
+        stream_acc = None
         for attempt in range(RECONFIGURE_RETRIES + 1):
             try:
-                resp = self._solve(req, timeout=rpc_timeout)
+                if self._stream_ok:
+                    try:
+                        resp, stream_acc = self._consume_stream(req, rpc_timeout)
+                    except grpc.RpcError as err:
+                        if err.code() != grpc.StatusCode.UNIMPLEMENTED:
+                            raise
+                        # older server without the SolveStream handler:
+                        # permanent downgrade to the unary path
+                        self._stream_ok = False
+                        resp, stream_acc = self._solve(req, timeout=rpc_timeout), None
+                else:
+                    resp, stream_acc = self._solve(req, timeout=rpc_timeout), None
                 break
             except grpc.RpcError as err:
                 if (
@@ -235,13 +324,28 @@ class RemoteScheduler:
                     req.timeout_seconds = remaining
                     rpc_timeout = remaining + SOLVE_COMPILE_SLACK_SECONDS
         t_rpc = time.perf_counter()
-        result = convert.result_from_pb(
-            resp,
-            self.templates,
-            self._catalog,
-            {p.uid: p for p in pods},
-            existing_nodes,
-        )
+        pods_by_uid = {p.uid: p for p in pods}
+        if stream_acc is not None:
+            # streamed path: the per-pod tables arrived as ordered chunk
+            # frames; the final frame carried only the claim-level rest
+            result = convert.result_from_stream(
+                resp,
+                stream_acc["claims"],
+                stream_acc["existing"],
+                stream_acc["unsched"],
+                self.templates,
+                self._catalog,
+                pods_by_uid,
+                existing_nodes,
+            )
+        else:
+            result = convert.result_from_pb(
+                resp,
+                self.templates,
+                self._catalog,
+                pods_by_uid,
+                existing_nodes,
+            )
         if resp.dra_metadata_json:
             from karpenter_tpu.rpc.dra_codec import RemoteDRARound, decode_dra_metadata
 

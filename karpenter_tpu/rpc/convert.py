@@ -361,3 +361,58 @@ def result_from_pb(
         existing=existing,
         existing_assignments=existing_assignments,
     )
+
+
+def result_from_stream(
+    resp: pb.SolveResponse,
+    claim_pod_uids: dict[int, list[str]],
+    existing_pairs: list[tuple[str, str]],
+    unsched_pairs: list[tuple[str, str]],
+    templates: list,
+    catalog: dict[str, object],
+    pods_by_uid: dict[str, Pod],
+    existing_nodes: Optional[list[ExistingSimNode]] = None,
+) -> SchedulingResult:
+    """Rebuild a SchedulingResult from a STREAMED Solve: the final (slim)
+    SolveResponse carries claims WITHOUT pod_uids and none of the per-pod
+    tables — those arrived earlier as ordered chunk frames, accumulated by
+    the client into per-slot uid lists / assignment pairs. Pod order
+    within each claim (parity-relevant: it is the decode stream order) is
+    exactly the chunk emission order."""
+    claims = []
+    assignments: dict[str, int] = {}
+    for m in resp.claims:
+        uids = claim_pod_uids.get(m.slot, [])
+        for u in uids:
+            assignments[u] = m.slot
+        claims.append(
+            SimClaim(
+                template=templates[m.template_index],
+                requirements=reqs_from_pb(m.requirements),
+                used=dict(m.used),
+                instance_types=[catalog[n] for n in m.instance_type_names],
+                pods=[pods_by_uid[u] for u in uids if u in pods_by_uid],
+                slot=m.slot,
+                hostname=m.hostname,
+                host_ports=[(h.host_ip, h.port, h.protocol) for h in m.host_ports],
+                reserved_ids=frozenset(m.reserved_ids),
+                min_values_relaxed=m.min_values_relaxed,
+            )
+        )
+    existing = [n.clone() for n in (existing_nodes or [])]
+    by_name = {n.name: n for n in existing}
+    existing_assignments: dict[str, str] = {}
+    for uid, node_name in existing_pairs:
+        existing_assignments[uid] = node_name
+        node = by_name.get(node_name)
+        if node is not None and uid in pods_by_uid:
+            node.pods.append(pods_by_uid[uid])
+    return SchedulingResult(
+        claims=claims,
+        unschedulable=[
+            (pods_by_uid[u], reason) for u, reason in unsched_pairs if u in pods_by_uid
+        ],
+        assignments=assignments,
+        existing=existing,
+        existing_assignments=existing_assignments,
+    )

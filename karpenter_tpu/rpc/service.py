@@ -27,6 +27,37 @@ from karpenter_tpu.rpc.codec import decode_templates
 
 SERVICE_NAME = "karpenter_tpu.solver.v1.Solver"
 
+# SolveStream frame tags. The stream is hand-framed: each item is one tag
+# byte + (for chunk/final frames) SolveResponse bytes. Reusing the
+# existing message keeps the frozen protoc-generated pb2 module untouched
+# (no protoc in this image) while letting per-chunk partial results cross
+# the wire as the server's pipelined decode produces them.
+FRAME_CHUNK = b"\x01"  # partial per-pod tables from one decoded chunk group
+FRAME_FINAL_SLIM = b"\x02"  # final response MINUS the already-streamed tables
+FRAME_RESET = b"\x03"  # a relaxation round / fallback invalidated the chunks
+FRAME_FINAL_FULL = b"\x04"  # complete response (nothing was streamed)
+
+
+def _chunk_to_pb(delta: dict) -> pb.SolveResponse:
+    """One decoded chunk group's per-pod table deltas as a (partial)
+    SolveResponse: claim fragments carry only (slot, pod_uids) — order
+    preserved, the client appends per slot; existing assignments and
+    unschedulable entries ride their repeated fields. The assignments map
+    is NOT used (proto maps drop insertion order, and claim pod order is
+    parity-relevant)."""
+    resp = pb.SolveResponse()
+    for slot, uids in delta["claims"]:
+        m = resp.claims.add()
+        m.slot = slot
+        m.pod_uids.extend(uids)
+    for uid, node_name in delta["existing"]:
+        a = resp.existing_assignments.add()
+        a.pod_uid, a.node_name = uid, node_name
+    for uid, reason in delta["unsched"]:
+        u = resp.unschedulable.add()
+        u.pod_uid, u.reason = uid, reason
+    return resp
+
 
 class SolverService:
     """RPC method implementations. Holds the Configure'd scheduler."""
@@ -86,7 +117,17 @@ class SolverService:
         with self._server_span("rpc.server.Solve", context):
             return self._solve(request, context)
 
-    def _solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
+    def SolveStream(self, request: pb.SolveRequest, context):
+        """Streaming Solve: the scheduler's pipelined decode emits each
+        chunk group's per-pod tables as soon as it lands, so serialization
+        + DCN transfer of the bulk tables overlap the server's decode of
+        later chunks; the final frame carries the claim-level remainder.
+        A reset frame invalidates prior chunks whenever a relaxation round
+        (or a host-oracle fallback) restarts the tables."""
+        with self._server_span("rpc.server.SolveStream", context):
+            yield from self._solve_stream(request, context)
+
+    def _checked_scheduler(self, request, context):
         with self._lock:
             sched, version = self._scheduler, self._version
         if sched is None or request.config_version != version:
@@ -94,6 +135,69 @@ class SolverService:
                 grpc.StatusCode.FAILED_PRECONDITION,
                 f"config_version {request.config_version} != live {version}; re-Configure",
             )
+        return sched
+
+    def _solve_stream(self, request: pb.SolveRequest, context):
+        import queue
+
+        sched = self._checked_scheduler(request, context)
+        frames: queue.Queue = queue.Queue()
+        streamed = [False]  # chunks emitted since the last reset
+        _DONE = object()
+
+        def sink(event) -> None:
+            kind, delta = event
+            if kind == "reset":
+                if streamed[0]:
+                    frames.put(FRAME_RESET)
+                streamed[0] = False
+            else:
+                streamed[0] = True
+                frames.put(FRAME_CHUNK + _chunk_to_pb(delta).SerializeToString())
+
+        # the solve runs in a worker so the handler thread can yield chunk
+        # frames while the decode is still producing later ones
+        args, kwargs = self._solve_args(request, sched)
+
+        def run() -> None:
+            try:
+                with self._solve_lock:
+                    result = sched.solve(*args, chunk_sink=sink, **kwargs)
+                resp = self._result_pb(sched, result)
+                if streamed[0]:
+                    # the streamed chunks already carried the per-pod
+                    # tables — strip them so the drain frame stays small
+                    for m in resp.claims:
+                        m.ClearField("pod_uids")
+                    resp.ClearField("assignments")
+                    resp.ClearField("existing_assignments")
+                    resp.ClearField("unschedulable")
+                    frames.put(FRAME_FINAL_SLIM + resp.SerializeToString())
+                else:
+                    frames.put(FRAME_FINAL_FULL + resp.SerializeToString())
+            except BaseException as e:  # noqa: BLE001 — re-raised in handler
+                frames.put(e)
+            frames.put(_DONE)
+
+        # the worker must inherit the handler thread's contextvars so its
+        # solve spans root under the server span (and thus stitch into the
+        # client's trace via the ktpu-trace-id metadata)
+        import contextvars
+
+        ctx = contextvars.copy_context()
+        worker = threading.Thread(target=ctx.run, args=(run,), daemon=True)
+        worker.start()
+        while True:
+            item = frames.get()
+            if item is _DONE:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+    def _solve_args(self, request: pb.SolveRequest, sched) -> tuple:
+        """Decode a SolveRequest into TPUScheduler.solve positional args —
+        one decoding shared by the unary and streaming handlers."""
         pods = [convert.pod_from_pb(m) for m in request.pods]
         existing = [
             convert.existing_from_pb(m, i) for i, m in enumerate(request.existing_nodes)
@@ -120,10 +224,15 @@ class SolverService:
             from karpenter_tpu.tracing.tracer import TRACER
 
             with TRACER.span("topology.build", pods=len(current_pods)):
-                universe = build_universe_domains(
-                    sched.templates, existing, template_base=sched.universe_base()
+                # lazy universe: topology-free pod sets skip domain
+                # construction entirely (Topology.build fast path)
+                return Topology.build(
+                    current_pods,
+                    lambda: build_universe_domains(
+                        sched.templates, existing, template_base=sched.universe_base()
+                    ),
+                    bound,
                 )
-                return Topology.build(current_pods, universe, bound)
 
         dra_problem = None
         if request.dra_problem_json:
@@ -136,19 +245,25 @@ class SolverService:
         deadline = None
         if request.HasField("timeout_seconds"):
             deadline = time.monotonic() + request.timeout_seconds
+        return (pods, existing, budgets), dict(
+            topology_factory=topology_factory,
+            volume_reqs=volume_reqs,
+            reserved_mode=request.reserved_mode or None,
+            reserved_in_use=dict(request.reserved_in_use) or None,
+            pod_volumes=pod_volumes,
+            dra_problem=dra_problem,
+            deadline=deadline,
+        )
+
+    def _solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
+        sched = self._checked_scheduler(request, context)
+        args, kwargs = self._solve_args(request, sched)
         with self._solve_lock:
-            result = sched.solve(
-                pods,
-                existing,
-                budgets,
-                topology_factory=topology_factory,
-                volume_reqs=volume_reqs,
-                reserved_mode=request.reserved_mode or None,
-                reserved_in_use=dict(request.reserved_in_use) or None,
-                pod_volumes=pod_volumes,
-                dra_problem=dra_problem,
-                deadline=deadline,
-            )
+            result = sched.solve(*args, **kwargs)
+        return self._result_pb(sched, result)
+
+    @staticmethod
+    def _result_pb(sched, result) -> pb.SolveResponse:
         resp = convert.result_to_pb(result, sched.templates)
         if result.dra is not None:
             from karpenter_tpu.rpc.dra_codec import encode_dra_metadata
@@ -163,7 +278,10 @@ class SolverService:
         scenarios in ONE device dispatch (TPUScheduler.whatif_batch).
         Declines exactly when the in-process prefilter would (multi-alt
         volumes, per-scenario group-structure divergence) — callers fall
-        back to sequential Solve RPCs. CSI attach limits ride the batch."""
+        back to sequential Solve RPCs. CSI attach limits ride the batch.
+        Stays unary (no SolveStream analog): the reply is O(S) verdict
+        booleans from one vmapped dispatch — there are no chunk results
+        to stream, unlike Solve's per-pod tables."""
         with self._server_span("rpc.server.WhatIf", context):
             return self._whatif(request, context)
 
@@ -206,11 +324,14 @@ class SolverService:
             # a domain only an excluded node carries would otherwise pin
             # the spread global min at a permanently-zero domain
             surviving = [n for n in existing if n.name not in excluded]
-            universe = build_universe_domains(
-                sched.templates, surviving, template_base=sched.universe_base()
-            )
             keep = [(p, labels) for p, labels, name in bound if name not in excluded]
-            return Topology.build(current_pods, universe, keep)
+            return Topology.build(
+                current_pods,
+                lambda: build_universe_domains(
+                    sched.templates, surviving, template_base=sched.universe_base()
+                ),
+                keep,
+            )
 
         with self._solve_lock:
             out = sched.whatif_batch(
@@ -262,6 +383,13 @@ def _handlers(service: SolverService) -> grpc.GenericRpcHandler:
             service.Solve,
             request_deserializer=pb.SolveRequest.FromString,
             response_serializer=pb.SolveResponse.SerializeToString,
+        ),
+        # hand-framed server stream: each item is already bytes (tag +
+        # SolveResponse payload), so the serializer is the identity
+        "SolveStream": grpc.unary_stream_rpc_method_handler(
+            service.SolveStream,
+            request_deserializer=pb.SolveRequest.FromString,
+            response_serializer=lambda b: b,
         ),
         "WhatIf": grpc.unary_unary_rpc_method_handler(
             service.WhatIf,

@@ -2,11 +2,16 @@
 
 The Dapper/OpenTelemetry lineage (PAPERS.md) applied to the provisioning
 and disruption hot loops: nested spans with per-span attributes answer
-"where inside the 0.85 s north-star solve did the time go" the same way
+"where inside the north-star solve did the time go" the same way
 the reference's pprof handlers answer CPU questions — but along the
 pipeline's own stage boundaries (batcher wait -> topology build ->
 encode -> device dispatch -> wire transfer -> decode -> claim
-creation/bind) instead of stack samples.
+creation/bind) instead of stack samples. Pipelined solves additionally
+emit a `solve.pipeline` span with per-group `solve.pipeline.chunk[i]`
+children: each carries wire_s / decode_s / in_flight attributes and the
+parent carries `overlap_frac` — the share of wire+decode time hidden
+behind in-flight device compute (overlap attribution; chunk spans
+stitch across the gRPC split like every other span).
 
 Design constraints, in order:
 

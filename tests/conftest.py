@@ -23,6 +23,13 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 gate (-m 'not slow'); run on demand",
+    )
+
+
 @pytest.fixture
 def rng():
     import numpy as np

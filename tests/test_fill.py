@@ -50,10 +50,10 @@ def _compare(templates, pods, existing=None, max_claims=64, expect_unschedulable
     orig = sched._run_solve_inner
 
     def wrapped(enc):
-        state, outputs = orig(enc)
+        state, outputs, tmpl_snaps = orig(enc)
         for o in outputs:
             stats[o[0]] += 1
-        return state, outputs
+        return state, outputs, tmpl_snaps
 
     sched._run_solve_inner = wrapped
     r_dev = sched.solve(pods, existing_nodes=[n.clone() for n in (existing or [])])

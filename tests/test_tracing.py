@@ -279,14 +279,17 @@ class TestRemoteSolveStitching:
             assert not result.unschedulable
             trace = tracer.trace(root.trace_id)
             by = spans_by_name(trace)
-            assert "rpc.Solve" in by  # the client-side wire crossing
-            assert "rpc.server.Solve" in by  # the server fragment
+            # solves prefer the streaming SolveStream crossing (unary
+            # Solve remains the downgrade path on older servers)
+            method = "SolveStream" if "rpc.SolveStream" in by else "Solve"
+            assert f"rpc.{method}" in by  # the client-side wire crossing
+            assert f"rpc.server.{method}" in by  # the server fragment
             assert "solve.encode" in by  # server-side solve internals
             # stitched: one trace id across both sides of the socket
             assert all(s["trace_id"] == root.trace_id for s in trace["spans"])
-            # the server fragment hangs off the client's rpc.Solve span
-            server_root = by["rpc.server.Solve"][0]
-            assert server_root["parent_id"] == by["rpc.Solve"][0]["span_id"]
+            # the server fragment hangs off the client's rpc span
+            server_root = by[f"rpc.server.{method}"][0]
+            assert server_root["parent_id"] == by[f"rpc.{method}"][0]["span_id"]
         finally:
             server.stop(0)
 
