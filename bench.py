@@ -309,6 +309,80 @@ def run_rpc_stage(pods, n_types, local_wall_s):
         server.stop(0)
 
 
+def run_chaos_stage(on_tpu: bool) -> dict:
+    """--chaos smoke: the north-star scenario under a LIGHT fault plan
+    (occasional injected latency at the device-dispatch seam plus one
+    recovered device failure), asserting the wall-clock gate still holds
+    and that the fault points' disabled-path overhead is < 1% of a solve.
+
+    On TPU the workload and gate are the north star's
+    (tests/test_perf_gate.NORTHSTAR_MAX_WALL_S); the CPU fallback runs
+    the 2048-selector stage and gates only the overhead + convergence
+    halves (there is no CPU wall gate to hold)."""
+    from karpenter_tpu.controllers.provisioning import TPUScheduler
+    from karpenter_tpu.faultinject import FAULT, FaultInjector, active_plan
+
+    n_pods, n_types, max_claims = (100_000, 1000, 4096) if on_tpu else (2048, 400, 256)
+    wall_gate_s = 0.70 if on_tpu else None  # test_perf_gate.NORTHSTAR_MAX_WALL_S
+    pods = selector_pods(n_pods)
+    templates = make_templates(n_types)
+    sched = TPUScheduler(templates, pod_pad=n_pods, max_claims=max_claims)
+    baseline = sched.solve(pods)  # cold
+    t0 = time.perf_counter()
+    baseline = sched.solve(pods)
+    clean_wall = time.perf_counter() - t0
+    assert not baseline.unschedulable
+
+    # 1. disabled-path overhead: a solve crosses a handful of fault
+    # points; budget 1000 crossings and demand they cost < 1% of the
+    # measured clean solve (the same discipline as the tracer gate)
+    probe = FaultInjector()  # disabled: the production steady state
+    n_calls = 100_000
+    t0 = time.perf_counter()
+    for _ in range(n_calls):
+        probe.point("bench.overhead")
+    per_call_s = (time.perf_counter() - t0) / n_calls
+    overhead_frac = (per_call_s * 1000) / clean_wall
+    assert overhead_frac < 0.01, (
+        f"disabled fault points cost {100 * overhead_frac:.2f}% of a solve"
+    )
+
+    # 2. the light plan: rare 1ms latency at the dispatch seam + exactly
+    # one injected device failure (absorbed by the degradation ladder)
+    plan = {
+        "seed": 97,
+        "rules": [
+            {"point": "solver.dispatch", "error": "runtime", "times": 1},
+            {"point": "solver.dispatch", "mode": "latency", "delay_s": 0.001, "p": 0.25},
+        ],
+    }
+    with active_plan(plan):
+        degraded = sched.solve(pods)  # the device failure -> host oracle
+        t0 = time.perf_counter()
+        chaotic = sched.solve(pods)  # back on the device, latency plan live
+        chaos_wall = time.perf_counter() - t0
+        injected = FAULT.fires()
+    assert not degraded.unschedulable and not chaotic.unschedulable
+    assert chaotic.node_count == baseline.node_count, "chaos changed the answer"
+    out = {
+        "pods": n_pods,
+        "types": n_types,
+        "clean_wall_s": round(clean_wall, 4),
+        "chaos_wall_s": round(chaos_wall, 4),
+        "faults_injected": injected,
+        "disabled_point_ns": round(per_call_s * 1e9, 1),
+        "disabled_overhead_frac_of_solve": round(overhead_frac, 6),
+    }
+    if wall_gate_s is not None:
+        out["wall_gate_s"] = wall_gate_s
+        out["gate_ok"] = chaos_wall <= wall_gate_s
+        assert out["gate_ok"], (
+            f"north-star wall gate broke under the light fault plan: "
+            f"{chaos_wall:.3f}s > {wall_gate_s}s"
+        )
+    return out
+
+
 def _print_padding_report(detail: dict) -> None:
     """--report-padding: per-solve padded-vs-real element waste, one line
     per (stage, axis). The JSON line still carries the same numbers under
@@ -335,6 +409,13 @@ def main() -> None:
         "(the same numbers land under each stage's 'padding' key in the "
         "final JSON line)",
     )
+    parser.add_argument(
+        "--chaos",
+        action="store_true",
+        help="smoke mode: run ONLY the north-star scenario under a light "
+        "fault plan and assert the wall gate still holds + the fault "
+        "points' disabled-path overhead is < 1% of a solve",
+    )
     args = parser.parse_args()
 
     from karpenter_tpu.utils.accel import force_cpu_if_unavailable
@@ -351,6 +432,18 @@ def main() -> None:
 
     platform = jax.devices()[0].platform
     on_tpu = platform != "cpu"
+
+    if args.chaos:
+        print(
+            json.dumps(
+                {
+                    "metric": "chaos_smoke",
+                    "platform": platform,
+                    "detail": run_chaos_stage(on_tpu),
+                }
+            )
+        )
+        return
 
     detail = {"platform": platform}
 

@@ -2,13 +2,23 @@
 
 The error type — not the message — drives controller behavior:
   NodeClaimNotFoundError    delete retries until the instance is gone
-  InsufficientCapacityError launch fails fast; claim deleted; pods re-scheduled
+  InsufficientCapacityError launch fails fast; claim deleted; pods re-scheduled;
+                            the named offerings enter the blackout cache
+  TransientError            bounded retry + requeue (throttle, timeout, flake)
+  TerminalError             no retry; the claim's condition carries the reason
   NodeClassNotReadyError    launch requeues until the node class is ready
   CreateError               carries a condition reason/message onto the claim
   UnevaluatedNodePoolError  overlay store has not evaluated this pool yet
+
+The Transient/ICE/Terminal split is the retry taxonomy the fault-inject
+subsystem exercises: ``is_retryable`` is the single predicate the
+lifecycle controller and the disruption queue consult, so a provider
+(or an injected fault) only has to pick the right type.
 """
 
 from __future__ import annotations
+
+from typing import Optional, Sequence
 
 
 class CloudProviderError(Exception):
@@ -19,8 +29,39 @@ class NodeClaimNotFoundError(CloudProviderError):
     pass
 
 
+class TransientError(CloudProviderError):
+    """Retryable: the same call is expected to succeed shortly (API
+    brownout, rate limit, network flake). Controllers retry with bounded
+    attempts + requeue instead of failing the claim."""
+
+
+class ThrottleError(TransientError):
+    """Provider rate limiting (AWS ThrottlingException analog)."""
+
+
+class CloudTimeoutError(TransientError):
+    """The provider call timed out; the operation may or may not have
+    landed — callers must stay idempotent."""
+
+
+class TerminalError(CloudProviderError):
+    """Not retryable: repeating the call cannot succeed (bad request,
+    quota config, permanent rejection)."""
+
+
 class InsufficientCapacityError(CloudProviderError):
-    pass
+    """No capacity for the requested offering(s). ``offerings`` names the
+    (instance_type, zone, capacity_type) triples the provider attempted,
+    so the lifecycle controller can blackout exactly those offerings
+    (reference pkg/providers ICE cache parity)."""
+
+    def __init__(
+        self,
+        message: str = "",
+        offerings: Optional[Sequence[tuple[str, str, str]]] = None,
+    ):
+        super().__init__(message)
+        self.offerings = list(offerings or [])
 
 
 class NodeClassNotReadyError(CloudProviderError):
@@ -46,6 +87,13 @@ def instance_types_or_none(cloud, pool):
         return cloud.get_instance_types(pool)
     except UnevaluatedNodePoolError:
         return None
+
+
+def is_retryable(err: Exception) -> bool:
+    """The retry predicate: transient errors get bounded retry + requeue;
+    everything else follows its own typed path (ICE fail-fast, terminal
+    condition, not-found finalizer drop)."""
+    return isinstance(err, TransientError)
 
 
 def is_insufficient_capacity(err: Exception) -> bool:

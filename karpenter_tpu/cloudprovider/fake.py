@@ -15,6 +15,7 @@ import itertools
 from typing import Callable, Optional
 
 from karpenter_tpu.cloudprovider import errors
+from karpenter_tpu.faultinject import FAULT
 from karpenter_tpu.cloudprovider.instancetype import InstanceType, InstanceTypeOverhead, Offering
 from karpenter_tpu.cloudprovider.spi import CloudProvider, RepairPolicy
 from karpenter_tpu.models import labels as l
@@ -195,6 +196,22 @@ class FakeCloudProvider(CloudProvider):
                 f"no compatible instance types for claim {node_claim.name}"
             )
         _, it, offering = best
+        # chaos seam: fires after offering resolution so an injected ICE
+        # names the REAL offering the launch would have used — the
+        # lifecycle controller blackouts exactly that (it, zone, ct)
+        try:
+            FAULT.point(
+                "cloud.create",
+                provider="fake",
+                claim=node_claim.name,
+                instance_type=it.name,
+                zone=offering.zone,
+                capacity_type=offering.capacity_type,
+            )
+        except errors.InsufficientCapacityError as e:
+            if not e.offerings:
+                e.offerings = [(it.name, offering.zone, offering.capacity_type)]
+            raise
         resolved = node_claim
         resolved.status.provider_id = f"fake:///{node_claim.name}/{new_uid('instance')}"
         resolved.status.capacity = dict(it.capacity)
@@ -212,6 +229,7 @@ class FakeCloudProvider(CloudProvider):
         return resolved
 
     def delete(self, node_claim: NodeClaim) -> None:
+        FAULT.point("cloud.delete", provider="fake", claim=node_claim.name)
         self.delete_calls.append(node_claim)
         pid = node_claim.status.provider_id
         if pid not in self.created:

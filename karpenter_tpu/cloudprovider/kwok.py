@@ -14,6 +14,7 @@ from typing import Optional
 
 from karpenter_tpu.cloudprovider import errors
 from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.faultinject import FAULT
 from karpenter_tpu.cloudprovider.instancetype import RESERVATION_ID_LABEL, InstanceType, Offering
 from karpenter_tpu.cloudprovider.spi import CloudProvider
 from karpenter_tpu.models import labels as l
@@ -72,6 +73,21 @@ class KwokCloudProvider(CloudProvider):
 
     def create(self, claim: NodeClaim) -> NodeClaim:
         it, offering = self._resolve(claim)
+        # chaos seam (mirrors fake.create): resolution first, so an
+        # injected ICE carries the exact offering for the blackout cache
+        try:
+            FAULT.point(
+                "cloud.create",
+                provider="kwok",
+                claim=claim.name,
+                instance_type=it.name,
+                zone=offering.zone,
+                capacity_type=offering.capacity_type,
+            )
+        except errors.InsufficientCapacityError as e:
+            if not e.offerings:
+                e.offerings = [(it.name, offering.zone, offering.capacity_type)]
+            raise
         if offering.capacity_type == l.CAPACITY_TYPE_RESERVED:
             # the provider is the source of truth for reservation usage: a
             # launch consumes a slot, so the catalog the NEXT scheduling
@@ -117,6 +133,7 @@ class KwokCloudProvider(CloudProvider):
         return claim
 
     def delete(self, claim: NodeClaim) -> None:
+        FAULT.point("cloud.delete", provider="kwok", claim=claim.name)
         node = self.store.node_by_provider_id(claim.status.provider_id)
         if node is None:
             raise errors.NodeClaimNotFoundError(claim.status.provider_id)

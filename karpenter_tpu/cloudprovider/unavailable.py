@@ -1,0 +1,136 @@
+"""TTL'd unavailable-offerings blackout cache.
+
+Counterpart of the reference providers' ICE cache (aws
+pkg/providers/instance unavailableofferings.Cache, surfaced in kwok via
+offering availability): when a launch fails with InsufficientCapacity,
+the exact (instance_type, zone, capacity_type) triples the provider
+attempted are blacked out for a TTL, so the very next scheduling loop
+stops picking the offering that just failed instead of ping-ponging
+claims into the same empty pool.
+
+Wiring: the Manager owns one cache on the injected clock and hands it to
+both the lifecycle controller (which marks on ICE) and the Provisioner
+(which filters each pool's catalog through it before building the
+scheduler, and folds ``generation`` into the scheduler cache signature
+so a blackout change — or an expiry — rebuilds the solver's catalog).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from karpenter_tpu.cloudprovider.instancetype import InstanceType
+from karpenter_tpu.utils.clock import Clock
+
+# reference parity: the AWS ICE cache holds offerings out for 3 minutes
+DEFAULT_BLACKOUT_TTL_SECONDS = 180.0
+
+Key = tuple[str, str, str]  # (instance_type, zone, capacity_type)
+
+
+class UnavailableOfferings:
+    def __init__(
+        self, clock: Optional[Clock] = None, ttl_seconds: float = DEFAULT_BLACKOUT_TTL_SECONDS
+    ):
+        self.clock = clock or Clock()
+        self.ttl_seconds = ttl_seconds
+        self._entries: dict[Key, float] = {}  # key -> expiry (clock domain)
+        # bumped on every mark and on every observed expiry: the scheduler
+        # cache signature folds this in, so catalog filtering can't go
+        # stale in either direction
+        self.generation = 0
+
+    # -- writes ------------------------------------------------------------
+
+    def mark(
+        self,
+        instance_type: str,
+        zone: str,
+        capacity_type: str,
+        ttl_seconds: Optional[float] = None,
+    ) -> None:
+        ttl = self.ttl_seconds if ttl_seconds is None else ttl_seconds
+        self._entries[(instance_type, zone, capacity_type)] = self.clock.now() + ttl
+        self.generation += 1
+        self._update_gauge()
+
+    def mark_from_error(self, err: Exception) -> int:
+        """Blackout every offering an InsufficientCapacityError names;
+        returns how many were marked (an ICE without offering info — a
+        fully exhausted catalog — marks nothing)."""
+        marked = 0
+        for entry in getattr(err, "offerings", ()) or ():
+            it_name, zone, capacity_type = entry
+            self.mark(it_name, zone, capacity_type)
+            marked += 1
+        return marked
+
+    # -- reads -------------------------------------------------------------
+
+    def prune(self) -> int:
+        """Drop expired entries; returns how many expired. Bumps the
+        generation when anything changed so cached schedulers rebuilt
+        against the filtered catalog pick the offerings back up."""
+        now = self.clock.now()
+        expired = [k for k, exp in self._entries.items() if exp <= now]
+        for k in expired:
+            del self._entries[k]
+        if expired:
+            self.generation += 1
+            self._update_gauge()
+        return len(expired)
+
+    def is_unavailable(self, instance_type: str, zone: str, capacity_type: str) -> bool:
+        exp = self._entries.get((instance_type, zone, capacity_type))
+        return exp is not None and exp > self.clock.now()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def entries(self) -> list[Key]:
+        return list(self._entries)
+
+    # -- catalog filtering -------------------------------------------------
+
+    def filter_catalog(self, its: list[InstanceType]) -> list[InstanceType]:
+        """The scheduler-facing view of a pool's catalog: blacked-out
+        offerings removed, instance types with no surviving offering
+        dropped. The empty-cache fast path returns the input list
+        untouched (the steady state pays one truthiness check)."""
+        self.prune()
+        if not self._entries:
+            return its
+        out: list[InstanceType] = []
+        for it in its:
+            keep = [
+                o
+                for o in it.offerings
+                if not self.is_unavailable(it.name, o.zone, o.capacity_type)
+            ]
+            if len(keep) == len(it.offerings):
+                out.append(it)
+            elif keep:
+                out.append(
+                    InstanceType(
+                        it.name,
+                        it.requirements,
+                        keep,
+                        it.capacity,
+                        it.overhead,
+                        dra_slices=it.dra_slices,
+                        dra_attribute_bindings=it.dra_attribute_bindings,
+                    )
+                )
+            # else: every offering blacked out — the type is unlaunchable
+            # for the TTL and leaves the catalog entirely
+        return out
+
+    def _update_gauge(self) -> None:
+        from karpenter_tpu.utils.metrics import OFFERING_BLACKOUT
+
+        OFFERING_BLACKOUT.values.clear()
+        counts: dict[str, int] = {}
+        for _, _, ct in self._entries:
+            counts[ct] = counts.get(ct, 0) + 1
+        for ct, n in counts.items():
+            OFFERING_BLACKOUT.set(float(n), capacity_type=ct)

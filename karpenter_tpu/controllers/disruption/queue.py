@@ -11,6 +11,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
+from karpenter_tpu.cloudprovider.errors import TransientError
 from karpenter_tpu.controllers.disruption.methods import Command
 from karpenter_tpu.models import labels as l
 from karpenter_tpu.models.nodeclaim import COND_INITIALIZED
@@ -20,6 +21,10 @@ from karpenter_tpu.state.store import ObjectStore
 from karpenter_tpu.utils.clock import Clock
 
 REPLACEMENT_TIMEOUT_SECONDS = 10 * 60.0
+# Transient API errors while advancing an in-flight command retry across
+# process() passes, bounded: past the budget the command rolls back (the
+# candidates return to service; the next disruption poll recomputes)
+MAX_CHECK_RETRIES = 8
 
 
 @dataclass
@@ -28,6 +33,8 @@ class _InFlight:
     replacement_names: list[str]
     started_at: float
     candidate_provider_ids: list[str] = field(default_factory=list)
+    retries: int = 0
+    abandoning: bool = False  # retries exhausted; only rollback remains
 
 
 class OrchestrationQueue:
@@ -41,6 +48,23 @@ class OrchestrationQueue:
     # -- StartCommand (queue.go:313-392) ------------------------------------
 
     def start(self, command: Command) -> None:
+        """Begin a command; a transient API error mid-start aborts it
+        cleanly (partial taints/replacements undone) instead of leaving
+        half a command in flight — the next disruption poll recomputes
+        from live state, which is the requeue."""
+        replacement_names: list[str] = []
+        try:
+            self._start(command, replacement_names)
+        except TransientError:
+            from karpenter_tpu.utils import metrics
+
+            metrics.TRANSIENT_RETRIES.inc(controller="disruption.queue")
+            metrics.VOLUNTARY_DISRUPTION_DECISIONS.inc(
+                decision="aborted", reason=command.reason
+            )
+            self._abort_start(command, replacement_names)
+
+    def _start(self, command: Command, replacement_names: list[str]) -> None:
         # 1. taint candidates so nothing new schedules there
         for c in command.candidates:
             node = c.state_node.node
@@ -54,15 +78,14 @@ class OrchestrationQueue:
         # create_node_claims parity)
         from karpenter_tpu.utils import metrics
 
-        replacement_names = []
         for sim in command.replacements:
             claim = self.provisioner._to_node_claim(sim)
+            self.store.create(ObjectStore.NODECLAIMS, claim)
             metrics.NODECLAIMS_CREATED.inc(
                 reason=command.reason,
                 nodepool=sim.template.nodepool_name,
                 min_values_relaxed="true" if sim.min_values_relaxed else "false",
             )
-            self.store.create(ObjectStore.NODECLAIMS, claim)
             self.cluster.update_nodeclaim(claim)
             for pod in sim.pods:
                 self.cluster.nominate_pod(pod.uid, claim.name)
@@ -79,6 +102,37 @@ class OrchestrationQueue:
             )
         )
 
+    def _abort_start(self, command: Command, replacement_names: list[str]) -> None:
+        """Best-effort unwind of a partially-started command: drop any
+        replacements already created and untaint the candidates. Each
+        step absorbs further transient errors — an orphan that slips
+        through is reclaimed by liveness/GC, and pod nominations expire
+        on their own TTL."""
+        for name in replacement_names:
+            try:
+                claim = self.store.get(ObjectStore.NODECLAIMS, name)
+                if claim is not None:
+                    claim.metadata.finalizers = []
+                    self.store.delete(ObjectStore.NODECLAIMS, name)
+            except TransientError:
+                pass
+        for c in command.candidates:
+            node = c.state_node.node
+            if node is None:
+                continue
+            live = self.store.get(ObjectStore.NODES, node.name)
+            if live is None:
+                continue
+            before = len(live.spec.taints)
+            live.spec.taints = [
+                t for t in live.spec.taints if not t.match(DISRUPTED_NO_SCHEDULE_TAINT)
+            ]
+            if len(live.spec.taints) != before:
+                try:
+                    self.store.update(ObjectStore.NODES, live)
+                except TransientError:
+                    live.spec.taints.append(DISRUPTED_NO_SCHEDULE_TAINT)
+
     # -- waitOrTerminate (queue.go:186-257) -----------------------------------
 
     def process(self) -> int:
@@ -94,7 +148,22 @@ class OrchestrationQueue:
         done = 0
         remaining = []
         for item in self.in_flight:
-            status = self._check(item)
+            try:
+                if item.abandoning:
+                    # retry budget already spent: only the rollback
+                    # remains, retried until it lands
+                    self._rollback(item)
+                    continue
+                status = self._check(item)
+            except TransientError:
+                from karpenter_tpu.utils import metrics
+
+                metrics.TRANSIENT_RETRIES.inc(controller="disruption.queue")
+                item.retries += 1
+                if item.retries > MAX_CHECK_RETRIES:
+                    item.abandoning = True
+                remaining.append(item)  # requeue: next process() retries
+                continue
             if status == "wait":
                 remaining.append(item)
             elif status == "done":

@@ -65,11 +65,18 @@ class Manager:
             idle=self.options.batch_idle_seconds,
             max_duration=self.options.batch_max_seconds,
         )
+        # ONE blackout cache shared by the lifecycle controller (marks on
+        # ICE) and the Provisioner (filters the catalog): the loop that
+        # makes a failed launch stop being re-picked for the TTL
+        from karpenter_tpu.cloudprovider.unavailable import UnavailableOfferings
+
+        self.unavailable = UnavailableOfferings(self.clock)
         self.provisioner = Provisioner(
             store,
             self.cluster,
             cloud,
             self.clock,
+            unavailable=self.unavailable,
             ignore_preferences=self.options.preference_policy == "Ignore",
             reserved_capacity_enabled=self.options.feature_gates.reserved_capacity,
             min_values_policy=self.options.min_values_policy,
@@ -85,7 +92,9 @@ class Manager:
 
             self.device_allocation = DeviceAllocationController(store, self.clock)
             self.provisioner.device_allocation = self.device_allocation
-        self.lifecycle = NodeClaimLifecycleController(store, cloud, self.clock)
+        self.lifecycle = NodeClaimLifecycleController(
+            store, cloud, self.clock, unavailable=self.unavailable
+        )
         self.nodeclaim_disruption = NodeClaimDisruptionController(store, cloud, self.clock)
         from karpenter_tpu.controllers.disruption import DisruptionController
         from karpenter_tpu.controllers.garbage_collection import (
@@ -328,11 +337,25 @@ class Manager:
         # nodeclaim lifecycle
         dirty, self._dirty_claims = self._dirty_claims, set()
         if dirty:
+            from karpenter_tpu.cloudprovider.errors import TransientError
+
             with TRACER.span("lifecycle.drain", claims=len(dirty)):
                 for name in sorted(dirty):
                     claim = self.store.get(ObjectStore.NODECLAIMS, name)
                     if claim is not None:
-                        self.lifecycle.reconcile(claim)
+                        try:
+                            self.lifecycle.reconcile(claim)
+                        except TransientError:
+                            # a flaky apiserver write mid-reconcile:
+                            # requeue the claim (idempotent reconcilers
+                            # make the retry safe) instead of crashing
+                            # the whole drain pass
+                            from karpenter_tpu.utils import metrics
+
+                            metrics.TRANSIENT_RETRIES.inc(
+                                controller="nodeclaim.lifecycle"
+                            )
+                            self._dirty_claims.add(name)
                         worked = True
         # device allocation collapse (DRA): claims whose NodeClaim launched
         if self.device_allocation is not None:

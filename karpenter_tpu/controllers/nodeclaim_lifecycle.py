@@ -24,10 +24,22 @@ from karpenter_tpu.state.store import ObjectStore
 from karpenter_tpu.utils.clock import Clock
 
 LAUNCH_TTL_SECONDS = 5 * 60.0  # liveness.go:59 registration/launch timeout
+# Transient launch errors (throttle/timeout/API flake) retry per
+# reconcile, bounded: once the budget is spent the claim is given up the
+# same way an ICE is (deleted; pods re-schedule onto a fresh claim)
+MAX_LAUNCH_ATTEMPTS = 5
+LAUNCH_ATTEMPTS_ANNOTATION = "karpenter-tpu.sh/launch-attempts"
 
 
 class NodeClaimLifecycleController:
-    def __init__(self, store: ObjectStore, cloud: CloudProvider, clock: Clock, terminator=None):
+    def __init__(
+        self,
+        store: ObjectStore,
+        cloud: CloudProvider,
+        clock: Clock,
+        terminator=None,
+        unavailable=None,
+    ):
         self.store = store
         self.cloud = cloud
         self.clock = clock
@@ -36,6 +48,14 @@ class NodeClaimLifecycleController:
 
             terminator = NodeTerminationController(store, clock)
         self.terminator = terminator
+        # the shared unavailable-offerings blackout cache (Manager wires
+        # the same instance into the Provisioner); standalone harnesses
+        # get a private one so marking is always safe
+        if unavailable is None:
+            from karpenter_tpu.cloudprovider.unavailable import UnavailableOfferings
+
+            unavailable = UnavailableOfferings(clock)
+        self.unavailable = unavailable
 
     def reconcile(self, claim: NodeClaim) -> None:
         from karpenter_tpu.tracing.tracer import TRACER
@@ -82,11 +102,18 @@ class NodeClaimLifecycleController:
         try:
             self.cloud.create(claim)
         except errors.InsufficientCapacityError as e:
+            # the failed offerings enter the blackout cache FIRST, so the
+            # re-scheduled pods can't be solved straight back onto the
+            # same (instance type, zone, capacity type) for the TTL
+            # (reference pkg/providers ICE-cache parity)
+            self.unavailable.mark_from_error(e)
             # fail fast: delete the claim so pods re-schedule (launch.go:81)
             claim.conditions.set_false(COND_LAUNCHED, "InsufficientCapacity", str(e), self.clock.now())
             claim.metadata.finalizers = []
             self.store.delete(ObjectStore.NODECLAIMS, claim.name)
             return False
+        except errors.TransientError as e:
+            return self._transient_launch_failure(claim, e)
         except errors.NodeClassNotReadyError as e:
             return claim.conditions.set_false(
                 COND_LAUNCHED, "NodeClassNotReady", str(e), self.clock.now()
@@ -94,6 +121,38 @@ class NodeClaimLifecycleController:
         except errors.CreateError as e:
             return claim.conditions.set_false(COND_LAUNCHED, e.reason, str(e), self.clock.now())
         claim.conditions.set_true(COND_LAUNCHED, "Launched", now=self.clock.now())
+        return True
+
+    def _transient_launch_failure(self, claim: NodeClaim, err: Exception) -> bool:
+        """Bounded retry + requeue for retryable launch errors: the
+        attempt count rides a claim annotation (it must survive process
+        restarts like everything else about the claim); each failure
+        writes the claim back, whose MODIFIED event requeues the next
+        attempt. Budget exhausted -> give up exactly like an ICE."""
+        from karpenter_tpu.utils import metrics
+
+        metrics.TRANSIENT_RETRIES.inc(controller="nodeclaim.lifecycle")
+        attempts = int(claim.metadata.annotations.get(LAUNCH_ATTEMPTS_ANNOTATION, "0")) + 1
+        claim.metadata.annotations[LAUNCH_ATTEMPTS_ANNOTATION] = str(attempts)
+        if attempts >= MAX_LAUNCH_ATTEMPTS:
+            claim.conditions.set_false(
+                COND_LAUNCHED,
+                "TransientLaunchFailed",
+                f"gave up after {attempts} attempts: {err}",
+                self.clock.now(),
+            )
+            claim.metadata.finalizers = []
+            self.store.delete(ObjectStore.NODECLAIMS, claim.name)
+            return False
+        claim.conditions.set_false(
+            COND_LAUNCHED,
+            "TransientLaunchFailure",
+            f"attempt {attempts}/{MAX_LAUNCH_ATTEMPTS}: {err}",
+            self.clock.now(),
+        )
+        # the annotation changed even when the condition text didn't:
+        # report the object dirty so the write-back (and its requeueing
+        # MODIFIED event) always happens
         return True
 
     # -- registration (registration.go:59-206) --------------------------------
@@ -282,12 +341,6 @@ class NodeClaimLifecycleController:
                 claim.conditions.set_true(
                     COND_VOLUMES_DETACHED, "VolumesDetached", now=self.clock.now()
                 )
-        metrics.NODECLAIMS_TERMINATED.inc(
-            reason=claim.metadata.annotations.get(
-                "karpenter.sh/termination-reason", "deleted"
-            ),
-            nodepool=claim.metadata.labels.get(labels_mod.NODEPOOL_LABEL_KEY, ""),
-        )
         # then instance termination (the provider owns the node object in
         # simulated clouds); the store node is only force-dropped if the
         # provider had already lost the instance
@@ -296,6 +349,26 @@ class NodeClaimLifecycleController:
                 self.cloud.delete(claim)
         except errors.NodeClaimNotFoundError:
             pass  # instance already gone — finalizer can drop
+        except errors.TransientError as e:
+            # retryable (throttle/brownout): keep the finalizer and
+            # requeue — the instance MUST NOT leak because one delete
+            # call flaked (the reference retries until NotFound)
+            metrics.TRANSIENT_RETRIES.inc(controller="nodeclaim.lifecycle")
+            claim.conditions.set_unknown(
+                "InstanceTerminating",
+                "TransientDeleteFailure",
+                str(e),
+                self.clock.now(),
+            )
+            return
+        # terminated = the instance is actually gone (counted here, after
+        # the delete, so a transiently-failed finalize can't double-count)
+        metrics.NODECLAIMS_TERMINATED.inc(
+            reason=claim.metadata.annotations.get(
+                "karpenter.sh/termination-reason", "deleted"
+            ),
+            nodepool=claim.metadata.labels.get(labels_mod.NODEPOOL_LABEL_KEY, ""),
+        )
         node = self._node_for(claim)
         if node is not None:
             node.metadata.finalizers = []

@@ -47,11 +47,21 @@ class Provisioner:
         solver_endpoint: str = "",
         mesh_devices: int = 0,
         recorder=None,
+        unavailable=None,
     ):
         self.store = store
         self.cluster = cluster
         self.cloud = cloud
         self.clock = clock
+        # unavailable-offerings blackout cache (Manager shares one with
+        # the lifecycle controller); the catalog every scheduler build
+        # sees is filtered through it, so a just-ICE'd offering can't be
+        # re-picked until its TTL lapses
+        if unavailable is None:
+            from karpenter_tpu.cloudprovider.unavailable import UnavailableOfferings
+
+            unavailable = UnavailableOfferings(clock)
+        self.unavailable = unavailable
         self.ignore_preferences = ignore_preferences  # PreferencePolicy=Ignore
         self.reserved_capacity_enabled = reserved_capacity_enabled
         self.min_values_policy = min_values_policy
@@ -544,10 +554,15 @@ class Provisioner:
             return None
         from karpenter_tpu.cloudprovider.errors import instance_types_or_none
 
+        # blackout filter: offerings that just ICE'd leave the catalog for
+        # their TTL (expiries bump the generation, invalidating the cache
+        # below so the offerings come back without a pool event)
+        self.unavailable.prune()
         pool_catalogs = [
-            (p, its)
+            (p, filtered)
             for p in pools
             if (its := instance_types_or_none(self.cloud, p)) is not None
+            and (filtered := self.unavailable.filter_catalog(its))
         ]
         templates = build_templates(pool_catalogs)
         if not templates:
@@ -577,7 +592,7 @@ class Provisioner:
                 (ds.name, pod_content_sig(ds.as_pod()))
                 for ds in self.store.list(self.store.DAEMONSETS)
             )
-        )
+        ) + (("blackout_generation", self.unavailable.generation),)
         if self._scheduler_cache is not None and self._scheduler_cache[0] == sig:
             return self._scheduler_cache[1]
         templates = self._apply_daemon_overhead(templates)

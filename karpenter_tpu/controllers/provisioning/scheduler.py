@@ -557,13 +557,18 @@ class TPUScheduler:
         self._chunk_sink = chunk_sink
 
         def host_solve(reason: str) -> SchedulingResult:
-            from karpenter_tpu.utils.metrics import SOLVER_HOST_FALLBACKS
+            from karpenter_tpu.tracing.tracer import TRACER
+            from karpenter_tpu.utils.metrics import SOLVER_FALLBACK, SOLVER_HOST_FALLBACKS
 
             if chunk_sink is not None:
                 # any streamed chunks came from an abandoned device round;
                 # the consumer must discard them before the full result
                 chunk_sink(("reset", None))
             SOLVER_HOST_FALLBACKS.inc(reason=reason)
+            SOLVER_FALLBACK.inc(reason=reason)
+            cur = TRACER.current()
+            if cur is not None:
+                cur.set(host_fallback=reason)
             host = HostScheduler(
                 self.templates,
                 existing_nodes=[n.clone() for n in (existing_nodes or [])],
@@ -670,6 +675,21 @@ class TPUScheduler:
             # divergence re-solves the whole problem on the exact oracle
             # and records the event instead of failing provisioning
             return host_solve("divergence")
+        except Exception as err:  # noqa: BLE001 — the degradation ladder
+            # device dispatch / decode blowing up (an XLA abort, a device
+            # gone bad, an injected solver.dispatch fault) must not fail
+            # the provisioning loop: the host oracle is authoritative for
+            # the identical problem, so degrade THIS solve to it, logged
+            # and counted. A host-oracle failure propagates — there is no
+            # rung below the oracle.
+            from karpenter_tpu.utils.logging import get_logger
+
+            get_logger().with_values(controller="scheduler").warn(
+                "device solve failed; degrading to host oracle",
+                error=type(err).__name__,
+                detail=str(err)[:200],
+            )
+            return host_solve("device_dispatch")
         finally:
             self.reserved_mode = prev_mode
             self._chunk_sink = None
@@ -1387,6 +1407,11 @@ class TPUScheduler:
 
         import jax
 
+        from karpenter_tpu.faultinject import FAULT
+
+        # the chaos seam for the degradation ladder: an injected error
+        # here is indistinguishable from the device dying mid-solve
+        FAULT.point("solver.dispatch", pods=int(enc["P"]))
         profile_dir = os.environ.get("KTPU_PROFILE_DIR")
         ctx = (
             jax.profiler.trace(profile_dir)

@@ -1,0 +1,44 @@
+"""Deterministic, seeded fault injection for the control plane.
+
+The chaos-engineering counterpart of the reference's scripted fake
+provider errors (pkg/cloudprovider/fake) generalized into a subsystem:
+named fault points guard every hardened hot path (cloud launches, the
+SolveStream wire, device dispatch, apiserver writes), a seeded
+``FaultPlan`` decides which crossings break and how, and every injected
+fault is counted (``ktpu_fault_injections_total``) and stamped onto the
+live trace. ``tests/test_faults.py`` drives the seeded chaos scenarios;
+``KTPU_FAULT_PLAN`` activates a plan in any entrypoint.
+
+Registered fault points (grep ``FAULT.point`` for the live list):
+
+=====================  ====================================================
+``cloud.create``       provider launch, after offering resolution (the ctx
+                       carries instance_type/zone/capacity_type so an
+                       injected ICE blackouts the real offering)
+``cloud.delete``       provider instance termination
+``rpc.solve.send``     client-side, before a Solve/SolveStream crossing
+``rpc.stream.chunk``   client-side, per received chunk frame (``index``)
+``solver.dispatch``    top of the device dispatch inside a solve
+``api.create``         ObjectStore.create (apiserver POST analog)
+``api.patch``          ObjectStore.update (apiserver PATCH analog)
+``api.delete``         ObjectStore.delete (apiserver DELETE analog)
+=====================  ====================================================
+"""
+
+from karpenter_tpu.faultinject.injector import FAULT, FaultInjector, active_plan
+from karpenter_tpu.faultinject.plan import (
+    ENV_FAULT_PLAN,
+    FaultPlan,
+    FaultRule,
+    make_error,
+)
+
+__all__ = [
+    "FAULT",
+    "FaultInjector",
+    "FaultPlan",
+    "FaultRule",
+    "ENV_FAULT_PLAN",
+    "active_plan",
+    "make_error",
+]

@@ -1,0 +1,150 @@
+"""The process-global fault injector.
+
+Design constraints mirror the tracer (tracing/tracer.py), in order:
+
+- ~zero cost when disabled (the default): ``FAULT.point(...)`` is one
+  attribute check and an immediate return — no allocation, no lock, no
+  rule walk. The chaos suite pins this with the same bar as the
+  disabled-tracer gate.
+- deterministic when enabled: rule eligibility (``p``) draws from the
+  PLAN's seeded RNG under a lock, so a given (plan, call sequence) pair
+  always injects the same faults.
+- observable: every fire counts into ``ktpu_fault_injections_total``
+  {point, mode} and stamps ``fault_point`` / ``fault_mode`` attrs on the
+  live trace span, so injected faults are visible in ``/debug/traces``
+  next to the stage they broke.
+
+Activation: ``FAULT.activate(plan)`` / ``FAULT.deactivate()`` directly,
+the ``active_plan`` context manager in tests, or the ``KTPU_FAULT_PLAN``
+env var (read once when this module first loads — every guarded module
+imports it, so ``python -m ...`` entrypoints need no wiring).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+from karpenter_tpu.faultinject.plan import FaultPlan, FaultRule, make_error
+
+
+class FaultInjector:
+    def __init__(self):
+        self.enabled = False
+        self._plan: Optional[FaultPlan] = None
+        self._rng = None
+        self._lock = threading.Lock()
+        self.counters: dict[tuple[str, str], int] = {}  # (point, mode) -> fires
+        self._env_checked = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def activate(self, plan: FaultPlan) -> None:
+        with self._lock:
+            for rule in plan.rules:
+                rule.reset()
+            self._plan = plan
+            self._rng = plan.rng()
+            self.counters = {}
+            self.enabled = True
+
+    def deactivate(self) -> None:
+        with self._lock:
+            self.enabled = False
+            self._plan = None
+            self._rng = None
+
+    def maybe_activate_from_env(self) -> bool:
+        """One-shot env activation (KTPU_FAULT_PLAN); idempotent."""
+        if self._env_checked:
+            return self.enabled
+        self._env_checked = True
+        plan = FaultPlan.from_env()
+        if plan is not None:
+            self.activate(plan)
+        return self.enabled
+
+    # -- the guard ---------------------------------------------------------
+
+    def point(self, name: str, /, **ctx) -> None:
+        """The fault point every hardened path guards with. Disabled is
+        the hot path: one attribute check, immediate return. ``name`` is
+        positional-only so ctx kwargs can use any key (including "name",
+        e.g. the apiserver seams' object name)."""
+        if not self.enabled:
+            return
+        self._fire(name, ctx)
+
+    def _fire(self, name: str, ctx: dict) -> None:
+        rule = None
+        with self._lock:
+            plan = self._plan
+            if plan is None:
+                return
+            for r in plan.rules:
+                if not r.matches(name, ctx):
+                    continue
+                r.hits += 1
+                if r.hits <= r.skip:
+                    continue
+                if r.times is not None and r.fires >= r.times:
+                    continue
+                if r.p < 1.0 and self._rng.random() >= r.p:
+                    continue
+                r.fires += 1
+                key = (name, r.mode)
+                self.counters[key] = self.counters.get(key, 0) + 1
+                rule = r
+                break  # first eligible rule wins
+        if rule is None:
+            return
+        self._record(name, rule)
+        if rule.mode == "latency":
+            time.sleep(rule.delay_s)
+            return
+        raise make_error(rule.error, rule.message or f"injected fault at {name}")
+
+    @staticmethod
+    def _record(name: str, rule: FaultRule) -> None:
+        """Metric + trace-span visibility for one fire (outside the plan
+        lock: metrics/tracer take their own)."""
+        from karpenter_tpu.utils.metrics import FAULT_INJECTIONS
+
+        FAULT_INJECTIONS.inc(point=name, mode=rule.mode)
+        from karpenter_tpu.tracing.tracer import TRACER
+
+        cur = TRACER.current()
+        if cur is not None:
+            cur.set(fault_point=name, fault_mode=rule.mode)
+
+    # -- readout -----------------------------------------------------------
+
+    def fires(self, point: Optional[str] = None) -> int:
+        with self._lock:
+            return sum(
+                n for (p, _), n in self.counters.items() if point is None or p == point
+            )
+
+
+# the process-global injector every guarded site imports
+FAULT = FaultInjector()
+FAULT.maybe_activate_from_env()
+
+
+@contextmanager
+def active_plan(plan_or_spec):
+    """Test fixture: activate a plan (FaultPlan, dict, or JSON string)
+    for the block, deactivating on exit even when the block raises."""
+    if isinstance(plan_or_spec, str):
+        plan = FaultPlan.from_json(plan_or_spec)
+    elif isinstance(plan_or_spec, dict):
+        plan = FaultPlan.from_dict(plan_or_spec)
+    else:
+        plan = plan_or_spec
+    FAULT.activate(plan)
+    try:
+        yield FAULT
+    finally:
+        FAULT.deactivate()

@@ -1,0 +1,145 @@
+"""Fault plans: what to break, where, and how often.
+
+A ``FaultPlan`` is a seeded list of ``FaultRule``s. Each rule names a
+fault point (``cloud.create``, ``rpc.stream.chunk``, ``solver.dispatch``,
+``api.patch``, ...), a mode, and activation gates:
+
+- ``mode="error"``  raises a typed exception (``error`` picks the kind
+  from the taxonomy below) at the guarded call site;
+- ``mode="latency"`` sleeps ``delay_s`` and lets the call proceed — the
+  slow-dependency half of chaos testing;
+- repetition rides ``times`` (total fires) / ``skip`` (hits to let pass
+  first) / ``p`` (per-hit probability under the PLAN's seeded RNG), so a
+  scripted storm like "fail the first 3 launches" or a statistical one
+  like "30% of chunk frames" are both one rule.
+
+``match`` filters on the call-site context kwargs by equality
+(``{"point": "rpc.stream.chunk", "match": {"index": 2}}`` cuts the
+stream at exactly chunk 2), and the point name itself accepts
+``fnmatch`` globs (``cloud.*``).
+
+Determinism: the plan owns one ``random.Random(seed)``; two activations
+of the same plan against the same call sequence inject the same faults.
+That is the property the chaos e2e suite leans on — a faulted run is
+reproducible from (plan JSON, workload), no flake hunting.
+
+Error taxonomy (``error`` kinds):
+
+====================  =====================================================
+``transient``         cloudprovider.errors.TransientError (retryable)
+``throttle``          cloudprovider.errors.ThrottleError (retryable)
+``timeout``           cloudprovider.errors.CloudTimeoutError (retryable)
+``ice``               cloudprovider.errors.InsufficientCapacityError
+``terminal``          cloudprovider.errors.TerminalError
+``runtime``           RuntimeError (an unclassified crash, e.g. a device
+                      dispatch blowing up mid-solve)
+``unavailable``       a grpc.RpcError with code UNAVAILABLE (transport cut)
+``exhausted``         a grpc.RpcError with code RESOURCE_EXHAUSTED
+====================  =====================================================
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+import random
+from dataclasses import dataclass, field
+from typing import Optional
+
+ENV_FAULT_PLAN = "KTPU_FAULT_PLAN"
+
+
+def make_error(kind: str, message: str) -> Exception:
+    """Resolve an ``error`` kind to an exception instance (lazy imports:
+    the plan module must stay importable from anywhere without dragging
+    in grpc or the provider stack)."""
+    from karpenter_tpu.cloudprovider import errors as cpe
+
+    if kind == "transient":
+        return cpe.TransientError(message)
+    if kind == "throttle":
+        return cpe.ThrottleError(message)
+    if kind == "timeout":
+        return cpe.CloudTimeoutError(message)
+    if kind == "ice":
+        return cpe.InsufficientCapacityError(message)
+    if kind == "terminal":
+        return cpe.TerminalError(message)
+    if kind == "runtime":
+        return RuntimeError(message)
+    if kind in ("unavailable", "exhausted"):
+        from karpenter_tpu.rpc.retry import injected_rpc_error
+
+        return injected_rpc_error(kind, message)
+    raise ValueError(f"unknown fault error kind {kind!r}")
+
+
+@dataclass
+class FaultRule:
+    point: str
+    mode: str = "error"  # error | latency
+    error: str = "transient"
+    p: float = 1.0
+    times: Optional[int] = None  # total fires allowed; None = unlimited
+    skip: int = 0  # matching hits to let pass before becoming eligible
+    delay_s: float = 0.0
+    match: dict = field(default_factory=dict)
+    message: str = ""
+    # runtime state (reset on plan activation)
+    hits: int = 0
+    fires: int = 0
+
+    def matches(self, name: str, ctx: dict) -> bool:
+        if name != self.point and not fnmatch.fnmatchcase(name, self.point):
+            return False
+        return all(ctx.get(k) == v for k, v in self.match.items())
+
+    def reset(self) -> None:
+        self.hits = 0
+        self.fires = 0
+
+
+@dataclass
+class FaultPlan:
+    rules: list[FaultRule] = field(default_factory=list)
+    seed: int = 0
+
+    @classmethod
+    def from_dict(cls, spec: dict) -> "FaultPlan":
+        rules = [
+            FaultRule(
+                point=r["point"],
+                mode=r.get("mode", "error"),
+                error=r.get("error", "transient"),
+                p=float(r.get("p", 1.0)),
+                times=r.get("times"),
+                skip=int(r.get("skip", 0)),
+                delay_s=float(r.get("delay_s", 0.0)),
+                match=dict(r.get("match", {})),
+                message=r.get("message", ""),
+            )
+            for r in spec.get("rules", ())
+        ]
+        return cls(rules=rules, seed=int(spec.get("seed", 0)))
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        return cls.from_dict(json.loads(text))
+
+    @classmethod
+    def from_env(cls) -> Optional["FaultPlan"]:
+        """KTPU_FAULT_PLAN: inline JSON, or a path to a JSON file (bare
+        path or ``@path``). Empty/unset means no plan."""
+        raw = os.environ.get(ENV_FAULT_PLAN, "").strip()
+        if not raw:
+            return None
+        if raw.startswith("@"):
+            raw = raw[1:]
+        if not raw.lstrip().startswith("{"):
+            with open(raw) as f:
+                raw = f.read()
+        return cls.from_json(raw)
+
+    def rng(self) -> random.Random:
+        return random.Random(self.seed)

@@ -22,6 +22,7 @@ Split of labor:
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Optional, Sequence
 
@@ -32,11 +33,23 @@ from karpenter_tpu.controllers.provisioning.host_scheduler import (
     normalize_volume_reqs,
 )
 from karpenter_tpu.controllers.provisioning.nodeclaimtemplate import ClaimTemplate
+from karpenter_tpu.faultinject import FAULT
 from karpenter_tpu.models.pod import Pod
 from karpenter_tpu.rpc import solver_pb2 as pb
 from karpenter_tpu.rpc import convert
 from karpenter_tpu.rpc.codec import encode_templates
-from karpenter_tpu.rpc.service import SERVICE_NAME
+from karpenter_tpu.rpc.retry import (
+    Backoff,
+    CircuitBreaker,
+    CircuitOpenError,
+    is_transient_code,
+)
+from karpenter_tpu.rpc.service import (
+    FRAME_CHUNK,
+    FRAME_FINAL_FULL,
+    FRAME_RESET,
+    SERVICE_NAME,
+)
 
 _RPC_OPTIONS = [
     ("grpc.max_receive_message_length", 256 * 1024 * 1024),
@@ -55,6 +68,110 @@ RECONFIGURE_RETRIES = 3
 HEALTH_TIMEOUT_SECONDS = 10.0
 SOLVE_COMPILE_SLACK_SECONDS = 600.0
 DEFAULT_SOLVE_BUDGET_SECONDS = 600.0
+# Transport hardening (all env-tunable; tests shrink the backoff):
+# transient codes (UNAVAILABLE/RESOURCE_EXHAUSTED/ABORTED) retry with
+# exponential backoff + jitter; after STREAM_RETRIES mid-stream failures
+# the call downgrades to unary Solve for its remaining attempts (the
+# chunk stitcher restarts clean either way — accumulated frames from a
+# broken attempt never leak into the retry).
+TRANSPORT_RETRIES = int(os.environ.get("KTPU_RPC_RETRIES", "3"))
+STREAM_RETRIES = int(os.environ.get("KTPU_RPC_STREAM_RETRIES", "2"))
+RETRY_BASE_SECONDS = float(os.environ.get("KTPU_RPC_RETRY_BASE", "0.2"))
+RETRY_CAP_SECONDS = float(os.environ.get("KTPU_RPC_RETRY_CAP", "10.0"))
+BREAKER_THRESHOLD = int(os.environ.get("KTPU_RPC_BREAKER_THRESHOLD", "5"))
+BREAKER_COOLDOWN_SECONDS = float(os.environ.get("KTPU_RPC_BREAKER_COOLDOWN", "15.0"))
+
+# per-target circuit breakers: every RemoteScheduler against the same
+# endpoint shares one breaker, so a down solver is tripped once, not once
+# per scheduler-cache rebuild
+_BREAKERS: dict[str, CircuitBreaker] = {}
+
+
+def _breaker_for(endpoint: str) -> CircuitBreaker:
+    breaker = _BREAKERS.get(endpoint)
+    if breaker is None:
+
+        def on_transition(to: str) -> None:
+            from karpenter_tpu.utils.metrics import CIRCUIT_TRANSITIONS
+
+            CIRCUIT_TRANSITIONS.inc(target=endpoint, to=to)
+
+        breaker = CircuitBreaker(
+            failure_threshold=BREAKER_THRESHOLD,
+            cooldown_s=BREAKER_COOLDOWN_SECONDS,
+            on_transition=on_transition,
+        )
+        _BREAKERS[endpoint] = breaker
+    return breaker
+
+
+def reset_breakers() -> None:
+    """Drop all per-target breaker state (tests)."""
+    _BREAKERS.clear()
+
+
+class StreamStitcher:
+    """The SolveStream chunk-stitching state machine, extracted so the
+    out-of-order/stale-frame behavior is unit-testable without sockets.
+
+    Frames carry a server-side ROUND (service.py framing): a reset frame
+    advances the live round and discards accumulated tables; a chunk
+    frame whose round differs from the live one is STALE — it belongs to
+    a relaxation round (or a cut stream's abandoned attempt) that a reset
+    already invalidated — and must be dropped, not stitched."""
+
+    def __init__(self):
+        self.claims: dict[int, list[str]] = {}
+        self.exist: list[tuple[str, str]] = []
+        self.unsched: list[tuple[str, str]] = []
+        self.round = 0
+        self.n_frames = self.n_chunks = self.n_resets = self.n_stale = 0
+        self.final = None
+        self.full = False
+
+    def feed(self, frame: bytes) -> bool:
+        """Consume one frame; True once the final frame landed."""
+        self.n_frames += 1
+        tag = frame[:1]
+        if tag == FRAME_RESET:
+            self.n_resets += 1
+            self.round = int.from_bytes(frame[1:5], "big")
+            self.claims.clear()
+            self.exist.clear()
+            self.unsched.clear()
+        elif tag == FRAME_CHUNK:
+            round_no = int.from_bytes(frame[1:5], "big")
+            if round_no != self.round:
+                self.n_stale += 1
+                from karpenter_tpu.utils.metrics import STREAM_STALE_FRAMES
+
+                STREAM_STALE_FRAMES.inc()
+                return False
+            self.n_chunks += 1
+            part = pb.SolveResponse.FromString(bytes(frame[5:]))
+            for m in part.claims:
+                self.claims.setdefault(m.slot, []).extend(m.pod_uids)
+            for a in part.existing_assignments:
+                self.exist.append((a.pod_uid, a.node_name))
+            for u in part.unschedulable:
+                self.unsched.append((u.pod_uid, u.reason))
+        else:  # FINAL_SLIM / FINAL_FULL
+            self.final = pb.SolveResponse.FromString(bytes(frame[1:]))
+            self.full = tag == FRAME_FINAL_FULL
+            return True
+        return False
+
+    def tables(self) -> dict:
+        return {"claims": self.claims, "existing": self.exist, "unsched": self.unsched}
+
+    def stats(self) -> dict:
+        return {
+            "frames": self.n_frames,
+            "chunks": self.n_chunks,
+            "resets": self.n_resets,
+            "stale": self.n_stale,
+            "full": self.full,
+        }
 
 
 class RemoteScheduler:
@@ -121,19 +238,22 @@ class RemoteScheduler:
         self._health = timed_stub("Health", pb.HealthRequest, pb.HealthResponse)
         # streaming Solve: per-chunk partial tables arrive while the
         # server's pipelined decode still works on later chunks. Frames
-        # are hand-framed bytes (tag + SolveResponse payload) so the
-        # deserializer is the identity. Preferred by default; one
+        # are hand-framed bytes (tag [+ round] + SolveResponse payload)
+        # so the deserializer is the identity. Preferred by default; one
         # UNIMPLEMENTED (older server) downgrades to unary for the
         # channel's lifetime. KTPU_RPC_STREAM=0 opts out.
-        import os as _os
-
         self._solve_stream = self._channel.unary_stream(
             f"/{SERVICE_NAME}/SolveStream",
             request_serializer=pb.SolveRequest.SerializeToString,
             response_deserializer=lambda b: b,
         )
-        self._stream_ok = _os.environ.get("KTPU_RPC_STREAM", "1") != "0"
+        self._stream_ok = os.environ.get("KTPU_RPC_STREAM", "1") != "0"
         self.last_stream: dict = {}
+        # transport hardening: per-target breaker + jittered backoff (the
+        # RNG is fresh per scheduler; seed via rpc.retry.Backoff in tests)
+        self._endpoint = endpoint or "in-process"
+        self._breaker = _breaker_for(self._endpoint)
+        self._backoff = Backoff(base_s=RETRY_BASE_SECONDS, cap_s=RETRY_CAP_SECONDS)
         req = pb.ConfigureRequest(
             templates_json=encode_templates(templates),
             reserved_mode=reserved_mode,
@@ -160,26 +280,19 @@ class RemoteScheduler:
         return self._health(pb.HealthRequest(), timeout=HEALTH_TIMEOUT_SECONDS)
 
     def _consume_stream(self, req, rpc_timeout: float):
-        """Drive one SolveStream call to completion: accumulate the
-        ordered per-pod tables from chunk frames (a reset frame discards
-        them — a relaxation round or host fallback restarted the solve)
-        and return (final SolveResponse, accumulated tables or None when
-        the final frame was FULL). Tracing metadata and the RPC duration
-        histogram mirror the unary stub."""
-        from karpenter_tpu.rpc.service import (
-            FRAME_CHUNK,
-            FRAME_FINAL_FULL,
-            FRAME_RESET,
-        )
+        """Drive one SolveStream call to completion through a fresh
+        StreamStitcher (a reset frame discards accumulated tables — a
+        relaxation round or host fallback restarted the solve; a stale
+        chunk from a superseded round is dropped) and return (final
+        SolveResponse, accumulated tables or None when the final frame
+        was FULL). The stitcher is LOCAL to the call: a mid-stream
+        failure abandons it wholesale, so a transport retry can never
+        stitch frames from a broken attempt. Tracing metadata and the
+        RPC duration histogram mirror the unary stub."""
         from karpenter_tpu.tracing.tracer import TRACER
         from karpenter_tpu.utils.metrics import SOLVER_RPC_DURATION
 
-        claims: dict[int, list[str]] = {}
-        exist: list[tuple[str, str]] = []
-        unsched: list[tuple[str, str]] = []
-        final = None
-        full = False
-        n_frames = n_chunks = n_resets = 0
+        stitcher = StreamStitcher()
         with TRACER.span("rpc.SolveStream"):
             kwargs: dict = {"timeout": rpc_timeout}
             ctx = TRACER.context()
@@ -190,36 +303,71 @@ class RemoteScheduler:
                 ]
             with SOLVER_RPC_DURATION.time(method="SolveStream"):
                 for frame in self._solve_stream(req, **kwargs):
-                    n_frames += 1
-                    tag, payload = frame[:1], bytes(frame[1:])
-                    if tag == FRAME_RESET:
-                        n_resets += 1
-                        claims.clear()
-                        exist.clear()
-                        unsched.clear()
-                    elif tag == FRAME_CHUNK:
-                        n_chunks += 1
-                        part = pb.SolveResponse.FromString(payload)
-                        for m in part.claims:
-                            claims.setdefault(m.slot, []).extend(m.pod_uids)
-                        for a in part.existing_assignments:
-                            exist.append((a.pod_uid, a.node_name))
-                        for u in part.unschedulable:
-                            unsched.append((u.pod_uid, u.reason))
-                    else:  # FINAL_SLIM / FINAL_FULL
-                        final = pb.SolveResponse.FromString(payload)
-                        full = tag == FRAME_FINAL_FULL
-        if final is None:
+                    # the mid-stream cut point: an injected UNAVAILABLE
+                    # here simulates the transport dying at chunk <index>
+                    FAULT.point("rpc.stream.chunk", index=stitcher.n_chunks)
+                    if stitcher.feed(frame):
+                        break
+        if stitcher.final is None:
             raise RuntimeError("SolveStream ended without a final frame")
-        self.last_stream = {
-            "frames": n_frames,
-            "chunks": n_chunks,
-            "resets": n_resets,
-            "full": full,
-        }
-        if full:
-            return final, None
-        return final, {"claims": claims, "existing": exist, "unsched": unsched}
+        self.last_stream = stitcher.stats()
+        if stitcher.full:
+            return stitcher.final, None
+        return stitcher.final, stitcher.tables()
+
+    def _transport_solve(self, req, rpc_timeout: float):
+        """One hardened Solve crossing: stream-first with mid-stream
+        recovery (reconnect and re-solve from scratch; after
+        STREAM_RETRIES stream failures the call downgrades to unary for
+        its remaining attempts), transient-code retry with jittered
+        backoff, and per-target circuit-breaker accounting. Non-transient
+        codes (FAILED_PRECONDITION included — the caller's re-Configure
+        loop owns that) raise through untouched."""
+        from karpenter_tpu.utils.metrics import STREAM_RECOVERIES
+
+        stream_failures = 0
+        for attempt in range(TRANSPORT_RETRIES + 1):
+            if not self._breaker.allow():
+                raise CircuitOpenError(
+                    f"solver {self._endpoint} circuit open"
+                    f" (cooling down after repeated transport failures)"
+                )
+            use_stream = self._stream_ok and stream_failures < STREAM_RETRIES
+            try:
+                FAULT.point(
+                    "rpc.solve.send",
+                    method="SolveStream" if use_stream else "Solve",
+                    attempt=attempt,
+                )
+                if use_stream:
+                    try:
+                        out = self._consume_stream(req, rpc_timeout)
+                    except grpc.RpcError as err:
+                        if err.code() != grpc.StatusCode.UNIMPLEMENTED:
+                            raise
+                        # older server without the SolveStream handler:
+                        # permanent downgrade to the unary path
+                        self._stream_ok = False
+                        out = self._solve(req, timeout=rpc_timeout), None
+                else:
+                    out = self._solve(req, timeout=rpc_timeout), None
+                self._breaker.record_success()
+                if stream_failures:
+                    STREAM_RECOVERIES.inc(
+                        outcome="resumed" if use_stream else "downgraded_unary"
+                    )
+                return out
+            except grpc.RpcError as err:
+                if not is_transient_code(err):
+                    raise
+                self._breaker.record_failure()
+                if use_stream:
+                    stream_failures += 1
+                if attempt >= TRANSPORT_RETRIES:
+                    if stream_failures:
+                        STREAM_RECOVERIES.inc(outcome="exhausted")
+                    raise
+                time.sleep(self._backoff.delay(attempt))
 
     # -- the TPUScheduler surface -----------------------------------------
 
@@ -292,18 +440,7 @@ class RemoteScheduler:
         stream_acc = None
         for attempt in range(RECONFIGURE_RETRIES + 1):
             try:
-                if self._stream_ok:
-                    try:
-                        resp, stream_acc = self._consume_stream(req, rpc_timeout)
-                    except grpc.RpcError as err:
-                        if err.code() != grpc.StatusCode.UNIMPLEMENTED:
-                            raise
-                        # older server without the SolveStream handler:
-                        # permanent downgrade to the unary path
-                        self._stream_ok = False
-                        resp, stream_acc = self._solve(req, timeout=rpc_timeout), None
-                else:
-                    resp, stream_acc = self._solve(req, timeout=rpc_timeout), None
+                resp, stream_acc = self._transport_solve(req, rpc_timeout)
                 break
             except grpc.RpcError as err:
                 if (
@@ -355,9 +492,10 @@ class RemoteScheduler:
             # bytes mean the server predates field 11 and SILENTLY solved
             # without any allocator — fall back to the local host engine
             # rather than placing claim pods with no device constraints
-            from karpenter_tpu.utils.metrics import SOLVER_HOST_FALLBACKS
+            from karpenter_tpu.utils.metrics import SOLVER_FALLBACK, SOLVER_HOST_FALLBACKS
 
             SOLVER_HOST_FALLBACKS.inc(reason="dra_server_predates")
+            SOLVER_FALLBACK.inc(reason="dra_server_predates")
             from karpenter_tpu.controllers.provisioning.host_scheduler import (
                 HostScheduler,
             )
@@ -444,6 +582,15 @@ class RemoteScheduler:
                 if err.code() == grpc.StatusCode.UNIMPLEMENTED:
                     # older solver without the WhatIf handler: sequential
                     # fallback, exactly the pre-RPC behavior
+                    return None
+                if is_transient_code(err):
+                    # what-ifs are an optimization — a flaky wire degrades
+                    # to the sequential-simulate path instead of failing
+                    # the consolidation pass (the breaker still learns)
+                    self._breaker.record_failure()
+                    from karpenter_tpu.utils.metrics import SOLVER_FALLBACK
+
+                    SOLVER_FALLBACK.inc(reason="whatif_transport")
                     return None
                 if (
                     err.code() != grpc.StatusCode.FAILED_PRECONDITION

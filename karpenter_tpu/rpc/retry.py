@@ -1,0 +1,158 @@
+"""Retry/backoff and circuit-breaking for the control->solver wire.
+
+Pure mechanisms — no sockets, no globals — so the math is unit-testable
+(tests/test_retry.py) and the RemoteScheduler composes them:
+
+- ``Backoff``: exponential with multiplicative jitter, capped. The
+  jitter draws from an injectable ``random.Random`` so a seeded RNG
+  yields a deterministic delay sequence (the chaos suite's
+  reproducibility contract extends to retry timing).
+- ``CircuitBreaker``: closed -> open after N consecutive failures; open
+  fails fast (no hammering a down solver from the provisioning loop)
+  until the cooldown elapses; half-open admits one probe; a probe
+  success closes, a probe failure re-opens. The clock is an injectable
+  ``now()`` so transitions are testable without sleeping.
+
+``injected_rpc_error`` manufactures grpc.RpcError-compatible errors for
+the fault injector ("unavailable" / "exhausted" kinds): the client's
+transient-code classification treats them exactly like a real transport
+failure, which is the point.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional
+
+import grpc
+
+# codes worth a client-side retry: the request never ran to completion
+# (transport cut, server overload, racing cancellation). NOT here:
+# DEADLINE_EXCEEDED (the budget is spent — retrying overdrafts it) and
+# FAILED_PRECONDITION (the re-Configure loop owns that).
+TRANSIENT_CODES = frozenset(
+    {
+        grpc.StatusCode.UNAVAILABLE,
+        grpc.StatusCode.RESOURCE_EXHAUSTED,
+        grpc.StatusCode.ABORTED,
+    }
+)
+
+
+def is_transient_code(err: Exception) -> bool:
+    return isinstance(err, grpc.RpcError) and err.code() in TRANSIENT_CODES
+
+
+class InjectedRpcError(grpc.RpcError):
+    """A grpc.RpcError the fault injector can raise from client-side
+    fault points; carries just the surface the client consults."""
+
+    def __init__(self, code: grpc.StatusCode, message: str):
+        super().__init__(message)
+        self._code = code
+        self._message = message
+
+    def code(self) -> grpc.StatusCode:
+        return self._code
+
+    def details(self) -> str:
+        return self._message
+
+
+def injected_rpc_error(kind: str, message: str) -> InjectedRpcError:
+    code = {
+        "unavailable": grpc.StatusCode.UNAVAILABLE,
+        "exhausted": grpc.StatusCode.RESOURCE_EXHAUSTED,
+    }[kind]
+    return InjectedRpcError(code, message)
+
+
+class Backoff:
+    """delay(attempt) = min(base * multiplier**attempt, cap) scaled into
+    [1 - jitter_frac, 1] by the RNG — full-jitter-style spreading that
+    never exceeds the deterministic ceiling, so cap math stays exact."""
+
+    def __init__(
+        self,
+        base_s: float = 0.2,
+        cap_s: float = 30.0,
+        multiplier: float = 2.0,
+        jitter_frac: float = 0.5,
+        rng: Optional[random.Random] = None,
+    ):
+        if not 0.0 <= jitter_frac <= 1.0:
+            raise ValueError(f"jitter_frac {jitter_frac} outside [0, 1]")
+        self.base_s = base_s
+        self.cap_s = cap_s
+        self.multiplier = multiplier
+        self.jitter_frac = jitter_frac
+        self._rng = rng or random.Random()
+
+    def ceiling(self, attempt: int) -> float:
+        return min(self.base_s * self.multiplier**attempt, self.cap_s)
+
+    def delay(self, attempt: int) -> float:
+        raw = self.ceiling(attempt)
+        if not self.jitter_frac:
+            return raw
+        return raw * (1.0 - self.jitter_frac * self._rng.random())
+
+
+class CircuitBreaker:
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(
+        self,
+        failure_threshold: int = 5,
+        cooldown_s: float = 30.0,
+        now: Callable[[], float] = time.monotonic,
+        on_transition: Optional[Callable[[str], None]] = None,
+    ):
+        self.failure_threshold = failure_threshold
+        self.cooldown_s = cooldown_s
+        self._now = now
+        self._on_transition = on_transition
+        self.state = self.CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+
+    def _transition(self, to: str) -> None:
+        if self.state == to:
+            return
+        self.state = to
+        if self._on_transition is not None:
+            self._on_transition(to)
+
+    def allow(self) -> bool:
+        """May a call proceed right now? An open breaker past its
+        cooldown moves to half-open and admits the probe."""
+        if self.state == self.CLOSED:
+            return True
+        if self.state == self.OPEN:
+            if self._now() - self._opened_at >= self.cooldown_s:
+                self._transition(self.HALF_OPEN)
+                return True
+            return False
+        return True  # half-open: the probe is in flight
+
+    def record_success(self) -> None:
+        self._failures = 0
+        self._transition(self.CLOSED)
+
+    def record_failure(self) -> None:
+        if self.state == self.HALF_OPEN:
+            # the probe failed: straight back to open, fresh cooldown
+            self._opened_at = self._now()
+            self._transition(self.OPEN)
+            return
+        self._failures += 1
+        if self._failures >= self.failure_threshold:
+            self._opened_at = self._now()
+            self._transition(self.OPEN)
+
+
+class CircuitOpenError(ConnectionError):
+    """Raised instead of dialing when the target's breaker is open."""

@@ -28,14 +28,22 @@ from karpenter_tpu.rpc.codec import decode_templates
 SERVICE_NAME = "karpenter_tpu.solver.v1.Solver"
 
 # SolveStream frame tags. The stream is hand-framed: each item is one tag
-# byte + (for chunk/final frames) SolveResponse bytes. Reusing the
-# existing message keeps the frozen protoc-generated pb2 module untouched
-# (no protoc in this image) while letting per-chunk partial results cross
-# the wire as the server's pipelined decode produces them.
-FRAME_CHUNK = b"\x01"  # partial per-pod tables from one decoded chunk group
+# byte + (for chunk/reset frames) a 4-byte big-endian ROUND + (for
+# chunk/final frames) SolveResponse bytes. Reusing the existing message
+# keeps the frozen protoc-generated pb2 module untouched (no protoc in
+# this image) while letting per-chunk partial results cross the wire as
+# the server's pipelined decode produces them. The round tag makes the
+# client's stitching state machine robust to stale frames: a chunk whose
+# round predates the last reset is discarded, never stitched (the
+# mid-stream-recovery hazard — see rpc/client.StreamStitcher).
+FRAME_CHUNK = b"\x01"  # round + partial per-pod tables from one chunk group
 FRAME_FINAL_SLIM = b"\x02"  # final response MINUS the already-streamed tables
-FRAME_RESET = b"\x03"  # a relaxation round / fallback invalidated the chunks
+FRAME_RESET = b"\x03"  # round; a relaxation round/fallback invalidated chunks
 FRAME_FINAL_FULL = b"\x04"  # complete response (nothing was streamed)
+
+
+def _round_bytes(round_no: int) -> bytes:
+    return round_no.to_bytes(4, "big")
 
 
 def _chunk_to_pb(delta: dict) -> pb.SolveResponse:
@@ -143,17 +151,23 @@ class SolverService:
         sched = self._checked_scheduler(request, context)
         frames: queue.Queue = queue.Queue()
         streamed = [False]  # chunks emitted since the last reset
+        round_no = [0]  # bumps with every EMITTED reset frame
         _DONE = object()
 
         def sink(event) -> None:
             kind, delta = event
             if kind == "reset":
                 if streamed[0]:
-                    frames.put(FRAME_RESET)
+                    round_no[0] += 1
+                    frames.put(FRAME_RESET + _round_bytes(round_no[0]))
                 streamed[0] = False
             else:
                 streamed[0] = True
-                frames.put(FRAME_CHUNK + _chunk_to_pb(delta).SerializeToString())
+                frames.put(
+                    FRAME_CHUNK
+                    + _round_bytes(round_no[0])
+                    + _chunk_to_pb(delta).SerializeToString()
+                )
 
         # the solve runs in a worker so the handler thread can yield chunk
         # frames while the decode is still producing later ones

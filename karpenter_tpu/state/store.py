@@ -16,6 +16,7 @@ import enum
 from collections import defaultdict
 from typing import Callable, Iterable, Optional, TypeVar
 
+from karpenter_tpu.faultinject import FAULT
 from karpenter_tpu.utils.clock import Clock
 
 T = TypeVar("T")
@@ -63,6 +64,10 @@ class ObjectStore:
 
     def create(self, kind: str, obj) -> object:
         name = obj.metadata.name
+        # apiserver fault seams: fired BEFORE any mutation, so an injected
+        # "API error" is atomic — a failed write leaves no partial state
+        # (exactly what a real 429/503 from the apiserver guarantees)
+        FAULT.point("api.create", kind=kind, name=name)
         if name in self._buckets[kind]:
             raise ValueError(f"{kind}/{name} already exists")
         self._rv += 1
@@ -77,6 +82,7 @@ class ObjectStore:
 
     def update(self, kind: str, obj) -> object:
         name = obj.metadata.name
+        FAULT.point("api.patch", kind=kind, name=name)
         if name not in self._buckets[kind]:
             raise KeyError(f"{kind}/{name} not found")
         self._rv += 1
@@ -97,6 +103,7 @@ class ObjectStore:
         """Graceful delete: stamps deletion_timestamp; object is removed only
         once no finalizers remain (Kubernetes semantics the reference's
         termination flows depend on)."""
+        FAULT.point("api.delete", kind=kind, name=name)
         obj = self._buckets[kind].get(name)
         if obj is None:
             return False

@@ -362,3 +362,42 @@ NODECLAIM_TERMINATION_DURATION = REGISTRY.histogram(
     "NodeClaim deletion to finalizer removal"
     " (reference karpenter_nodeclaims_termination_duration_seconds)",
 )
+# ---- fault injection & hardened failure paths (faultinject/, PR 4) ----
+FAULT_INJECTIONS = REGISTRY.counter(
+    "ktpu_fault_injections_total",
+    "Faults injected at guarded points by the active FaultPlan",
+    ("point", "mode"),
+)
+SOLVER_FALLBACK = REGISTRY.counter(
+    "ktpu_solver_fallback_total",
+    "Solves that degraded down the ladder (device -> host oracle);"
+    " ktpu twin of karpenter_solver_host_fallback_total with the"
+    " degradation reasons (device_dispatch, divergence, dra, ...)",
+    ("reason",),
+)
+OFFERING_BLACKOUT = REGISTRY.gauge(
+    "ktpu_offering_blackout",
+    "Live unavailable-offering blackout entries by capacity type"
+    " (reference aws unavailableofferings ICE-cache size)",
+    ("capacity_type",),
+)
+STREAM_RECOVERIES = REGISTRY.counter(
+    "ktpu_stream_recoveries_total",
+    "Mid-SolveStream failures and how the client recovered"
+    " (resumed = stream retried clean; downgraded = unary fallback)",
+    ("outcome",),
+)
+STREAM_STALE_FRAMES = REGISTRY.counter(
+    "ktpu_stream_stale_frames_total",
+    "Chunk frames discarded because their round predates the last reset",
+)
+TRANSIENT_RETRIES = REGISTRY.counter(
+    "ktpu_transient_retries_total",
+    "Bounded retries of transient cloud/API errors, by controller",
+    ("controller",),
+)
+CIRCUIT_TRANSITIONS = REGISTRY.counter(
+    "ktpu_circuit_transitions_total",
+    "Solver-endpoint circuit-breaker state transitions",
+    ("target", "to"),
+)

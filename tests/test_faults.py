@@ -1,0 +1,509 @@
+"""Seeded chaos scenarios against the fault-injection subsystem (ISSUE 4).
+
+The contract under test, end to end on kwok + fake clock:
+
+- with a seeded ``FaultPlan`` injecting ICE storms, transient launch
+  errors, apiserver flakes, stream cuts at every chunk index, and device
+  dispatch failures, provisioning/consolidation still CONVERGE (every
+  pod bound, no duplicate NodeClaims, capacity reclaimed) — failures
+  bend the path, never the destination;
+- solver results are bit-identical to the unfaulted run once retries
+  succeed (the degradation ladder and stream recovery preserve the
+  differential-parity contract);
+- blacked-out offerings stop being picked for the TTL and return after;
+- fault points cost ~0 when disabled (the tracer's bar).
+"""
+
+import random
+
+import pytest
+
+import bench
+from karpenter_tpu.cloudprovider import errors
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_tpu.cloudprovider.unavailable import UnavailableOfferings
+from karpenter_tpu.controllers.nodeclaim_lifecycle import (
+    LAUNCH_ATTEMPTS_ANNOTATION,
+    MAX_LAUNCH_ATTEMPTS,
+    NodeClaimLifecycleController,
+)
+from karpenter_tpu.controllers.provisioning import TPUScheduler, build_templates
+from karpenter_tpu.envelope.scenarios import _harness, _provision, _settle
+from karpenter_tpu.faultinject import FAULT, FaultInjector, FaultPlan, active_plan
+from karpenter_tpu.models.nodeclaim import COND_LAUNCHED, NodeClaim
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.objects import ObjectMeta
+from karpenter_tpu.models.pod import make_pod
+from karpenter_tpu.state.store import ObjectStore
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.clock import FakeClock
+
+from test_solver import assert_same_packing
+
+
+def make_templates(n_types=16):
+    pool = NodePool()
+    pool.metadata.name = "default"
+    return build_templates([(pool, instance_types(n_types))])
+
+
+# -- plan mechanics -----------------------------------------------------------
+
+
+class TestFaultPlan:
+    def test_rules_fire_with_times_and_skip(self):
+        plan = FaultPlan.from_dict(
+            {"rules": [{"point": "x", "error": "transient", "times": 2, "skip": 1}]}
+        )
+        outcomes = []
+        with active_plan(plan):
+            for _ in range(5):
+                try:
+                    FAULT.point("x")
+                    outcomes.append("ok")
+                except errors.TransientError:
+                    outcomes.append("err")
+        # first hit skipped, next two fire, budget spent
+        assert outcomes == ["ok", "err", "err", "ok", "ok"]
+        assert not FAULT.enabled  # context manager deactivated
+
+    def test_glob_points_and_ctx_match(self):
+        plan = FaultPlan.from_dict(
+            {
+                "rules": [
+                    {"point": "cloud.*", "error": "throttle", "match": {"zone": "z2"}}
+                ]
+            }
+        )
+        with active_plan(plan):
+            FAULT.point("cloud.create", zone="z1")  # match filter misses
+            FAULT.point("api.patch", zone="z2")  # glob misses
+            with pytest.raises(errors.ThrottleError):
+                FAULT.point("cloud.create", zone="z2")
+
+    def test_seeded_probability_is_deterministic(self):
+        plan = FaultPlan.from_dict(
+            {"seed": 13, "rules": [{"point": "x", "error": "transient", "p": 0.5}]}
+        )
+
+        def pattern():
+            out = []
+            with active_plan(plan):
+                for _ in range(30):
+                    try:
+                        FAULT.point("x")
+                        out.append(0)
+                    except errors.TransientError:
+                        out.append(1)
+            return out
+
+        first, second = pattern(), pattern()
+        assert first == second  # reactivation reseeds identically
+        assert 0 < sum(first) < 30  # actually probabilistic
+
+    def test_counters_and_metric(self):
+        before = metrics.FAULT_INJECTIONS.get(point="y", mode="error")
+        with active_plan({"rules": [{"point": "y", "error": "terminal", "times": 3}]}):
+            for _ in range(3):
+                with pytest.raises(errors.TerminalError):
+                    FAULT.point("y")
+            FAULT.point("y")  # budget spent: passes through
+            assert FAULT.fires("y") == 3
+        assert metrics.FAULT_INJECTIONS.get(point="y", mode="error") == before + 3
+
+    def test_latency_mode_lets_the_call_proceed(self):
+        with active_plan(
+            {"rules": [{"point": "slow", "mode": "latency", "delay_s": 0.0}]}
+        ):
+            FAULT.point("slow")  # no raise
+            assert FAULT.fires("slow") == 1
+
+    def test_env_activation(self, monkeypatch, tmp_path):
+        spec = '{"seed": 3, "rules": [{"point": "z", "error": "transient"}]}'
+        monkeypatch.setenv("KTPU_FAULT_PLAN", spec)
+        inj = FaultInjector()
+        assert inj.maybe_activate_from_env()
+        with pytest.raises(errors.TransientError):
+            inj.point("z")
+        # file form
+        path = tmp_path / "plan.json"
+        path.write_text(spec)
+        monkeypatch.setenv("KTPU_FAULT_PLAN", str(path))
+        inj2 = FaultInjector()
+        assert inj2.maybe_activate_from_env()
+        # unset -> inert
+        monkeypatch.delenv("KTPU_FAULT_PLAN")
+        assert not FaultInjector().maybe_activate_from_env()
+
+
+class TestOverhead:
+    def test_disabled_point_is_near_free(self):
+        inj = FaultInjector()
+        import time
+
+        t0 = time.perf_counter()
+        for _ in range(100_000):
+            inj.point("hot.path")
+        elapsed = time.perf_counter() - t0
+        # the tracer's disabled-span bar (test_tracing.py): generous CI
+        # bound, typically < 30ms
+        assert elapsed < 2.0, f"100k disabled fault points took {elapsed:.3f}s"
+
+
+# -- blackout cache -----------------------------------------------------------
+
+
+class TestBlackoutCache:
+    def test_mark_expire_and_generation(self):
+        clock = FakeClock()
+        cache = UnavailableOfferings(clock, ttl_seconds=60.0)
+        g0 = cache.generation
+        cache.mark("s-4x-amd64", "test-zone-1", "spot")
+        assert cache.is_unavailable("s-4x-amd64", "test-zone-1", "spot")
+        assert not cache.is_unavailable("s-4x-amd64", "test-zone-2", "spot")
+        assert cache.generation == g0 + 1
+        clock.step(61.0)
+        assert cache.prune() == 1
+        assert cache.generation == g0 + 2
+        assert not cache.is_unavailable("s-4x-amd64", "test-zone-1", "spot")
+
+    def test_mark_from_error_reads_offerings(self):
+        cache = UnavailableOfferings(FakeClock())
+        err = errors.InsufficientCapacityError(
+            "no capacity", offerings=[("it-a", "z1", "spot"), ("it-b", "z2", "on-demand")]
+        )
+        assert cache.mark_from_error(err) == 2
+        assert cache.is_unavailable("it-a", "z1", "spot")
+        assert cache.is_unavailable("it-b", "z2", "on-demand")
+        # an ICE without offering info marks nothing and doesn't crash
+        assert cache.mark_from_error(errors.InsufficientCapacityError("bare")) == 0
+
+    def test_filter_catalog_removes_offerings_and_empty_types(self):
+        clock = FakeClock()
+        cache = UnavailableOfferings(clock, ttl_seconds=60.0)
+        its = instance_types(4)
+        # empty cache: the fast path returns the SAME list object
+        assert cache.filter_catalog(its) is its
+        victim = its[0]
+        first = victim.offerings[0]
+        cache.mark(victim.name, first.zone, first.capacity_type)
+        out = cache.filter_catalog(its)
+        filtered = next(it for it in out if it.name == victim.name)
+        assert len(filtered.offerings) == len(victim.offerings) - 1
+        assert not any(
+            o.zone == first.zone and o.capacity_type == first.capacity_type
+            for o in filtered.offerings
+        )
+        # blackout EVERY offering of the victim -> the type drops out
+        for o in victim.offerings:
+            cache.mark(victim.name, o.zone, o.capacity_type)
+        out = cache.filter_catalog(its)
+        assert victim.name not in {it.name for it in out}
+        # expiry restores the full catalog
+        clock.step(61.0)
+        assert cache.filter_catalog(its) is its
+
+    def test_gauge_tracks_entries(self):
+        cache = UnavailableOfferings(FakeClock())
+        cache.mark("a", "z1", "spot")
+        cache.mark("b", "z1", "spot")
+        cache.mark("c", "z1", "on-demand")
+        assert metrics.OFFERING_BLACKOUT.get(capacity_type="spot") == 2.0
+        assert metrics.OFFERING_BLACKOUT.get(capacity_type="on-demand") == 1.0
+
+
+# -- the degradation ladder (device dispatch -> host oracle) ------------------
+
+
+class TestDeviceDispatchFallback:
+    def test_dispatch_failure_degrades_to_host_with_identical_result(self):
+        sched = TPUScheduler(make_templates(16), max_claims=64)
+        pods = [make_pod(f"df-{i}", cpu=0.5, memory="512Mi") for i in range(48)]
+        baseline = sched.solve(pods)
+        assert not baseline.unschedulable
+        before = metrics.SOLVER_FALLBACK.get(reason="device_dispatch")
+        with active_plan(
+            {"rules": [{"point": "solver.dispatch", "error": "runtime", "times": 1}]}
+        ):
+            degraded = sched.solve(pods)
+        # the ladder: the solve did NOT fail, and the host oracle's answer
+        # is bit-identical to the device's (the differential contract)
+        assert_same_packing(baseline, degraded)
+        assert metrics.SOLVER_FALLBACK.get(reason="device_dispatch") == before + 1
+        # recovery: the next solve runs on the device again, same answer
+        assert_same_packing(baseline, sched.solve(pods))
+
+
+# -- lifecycle transient retry ------------------------------------------------
+
+
+class TestLifecycleTransientRetry:
+    def _env(self):
+        clock = FakeClock()
+        store = ObjectStore(clock)
+        cloud = FakeCloudProvider(catalog=instance_types(8))
+        ctrl = NodeClaimLifecycleController(store, cloud, clock)
+        claim = NodeClaim(metadata=ObjectMeta(name="tc-1"))
+        store.create(ObjectStore.NODECLAIMS, claim)
+        return store, ctrl, claim
+
+    def test_bounded_retry_then_success(self):
+        store, ctrl, claim = self._env()
+        with active_plan(
+            {"rules": [{"point": "cloud.create", "error": "throttle", "times": 2}]}
+        ):
+            ctrl.reconcile(claim)
+            assert not claim.conditions.is_true(COND_LAUNCHED)
+            assert claim.metadata.annotations[LAUNCH_ATTEMPTS_ANNOTATION] == "1"
+            ctrl.reconcile(claim)
+            assert claim.metadata.annotations[LAUNCH_ATTEMPTS_ANNOTATION] == "2"
+            ctrl.reconcile(claim)  # budget left, fault exhausted -> launch
+        assert claim.conditions.is_true(COND_LAUNCHED)
+        assert store.get(ObjectStore.NODECLAIMS, "tc-1") is not None
+
+    def test_budget_exhausted_gives_the_pods_back(self):
+        store, ctrl, claim = self._env()
+        with active_plan(
+            {"rules": [{"point": "cloud.create", "error": "timeout"}]}
+        ):
+            for _ in range(MAX_LAUNCH_ATTEMPTS):
+                ctrl.reconcile(claim)
+        # claim deleted like an ICE: pods re-schedule onto a fresh claim
+        assert store.get(ObjectStore.NODECLAIMS, "tc-1") is None
+
+    def test_ice_marks_the_blackout_cache(self):
+        store, ctrl, claim = self._env()
+        assert len(ctrl.unavailable) == 0
+        with active_plan(
+            {"rules": [{"point": "cloud.create", "error": "ice", "times": 1}]}
+        ):
+            ctrl.reconcile(claim)
+        # the fake provider attached the resolved offering to the ICE
+        assert len(ctrl.unavailable) == 1
+        assert store.get(ObjectStore.NODECLAIMS, "tc-1") is None
+
+
+# -- seeded chaos e2e on kwok + fake clock ------------------------------------
+
+
+def _settle_hard(mgr, store, cloud, rounds=16):
+    """_settle with a larger round budget: faulted runs legitimately need
+    extra provision->launch->bind cycles while retries drain."""
+    _settle(mgr, store, cloud, rounds=rounds)
+
+
+def _assert_converged(store, n_pods):
+    pods = store.pods()
+    assert len(pods) == n_pods
+    stranded = [p.name for p in pods if not p.spec.node_name]
+    assert not stranded, f"stranded pods: {stranded}"
+    # no duplicate NodeClaims: one claim per node, distinct provider ids,
+    # and every pod's node actually exists
+    claims = store.nodeclaims()
+    nodes = store.nodes()
+    pids = [c.status.provider_id for c in claims if c.status.provider_id]
+    assert len(pids) == len(set(pids)), "duplicate provider ids"
+    assert len(claims) == len(nodes), (len(claims), len(nodes))
+    node_names = {n.name for n in nodes}
+    assert all(p.spec.node_name in node_names for p in pods)
+
+
+class TestICEStormScaleOut:
+    def test_scale_out_converges_through_an_ice_storm(self):
+        clock, store, cloud, mgr = _harness(catalog_size=64)
+        pods = [
+            make_pod(f"ice-{i}", cpu=(0.25, 0.5, 1.0)[i % 3], memory="512Mi")
+            for i in range(40)
+        ]
+        plan = {
+            "seed": 11,
+            "rules": [
+                {"point": "cloud.create", "error": "ice", "p": 0.6, "times": 5}
+            ],
+        }
+        with active_plan(plan):
+            _provision(mgr, store, cloud, pods)
+            _settle_hard(mgr, store, cloud)
+            injected = FAULT.fires("cloud.create")
+        assert injected >= 1, "the storm never fired"
+        _assert_converged(store, 40)
+        # every ICE carried its resolved offering into the blackout cache
+        assert len(mgr.unavailable) >= 1
+        assert metrics.FAULT_INJECTIONS.get(point="cloud.create", mode="error") >= injected
+
+    def test_blackout_expiry_restores_offerings(self):
+        clock, store, cloud, mgr = _harness(catalog_size=16)
+        mgr.unavailable.mark("anything", "test-zone-1", "spot")
+        gen = mgr.unavailable.generation
+        clock.step(mgr.unavailable.ttl_seconds + 1.0)
+        # the provisioner's next scheduler build prunes and invalidates
+        store.create(ObjectStore.PODS, make_pod("bx-1", cpu=0.5))
+        _settle_hard(mgr, store, cloud, rounds=6)
+        assert len(mgr.unavailable) == 0
+        assert mgr.unavailable.generation > gen
+        _assert_converged(store, 1)
+
+
+class TestBrownoutConsolidation:
+    def test_consolidation_converges_through_provider_and_api_flakes(self):
+        from karpenter_tpu.envelope.scenarios import _delete_pods, _disruption_cycles
+
+        clock, store, cloud, mgr = _harness(catalog_size=64)
+        n = 16
+        survivors = {f"bc-{i}" for i in range(n // 2)}
+        _provision(
+            mgr, store, cloud,
+            [make_pod(f"bc-{i}", cpu=1.5, memory="1Gi") for i in range(n)],
+        )
+        cpu_before = sum(nd.status.capacity["cpu"] for nd in store.nodes())
+        _delete_pods(store, mgr, lambda p: p.name not in survivors)
+        clock.step(60.0)
+        retries_before = metrics.TRANSIENT_RETRIES.get(controller="disruption.queue")
+        plan = {
+            "seed": 23,
+            "rules": [
+                {"point": "cloud.create", "error": "throttle", "p": 0.5, "times": 3},
+                {
+                    "point": "api.delete",
+                    "match": {"kind": ObjectStore.NODECLAIMS},
+                    "error": "transient",
+                    "times": 2,
+                },
+            ],
+        }
+        with active_plan(plan):
+            executed = _disruption_cycles(clock, store, cloud, mgr, polls=10)
+            _settle_hard(mgr, store, cloud)
+        assert executed is not None, "no consolidation command produced"
+        _settle_hard(mgr, store, cloud)
+        cpu_after = sum(nd.status.capacity["cpu"] for nd in store.nodes())
+        assert cpu_after < cpu_before, "no capacity reclaimed under brownout"
+        _assert_converged(store, len(survivors))
+        # the injected api.delete flakes were absorbed as bounded retries
+        assert (
+            metrics.TRANSIENT_RETRIES.get(controller="disruption.queue")
+            >= retries_before
+        )
+
+
+# -- SolveStream cuts at every chunk index ------------------------------------
+
+
+class _StreamEnv:
+    def __init__(self, remote, pods, baseline, n_chunks):
+        self.remote = remote
+        self.pods = pods  # ONE pod list: uids must match across re-solves
+        self.baseline = baseline
+        self.n_chunks = n_chunks
+
+
+@pytest.fixture(scope="class")
+def stream_env():
+    """One server + client + pod set + unfaulted baseline for the whole
+    cut matrix (the jit cache and the Configure round-trip amortize, and
+    every faulted result compares against the SAME baseline)."""
+    import os
+
+    saved = {k: os.environ.get(k) for k in ("KTPU_PIPELINE_CHUNKS", "KTPU_PIPELINE_MIN_PODS")}
+    os.environ["KTPU_PIPELINE_CHUNKS"] = "2"
+    os.environ["KTPU_PIPELINE_MIN_PODS"] = "0"
+    from karpenter_tpu.rpc import RemoteScheduler, serve
+    from karpenter_tpu.rpc.retry import Backoff
+
+    server, addr = serve("127.0.0.1:0")
+    remote = RemoteScheduler(addr, bench.make_templates(24))
+    remote._backoff = Backoff(base_s=0.01, cap_s=0.05, rng=random.Random(0))
+    pods = bench.mixed_pods(96)
+    baseline = remote.solve(pods)
+    assert not baseline.unschedulable
+    n_chunks = remote.last_stream["chunks"]
+    assert n_chunks >= 2, remote.last_stream
+    try:
+        yield _StreamEnv(remote, pods, baseline, n_chunks)
+    finally:
+        remote.close()
+        server.stop(0)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+class TestStreamCuts:
+    def test_cut_at_every_chunk_index_recovers_bit_identical(self, stream_env):
+        remote, baseline = stream_env.remote, stream_env.baseline
+        n_chunks = stream_env.n_chunks
+        for index in range(n_chunks + 1):  # +1: the cut before the final frame
+            plan = {
+                "seed": index,
+                "rules": [
+                    {
+                        "point": "rpc.stream.chunk",
+                        "match": {"index": index},
+                        "error": "unavailable",
+                        "times": 1,
+                    }
+                ],
+            }
+            with active_plan(plan):
+                result = remote.solve(stream_env.pods)
+                assert FAULT.fires("rpc.stream.chunk") == 1, f"cut at {index} missed"
+            # the retry re-ran the stream from scratch; nothing from the
+            # broken attempt leaked into the stitcher
+            assert_same_packing(baseline, result)
+            assert remote.last_stream["chunks"] == n_chunks
+
+    def test_persistent_cut_downgrades_to_unary(self, stream_env):
+        remote = stream_env.remote
+        before = metrics.STREAM_RECOVERIES.get(outcome="downgraded_unary")
+        with active_plan(
+            {"rules": [{"point": "rpc.stream.chunk", "error": "unavailable"}]}
+        ):
+            result = remote.solve(stream_env.pods)
+        assert_same_packing(stream_env.baseline, result)
+        assert metrics.STREAM_RECOVERIES.get(outcome="downgraded_unary") == before + 1
+        # the downgrade was per-call: streaming stays preferred
+        assert remote._stream_ok
+        remote.solve(stream_env.pods)
+        assert remote.last_stream["chunks"] >= 2
+
+    def test_send_failure_retries_with_backoff(self, stream_env):
+        remote = stream_env.remote
+        with active_plan(
+            {"rules": [{"point": "rpc.solve.send", "error": "unavailable", "times": 1}]}
+        ):
+            result = remote.solve(stream_env.pods)
+        assert_same_packing(stream_env.baseline, result)
+
+    def test_breaker_opens_under_sustained_failure(self, stream_env):
+        remote = stream_env.remote
+        from karpenter_tpu.rpc.client import TRANSPORT_RETRIES
+        from karpenter_tpu.rpc.retry import CircuitBreaker, CircuitOpenError
+
+        # a private breaker so the class-scoped client's shared one isn't
+        # poisoned for the other tests
+        saved = remote._breaker
+        t = [0.0]
+        remote._breaker = CircuitBreaker(
+            failure_threshold=TRANSPORT_RETRIES + 1, cooldown_s=60.0, now=lambda: t[0]
+        )
+        try:
+            with active_plan(
+                {"rules": [{"point": "rpc.solve.send", "error": "unavailable"}]}
+            ):
+                import grpc
+
+                with pytest.raises(grpc.RpcError):
+                    remote.solve(stream_env.pods)
+                # every attempt failed -> breaker open -> fail fast
+                assert remote._breaker.state == CircuitBreaker.OPEN
+                with pytest.raises(CircuitOpenError):
+                    remote.solve(stream_env.pods)
+            # cooldown elapses, faults gone: the half-open probe heals it
+            t[0] = 61.0
+            result = remote.solve(stream_env.pods)
+            assert not result.unschedulable
+            assert remote._breaker.state == CircuitBreaker.CLOSED
+        finally:
+            remote._breaker = saved

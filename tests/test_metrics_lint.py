@@ -93,6 +93,38 @@ def test_every_label_is_snake_case():
     assert not bad, f"non-snake_case label names: {bad}"
 
 
+def test_grandfather_list_is_frozen():
+    """The freeze is the point: PR-4's fault/fallback/blackout families
+    all landed under ktpu_ — nothing new may sneak into the grandfather
+    set without consciously editing BOTH this count and the list."""
+    assert len(GRANDFATHERED) == 29, (
+        "GRANDFATHERED grew or shrank; new families must be ktpu_-prefixed"
+    )
+
+
+def test_fault_and_degradation_families_are_registered():
+    """ISSUE-4 families exist with the documented types and labels (the
+    doc/metrics-table satellite's machine-checked half)."""
+    from karpenter_tpu.utils.metrics import Counter, Gauge
+
+    fams = {f.name: f for f in _families()}
+    expected = {
+        "ktpu_fault_injections_total": (Counter, ("point", "mode")),
+        "ktpu_solver_fallback_total": (Counter, ("reason",)),
+        "ktpu_offering_blackout": (Gauge, ("capacity_type",)),
+        "ktpu_stream_recoveries_total": (Counter, ("outcome",)),
+        "ktpu_stream_stale_frames_total": (Counter, ()),
+        "ktpu_transient_retries_total": (Counter, ("controller",)),
+        "ktpu_circuit_transitions_total": (Counter, ("target", "to")),
+    }
+    for name, (cls, labels) in expected.items():
+        fam = fams.get(name)
+        assert fam is not None, f"{name} not registered"
+        assert isinstance(fam, cls), (name, type(fam).__name__)
+        assert fam.label_names == labels, (name, fam.label_names)
+        assert fam.help.strip()
+
+
 def test_counters_end_in_total_and_histograms_in_seconds_or_pods():
     """Unit-suffix discipline for NEW families (grandfathered names keep
     their reference spellings verbatim)."""

@@ -1,0 +1,129 @@
+"""Backoff math + circuit-breaker transitions (rpc/retry.py) — pure
+unit, no sockets: deterministic jitter under a seeded RNG, cap/ceiling
+behavior, and the closed -> open -> half-open -> closed lattice on an
+injected clock."""
+
+import random
+
+import pytest
+
+from karpenter_tpu.rpc.retry import (
+    Backoff,
+    CircuitBreaker,
+    InjectedRpcError,
+    injected_rpc_error,
+    is_transient_code,
+)
+
+
+class TestBackoff:
+    def test_seeded_jitter_is_deterministic(self):
+        a = Backoff(base_s=0.1, cap_s=5.0, rng=random.Random(42))
+        b = Backoff(base_s=0.1, cap_s=5.0, rng=random.Random(42))
+        assert [a.delay(i) for i in range(10)] == [b.delay(i) for i in range(10)]
+
+    def test_different_seeds_differ(self):
+        a = Backoff(base_s=0.1, cap_s=5.0, rng=random.Random(1))
+        b = Backoff(base_s=0.1, cap_s=5.0, rng=random.Random(2))
+        assert [a.delay(i) for i in range(10)] != [b.delay(i) for i in range(10)]
+
+    def test_ceiling_is_exponential_then_capped(self):
+        b = Backoff(base_s=0.25, cap_s=2.0, multiplier=2.0)
+        assert b.ceiling(0) == 0.25
+        assert b.ceiling(1) == 0.5
+        assert b.ceiling(2) == 1.0
+        assert b.ceiling(3) == 2.0
+        assert b.ceiling(4) == 2.0  # capped
+        assert b.ceiling(50) == 2.0  # no overflow past the cap
+
+    def test_jitter_stays_inside_the_band(self):
+        b = Backoff(base_s=0.1, cap_s=30.0, jitter_frac=0.5, rng=random.Random(7))
+        for attempt in range(12):
+            raw = b.ceiling(attempt)
+            for _ in range(50):
+                d = b.delay(attempt)
+                assert raw * 0.5 <= d <= raw, (attempt, d, raw)
+
+    def test_zero_jitter_is_exact(self):
+        b = Backoff(base_s=0.1, cap_s=1.0, jitter_frac=0.0)
+        assert [b.delay(i) for i in range(5)] == [b.ceiling(i) for i in range(5)]
+
+    def test_bad_jitter_frac_rejected(self):
+        with pytest.raises(ValueError):
+            Backoff(jitter_frac=1.5)
+
+
+class TestCircuitBreaker:
+    def _breaker(self, threshold=3, cooldown=10.0):
+        t = [0.0]
+        seen = []
+        br = CircuitBreaker(
+            failure_threshold=threshold,
+            cooldown_s=cooldown,
+            now=lambda: t[0],
+            on_transition=seen.append,
+        )
+        return br, t, seen
+
+    def test_closed_until_threshold(self):
+        br, _, seen = self._breaker(threshold=3)
+        for _ in range(2):
+            br.record_failure()
+            assert br.state == CircuitBreaker.CLOSED and br.allow()
+        br.record_failure()
+        assert br.state == CircuitBreaker.OPEN
+        assert not br.allow()
+        assert seen == [CircuitBreaker.OPEN]
+
+    def test_success_resets_the_failure_count(self):
+        br, _, _ = self._breaker(threshold=3)
+        br.record_failure()
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        br.record_failure()
+        assert br.state == CircuitBreaker.CLOSED  # 2 < 3 after the reset
+
+    def test_open_to_half_open_after_cooldown(self):
+        br, t, seen = self._breaker(threshold=1, cooldown=10.0)
+        br.record_failure()
+        assert not br.allow()
+        t[0] = 9.9
+        assert not br.allow()  # still cooling
+        t[0] = 10.0
+        assert br.allow()  # the probe
+        assert br.state == CircuitBreaker.HALF_OPEN
+        assert seen == [CircuitBreaker.OPEN, CircuitBreaker.HALF_OPEN]
+
+    def test_half_open_probe_success_closes(self):
+        br, t, seen = self._breaker(threshold=1, cooldown=5.0)
+        br.record_failure()
+        t[0] = 5.0
+        assert br.allow()
+        br.record_success()
+        assert br.state == CircuitBreaker.CLOSED
+        assert br.allow()
+        assert seen[-1] == CircuitBreaker.CLOSED
+
+    def test_half_open_probe_failure_reopens_with_fresh_cooldown(self):
+        br, t, _ = self._breaker(threshold=1, cooldown=5.0)
+        br.record_failure()  # open at t=0
+        t[0] = 5.0
+        assert br.allow()  # half-open probe
+        br.record_failure()  # probe failed
+        assert br.state == CircuitBreaker.OPEN
+        t[0] = 9.9  # 4.9s into the NEW cooldown — not the old one
+        assert not br.allow()
+        t[0] = 10.0
+        assert br.allow()
+
+
+class TestInjectedErrors:
+    def test_injected_unavailable_classifies_transient(self):
+        err = injected_rpc_error("unavailable", "chaos")
+        assert isinstance(err, InjectedRpcError)
+        assert is_transient_code(err)
+        assert err.details() == "chaos"
+
+    def test_non_rpc_errors_are_not_transient_codes(self):
+        assert not is_transient_code(RuntimeError("nope"))

@@ -254,6 +254,99 @@ class TestSolveParity:
         assert first._config_version > stale  # re-Configure happened
 
 
+class TestStreamStitcher:
+    """The SolveStream chunk-stitching state machine, fed frames directly
+    (no sockets): round-tagged frames make stale chunks — a chunk frame
+    arriving after the reset that invalidated its relaxation round —
+    discardable instead of silently stitched (ISSUE 4 satellite; the
+    mid-stream-recovery hazard)."""
+
+    @staticmethod
+    def _chunk(round_no, claims=(), exist=(), unsched=()):
+        from karpenter_tpu.rpc import solver_pb2 as pb
+        from karpenter_tpu.rpc.service import FRAME_CHUNK, _round_bytes
+
+        resp = pb.SolveResponse()
+        for slot, uids in claims:
+            m = resp.claims.add()
+            m.slot = slot
+            m.pod_uids.extend(uids)
+        for uid, node in exist:
+            a = resp.existing_assignments.add()
+            a.pod_uid, a.node_name = uid, node
+        for uid, reason in unsched:
+            u = resp.unschedulable.add()
+            u.pod_uid, u.reason = uid, reason
+        return FRAME_CHUNK + _round_bytes(round_no) + resp.SerializeToString()
+
+    @staticmethod
+    def _reset(round_no):
+        from karpenter_tpu.rpc.service import FRAME_RESET, _round_bytes
+
+        return FRAME_RESET + _round_bytes(round_no)
+
+    @staticmethod
+    def _final(slim=True):
+        from karpenter_tpu.rpc import solver_pb2 as pb
+        from karpenter_tpu.rpc.service import FRAME_FINAL_FULL, FRAME_FINAL_SLIM
+
+        tag = FRAME_FINAL_SLIM if slim else FRAME_FINAL_FULL
+        return tag + pb.SolveResponse().SerializeToString()
+
+    def test_in_order_rounds_stitch(self):
+        from karpenter_tpu.rpc.client import StreamStitcher
+
+        s = StreamStitcher()
+        frames = [
+            self._chunk(0, claims=[(0, ["a"])]),
+            self._chunk(0, claims=[(0, ["b"]), (1, ["c"])]),
+            self._final(),
+        ]
+        fed = [s.feed(f) for f in frames]
+        assert fed == [False, False, True]
+        assert s.tables()["claims"] == {0: ["a", "b"], 1: ["c"]}
+        assert s.n_chunks == 2 and s.n_stale == 0 and not s.full
+
+    def test_reset_discards_and_stale_chunk_is_dropped(self):
+        """The regression: chunk(round 0) after reset(round 1) belongs to
+        the abandoned round — it must NOT be stitched into round 1."""
+        from karpenter_tpu.rpc.client import StreamStitcher
+        from karpenter_tpu.utils.metrics import STREAM_STALE_FRAMES
+
+        before = STREAM_STALE_FRAMES.get()
+        s = StreamStitcher()
+        s.feed(self._chunk(0, claims=[(0, ["old-a"])], unsched=[("u1", "NoFit")]))
+        s.feed(self._reset(1))  # relaxation round restarted the tables
+        assert s.tables()["claims"] == {}  # accumulated state discarded
+        s.feed(self._chunk(0, claims=[(0, ["old-b"])]))  # STALE: round 0
+        s.feed(self._chunk(1, claims=[(0, ["new-a"])]))
+        s.feed(self._final())
+        assert s.tables()["claims"] == {0: ["new-a"]}, "stale chunk was stitched"
+        assert s.tables()["unsched"] == []
+        assert s.n_stale == 1 and s.n_resets == 1 and s.n_chunks == 2
+        assert STREAM_STALE_FRAMES.get() == before + 1
+
+    def test_future_round_chunk_without_its_reset_is_dropped(self):
+        """A chunk tagged PAST the live round (its reset frame never
+        arrived — out-of-order delivery) is equally unstitchable."""
+        from karpenter_tpu.rpc.client import StreamStitcher
+
+        s = StreamStitcher()
+        s.feed(self._chunk(0, claims=[(0, ["a"])]))
+        s.feed(self._chunk(2, claims=[(0, ["phantom"])]))
+        s.feed(self._final())
+        assert s.tables()["claims"] == {0: ["a"]}
+        assert s.n_stale == 1
+
+    def test_full_final_carries_everything(self):
+        from karpenter_tpu.rpc.client import StreamStitcher
+
+        s = StreamStitcher()
+        assert s.feed(self._final(slim=False))
+        assert s.full and s.final is not None
+        assert s.stats()["chunks"] == 0
+
+
 class TestPipelineThroughSocket:
     def test_kwok_provisioning_e2e(self, solver_server):
         """The full pipeline — batcher, provisioner, lifecycle, binding —
