@@ -180,6 +180,10 @@ def run_stage(pods, n_types, max_claims, warm_runs=2, host_parity=False):
         pl = timings["pipeline"]
         out["overlap_frac"] = pl["overlap_frac"]
         out["pipeline"] = pl
+    if timings.get("scan"):
+        # claims-axis occupancy: window size vs live high-water, frozen
+        # bank, spills, compactions (bench --report-scan prints these)
+        out["scan"] = timings["scan"]
     if timings.get("padding"):
         out["padding"] = timings["padding"]
     if host_parity:
@@ -398,6 +402,22 @@ def _print_padding_report(detail: dict) -> None:
             )
 
 
+def _print_scan_report(detail: dict) -> None:
+    """--report-scan: claims-axis occupancy per stage — the active window
+    vs the live high-water, frozen-bank size, spill and compaction counts.
+    The JSON line carries the same numbers under each stage's "scan" key."""
+    for stage, st in sorted(detail.items()):
+        if not isinstance(st, dict) or "scan" not in st:
+            continue
+        s = st["scan"]
+        print(
+            f"scan {stage:>28s}: window={s['window']:>5d}/{s['n_claims']:<5d} "
+            f"live_hw={s['live_hw']:>5d} opened={s['n_open']:>5d} "
+            f"frozen={s['frozen']:>5d} spills={s['spills']} "
+            f"compactions={s['compactions']}"
+        )
+
+
 def main() -> None:
     import argparse
 
@@ -408,6 +428,13 @@ def main() -> None:
         help="print per-solve padded-vs-real element waste per stage/axis "
         "(the same numbers land under each stage's 'padding' key in the "
         "final JSON line)",
+    )
+    parser.add_argument(
+        "--report-scan",
+        action="store_true",
+        help="print per-stage claims-axis occupancy (active window vs live "
+        "high-water, frozen bank, spills, compactions; the same numbers "
+        "land under each stage's 'scan' key in the final JSON line)",
     )
     parser.add_argument(
         "--chaos",
@@ -563,6 +590,8 @@ def main() -> None:
 
     if args.report_padding:
         _print_padding_report(detail)
+    if args.report_scan:
+        _print_scan_report(detail)
 
     print(
         json.dumps(

@@ -161,8 +161,8 @@ def _slim_outputs(specs: tuple, flat) -> tuple[list, list]:
             i += 1
         else:
             B = spec[1]
-            fc, fe, os_, no_, st_ = flat[i : i + 5]
-            i += 5
+            fc, fe, os_, no_, st_, sm = flat[i : i + 6]
+            i += 6
             maxes.append(jnp.max(fc))
             if fe.size:
                 maxes.append(jnp.max(fe))
@@ -173,23 +173,33 @@ def _slim_outputs(specs: tuple, flat) -> tuple[list, list]:
                     os_[:B],
                     no_[:B],
                     st_[:B],
+                    sm,  # the dispatch's window -> global slot map
                 ]
             )
     return proc, maxes
 
 
+# scalar/column head every decode fetch starts with (before tk rows)
+_STATE_HEAD = ("template", "its", "used", "held", "n_open", "w_open", "w_hw", "spills")
+
+
 def _state_reads(state, tk: tuple) -> list:
-    """The final-state reads every decode needs: claim finalization
-    columns, the n_open sync scalar, and (when vg topology narrowed
-    anything) the topo-key requirement rows, pre-gathered on device."""
-    proc = [state.template, state.its, state.used, state.held, state.n_open]
+    """The final-state reads every decode needs: GLOBAL-slot claim
+    finalization columns (hot window merged over the frozen bank), the
+    n_open/window sync scalars, and (when vg topology narrowed anything)
+    the topo-key requirement rows, pre-gathered on device."""
+    g = ops_solver.global_claims(state, tk)
+    proc = [
+        g["template"], g["its"], g["used"], g["held"],
+        state.n_open, state.w_open, state.w_hw, state.spills,
+    ]
     if tk:
         kid = list(tk)
         proc.extend(
             [
-                state.reqs.mask[:, kid, :],
-                state.reqs.inf[:, kid],
-                state.reqs.defined[:, kid],
+                g["tk_mask"],
+                g["tk_inf"],
+                g["tk_def"],
                 state.exist_reqs.mask[:, kid, :],
                 state.exist_reqs.inf[:, kid],
                 state.exist_reqs.defined[:, kid],
@@ -206,14 +216,16 @@ def _make_fetch_prep(specs: tuple, tk: tuple):
     The caller caches the jitted function per (specs, tk, pad signature)
     so repeated solves with the same shape reuse one executable."""
 
+    n_head = len(_STATE_HEAD)
+
     def _prep(state, flat):
-        proc = [state.template, state.its, state.used, state.held, state.n_open]
+        reads = _state_reads(state, tk)
+        proc = reads[:n_head]
         out, maxes = _slim_outputs(specs, flat)
         proc.extend(out)
         if maxes:
             proc.append(jnp.max(jnp.stack(maxes)))
-        if tk:
-            proc.extend(_state_reads(state, tk)[5:])
+        proc.extend(reads[n_head:])
         return proc
 
     return _prep
@@ -325,6 +337,28 @@ class TPUScheduler:
         import os
 
         self.solve_chunk = int(os.environ.get("KTPU_SOLVE_CHUNK", "2048"))
+        # active-window sizing for the claims axis: the scan's hot tensors
+        # cover only `window` resident claims (capacity-dead claims are
+        # evicted to the frozen bank between dispatches), so the per-step
+        # cost tracks the LIVE claim count instead of cumulative opens.
+        # 0 = adaptive: full axis cold, live high-water + margin warm.
+        self.scan_window = int(os.environ.get("KTPU_SCAN_WINDOW", "0") or 0)
+        # un-windowed solves at/above this size still run boundary
+        # compaction so w_hw measures true residency for warm sizing
+        self.compact_min_pods = int(
+            os.environ.get("KTPU_COMPACT_MIN_PODS", "1024") or 0
+        )
+        self._window_override: Optional[int] = None
+        self._last_w_hw: Optional[int] = None
+        self._last_window: Optional[int] = None
+        self._scan_stats: Optional[dict] = None
+        # incremental encode cache: per-kind encoded rows keyed on the kind
+        # content signature, valid while the vocab/pads/catalog stand still
+        self.encode_cache_enabled = (
+            os.environ.get("KTPU_ENCODE_CACHE", "1") not in ("0", "false")
+        )
+        self._encode_cache: dict = {}
+        self._encode_cache_key: Optional[tuple] = None
         # software pipeline (encode/dispatch vs wire/decode overlap): split
         # large solves into ~K chunk groups; each group's outputs are
         # fetched and decoded while the device still runs later chunks.
@@ -508,11 +542,12 @@ class TPUScheduler:
                 [True] * len(self.existing_nodes)
                 + [False] * (e_pad - len(self.existing_nodes))
             ),
-            ports=jnp.zeros((e_pad, 1), dtype=bool),  # re-filled per solve
-            # inert defaults; _encode replaces them when CSI limits bind
-            vols=jnp.zeros((e_pad, 1), dtype=bool),
+            # packed uint32 bitsets (kernels.pack_bool_np layout); re-filled
+            # per solve, inert 1-lane defaults when CSI limits don't bind
+            ports=jnp.zeros((e_pad, 1), dtype=jnp.uint32),
+            vols=jnp.zeros((e_pad, 1), dtype=jnp.uint32),
             vol_limits=jnp.full((e_pad, 1), np.inf, dtype=jnp.float32),
-            vol_driver=jnp.zeros((1, 1), dtype=bool),
+            vol_driver=jnp.zeros((1, 1), dtype=jnp.uint32),
         )
 
     # -- solving -----------------------------------------------------------
@@ -617,6 +652,7 @@ class TPUScheduler:
         # NO_ROOM escalation is per-solve: the next batch re-sizes from the
         # last observed need instead of inheriting a one-off doubling
         self._n_claims_override = None
+        self._window_override = None
         self._volume_reqs = norm_vol
         # CSI attach limits ride the device scan (distinct-PVC popcounts
         # over a (driver, pvc) column vocabulary — volumeusage.go:201-208)
@@ -648,6 +684,13 @@ class TPUScheduler:
                     for _, reason in result.unschedulable
                     if reason == NO_ROOM_REASON
                 )
+                spilled = (self._scan_stats or {}).get("spills", 0)
+                if leftover and spilled:
+                    # window-bound NO_ROOM: the claims axis had room but
+                    # the active window was full — grow the window to the
+                    # full axis and re-solve before escalating the axis
+                    self._window_override = used
+                    continue
                 if used >= cap or not leftover:
                     return result
                 # one-shot escalation: the failed solve already measured
@@ -659,6 +702,9 @@ class TPUScheduler:
                 self._n_claims_override = min(
                     max(used * 2, -(-est // 256) * 256), cap
                 )
+                # the escalated retry runs un-windowed: a spill there
+                # would just burn another full re-solve
+                self._window_override = self._n_claims_override
 
         def should_stop() -> bool:
             # the device dispatch is atomic — the Solve deadline
@@ -747,6 +793,7 @@ class TPUScheduler:
             self._chunk_sink(("reset", None))
         self._t_solve_start = _time.perf_counter()
         self._adaptive_claims = True
+        self._scan_stats = None
         pad_real0 = dict(self._pad_cache.real)
         pad_padded0 = dict(self._pad_cache.padded)
         try:
@@ -799,6 +846,8 @@ class TPUScheduler:
                 ),
             }
         self.last_timings["padding"] = padding
+        if self._scan_stats is not None:
+            self.last_timings["scan"] = self._scan_stats
         if self._pipeline_stats is not None:
             self.last_timings["pipeline"] = self._pipeline_stats
         return out
@@ -833,8 +882,10 @@ class TPUScheduler:
         self._volume_reqs = normalize_volume_reqs(volume_reqs)
         # a NO_ROOM escalation from an interleaved solve() must not shrink
         # the what-if's claims axis — scenarios displace extra pods and can
-        # need MORE slots than the last live solve
+        # need MORE slots than the last live solve (the what-if dispatch
+        # itself always runs un-windowed: solve_whatif defaults window=0)
         self._n_claims_override = None
+        self._window_override = None
         # CSI attach limits ride the batched path: displaced pods carry
         # their (driver, pvc) columns and surviving nodes keep their
         # attach-usage seeds (exist.vols) — the same tensorized check the
@@ -1019,6 +1070,20 @@ class TPUScheduler:
         else:
             n_claims = cap
         self._last_n_claims = n_claims
+        # active window: bounded hot claims axis within the global claim
+        # space [0, n_claims). Cold solves keep the full axis; warm solves
+        # shrink to a bucket above the live high-water (compaction keeps
+        # residency near the live set); spills escalate via solve_round.
+        if self._window_override:
+            window = min(self._window_override, n_claims)
+        elif self.scan_window > 0:
+            window = min(self.scan_window, n_claims)
+        elif self._adaptive_claims and self._last_w_hw is not None:
+            w_need = int(self._last_w_hw * 1.25) + 32
+            window = min(n_claims, max(256, -(-w_need // 256) * 256))
+        else:
+            window = n_claims
+        self._last_window = window
         from karpenter_tpu.controllers.provisioning.host_scheduler import (
             gather_ffd_keys,
         )
@@ -1089,24 +1154,103 @@ class TPUScheduler:
 
         U = len(reps)
         k_pad, v_pad = self._pads()
-        rep_req_sets = [self._pod_reqs(p) for p in reps]
-        reqs_k = encode_requirements(
-            self.encoder.vocab, rep_req_sets, k_pad, v_pad, self.encoder.skip_keys
-        )
-        it_allow_k = self.encoder.it_allow_mask(rep_req_sets, self.catalog)
-        if it_allow_k.shape[1] != self._T_pad:  # sharded catalog padding
-            it_allow_k = np.pad(
-                it_allow_k,
-                ((0, 0), (0, self._T_pad - it_allow_k.shape[1])),
-                constant_values=False,
+        G_tmpl = len(self.templates)
+        # ---- incremental encode cache (KTPU_ENCODE_CACHE) ------------------
+        # Every per-kind row below is a pure function of kind content and
+        # the encode epoch (vocab + pads + catalog + templates), so
+        # steady-state repeat solves assemble cached numpy rows instead of
+        # re-walking requirement objects. Node- and port-dependent rows
+        # (exist_ok, port bitsets) stay per-solve.
+        epoch = (self._vocab_sig, k_pad, v_pad, self._T_pad, G_tmpl)
+        cache = None
+        if self.encode_cache_enabled:
+            if self._encode_cache_key != epoch:
+                self._encode_cache = {}
+                self._encode_cache_key = epoch
+            elif len(self._encode_cache) > 8192:
+                # churning workloads can't pin rows forever
+                self._encode_cache.clear()
+            cache = self._encode_cache
+        bundles: list = [None] * U
+        rep_sigs = None
+        if cache is not None:
+            rep_sigs = [self._kind_sig(p) for p in reps]
+            for u in range(U):
+                bundles[u] = cache.get(rep_sigs[u])
+        n_hits = sum(b is not None for b in bundles)
+        miss = [u for u in range(U) if bundles[u] is None]
+        rep_req_sets: list = [None] * U
+        if miss:
+            from karpenter_tpu.ops.encode import encode_requirements_np
+
+            row_memo: dict = {}
+            miss_reqs = [self._pod_reqs(reps[u]) for u in miss]
+            for j, u in enumerate(miss):
+                rep_req_sets[u] = miss_reqs[j]
+            m_enc = encode_requirements_np(
+                self.encoder.vocab, miss_reqs, k_pad, v_pad,
+                self.encoder.skip_keys, row_memo=row_memo,
             )
-        # hostname selectors can never match a not-yet-named new node
-        for u, rq in enumerate(rep_req_sets):
-            if not self.encoder.hostname_allows(rq, None):
-                it_allow_k[u, :] = False
+            m_strict = encode_requirements_np(
+                self.encoder.vocab,
+                [
+                    Requirements.from_pod(reps[u], include_preferred=False)
+                    for u in miss
+                ],
+                k_pad, v_pad, self.encoder.skip_keys, row_memo=row_memo,
+            )
+            m_allow = self.encoder.it_allow_mask(miss_reqs, self.catalog)
+            if m_allow.shape[1] != self._T_pad:  # sharded catalog padding
+                m_allow = np.pad(
+                    m_allow,
+                    ((0, 0), (0, self._T_pad - m_allow.shape[1])),
+                    constant_values=False,
+                )
+            for j, u in enumerate(miss):
+                p = reps[u]
+                # hostname selectors can never match a not-yet-named node
+                if not self.encoder.hostname_allows(miss_reqs[j], None):
+                    m_allow[j, :] = False
+                bundle = dict(
+                    reqs=tuple(a[j] for a in m_enc),
+                    strict=tuple(a[j] for a in m_strict),
+                    requests=self.encoder.resources_vector(p.total_requests()),
+                    it_allow=m_allow[j],
+                    tol=np.array(
+                        [
+                            tolerates_all(t.taints, p.spec.tolerations) is None
+                            for t in self.templates
+                        ],
+                        dtype=bool,
+                    ),
+                )
+                bundles[u] = bundle
+                if cache is not None:
+                    cache[rep_sigs[u]] = bundle
+        if n_hits:
+            from karpenter_tpu.utils.metrics import ENCODE_CACHE_HITS
+
+            ENCODE_CACHE_HITS.inc(n_hits)
+        from karpenter_tpu.ops.encode import ReqSetTensors as _RST
+
+        reqs_k = _RST(
+            *(jnp.asarray(np.stack([b["reqs"][i] for b in bundles])) for i in range(6))
+        )
+        strict_reqs_k = _RST(
+            *(jnp.asarray(np.stack([b["strict"][i] for b in bundles])) for i in range(6))
+        )
+        it_allow_k = np.stack([b["it_allow"] for b in bundles])
+        requests_k = np.stack([b["requests"] for b in bundles])
+        tol_k = np.stack([b["tol"] for b in bundles])
         # static pod×existing-node checks for the skipped keys + taints
+        # (node-dependent: never cached; the Requirements rebuild only
+        # runs when existing nodes are present)
         E = exist_tensors.avail.shape[0]
         exist_ok_k = np.zeros((U, E), dtype=bool)
+        if self.existing_nodes:
+            for u in range(U):
+                if rep_req_sets[u] is None:
+                    rep_req_sets[u] = self._pod_reqs(reps[u])
         for e, n in enumerate(self.existing_nodes):
             hostname = n.requirements.get(l.LABEL_HOSTNAME).any_value() or None
             it_name = (
@@ -1122,13 +1266,6 @@ class TPUScheduler:
                     r = rq.get(l.LABEL_INSTANCE_TYPE)
                     ok = r.has(it_name) if it_name is not None else r.is_lenient()
                 exist_ok_k[u, e] = ok
-        strict_sets = [Requirements.from_pod(p, include_preferred=False) for p in reps]
-        strict_reqs_k = encode_requirements(
-            self.encoder.vocab, strict_sets, k_pad, v_pad, self.encoder.skip_keys
-        )
-        requests_k = np.stack(
-            [self.encoder.resources_vector(p.total_requests()) for p in reps]
-        )
         # topology tensors (counts + per-kind group relations); the hostname
         # slot space gets one spare column so tier-3's fresh-slot read stays
         # in bounds when every claim slot is open
@@ -1146,11 +1283,7 @@ class TPUScheduler:
         pod_topo_k, pod_topo_host = topo_ops.encode_pod_topology(
             self.topology, vg, hg, reps, strict_reqs_k
         )
-        # toleration matrix [U, G] host-side: taint sets are static per template
-        tol_k = np.zeros((U, len(self.templates)), dtype=bool)
-        for u, p in enumerate(reps):
-            for g, t in enumerate(self.templates):
-                tol_k[u, g] = tolerates_all(t.taints, p.spec.tolerations) is None
+        # (the [U, G] toleration matrix rides the per-kind encode bundles)
 
         # host-port vocabulary + wildcard-expanded conflict masks
         from karpenter_tpu.scheduling import hostports as hostports_mod
@@ -1188,7 +1321,15 @@ class TPUScheduler:
         for e, n in enumerate(self.existing_nodes):
             for key in n.host_ports:
                 exist_ports0[e, port_index[key]] = True
-        exist_tensors = exist_tensors._replace(ports=jnp.asarray(exist_ports0))
+        # bitset packing: port columns ride as uint32 lanes so the per-step
+        # conflict tests are fused bitwise ops (kernels.packed_conflict)
+        from karpenter_tpu.ops.kernels import pack_bool_np
+
+        pod_ports_k = pack_bool_np(pod_ports_k)
+        pod_port_conf_k = pack_bool_np(pod_port_conf_k)
+        exist_tensors = exist_tensors._replace(
+            ports=jnp.asarray(pack_bool_np(exist_ports0))
+        )
 
         # ---- CSI attach limits (volumeusage.go:187-229) --------------------
         # A (driver, pvc) column vocabulary shared by node usage and pod
@@ -1275,10 +1416,13 @@ class TPUScheduler:
             exist_vols0 = np.zeros((E, 1), dtype=bool)
             vol_limits0 = np.full((E, 1), np.inf, dtype=np.float32)
             pod_vols_k = np.zeros((U, 1), dtype=bool)
+        # volume bitsets pack like ports; vol_driver becomes a per-driver
+        # packed column mask ([ND, NVp]) for the popcount distinct-PVC count
+        pod_vols_k = pack_bool_np(pod_vols_k)
         exist_tensors = exist_tensors._replace(
-            vols=jnp.asarray(exist_vols0),
+            vols=jnp.asarray(pack_bool_np(exist_vols0)),
             vol_limits=jnp.asarray(vol_limits0),
-            vol_driver=jnp.asarray(vol_driver0),
+            vol_driver=jnp.asarray(pack_bool_np(vol_driver0.T)),
         )
 
         zone_kid, ct_kid = self.encoder.zone_ct_key_ids()
@@ -1375,6 +1519,7 @@ class TPUScheduler:
             zone_kid=zone_kid,
             ct_kid=ct_kid,
             n_claims=n_claims,
+            window=window,
             topo_kids=topo_kids,
             E=E,
             P=P,
@@ -1450,6 +1595,7 @@ class TPUScheduler:
         state = ops_solver.initial_state(
             exist_tensors, self.it_tensors, template_tensors, topo_tensors,
             n_claims, int(enc["ports_k"].shape[1]), self._res_cap0,
+            window=enc["window"], topo_kids=enc["topo_kids"],
         )
         # group consecutive segments into maximal same-mode runs; kind-scan
         # runs additionally split per topology key (the key is a static
@@ -1501,10 +1647,39 @@ class TPUScheduler:
         from karpenter_tpu.tracing.tracer import TRACER
 
         _trace_on = TRACER.enabled
+        # compaction bookkeeping: r_min over the pods a boundary has NOT
+        # yet dispatched decides which resident claims are capacity-dead
+        requests_np = np.asarray(enc["requests_k"], dtype=np.float32)
+        remaining = np.zeros(requests_np.shape[0], dtype=np.int64)
+        for _m, _segs in runs:
+            for lo_, hi_, k_ in _segs:
+                remaining[k_] += hi_ - lo_
+        # Boundary compaction runs when the solve is windowed, and ALSO on
+        # large un-windowed solves: eviction is what makes w_hw measure
+        # TRUE residency, which the next warm solve's window sizing feeds
+        # on (otherwise live == opens and the adaptive window could never
+        # undercut the claims axis). Small solves skip it — the extra
+        # dispatch + executable isn't worth a sub-second scan.
+        window_active = (
+            enc["window"] < n_claims or enc["P"] >= self.compact_min_pods
+        )
+        self._n_compactions = 0
+
+        def _maybe_compact(st):
+            if not window_active or not (remaining > 0).any():
+                return st
+            r_min = requests_np[remaining > 0].min(axis=0)
+            st, _closed = ops_solver.compact_state(
+                st, self.it_tensors, jnp.asarray(r_min), n_claims,
+                topo_kids=enc["topo_kids"],
+            )
+            self._n_compactions += 1
+            return st
+
         outputs: list[tuple] = []
-        tmpl_snaps: list = []  # post-dispatch state.template per output:
-        # the pipelined decode opens claims before the final state lands,
-        # and a slot's template is fixed the moment the claim opens
+        tmpl_snaps: list = []  # post-dispatch GLOBAL template snapshot per
+        # output: the pipelined decode opens claims before the final state
+        # lands, and a slot's template is fixed the moment the claim opens
         for mode, segs in runs:
             if _trace_on:
                 import time as _time
@@ -1537,8 +1712,13 @@ class TPUScheduler:
                     zone_kid=enc["zone_kid"], ct_kid=enc["ct_kid"],
                     n_claims=n_claims,
                 )
-                outputs.append(("fill", segs, ys))
-                tmpl_snaps.append(state.template)
+                # fill grids address WINDOW rows; the decode maps them to
+                # global claim ids via this dispatch's slot_of snapshot
+                outputs.append(("fill", segs, ys, state.slot_of))
+                tmpl_snaps.append(ops_solver.global_template(state))
+                for lo_, hi_, k_ in segs:
+                    remaining[k_] -= hi_ - lo_
+                state = _maybe_compact(state)
             elif mode[0] == "kscan":
                 # exact B: a padded segment would run the full-width
                 # precompute for nothing (the inner loop already has a
@@ -1567,7 +1747,10 @@ class TPUScheduler:
                     maxc=maxc,
                 )
                 outputs.append(("kscan", segs, ys))
-                tmpl_snaps.append(state.template)
+                tmpl_snaps.append(ops_solver.global_template(state))
+                for lo_, hi_, k_ in segs:
+                    remaining[k_] -= hi_ - lo_
+                state = _maybe_compact(state)
             else:
                 lo, hi = segs[0][0], segs[-1][1]
                 for clo in range(lo, hi, chunk):
@@ -1587,7 +1770,9 @@ class TPUScheduler:
                     )
                     state = res.claims
                     outputs.append(("pods", clo, clo + L, res.assignment))
-                    tmpl_snaps.append(state.template)
+                    tmpl_snaps.append(ops_solver.global_template(state))
+                    np.subtract.at(remaining, kind_of[clo : clo + L], 1)
+                    state = _maybe_compact(state)
             if _trace_on:
                 # per-mode child spans: dispatch cost only — the device
                 # runs async, so the wait shows up under solve.wire
@@ -1703,15 +1888,15 @@ class TPUScheduler:
             else:
                 ys = o[2]
                 flat.extend(
-                    [ys.fill_c, ys.fill_e, ys.open_start, ys.n_opened, ys.status]
+                    [ys.fill_c, ys.fill_e, ys.open_start, ys.n_opened, ys.status, o[3]]
                 )
                 specs.append(("fill", len(o[1])))
                 weights.append(sum(hi - lo for lo, hi, _ in o[1]))
             flat_spans.append((lo_f, len(flat)))
-        # prep-cache keys carry the pad signature and claims-axis size so
-        # a bucket change rebuilds the jitted prep instead of reusing a
-        # stale executable against resized tensors
-        pad_sig = self._pads() + (enc["n_claims"],)
+        # prep-cache keys carry the pad signature plus the claims-axis and
+        # window sizes so a bucket change rebuilds the jitted prep instead
+        # of reusing a stale executable against resized tensors
+        pad_sig = self._pads() + (enc["n_claims"], enc["window"])
 
         def _cached_prep(key, builder):
             prep = self._fetch_prep_cache.get(key)
@@ -1841,7 +2026,12 @@ class TPUScheduler:
             tier 2 in water-fill interleave order, tier 3 in slot order,
             leftovers last; f32 usage merges one multiply-add per
             (segment, node)). Multi-slot tier-2 interleaves are rare, so
-            they land as small permutation fixups on the repeated stream."""
+            they land as small permutation fixups on the repeated stream.
+
+            Fill grids address WINDOW rows; `slot_map` (this dispatch's
+            slot_of snapshot) translates them to global claim ids — the
+            tier-2/tier-3 split stays in window coordinates (open_start is
+            the segment's w_open), while every emitted slot is global."""
             lo0, hiN = segs[0][0], segs[-1][1]
             vals: list[int] = []  # E-space slot ids / negative sentinels
             cnts: list[int] = []
@@ -1856,12 +2046,14 @@ class TPUScheduler:
             open_start = f["open_start"]
             n_opened = f["n_opened"]
             status = f["status"]
+            slot_map = np.asarray(f["slot_map"], dtype=np.int64)
             pc = claim_pod_counts
-            # ONE nonzero scan over the whole [B, S] grid; per-segment
-            # (slot, count) pairs come from the row-pointer slices
+            # ONE nonzero scan over the whole [B, W] grid; per-segment
+            # (window row, count) pairs come from the row-pointer slices
             js, ss = np.nonzero(fill_c)
             cc = fill_c[js, ss].tolist()
             ss_l = ss.tolist()
+            gs_l = slot_map[ss].tolist() if ss.size else []
             row_ptr = np.searchsorted(js, np.arange(len(segs) + 1))
             for j, (lo, hi, kind) in enumerate(segs):
                 count = hi - lo
@@ -1878,38 +2070,39 @@ class TPUScheduler:
                         cnts += cl
                         placed += sum(cl)
                         exist_merges.append((kind, el, cl))
-                # touched claim slots, ascending (np.nonzero row-major)
+                # touched window rows, ascending (np.nonzero row-major;
+                # window order is open order, so global ids ascend too)
                 a, b = int(row_ptr[j]), int(row_ptr[j + 1])
-                pairs = list(zip(ss_l[a:b], cc[a:b]))
+                pairs = list(zip(ss_l[a:b], gs_l[a:b], cc[a:b]))
                 new_lo = int(open_start[j])
                 new_hi = new_lo + int(n_opened[j])
                 # tier 2: water-fill interleave over in-flight claims
-                t2 = [(s, c) for s, c in pairs if not new_lo <= s < new_hi]
+                t2 = [(g_, c) for s, g_, c in pairs if not new_lo <= s < new_hi]
                 if t2:
                     if len(t2) > 1:
                         fixups.append(
                             (
                                 lo - lo0 + placed,
-                                [s for s, _ in t2],
+                                [g_ for g_, _ in t2],
                                 [c for _, c in t2],
-                                [int(pc[s]) for s, _ in t2],
+                                [int(pc[g_]) for g_, _ in t2],
                             )
                         )
-                    for s, c in t2:
-                        vals.append(E + s)
+                    for g_, c in t2:
+                        vals.append(E + g_)
                         cnts.append(c)
-                        pc[s] += c
+                        pc[g_] += c
                         placed += c
-                        claim_events.append((s, kind, c))
+                        claim_events.append((g_, kind, c))
                 # tier 3: new claims in slot order, each filled to capacity
                 if new_hi > new_lo:
-                    for s, c in pairs:
+                    for s, g_, c in pairs:
                         if new_lo <= s < new_hi:
-                            vals.append(E + s)
+                            vals.append(E + g_)
                             cnts.append(c)
-                            pc[s] += c
+                            pc[g_] += c
                             placed += c
-                            claim_events.append((s, kind, c))
+                            claim_events.append((g_, kind, c))
                 # leftovers failed with a uniform reason
                 left = count - placed
                 if left > 0:
@@ -2004,17 +2197,65 @@ class TPUScheduler:
                     )
                     unschedulable.append((pods_sorted[lo0 + i], reason))
 
+        def apply_assignments(idx0: int, arr: np.ndarray) -> None:
+            """Vectorized per-pod decode: arr[i] is pod (idx0+i)'s E-space
+            slot (global claim ids) or a negative sentinel. Claims apply
+            grouped by slot (stable order -> per-claim pod order matches
+            the sequential replay; new slots ascend, so ensure_claim order
+            and hostnames match too); existing-node landings keep the
+            per-pod path (sequential f32 usage merges are order-exact);
+            failures append in pod order."""
+            cm = arr >= E
+            if cm.any():
+                ci = np.flatnonzero(cm)
+                cs = arr[ci] - E
+                o = np.argsort(cs, kind="stable")
+                cs_s = cs[o]
+                ci_s = ci[o] + idx0
+                bounds = np.flatnonzero(np.diff(cs_s)) + 1
+                starts = np.concatenate(([0], bounds))
+                ends = np.concatenate((bounds, [len(cs_s)]))
+                for a, b in zip(starts.tolist(), ends.tolist()):
+                    s = int(cs_s[a])
+                    claim = ensure_claim(s)
+                    il = ci_s[a:b].tolist()
+                    batch = [pods_sorted[i] for i in il]
+                    claim.pods.extend(batch)
+                    ck = claim_kinds[s]
+                    for i, p in zip(il, batch):
+                        assignments[p.metadata.uid] = s
+                        k = int(kind_of[i])
+                        ck[k] = ck.get(k, 0) + 1
+                        pk = kind_ports(k)
+                        if pk:
+                            claim.host_ports.extend(pk)
+                    claim_pod_counts[s] += b - a
+            em = (arr >= 0) & (arr < E)
+            if em.any():
+                for i in np.flatnonzero(em).tolist():
+                    decode_pod(idx0 + i, int(arr[i]))
+            nm = arr < 0
+            if nm.any():
+                for i in np.flatnonzero(nm).tolist():
+                    reason = (
+                        NO_ROOM_REASON
+                        if arr[i] == ops_solver.NO_ROOM
+                        else NO_CLAIM_REASON
+                    )
+                    unschedulable.append((pods_sorted[idx0 + i], reason))
+
         def apply_output(out) -> None:
             if out[0] == "pods":
                 _, lo, hi, assignment = out
-                for i in range(lo, hi):
-                    decode_pod(i, int(assignment[i - lo]))
+                apply_assignments(
+                    lo, np.asarray(assignment[: hi - lo], dtype=np.int64)
+                )
             elif out[0] == "kscan":
                 _, segs, assign = out
                 for j, (lo, hi, _kind) in enumerate(segs):
-                    row = assign[j]
-                    for i in range(lo, hi):
-                        decode_pod(i, int(row[i - lo]))
+                    apply_assignments(
+                        lo, np.asarray(assign[j][: hi - lo], dtype=np.int64)
+                    )
             else:
                 decode_fill_output(out[1], out[2])
 
@@ -2034,6 +2275,7 @@ class TPUScheduler:
                     "open_start": next(it_f),
                     "n_opened": next(it_f),
                     "status": next(it_f),
+                    "slot_map": next(it_f),
                 },
             ), True
 
@@ -2103,13 +2345,7 @@ class TPUScheduler:
                 fetched_flat = fetch_tree(prep(state, flat))
             self._t_fetch_done = _time.perf_counter()
             it_f = iter(fetched_flat)
-            fetched = dict(
-                template=next(it_f),
-                its=next(it_f),
-                used=next(it_f),
-                held=next(it_f),
-                n_open=next(it_f),
-            )
+            fetched = {name: next(it_f) for name in _STATE_HEAD}
             new_outputs = []
             any_fill = False
             for o, spec in zip(outputs, specs):
@@ -2196,17 +2432,11 @@ class TPUScheduler:
                     ("final", tk, pad_sig), lambda: _make_final_prep(tk)
                 )
                 t0 = _time.perf_counter()
-                with TRACER.span("solve.wire", arrays=5 + 6 * bool(tk)):
+                with TRACER.span("solve.wire", arrays=len(_STATE_HEAD) + 6 * bool(tk)):
                     fetched_flat = fetch_tree(prep(state))
                 t_final = _time.perf_counter() - t0
                 it_f = iter(fetched_flat)
-                fetched = dict(
-                    template=next(it_f),
-                    its=next(it_f),
-                    used=next(it_f),
-                    held=next(it_f),
-                    n_open=next(it_f),
-                )
+                fetched = {name: next(it_f) for name in _STATE_HEAD}
                 if tk:
                     for name in ("c_mask", "c_inf", "c_def", "e_mask", "e_inf", "e_def"):
                         fetched[name] = next(it_f)
@@ -2252,6 +2482,24 @@ class TPUScheduler:
                     ],
                 }
         self._last_n_open = int(fetched["n_open"])
+        self._last_w_hw = int(fetched["w_hw"])
+        # claims-axis occupancy for the bench/gates: live high-water vs the
+        # window, frozen-bank size, spill count (window-bound NO_ROOMs)
+        n_spills = int(fetched["spills"])
+        self._scan_stats = {
+            "window": int(enc["window"]),
+            "n_claims": int(enc["n_claims"]),
+            "n_open": int(fetched["n_open"]),
+            "live_hw": int(fetched["w_hw"]),
+            "resident": int(fetched["w_open"]),
+            "frozen": int(fetched["n_open"]) - int(fetched["w_open"]),
+            "spills": n_spills,
+            "compactions": int(getattr(self, "_n_compactions", 0)),
+        }
+        if n_spills:
+            from karpenter_tpu.utils.metrics import SCAN_WINDOW_SPILLS
+
+            SCAN_WINDOW_SPILLS.inc(n_spills)
 
         # ---- finalization from device state --------------------------------
         def fold_narrowing(reqs: Requirements, mask_r, inf_r, def_r, what: str):

@@ -178,6 +178,72 @@ class ReqSetTensors(NamedTuple):
         return self.mask.shape[0]
 
 
+def _requirement_row(vocab: Vocab, k: int, r, V: int, memo: dict) -> np.ndarray:
+    """[V] bool admitted-value row for one requirement at key id k.
+
+    Rows are memoized by requirement CONTENT — deployment-shaped problems
+    repeat the same selectors across hundreds of kinds, so each distinct
+    requirement encodes once per pass. Bound-free requirements take a
+    vectorized id-indexing path instead of the O(V) per-value has() loop
+    (the host-side encode kind pass's former hot spot)."""
+    vals = vocab.values[k]
+    key = (k, r.complement, r.gte, r.lte, frozenset(r.values))
+    row = memo.get(key)
+    if row is not None:
+        return row
+    row = np.zeros(V, dtype=bool)
+    if r.gte is None and r.lte is None:
+        ids = [vocab.value_to_id[k][v] for v in r.values if v in vocab.value_to_id[k]]
+        if r.complement:
+            row[: len(vals)] = True
+            row[ids] = False
+        else:
+            row[ids] = True
+    else:
+        for vid, value in enumerate(vals):
+            row[vid] = r.has(value)
+    memo[key] = row
+    return row
+
+
+def encode_requirements_np(
+    vocab: Vocab,
+    req_sets: Sequence[Requirements],
+    k_pad: Optional[int] = None,
+    v_pad: Optional[int] = None,
+    skip_keys: frozenset[str] = frozenset(),
+    row_memo: Optional[dict] = None,
+) -> tuple[np.ndarray, ...]:
+    """Host-array twin of encode_requirements: returns the six component
+    arrays as numpy (mask, inf, excl, gte, lte, defined) so callers can
+    cache/assemble rows without device round trips."""
+    B = len(req_sets)
+    K = k_pad or max(vocab.n_keys, 1)
+    V = v_pad or max(vocab.max_values, 1)
+    mask = np.ones((B, K, V), dtype=bool)
+    inf = np.ones((B, K), dtype=bool)
+    excl = np.zeros((B, K), dtype=bool)
+    gte = np.full((B, K), INT_MIN, dtype=np.int32)
+    lte = np.full((B, K), INT_MAX, dtype=np.int32)
+    defined = np.zeros((B, K), dtype=bool)
+    memo: dict = row_memo if row_memo is not None else {}
+    # padding key slots beyond the vocab stay at the identity encoding
+    for b, reqs in enumerate(req_sets):
+        for r in reqs:
+            if r.key in skip_keys:
+                continue
+            k = vocab.key_to_id[r.key]
+            # vocab slots beyond this key's value count are not real values
+            mask[b, k] = _requirement_row(vocab, k, r, V, memo)
+            inf[b, k] = r.complement
+            excl[b, k] = r.complement and bool(r.values)
+            # saturating clamp to int32 on both sides
+            gte[b, k] = min(max(r.gte, INT_MIN), INT_MAX) if r.gte is not None else INT_MIN
+            lte[b, k] = min(max(r.lte, INT_MIN), INT_MAX) if r.lte is not None else INT_MAX
+            defined[b, k] = True
+    return mask, inf, excl, gte, lte, defined
+
+
 def encode_requirements(
     vocab: Vocab,
     req_sets: Sequence[Requirements],
@@ -193,33 +259,9 @@ def encode_requirements(
     semantics by other means — see ProblemEncoder's instance-type-name
     special-casing).
     """
-    B = len(req_sets)
-    K = k_pad or max(vocab.n_keys, 1)
-    V = v_pad or max(vocab.max_values, 1)
-    mask = np.ones((B, K, V), dtype=bool)
-    inf = np.ones((B, K), dtype=bool)
-    excl = np.zeros((B, K), dtype=bool)
-    gte = np.full((B, K), INT_MIN, dtype=np.int32)
-    lte = np.full((B, K), INT_MAX, dtype=np.int32)
-    defined = np.zeros((B, K), dtype=bool)
-    # padding key slots beyond the vocab stay at the identity encoding
-    for b, reqs in enumerate(req_sets):
-        for r in reqs:
-            if r.key in skip_keys:
-                continue
-            k = vocab.key_to_id[r.key]
-            vals = vocab.values[k]
-            row = np.zeros(V, dtype=bool)
-            for vid, value in enumerate(vals):
-                row[vid] = r.has(value)
-            # vocab slots beyond this key's value count are not real values
-            mask[b, k] = row
-            inf[b, k] = r.complement
-            excl[b, k] = r.complement and bool(r.values)
-            # saturating clamp to int32 on both sides
-            gte[b, k] = min(max(r.gte, INT_MIN), INT_MAX) if r.gte is not None else INT_MIN
-            lte[b, k] = min(max(r.lte, INT_MIN), INT_MAX) if r.lte is not None else INT_MAX
-            defined[b, k] = True
+    mask, inf, excl, gte, lte, defined = encode_requirements_np(
+        vocab, req_sets, k_pad, v_pad, skip_keys
+    )
     return ReqSetTensors(
         mask=jnp.asarray(mask),
         inf=jnp.asarray(inf),

@@ -227,6 +227,90 @@ def update_set_at(r: ReqSetTensors, idx, value: ReqSetTensors) -> ReqSetTensors:
     )
 
 
+# ---------------------------------------------------------------------------
+# Packed boolean bitsets
+# ---------------------------------------------------------------------------
+# Host-port and CSI-volume bitsets ([*, NP] / [*, NV] bool) only ever see
+# three operations in the solve kernels: conflict tests (any(a & b)),
+# union updates (a | b) and per-group popcounts. Packing 32 columns into
+# one uint32 lane shrinks both the carry bytes and the per-step VPU work
+# by 32x, and each test fuses into a single bitwise op + reduce.
+
+PACK_LANE = 32
+
+
+def packed_width(n: int) -> int:
+    """uint32 lanes needed for an n-column bitset (>= 1)."""
+    return max(-(-n // PACK_LANE), 1)
+
+
+def pack_bool_np(a) -> "np.ndarray":
+    """Host-side packer: [..., N] bool -> [..., ceil(N/32)] uint32, column
+    j landing in lane j//32 at bit j%32 (little-endian within the lane)."""
+    import numpy as np
+
+    a = np.asarray(a, dtype=bool)
+    n = a.shape[-1]
+    lanes = packed_width(n)
+    pad = lanes * PACK_LANE - n
+    if pad:
+        a = np.concatenate(
+            [a, np.zeros(a.shape[:-1] + (pad,), dtype=bool)], axis=-1
+        )
+    bits = a.reshape(a.shape[:-1] + (lanes, PACK_LANE)).astype(np.uint32)
+    weights = (np.uint32(1) << np.arange(PACK_LANE, dtype=np.uint32))
+    return (bits * weights).sum(axis=-1, dtype=np.uint32)
+
+
+def pack_bool(a: jnp.ndarray) -> jnp.ndarray:
+    """Device-side twin of pack_bool_np (same lane/bit layout)."""
+    n = a.shape[-1]
+    lanes = packed_width(n)
+    pad = lanes * PACK_LANE - n
+    if pad:
+        a = jnp.concatenate(
+            [a, jnp.zeros(a.shape[:-1] + (pad,), dtype=bool)], axis=-1
+        )
+    bits = a.reshape(a.shape[:-1] + (lanes, PACK_LANE)).astype(jnp.uint32)
+    weights = jnp.uint32(1) << jnp.arange(PACK_LANE, dtype=jnp.uint32)
+    return jnp.sum(bits * weights, axis=-1, dtype=jnp.uint32)
+
+
+def unpack_bool(packed: jnp.ndarray, n: int) -> jnp.ndarray:
+    """[..., L] uint32 -> [..., n] bool (inverse of pack_bool)."""
+    lanes = packed.shape[-1]
+    shifts = jnp.arange(PACK_LANE, dtype=jnp.uint32)
+    bits = (packed[..., :, None] >> shifts) & jnp.uint32(1)
+    flat = bits.reshape(packed.shape[:-1] + (lanes * PACK_LANE,))
+    return flat[..., :n].astype(bool)
+
+
+def packed_conflict(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """[...] bool — any(a & b) over the packed trailing axis (the fused
+    test half of every port-conflict / volume-overlap check)."""
+    return jnp.any((a & b) != 0, axis=-1)
+
+
+def packed_any(a: jnp.ndarray) -> jnp.ndarray:
+    """[...] bool — any set bit over the packed trailing axis."""
+    return jnp.any(a != 0, axis=-1)
+
+
+def packed_or(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Union update (the fused update half of test-and-update)."""
+    return a | b
+
+
+def packed_count_and(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """[...] int32 — popcount(a & b) over the packed trailing axis; exact
+    (integer) twin of the bf16 membership einsum it replaces."""
+    import jax
+
+    return jnp.sum(
+        jax.lax.population_count(a & b).astype(jnp.int32), axis=-1
+    )
+
+
 def value_allowed(r: ReqSetTensors, key_id: int, value_ids: jnp.ndarray) -> jnp.ndarray:
     """[B, ...] bool — does each set admit vocab value value_ids of key_id?
 

@@ -71,49 +71,78 @@ class Templates(NamedTuple):
 
 class ExistingNodes(NamedTuple):
     """Existing/in-flight real nodes (tier 1). reqs seed from node labels;
-    avail is allocatable minus current pods and daemon overhead."""
+    avail is allocatable minus current pods and daemon overhead. Port and
+    volume bitsets ride as packed uint32 bitfields (kernels.pack_bool_np
+    layout) so the per-step conflict tests are fused bitwise ops."""
 
     reqs: ReqSetTensors  # [E, K, V]
     avail: jnp.ndarray  # [E, R] f32 — remaining schedulable resources
     valid: jnp.ndarray  # [E] bool
-    ports: jnp.ndarray  # [E, NP] bool — host ports already in use
+    ports: jnp.ndarray  # [E, NPp] uint32 — host ports already in use (packed)
     # CSI attach limits (volumeusage.go:201-208): distinct-PVC columns over
     # a (driver, pvc) vocabulary; resident volumes seed vols, per-driver
     # limits are +inf when the node publishes none
-    vols: jnp.ndarray  # [E, NV] bool — PVCs already attached
+    vols: jnp.ndarray  # [E, NVp] uint32 — PVCs already attached (packed)
     vol_limits: jnp.ndarray  # [E, ND] f32 — per-driver attach caps
-    vol_driver: jnp.ndarray  # [NV, ND] bool — column -> driver one-hot
+    vol_driver: jnp.ndarray  # [ND, NVp] uint32 — per-driver packed column mask
 
 
 class SolverState(NamedTuple):
-    """The scan carry."""
+    """The scan carry.
+
+    The claims axis is an ACTIVE WINDOW: hot per-claim tensors (reqs, its,
+    used, ports, held, ...) cover only W resident open claims, not the
+    full logical claim space [0, NCAP). `slot_of` maps window rows to
+    global claim ids (NCAP sentinel = unused row); `n_open` counts global
+    opens while `w_open` counts window residents. Claims that can never
+    take another pod are evicted between dispatches (compact_state) into
+    the append-only frozen bank — global-id-indexed decode columns the
+    scan step never rescans. Hostname-group counts stay global-slot
+    indexed, so frozen claims keep constraining topology."""
 
     # tier-1 existing nodes
     exist_reqs: ReqSetTensors  # [E, K, V] — evolve as pods land
     exist_used: jnp.ndarray  # [E, R]
-    # tier-2 in-flight claims
-    reqs: ReqSetTensors  # [N, K, V]
-    used: jnp.ndarray  # [N, R]
-    its: jnp.ndarray  # [N, T] bool
-    template: jnp.ndarray  # [N] int32
-    open: jnp.ndarray  # [N] bool
-    pods: jnp.ndarray  # [N] int32
-    n_open: jnp.ndarray  # [] int32
+    # tier-2 in-flight claims (hot window, axis W)
+    reqs: ReqSetTensors  # [W, K, V]
+    used: jnp.ndarray  # [W, R]
+    its: jnp.ndarray  # [W, T] bool
+    template: jnp.ndarray  # [W] int32
+    open: jnp.ndarray  # [W] bool
+    pods: jnp.ndarray  # [W] int32
+    n_open: jnp.ndarray  # [] int32 — global claims opened (next global id)
+    # window bookkeeping
+    slot_of: jnp.ndarray  # [W] i32 — global claim id per row (NCAP = unused)
+    w_open: jnp.ndarray  # [] i32 — open claims resident in the window
+    w_hw: jnp.ndarray  # [] i32 — high-water of w_open (window sizing)
+    spills: jnp.ndarray  # [] i32 — opens refused because the window was full
+    # frozen bank (global claim axis NCAP): decode-only columns of closed
+    # claims, written once at eviction, never rescanned
+    bank_frozen: jnp.ndarray  # [NCAP] bool
+    bank_template: jnp.ndarray  # [NCAP] i32
+    bank_its: jnp.ndarray  # [NCAP, T] bool
+    bank_used: jnp.ndarray  # [NCAP, R] f32
+    bank_held: jnp.ndarray  # [NCAP, RID] bool
+    # vg-narrowed requirement rows at the topology keys (decode's
+    # fold_narrowing inputs; TK = max(len(topo_kids), 1))
+    bank_tk_mask: jnp.ndarray  # [NCAP, TK, V] bool
+    bank_tk_inf: jnp.ndarray  # [NCAP, TK] bool
+    bank_tk_def: jnp.ndarray  # [NCAP, TK] bool
     # limits
     budget: jnp.ndarray  # [G, R]
     nodes_budget: jnp.ndarray  # [G]
     # topology counts
     vg_counts: jnp.ndarray  # [NGv, V]
-    hg_counts: jnp.ndarray  # [NGh, E+N]
-    # host ports in use (hostportusage.go:35-97)
-    exist_ports: jnp.ndarray  # [E, NP] bool
-    claim_ports: jnp.ndarray  # [N, NP] bool
+    hg_counts: jnp.ndarray  # [NGh, E+NCAP+1] — global hostname slots
+    # host ports in use (hostportusage.go:35-97), packed bitfields
+    exist_ports: jnp.ndarray  # [E, NPp] uint32
+    claim_ports: jnp.ndarray  # [W, NPp] uint32
     # distinct PVCs attached per existing node (volumeusage.go:187-229);
     # claims have no CSINode, so no claim-side twin exists
-    exist_vols: jnp.ndarray  # [E, NV] bool
+    exist_vols: jnp.ndarray  # [E, NVp] uint32
     # reserved-capacity twin (reservationmanager.go:28-115)
     res_cap: jnp.ndarray  # [RID] i32 — remaining capacity per reservation id
-    held: jnp.ndarray  # [N, RID] bool — reservations each claim holds
+    held: jnp.ndarray  # [W, RID] bool — reservations each claim holds
 
 
 class SolveResult(NamedTuple):
@@ -215,8 +244,11 @@ def _make_step(
     res_active: bool,
     res_strict: bool,
 ):
-    """Build the per-pod scan step closure shared by solve/solve_from."""
-    N = n_claims
+    """Build the per-pod scan step closure shared by solve/solve_from.
+    The claims axis it scans is the ACTIVE WINDOW (W = the carry's hot
+    claims axis, read off the state shapes at trace time); n_claims stays
+    the GLOBAL claim-space cap (hostname slots, bank width)."""
+    NCAP = n_claims
     K = it.reqs.mask.shape[1]
     E = exist.avail.shape[0]
     G = templates.its.shape[0]
@@ -269,6 +301,7 @@ def _make_step(
             hg_self,
             strict_mask,
         ) = xs
+        W = state.open.shape[0]
 
         # ---- tier 1: existing nodes (earliest index wins) -----------------
         pod_e = _broadcast_pod(pod_reqs, E)
@@ -287,20 +320,17 @@ def _make_step(
         topo_eh = topo_ops.hg_evaluate(
             topo, state.hg_counts, jnp.arange(E, dtype=jnp.int32), hg_applies, hg_self
         )
-        ports_ok_e = ~jnp.any(port_conf_p[None, :] & state.exist_ports, axis=-1)  # [E]
+        ports_ok_e = ~kernels.packed_conflict(port_conf_p[None, :], state.exist_ports)  # [E]
         # CSI attach limits: distinct PVCs per driver after the add must
         # stay within each node's published caps (volumeusage.go:201-208)
-        newv_e = state.exist_vols | vols_p[None, :]  # [E, NV]
-        vcount_e = jnp.einsum(
-            "ev,vd->ed",
-            newv_e.astype(jnp.bfloat16),
-            exist.vol_driver.astype(jnp.bfloat16),
-            preferred_element_type=jnp.float32,
-        )
+        newv_e = state.exist_vols | vols_p[None, :]  # [E, NVp]
+        vcount_e = kernels.packed_count_and(
+            newv_e[:, None, :], exist.vol_driver[None, :, :]
+        ).astype(jnp.float32)  # [E, ND]
         # volume-free pods skip the check entirely (the host gates on
         # `if pod_vols` — a node already OVER a shrunk cap still takes
         # podless-volume adds, volumeusage.go exceedsLimits call sites)
-        vols_ok_e = jnp.all(vcount_e <= exist.vol_limits, axis=-1) | ~jnp.any(vols_p)
+        vols_ok_e = jnp.all(vcount_e <= exist.vol_limits, axis=-1) | ~kernels.packed_any(vols_p)
         feas_e = (
             exist.valid
             & exist_ok_e
@@ -316,14 +346,16 @@ def _make_step(
         found_e = jnp.any(feas_e)
 
         # ---- tier 2: in-flight claims (fewest pods, earliest slot) --------
-        pod_b = _broadcast_pod(pod_reqs, N)
+        # the scan touches only the W window rows; hostname-group reads go
+        # through slot_of so frozen claims' counts still apply
+        pod_b = _broadcast_pod(pod_reqs, W)
         comb = kernels.intersect_sets(state.reqs, pod_b)
         claim_ok = kernels.compatible_elemwise(state.reqs, pod_b, well_known)
         topo_n, upd_n, _ = topo_ops.vg_evaluate(topo, vg_pre, comb.mask)
         topo_nh = topo_ops.hg_evaluate(
             topo,
             state.hg_counts,
-            E + jnp.arange(N, dtype=jnp.int32),
+            E + state.slot_of,
             hg_applies,
             hg_self,
         )
@@ -343,8 +375,8 @@ def _make_step(
         #                   the full pairwise intersects for this step.
         # Only claims that can be picked (open & Compatible) gate the
         # fallback; garbage values elsewhere are masked by feas/state.its.
-        eqP = kernels.set_eq_rows(comb_t, _broadcast_pod(pod_reqs, N))  # [N, K]
-        eqC = kernels.set_eq_rows(comb_t, state.reqs)  # [N, K]
+        eqP = kernels.set_eq_rows(comb_t, _broadcast_pod(pod_reqs, W))  # [W, K]
+        eqC = kernels.set_eq_rows(comb_t, state.reqs)  # [W, K]
         nonkid = ~kid_mask[None, :]
         need_exact = ~eqP & ~eqC & nonkid
         any_fallback = jnp.any(
@@ -376,7 +408,7 @@ def _make_step(
         fits_off = _fits_and_offering(total, comb_t, it, zone_kid, ct_kid)
         new_its = state.its & it_compat & fits_off & it_allow[None, :]
         tol = tmpl_ok_g[state.template]
-        ports_ok_n = ~jnp.any(port_conf_p[None, :] & state.claim_ports, axis=-1)  # [N]
+        ports_ok_n = ~kernels.packed_conflict(port_conf_p[None, :], state.claim_ports)  # [W]
         feas = (
             state.open
             & claim_ok
@@ -408,7 +440,10 @@ def _make_step(
                 )
         else:
             to_res = state.held  # unused; keeps shapes uniform
-        order_key = state.pods * jnp.int32(N) + jnp.arange(N, dtype=jnp.int32)
+        # fewest-pods-first with earliest-slot tie-break: window order is
+        # open order (compaction is stable), so relative comparisons match
+        # the un-windowed global-slot keys exactly
+        order_key = state.pods * jnp.int32(W) + jnp.arange(W, dtype=jnp.int32)
         pick = jnp.argmin(jnp.where(feas, order_key, BIG))
         found = jnp.any(feas)
 
@@ -465,15 +500,17 @@ def _make_step(
             to_res0 = jnp.zeros((G, state.held.shape[1]), dtype=bool)
         g = jnp.argmax(tmpl_feas)
         any_template = jnp.any(tmpl_feas) & pod_valid & ~found_e & ~found
-        can_open = any_template & (state.n_open < N)
+        can_open = any_template & (state.w_open < W) & (state.n_open < NCAP)
+        # a refusal with global capacity left is a WINDOW spill: the host
+        # escalates the window and re-solves (same NO_ROOM recovery path)
+        spilled = any_template & ~can_open & (state.n_open < NCAP)
 
         # ---- merge the three outcomes ----------------------------------------
-        open_slot = state.n_open
-        slot = jnp.where(
-            found_e,
-            pick_e,
-            jnp.where(found, E + pick, E + open_slot),
-        )
+        # assignments carry GLOBAL slots (decode is window-agnostic);
+        # carry updates address the window row cslot
+        open_slot = state.w_open
+        gslot = jnp.where(found, state.slot_of[pick], state.n_open)
+        slot = jnp.where(found_e, pick_e, E + gslot)
         place = found_e | found | can_open
         assignment = jnp.where(
             place,
@@ -515,9 +552,9 @@ def _make_step(
         )
         sel_template = jnp.where(found, state.template[pick], g.astype(jnp.int32))
 
-        # topology count commits for the winning candidate
+        # topology count commits for the winning candidate (global slots)
         final_reqs = kernels.select_set(found_e, kernels.take_set(comb_e_t, pick_e), sel_reqs)
-        slot_h = jnp.where(found_e, pick_e, E + cslot).astype(jnp.int32)
+        slot_h = jnp.where(found_e, pick_e, E + gslot).astype(jnp.int32)
         new_vg_counts = jnp.where(
             place,
             topo_ops.vg_commit(topo, state.vg_counts, final_reqs.mask, final_reqs.inf, vg_records),
@@ -544,7 +581,12 @@ def _make_step(
             state.claim_ports,
         )
         opened = can_open & ~found
-        new_n_open = state.n_open + jnp.where(opened, 1, 0).astype(jnp.int32)
+        opened_i = jnp.where(opened, 1, 0).astype(jnp.int32)
+        new_n_open = state.n_open + opened_i
+        new_w_open = state.w_open + opened_i
+        new_slot_of = jnp.where(
+            opened, state.slot_of.at[cslot].set(state.n_open), state.slot_of
+        )
 
         # reserved-capacity commit: reserve new ids, release dropped ones
         # (nodeclaim.go:260-262 Reserve + releaseReservedOfferings)
@@ -590,6 +632,18 @@ def _make_step(
                 open=new_open,
                 pods=new_pods,
                 n_open=new_n_open,
+                slot_of=new_slot_of,
+                w_open=new_w_open,
+                w_hw=jnp.maximum(state.w_hw, new_w_open),
+                spills=state.spills + jnp.where(spilled, 1, 0).astype(jnp.int32),
+                bank_frozen=state.bank_frozen,
+                bank_template=state.bank_template,
+                bank_its=state.bank_its,
+                bank_used=state.bank_used,
+                bank_held=state.bank_held,
+                bank_tk_mask=state.bank_tk_mask,
+                bank_tk_inf=state.bank_tk_inf,
+                bank_tk_def=state.bank_tk_def,
                 budget=new_budget,
                 nodes_budget=new_nodes_budget,
                 vg_counts=new_vg_counts,
@@ -614,38 +668,169 @@ def initial_state(
     n_claims: int,
     n_ports: int,
     res_cap0=None,
+    window: int = 0,
+    topo_kids: tuple = (),
 ) -> SolverState:
-    """The empty carry (no pods placed yet)."""
-    N = n_claims
+    """The empty carry (no pods placed yet). `window` bounds the hot
+    claims axis (0 = the full global space n_claims); `n_ports` is the
+    PACKED port-bitset lane count."""
+    NB = n_claims
+    W = min(window, NB) if window else NB
     K = it.reqs.mask.shape[1]
     V = it.reqs.mask.shape[2]
     R = it.alloc.shape[2]
     T = it.alloc.shape[0]
     E = exist.avail.shape[0]
+    RID = it.res_ofs.shape[1]
+    TK = max(len(topo_kids), 1)
     return SolverState(
         exist_reqs=exist.reqs,
         exist_used=jnp.zeros((E, R), dtype=jnp.float32),
-        reqs=identity_reqs(N, K, V),
-        used=jnp.zeros((N, R), dtype=jnp.float32),
-        its=jnp.zeros((N, T), dtype=bool),
-        template=jnp.zeros(N, dtype=jnp.int32),
-        open=jnp.zeros(N, dtype=bool),
-        pods=jnp.zeros(N, dtype=jnp.int32),
+        reqs=identity_reqs(W, K, V),
+        used=jnp.zeros((W, R), dtype=jnp.float32),
+        its=jnp.zeros((W, T), dtype=bool),
+        template=jnp.zeros(W, dtype=jnp.int32),
+        open=jnp.zeros(W, dtype=bool),
+        pods=jnp.zeros(W, dtype=jnp.int32),
         n_open=jnp.int32(0),
+        slot_of=jnp.full(W, NB, dtype=jnp.int32),
+        w_open=jnp.int32(0),
+        w_hw=jnp.int32(0),
+        spills=jnp.int32(0),
+        bank_frozen=jnp.zeros(NB, dtype=bool),
+        bank_template=jnp.zeros(NB, dtype=jnp.int32),
+        bank_its=jnp.zeros((NB, T), dtype=bool),
+        bank_used=jnp.zeros((NB, R), dtype=jnp.float32),
+        bank_held=jnp.zeros((NB, RID), dtype=bool),
+        bank_tk_mask=jnp.zeros((NB, TK, V), dtype=bool),
+        bank_tk_inf=jnp.zeros((NB, TK), dtype=bool),
+        bank_tk_def=jnp.zeros((NB, TK), dtype=bool),
         budget=templates.budget,
         nodes_budget=templates.nodes_budget,
         vg_counts=topo.vg_counts0,
         hg_counts=topo.hg_counts0,
         exist_ports=exist.ports,
-        claim_ports=jnp.zeros((N, n_ports), dtype=bool),
+        claim_ports=jnp.zeros((W, n_ports), dtype=jnp.uint32),
         exist_vols=exist.vols,
         res_cap=(
             jnp.asarray(res_cap0, dtype=jnp.int32)
             if res_cap0 is not None
-            else jnp.zeros(it.res_ofs.shape[1], dtype=jnp.int32)
+            else jnp.zeros(RID, dtype=jnp.int32)
         ),
-        held=jnp.zeros((N, it.res_ofs.shape[1]), dtype=bool),
+        held=jnp.zeros((W, RID), dtype=bool),
     )
+
+
+def _bank_rows(state: SolverState, idx: jnp.ndarray, topo_kids: tuple):
+    """Scatter the window's decode columns into the bank at global ids
+    `idx` (out-of-range sentinel rows drop)."""
+    out = dict(
+        bank_frozen=state.bank_frozen.at[idx].set(True, mode="drop"),
+        bank_template=state.bank_template.at[idx].set(state.template, mode="drop"),
+        bank_its=state.bank_its.at[idx].set(state.its, mode="drop"),
+        bank_used=state.bank_used.at[idx].set(state.used, mode="drop"),
+        bank_held=state.bank_held.at[idx].set(state.held, mode="drop"),
+    )
+    if topo_kids:
+        tk = list(topo_kids)
+        out.update(
+            bank_tk_mask=state.bank_tk_mask.at[idx].set(
+                state.reqs.mask[:, tk, :], mode="drop"
+            ),
+            bank_tk_inf=state.bank_tk_inf.at[idx].set(
+                state.reqs.inf[:, tk], mode="drop"
+            ),
+            bank_tk_def=state.bank_tk_def.at[idx].set(
+                state.reqs.defined[:, tk], mode="drop"
+            ),
+        )
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("n_claims", "topo_kids"))
+def compact_state(
+    state: SolverState,
+    it: InstanceTypeTensors,
+    r_min: jnp.ndarray,  # [R] f32 — elementwise min request over remaining pods
+    n_claims: int,
+    topo_kids: tuple = (),
+) -> tuple[SolverState, jnp.ndarray]:
+    """Evict capacity-dead claims from the active window into the frozen
+    bank, then stable-compact survivors to the front.
+
+    A claim is dead when no viable (type, group) cell fits used + r_min
+    under the step's total-based pass rule — every remaining pod requests
+    at least r_min elementwise, so the claim can never again pass the
+    tier-2 fits check (feasibility is an AND, hence eviction is sound and
+    the compacted solve stays bit-identical). Stable compaction preserves
+    open order, so the fewest-pods/earliest-slot tie-break is unchanged.
+    Returns (state', n_closed)."""
+    NB = n_claims
+    W = state.open.shape[0]
+    K = state.reqs.mask.shape[1]
+    V = state.reqs.mask.shape[2]
+    total = state.used + r_min[None, :]
+    t = total[:, None, None, :]
+    fit = jnp.all((t <= it.alloc[None]) | (t == 0.0), axis=-1)  # [W, T, GR]
+    alive_cap = jnp.any(
+        fit & it.group_valid[None] & state.its[:, :, None], axis=(1, 2)
+    )
+    close = state.open & ~alive_cap
+    bank = _bank_rows(state, jnp.where(close, state.slot_of, NB), topo_kids)
+    alive = state.open & ~close
+    perm = jnp.argsort(~alive, stable=True)
+    alive_p = alive[perm]
+    ident = identity_reqs(W, K, V)
+    reqs2 = kernels.select_set(alive_p, kernels.take_set(state.reqs, perm), ident)
+    return (
+        state._replace(
+            reqs=reqs2,
+            used=jnp.where(alive_p[:, None], state.used[perm], 0.0),
+            its=jnp.where(alive_p[:, None], state.its[perm], False),
+            template=jnp.where(alive_p, state.template[perm], 0),
+            open=alive_p,
+            pods=jnp.where(alive_p, state.pods[perm], 0),
+            slot_of=jnp.where(alive_p, state.slot_of[perm], NB),
+            w_open=jnp.sum(alive_p).astype(jnp.int32),
+            claim_ports=jnp.where(
+                alive_p[:, None], state.claim_ports[perm], jnp.uint32(0)
+            ),
+            held=jnp.where(alive_p[:, None], state.held[perm], False),
+            **bank,
+        ),
+        jnp.sum(close).astype(jnp.int32),
+    )
+
+
+@jax.jit
+def global_template(state: SolverState) -> jnp.ndarray:
+    """[NCAP] i32 — the global template column alone (the pipelined
+    decode's per-dispatch snapshot; a claim's template is fixed at open,
+    so merging window over bank is exact for every opened slot)."""
+    return state.bank_template.at[state.slot_of].set(state.template, mode="drop")
+
+
+@functools.partial(jax.jit, static_argnames=("topo_kids",))
+def global_claims(state: SolverState, topo_kids: tuple = ()) -> dict:
+    """Merge the hot window over the frozen bank into global-slot-indexed
+    decode columns (template/its/used/held [+ vg-narrowed topo-key rows]).
+    Window rows override bank rows at their global id; unused rows carry
+    the NB sentinel and drop."""
+    sl = state.slot_of
+    out = dict(
+        template=state.bank_template.at[sl].set(state.template, mode="drop"),
+        its=state.bank_its.at[sl].set(state.its, mode="drop"),
+        used=state.bank_used.at[sl].set(state.used, mode="drop"),
+        held=state.bank_held.at[sl].set(state.held, mode="drop"),
+    )
+    if topo_kids:
+        tk = list(topo_kids)
+        out.update(
+            tk_mask=state.bank_tk_mask.at[sl].set(state.reqs.mask[:, tk, :], mode="drop"),
+            tk_inf=state.bank_tk_inf.at[sl].set(state.reqs.inf[:, tk], mode="drop"),
+            tk_def=state.bank_tk_def.at[sl].set(state.reqs.defined[:, tk], mode="drop"),
+        )
+    return out
 
 
 def _xs(
@@ -682,6 +867,7 @@ _STATIC = (
     "res_vid",
     "res_active",
     "res_strict",
+    "window",
 )
 
 
@@ -710,9 +896,11 @@ def solve(
     res_vid: int = -1,
     res_active: bool = False,
     res_strict: bool = False,
+    window: int = 0,
 ) -> SolveResult:
     state = initial_state(
-        exist, it, templates, topo, n_claims, pod_ports.shape[1], res_cap0
+        exist, it, templates, topo, n_claims, pod_ports.shape[1], res_cap0,
+        window=window, topo_kids=topo_kids,
     )
     step = _make_step(
         exist, it, templates, well_known, topo, zone_kid, ct_kid, n_claims,
@@ -751,6 +939,7 @@ def solve_from(
     res_vid: int = -1,
     res_active: bool = False,
     res_strict: bool = False,
+    window: int = 0,  # unused here: the carry's shapes define the window
 ) -> SolveResult:
     """Resume the scan from an explicit carry — the chunked-solve entry:
     the host splits a large pod batch into fixed-size chunks (bounded
@@ -808,6 +997,7 @@ def solve_whatif(
     res_vid: int = -1,
     res_active: bool = False,
     res_strict: bool = False,
+    window: int = 0,
 ):
     """Batched consolidation what-ifs: S disruption scenarios solved in ONE
     device dispatch (the reference runs SimulateScheduling sequentially per
@@ -832,7 +1022,10 @@ def solve_whatif(
             requests=pods.requests[idx],
             valid=valid,
         )
-        state = initial_state(ex, it, templates, tp, n_claims, pod_ports.shape[1], res_cap0)
+        state = initial_state(
+            ex, it, templates, tp, n_claims, pod_ports.shape[1], res_cap0,
+            window=window, topo_kids=topo_kids,
+        )
         step = _make_step(
             ex, it, templates, well_known, tp, zone_kid, ct_kid, n_claims,
             mv_active, topo_kids, rid_kid, res_vid, res_active, res_strict,
@@ -904,9 +1097,10 @@ class FillYs(NamedTuple):
     assignments host-side)."""
 
     fill_e: jnp.ndarray  # [E] i32 — pods landed per existing node
-    fill_c: jnp.ndarray  # [N] i32 — pods landed per claim slot
-    open_start: jnp.ndarray  # [] i32 — n_open before this segment
-    n_opened: jnp.ndarray  # [] i32 — new claims opened (contiguous slots)
+    fill_c: jnp.ndarray  # [W] i32 — pods landed per WINDOW row (the host
+    # maps rows to global claim ids via the dispatch's slot_of snapshot)
+    open_start: jnp.ndarray  # [] i32 — w_open before this segment
+    n_opened: jnp.ndarray  # [] i32 — new claims opened (contiguous rows)
     tmpl: jnp.ndarray  # [] i32 — template of opened claims (-1 = none)
     leftover: jnp.ndarray  # [] i32 — pods that failed to place
     status: jnp.ndarray  # [] i32 — NO_CLAIM / NO_ROOM for the leftover
@@ -1119,7 +1313,7 @@ def _make_fill_step(
     ct_kid: int,
     n_claims: int,
 ):
-    N = n_claims
+    NCAP = n_claims
     E = exist.avail.shape[0]
     G = templates.its.shape[0]
     no_wk = jnp.zeros_like(well_known)
@@ -1143,15 +1337,16 @@ def _make_fill_step(
         )
 
     def step(state: SolverState, xs: FillXs):
+        W = state.open.shape[0]
         count = xs.count
         requests = xs.requests
-        self_conf = jnp.any(xs.ports & xs.port_conf)
+        self_conf = kernels.packed_conflict(xs.ports, xs.port_conf)
 
         # ---- tier 1: fill existing nodes in index order -------------------
         pod_e = _broadcast_pod(xs.reqs, E)
         comb_e = kernels.intersect_sets(state.exist_reqs, pod_e)
         compat_e = kernels.compatible_elemwise(state.exist_reqs, pod_e, no_wk)
-        ports_ok_e = ~jnp.any(xs.port_conf[None, :] & state.exist_ports, axis=-1)
+        ports_ok_e = ~kernels.packed_conflict(xs.port_conf[None, :], state.exist_ports)
         cap_res_e = _count_cap_seq(state.exist_used, requests[None, :], exist.avail)
         cap_topo_e = _hg_slot_caps(
             topo,
@@ -1167,14 +1362,11 @@ def _make_fill_step(
         # is count-independent — the node admits the kind iff the union
         # stays within every driver cap (volumeusage.go:201-208)
         newv_e = state.exist_vols | xs.vols[None, :]
-        vcount_e = jnp.einsum(
-            "ev,vd->ed",
-            newv_e.astype(jnp.bfloat16),
-            exist.vol_driver.astype(jnp.bfloat16),
-            preferred_element_type=jnp.float32,
-        )
+        vcount_e = kernels.packed_count_and(
+            newv_e[:, None, :], exist.vol_driver[None, :, :]
+        ).astype(jnp.float32)
         # volume-free kinds skip the check (host parity — see per-pod step)
-        vols_ok_e = jnp.all(vcount_e <= exist.vol_limits, axis=-1) | ~jnp.any(xs.vols)
+        vols_ok_e = jnp.all(vcount_e <= exist.vol_limits, axis=-1) | ~kernels.packed_any(xs.vols)
         feas_e = exist.valid & xs.exist_ok & compat_e & ports_ok_e & vols_ok_e
         cap_e = jnp.where(feas_e, cap_e, 0)
         cap_e = jnp.minimum(cap_e, count)
@@ -1185,27 +1377,31 @@ def _make_fill_step(
         landed_e = fill_e > 0
         new_exist_used = state.exist_used + fill_e[:, None].astype(jnp.float32) * requests[None, :]
         new_exist_reqs = kernels.select_set(landed_e, comb_e, state.exist_reqs)
-        new_exist_ports = state.exist_ports | (landed_e[:, None] & xs.ports[None, :])
-        new_exist_vols = state.exist_vols | (landed_e[:, None] & xs.vols[None, :])
+        new_exist_ports = jnp.where(
+            landed_e[:, None], state.exist_ports | xs.ports[None, :], state.exist_ports
+        )
+        new_exist_vols = jnp.where(
+            landed_e[:, None], state.exist_vols | xs.vols[None, :], state.exist_vols
+        )
 
-        # ---- tier 2: water-fill in-flight claims --------------------------
-        pod_b = _broadcast_pod(xs.reqs, N)
+        # ---- tier 2: water-fill in-flight claims (the active window) ------
+        pod_b = _broadcast_pod(xs.reqs, W)
         comb = kernels.intersect_sets(state.reqs, pod_b)
         claim_ok = kernels.compatible_elemwise(state.reqs, pod_b, well_known)
-        it_compat = kernels.intersects(it.reqs, comb).T  # [N, T]
-        off_n = _off_for(comb, N)
+        it_compat = kernels.intersects(it.reqs, comb).T  # [W, T]
+        off_n = _off_for(comb, W)
         allow_t = xs.it_allow[None, :]
         viable = state.its & it_compat & allow_t
         cap_res_n = _claim_fill_caps(state.used, viable, requests, it, off_n)
         cap_topo_n = _hg_slot_caps(
             topo,
             state.hg_counts,
-            E + jnp.arange(N, dtype=jnp.int32),
+            E + state.slot_of,
             xs.hg_applies,
             xs.hg_records,
             xs.hg_self,
         )
-        ports_ok_n = ~jnp.any(xs.port_conf[None, :] & state.claim_ports, axis=-1)
+        ports_ok_n = ~kernels.packed_conflict(xs.port_conf[None, :], state.claim_ports)
         tol = xs.tmpl_ok[state.template]
         feas_n = state.open & claim_ok & tol & ports_ok_n
         f_n = jnp.minimum(cap_res_n, cap_topo_n)
@@ -1223,7 +1419,9 @@ def _make_fill_step(
         its2 = jnp.where(landed_n[:, None], viable & fits_final, state.its)
         reqs2 = kernels.select_set(landed_n, comb, state.reqs)
         pods2 = state.pods + fill_c2
-        ports2 = state.claim_ports | (landed_n[:, None] & xs.ports[None, :])
+        ports2 = jnp.where(
+            landed_n[:, None], state.claim_ports | xs.ports[None, :], state.claim_ports
+        )
 
         # ---- tier 3: open new claims, each filled to capacity -------------
         pod_g = _broadcast_pod(xs.reqs, G)
@@ -1267,18 +1465,25 @@ def _make_fill_step(
         f_new = jnp.minimum(f_new0, cap_topo_fresh)
         f_new = jnp.where(self_conf, jnp.minimum(f_new, 1), f_new)
         f_new = jnp.where(any_template, jnp.maximum(f_new, 0), 0)
-        slots_avail = jnp.maximum(N - state.n_open, 0)
+        # fresh claims take contiguous WINDOW rows at w_open and contiguous
+        # GLOBAL ids at n_open; the window and the global cap both bound
+        # the opens (a window-bound shortfall is a spill the host recovers)
+        avail_w = jnp.maximum(W - state.w_open, 0)
+        avail_cap = jnp.maximum(NCAP - state.n_open, 0)
+        slots_avail = jnp.minimum(avail_w, avail_cap)
         want = jnp.where(
             f_new > 0, (rem2 + f_new - 1) // jnp.maximum(f_new, 1), 0
         )
         n_new = jnp.minimum(want, slots_avail)
-        idx = jnp.arange(N, dtype=jnp.int32)
-        i_new = idx - state.n_open
+        spilled = (want > n_new) & (avail_cap > slots_avail)
+        idx = jnp.arange(W, dtype=jnp.int32)
+        i_new = idx - state.w_open
         is_new = (i_new >= 0) & (i_new < n_new)
         c_new = jnp.where(is_new, jnp.clip(rem2 - i_new * f_new, 0, f_new), 0)
         placed3 = jnp.sum(c_new)
         leftover = rem2 - placed3
         status = jnp.where(any_template, jnp.int32(NO_ROOM), jnp.int32(NO_CLAIM))
+        new_slot_of = jnp.where(is_new, state.n_open + i_new, state.slot_of)
 
         used3 = jnp.where(
             is_new[:, None],
@@ -1286,19 +1491,19 @@ def _make_fill_step(
             + c_new[:, None].astype(jnp.float32) * requests[None, :],
             used2,
         )
-        off_new = jnp.broadcast_to(off_g[g][None], (N,) + off_g.shape[1:])
+        off_new = jnp.broadcast_to(off_g[g][None], (W,) + off_g.shape[1:])
         fits_new = jnp.any(
             _fits_off_counted(
-                jnp.broadcast_to(templates.daemon_requests[g][None, :], (N, requests.shape[0])),
+                jnp.broadcast_to(templates.daemon_requests[g][None, :], (W, requests.shape[0])),
                 jnp.broadcast_to(c_new[:, None, None], off_new.shape),
                 requests,
                 it,
                 off_new,
             ),
             axis=-1,
-        )  # [N, T]
+        )  # [W, T]
         its3 = jnp.where(is_new[:, None], its0[g][None, :] & fits_new, its2)
-        reqs3 = kernels.select_set(is_new, _broadcast_pod(kernels.take_set(comb0, g), N), reqs2)
+        reqs3 = kernels.select_set(is_new, _broadcast_pod(kernels.take_set(comb0, g), W), reqs2)
         template3 = jnp.where(is_new, g.astype(jnp.int32), state.template)
         open3 = state.open | is_new
         pods3 = jnp.where(is_new, c_new, pods2)
@@ -1306,12 +1511,16 @@ def _make_fill_step(
             (is_new & (c_new > 0))[:, None], ports2 | xs.ports[None, :], ports2
         )
         new_n_open = state.n_open + n_new
+        new_w_open = state.w_open + n_new
 
-        # hostname-group count commits for every landed pod
-        fill_all_slots = jnp.concatenate([fill_e, jnp.where(is_new, c_new, fill_c2)])
+        # hostname-group count commits for every landed pod, scattered at
+        # GLOBAL slots (window rows map through slot_of; unused-row adds
+        # carry count 0 into the spare column, a no-op)
         S = state.hg_counts.shape[1]
-        pad = S - fill_all_slots.shape[0]
-        fill_slots = jnp.pad(fill_all_slots, (0, pad))
+        fill_claims = jnp.where(is_new, c_new, fill_c2)
+        fill_slots = jnp.pad(fill_e, (0, S - E)).at[E + new_slot_of].add(
+            fill_claims, mode="drop"
+        )
         rec = (xs.hg_records & topo.hg_valid).astype(jnp.int32)
         new_hg_counts = state.hg_counts + rec[:, None] * fill_slots[None, :]
 
@@ -1324,15 +1533,15 @@ def _make_fill_step(
 
         ys = FillYs(
             fill_e=fill_e,
-            fill_c=jnp.where(is_new, c_new, fill_c2),
-            open_start=state.n_open,
+            fill_c=fill_claims,
+            open_start=state.w_open,
             n_opened=n_new,
             tmpl=jnp.where(n_new > 0, g.astype(jnp.int32), jnp.int32(-1)),
             leftover=leftover,
             status=status,
         )
         return (
-            SolverState(
+            state._replace(
                 exist_reqs=new_exist_reqs,
                 exist_used=new_exist_used,
                 reqs=reqs3,
@@ -1342,15 +1551,16 @@ def _make_fill_step(
                 open=open3,
                 pods=pods3,
                 n_open=new_n_open,
+                slot_of=new_slot_of,
+                w_open=new_w_open,
+                w_hw=jnp.maximum(state.w_hw, new_w_open),
+                spills=state.spills + jnp.where(spilled, 1, 0).astype(jnp.int32),
                 budget=new_budget,
                 nodes_budget=new_nodes_budget,
-                vg_counts=state.vg_counts,
                 hg_counts=new_hg_counts,
                 exist_ports=new_exist_ports,
                 claim_ports=ports3,
                 exist_vols=new_exist_vols,
-                res_cap=state.res_cap,
-                held=state.held,
             ),
             ys,
         )
@@ -1587,32 +1797,33 @@ def _make_kind_step(
     D: int,
     maxc: int,
 ):
-    N = n_claims
+    NCAP = n_claims
     E = exist.avail.shape[0]
     G = templates.its.shape[0]
     no_wk = jnp.zeros_like(well_known)
     i32 = jnp.int32
 
     def seg_step(state: SolverState, xs: KindXs):
+        W = state.open.shape[0]
         count = xs.count
         requests = xs.requests
-        self_conf = jnp.any(xs.ports & xs.port_conf)
+        self_conf = kernels.packed_conflict(xs.ports, xs.port_conf)
         pd = xs.strict_mask[key_kid, :D]  # [D] pod strict domains
         key_touched = jnp.any(xs.vg_applies & topo.vg_valid)
 
         # ---- per-segment invariants (one full-width pass) -----------------
-        # tier 2: claims
-        pod_b = _broadcast_pod(xs.reqs, N)
+        # tier 2: claims (the active window)
+        pod_b = _broadcast_pod(xs.reqs, W)
         comb = kernels.intersect_sets(state.reqs, pod_b)
         claim_ok = kernels.compatible_elemwise(state.reqs, pod_b, well_known)
-        it_compat = kernels.intersects(it.reqs, comb).T  # [N, T]
+        it_compat = kernels.intersects(it.reqs, comb).T  # [W, T]
         viable0 = state.its & it_compat & xs.it_allow[None, :]
         tol = xs.tmpl_ok[state.template]
-        ports_ok_n = ~jnp.any(xs.port_conf[None, :] & state.claim_ports, axis=-1)
+        ports_ok_n = ~kernels.packed_conflict(xs.port_conf[None, :], state.claim_ports)
         static_n0 = claim_ok & tol & ports_ok_n
         ct_n = comb.mask[:, ct_kid, :]
         zfull_n = comb.mask[:, zone_kid, :]
-        grid_n = _cap_res_grid(state.used, requests, it)  # [N, T, GR]
+        grid_n = _cap_res_grid(state.used, requests, it)  # [W, T, GR]
         capd_n0 = _kscan_capd(
             grid_n, viable0, ct_n, zfull_n, it, key_kid, zone_kid, D
         )
@@ -1621,15 +1832,12 @@ def _make_kind_step(
         pod_e = _broadcast_pod(xs.reqs, E)
         comb_e = kernels.intersect_sets(state.exist_reqs, pod_e)
         compat_e = kernels.compatible_elemwise(state.exist_reqs, pod_e, no_wk)
-        ports_ok_e = ~jnp.any(xs.port_conf[None, :] & state.exist_ports, axis=-1)
+        ports_ok_e = ~kernels.packed_conflict(xs.port_conf[None, :], state.exist_ports)
         newv_e = state.exist_vols | xs.vols[None, :]
-        vcount_e = jnp.einsum(
-            "ev,vd->ed",
-            newv_e.astype(jnp.bfloat16),
-            exist.vol_driver.astype(jnp.bfloat16),
-            preferred_element_type=jnp.float32,
-        )
-        vols_ok_e = jnp.all(vcount_e <= exist.vol_limits, axis=-1) | ~jnp.any(xs.vols)
+        vcount_e = kernels.packed_count_and(
+            newv_e[:, None, :], exist.vol_driver[None, :, :]
+        ).astype(jnp.float32)
+        vols_ok_e = jnp.all(vcount_e <= exist.vol_limits, axis=-1) | ~kernels.packed_any(xs.vols)
         cap_e = _count_cap_seq(state.exist_used, requests[None, :], exist.avail)
         static_e = exist.valid & xs.exist_ok & compat_e & ports_ok_e & vols_ok_e
         cap_e = jnp.where(static_e, cap_e, 0)
@@ -1727,18 +1935,21 @@ def _make_kind_step(
         #   total pods: state.pods + pl_n
         zin0 = comb.inf[:, key_kid]
         zie0 = comb_e.inf[:, key_kid]
-        n_open0 = state.n_open
-        arange_n = jnp.arange(N, dtype=i32)
+        w_open0 = state.w_open
+        arange_n = jnp.arange(W, dtype=i32)
         carry0 = dict(
             zn=comb.mask[:, key_kid, :D],
             ze=comb_e.mask[:, key_kid, :D],
             capd=capd_n0,
-            pl_n=jnp.zeros(N, dtype=i32),
+            pl_n=jnp.zeros(W, dtype=i32),
             pl_e=jnp.zeros(E, dtype=i32),
             tmpl_n=state.template,
             cnt=state.vg_counts[:, :D],
             hgc=state.hg_counts,
             n_open=state.n_open,
+            w_open=state.w_open,
+            slot_of=state.slot_of,
+            spills=state.spills,
         )
 
         def pod_step(c, i):
@@ -1751,7 +1962,7 @@ def _make_kind_step(
             slots_all = jnp.concatenate(
                 [
                     jnp.arange(E, dtype=i32),
-                    E + jnp.arange(N, dtype=i32),
+                    E + c["slot_of"],
                     jnp.broadcast_to(E + c["n_open"], (G,)).astype(i32),
                 ]
             )
@@ -1765,32 +1976,34 @@ def _make_kind_step(
             found_e = jnp.any(feas_e)
             newz_e = newz[:E]
 
-            # tier 2: fewest pods, earliest slot
-            newz_n = newz[E : E + N]
+            # tier 2: fewest pods, earliest slot (window order = open order)
+            newz_n = newz[E : E + W]
             lim_n = jnp.where(self_conf, jnp.minimum(c["capd"], 1), c["capd"])
             fits_n = jnp.any(newz_n & (lim_n > c["pl_n"][:, None]), axis=-1)
-            fresh_here = (arange_n >= n_open0) & (arange_n < c["n_open"])
+            fresh_here = (arange_n >= w_open0) & (arange_n < c["w_open"])
             open_n = state.open | fresh_here
             stat_n = static_n0 | fresh_here
             feas_n = (
-                open_n & stat_n & f_topo[E : E + N] & fits_n
-                & hg_ok[E : E + N] & valid & ~found_e
+                open_n & stat_n & f_topo[E : E + W] & fits_n
+                & hg_ok[E : E + W] & valid & ~found_e
             )
-            order = (state.pods + c["pl_n"]) * i32(N) + arange_n
+            order = (state.pods + c["pl_n"]) * i32(W) + arange_n
             pick = jnp.argmin(jnp.where(feas_n, order, BIG))
             found = jnp.any(feas_n)
 
             # tier 3: first weight-ordered feasible template
-            newz_g = newz[E + N :]
+            newz_g = newz[E + W :]
             fits_g = jnp.any(newz_g & (capd_g >= 1), axis=-1)
-            tmpl_feas = static_g & f_topo[E + N :] & fits_g & hg_ok[E + N :]
+            tmpl_feas = static_g & f_topo[E + W :] & fits_g & hg_ok[E + W :]
             g = jnp.argmax(tmpl_feas)
             any_t = jnp.any(tmpl_feas) & valid & ~found_e & ~found
-            can_open = any_t & (c["n_open"] < N)
+            can_open = any_t & (c["w_open"] < W) & (c["n_open"] < NCAP)
+            spilled = any_t & ~can_open & (c["n_open"] < NCAP)
 
             place = found_e | found | can_open
-            cslot = jnp.where(found, pick, c["n_open"])
-            slot = jnp.where(found_e, pick_e, E + cslot)
+            cslot = jnp.where(found, pick, c["w_open"])
+            gslot = jnp.where(found, c["slot_of"][pick], c["n_open"])
+            slot = jnp.where(found_e, pick_e, E + gslot)
             assignment = jnp.where(
                 place,
                 slot.astype(i32),
@@ -1813,7 +2026,7 @@ def _make_kind_step(
             do = recs & ~win_zinf & (is_anti | single)
             delta = (do[:, None] & win_z[None, :]).astype(i32)
             cnt2 = jnp.where(place, c["cnt"] + delta, c["cnt"])
-            slot_h = jnp.where(found_e, pick_e, E + cslot).astype(i32)
+            slot_h = jnp.where(found_e, pick_e, E + gslot).astype(i32)
             hgc2 = jnp.where(
                 place,
                 topo_ops.hg_commit(c["hgc"], slot_h, xs.hg_records, topo.hg_valid),
@@ -1836,14 +2049,20 @@ def _make_kind_step(
             tmpl2 = jnp.where(
                 opened, c["tmpl_n"].at[cslot].set(g.astype(i32)), c["tmpl_n"]
             )
-            n_open2 = c["n_open"] + jnp.where(opened, 1, 0).astype(i32)
+            opened_i = jnp.where(opened, 1, 0).astype(i32)
+            slot_of2 = jnp.where(
+                opened, c["slot_of"].at[cslot].set(c["n_open"]), c["slot_of"]
+            )
 
             return (
                 dict(
                     zn=zn2, ze=ze2, capd=capd2,
                     pl_n=pl_n2, pl_e=pl_e2,
                     tmpl_n=tmpl2, cnt=cnt2, hgc=hgc2,
-                    n_open=n_open2,
+                    n_open=c["n_open"] + opened_i,
+                    w_open=c["w_open"] + opened_i,
+                    slot_of=slot_of2,
+                    spills=c["spills"] + jnp.where(spilled, 1, 0).astype(i32),
                 ),
                 assignment,
             )
@@ -1901,8 +2120,8 @@ def _make_kind_step(
         km = km | (
             base_reqs.mask[:, key_kid, :]
             & jnp.concatenate(
-                [jnp.zeros((N, D), dtype=bool),
-                 jnp.ones((N, km.shape[1] - D), dtype=bool)],
+                [jnp.zeros((W, D), dtype=bool),
+                 jnp.ones((W, km.shape[1] - D), dtype=bool)],
                 axis=1,
             )
         )
@@ -1945,9 +2164,15 @@ def _make_kind_step(
             landed_n[:, None], viable_base & ok_key & fits_f, state.its
         )
 
-        new_ports = state.claim_ports | (landed_n[:, None] & xs.ports[None, :])
-        new_eports = state.exist_ports | (landed_e[:, None] & xs.ports[None, :])
-        new_evols = state.exist_vols | (landed_e[:, None] & xs.vols[None, :])
+        new_ports = jnp.where(
+            landed_n[:, None], state.claim_ports | xs.ports[None, :], state.claim_ports
+        )
+        new_eports = jnp.where(
+            landed_e[:, None], state.exist_ports | xs.ports[None, :], state.exist_ports
+        )
+        new_evols = jnp.where(
+            landed_e[:, None], state.exist_vols | xs.vols[None, :], state.exist_vols
+        )
 
         # existing-node requirements writeback (same key-row treatment)
         ekm = jnp.zeros_like(comb_e.mask[:, key_kid, :])
@@ -1984,7 +2209,7 @@ def _make_kind_step(
 
         ys = KindYs(assignment=assignment.astype(jnp.int32))
         return (
-            SolverState(
+            state._replace(
                 exist_reqs=new_ereqs,
                 exist_used=new_exist_used,
                 reqs=new_reqs,
@@ -1992,18 +2217,18 @@ def _make_kind_step(
                 its=new_its,
                 template=jnp.where(opened_here, tmpl_n, state.template),
                 open=state.open
-                | ((arange_n >= n_open0) & (arange_n < carry["n_open"])),
+                | ((arange_n >= w_open0) & (arange_n < carry["w_open"])),
                 pods=state.pods + pl_n,
                 n_open=carry["n_open"],
-                budget=state.budget,
-                nodes_budget=state.nodes_budget,
+                slot_of=carry["slot_of"],
+                w_open=carry["w_open"],
+                w_hw=jnp.maximum(state.w_hw, carry["w_open"]),
+                spills=carry["spills"],
                 vg_counts=new_vg,
                 hg_counts=carry["hgc"],
                 exist_ports=new_eports,
                 claim_ports=new_ports,
                 exist_vols=new_evols,
-                res_cap=state.res_cap,
-                held=state.held,
             ),
             ys,
         )

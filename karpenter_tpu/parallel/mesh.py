@@ -115,6 +115,7 @@ def sharded_solve(
     res_vid: int = -1,
     res_active: bool = False,
     res_strict: bool = False,
+    window: int = 0,
 ):
     """Run ops_solver.solve with the catalog sharded over the "it" mesh axis.
 
@@ -122,6 +123,12 @@ def sharded_solve(
     triple-mask computation across devices and inserts the any-reduce
     collectives over ICI. The per-type template and pod-allow masks are
     padded to the sharded catalog size; everything else is replicated.
+
+    The active window (claims axis W) shards exactly like the full claims
+    axis did: the hot [W, T] viability masks and bank [NCAP, T] columns
+    follow the catalog's "it" sharding through GSPMD propagation, while
+    the [W, K, V] requirement tensors stay replicated — `window` threads
+    straight through to ops_solver.solve.
     """
     T_pad = it_sharded.alloc.shape[0]
     # every per-type tensor must grow with the padded catalog: the template
@@ -156,4 +163,5 @@ def sharded_solve(
         res_vid=res_vid,
         res_active=res_active,
         res_strict=res_strict,
+        window=window,
     )

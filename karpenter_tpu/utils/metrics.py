@@ -401,3 +401,14 @@ CIRCUIT_TRANSITIONS = REGISTRY.counter(
     "Solver-endpoint circuit-breaker state transitions",
     ("target", "to"),
 )
+# ---- active-window device scan + incremental encode (PR 5) ----
+SCAN_WINDOW_SPILLS = REGISTRY.counter(
+    "ktpu_scan_window_spills_total",
+    "Claim opens refused because the solver's active window was full"
+    " (the host grows the window and re-solves)",
+)
+ENCODE_CACHE_HITS = REGISTRY.counter(
+    "ktpu_encode_cache_hits_total",
+    "Pod-kind encode rows served from the incremental encode cache"
+    " instead of re-encoding (KTPU_ENCODE_CACHE)",
+)

@@ -40,17 +40,35 @@ from karpenter_tpu.models.pod import make_pod
 pool = NodePool(); pool.metadata.name = "default"
 templates = build_templates([(pool, instance_types(16))])
 pods = [make_pod(f"p-{i}", cpu=0.5) for i in range(48)]
+sched = TPUScheduler(templates)
 t0 = time.perf_counter()
-result = TPUScheduler(templates).solve(pods)
+result = sched.solve(pods)
 cold_s = time.perf_counter() - t0
 assert not result.unschedulable
-print(json.dumps({"cold_s": cold_s, "cache_hits": hits[0], "claims": len(result.claims)}))
+# the warm solve re-sizes the claims axis AND the active window (window
+# W is part of the compiled shapes, hence of the cache keys) — run it in
+# BOTH children so the windowed executables land in the cache too and
+# the key-stability assertion covers them
+warm = sched.solve(pods)
+assert not warm.unschedulable
+assert len(warm.claims) == len(result.claims)
+scan = sched.last_timings.get("scan") or {}
+print(json.dumps({
+    "cold_s": cold_s,
+    "cache_hits": hits[0],
+    "claims": len(result.claims),
+    "window": scan.get("window"),
+}))
 """
 
 
 def _run_child(cache_dir: str) -> dict:
     env = dict(os.environ)
     env["KTPU_COMPILE_CACHE"] = cache_dir
+    # pin the active window so both children compile the SAME windowed
+    # executables (cache keys include W via the carry shapes); without the
+    # pin, determinism would hinge on the adaptive sizing heuristics
+    env["KTPU_SCAN_WINDOW"] = "32"
     out = subprocess.run(
         [sys.executable, "-c", _CHILD],
         capture_output=True,
@@ -101,7 +119,12 @@ def test_restart_skips_cold_compile(tmp_path):
     second = _run_child(cache_dir)
     after = _cache_entries(cache_dir)
     assert second["claims"] == first["claims"]
-    # deterministic shape-bucketed keys: the rerun adds nothing new
+    assert second["window"] == first["window"], (
+        "the pinned scan window must size identically across restarts "
+        f"({first['window']} vs {second['window']})"
+    )
+    # deterministic shape-bucketed keys (claims axis, pads AND window W):
+    # the rerun adds nothing new
     assert after == populated, f"cache grew {populated} -> {after}; keys unstable"
     # and the compiles were served from disk
     assert second["cache_hits"] > 0, "no persistent-cache hits on restart"

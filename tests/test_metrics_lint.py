@@ -125,6 +125,25 @@ def test_fault_and_degradation_families_are_registered():
         assert fam.help.strip()
 
 
+def test_scan_window_and_encode_cache_families_are_registered():
+    """ISSUE-5 families: the active-window spill counter and the
+    incremental encode cache hit counter, label-free counters with the
+    documented names (bench --report-scan and the perf gates read the
+    same numbers from last_timings['scan'])."""
+    from karpenter_tpu.utils.metrics import Counter
+
+    fams = {f.name: f for f in _families()}
+    for name in (
+        "ktpu_scan_window_spills_total",
+        "ktpu_encode_cache_hits_total",
+    ):
+        fam = fams.get(name)
+        assert fam is not None, f"{name} not registered"
+        assert isinstance(fam, Counter), (name, type(fam).__name__)
+        assert fam.label_names == ()
+        assert fam.help.strip()
+
+
 def test_counters_end_in_total_and_histograms_in_seconds_or_pods():
     """Unit-suffix discipline for NEW families (grandfathered names keep
     their reference spellings verbatim)."""

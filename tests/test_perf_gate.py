@@ -77,7 +77,12 @@ def test_tpu_regime_gate():
 # ceiling so a persistent-cache key bust fails loudly instead of looking
 # like a CI hang, and a whatif-batch floor so the 22x -> 13.8x r4->r5
 # slide (VERDICT r5 weak #4) can never recur silently.
-NORTHSTAR_MAX_WALL_S = 0.70  # ratchet toward the 0.5s BASELINE target
+NORTHSTAR_MAX_WALL_S = 0.60  # ISSUE-5 ratchet (stretch: 0.55) toward 0.5s
+# the active-window scan + incremental encode must actually move the
+# splits, not just the wall: device_s below the r5 0.33s scan split and
+# encode_s below 0.09s (both recorded in the bench JSON per stage)
+NORTHSTAR_MAX_DEVICE_S = 0.30
+NORTHSTAR_MAX_ENCODE_S = 0.09
 # the pipelined solve must hide >= 30% of its wire+decode time behind
 # in-flight device compute on the north-star workload (ISSUE 3; the same
 # overlap_frac lands in the bench JSON under the stage's "pipeline" key)
@@ -104,14 +109,26 @@ def test_northstar_wall_gate():
     sched = TPUScheduler(templates, pod_pad=len(pods), max_claims=4096)
     assert not sched.solve(pods).unschedulable  # cold
     best = None
+    best_timings = None
     for _ in range(2):
         t0 = time.perf_counter()
         result = sched.solve(pods)
         wall = time.perf_counter() - t0
-        best = wall if best is None or wall < best else best
+        if best is None or wall < best:
+            best, best_timings = wall, dict(sched.last_timings)
     assert not result.unschedulable
     assert best <= NORTHSTAR_MAX_WALL_S, (
         f"north-star regression: {best:.3f}s > {NORTHSTAR_MAX_WALL_S}s"
+    )
+    # ISSUE-5 sub-gates: the active-window scan and incremental encode
+    # must move the splits themselves, not just the wall
+    assert best_timings["device_s"] <= NORTHSTAR_MAX_DEVICE_S, (
+        f"device scan regression: {best_timings['device_s']:.3f}s > "
+        f"{NORTHSTAR_MAX_DEVICE_S}s (scan={best_timings.get('scan')})"
+    )
+    assert best_timings["encode_s"] <= NORTHSTAR_MAX_ENCODE_S, (
+        f"encode regression: {best_timings['encode_s']:.3f}s > "
+        f"{NORTHSTAR_MAX_ENCODE_S}s"
     )
 
 

@@ -1,0 +1,240 @@
+"""Differential parity for the active-window claims compaction (ISSUE 5).
+
+The solver's carry keeps hot per-claim tensors only for a bounded window
+W of resident open claims; capacity-dead claims are evicted into the
+frozen bank between dispatches, and window-bound opens spill into the
+host's NO_ROOM escalation (grow the window, re-solve). None of that may
+move a single pod: every windowed solve must be BIT-identical to the
+host oracle and to the un-windowed device solve, across the three
+dispatch modes (fill / kind-scan / per-pod) crossed with pipeline
+chunking at K in {1, 2, 4}.
+
+Everything here is host-only (CPU mesh) and sized for tier-1 — the
+window path needs no accelerator to be exercised at small W.
+"""
+
+import numpy as np
+import pytest
+
+import bench
+from karpenter_tpu.controllers.provisioning import TPUScheduler, build_templates
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import make_pod
+
+from test_solver import assert_same_packing
+
+
+def make_templates(n_types=40):
+    pool = NodePool()
+    pool.metadata.name = "default"
+    return build_templates([(pool, instance_types(n_types))])
+
+
+def windowed_scheduler(monkeypatch, window, k=0, n_types=40, max_claims=128,
+                       solve_chunk=None):
+    """A TPUScheduler with the active window forced to `window` columns
+    (0 = un-windowed baseline) and the pipeline forced to K chunk groups."""
+    if window:
+        monkeypatch.setenv("KTPU_SCAN_WINDOW", str(window))
+    else:
+        monkeypatch.delenv("KTPU_SCAN_WINDOW", raising=False)
+    if k > 1:
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", str(k))
+        monkeypatch.setenv("KTPU_PIPELINE_MIN_PODS", "0")
+    else:
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+    if solve_chunk is not None:
+        monkeypatch.setenv("KTPU_SOLVE_CHUNK", str(solve_chunk))
+    return TPUScheduler(
+        make_templates(n_types), pod_pad=None, max_claims=max_claims
+    )
+
+
+def assert_scan_coherent(sched):
+    """The occupancy record must be internally consistent."""
+    scan = sched.last_timings.get("scan")
+    assert scan is not None, "windowed solve must record last_timings['scan']"
+    assert scan["resident"] + scan["frozen"] == scan["n_open"], scan
+    assert scan["live_hw"] <= scan["window"], scan
+    assert scan["window"] <= scan["n_claims"], scan
+    return scan
+
+
+def run_window_parity(monkeypatch, pods, n_types, max_claims, window,
+                      budgets=None, solve_chunk=None, ks=(1, 2, 4)):
+    """Solve windowed at each K; pin against the un-windowed unchunked
+    device solve AND the host oracle."""
+    href, _ = bench.host_solve(make_templates(n_types), pods)
+    if budgets is not None:
+        from karpenter_tpu.controllers.provisioning.host_scheduler import (
+            HostScheduler,
+        )
+        from karpenter_tpu.controllers.provisioning.topology import (
+            Topology,
+            build_universe_domains,
+        )
+
+        templates = make_templates(n_types)
+        topo = Topology.build(
+            list(pods), build_universe_domains(templates, []), []
+        )
+        href = HostScheduler(templates, budgets=budgets, topology=topo).solve(
+            list(pods)
+        )
+    base_sched = windowed_scheduler(
+        monkeypatch, 0, 0, n_types, max_claims, solve_chunk=solve_chunk
+    )
+    base = base_sched.solve(pods, budgets=budgets)
+    assert_same_packing(href, base)
+    for k in ks:
+        sched = windowed_scheduler(
+            monkeypatch, window, k, n_types, max_claims, solve_chunk=solve_chunk
+        )
+        result = sched.solve(pods, budgets=budgets)
+        assert_same_packing(base, result)  # vs un-windowed device solve
+        assert_same_packing(href, result)  # vs the host oracle
+        assert_scan_coherent(sched)
+    return base
+
+
+class TestWindowedParity:
+    def test_fill_path_small_window(self, monkeypatch):
+        """Selector-only pods (kind-level fill scan) with the window well
+        below the claims the solve opens: overflow falls back via the
+        NO_ROOM escalation and still lands the oracle packing."""
+        run_window_parity(monkeypatch, bench.selector_pods(128), 40, 128, 8)
+
+    def test_topology_mix_small_window(self, monkeypatch):
+        """The reference mix crosses fill + kind-scan dispatches with a
+        compacted carry threaded between them."""
+        run_window_parity(monkeypatch, bench.mixed_pods(96), 40, 128, 16)
+
+    def test_perpod_resume_with_compacted_carry(self, monkeypatch):
+        """Finite budgets force the per-pod scan; a small solve_chunk makes
+        several solve_from dispatches with compaction (and possible window
+        spill) between them — pinned vs the unchunked un-windowed solve."""
+        budgets = {"default": {"cpu": 100000.0}}
+        run_window_parity(
+            monkeypatch,
+            bench.mixed_pods(72),
+            24,
+            128,
+            12,
+            budgets=budgets,
+            solve_chunk=24,
+        )
+
+
+class TestWindowOverflow:
+    def test_overflow_grows_and_recovers(self, monkeypatch):
+        """Open claims far beyond W: the spill surfaces in the scan stats
+        and the metric, the escalation re-solves with a grown window, and
+        nothing ends up unschedulable."""
+        from karpenter_tpu.utils.metrics import SCAN_WINDOW_SPILLS
+
+        pods = [make_pod(f"big-{i}", cpu=1.8) for i in range(24)]
+        href, _ = bench.host_solve(make_templates(16), pods)
+        spills0 = SCAN_WINDOW_SPILLS.get()
+        sched = windowed_scheduler(monkeypatch, 4, 0, 16, 64)
+        result = sched.solve(pods)
+        assert not result.unschedulable
+        assert_same_packing(href, result)
+        scan = assert_scan_coherent(sched)
+        # the FINAL (escalated) solve ran with a grown window
+        assert scan["window"] > 4
+        assert SCAN_WINDOW_SPILLS.get() > spills0, (
+            "the window-bound refusal must land in "
+            "ktpu_scan_window_spills_total"
+        )
+
+    def test_forced_window_reported_in_timings(self, monkeypatch):
+        sched = windowed_scheduler(monkeypatch, 8, 0, 16, 64)
+        result = sched.solve([make_pod(f"p-{i}", cpu=0.5) for i in range(12)])
+        assert not result.unschedulable
+        scan = assert_scan_coherent(sched)
+        assert scan["window"] == 8
+        assert scan["spills"] == 0
+
+
+class TestFrozenBank:
+    def test_dead_claims_evict_between_dispatches(self, monkeypatch):
+        """Two kinds sized so the first kind's claims are capacity-dead
+        once only the second kind remains (headroom < the remaining
+        minimum request): the boundary compaction must evict them to the
+        frozen bank, keep residency within a window smaller than the
+        total opens, and still produce the oracle packing."""
+        pods = [make_pod(f"big-{i}", cpu=1.8) for i in range(12)] + [
+            make_pod(f"mid-{i}", cpu=0.9) for i in range(12)
+        ]
+        href, _ = bench.host_solve(make_templates(16), pods)
+        base = windowed_scheduler(monkeypatch, 0, 0, 16, 64).solve(pods)
+        assert_same_packing(href, base)
+        # force a dispatch boundary between the two fill segments
+        sched = windowed_scheduler(monkeypatch, 16, 4, 16, 64)
+        result = sched.solve(pods)
+        assert_same_packing(base, result)
+        scan = assert_scan_coherent(sched)
+        assert scan["compactions"] >= 1, scan
+        assert scan["frozen"] > 0, (
+            f"expected capacity-dead claims in the frozen bank, got {scan}"
+        )
+        # residency stayed below total opens — the whole point
+        assert scan["live_hw"] < scan["n_open"], scan
+
+    def test_warm_adaptive_window_shrinks(self, monkeypatch):
+        """With no forced window, warm solves size the window from the
+        live high-water, not the cumulative opens."""
+        pods = [make_pod(f"big-{i}", cpu=1.8) for i in range(12)] + [
+            make_pod(f"mid-{i}", cpu=0.9) for i in range(12)
+        ]
+        sched = windowed_scheduler(monkeypatch, 0, 4, 16, 1024)
+        r1 = sched.solve(pods)
+        assert not r1.unschedulable
+        scan1 = assert_scan_coherent(sched)
+        r2 = sched.solve(pods)
+        assert not r2.unschedulable
+        scan2 = assert_scan_coherent(sched)
+        assert scan2["window"] <= scan1["window"]
+        assert len(r1.claims) == len(r2.claims)
+
+
+class TestPackedBitsets:
+    def test_pack_roundtrip_and_ops(self, rng):
+        import jax.numpy as jnp
+
+        from karpenter_tpu.ops import kernels as k
+
+        a = rng.random((7, 70)) < 0.3
+        b = rng.random((7, 70)) < 0.3
+        pa, pb = k.pack_bool_np(a), k.pack_bool_np(b)
+        assert pa.dtype == np.uint32 and pa.shape == (7, 3)
+        assert np.array_equal(np.asarray(k.pack_bool(jnp.asarray(a))), pa)
+        assert np.array_equal(np.asarray(k.unpack_bool(jnp.asarray(pa), 70)), a)
+        assert np.array_equal(
+            np.asarray(k.packed_conflict(jnp.asarray(pa), jnp.asarray(pb))),
+            (a & b).any(-1),
+        )
+        assert np.array_equal(
+            np.asarray(k.packed_any(jnp.asarray(pa))), a.any(-1)
+        )
+        assert np.array_equal(
+            np.asarray(k.packed_count_and(jnp.asarray(pa), jnp.asarray(pb))),
+            (a & b).sum(-1),
+        )
+
+    def test_host_ports_still_conflict_windowed(self, monkeypatch):
+        """Port bitsets ride packed through the windowed carry: two pods
+        demanding the same host port must land on different nodes."""
+        from karpenter_tpu.models.pod import HostPort
+
+        pods = []
+        for i in range(6):
+            p = make_pod(f"hp-{i}", cpu=0.5)
+            p.spec.host_ports = [HostPort(port=8080)]
+            pods.append(p)
+        href, _ = bench.host_solve(make_templates(16), pods)
+        sched = windowed_scheduler(monkeypatch, 8, 0, 16, 64)
+        result = sched.solve(pods)
+        assert_same_packing(href, result)
+        assert len(result.claims) == 6  # one port-conflicting pod per node
