@@ -250,6 +250,66 @@ def run_whatif_stage(n_candidates, seq_sample=8):
     }
 
 
+def run_gang_storm_stage(on_tpu: bool) -> dict:
+    """Gang-storm (ISSUE 6): a training-job burst — all-or-nothing gangs
+    mixed with singleton pods, plus one deliberately unplaceable "whale"
+    gang — through the full pipeline. Reports gangs-scheduled/sec and the
+    spill count (the whale must spill atomically: every member fails
+    together, nothing else is disturbed)."""
+    from karpenter_tpu.controllers.provisioning import TPUScheduler
+    from karpenter_tpu.envelope.sampler import measured
+    from karpenter_tpu.gang import make_gang_pods
+
+    n_gangs, gang_size, n_singles, n_types, max_claims = (
+        (64, 16, 2048, 400, 2048) if on_tpu else (12, 8, 256, 100, 256)
+    )
+    pods = []
+    for gi in range(n_gangs):
+        pods.extend(make_gang_pods(f"storm-{gi}", gang_size, cpu=1.5))
+    # the whale: no instance type can host a member -> atomic spill
+    pods.extend(make_gang_pods("whale", 4, cpu=10000.0))
+    pods.extend(selector_pods(n_singles))
+    envelope = {}
+    with measured(envelope, stage="gang_storm"):
+        templates = make_templates(n_types)
+        sched = TPUScheduler(templates, pod_pad=len(pods), max_claims=max_claims)
+        t0 = time.perf_counter()
+        result = sched.solve(pods)  # cold
+        cold_s = time.perf_counter() - t0
+        best = None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            result = sched.solve(pods)
+            wall = time.perf_counter() - t0
+            best = wall if best is None or wall < best else best
+        best = best if best is not None else cold_s
+    # every spill is a WHOLE gang: unschedulable members group exactly
+    # into complete gangs (here: just the whale)
+    from karpenter_tpu.gang import gang_of
+
+    spilled: dict[str, int] = {}
+    for p, _reason in result.unschedulable:
+        parsed = gang_of(p)
+        assert parsed is not None, f"singleton spilled: {p.metadata.name}"
+        spilled[parsed[0]] = spilled.get(parsed[0], 0) + 1
+    assert spilled == {"default/whale": 4}, f"partial spill: {spilled}"
+    slice_hosts = sum(1 for c in result.claims if c.gang)
+    return {
+        "gangs": n_gangs,
+        "gang_size": gang_size,
+        "singles": n_singles,
+        "pods": len(pods),
+        "wall_s": round(best, 4),
+        "cold_s": round(cold_s, 2),
+        "gangs_per_sec": round(n_gangs / best, 1),
+        "pods_per_sec": round(len(pods) / best, 1),
+        "slice_hosts": slice_hosts,
+        "spilled_gangs": len(spilled),
+        "spilled_pods": sum(spilled.values()),
+        **envelope,
+    }
+
+
 def run_restart_stage(n_pods, n_types, max_claims, on_tpu=True):
     """Cold-start cost after a process restart with the persistent compile
     cache populated (the bench process itself just populated it): the
@@ -545,6 +605,13 @@ def main() -> None:
             detail["northstar_100000x1000"] = f"failed: {repr(e)[:300]}"
     else:
         detail["northstar_100000x1000"] = "skipped on CPU fallback"
+
+    # stage 3.5: gang-storm — all-or-nothing slice scheduling throughput
+    # (gangs-scheduled/sec + atomic spill accounting, ISSUE 6)
+    try:
+        detail["gang_storm"] = run_gang_storm_stage(on_tpu)
+    except Exception as e:  # noqa: BLE001
+        detail["gang_storm"] = f"failed: {repr(e)[:300]}"
 
     # stage 4: disruption what-ifs — batched vs sequential (§2.6)
     try:

@@ -20,6 +20,19 @@ from karpenter_tpu.utils.clock import Clock
 POD_DELETION_COST_ANNOTATION = "controller.kubernetes.io/pod-deletion-cost"
 
 
+def gang_key_of_node(sn: StateNode) -> Optional[str]:
+    """The gang key stamped on a slice host's NodeClaim at launch
+    (gang.GANG_CLAIM_ANNOTATION), or None for ordinary nodes."""
+    from karpenter_tpu.gang import GANG_CLAIM_ANNOTATION
+
+    for obj in (sn.node_claim, sn.node):
+        if obj is not None:
+            key = obj.metadata.annotations.get(GANG_CLAIM_ANNOTATION)
+            if key:
+                return key
+    return None
+
+
 @dataclass
 class Candidate:
     """A node eligible for disruption (types.go:75-92)."""
@@ -30,6 +43,9 @@ class Candidate:
     price: float
     reschedulable_pods: list[Pod] = field(default_factory=list)
     disruption_cost: float = 1.0
+    # gang key when this node is one host of a multi-host slice: the
+    # slice's claim group is disrupted atomically (all hosts or none)
+    gang_key: Optional[str] = None
 
     @property
     def name(self) -> str:
@@ -124,9 +140,81 @@ def build_candidates(
                 price=price,
                 reschedulable_pods=reschedulable,
                 disruption_cost=cost,
+                gang_key=gang_key_of_node(sn),
             )
         )
-    return out
+    # a multi-host slice is disrupted atomically: a gang enters the
+    # candidate set only when EVERY live host of the slice is itself a
+    # candidate — one blocked host (nominated, PDB, do-not-disrupt,
+    # deleting) withdraws the whole gang
+    return drop_partial_gangs(out, cluster)
+
+
+def drop_partial_gangs(
+    candidates: list[Candidate], cluster: Cluster
+) -> list[Candidate]:
+    """Remove gang candidates whose slice is only partially represented:
+    disruption never evicts a strict subset of a gang's claims, so unless
+    every live host of the gang survived candidate filtering, none do."""
+    pops: dict[str, int] = {}
+    for sn in cluster.nodes():
+        key = gang_key_of_node(sn)
+        if key:
+            pops[key] = pops.get(key, 0) + 1
+    have: dict[str, int] = {}
+    for c in candidates:
+        if c.gang_key:
+            have[c.gang_key] = have.get(c.gang_key, 0) + 1
+    return [
+        c
+        for c in candidates
+        if not c.gang_key or have[c.gang_key] >= pops.get(c.gang_key, 0)
+    ]
+
+
+def atomic_units(candidates: list[Candidate]) -> list[list[Candidate]]:
+    """Group candidates into atomic disruption units, order-preserving:
+    one unit per ordinary node, one unit per gang (every host of the
+    slice, grouped at the gang's first appearance). Disruption methods
+    select whole units, so a command can never carry a strict subset of a
+    slice's hosts."""
+    units: list[list[Candidate]] = []
+    gang_unit: dict[str, list[Candidate]] = {}
+    for c in candidates:
+        if c.gang_key is None:
+            units.append([c])
+            continue
+        u = gang_unit.get(c.gang_key)
+        if u is None:
+            u = gang_unit[c.gang_key] = [c]
+            units.append(u)
+        else:
+            u.append(c)
+    return units
+
+
+def partial_gang_violation(
+    candidates: list[Candidate], cluster: Cluster
+) -> Optional[str]:
+    """The no-partial-eviction tripwire: the gang key of any live slice a
+    command would evict a strict subset of, else None. Impossible by
+    construction (build_candidates + atomic unit selection), checked
+    anyway before every command executes."""
+    chosen: dict[str, int] = {}
+    for c in candidates:
+        if c.gang_key:
+            chosen[c.gang_key] = chosen.get(c.gang_key, 0) + 1
+    if not chosen:
+        return None
+    pops: dict[str, int] = {}
+    for sn in cluster.nodes():
+        key = gang_key_of_node(sn)
+        if key in chosen:
+            pops[key] = pops.get(key, 0) + 1
+    for key, n in chosen.items():
+        if n < pops.get(key, 0):
+            return key
+    return None
 
 
 def build_disruption_budgets(

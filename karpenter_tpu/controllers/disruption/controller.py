@@ -252,9 +252,25 @@ class DisruptionController:
         """Re-verify after the delay: candidates still disruptable, not
         newly PDB-blocked, and the pods still have somewhere to go
         (validation.go:258)."""
-        from karpenter_tpu.controllers.disruption.candidates import is_disruptable
+        from karpenter_tpu.controllers.disruption.candidates import (
+            is_disruptable,
+            partial_gang_violation,
+        )
         from karpenter_tpu.models.pdb import blocked_pod_uids
 
+        # the no-partial-eviction tripwire: impossible by construction
+        # (atomic unit selection), but a command that would evict a strict
+        # subset of a live slice's hosts is refused outright
+        viol = partial_gang_violation(command.candidates, self.cluster)
+        if viol is not None:
+            from karpenter_tpu.utils.logging import get_logger
+
+            get_logger().with_values(controller="disruption").error(
+                "command would evict a strict subset of a gang's claims",
+                gang=viol,
+                reason=command.reason,
+            )
+            return False
         blocked = blocked_pod_uids(self.store.list(ObjectStore.PDBS), self.store.pods())
         for c in command.candidates:
             sn = self.cluster.node_by_name(c.name)

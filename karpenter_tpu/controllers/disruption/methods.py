@@ -11,7 +11,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from karpenter_tpu.controllers.disruption.candidates import Candidate
+from karpenter_tpu.controllers.disruption.candidates import Candidate, atomic_units
 from karpenter_tpu.controllers.provisioning.host_scheduler import SchedulingResult, SimClaim
 from karpenter_tpu.models import labels as l
 from karpenter_tpu.models.nodepool import (
@@ -57,15 +57,53 @@ class Command:
 
 def _within_budget(candidates: list[Candidate], budgets: dict[str, int]) -> list[Candidate]:
     """Prefilter preserving order so no pool exceeds its budget
-    (multinodeconsolidation.go:52-80)."""
+    (multinodeconsolidation.go:52-80). Selection is by ATOMIC UNIT: a
+    gang's slice hosts are taken together or not at all — a budget that
+    cannot absorb the whole slice skips the gang instead of splitting it.
+    Non-gang candidates behave exactly as before (singleton units)."""
     taken: dict[str, int] = {}
     out = []
-    for c in candidates:
-        pool = c.nodepool.name
-        if taken.get(pool, 0) < budgets.get(pool, 0):
-            taken[pool] = taken.get(pool, 0) + 1
-            out.append(c)
+    for unit in atomic_units(candidates):
+        need: dict[str, int] = {}
+        for c in unit:
+            need[c.nodepool.name] = need.get(c.nodepool.name, 0) + 1
+        if all(taken.get(p, 0) + n <= budgets.get(p, 0) for p, n in need.items()):
+            for p, n in need.items():
+                taken[p] = taken.get(p, 0) + n
+            out.extend(unit)
     return out
+
+
+def _complete_units(
+    filtered: list[Candidate], all_candidates: list[Candidate]
+) -> list[Candidate]:
+    """Drop gang candidates whose slice peers did not survive a method's
+    eligibility filter: a strict subset of a slice is never disruptable
+    (the all-or-none eviction invariant)."""
+    pops: dict[str, int] = {}
+    for c in all_candidates:
+        if c.gang_key:
+            pops[c.gang_key] = pops.get(c.gang_key, 0) + 1
+    have: dict[str, int] = {}
+    for c in filtered:
+        if c.gang_key:
+            have[c.gang_key] = have.get(c.gang_key, 0) + 1
+    return [
+        c
+        for c in filtered
+        if not c.gang_key or have[c.gang_key] >= pops.get(c.gang_key, 0)
+    ]
+
+
+def _unit_savings_ratio(unit: list[Candidate]) -> float:
+    """The unit analog of Candidate.savings_ratio: an ordinary node keeps
+    its own ratio (pre-gang sort order, bit-for-bit); a slice is priced
+    and cost-weighted as a whole."""
+    if len(unit) == 1:
+        return unit[0].savings_ratio
+    price = sum(c.price for c in unit)
+    cost = sum(c.disruption_cost for c in unit)
+    return price / cost if cost else price
 
 
 def _consolidatable(c: Candidate, clock, policy_filter: tuple[str, ...]) -> bool:
@@ -122,7 +160,9 @@ class Emptiness:
                 (CONSOLIDATION_WHEN_EMPTY, CONSOLIDATION_WHEN_EMPTY_OR_UNDERUTILIZED),
             )
         ]
-        chosen = _within_budget(empty, budgets)
+        # a slice whose training job finished empties as a whole; a gang
+        # with any non-empty host keeps every host (all-or-none eviction)
+        chosen = _within_budget(_complete_units(empty, candidates), budgets)
         return Command(candidates=chosen, reason=self.reason)
 
 
@@ -136,23 +176,33 @@ class Drift:
         self.simulate = simulate
 
     def compute(self, candidates: list[Candidate], budgets: dict[str, int]) -> Command:
-        drifted = [
-            c
-            for c in candidates
-            if not c.owned_by_static
-            and c.state_node.node_claim is not None
-            and c.state_node.node_claim.conditions.is_true(COND_DRIFTED)
+        def claim_drifted(c: Candidate) -> bool:
+            return (
+                c.state_node.node_claim is not None
+                and c.state_node.node_claim.conditions.is_true(COND_DRIFTED)
+            )
+
+        # a drifted slice host recycles the WHOLE slice: replacing one
+        # host would break the gang's rank layout, so any drifted member
+        # pulls every host of its unit into the command
+        drifted_units = [
+            u
+            for u in atomic_units(candidates)
+            if not any(c.owned_by_static for c in u) and any(claim_drifted(c) for c in u)
         ]
-        chosen = _within_budget(drifted, budgets)
+        chosen = _within_budget(
+            [c for u in drifted_units for c in u], budgets
+        )
         if not chosen:
             return Command(reason=self.reason)
-        # one at a time, verifying pods have somewhere to go (drift.go:98+)
-        for c in chosen:
-            results, unscheduled = self.simulate([c])
+        # one unit at a time, verifying pods have somewhere to go
+        # (drift.go:98+); a gang unit re-provisions a full new slice
+        for unit in atomic_units(chosen):
+            results, unscheduled = self.simulate(unit)
             if results is None or unscheduled:
                 continue
             return Command(
-                candidates=[c],
+                candidates=list(unit),
                 replacements=list(results.claims),
                 reason=self.reason,
                 results=results,
@@ -238,12 +288,14 @@ class _ConsolidationBase:
         self.simulate_batch = simulate_batch
 
     def eligible(self, candidates: list[Candidate]) -> list[Candidate]:
-        return [
+        out = [
             c
             for c in candidates
             if not c.owned_by_static
             and _consolidatable(c, self.clock, (CONSOLIDATION_WHEN_EMPTY_OR_UNDERUTILIZED,))
         ]
+        # all-or-none: a gang consolidates only as a complete slice
+        return _complete_units(out, candidates)
 
     # -- computeConsolidation (consolidation.go:159-343) --------------------
 
@@ -335,11 +387,18 @@ class SingleNodeConsolidation(_ConsolidationBase):
 
     def compute(self, candidates: list[Candidate], budgets: dict[str, int]) -> Command:
         deadline = self.clock.now() + SINGLE_NODE_CONSOLIDATION_TIMEOUT_SECONDS
-        eligible = _within_budget(
-            sorted(self.eligible(candidates), key=lambda c: c.savings_ratio), budgets
-        )
-        if len(eligible) > 1 and self.simulate_batch is not None:
-            signals = self.simulate_batch([[c] for c in eligible])
+        # the walk is over atomic units: ordinary nodes one at a time,
+        # gang slices as whole claim groups (all-or-none eviction)
+        ordered = [
+            c
+            for u in sorted(
+                atomic_units(self.eligible(candidates)), key=_unit_savings_ratio
+            )
+            for c in u
+        ]
+        units = atomic_units(_within_budget(ordered, budgets))
+        if len(units) > 1 and self.simulate_batch is not None:
+            signals = self.simulate_batch([list(u) for u in units])
             if signals is not None:
                 # feasibility is a sound over-approximation (the batch is
                 # fully relaxed), so ok=False candidates are truly dead.
@@ -347,18 +406,18 @@ class SingleNodeConsolidation(_ConsolidationBase):
                 # under constraint removal — so it only ORDERS the
                 # sequential confirms, never drops a feasible candidate.
                 feasible = [
-                    (c, n_new) for c, (ok, n_new) in zip(eligible, signals) if ok
+                    (u, n_new) for u, (ok, n_new) in zip(units, signals) if ok
                 ]
-                eligible = [c for c, n in feasible if n <= 1] + [
-                    c for c, n in feasible if n > 1
+                units = [u for u, n in feasible if n <= 1] + [
+                    u for u, n in feasible if n > 1
                 ]
-        for c in eligible:
+        for unit in units:
             if self.clock.now() >= deadline:
                 from karpenter_tpu.utils.metrics import CONSOLIDATION_TIMEOUTS
 
                 CONSOLIDATION_TIMEOUTS.inc(method="single-node")
                 break
-            cmd = self.compute_consolidation([c], deadline)
+            cmd = self.compute_consolidation(list(unit), deadline)
             if not cmd.is_empty:
                 return cmd
         return Command(reason=self.reason)
@@ -370,11 +429,29 @@ class MultiNodeConsolidation(_ConsolidationBase):
 
     def compute(self, candidates: list[Candidate], budgets: dict[str, int]) -> Command:
         deadline = self.clock.now() + MULTI_NODE_CONSOLIDATION_TIMEOUT_SECONDS
-        eligible = _within_budget(
-            sorted(self.eligible(candidates), key=lambda c: c.savings_ratio), budgets
-        )[:MAX_MULTI_NODE_BATCH]
-        if len(eligible) < 2:
+        # prefixes are over atomic units so a slice's hosts always enter a
+        # prefix together; the batch cap counts NODES, aligned down to a
+        # unit boundary
+        ordered = [
+            c
+            for u in sorted(
+                atomic_units(self.eligible(candidates)), key=_unit_savings_ratio
+            )
+            for c in u
+        ]
+        units: list[list[Candidate]] = []
+        total = 0
+        for u in atomic_units(_within_budget(ordered, budgets)):
+            if total + len(u) > MAX_MULTI_NODE_BATCH:
+                break
+            units.append(u)
+            total += len(u)
+        if total < 2:
             return Command(reason=self.reason)
+
+        def flatten(n: int) -> list[Candidate]:
+            return [c for u in units[:n] for c in u]
+
         # memoized per prefix length: the confirm walk and the binary-search
         # fallback share results, bounding total sequential simulates to
         # confirm_budget + log N with no repeats
@@ -382,7 +459,7 @@ class MultiNodeConsolidation(_ConsolidationBase):
 
         def compute_prefix(n: int) -> Command:
             if n not in prefix_memo:
-                prefix_memo[n] = self.compute_consolidation(eligible[:n], deadline)
+                prefix_memo[n] = self.compute_consolidation(flatten(n), deadline)
             return prefix_memo[n]
 
         def timed_out() -> bool:
@@ -396,7 +473,7 @@ class MultiNodeConsolidation(_ConsolidationBase):
             return False
 
         if self.simulate_batch is not None:
-            signals = self.simulate_batch([eligible[:n] for n in range(1, len(eligible) + 1)])
+            signals = self.simulate_batch([flatten(n) for n in range(1, len(units) + 1)])
             if signals is not None:
                 # every prefix evaluated in ONE device dispatch; confirm the
                 # largest batch-feasible prefixes sequentially (price rules
@@ -411,18 +488,18 @@ class MultiNodeConsolidation(_ConsolidationBase):
                 # exact binary search rather than silently skip.
                 feasible = [
                     (n, n_new)
-                    for n, (ok, n_new) in zip(range(1, len(eligible) + 1), signals)
+                    for n, (ok, n_new) in zip(range(1, len(units) + 1), signals)
                     if ok
                 ]
                 ordered = sorted((n for n, nn in feasible if nn <= 1), reverse=True) + sorted(
                     (n for n, nn in feasible if nn > 1), reverse=True
                 )
-                confirm_budget = max(2, len(eligible).bit_length())
+                confirm_budget = max(2, len(units).bit_length())
                 for n in ordered[:confirm_budget]:
                     if timed_out():
                         return Command(reason=self.reason)
                     cmd = compute_prefix(n)
-                    if not cmd.is_empty and self._replacement_improves(cmd, eligible[:n]):
+                    if not cmd.is_empty and self._replacement_improves(cmd, flatten(n)):
                         return cmd
                 if len(ordered) <= confirm_budget:
                     # every batch-feasible prefix was confirmed infeasible
@@ -431,14 +508,14 @@ class MultiNodeConsolidation(_ConsolidationBase):
                 # untried feasible prefixes remain — run the exact search
         # binary search on the prefix length: find the largest N where
         # consolidating candidates[0..N) simulates successfully
-        lo, hi = 1, len(eligible)
+        lo, hi = 1, len(units)
         best = Command(reason=self.reason)
         while lo <= hi:
             if timed_out():
                 return best  # last valid command
             mid = (lo + hi) // 2
             cmd = compute_prefix(mid)
-            if not cmd.is_empty and self._replacement_improves(cmd, eligible[:mid]):
+            if not cmd.is_empty and self._replacement_improves(cmd, flatten(mid)):
                 best = cmd
                 lo = mid + 1
             else:

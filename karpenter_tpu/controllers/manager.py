@@ -570,8 +570,47 @@ class KubeSchedulerSim:
             sp.set(bound=bound)
         return bound
 
+    def _gang_gate(self, pod, ready_cache: dict) -> bool:
+        """The gang bind gate: a gang member binds ONLY when every member
+        of its gang is bindable too — already bound, or pending with a
+        live nomination whose target node exists, is Ready, and admits it.
+        This is what makes "no partial gang ever binds" hold end-to-end:
+        a slice host lost to an ICE or node failure un-readies the whole
+        gang until the full gang re-places (the real deployment's
+        coscheduling gate; the reference leans on scheduler plugins)."""
+        from karpenter_tpu.gang import gang_of
+
+        parsed = gang_of(pod)
+        if parsed is None:
+            return True
+        key, size, _rank = parsed
+        ready = ready_cache.get(key)
+        if ready is None:
+            members = [
+                p
+                for p in self.store.pods()
+                if (g := gang_of(p)) is not None and g[0] == key
+            ]
+            ready = len(members) >= size
+            if ready:
+                for m in members:
+                    if m.spec.node_name:
+                        continue  # already bound
+                    target = self.cluster.pod_nomination(m.uid)
+                    sn = self._node_for_target(target) if target is not None else None
+                    if sn is None or not self._bindable(
+                        sn, m, Requirements.from_pod(m)
+                    ):
+                        ready = False
+                        break
+            ready_cache[key] = ready
+        return ready
+
     def _bind_pending(self) -> int:
         bound = 0
+        gang_ready: dict[str, bool] = {}
+        from karpenter_tpu.gang import is_gang_pod
+
         for pod in self.store.pods():
             if not pod.is_pending():
                 continue
@@ -581,10 +620,16 @@ class KubeSchedulerSim:
             if target is not None:
                 sn = self._node_for_target(target)
                 if sn is not None and self._bindable(sn, pod, pod_reqs):
+                    if not self._gang_gate(pod, gang_ready):
+                        continue  # all-or-nothing: wait for the full slice
                     self.store.bind_pod(pod.name, sn.node.name)
                     bound += 1
                     continue
                 continue  # target not ready yet: wait instead of scrambling
+            if is_gang_pod(pod):
+                # gang members bind only through their slice nomination —
+                # greedy placement would scramble the rank layout
+                continue
             # greedy fallback must not consume capacity OTHER pods' live
             # nominations reserved
             reserved = self.cluster.nomination_targets()

@@ -53,6 +53,10 @@ class SimClaim:
     reserved_ids: frozenset = frozenset()
     # BestEffort minValues relaxation happened (scheduler.go:769)
     min_values_relaxed: bool = False
+    # gang key when this claim is one host of a dedicated multi-host slice
+    # (gang claims never accept tier-2 adds; disruption treats the slice's
+    # claim group atomically)
+    gang: Optional[str] = None
 
     def cheapest_launch(self) -> tuple[Optional[InstanceType], float]:
         """Cheapest (type, price) among viable types/offerings compatible
@@ -734,7 +738,157 @@ class HostScheduler:
 
         return prefs.run_with_relaxation(list(pods), solve_round, should_stop)
 
+    # -- gang placement (the host gang oracle; ops/solver.py solve_gang twin) --
+
+    def _place_gang(
+        self,
+        gang,
+        claims: list[SimClaim],
+        assignments: dict[str, int],
+        unschedulable: list[tuple[Pod, str]],
+    ) -> None:
+        """All-or-nothing slice placement: the gang's members land on
+        ``ceil(size / f)`` freshly-opened dedicated claims of ONE
+        weight-ordered template (rank r -> host r // f, contiguous rank
+        blocks), or every member fails together. State mutated by a
+        partial attempt (topology counts, budgets, hostnames,
+        reservations) is rolled back, so no partial placement is ever
+        observable."""
+        import copy as _copy
+
+        from karpenter_tpu.gang import GANG_SPILL_REASON, oracle as gang_oracle
+        from karpenter_tpu.scheduling import hostports as hp
+
+        pods = gang.pods_in_rank_order()
+        count = len(pods)
+        rep = pods[0]
+        if self._dra is not None and rep.spec.resource_claims:
+            for p in pods:
+                unschedulable.append(
+                    (p, "gang pods with resource claims are not supported")
+                )
+            return
+        pod_reqs = Requirements.from_pod(rep)
+        strict = Requirements.from_pod(rep, include_preferred=False)
+        volalts = self.volume_reqs.get(rep.uid)
+        relax_mv = self.min_values_policy == "BestEffort"
+        chosen = None
+        for tmpl in self.templates:  # weight order, like try_new_claim
+            budget = self.budgets.get(tmpl.nodepool_name)
+            if budget is not None and budget.get("nodes", 1.0) < 1.0:
+                continue
+            if tolerates_all(tmpl.taints, rep.spec.tolerations) is not None:
+                continue
+            if tmpl.requirements.compatible(pod_reqs, l.WELL_KNOWN_LABELS) is not None:
+                continue
+            combined = gang_oracle.gang_requirements(tmpl, pod_reqs)
+            if volalts:
+                alt = volalts[0]
+                if combined.compatible(alt, l.WELL_KNOWN_LABELS) is not None:
+                    continue
+                combined.add(*alt.values())
+            candidates = self._within_budget(tmpl, tmpl.instance_types)
+            total1 = res.merge(tmpl.daemon_requests, rep.total_requests())
+            remaining1 = filter_instance_types(
+                candidates, combined, total1, relax_min_values=relax_mv
+            )
+            if not remaining1:
+                continue
+            chosen = (tmpl, combined, candidates, remaining1)
+            break
+        if chosen is None:
+            for p in pods:
+                unschedulable.append((p, "no compatible in-flight claim or template"))
+            return
+        tmpl, combined, candidates, remaining1 = chosen
+        f = gang_oracle.slice_capacity(
+            remaining1,
+            combined,
+            tmpl.daemon_requests,
+            rep.total_requests(),
+            host_ports=bool(rep.spec.host_ports),
+        )
+        want = gang_oracle.hosts_needed(count, f)
+        if want == 0:
+            for p in pods:
+                unschedulable.append((p, "no compatible in-flight claim or template"))
+            return
+        budget = self.budgets.get(tmpl.nodepool_name)
+        if budget is not None and budget.get("nodes", float("inf")) < want:
+            # a constraint no slot escalation can fix: the whole gang spills
+            for p in pods:
+                unschedulable.append((p, GANG_SPILL_REASON))
+            return
+        # snapshot the state a partial attempt could dirty
+        topo_snapshot = _copy.deepcopy(self.topology)
+        budgets_snapshot = {k: dict(v) for k, v in self.budgets.items()}
+        hostname_seq0 = self._hostname_seq
+        new_claims: list[SimClaim] = []
+        ok = True
+        for block in gang_oracle.rank_blocks(pods, f):
+            hostname = self._next_hostname()
+            tightened = combined.copy()
+            tightened.add(gang_oracle.hostname_requirement(hostname))
+            for p in block:
+                t2 = self.topology.add_requirements(p, strict, tightened)
+                if t2 is None or tightened.compatible(t2, l.WELL_KNOWN_LABELS) is not None:
+                    ok = False
+                    break
+                tightened = t2
+            if not ok:
+                break
+            total = gang_oracle.merge_scaled(
+                dict(tmpl.daemon_requests), rep.total_requests(), len(block)
+            )
+            remaining = filter_instance_types(
+                candidates, tightened, total, relax_min_values=relax_mv
+            )
+            if not remaining:
+                ok = False
+                break
+            new_ids = self._reserve_for(hostname, remaining, tightened, frozenset())
+            if new_ids is None:
+                ok = False
+                break
+            self.topology.register(l.LABEL_HOSTNAME, hostname)
+            for p in block:
+                self.topology.record(p, tightened)
+            self._charge_budget(tmpl, remaining)
+            new_claims.append(
+                SimClaim(
+                    template=tmpl,
+                    requirements=tightened,
+                    used=total,
+                    instance_types=remaining,
+                    pods=list(block),
+                    slot=len(claims) + len(new_claims),
+                    hostname=hostname,
+                    host_ports=[
+                        hp.port_key(h) for p in block for h in p.spec.host_ports
+                    ],
+                    reserved_ids=new_ids,
+                    gang=gang.key,
+                )
+            )
+        if not ok:
+            # unwind: no partial gang is ever observable
+            self.topology = topo_snapshot
+            self.budgets = budgets_snapshot
+            self._hostname_seq = hostname_seq0
+            if self._rm is not None:
+                for claim in new_claims:
+                    self._rm.release(claim.hostname, *claim.reserved_ids)
+            for p in pods:
+                unschedulable.append((p, GANG_SPILL_REASON))
+            return
+        for claim in new_claims:
+            claims.append(claim)
+            for p in claim.pods:
+                assignments[p.uid] = claim.slot
+
     def _solve_once(self, pods: list[Pod]) -> SchedulingResult:
+        from karpenter_tpu.gang import GANG_WAITING_REASON, collect_gangs, order_gangs
+
         self._rm = self._build_rm()
         self._dra = self.dra_problem.fresh_round() if self.dra_problem is not None else None
         claims: list[SimClaim] = []
@@ -742,7 +896,26 @@ class HostScheduler:
         assignments: dict[str, int] = {}
         existing_assignments: dict[str, str] = {}
         expired = False
-        for pod in ffd_sort(pods):
+        # gangs place FIRST, largest slice first, all-or-nothing on fresh
+        # dedicated claims; singleton pods then run the usual FFD cascade
+        # (tier 2 skips gang claims — a slice is never shared)
+        gangs, singles, invalid = collect_gangs(pods)
+        for pod, reason in invalid:
+            unschedulable.append((pod, reason))
+        for gang in order_gangs(gangs):
+            if self.deadline is not None and self.now() >= self.deadline:
+                for p in gang.pods_in_rank_order():
+                    unschedulable.append((p, SOLVE_TIMEOUT_REASON))
+                continue
+            if not gang.complete:
+                # stragglers missing: the orchestration layer normally
+                # holds these back (GangWaitTracker); a direct solve keeps
+                # them pending as a unit
+                for p in gang.pods_in_rank_order():
+                    unschedulable.append((p, GANG_WAITING_REASON))
+                continue
+            self._place_gang(gang, claims, assignments, unschedulable)
+        for pod in ffd_sort(singles):
             expired = expired or (
                 self.deadline is not None and self.now() >= self.deadline
             )
@@ -773,8 +946,12 @@ class HostScheduler:
             if placed:
                 continue
             # tier 2: in-flight claims, fewest pods first, earliest slot
-            # tie-break (scheduler.go:598-599)
-            for claim in sorted(claims, key=lambda c: (len(c.pods), c.slot)):
+            # tie-break (scheduler.go:598-599); gang claims are dedicated
+            # slice hosts and never accept singleton adds
+            for claim in sorted(
+                (c for c in claims if c.gang is None),
+                key=lambda c: (len(c.pods), c.slot),
+            ):
                 updated = self.can_add(claim, pod, pod_reqs, strict)
                 if updated is not None:
                     claims[claims.index(claim)] = updated

@@ -80,8 +80,13 @@ class Provisioner:
         self.device_allocation = None
         self._scheduler_cache: Optional[tuple[tuple, TPUScheduler]] = None
         self._buffer_pods: dict[tuple[str, int], list[Pod]] = {}
+        from karpenter_tpu.gang import GangWaitTracker
         from karpenter_tpu.utils.logging import ChangeMonitor
 
+        # straggler wait for partial gangs: incomplete gangs are held out
+        # of the solve until every member arrives or the wait times out
+        # (KTPU_GANG_WAIT_SECONDS); completion observes the wait histogram
+        self.gang_wait = GangWaitTracker(clock)
         self._log_monitor = ChangeMonitor(clock=clock)
 
     # -- pod collection (provisioner.go:350-385) -------------------------------
@@ -134,6 +139,99 @@ class Provisioner:
                 self._buffer_pods[key] = virtual_pods([buffer], self.store)
             out.extend(self._buffer_pods[key])
         return out
+
+    # -- gang batching (gangs batch as units; stragglers wait) -------------------
+
+    def _admit_gangs(self, pods: list[Pod]) -> list[Pod]:
+        """Gang-aware batch admission: complete gangs enter the solve as
+        units; partial gangs are held back until every member arrives or
+        the wait times out (reported via metric + event, then the wait
+        restarts). Also runs gang RECOVERY: when some members of a gang
+        lost their claim (ICE, node death) while peers still hold live
+        nominations to unbound claims, the peers' nominations are released
+        so the WHOLE gang re-solves — all-or-nothing applies to re-placement
+        too, never just the orphaned members."""
+        from karpenter_tpu.gang import collect_gangs, gang_of, is_gang_pod
+        from karpenter_tpu.utils import events, metrics
+
+        if not any(is_gang_pod(p) for p in pods):
+            return pods
+        gangs, singles, invalid = collect_gangs(pods)
+        # recovery: fold nominated-but-unbound peers into incomplete gangs
+        if any(not g.complete for g in gangs):
+            by_key = {g.key: g for g in gangs}
+            for p in self.store.pods():
+                if not p.is_pending() or self.cluster.pod_nomination(p.uid) is None:
+                    continue
+                parsed = gang_of(p)
+                if parsed is None:
+                    continue
+                key, _size, rank = parsed
+                g = by_key.get(key)
+                if g is not None and not g.complete and rank not in g.members:
+                    self.cluster.clear_pod_nomination(p.uid)
+                    g.members[rank] = p
+        ready, waiting, timed_out = self.gang_wait.admit(gangs)
+        for g in timed_out:
+            metrics.GANG_PLACEMENTS.inc(outcome="timeout")
+            if self.recorder is not None:
+                self.recorder.publish(
+                    events.failed_scheduling(
+                        g.key,
+                        f"gang {g.key} waited past the straggler timeout: "
+                        f"{g.missing}/{g.size} members still missing",
+                    )
+                )
+        out = list(singles)
+        out.extend(p for p, _ in invalid)  # engines report these loudly
+        for g in ready:
+            out.extend(g.pods_in_rank_order())
+        return out
+
+    def _record_gang_outcomes(self, result: SchedulingResult) -> None:
+        """Per-gang outcome accounting over one solve result, and the
+        no-partial-placement tripwire (outcome="partial" must stay zero —
+        both engines commit gangs atomically by construction)."""
+        from karpenter_tpu.gang import GANG_INVALID_REASON, gang_of
+        from karpenter_tpu.utils import metrics
+
+        placed: dict[str, int] = {}
+        failed: dict[str, int] = {}
+        invalid: dict[str, int] = {}
+        sizes: dict[str, int] = {}
+        for pod, reason in result.unschedulable:
+            parsed = gang_of(pod)
+            if parsed is None:
+                continue
+            key, size, _rank = parsed
+            sizes[key] = size
+            if reason.startswith(GANG_INVALID_REASON):
+                invalid[key] = invalid.get(key, 0) + 1
+            else:
+                failed[key] = failed.get(key, 0) + 1
+        for sim in result.claims:
+            if sim.gang:
+                sizes.setdefault(sim.gang, 0)
+                placed[sim.gang] = placed.get(sim.gang, 0) + len(sim.pods)
+        for key in sizes:
+            n_placed = placed.get(key, 0)
+            n_failed = failed.get(key, 0)
+            if invalid.get(key):
+                metrics.GANG_PLACEMENTS.inc(outcome="invalid")
+            elif n_placed and not n_failed:
+                metrics.GANG_PLACEMENTS.inc(outcome="placed")
+            elif n_failed and not n_placed:
+                metrics.GANG_PLACEMENTS.inc(outcome="spilled")
+                metrics.GANG_SPILLS.inc()
+            elif n_placed and n_failed:
+                # invariant violation: should be impossible by construction
+                from karpenter_tpu.utils.logging import get_logger
+
+                get_logger().with_values(controller="provisioner").error(
+                    "partial gang placement observed", gang=key,
+                    placed=n_placed, failed=n_failed,
+                )
+                metrics.GANG_PLACEMENTS.inc(outcome="partial")
 
     # -- scheduling --------------------------------------------------------------
 
@@ -364,6 +462,13 @@ class Provisioner:
         # toleration, or batch-infeasible verdicts wrongly kill candidates
         all_pods = [terminal_relaxed(p) for p in pending + list(union.values())]
         if self.dynamic_resources_enabled and any(p.spec.resource_claims for p in all_pods):
+            return None
+        from karpenter_tpu.gang import is_gang_pod
+
+        if any(is_gang_pod(p) for p in all_pods):
+            # the batched what-if kernel has no gang atomicity — a partial
+            # placement would read feasible; fall back to the sequential
+            # simulate, whose engines solve gangs exactly
             return None
         volctx = self._volume_context()
         existing = self._existing_sim_nodes(volctx=volctx)
@@ -751,6 +856,12 @@ class Provisioner:
                 "true" if sim.min_values_relaxed else "false"
             )
         }
+        if sim.gang:
+            # every host claim of a slice carries the gang key so
+            # disruption/lifecycle can treat the claim group atomically
+            from karpenter_tpu.gang import GANG_CLAIM_ANNOTATION
+
+            annotations[GANG_CLAIM_ANNOTATION] = sim.gang
         launchable = order_by_price(sim.instance_types, sim.requirements)[:MAX_INSTANCE_TYPES]
         requirements = []
         for r in sim.requirements.values():
@@ -851,6 +962,12 @@ class Provisioner:
             return None
         if not self.cluster.synced():
             return self.GATED
+        # gangs batch as units: partial gangs wait for stragglers (with a
+        # timeout), orphaned members pull their nominated peers back so
+        # the whole gang re-solves
+        pods = self._admit_gangs(pods)
+        if not pods:
+            return None  # every pending pod is a gang still waiting
         scheduler = self._build_scheduler()
         if scheduler is None:
             return self.GATED
@@ -916,6 +1033,8 @@ class Provisioner:
                 ),
             )
         metrics.SCHEDULING_UNSCHEDULABLE.set(float(len(result.unschedulable)))
+        # per-gang outcome accounting + the partial-placement tripwire
+        self._record_gang_outcomes(result)
         # per-pod scheduling explainer: provenance into the deduped event
         # stream + the trace, and the reasoned unschedulable-pods gauge
         self._explain_result(result, scheduler.templates)

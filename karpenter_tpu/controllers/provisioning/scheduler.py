@@ -49,6 +49,13 @@ class DivergenceError(RuntimeError):
     to the host oracle (never aborts provisioning)."""
 
 
+class _GangHostRoute(RuntimeError):
+    """A gang solve hit a constraint family the device gang kernel does
+    not cover (finite budgets, reservations, enforced minValues, or a
+    gang kind with topology interaction); the solve degrades to the host
+    oracle, which implements the identical all-or-nothing semantics."""
+
+
 # NO_ROOM is a device-shape artifact with no reference analog: the Go
 # scheduler always opens another node (scheduler.go:582-612). solve()
 # recovers by doubling the claim-slot capacity and re-solving, so this
@@ -159,6 +166,10 @@ def _slim_outputs(specs: tuple, flat) -> tuple[list, list]:
         elif spec[0] == "kscan":
             proc.append(flat[i][: spec[1]])
             i += 1
+        elif spec[0] == "gang":
+            B = spec[1]
+            proc.extend(a[:B] for a in flat[i : i + 5])
+            i += 5
         else:
             B = spec[1]
             fc, fe, os_, no_, st_, sm = flat[i : i + 6]
@@ -253,6 +264,24 @@ def _make_final_prep(tk: tuple):
 
     def _prep(state):
         return _state_reads(state, tk)
+
+    return _prep
+
+
+def _make_group_final_prep(specs: tuple, tk: tuple):
+    """Jitted fetch prep for the LAST pipeline chunk group: the group's
+    outputs AND the final-state reads ride ONE transfer, so the pipelined
+    decode pays no trailing state-fetch round trip (the ROADMAP's
+    "ride the final state fetch on the last chunk group" lever)."""
+
+    def _prep(tmpl, flat, state):
+        proc = [tmpl]
+        out, maxes = _slim_outputs(specs, flat)
+        proc.extend(out)
+        if maxes:
+            proc.append(jnp.max(jnp.stack(maxes)))
+        proc.extend(_state_reads(state, tk))
+        return proc
 
     return _prep
 
@@ -716,6 +745,12 @@ class TPUScheduler:
             self.reserved_mode = reserved_mode
         try:
             return prefs.run_with_relaxation(list(pods), solve_round, should_stop)
+        except _GangHostRoute:
+            # gangs + a constraint family the device gang kernel does not
+            # cover (finite budgets, reservations, enforced minValues, or
+            # gang topology interaction): the host oracle implements the
+            # identical all-or-nothing semantics exactly
+            return host_solve("gang_constraints")
         except DivergenceError:
             # the reference never aborts a Solve — a device/host decode
             # divergence re-solves the whole problem on the exact oracle
@@ -906,6 +941,13 @@ class TPUScheduler:
                 return None
         self._reserved_in_use = reserved_in_use or {}
         pods = list(pods)
+        from karpenter_tpu.gang import is_gang_pod
+
+        if any(is_gang_pod(p) for p in pods):
+            # the per-pod what-if kernel has no gang atomicity — a partial
+            # placement would read as feasible; callers fall back to the
+            # sequential simulate, which solves gangs exactly
+            return None
         topo0 = topology_factory(pods, scenarios[0][0])
         pods_sorted, enc = self._encode(
             pods, [n.clone() for n in existing_nodes], budgets, topo0
@@ -1057,7 +1099,30 @@ class TPUScheduler:
         # the same keys), and np.unique factorizes kinds. The volume-
         # restricted case (rare; multi-alternative routes to the host
         # anyway) refines kinds with the per-pod volume signature.
-        pods_list = list(pods)
+        # ---- gang partition: gangs solve FIRST as all-or-nothing units ----
+        # Complete gangs form a rank-ordered prefix (largest slice first —
+        # the shared order_gangs rule the host oracle uses too); incomplete
+        # and invalid gangs never enter the solve and surface as
+        # pre-decided unschedulable entries (the orchestration layer's
+        # GangWaitTracker normally holds stragglers back before this).
+        from karpenter_tpu import gang as gang_mod
+
+        pods_all = list(pods)
+        gangs_g, singles_list, invalid_g = gang_mod.collect_gangs(pods_all)
+        pre_unsched: list = list(invalid_g)
+        gang_prefix: list = []
+        gang_bounds: list = []  # (lo, hi, gang key) within the prefix
+        for g in gang_mod.order_gangs(gangs_g):
+            if not g.complete:
+                pre_unsched.extend(
+                    (p, gang_mod.GANG_WAITING_REASON) for p in g.pods_in_rank_order()
+                )
+                continue
+            lo_g = len(gang_prefix)
+            gang_prefix.extend(g.pods_in_rank_order())
+            gang_bounds.append((lo_g, len(gang_prefix), g.key))
+        pods_list = gang_prefix + singles_list
+        n_gang = len(gang_prefix)
         P = len(pods_list)
         cap = self.max_claims or _next_pow2(max(P, 1))
         if self._n_claims_override:
@@ -1101,11 +1166,27 @@ class TPUScheduler:
                 sizes[i] = req.get(res.CPU, 0.0) + req.get(res.MEMORY, 0.0) / (4.0 * 2**30)
         else:
             gather_ffd_keys(pods_list, sig, sizes)
+        # each gang is its OWN kind (negative sig ids never collide with
+        # interned content sigs), so every gang is exactly one contiguous
+        # scan segment and cross-gang kind merging cannot happen
+        for gi, (lo_g, hi_g, _key) in enumerate(gang_bounds):
+            sig[lo_g:hi_g] = -(gi + 1)
         if P:
-            # first-appearance rank in ORIGINAL order = ffd_sort's tie key
-            _, first0, inv0 = np.unique(sig[:P], return_index=True, return_inverse=True)
-            ranks = np.argsort(np.argsort(first0))[inv0]
-            order = np.lexsort((ranks, -sizes[:P]))
+            if n_gang:
+                # the gang prefix keeps its order; only singletons FFD-sort
+                s_sig = sig[n_gang:P]
+                s_sizes = sizes[n_gang:P]
+                _, first0, inv0 = np.unique(s_sig, return_index=True, return_inverse=True)
+                ranks = np.argsort(np.argsort(first0))[inv0]
+                order_s = np.lexsort((ranks, -s_sizes))
+                order = np.concatenate(
+                    [np.arange(n_gang, dtype=np.int64), n_gang + order_s]
+                )
+            else:
+                # first-appearance rank in ORIGINAL order = ffd_sort's tie key
+                _, first0, inv0 = np.unique(sig[:P], return_index=True, return_inverse=True)
+                ranks = np.argsort(np.argsort(first0))[inv0]
+                order = np.lexsort((ranks, -sizes[:P]))
             pods_sorted = [pods_list[i] for i in order]
             # kind ids numbered by first appearance in the SORTED sequence
             sig_sorted = sig[:P][order]
@@ -1117,6 +1198,11 @@ class TPUScheduler:
             pods_sorted = []
             kind_of = np.zeros(1, dtype=np.int64)
             reps = [Pod()]  # degenerate empty solve
+        # kind -> gang key for the gang prefix (prefix positions survive the
+        # sort untouched, so kind_of[lo] is the gang's kind id)
+        gang_key_of_kind: dict[int, str] = {
+            int(kind_of[lo_g]): key for lo_g, _hi, key in gang_bounds
+        }
 
         for p in reps:
             self.encoder.observe_pod(p)
@@ -1472,6 +1558,27 @@ class TPUScheduler:
                     and not vgr_np[u].any()
                     and not (hga_np[u] & empty_aff).any()
                 )
+        # gang kinds ride the gang-atomic kernel only; its routing
+        # preconditions are the fill kernel's (no enforced minValues, no
+        # reservations, no finite budgets) plus zero topology interaction
+        # — anything else degrades the whole solve to the host oracle,
+        # which implements identical all-or-nothing semantics exactly
+        gang_kind = np.zeros(U, dtype=bool)
+        for k in gang_key_of_kind:
+            gang_kind[k] = True
+        if gang_bounds:
+            gk = np.flatnonzero(gang_kind)
+            topo_touch = bool(
+                vga_np[gk].any()
+                or vgr_np[gk].any()
+                or hga_np[gk].any()
+                or hgr_np[gk].any()
+            )
+            if not allow_fill or topo_touch:
+                raise _GangHostRoute(
+                    "gang solve outside the device kernel's constraint family"
+                )
+        batchable[gang_kind] = False
         # vg-topology kinds whose every applying/recording group shares ONE
         # narrow vocab key ride the same-kind batched scan instead of the
         # per-pod scan (ops/solver.py solve_kind_scan — the reference
@@ -1511,6 +1618,9 @@ class TPUScheduler:
             segments=segments,
             batchable=batchable,
             kscan_key=kscan_key,
+            gang_kind=gang_kind,
+            gang_key_of_kind=gang_key_of_kind,
+            pre_unsched=pre_unsched,
             kind_records=kind_records,
             reps=reps,
             exist_tensors=exist_tensors,
@@ -1601,9 +1711,12 @@ class TPUScheduler:
         # runs additionally split per topology key (the key is a static
         # kernel argument)
         kscan_key = enc["kscan_key"]
+        gang_kind = enc["gang_kind"]
 
         def _seg_mode(seg):
             k = seg[2]
+            if gang_kind[k]:
+                return ("gang",)
             if batchable[k]:
                 return ("fill",)
             if kscan_key[k] >= 0:
@@ -1685,7 +1798,37 @@ class TPUScheduler:
                 import time as _time
 
                 _t_run0 = _time.perf_counter()
-            if mode[0] == "fill":
+            if mode[0] == "gang":
+                # gang-atomic slice placement: one scan segment per gang,
+                # pods in rank order; padded rows carry count=0 (no-ops)
+                B = len(segs)
+                B_pad = self._pad_cache.pad("gang_segments", B, step=8)
+                kind_ids = np.zeros(B_pad, dtype=np.int64)
+                counts = np.zeros(B_pad, dtype=np.int32)
+                for j, (lo, hi, k) in enumerate(segs):
+                    kind_ids[j] = k
+                    counts[j] = hi - lo
+                # hosts-per-slice static bound: a gang of N pods never
+                # opens more than N claims
+                maxg = self._pad_cache.pad("gang_cap", int(counts.max()), step=8)
+                xs = _gather_fill_xs(
+                    enc["reqs_k"], enc["requests_k"], enc["tol_k"],
+                    enc["it_allow_k"], enc["exist_ok_k"], enc["ports_k"],
+                    enc["conf_k"], enc["vols_k"], enc["pod_topo_k"],
+                    jnp.asarray(kind_ids), jnp.asarray(counts),
+                )
+                state, ys = ops_solver.solve_gang(
+                    state, xs, exist_tensors, self.it_tensors, template_tensors,
+                    self.well_known, topo_tensors,
+                    zone_kid=enc["zone_kid"], ct_kid=enc["ct_kid"],
+                    n_claims=n_claims, maxg=maxg,
+                )
+                outputs.append(("gang", segs, ys))
+                tmpl_snaps.append(ops_solver.global_template(state))
+                for lo_, hi_, k_ in segs:
+                    remaining[k_] -= hi_ - lo_
+                state = _maybe_compact(state)
+            elif mode[0] == "fill":
                 B = len(segs)
                 # bucketed padding: multiple-of-8 up to 32, multiple-of-32
                 # above (every padded row is a full fill step); the
@@ -1885,6 +2028,13 @@ class TPUScheduler:
                 flat.append(o[2].assignment)
                 specs.append(("kscan", len(o[1])))
                 weights.append(sum(hi - lo for lo, hi, _ in o[1]))
+            elif o[0] == "gang":
+                ys = o[2]
+                flat.extend(
+                    [ys.open_g, ys.n_opened, ys.fill, ys.leftover, ys.status]
+                )
+                specs.append(("gang", len(o[1])))
+                weights.append(sum(hi - lo for lo, hi, _ in o[1]))
             else:
                 ys = o[2]
                 flat.extend(
@@ -1921,7 +2071,10 @@ class TPUScheduler:
         slot_to_claim: dict[int, SimClaim] = {}
         claim_kinds: dict[int, dict[int, int]] = {}  # slot -> kind -> count
         node_kinds: dict[int, dict[int, int]] = {}
-        unschedulable: list[tuple[Pod, str]] = []
+        # pods decided before the solve ran: invalid gang annotations and
+        # gangs still waiting for stragglers (the host oracle reports the
+        # same entries at the same point of its cascade)
+        unschedulable: list[tuple[Pod, str]] = list(enc.get("pre_unsched") or [])
         assignments: dict[str, int] = {}
         existing_assignments: dict[str, str] = {}
         hostname_seq = 0
@@ -2197,6 +2350,56 @@ class TPUScheduler:
                     )
                     unschedulable.append((pods_sorted[lo0 + i], reason))
 
+        def decode_gang_output(segs, f) -> None:
+            """Gang-grouped claim expansion: slice host j takes the
+            contiguous rank block [j*f, (j+1)*f). All-or-nothing by
+            construction — the kernel commits either every host of the
+            slice or none, so a partial gang can never decode; a spilled
+            gang fails every member together with one reason."""
+            from karpenter_tpu.gang import GANG_SPILL_REASON
+
+            gang_by_kind = enc.get("gang_key_of_kind") or {}
+            open_g = f["open_g"]
+            n_opened = f["n_opened"]
+            fills = f["fill"]
+            leftover = f["leftover"]
+            status = f["status"]
+            for j, (lo, hi, kind) in enumerate(segs):
+                count = hi - lo
+                if count == 0:
+                    continue
+                if int(leftover[j]):
+                    st = int(status[j])
+                    if st == ops_solver.NO_ROOM:
+                        reason = NO_ROOM_REASON
+                    elif st == ops_solver.GANG_SPILL:
+                        reason = GANG_SPILL_REASON
+                    else:
+                        reason = NO_CLAIM_REASON
+                    for i2 in range(lo, hi):
+                        unschedulable.append((pods_sorted[i2], reason))
+                    continue
+                fj = int(fills[j])
+                base = int(open_g[j])
+                n_h = int(n_opened[j])
+                pk = kind_ports(kind)
+                for cj in range(n_h):
+                    slot = base + cj
+                    claim = ensure_claim(slot)
+                    claim.gang = gang_by_kind.get(int(kind))
+                    batch = [
+                        pods_sorted[i2]
+                        for i2 in range(lo + cj * fj, lo + min((cj + 1) * fj, count))
+                    ]
+                    claim.pods.extend(batch)
+                    for p in batch:
+                        assignments[p.metadata.uid] = slot
+                    if pk:
+                        claim.host_ports.extend(pk * len(batch))
+                    ck = claim_kinds[slot]
+                    ck[kind] = ck.get(kind, 0) + len(batch)
+                    claim_pod_counts[slot] += len(batch)
+
         def apply_assignments(idx0: int, arr: np.ndarray) -> None:
             """Vectorized per-pod decode: arr[i] is pod (idx0+i)'s E-space
             slot (global claim ids) or a negative sentinel. Claims apply
@@ -2250,6 +2453,8 @@ class TPUScheduler:
                 apply_assignments(
                     lo, np.asarray(assignment[: hi - lo], dtype=np.int64)
                 )
+            elif out[0] == "gang":
+                decode_gang_output(out[1], out[2])
             elif out[0] == "kscan":
                 _, segs, assign = out
                 for j, (lo, hi, _kind) in enumerate(segs):
@@ -2266,6 +2471,18 @@ class TPUScheduler:
                 return (o[0], o[1], o[2], next(it_f)), False
             if spec[0] == "kscan":
                 return (o[0], o[1], next(it_f)), False
+            if spec[0] == "gang":
+                return (
+                    o[0],
+                    o[1],
+                    {
+                        "open_g": next(it_f),
+                        "n_opened": next(it_f),
+                        "fill": next(it_f),
+                        "leftover": next(it_f),
+                        "status": next(it_f),
+                    },
+                ), False
             return (
                 o[0],
                 o[1],
@@ -2377,21 +2594,34 @@ class TPUScheduler:
             with TRACER.span("solve.pipeline", chunks=G) as psp:
                 for gi, (glo, ghi) in enumerate(groups):
                     in_flight = G - 1 - gi  # chunk groups still on device
+                    last_group = gi == G - 1
                     cpu0 = read_cpu_seconds()
                     with TRACER.span(
                         f"solve.pipeline.chunk[{gi}]", idx=gi, in_flight=in_flight
                     ) as csp:
                         sg = tuple(specs[glo:ghi])
-                        prep = _cached_prep(
-                            ("group", sg, pad_sig),
-                            lambda sg=sg: _make_group_prep(sg),
-                        )
                         f_lo = flat_spans[glo][0]
                         f_hi = flat_spans[ghi - 1][1]
                         t0 = _time.perf_counter()
-                        fetched_flat = fetch_tree(
-                            prep(tmpl_snaps[ghi - 1], flat[f_lo:f_hi])
-                        )
+                        if last_group:
+                            # the final-state reads RIDE the last chunk
+                            # group's transfer: the trailing wire drain
+                            # (a whole extra round trip) disappears
+                            prep = _cached_prep(
+                                ("group_final", sg, tk, pad_sig),
+                                lambda sg=sg: _make_group_final_prep(sg, tk),
+                            )
+                            fetched_flat = fetch_tree(
+                                prep(tmpl_snaps[ghi - 1], flat[f_lo:f_hi], state)
+                            )
+                        else:
+                            prep = _cached_prep(
+                                ("group", sg, pad_sig),
+                                lambda sg=sg: _make_group_prep(sg),
+                            )
+                            fetched_flat = fetch_tree(
+                                prep(tmpl_snaps[ghi - 1], flat[f_lo:f_hi])
+                            )
                         t1 = _time.perf_counter()
                         if self._t_fetch_done is None:
                             self._t_fetch_done = t1
@@ -2404,6 +2634,15 @@ class TPUScheduler:
                             any_fill |= is_fill
                             new_outs.append(out)
                         fill_max = next(it_f) if any_fill else None
+                        if last_group:
+                            fetched = {name: next(it_f) for name in _STATE_HEAD}
+                            if tk:
+                                for name in (
+                                    "c_mask", "c_inf", "c_def",
+                                    "e_mask", "e_inf", "e_def",
+                                ):
+                                    fetched[name] = next(it_f)
+                            claim_template = fetched["template"]
                         if fill_max is not None and int(fill_max) >= 2**15:
                             widen_fill(range(glo, ghi), new_outs)
                         for out in new_outs:
@@ -2425,21 +2664,11 @@ class TPUScheduler:
                             pods=stat["pods"],
                         )
                         chunk_stats.append(stat)
-                # the drain: final-state reads (template/its/used/held/
-                # n_open + topo rows) — the pipeline's only exposed round
-                # trip besides chunk 0's device wait
-                prep = _cached_prep(
-                    ("final", tk, pad_sig), lambda: _make_final_prep(tk)
-                )
-                t0 = _time.perf_counter()
-                with TRACER.span("solve.wire", arrays=len(_STATE_HEAD) + 6 * bool(tk)):
-                    fetched_flat = fetch_tree(prep(state))
-                t_final = _time.perf_counter() - t0
-                it_f = iter(fetched_flat)
-                fetched = {name: next(it_f) for name in _STATE_HEAD}
-                if tk:
-                    for name in ("c_mask", "c_inf", "c_def", "e_mask", "e_inf", "e_def"):
-                        fetched[name] = next(it_f)
+                # no trailing drain: the final-state reads rode the last
+                # chunk group's transfer (group_final prep), so the
+                # pipeline's only exposed round trip is chunk 0's device
+                # wait — `fetched` was populated inside the loop
+                t_final = 0.0
                 # overlap attribution: a chunk's wire+decode time is
                 # overlapped exactly when later chunk groups were still in
                 # flight on the device; the last chunk and the final fetch
@@ -2461,6 +2690,8 @@ class TPUScheduler:
                 self._pipeline_stats = {
                     "n_chunks": G,
                     "overlap_frac": overlap_frac,
+                    # the final-state reads rode the last chunk's transfer
+                    "fused_final": True,
                     # chunk 0's fetch = device drain of chunk 0 + its
                     # transfer (the pipeline fill; analogous to the old
                     # single-fetch device wait)
@@ -2527,14 +2758,40 @@ class TPUScheduler:
 
         its_mask = fetched["its"]
         held = fetched["held"]
-        used_np = fetched["used"]
+        used_np = np.asarray(fetched["used"])
         rids = self.encoder._resource_ids
+        # The per-claim requirements rebuild was the last per-claim Python
+        # on the hot path (ROADMAP lever): a solve opens thousands of
+        # claims drawn from a handful of (template, kind-set) combinations,
+        # so the expensive pieces — the template ∩ kind-requirements
+        # intersection, the resource-name/rid layout, and the viable
+        # instance-type selection — are memoized per combination and each
+        # claim pays only a dict copy + its own hostname/narrowing fold.
+        proto_cache: dict = {}  # (tmpl id, kinds sig) -> (reqs, names, ridx)
+        its_cache: dict = {}  # (tmpl id, its-row bytes) -> [InstanceType]
+        n_rid = len(self._rid_names) if self._rid_names else 0
         for claim in claims:
             s = claim.slot
             kinds = claim_kinds[s]
-            reqs = claim.requirements
-            for k in kinds:
-                reqs.add(*kind_reqs(k).values())
+            ksig = tuple(sorted(kinds))
+            tid = id(claim.template)
+            memo = proto_cache.get((tid, ksig))
+            if memo is None:
+                proto = claim.template.requirements.copy()
+                names = set(claim.template.daemon_requests)
+                for k in ksig:
+                    proto.add(*kind_reqs(k).values())
+                    names.update(kind_total(k))
+                names = sorted(names)
+                ridx = np.array([rids[n] for n in names], dtype=np.int64)
+                memo = proto_cache[(tid, ksig)] = (proto, names, ridx)
+            proto, names, ridx = memo
+            # template ∩ kind reqs (shared) + this claim's hostname; the
+            # intersection is commutative, so this equals the old
+            # per-claim re-add of every kind's requirements
+            reqs = proto.copy()
+            reqs.add(Requirement.new(l.LABEL_HOSTNAME, Operator.IN, claim.hostname))
+            claim.requirements = reqs
             if topo_kids:
                 fold_narrowing(
                     reqs,
@@ -2543,26 +2800,28 @@ class TPUScheduler:
                     fetched["c_def"][s],
                     f"claim slot {s}",
                 )
-            # usage from the device carry (daemon overhead folded in on open)
-            keys = set(claim.template.daemon_requests)
-            for k in kinds:
-                keys.update(kind_total(k))
-            vec = used_np[s]
-            claim.used = {name: float(vec[rids[name]]) for name in keys}
+            # usage from the device carry (daemon overhead folded in on
+            # open): one fancy-index gather per claim over the memoized
+            # name layout
+            vec = used_np[s][ridx]
+            claim.used = dict(zip(names, vec.tolist()))
             # viable instance types straight from the device solver state
             # (the device carried budget bookkeeping too); TEMPLATE catalog
             # order so cheapest_launch tie-breaks identically to the host.
-            # The template's ITs are pre-indexed into catalog columns so
-            # the filter is one mask gather, not an O(T) name-set scan
-            # per claim (the north star opens thousands of claims).
-            t_its, t_cat_idx = self._template_it_index(claim.template)
-            sel = np.flatnonzero(its_mask[s][t_cat_idx])
-            claim.instance_types = [t_its[i] for i in sel.tolist()]
+            # Identical mask rows (thousands of same-shape claims at the
+            # north star) share one decoded list.
+            row = np.asarray(its_mask[s])
+            ikey = (tid, row.tobytes())
+            sel_list = its_cache.get(ikey)
+            if sel_list is None:
+                t_its, t_cat_idx = self._template_it_index(claim.template)
+                sel = np.flatnonzero(row[t_cat_idx])
+                sel_list = its_cache[ikey] = [t_its[i] for i in sel.tolist()]
+            claim.instance_types = list(sel_list)
             # reservations the scan committed for this claim slot
-            if self._rid_names:
+            if n_rid:
                 claim.reserved_ids = frozenset(
-                    self._rid_names[r]
-                    for r in np.nonzero(held[s][: len(self._rid_names)])[0]
+                    self._rid_names[r] for r in np.nonzero(held[s][:n_rid])[0]
                 )
             finalize_reserved(claim)
             if self.min_values_policy == "BestEffort":

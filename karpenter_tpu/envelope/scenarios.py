@@ -237,6 +237,48 @@ def hostname_spread(n_pods: int = 20) -> dict:
     return {"pods": n_pods, "nodes": len(per_node), "skew": skew}
 
 
+def training_storm(n_gangs: int = 3, gang_size: int = 4, n_singles: int = 10) -> dict:
+    """Training-job storm (ISSUE 6): all-or-nothing gangs mixed with
+    singleton pods in one batch. Every gang lands complete on dedicated
+    slice hosts (a slice never shares a node with singletons), every
+    singleton binds, and no gang is ever observably part-bound."""
+    from karpenter_tpu.gang import gang_of, make_gang_pods, partially_bound_gangs
+    from karpenter_tpu.models.pod import make_pod
+
+    clock, store, cloud, mgr = _harness(catalog_size=64)
+    pods = []
+    for gi in range(n_gangs):
+        pods.extend(make_gang_pods(f"storm-{gi}", gang_size, cpu=1.5))
+    for i in range(n_singles):
+        pods.append(make_pod(f"ts-{i}", cpu=(0.25, 0.5, 1.0)[i % 3]))
+    _provision(mgr, store, cloud, pods)
+    partial = partially_bound_gangs(store.pods())
+    assert not partial, f"partially bound gangs: {partial}"
+    stranded = [p.name for p in store.pods() if not p.spec.node_name]
+    assert not stranded, f"stranded pods: {stranded}"
+    # slice dedication: every node hosting a gang pod hosts ONLY that gang
+    gang_nodes: dict[str, str] = {}
+    for p in store.pods():
+        parsed = gang_of(p)
+        if parsed is not None:
+            key = gang_nodes.setdefault(p.spec.node_name, parsed[0])
+            assert key == parsed[0], (
+                f"two gangs share slice host {p.spec.node_name}"
+            )
+    for p in store.pods():
+        if gang_of(p) is None:
+            assert p.spec.node_name not in gang_nodes, (
+                f"singleton {p.name} shares slice host {p.spec.node_name}"
+            )
+    return {
+        "gangs": n_gangs,
+        "gang_pods": n_gangs * gang_size,
+        "singles": n_singles,
+        "slice_hosts": len(gang_nodes),
+        "nodes": len(store.nodes()),
+    }
+
+
 # -- registry + runner --------------------------------------------------------
 
 # Default envelopes, calibrated on the 8-device CPU-mesh CI harness
@@ -266,6 +308,10 @@ SCENARIOS: dict[str, tuple[Callable[[], dict], Envelope]] = {
     "hostname_spread": (
         hostname_spread,
         Envelope(max_wall_s=60.0, max_rss_mb_p95=600.0, max_cpu_cores=_CORES_CEILING),
+    ),
+    "training_storm": (
+        training_storm,
+        Envelope(max_wall_s=90.0, max_rss_mb_p95=600.0, max_cpu_cores=_CORES_CEILING),
     ),
 }
 

@@ -1,0 +1,256 @@
+"""Gang-aware multi-host slice scheduling: the pod-group layer.
+
+Training jobs arrive as all-or-nothing GANGS: a set of identically-specced
+pods carrying a shared gang id, a total size, and a per-member rank, that
+must land together on one multi-host TPU slice (Rank-Aware Resource
+Scheduling for MPI on Kubernetes, PAPERS.md 2603.22691; VirtualFlow
+2009.09523). This package owns the pod-group annotation contract and the
+host-side orchestration primitives:
+
+  * annotation parsing + validation (``gang_of`` / ``collect_gangs``)
+  * the deterministic gang solve order shared by BOTH engines
+    (``order_gangs``) — gangs place before singleton pods, largest slice
+    first, members in rank order
+  * the straggler wait (``GangWaitTracker``): a partial gang is held out
+    of the solve until every member has arrived or the wait timeout
+    expires (KTPU_GANG_WAIT_SECONDS)
+
+Placement semantics (enforced by both engines, differentially tested in
+tests/test_gang.py):
+
+  * a gang places ONLY on freshly-opened dedicated claims (a multi-host
+    slice is never shared with singleton pods, and gang claims never
+    accept later tier-2 adds);
+  * rank r lands on slice host ``r // pods_per_host`` — contiguous rank
+    blocks per claim, so co-ranked pods sit on adjacent chips via the
+    hostname-slot layout;
+  * the gang either fully places in one dispatch or every member cleanly
+    fails together — no partial placement ever decodes.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+GANG_NAME_ANNOTATION = "ktpu.dev/gang-name"
+GANG_SIZE_ANNOTATION = "ktpu.dev/gang-size"
+GANG_RANK_ANNOTATION = "ktpu.dev/gang-rank"
+# stamped on every NodeClaim of a gang slice so disruption/lifecycle can
+# treat the claim group atomically
+GANG_CLAIM_ANNOTATION = "ktpu.dev/gang"
+
+# how long a partial gang waits for stragglers before the wait times out
+# (the timer restarts: the gang keeps waiting, but the timeout is observed
+# in metrics/events so operators see stuck gangs)
+GANG_WAIT_SECONDS_DEFAULT = 30.0
+
+# unschedulable reasons (explainer slugs map them in tracing/explainer.py)
+GANG_SPILL_REASON = "gang does not fit: no slice shape can hold every member"
+GANG_INVALID_REASON = "invalid gang annotations"
+GANG_WAITING_REASON = "gang waiting for stragglers"
+
+
+def gang_wait_seconds() -> float:
+    try:
+        return float(os.environ.get("KTPU_GANG_WAIT_SECONDS", GANG_WAIT_SECONDS_DEFAULT))
+    except ValueError:
+        return GANG_WAIT_SECONDS_DEFAULT
+
+
+def gang_of(pod) -> Optional[tuple[str, int, int]]:
+    """(gang key, size, rank) parsed from the pod-group annotations, or
+    None for singleton pods. Malformed annotations return None too —
+    ``collect_gangs`` separately surfaces them as invalid."""
+    ann = pod.metadata.annotations
+    name = ann.get(GANG_NAME_ANNOTATION)
+    if not name:
+        return None
+    try:
+        size = int(ann.get(GANG_SIZE_ANNOTATION, ""))
+        rank = int(ann.get(GANG_RANK_ANNOTATION, ""))
+    except (TypeError, ValueError):
+        return None
+    if size <= 0 or rank < 0 or rank >= size:
+        return None
+    return (f"{pod.metadata.namespace}/{name}", size, rank)
+
+
+def is_gang_pod(pod) -> bool:
+    return bool(pod.metadata.annotations.get(GANG_NAME_ANNOTATION))
+
+
+@dataclass
+class GangSpec:
+    """One gang's membership as observed in a pod set."""
+
+    key: str
+    size: int
+    members: dict[int, object] = field(default_factory=dict)  # rank -> Pod
+    first_index: int = 0  # first appearance in the input order (tie-break)
+
+    @property
+    def complete(self) -> bool:
+        return len(self.members) == self.size
+
+    @property
+    def missing(self) -> int:
+        return self.size - len(self.members)
+
+    def pods_in_rank_order(self) -> list:
+        return [self.members[r] for r in sorted(self.members)]
+
+
+def collect_gangs(pods) -> tuple[list[GangSpec], list, list]:
+    """Partition a pod list into (gangs, singletons, invalid).
+
+    ``gangs`` holds one GangSpec per gang key in first-appearance order.
+    ``invalid`` is [(pod, reason)] for pods whose gang annotations cannot
+    be honored: malformed name/size/rank, duplicate ranks, conflicting
+    sizes, or members whose specs are not content-identical (a slice hosts
+    one uniform worker kind; heterogeneous gangs are rejected loudly
+    instead of silently losing the all-or-nothing guarantee).
+    """
+    from karpenter_tpu.controllers.provisioning.host_scheduler import pod_content_sig
+
+    gangs: dict[str, GangSpec] = {}
+    singles: list = []
+    invalid: list = []
+    for i, pod in enumerate(pods):
+        if not is_gang_pod(pod):
+            singles.append(pod)
+            continue
+        parsed = gang_of(pod)
+        if parsed is None:
+            invalid.append((pod, f"{GANG_INVALID_REASON}: bad name/size/rank"))
+            continue
+        key, size, rank = parsed
+        g = gangs.get(key)
+        if g is None:
+            g = gangs[key] = GangSpec(key=key, size=size, first_index=i)
+        if g.size != size:
+            invalid.append((pod, f"{GANG_INVALID_REASON}: conflicting gang-size"))
+            continue
+        if rank in g.members:
+            invalid.append((pod, f"{GANG_INVALID_REASON}: duplicate rank {rank}"))
+            continue
+        g.members[rank] = pod
+    # uniformity: every member must be content-identical (one pod kind)
+    out: list[GangSpec] = []
+    for g in gangs.values():
+        sigs = {pod_content_sig(p) for p in g.members.values()}
+        if len(sigs) > 1:
+            for p in g.pods_in_rank_order():
+                invalid.append((p, f"{GANG_INVALID_REASON}: members not identical"))
+            continue
+        out.append(g)
+    return out, singles, invalid
+
+
+def order_gangs(gangs: list[GangSpec]) -> list[GangSpec]:
+    """The deterministic gang solve order both engines share: largest
+    slice footprint first (member FFD size x gang size — the gang analog
+    of the FFD sort), first-appearance tie-break. Gangs always solve
+    BEFORE singleton pods."""
+    from karpenter_tpu.controllers.provisioning.host_scheduler import pod_ffd_key
+
+    def footprint(g: GangSpec) -> float:
+        any_member = next(iter(g.members.values()))
+        return pod_ffd_key(any_member)[1] * g.size
+
+    return sorted(gangs, key=lambda g: (-footprint(g), g.first_index))
+
+
+class GangWaitTracker:
+    """Straggler wait for partial gangs (clock-injected, fake-clock
+    testable). ``admit`` splits the observed gangs into (ready, waiting,
+    timed_out); a gang that completes observes its wait duration into the
+    gang wait histogram; a wait that exceeds the timeout is reported once
+    per timeout interval (the timer restarts so the metric/event repeats
+    instead of firing forever)."""
+
+    def __init__(self, clock, timeout_s: Optional[float] = None):
+        self.clock = clock
+        self.timeout_s = timeout_s if timeout_s is not None else gang_wait_seconds()
+        self._first_seen: dict[str, float] = {}
+
+    def admit(
+        self, gangs: list[GangSpec]
+    ) -> tuple[list[GangSpec], list[GangSpec], list[GangSpec]]:
+        from karpenter_tpu.utils.metrics import GANG_WAIT_DURATION
+
+        now = self.clock.now()
+        ready: list[GangSpec] = []
+        waiting: list[GangSpec] = []
+        timed_out: list[GangSpec] = []
+        live = set()
+        for g in gangs:
+            live.add(g.key)
+            if g.complete:
+                started = self._first_seen.pop(g.key, None)
+                if started is not None:
+                    GANG_WAIT_DURATION.observe(max(now - started, 0.0))
+                ready.append(g)
+                continue
+            started = self._first_seen.setdefault(g.key, now)
+            if now - started >= self.timeout_s:
+                timed_out.append(g)
+                self._first_seen[g.key] = now  # restart the wait window
+            else:
+                waiting.append(g)
+        # gangs that vanished (scheduled or deleted) release their timers
+        for key in list(self._first_seen):
+            if key not in live:
+                del self._first_seen[key]
+        return ready, waiting, timed_out
+
+
+def partially_bound_gangs(pods) -> dict[str, tuple[int, int]]:
+    """Gangs violating the all-or-nothing bind invariant: gang key ->
+    (bound members, gang size) for every gang with SOME but not all
+    members bound to a node. Empty means every gang is fully bound or
+    fully pending — the e2e/chaos suites assert this at every
+    observable point."""
+    bound: dict[str, int] = {}
+    sizes: dict[str, int] = {}
+    for p in pods:
+        parsed = gang_of(p)
+        if parsed is None:
+            continue
+        key, size, _rank = parsed
+        sizes[key] = size
+        if p.spec.node_name:
+            bound[key] = bound.get(key, 0) + 1
+    return {
+        key: (bound.get(key, 0), size)
+        for key, size in sizes.items()
+        if 0 < bound.get(key, 0) < size
+    }
+
+
+def make_gang_pods(
+    name: str,
+    size: int,
+    cpu: "str | float" = 1.0,
+    memory: "str | float" = "1Gi",
+    namespace: str = "default",
+    **kwargs,
+):
+    """Test/bench factory: one complete gang of `size` rank-annotated,
+    content-identical pods."""
+    from karpenter_tpu.models.pod import make_pod
+
+    pods = []
+    for rank in range(size):
+        p = make_pod(f"{name}-{rank}", cpu=cpu, memory=memory, **kwargs)
+        p.metadata.namespace = namespace
+        p.metadata.annotations.update(
+            {
+                GANG_NAME_ANNOTATION: name,
+                GANG_SIZE_ANNOTATION: str(size),
+                GANG_RANK_ANNOTATION: str(rank),
+            }
+        )
+        pods.append(p)
+    return pods

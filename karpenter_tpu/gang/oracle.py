@@ -1,0 +1,100 @@
+"""Host-side slice-shape math — the gang oracle's capacity kernel.
+
+The exact host twin of the device gang step's closed-form slice shape
+(ops/solver.py solve_gang): per-host capacity ``f`` is the largest pod
+count whose f32 multiply-add total fits some viable (instance type,
+allocatable group) cell with a compatible available offering, and a gang
+of ``size`` members needs ``ceil(size / f)`` hosts. Both engines share
+the one-multiply-add accumulation convention (utils.resources.merge /
+scheduler._merge_scaled), so the capacity predicate — and therefore the
+slice shape — is bit-identical on the differentially-tested path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from karpenter_tpu.models import labels as l
+
+# the fill kernels' "unbounded" cap (ops/solver.py COUNT_CAP)
+COUNT_CAP = 2**22
+
+
+def merge_scaled(base: dict, req: dict, c: int) -> dict:
+    """base + c*req per resource in the f32 one-multiply-add convention
+    (the batch-placement accumulation both engines decode with)."""
+    out = dict(base)
+    cf = np.float32(c)
+    for k, v in req.items():
+        out[k] = float(np.float32(np.float32(out.get(k, 0.0)) + cf * np.float32(v)))
+    return out
+
+
+def slice_capacity(
+    its: list,
+    requirements,
+    daemon: dict,
+    req: dict,
+    host_ports: bool = False,
+) -> int:
+    """Max pods per host: the largest c with ``daemon + c*req`` fitting an
+    allocatable group of some viable instance type that keeps a compatible
+    available offering. Monotone in c, so a doubling + binary search over
+    the shared predicate lands on the same count as the device kernel's
+    corrected float estimate. Host-port-carrying pods self-conflict, so
+    they cap at one per host (the device's self_conf clamp)."""
+    from karpenter_tpu.controllers.provisioning.host_scheduler import (
+        _fits_and_offering,
+    )
+
+    def ok(c: int) -> bool:
+        total = merge_scaled(daemon, req, c)
+        return any(
+            _fits_and_offering(it.allocatable_offerings(), requirements, total)
+            for it in its
+        )
+
+    if not its or not ok(1):
+        return 0
+    if host_ports:
+        return 1
+    lo, hi = 1, 2
+    while hi < COUNT_CAP and ok(hi):
+        lo, hi = hi, hi * 2
+    # invariant: ok(lo), not ok(hi) (or hi hit the cap)
+    if hi >= COUNT_CAP and ok(hi):
+        return COUNT_CAP
+    while hi - lo > 1:
+        mid = (lo + hi) // 2
+        if ok(mid):
+            lo = mid
+        else:
+            hi = mid
+    return lo
+
+
+def hosts_needed(size: int, per_host: int) -> int:
+    return -(-size // per_host) if per_host > 0 else 0
+
+
+def rank_blocks(pods: list, per_host: int) -> list[list]:
+    """Contiguous rank blocks: host j takes ranks [j*f, (j+1)*f) — the
+    deterministic rank -> slice-host mapping both engines emit."""
+    return [pods[i : i + per_host] for i in range(0, len(pods), per_host)]
+
+
+def gang_requirements(template, pod_reqs):
+    """Template ∩ pod requirements (hostname added per host claim)."""
+    combined = template.requirements.copy()
+    combined.add(*pod_reqs.values())
+    return combined
+
+
+def claim_annotation_value(gang_key: str) -> str:
+    return gang_key
+
+
+def hostname_requirement(hostname: str):
+    from karpenter_tpu.scheduling import Operator, Requirement
+
+    return Requirement.new(l.LABEL_HOSTNAME, Operator.IN, hostname)

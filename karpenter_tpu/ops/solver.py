@@ -46,6 +46,10 @@ from karpenter_tpu.ops.topology import PodTopology, TopologyTensors
 # assignment sentinels
 NO_CLAIM = -1  # no compatible existing node, in-flight claim, or template
 NO_ROOM = -2  # a template was feasible but the claim-slot capacity is full
+# a gang blocked by an all-or-nothing constraint the solver cannot relax
+# (e.g. a finite node budget smaller than the slice): every member fails
+# together; the host keeps the gang pending instead of escalating slots
+GANG_SPILL = -3
 BIG = jnp.int32(2**31 - 1)
 
 
@@ -1589,6 +1593,216 @@ def solve_fill(
     dispatches freely (vg-topology kinds per-pod, everything else here)."""
     step = _make_fill_step(
         exist, it, templates, well_known, topo, zone_kid, ct_kid, n_claims
+    )
+    return jax.lax.scan(step, state, xs)
+
+
+# ---------------------------------------------------------------------------
+# Gang-atomic slice placement (all-or-nothing batched device constraint)
+# ---------------------------------------------------------------------------
+#
+# A gang is one scan segment (its pods are one content-identical kind, in
+# rank order). Placement is tier-3 ONLY — a multi-host slice is a group of
+# freshly-opened DEDICATED claims from one weight-ordered template; gangs
+# never land on existing nodes and never share claims with singleton pods.
+# The step computes the slice shape in closed form:
+#
+#   f    = per-host capacity (the fill kernel's per-claim water level)
+#   want = ceil(size / f) hosts
+#
+# and commits atomically: either every member lands (rank r on host
+# r // f — contiguous rank blocks, so co-ranked pods sit on adjacent
+# chips) or NO member does. Committed gang claims bypass the active
+# window entirely and are written straight into the frozen bank: they are
+# full-by-construction and dedicated, so the scan must never rescan them
+# and later tier-2 water-fills must never see them. n_open still advances,
+# so global claim ids, hostname-placeholder order, and the fresh-slot
+# hostname reads of later dispatches stay identical to the host oracle's
+# gangs-first bookkeeping.
+#
+# Spill semantics reuse the existing recovery ladder: a claims-axis-bound
+# refusal is NO_ROOM (solve_round escalates the axis and re-solves); a
+# refusal no escalation can fix (finite node budget below the slice size)
+# is GANG_SPILL — the host reports every member together and keeps the
+# gang pending. The host routes gang solves here only when the fill
+# preconditions hold AND the gang kind has no topology interaction
+# (vg/hg applies+records all false); anything else degrades to the host
+# oracle, which implements the identical semantics exactly.
+
+
+class GangYs(NamedTuple):
+    """Per-gang segment record (scalars; the decode expands rank blocks)."""
+
+    open_g: jnp.ndarray  # [] i32 — first global claim id of the slice
+    n_opened: jnp.ndarray  # [] i32 — hosts opened (0 = gang did not place)
+    fill: jnp.ndarray  # [] i32 — pods per host (last host takes the rest)
+    tmpl: jnp.ndarray  # [] i32 — slice template (-1 = none)
+    leftover: jnp.ndarray  # [] i32 — 0 (placed) or the full gang size
+    status: jnp.ndarray  # [] i32 — NO_CLAIM / NO_ROOM / GANG_SPILL
+
+
+def _make_gang_step(
+    exist: ExistingNodes,
+    it: InstanceTypeTensors,
+    templates: Templates,
+    well_known: jnp.ndarray,
+    topo: TopologyTensors,
+    zone_kid: int,
+    ct_kid: int,
+    n_claims: int,
+    maxg: int,
+):
+    NCAP = n_claims
+    G = templates.its.shape[0]
+    E = exist.avail.shape[0]
+    i32 = jnp.int32
+
+    def step(state: SolverState, xs: FillXs):
+        count = xs.count
+        requests = xs.requests
+        self_conf = kernels.packed_conflict(xs.ports, xs.port_conf)
+
+        # slice template selection — the fill step's tier 3 verbatim
+        pod_g = _broadcast_pod(xs.reqs, G)
+        comb0 = kernels.intersect_sets(templates.reqs, pod_g)
+        tmpl_compat = kernels.compatible_elemwise(templates.reqs, pod_g, well_known)
+        it_compat0 = kernels.intersects(it.reqs, comb0).T  # [G, T]
+        zmask = comb0.mask[:, zone_kid, :]
+        cmask = comb0.mask[:, ct_kid, :]
+        Z = it.zc_avail.shape[2]
+        C = it.zc_avail.shape[3]
+        off_g = (
+            jnp.einsum(
+                "tgzc,nz,nc->ntg",
+                it.zc_avail.astype(jnp.bfloat16),
+                zmask[:, :Z].astype(jnp.bfloat16),
+                cmask[:, :C].astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            )
+            > 0
+        )
+        fits_off0 = jnp.any(
+            _fits_off_counted(
+                templates.daemon_requests,
+                jnp.ones(off_g.shape, dtype=jnp.int32),
+                requests,
+                it,
+                off_g,
+            ),
+            axis=-1,
+        )
+        cap_ok = jnp.all(it.cap[None, :, :] <= state.budget[:, None, :], axis=-1)
+        its0 = templates.its & it_compat0 & fits_off0 & xs.it_allow[None, :] & cap_ok
+        cap_topo_fresh = _hg_slot_caps(
+            topo,
+            state.hg_counts,
+            jnp.broadcast_to(E + state.n_open, (1,)).astype(i32),
+            xs.hg_applies,
+            xs.hg_records,
+            xs.hg_self,
+        )[0]
+        tmpl_feas = (
+            templates.valid
+            & tmpl_compat
+            & xs.tmpl_ok
+            & jnp.any(its0, axis=-1)
+            & (state.nodes_budget >= 1.0)
+        )
+        g = jnp.argmax(tmpl_feas)
+        any_t = jnp.any(tmpl_feas) & (count > 0) & (cap_topo_fresh > 0)
+
+        # slice shape: per-host capacity f, hosts want = ceil(size / f)
+        f0 = _claim_fill_caps(templates.daemon_requests, its0, requests, it, off_g)[g]
+        f = jnp.minimum(f0, cap_topo_fresh)
+        f = jnp.where(self_conf, jnp.minimum(f, 1), f)
+        f = jnp.where(any_t, jnp.maximum(f, 0), 0)
+        want = jnp.where(f > 0, (count + f - 1) // jnp.maximum(f, 1), 0)
+        avail_cap = jnp.maximum(NCAP - state.n_open, 0)
+        budget_ok = state.nodes_budget[g] >= want.astype(jnp.float32)
+        shaped = any_t & (f > 0)
+        can = shaped & (want <= avail_cap) & budget_ok
+        # NO_ROOM = axis-bound (the host escalates n_claims and re-solves);
+        # GANG_SPILL = a constraint no escalation fixes (node budget)
+        status = jnp.where(
+            shaped & ~budget_ok,
+            i32(GANG_SPILL),
+            jnp.where(shaped, i32(NO_ROOM), i32(NO_CLAIM)),
+        )
+
+        # atomic commit: rank block j -> global claim id n_open + j,
+        # written STRAIGHT into the frozen bank (dedicated + full)
+        j = jnp.arange(maxg, dtype=i32)
+        active = can & (j < want)
+        gid = jnp.where(active, state.n_open + j, i32(NCAP))
+        c_j = jnp.clip(count - j * f, 0, f)  # [MAXG] pods on host j
+        used_j = (
+            templates.daemon_requests[g][None, :]
+            + c_j[:, None].astype(jnp.float32) * requests[None, :]
+        )
+        off_j = jnp.broadcast_to(off_g[g][None], (maxg,) + off_g.shape[1:])
+        fits_j = jnp.any(
+            _fits_off_counted(
+                jnp.broadcast_to(
+                    templates.daemon_requests[g][None, :], (maxg, requests.shape[0])
+                ),
+                jnp.broadcast_to(c_j[:, None, None], off_j.shape),
+                requests,
+                it,
+                off_j,
+            ),
+            axis=-1,
+        )  # [MAXG, T]
+        its_j = its0[g][None, :] & fits_j
+
+        opened = jnp.where(can, want, 0)
+        max_cap = jnp.max(jnp.where(its0[g][:, None], it.cap, -jnp.inf), axis=0)
+        max_cap = jnp.where(jnp.isfinite(max_cap), max_cap, 0.0)
+        wf = opened.astype(jnp.float32)
+        new_state = state._replace(
+            bank_frozen=state.bank_frozen.at[gid].set(True, mode="drop"),
+            bank_template=state.bank_template.at[gid].set(g.astype(i32), mode="drop"),
+            bank_its=state.bank_its.at[gid].set(its_j, mode="drop"),
+            bank_used=state.bank_used.at[gid].set(used_j, mode="drop"),
+            n_open=state.n_open + opened,
+            budget=state.budget.at[g].add(-max_cap * wf),
+            nodes_budget=state.nodes_budget.at[g].add(-wf),
+        )
+        ys = GangYs(
+            open_g=state.n_open,
+            n_opened=opened,
+            fill=f,
+            tmpl=jnp.where(can, g.astype(i32), i32(-1)),
+            leftover=jnp.where(can, 0, count).astype(i32),
+            status=status,
+        )
+        return new_state, ys
+
+    return step
+
+
+_GANG_STATIC = ("zone_kid", "ct_kid", "n_claims", "maxg")
+
+
+@functools.partial(jax.jit, static_argnames=_GANG_STATIC)
+def solve_gang(
+    state: SolverState,
+    xs: FillXs,
+    exist: ExistingNodes,
+    it: InstanceTypeTensors,
+    templates: Templates,
+    well_known: jnp.ndarray,
+    topo: TopologyTensors,
+    zone_kid: int,
+    ct_kid: int,
+    n_claims: int,
+    maxg: int,
+) -> tuple[SolverState, GangYs]:
+    """Scan gang-atomic slice placement over B gang segments (one segment
+    per gang, pods in rank order), threading the same SolverState as the
+    other dispatch kernels. `maxg` statically bounds hosts-per-slice
+    (a gang of N pods never needs more than N hosts)."""
+    step = _make_gang_step(
+        exist, it, templates, well_known, topo, zone_kid, ct_kid, n_claims, maxg
     )
     return jax.lax.scan(step, state, xs)
 

@@ -30,6 +30,9 @@ _SLUGS = (
     ("scheduling timeout exceeded", "solve_timeout"),
     ("claim-slot capacity", "no_room"),
     ("no compatible in-flight claim or template", "incompatible"),
+    ("gang does not fit", "gang_spill"),
+    ("gang waiting", "gang_waiting"),
+    ("invalid gang", "gang_invalid"),
     ("resourceclaim", "dra"),
     ("resource claim", "dra"),
 )

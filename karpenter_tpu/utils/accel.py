@@ -87,6 +87,19 @@ def enable_persistent_compile_cache(path: "str | None" = None) -> str:
         # handful of executables and the reads are cheap
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        # The cache module LATCHES its enabled/initialized decision on the
+        # first compile. Any jit dispatch before this point (jnp.asarray in
+        # an encode helper is enough) initializes it with NO cache dir, and
+        # every later config update is silently ignored — the historical
+        # "zero entries persisted on CPU" tier-1 skip was exactly this
+        # ordering hazard, not a platform limitation. Resetting after the
+        # config updates re-initializes against the configured dir.
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:  # pragma: no cover — private-API drift
+            pass
         _cache_enabled = True
     return path
 

@@ -412,3 +412,24 @@ ENCODE_CACHE_HITS = REGISTRY.counter(
     "Pod-kind encode rows served from the incremental encode cache"
     " instead of re-encoding (KTPU_ENCODE_CACHE)",
 )
+# ---- gang-aware multi-host slice scheduling (gang/, PR 6) ----
+GANG_PLACEMENTS = REGISTRY.counter(
+    "ktpu_gang_placements_total",
+    "Gang scheduling outcomes per solve: placed (every member bound to one"
+    " slice-shaped claim group), spilled (all-or-nothing refusal — every"
+    " member failed together), timeout (straggler wait expired), invalid"
+    " (malformed gang annotations), partial (invariant violation tripwire;"
+    " must stay zero)",
+    ("outcome",),
+)
+GANG_SPILLS = REGISTRY.counter(
+    "ktpu_gang_spills_total",
+    "Gangs that failed placement atomically (no slice shape could hold"
+    " every member); the gang stays pending and retries",
+)
+GANG_WAIT_DURATION = REGISTRY.histogram(
+    "ktpu_gang_wait_duration_seconds",
+    "How long a partial gang waited for stragglers before every member"
+    " arrived (observed when the gang completes; KTPU_GANG_WAIT_SECONDS"
+    " bounds the wait between timeout reports)",
+)

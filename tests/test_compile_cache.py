@@ -53,10 +53,20 @@ warm = sched.solve(pods)
 assert not warm.unschedulable
 assert len(warm.claims) == len(result.claims)
 scan = sched.last_timings.get("scan") or {}
+# a gang solve exercises the gang-atomic kernel's encode columns and
+# slice-shape tables (ISSUE-6): its executables must land in the cache
+# with deterministic keys too, so BOTH children run one
+from karpenter_tpu.gang import make_gang_pods
+gang_pods = make_gang_pods("cc-gang", 4, cpu=1.5) + pods[:8]
+gres = sched.solve(gang_pods)
+assert not gres.unschedulable
+gang_claims = sum(1 for c in gres.claims if c.gang)
+assert gang_claims >= 1, "the gang solve never opened a slice claim"
 print(json.dumps({
     "cold_s": cold_s,
     "cache_hits": hits[0],
     "claims": len(result.claims),
+    "gang_claims": gang_claims,
     "window": scan.get("window"),
 }))
 """
@@ -119,6 +129,7 @@ def test_restart_skips_cold_compile(tmp_path):
     second = _run_child(cache_dir)
     after = _cache_entries(cache_dir)
     assert second["claims"] == first["claims"]
+    assert second["gang_claims"] == first["gang_claims"]
     assert second["window"] == first["window"], (
         "the pinned scan window must size identically across restarts "
         f"({first['window']} vs {second['window']})"

@@ -1,0 +1,572 @@
+"""Gang-aware multi-host slice scheduling (ISSUE 6).
+
+The contract under test:
+
+- DIFFERENTIAL: gang solves — mixed with fill / kind-scan / per-pod
+  singleton dispatches, chunked at K in {1, 2, 4}, windowed and
+  un-windowed — are BIT-identical to the host gang oracle
+  (HostScheduler._place_gang), and the non-gang path stays bit-identical
+  to its own oracle (the pre-PR contract, untouched);
+- ALL-OR-NOTHING: a gang either fully places on one slice-shaped claim
+  group in a dispatch or every member cleanly fails together with one
+  reason — no partial placement ever decodes, no singleton ever lands on
+  a slice host, and ranks map contiguously onto slice hosts;
+- ORCHESTRATION: partial gangs wait for stragglers (clock-injected
+  timeout), invalid gangs surface loudly, and the bind gate holds a gang
+  out of the cluster until every member can bind;
+- DISRUPTION: a slice's claim group is atomic — candidates are computed
+  per gang, budgets/methods select whole units, and no command ever
+  evicts a strict subset of a gang's hosts.
+
+Everything here is host-only (CPU mesh) and sized for tier-1.
+"""
+
+import numpy as np
+import pytest
+
+import bench
+from karpenter_tpu.controllers.provisioning.host_scheduler import HostScheduler
+from karpenter_tpu.gang import (
+    GANG_CLAIM_ANNOTATION,
+    GANG_INVALID_REASON,
+    GANG_NAME_ANNOTATION,
+    GANG_RANK_ANNOTATION,
+    GANG_SIZE_ANNOTATION,
+    GANG_WAITING_REASON,
+    GangWaitTracker,
+    collect_gangs,
+    gang_of,
+    make_gang_pods,
+    order_gangs,
+    partially_bound_gangs,
+)
+from karpenter_tpu.models.pod import make_pod
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.clock import FakeClock
+
+from test_solver import assert_same_packing
+from test_window import make_templates, windowed_scheduler
+
+
+# -- differential helpers -----------------------------------------------------
+
+
+def host_oracle(pods, n_types=16, budgets=None):
+    """The host gang oracle on the identical problem (the same topology
+    construction bench.host_solve/_encode use)."""
+    from karpenter_tpu.controllers.provisioning.topology import (
+        Topology,
+        build_universe_domains,
+    )
+
+    templates = make_templates(n_types)
+    topo = Topology.build(list(pods), build_universe_domains(templates, []), [])
+    return HostScheduler(templates, budgets=budgets, topology=topo).solve(list(pods))
+
+
+def assert_gang_shape(result, key, size):
+    """Slice-structure invariants on one engine's result: the gang's
+    claims are dedicated (no foreign pods), hold contiguous rank blocks in
+    slot order, and cover every rank exactly once."""
+    gang_claims = sorted(
+        (c for c in result.claims if getattr(c, "gang", None) == key),
+        key=lambda c: c.slot,
+    )
+    ranks = []
+    for c in gang_claims:
+        claim_ranks = []
+        for p in c.pods:
+            parsed = gang_of(p)
+            assert parsed is not None and parsed[0] == key, (
+                f"foreign pod {p.metadata.name} on slice host {c.hostname}"
+            )
+            claim_ranks.append(parsed[2])
+        assert claim_ranks == sorted(claim_ranks)
+        ranks.extend(claim_ranks)
+    assert ranks == list(range(size)), (
+        f"ranks not contiguous across slice hosts: {ranks}"
+    )
+    # no gang pod may sit on a non-gang claim
+    for c in result.claims:
+        if getattr(c, "gang", None) != key:
+            assert not any(
+                (g := gang_of(p)) is not None and g[0] == key for p in c.pods
+            )
+    return gang_claims
+
+
+def run_gang_parity(monkeypatch, pods, n_types=16, max_claims=128, window=0,
+                    ks=(1, 2, 4), budgets=None, gangs=()):
+    """Solve at each chunking K (optionally windowed); pin every run
+    against the unchunked un-windowed device solve AND the host gang
+    oracle, then check slice-structure invariants on both engines."""
+    href = host_oracle(pods, n_types, budgets=budgets)
+    base_sched = windowed_scheduler(monkeypatch, 0, 0, n_types, max_claims)
+    base = base_sched.solve(pods, budgets=budgets)
+    assert_same_packing(href, base)
+    for key, size in gangs:
+        assert_gang_shape(href, key, size)
+        assert_gang_shape(base, key, size)
+    for k in ks:
+        sched = windowed_scheduler(monkeypatch, window, k, n_types, max_claims)
+        result = sched.solve(pods, budgets=budgets)
+        assert_same_packing(base, result)
+        assert_same_packing(href, result)
+        for key, size in gangs:
+            assert_gang_shape(result, key, size)
+    return href, base
+
+
+# -- differential parity ------------------------------------------------------
+
+
+class TestGangParity:
+    def test_gang_with_fill_singles(self, monkeypatch):
+        """One gang + selector singletons: the gang rides the gang-atomic
+        kernel, singletons the kind-level fill scan, across K chunks."""
+        pods = make_gang_pods("train-a", 8, cpu=1.5) + bench.selector_pods(24)
+        run_gang_parity(
+            monkeypatch, pods, gangs=[("default/train-a", 8)]
+        )
+
+    def test_multiple_gangs_largest_first(self, monkeypatch):
+        """Three gangs of different slice footprints + singles: both
+        engines share the largest-slice-first gang order, so packing is
+        identical and each slice stays dedicated."""
+        pods = (
+            make_gang_pods("small", 2, cpu=0.5)
+            + [make_pod(f"s-{i}", cpu=0.5) for i in range(12)]
+            + make_gang_pods("big", 6, cpu=1.5)
+            + make_gang_pods("mid", 4, cpu=1.0)
+        )
+        run_gang_parity(
+            monkeypatch,
+            pods,
+            gangs=[
+                ("default/small", 2),
+                ("default/big", 6),
+                ("default/mid", 4),
+            ],
+        )
+
+    def test_gang_with_kscan_topology_singles(self, monkeypatch):
+        """Singletons carrying zonal TSC / affinity topology ride the
+        kind-scan and per-pod dispatches while the (topology-free) gang
+        rides the gang kernel — mixed dispatch modes in one solve."""
+        pods = make_gang_pods("train-k", 6, cpu=1.2) + bench.mixed_pods(30)
+        run_gang_parity(
+            monkeypatch, pods, n_types=24, gangs=[("default/train-k", 6)]
+        )
+
+    def test_gang_windowed_small_window(self, monkeypatch):
+        """An active window far smaller than the slice: the gang's
+        window-bound refusal reuses the NO_ROOM spill-and-retry path
+        (solve_round grows the axis and re-solves) and still lands the
+        oracle packing."""
+        pods = make_gang_pods("train-w", 8, cpu=1.5) + [
+            make_pod(f"w-{i}", cpu=0.5) for i in range(8)
+        ]
+        run_gang_parity(
+            monkeypatch, pods, window=4, gangs=[("default/train-w", 8)]
+        )
+
+    def test_gang_under_budgets_routes_to_host_oracle(self, monkeypatch):
+        """Finite pool budgets are outside the device gang kernel's
+        constraint family: the solve degrades to the host oracle
+        (identical semantics) and records the fallback."""
+        budgets = {"default": {"cpu": 100000.0}}
+        before = metrics.SOLVER_FALLBACK.get(reason="gang_constraints")
+        pods = make_gang_pods("train-b", 4, cpu=1.0) + [
+            make_pod(f"b-{i}", cpu=0.5) for i in range(8)
+        ]
+        href = host_oracle(pods, 16, budgets=budgets)
+        sched = windowed_scheduler(monkeypatch, 0, 0, 16, 128)
+        result = sched.solve(pods, budgets=budgets)
+        assert_same_packing(href, result)
+        assert_gang_shape(result, "default/train-b", 4)
+        assert metrics.SOLVER_FALLBACK.get(reason="gang_constraints") > before
+
+    def test_gang_with_topology_routes_to_host_oracle(self, monkeypatch):
+        """A gang kind carrying topology interaction (zonal TSC on the
+        members) degrades to the host oracle too."""
+        from karpenter_tpu.models import labels as l
+        from karpenter_tpu.models.pod import TopologySpreadConstraint
+
+        before = metrics.SOLVER_FALLBACK.get(reason="gang_constraints")
+        pods = make_gang_pods("train-t", 4, cpu=1.0)
+        for p in pods:
+            p.metadata.labels = {"spread": "zonal"}
+            p.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=l.LABEL_TOPOLOGY_ZONE,
+                    label_selector={"spread": "zonal"},
+                )
+            ]
+        href = host_oracle(pods, 16)
+        sched = windowed_scheduler(monkeypatch, 0, 0, 16, 128)
+        result = sched.solve(pods)
+        assert_same_packing(href, result)
+        assert metrics.SOLVER_FALLBACK.get(reason="gang_constraints") > before
+
+    def test_non_gang_solves_untouched(self, monkeypatch):
+        """The non-gang path must not shift by a single pod: the standard
+        mixed workload still matches its oracle (and the gang partition
+        code never runs — no gang annotations present)."""
+        run_gang_parity(monkeypatch, bench.mixed_pods(48), n_types=24)
+
+
+# -- all-or-nothing semantics -------------------------------------------------
+
+
+class TestAllOrNothing:
+    def test_unplaceable_gang_fails_together(self, monkeypatch):
+        """A gang no instance type can host: every member fails with ONE
+        reason, and the singletons in the same solve still place."""
+        pods = make_gang_pods("huge", 4, cpu=10000.0) + [
+            make_pod(f"ok-{i}", cpu=0.5) for i in range(6)
+        ]
+        href, base = run_gang_parity(monkeypatch, pods)
+        unsched = {p.metadata.name for p, _ in base.unschedulable}
+        assert unsched == {f"huge-{r}" for r in range(4)}
+        reasons = {r for _, r in base.unschedulable}
+        assert len(reasons) == 1, f"split reasons across one gang: {reasons}"
+        assert len(base.claims) >= 1  # singles placed
+
+    def test_incomplete_gang_held_out(self, monkeypatch):
+        """Missing ranks keep the WHOLE gang out of the solve (waiting
+        reason), identically on both engines."""
+        pods = make_gang_pods("partial", 4, cpu=1.0)[:2] + [
+            make_pod(f"ok-{i}", cpu=0.5) for i in range(4)
+        ]
+        href, base = run_gang_parity(monkeypatch, pods)
+        waiting = {
+            p.metadata.name for p, r in base.unschedulable if r == GANG_WAITING_REASON
+        }
+        assert waiting == {"partial-0", "partial-1"}
+
+    def test_invalid_gangs_surface_loudly(self, monkeypatch):
+        """Duplicate ranks, conflicting sizes, heterogeneous members:
+        rejected with invalid reasons, never silently solved."""
+        dup = make_gang_pods("dup", 2, cpu=0.5)
+        dup[1].metadata.annotations[GANG_RANK_ANNOTATION] = "0"
+        hetero = make_gang_pods("hetero", 2, cpu=0.5)
+        hetero[1].spec.requests["cpu"] = 1.5
+        pods = dup + hetero + [make_pod("ok-0", cpu=0.5)]
+        href, base = run_gang_parity(monkeypatch, pods)
+        invalid = {
+            p.metadata.name
+            for p, r in base.unschedulable
+            if r.startswith(GANG_INVALID_REASON)
+        }
+        assert "dup-1" in invalid
+        assert {"hetero-0", "hetero-1"} <= invalid
+
+    def test_no_singleton_backfills_slice_headroom(self, monkeypatch):
+        """A slice host with spare room (last rank block not full) must
+        NOT accept singleton pods — gang claims are dedicated on both
+        engines (host tier-2 skips them; the device freezes them)."""
+        # gang of 3 at 0.5 cpu: per-host fill > 1, so the last slice host
+        # has headroom a greedy tier-2 would love to fill
+        pods = make_gang_pods("lone", 3, cpu=0.5) + [
+            make_pod(f"bf-{i}", cpu=0.5) for i in range(6)
+        ]
+        href, base = run_gang_parity(
+            monkeypatch, pods, gangs=[("default/lone", 3)]
+        )
+        for result in (href, base):
+            for c in result.claims:
+                if getattr(c, "gang", None):
+                    assert all(
+                        gang_of(p) is not None for p in c.pods
+                    ), "singleton backfilled a slice host"
+
+
+# -- annotations, ordering, straggler wait ------------------------------------
+
+
+class TestGangCollect:
+    def test_parse_and_validate(self):
+        p = make_gang_pods("g", 2)[1]
+        assert gang_of(p) == ("default/g", 2, 1)
+        p.metadata.annotations[GANG_RANK_ANNOTATION] = "2"  # rank >= size
+        assert gang_of(p) is None
+        p.metadata.annotations[GANG_RANK_ANNOTATION] = "x"
+        assert gang_of(p) is None
+        p.metadata.annotations.pop(GANG_NAME_ANNOTATION)
+        assert gang_of(p) is None
+        q = make_gang_pods("q", 2)[0]
+        q.metadata.annotations[GANG_SIZE_ANNOTATION] = "0"
+        assert gang_of(q) is None
+
+    def test_collect_partitions_and_rejects(self):
+        good = make_gang_pods("good", 2)
+        clash = make_gang_pods("clash", 2)
+        clash[1].metadata.annotations[GANG_SIZE_ANNOTATION] = "3"
+        singles = [make_pod("s-0"), make_pod("s-1")]
+        gangs, out_singles, invalid = collect_gangs(good + clash + singles)
+        assert {g.key for g in gangs} == {"default/good", "default/clash"}
+        assert [p.metadata.name for p in out_singles] == ["s-0", "s-1"]
+        assert [p.metadata.name for p, _ in invalid] == ["clash-1"]
+        good_spec = next(g for g in gangs if g.key == "default/good")
+        assert good_spec.complete and good_spec.missing == 0
+
+    def test_order_largest_slice_first(self):
+        small = make_gang_pods("small", 2, cpu=0.5)
+        big = make_gang_pods("big", 4, cpu=2.0)
+        gangs, _, _ = collect_gangs(small + big)
+        ordered = order_gangs(gangs)
+        assert [g.key for g in ordered] == ["default/big", "default/small"]
+
+    def test_wait_tracker_timeout_and_completion(self):
+        clock = FakeClock()
+        tracker = GangWaitTracker(clock, timeout_s=30.0)
+        partial_pods = make_gang_pods("w", 3)[:2]
+        gangs, _, _ = collect_gangs(partial_pods)
+        ready, waiting, timed_out = tracker.admit(gangs)
+        assert not ready and not timed_out and len(waiting) == 1
+        clock.step(31.0)
+        gangs, _, _ = collect_gangs(partial_pods)
+        ready, waiting, timed_out = tracker.admit(gangs)
+        assert len(timed_out) == 1  # reported once, then the window restarts
+        gangs, _, _ = collect_gangs(partial_pods)
+        ready, waiting, timed_out = tracker.admit(gangs)
+        assert len(waiting) == 1 and not timed_out
+        # completion observes the wait histogram and releases the timer
+        h0 = metrics.GANG_WAIT_DURATION.totals.get((), 0)
+        clock.step(5.0)
+        gangs, _, _ = collect_gangs(make_gang_pods("w", 3))
+        ready, waiting, timed_out = tracker.admit(gangs)
+        assert len(ready) == 1
+        assert metrics.GANG_WAIT_DURATION.totals.get((), 0) == h0 + 1
+        assert not tracker._first_seen
+
+
+# -- disruption atomicity -----------------------------------------------------
+
+
+def _gang_env(n_gangs=2, gang_size=3, n_singles=2, consolidate_after=0.0,
+              cpu=1.5):
+    """kwok harness with bound gangs + singles; returns the usual stack."""
+    from karpenter_tpu.envelope.scenarios import _harness, _provision
+
+    clock, store, cloud, mgr = _harness(
+        catalog_size=64, consolidate_after=consolidate_after
+    )
+    pods = []
+    for gi in range(n_gangs):
+        pods.extend(make_gang_pods(f"dg-{gi}", gang_size, cpu=cpu))
+    pods.extend(make_pod(f"dgs-{i}", cpu=0.5) for i in range(n_singles))
+    _provision(mgr, store, cloud, pods)
+    assert not partially_bound_gangs(store.pods())
+    assert all(p.spec.node_name for p in store.pods())
+    return clock, store, cloud, mgr
+
+
+def _gang_claim_names(store, key):
+    return {
+        c.name
+        for c in store.nodeclaims()
+        if c.metadata.annotations.get(GANG_CLAIM_ANNOTATION) == key
+    }
+
+
+class TestGangDisruption:
+    def test_claims_annotated_and_candidates_grouped(self):
+        from karpenter_tpu.controllers.disruption.candidates import (
+            atomic_units,
+            build_candidates,
+            gang_key_of_node,
+        )
+
+        clock, store, cloud, mgr = _gang_env()
+        assert len(_gang_claim_names(store, "default/dg-0")) >= 1
+        # every slice host's StateNode resolves its gang key
+        keyed = [
+            gang_key_of_node(sn)
+            for sn in mgr.cluster.nodes()
+            if gang_key_of_node(sn)
+        ]
+        assert len(keyed) == len(_gang_claim_names(store, "default/dg-0")) + len(
+            _gang_claim_names(store, "default/dg-1")
+        )
+        # candidate units: one per gang (complete), singletons alone
+        from karpenter_tpu.cloudprovider.errors import instance_types_or_none
+        from karpenter_tpu.state.store import ObjectStore
+
+        pools = {p.name: p for p in store.nodepools()}
+        its = {
+            it.name: it
+            for p in pools.values()
+            for it in instance_types_or_none(cloud, p) or ()
+        }
+        cands = build_candidates(mgr.cluster, pools, its, clock)
+        units = atomic_units(cands)
+        by_key = {}
+        for u in units:
+            if u[0].gang_key:
+                by_key[u[0].gang_key] = len(u)
+        hosts_per_gang = len(_gang_claim_names(store, "default/dg-0"))
+        assert by_key.get("default/dg-0") == hosts_per_gang
+        assert by_key.get("default/dg-1") == hosts_per_gang
+
+    def test_blocked_host_withdraws_whole_gang(self):
+        from karpenter_tpu.cloudprovider.errors import instance_types_or_none
+        from karpenter_tpu.controllers.disruption.candidates import build_candidates
+        from karpenter_tpu.models import labels as l
+        from karpenter_tpu.state.store import ObjectStore
+
+        clock, store, cloud, mgr = _gang_env(n_gangs=1, gang_size=4, n_singles=1)
+        # block ONE slice host via do-not-disrupt on a pod
+        victim = next(
+            p for p in store.pods() if gang_of(p) is not None and p.spec.node_name
+        )
+        victim.metadata.annotations[l.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        store.update(ObjectStore.PODS, victim)
+        pools = {p.name: p for p in store.nodepools()}
+        its = {
+            it.name: it
+            for p in pools.values()
+            for it in instance_types_or_none(cloud, p) or ()
+        }
+        cands = build_candidates(mgr.cluster, pools, its, clock)
+        assert not any(c.gang_key for c in cands), (
+            "one blocked slice host must withdraw every host of the gang"
+        )
+
+    def test_budget_never_splits_a_gang(self):
+        from karpenter_tpu.controllers.disruption.methods import _within_budget
+
+        clock, store, cloud, mgr = _gang_env(n_gangs=1, gang_size=3, n_singles=0, cpu=24.0)
+        from karpenter_tpu.cloudprovider.errors import instance_types_or_none
+        from karpenter_tpu.controllers.disruption.candidates import build_candidates
+
+        pools = {p.name: p for p in store.nodepools()}
+        its = {
+            it.name: it
+            for p in pools.values()
+            for it in instance_types_or_none(cloud, p) or ()
+        }
+        cands = build_candidates(mgr.cluster, pools, its, clock)
+        gang_cands = [c for c in cands if c.gang_key]
+        n_hosts = len(gang_cands)
+        if n_hosts < 2:
+            pytest.skip("slice fit on one host in this catalog")
+        # a budget smaller than the slice takes NONE of its hosts
+        chosen = _within_budget(gang_cands, {"default": n_hosts - 1})
+        assert chosen == []
+        chosen = _within_budget(gang_cands, {"default": n_hosts})
+        assert len(chosen) == n_hosts
+
+    def test_emptiness_evicts_finished_slice_atomically(self):
+        from karpenter_tpu.state.store import ObjectStore
+
+        from test_disruption import delete_pods, disrupt_through_validation
+
+        clock, store, cloud, mgr = _gang_env(n_gangs=1, gang_size=4, n_singles=1)
+        slice_nodes = {
+            p.spec.node_name for p in store.pods() if gang_of(p) is not None
+        }
+        single_node = next(
+            p.spec.node_name for p in store.pods() if gang_of(p) is None
+        )
+        # the training job finishes: every gang pod completes
+        delete_pods(store, mgr, lambda p: gang_of(p) is not None)
+        clock.step(60.0)
+        cmd = disrupt_through_validation(mgr, clock)
+        assert cmd is not None and cmd.reason == "Empty"
+        gang_cands = [c for c in cmd.candidates if c.gang_key == "default/dg-0"]
+        assert len(gang_cands) == len(slice_nodes), (
+            "emptiness must take the whole slice, never a subset"
+        )
+        # settle the deletions: every slice host leaves TOGETHER, the
+        # singleton's (non-empty) host survives
+        for _ in range(4):
+            mgr.run_until_idle()
+            clock.step(16.0)
+            mgr.run_disruption_once()
+        node_names = {n.name for n in store.nodes()}
+        assert not (slice_nodes & node_names), "slice hosts lingered"
+        assert single_node in node_names
+
+    def test_partial_gang_violation_tripwire(self):
+        from karpenter_tpu.cloudprovider.errors import instance_types_or_none
+        from karpenter_tpu.controllers.disruption.candidates import (
+            build_candidates,
+            partial_gang_violation,
+        )
+
+        clock, store, cloud, mgr = _gang_env(n_gangs=1, gang_size=4, n_singles=0, cpu=24.0)
+        pools = {p.name: p for p in store.nodepools()}
+        its = {
+            it.name: it
+            for p in pools.values()
+            for it in instance_types_or_none(cloud, p) or ()
+        }
+        cands = build_candidates(mgr.cluster, pools, its, clock)
+        gang_cands = [c for c in cands if c.gang_key]
+        if len(gang_cands) < 2:
+            pytest.skip("slice fit on one host in this catalog")
+        assert partial_gang_violation(gang_cands, mgr.cluster) is None
+        assert (
+            partial_gang_violation(gang_cands[:-1], mgr.cluster)
+            == "default/dg-0"
+        )
+
+
+# -- e2e: storm + chaos -------------------------------------------------------
+
+
+class TestTrainingStorm:
+    def test_training_storm_scenario_under_envelope(self):
+        from karpenter_tpu.envelope.scenarios import run_scenario
+
+        result = run_scenario("training_storm")
+        assert result.detail["gangs"] == 3
+        assert result.detail["slice_hosts"] >= result.detail["gangs"]
+
+    def test_ice_storm_mid_gang_never_partial(self):
+        """Chaos variant: an ICE storm hits claim launches while gangs are
+        in flight. At EVERY observable point, each gang is fully bound or
+        fully pending; the storm bends the path, never the invariant, and
+        everything converges once the storm passes."""
+        from karpenter_tpu.controllers.manager import KubeSchedulerSim
+        from karpenter_tpu.envelope.scenarios import _harness
+        from karpenter_tpu.faultinject import FAULT, active_plan
+        from karpenter_tpu.state.store import ObjectStore
+
+        clock, store, cloud, mgr = _harness(catalog_size=64)
+        pods = (
+            make_gang_pods("ice-a", 4, cpu=1.5)
+            + make_gang_pods("ice-b", 3, cpu=1.0)
+            + [make_pod(f"ice-s-{i}", cpu=0.5) for i in range(6)]
+        )
+        plan = {
+            "seed": 13,
+            "rules": [
+                {"point": "cloud.create", "error": "ice", "p": 0.5, "times": 6}
+            ],
+        }
+        with active_plan(plan):
+            for p in pods:
+                store.create(ObjectStore.PODS, p)
+            for _ in range(24):
+                mgr.run_until_idle()
+                cloud.simulate_kubelet_ready()
+                mgr.run_until_idle()
+                KubeSchedulerSim(store, mgr.cluster).bind_pending()
+                partial = partially_bound_gangs(store.pods())
+                assert not partial, f"partial gang bound mid-storm: {partial}"
+                if all(p.spec.node_name for p in store.pods()):
+                    break
+                mgr.batcher.trigger()
+                clock.step(5.0)
+            injected = FAULT.fires("cloud.create")
+        assert injected >= 1, "the ICE storm never fired"
+        stranded = [p.name for p in store.pods() if not p.spec.node_name]
+        assert not stranded, f"stranded after the storm: {stranded}"
+        assert not partially_bound_gangs(store.pods())
+        # outcome accounting saw the gangs land
+        assert metrics.GANG_PLACEMENTS.get(outcome="placed") >= 1
+        # and the partial-placement tripwire never fired, ever
+        assert metrics.GANG_PLACEMENTS.get(outcome="partial") == 0

@@ -81,6 +81,10 @@ class _FakeCandidate:
     state_node: _FakeStateNode = field(default_factory=_FakeStateNode)
     reschedulable_pods: list = field(default_factory=list)
     instance_type: object = None
+    # ordinary node (not a slice host): methods group candidates into
+    # atomic units by this key
+    gang_key: object = None
+    disruption_cost: float = 1.0
 
 
 def _ok_result():
