@@ -18,4 +18,7 @@ from karpenter_tpu.controllers.provisioning.host_scheduler import (  # noqa: F40
     SchedulingResult,
     SimClaim,
 )
-from karpenter_tpu.controllers.provisioning.scheduler import TPUScheduler  # noqa: F401
+from karpenter_tpu.controllers.provisioning.scheduler import (  # noqa: F401
+    ResidentSession,
+    TPUScheduler,
+)

@@ -722,6 +722,16 @@ class Provisioner:
                 min_values_policy=self.min_values_policy,
                 mesh=mesh,
             )
+            from karpenter_tpu.controllers.provisioning.scheduler import (
+                resident_enabled,
+            )
+
+            if resident_enabled():
+                # service mode (ISSUE 7): SolverState stays resident across
+                # reconcile rounds; steady-state deltas skip the snapshot
+                # re-encode/re-solve. Every unsupported shape falls back to
+                # a bit-identical full solve inside the session.
+                sched = sched.resident_session()
         # close the REPLACED RemoteScheduler's channel only after the new
         # scheduler is successfully built — a failed rebuild must not leave
         # a closed channel live in the cache

@@ -62,6 +62,7 @@ class _GangHostRoute(RuntimeError):
 # reason only ever surfaces if recovery is impossible (it never is — the
 # cap grows to one slot per pod).
 NO_ROOM_REASON = "claim-slot capacity exhausted; raise max_claims"
+NO_CLAIM_REASON = "no compatible in-flight claim or template"
 
 
 def _next_pow2(n: int, floor: int = 8) -> int:
@@ -165,7 +166,8 @@ def _slim_outputs(specs: tuple, flat) -> tuple[list, list]:
             i += 1
         elif spec[0] == "kscan":
             proc.append(flat[i][: spec[1]])
-            i += 1
+            proc.append(flat[i + 1][: spec[1]])  # per-segment grid_reused
+            i += 2
         elif spec[0] == "gang":
             B = spec[1]
             proc.extend(a[:B] for a in flat[i : i + 5])
@@ -316,6 +318,196 @@ def _merge_scaled(base: dict, req: dict, c: int) -> dict:
     return out
 
 
+def _decode_fill_segments(ctx, segs, f) -> None:
+    """Vectorized fill decode: expand every segment's per-slot counts to a
+    per-pod slot stream via ONE global np.repeat over (value, count) pairs
+    collected in pure Python from the COO fetch, then apply grouped —
+    identical pod/claim/merge ORDER to the per-pod replay it replaces
+    (tier 1 in node-index order, tier 2 in water-fill interleave order,
+    tier 3 in slot order, leftovers last; f32 usage merges one
+    multiply-add per (segment, node)). Multi-slot tier-2 interleaves are
+    rare, so they land as small permutation fixups on the repeated stream.
+
+    Fill grids address WINDOW rows; `slot_map` (the dispatch's slot_of
+    snapshot) translates them to global claim ids — the tier-2/tier-3
+    split stays in window coordinates (open_start is the segment's
+    w_open), while every emitted slot is global.
+
+    `ctx` carries the decode bookkeeping (the full decode's closure state,
+    or a ResidentSession's persistent cross-round bookkeeping — both paths
+    share this function so delta rounds replay the exact same order
+    semantics): E, existing_nodes, pods_sorted, ensure_claim,
+    slot_to_claim, claim_kinds, claim_pod_counts, NC1, assignments,
+    existing_assignments, unschedulable, node_kinds, kind_ports,
+    kind_total."""
+    E = ctx.E
+    pods_sorted = ctx.pods_sorted
+    lo0 = segs[0][0]
+    vals: list[int] = []  # E-space slot ids / negative sentinels
+    cnts: list[int] = []
+    # (stream_pos, slots, counts, p0s) for multi-slot tier-2 runs
+    fixups: list = []
+    # (kind, e_slots, e_counts) per segment, in segment order
+    exist_merges: list = []
+    # (slot, kind, count) per touched claim, in segment order
+    claim_events: list = []
+    fill_c = f["fill_c"]
+    fill_e = f["fill_e"]
+    open_start = f["open_start"]
+    n_opened = f["n_opened"]
+    status = f["status"]
+    slot_map = np.asarray(f["slot_map"], dtype=np.int64)
+    pc = ctx.claim_pod_counts
+    # ONE nonzero scan over the whole [B, W] grid; per-segment
+    # (window row, count) pairs come from the row-pointer slices
+    js, ss = np.nonzero(fill_c)
+    cc = fill_c[js, ss].tolist()
+    ss_l = ss.tolist()
+    gs_l = slot_map[ss].tolist() if ss.size else []
+    row_ptr = np.searchsorted(js, np.arange(len(segs) + 1))
+    for j, (lo, hi, kind) in enumerate(segs):
+        count = hi - lo
+        if count == 0:
+            continue
+        placed = 0
+        # tier 1: existing nodes in index order
+        if E:
+            e_idx = np.flatnonzero(fill_e[j])
+            if e_idx.size:
+                el = e_idx.tolist()
+                cl = fill_e[j][e_idx].tolist()
+                vals += el
+                cnts += cl
+                placed += sum(cl)
+                exist_merges.append((kind, el, cl))
+        # touched window rows, ascending (np.nonzero row-major; window
+        # order is open order, so global ids ascend too)
+        a, b = int(row_ptr[j]), int(row_ptr[j + 1])
+        pairs = list(zip(ss_l[a:b], gs_l[a:b], cc[a:b]))
+        new_lo = int(open_start[j])
+        new_hi = new_lo + int(n_opened[j])
+        # tier 2: water-fill interleave over in-flight claims
+        t2 = [(g_, c) for s, g_, c in pairs if not new_lo <= s < new_hi]
+        if t2:
+            if len(t2) > 1:
+                fixups.append(
+                    (
+                        lo - lo0 + placed,
+                        [g_ for g_, _ in t2],
+                        [c for _, c in t2],
+                        [int(pc[g_]) for g_, _ in t2],
+                    )
+                )
+            for g_, c in t2:
+                vals.append(E + g_)
+                cnts.append(c)
+                pc[g_] += c
+                placed += c
+                claim_events.append((g_, kind, c))
+        # tier 3: new claims in slot order, each filled to capacity
+        if new_hi > new_lo:
+            for s, g_, c in pairs:
+                if new_lo <= s < new_hi:
+                    vals.append(E + g_)
+                    cnts.append(c)
+                    pc[g_] += c
+                    placed += c
+                    claim_events.append((g_, kind, c))
+        # leftovers failed with a uniform reason
+        left = count - placed
+        if left > 0:
+            vals.append(
+                ops_solver.NO_ROOM
+                if int(status[j]) == ops_solver.NO_ROOM
+                else -1
+            )
+            cnts.append(left)
+    stream = np.repeat(
+        np.asarray(vals, dtype=np.int64),
+        np.asarray(cnts, dtype=np.int64),
+    )
+    # tier-2 interleave fixups: rewrite the slot-grouped span in
+    # fewest-pods-first (level, slot) order — same keys as the
+    # sequential replay
+    for pos, slots, counts, p0s in fixups:
+        c2 = np.asarray(counts, dtype=np.int64)
+        n2 = int(c2.sum())
+        p0 = np.asarray(p0s, dtype=np.int64)
+        t2a = np.asarray(slots, dtype=np.int64)
+        ar = np.arange(n2, dtype=np.int64)
+        cum0 = np.cumsum(c2) - c2
+        levels = ar - np.repeat(cum0 - p0, c2)
+        slots_rep = np.repeat(t2a, c2)
+        order = np.argsort(levels * ctx.NC1 + slots_rep, kind="stable")
+        stream[pos : pos + n2] = E + slots_rep[order]
+
+    # ---- apply: claims ensured in ascending-slot order (== the
+    # device's contiguous open order, so hostnames match the
+    # sequential replay), pods grouped by slot in stream order
+    cmask = stream >= E
+    if cmask.any():
+        ci = np.flatnonzero(cmask)
+        cs = stream[ci] - E
+        o = np.argsort(cs, kind="stable")
+        cs_sorted = cs[o]
+        ci_list = (ci[o] + lo0).tolist()
+        bounds = np.flatnonzero(np.diff(cs_sorted)) + 1
+        starts = np.concatenate(([0], bounds))
+        ends = np.concatenate((bounds, [len(cs_sorted)]))
+        for a, b in zip(starts.tolist(), ends.tolist()):
+            s = int(cs_sorted[a])
+            claim = ctx.ensure_claim(s)
+            batch = [pods_sorted[i] for i in ci_list[a:b]]
+            claim.pods.extend(batch)
+            for p in batch:
+                ctx.assignments[p.metadata.uid] = s
+    for s, kind, c in claim_events:
+        claim = ctx.slot_to_claim[s]
+        pk = ctx.kind_ports(kind)
+        if pk:
+            claim.host_ports.extend(pk * c)
+        ck = ctx.claim_kinds[s]
+        ck[kind] = ck.get(kind, 0) + c
+    # ---- apply: existing nodes (index order per segment)
+    emask = (stream >= 0) & (stream < E)
+    if emask.any():
+        ei = np.flatnonzero(emask)
+        es = stream[ei]
+        o = np.argsort(es, kind="stable")
+        es_sorted = es[o]
+        ei_sorted = ei[o]
+        bounds = np.flatnonzero(np.diff(es_sorted)) + 1
+        starts = np.concatenate(([0], bounds))
+        ends = np.concatenate((bounds, [len(es_sorted)]))
+        ei_list = (ei_sorted + lo0).tolist()
+        for a, b in zip(starts.tolist(), ends.tolist()):
+            node = ctx.existing_nodes[int(es_sorted[a])]
+            batch = [pods_sorted[i] for i in ei_list[a:b]]
+            node.pods.extend(batch)
+            for p in batch:
+                ctx.existing_assignments[p.metadata.uid] = node.name
+    for kind, e_idx, ce in exist_merges:
+        req_d = ctx.kind_total(kind)
+        pk = ctx.kind_ports(kind)
+        for e, c in zip(e_idx, ce):
+            node = ctx.existing_nodes[e]
+            node.used = _merge_scaled(node.used, req_d, c)
+            if pk:
+                node.host_ports.extend(pk * c)
+            nk = ctx.node_kinds.setdefault(e, {})
+            nk[kind] = nk.get(kind, 0) + c
+    # ---- apply: leftovers, in stream (= segment) order
+    nmask = stream < 0
+    if nmask.any():
+        for i in np.flatnonzero(nmask).tolist():
+            reason = (
+                NO_ROOM_REASON
+                if stream[i] == ops_solver.NO_ROOM
+                else NO_CLAIM_REASON
+            )
+            ctx.unschedulable.append((pods_sorted[lo0 + i], reason))
+
+
 class TPUScheduler:
     """One scheduler instance per template/catalog set; reusable across
     solve() batches (the vocab may grow between calls)."""
@@ -398,6 +590,15 @@ class TPUScheduler:
         self.pipeline_min_pods = int(os.environ.get("KTPU_PIPELINE_MIN_PODS", "4096"))
         # per-chunk streaming sink (gRPC SolveStream); None in-process
         self._chunk_sink = None
+        # resident-session capture: when a ResidentSession wraps this
+        # scheduler, full solves stash their post-solve device state +
+        # decode bookkeeping here so delta rounds can resume from them
+        self._capture = False
+        self._captured: Optional[dict] = None
+        # elementwise max over the r_min vectors boundary compaction used
+        # this solve — the resident session's eviction-soundness floor
+        # (an arrival below it could have fit an evicted claim)
+        self._last_compact_rmin: Optional[np.ndarray] = None
         # tighter-than-pow2 pad buckets with executable-reuse amortization
         self._pad_cache = PadBucketCache()
         self._volume_reqs: dict = {}
@@ -410,6 +611,12 @@ class TPUScheduler:
         for it in self.catalog:
             self.encoder.observe_instance_type(it)
         self._vocab_sig: Optional[tuple] = None
+
+    def resident_session(self) -> "ResidentSession":
+        """Wrap this scheduler in a ResidentSession: SolverState stays
+        resident on device across solve() calls and steady-state rounds
+        feed only the pod DELTA through the pipeline (ISSUE 7)."""
+        return ResidentSession(self)
 
     # -- encoding ----------------------------------------------------------
 
@@ -624,6 +831,8 @@ class TPUScheduler:
             from karpenter_tpu.tracing.tracer import TRACER
             from karpenter_tpu.utils.metrics import SOLVER_FALLBACK, SOLVER_HOST_FALLBACKS
 
+            # a host-oracle result has no device state to go resident on
+            self._captured = None
             if chunk_sink is not None:
                 # any streamed chunks came from an abandoned device round;
                 # the consumer must discard them before the full result
@@ -829,6 +1038,7 @@ class TPUScheduler:
         self._t_solve_start = _time.perf_counter()
         self._adaptive_claims = True
         self._scan_stats = None
+        self._last_compact_rmin = None
         pad_real0 = dict(self._pad_cache.real)
         pad_padded0 = dict(self._pad_cache.padded)
         try:
@@ -1051,6 +1261,139 @@ class TPUScheduler:
         n_open = _np.asarray(n_open)
         return [(int(unsched[s]) == 0, int(n_open[s])) for s in range(S)]
 
+    def _kind_bundles(self, reps: list) -> tuple[list, list]:
+        """Assemble per-kind encode bundles (reqs/strict/requests/it_allow/
+        tol rows) through the incremental encode cache (KTPU_ENCODE_CACHE).
+
+        Every row is a pure function of kind content and the encode epoch
+        (vocab + pads + catalog + templates), so steady-state repeat solves
+        — and ResidentSession delta rounds, which encode ONLY arrived kinds
+        — assemble cached numpy rows instead of re-walking requirement
+        objects. Returns (bundles, rep_req_sets): rep_req_sets[u] is the
+        rebuilt Requirements for cache misses (None on hits; callers that
+        need it rebuild lazily via _pod_reqs)."""
+        U = len(reps)
+        k_pad, v_pad = self._pads()
+        epoch = (
+            self._vocab_sig, k_pad, v_pad, self._T_pad, len(self.templates)
+        )
+        cache = None
+        if self.encode_cache_enabled:
+            if self._encode_cache_key != epoch:
+                self._encode_cache = {}
+                self._encode_cache_key = epoch
+            elif len(self._encode_cache) > 8192:
+                # churning workloads can't pin rows forever
+                self._encode_cache.clear()
+            cache = self._encode_cache
+        bundles: list = [None] * U
+        rep_sigs = None
+        if cache is not None:
+            rep_sigs = [self._kind_sig(p) for p in reps]
+            for u in range(U):
+                bundles[u] = cache.get(rep_sigs[u])
+        n_hits = sum(b is not None for b in bundles)
+        miss = [u for u in range(U) if bundles[u] is None]
+        rep_req_sets: list = [None] * U
+        if miss:
+            from karpenter_tpu.ops.encode import encode_requirements_np
+
+            row_memo: dict = {}
+            miss_reqs = [self._pod_reqs(reps[u]) for u in miss]
+            for j, u in enumerate(miss):
+                rep_req_sets[u] = miss_reqs[j]
+            m_enc = encode_requirements_np(
+                self.encoder.vocab, miss_reqs, k_pad, v_pad,
+                self.encoder.skip_keys, row_memo=row_memo,
+            )
+            m_strict = encode_requirements_np(
+                self.encoder.vocab,
+                [
+                    Requirements.from_pod(reps[u], include_preferred=False)
+                    for u in miss
+                ],
+                k_pad, v_pad, self.encoder.skip_keys, row_memo=row_memo,
+            )
+            m_allow = self.encoder.it_allow_mask(miss_reqs, self.catalog)
+            if m_allow.shape[1] != self._T_pad:  # sharded catalog padding
+                m_allow = np.pad(
+                    m_allow,
+                    ((0, 0), (0, self._T_pad - m_allow.shape[1])),
+                    constant_values=False,
+                )
+            for j, u in enumerate(miss):
+                p = reps[u]
+                # hostname selectors can never match a not-yet-named node
+                if not self.encoder.hostname_allows(miss_reqs[j], None):
+                    m_allow[j, :] = False
+                bundle = dict(
+                    reqs=tuple(a[j] for a in m_enc),
+                    strict=tuple(a[j] for a in m_strict),
+                    requests=self.encoder.resources_vector(p.total_requests()),
+                    it_allow=m_allow[j],
+                    tol=np.array(
+                        [
+                            tolerates_all(t.taints, p.spec.tolerations) is None
+                            for t in self.templates
+                        ],
+                        dtype=bool,
+                    ),
+                )
+                bundles[u] = bundle
+                if cache is not None:
+                    cache[rep_sigs[u]] = bundle
+        if n_hits:
+            from karpenter_tpu.utils.metrics import ENCODE_CACHE_HITS
+
+            ENCODE_CACHE_HITS.inc(n_hits)
+        return bundles, rep_req_sets
+
+    @staticmethod
+    def _stack_bundles(bundles: list):
+        """Stack per-kind bundle rows into the kind-axis problem tensors
+        (reqs, strict, requests, it_allow, tol)."""
+        from karpenter_tpu.ops.encode import ReqSetTensors as _RST
+
+        reqs_k = _RST(
+            *(jnp.asarray(np.stack([b["reqs"][i] for b in bundles])) for i in range(6))
+        )
+        strict_reqs_k = _RST(
+            *(jnp.asarray(np.stack([b["strict"][i] for b in bundles])) for i in range(6))
+        )
+        it_allow_k = np.stack([b["it_allow"] for b in bundles])
+        requests_k = np.stack([b["requests"] for b in bundles])
+        tol_k = np.stack([b["tol"] for b in bundles])
+        return reqs_k, strict_reqs_k, requests_k, it_allow_k, tol_k
+
+    def _exist_ok_rows(
+        self, reps: list, rep_req_sets: list, nodes: list, e_pad: int
+    ) -> np.ndarray:
+        """[U, e_pad] static pod-kind × existing-node checks (taints +
+        skipped-key hostname/instance-type selectors) against the PRISTINE
+        input nodes — node-dependent, never cached."""
+        U = len(reps)
+        exist_ok_k = np.zeros((U, e_pad), dtype=bool)
+        if nodes:
+            for u in range(U):
+                if rep_req_sets[u] is None:
+                    rep_req_sets[u] = self._pod_reqs(reps[u])
+        for e, n in enumerate(nodes):
+            hostname = n.requirements.get(l.LABEL_HOSTNAME).any_value() or None
+            it_name = (
+                n.requirements.get(l.LABEL_INSTANCE_TYPE).any_value() or None
+                if n.requirements.has(l.LABEL_INSTANCE_TYPE)
+                else None
+            )
+            for u, p in enumerate(reps):
+                rq = rep_req_sets[u]
+                ok = tolerates_all(n.taints, p.spec.tolerations) is None
+                ok = ok and self.encoder.hostname_allows(rq, hostname)
+                if ok and rq.has(l.LABEL_INSTANCE_TYPE):
+                    r = rq.get(l.LABEL_INSTANCE_TYPE)
+                    ok = r.has(it_name) if it_name is not None else r.is_lenient()
+                exist_ok_k[u, e] = ok
+        return exist_ok_k
+
     def _encode(
         self,
         pods: Sequence[Pod],
@@ -1125,6 +1468,12 @@ class TPUScheduler:
         n_gang = len(gang_prefix)
         P = len(pods_list)
         cap = self.max_claims or _next_pow2(max(P, 1))
+        if self._capture and not self.max_claims:
+            # resident-session base solves need claim-axis headroom: delta
+            # rounds append into THIS state's global claim space, and a
+            # NO_ROOM there costs a full re-solve (the axis is a perf
+            # knob, not a semantic one — results are axis-independent)
+            cap *= 2
         if self._n_claims_override:
             n_claims = self._n_claims_override
         elif self._adaptive_claims and self._last_n_open is not None:
@@ -1240,118 +1589,15 @@ class TPUScheduler:
 
         U = len(reps)
         k_pad, v_pad = self._pads()
-        G_tmpl = len(self.templates)
-        # ---- incremental encode cache (KTPU_ENCODE_CACHE) ------------------
-        # Every per-kind row below is a pure function of kind content and
-        # the encode epoch (vocab + pads + catalog + templates), so
-        # steady-state repeat solves assemble cached numpy rows instead of
-        # re-walking requirement objects. Node- and port-dependent rows
-        # (exist_ok, port bitsets) stay per-solve.
-        epoch = (self._vocab_sig, k_pad, v_pad, self._T_pad, G_tmpl)
-        cache = None
-        if self.encode_cache_enabled:
-            if self._encode_cache_key != epoch:
-                self._encode_cache = {}
-                self._encode_cache_key = epoch
-            elif len(self._encode_cache) > 8192:
-                # churning workloads can't pin rows forever
-                self._encode_cache.clear()
-            cache = self._encode_cache
-        bundles: list = [None] * U
-        rep_sigs = None
-        if cache is not None:
-            rep_sigs = [self._kind_sig(p) for p in reps]
-            for u in range(U):
-                bundles[u] = cache.get(rep_sigs[u])
-        n_hits = sum(b is not None for b in bundles)
-        miss = [u for u in range(U) if bundles[u] is None]
-        rep_req_sets: list = [None] * U
-        if miss:
-            from karpenter_tpu.ops.encode import encode_requirements_np
-
-            row_memo: dict = {}
-            miss_reqs = [self._pod_reqs(reps[u]) for u in miss]
-            for j, u in enumerate(miss):
-                rep_req_sets[u] = miss_reqs[j]
-            m_enc = encode_requirements_np(
-                self.encoder.vocab, miss_reqs, k_pad, v_pad,
-                self.encoder.skip_keys, row_memo=row_memo,
-            )
-            m_strict = encode_requirements_np(
-                self.encoder.vocab,
-                [
-                    Requirements.from_pod(reps[u], include_preferred=False)
-                    for u in miss
-                ],
-                k_pad, v_pad, self.encoder.skip_keys, row_memo=row_memo,
-            )
-            m_allow = self.encoder.it_allow_mask(miss_reqs, self.catalog)
-            if m_allow.shape[1] != self._T_pad:  # sharded catalog padding
-                m_allow = np.pad(
-                    m_allow,
-                    ((0, 0), (0, self._T_pad - m_allow.shape[1])),
-                    constant_values=False,
-                )
-            for j, u in enumerate(miss):
-                p = reps[u]
-                # hostname selectors can never match a not-yet-named node
-                if not self.encoder.hostname_allows(miss_reqs[j], None):
-                    m_allow[j, :] = False
-                bundle = dict(
-                    reqs=tuple(a[j] for a in m_enc),
-                    strict=tuple(a[j] for a in m_strict),
-                    requests=self.encoder.resources_vector(p.total_requests()),
-                    it_allow=m_allow[j],
-                    tol=np.array(
-                        [
-                            tolerates_all(t.taints, p.spec.tolerations) is None
-                            for t in self.templates
-                        ],
-                        dtype=bool,
-                    ),
-                )
-                bundles[u] = bundle
-                if cache is not None:
-                    cache[rep_sigs[u]] = bundle
-        if n_hits:
-            from karpenter_tpu.utils.metrics import ENCODE_CACHE_HITS
-
-            ENCODE_CACHE_HITS.inc(n_hits)
-        from karpenter_tpu.ops.encode import ReqSetTensors as _RST
-
-        reqs_k = _RST(
-            *(jnp.asarray(np.stack([b["reqs"][i] for b in bundles])) for i in range(6))
+        bundles, rep_req_sets = self._kind_bundles(reps)
+        reqs_k, strict_reqs_k, requests_k, it_allow_k, tol_k = (
+            self._stack_bundles(bundles)
         )
-        strict_reqs_k = _RST(
-            *(jnp.asarray(np.stack([b["strict"][i] for b in bundles])) for i in range(6))
-        )
-        it_allow_k = np.stack([b["it_allow"] for b in bundles])
-        requests_k = np.stack([b["requests"] for b in bundles])
-        tol_k = np.stack([b["tol"] for b in bundles])
         # static pod×existing-node checks for the skipped keys + taints
         # (node-dependent: never cached; the Requirements rebuild only
         # runs when existing nodes are present)
         E = exist_tensors.avail.shape[0]
-        exist_ok_k = np.zeros((U, E), dtype=bool)
-        if self.existing_nodes:
-            for u in range(U):
-                if rep_req_sets[u] is None:
-                    rep_req_sets[u] = self._pod_reqs(reps[u])
-        for e, n in enumerate(self.existing_nodes):
-            hostname = n.requirements.get(l.LABEL_HOSTNAME).any_value() or None
-            it_name = (
-                n.requirements.get(l.LABEL_INSTANCE_TYPE).any_value() or None
-                if n.requirements.has(l.LABEL_INSTANCE_TYPE)
-                else None
-            )
-            for u, p in enumerate(reps):
-                rq = rep_req_sets[u]
-                ok = tolerates_all(n.taints, p.spec.tolerations) is None
-                ok = ok and self.encoder.hostname_allows(rq, hostname)
-                if ok and rq.has(l.LABEL_INSTANCE_TYPE):
-                    r = rq.get(l.LABEL_INSTANCE_TYPE)
-                    ok = r.has(it_name) if it_name is not None else r.is_lenient()
-                exist_ok_k[u, e] = ok
+        exist_ok_k = self._exist_ok_rows(reps, rep_req_sets, self.existing_nodes, E)
         # topology tensors (counts + per-kind group relations); the hostname
         # slot space gets one spare column so tier-3's fresh-slot read stays
         # in bounds when every claim slot is open
@@ -1782,6 +2028,10 @@ class TPUScheduler:
             if not window_active or not (remaining > 0).any():
                 return st
             r_min = requests_np[remaining > 0].min(axis=0)
+            prev = self._last_compact_rmin
+            self._last_compact_rmin = (
+                r_min if prev is None else np.maximum(prev, r_min)
+            )
             st, _closed = ops_solver.compact_state(
                 st, self.it_tensors, jnp.asarray(r_min), n_claims,
                 topo_kids=enc["topo_kids"],
@@ -2026,6 +2276,7 @@ class TPUScheduler:
                 weights.append(o[2] - o[1])
             elif o[0] == "kscan":
                 flat.append(o[2].assignment)
+                flat.append(o[2].grid_reused)
                 specs.append(("kscan", len(o[1])))
                 weights.append(sum(hi - lo for lo, hi, _ in o[1]))
             elif o[0] == "gang":
@@ -2137,11 +2388,12 @@ class TPUScheduler:
                 claim_kinds[slot] = {}
             return claim
 
-        NO_CLAIM_REASON = "no compatible in-flight claim or template"
         # running pod count per claim slot — the water-fill levels of later
         # segments depend on it (fewest-pods-first replays exactly)
         claim_pod_counts = np.zeros(enc["n_claims"], dtype=np.int64)
         NC1 = np.int64(enc["n_claims"] + 1)
+        # [incremental, full] kscan capacity-grid updates this solve
+        kscan_grid_stats = [0, 0]
 
         def decode_pod(i: int, slot: int) -> None:
             pod = pods_sorted[i]
@@ -2170,185 +2422,28 @@ class TPUScheduler:
             ck[k] = ck.get(k, 0) + 1
             claim_pod_counts[slot] += 1
 
+        from types import SimpleNamespace
+
+        fill_ctx = SimpleNamespace(
+            E=E,
+            NC1=NC1,
+            existing_nodes=self.existing_nodes,
+            pods_sorted=pods_sorted,
+            ensure_claim=ensure_claim,
+            slot_to_claim=slot_to_claim,
+            claim_kinds=claim_kinds,
+            claim_pod_counts=claim_pod_counts,
+            assignments=assignments,
+            existing_assignments=existing_assignments,
+            unschedulable=unschedulable,
+            node_kinds=node_kinds,
+            kind_ports=kind_ports,
+            kind_total=kind_total,
+        )
+
         def decode_fill_output(segs, f) -> None:
-            """Vectorized fill decode: expand every segment's per-slot
-            counts to a per-pod slot stream via ONE global np.repeat over
-            (value, count) pairs collected in pure Python from the COO
-            fetch, then apply grouped — identical pod/claim/merge ORDER to
-            the per-pod replay it replaces (tier 1 in node-index order,
-            tier 2 in water-fill interleave order, tier 3 in slot order,
-            leftovers last; f32 usage merges one multiply-add per
-            (segment, node)). Multi-slot tier-2 interleaves are rare, so
-            they land as small permutation fixups on the repeated stream.
-
-            Fill grids address WINDOW rows; `slot_map` (this dispatch's
-            slot_of snapshot) translates them to global claim ids — the
-            tier-2/tier-3 split stays in window coordinates (open_start is
-            the segment's w_open), while every emitted slot is global."""
-            lo0, hiN = segs[0][0], segs[-1][1]
-            vals: list[int] = []  # E-space slot ids / negative sentinels
-            cnts: list[int] = []
-            # (stream_pos, slots, counts, p0s) for multi-slot tier-2 runs
-            fixups: list = []
-            # (kind, e_slots, e_counts) per segment, in segment order
-            exist_merges: list = []
-            # (slot, kind, count) per touched claim, in segment order
-            claim_events: list = []
-            fill_c = f["fill_c"]
-            fill_e = f["fill_e"]
-            open_start = f["open_start"]
-            n_opened = f["n_opened"]
-            status = f["status"]
-            slot_map = np.asarray(f["slot_map"], dtype=np.int64)
-            pc = claim_pod_counts
-            # ONE nonzero scan over the whole [B, W] grid; per-segment
-            # (window row, count) pairs come from the row-pointer slices
-            js, ss = np.nonzero(fill_c)
-            cc = fill_c[js, ss].tolist()
-            ss_l = ss.tolist()
-            gs_l = slot_map[ss].tolist() if ss.size else []
-            row_ptr = np.searchsorted(js, np.arange(len(segs) + 1))
-            for j, (lo, hi, kind) in enumerate(segs):
-                count = hi - lo
-                if count == 0:
-                    continue
-                placed = 0
-                # tier 1: existing nodes in index order
-                if E:
-                    e_idx = np.flatnonzero(fill_e[j])
-                    if e_idx.size:
-                        el = e_idx.tolist()
-                        cl = fill_e[j][e_idx].tolist()
-                        vals += el
-                        cnts += cl
-                        placed += sum(cl)
-                        exist_merges.append((kind, el, cl))
-                # touched window rows, ascending (np.nonzero row-major;
-                # window order is open order, so global ids ascend too)
-                a, b = int(row_ptr[j]), int(row_ptr[j + 1])
-                pairs = list(zip(ss_l[a:b], gs_l[a:b], cc[a:b]))
-                new_lo = int(open_start[j])
-                new_hi = new_lo + int(n_opened[j])
-                # tier 2: water-fill interleave over in-flight claims
-                t2 = [(g_, c) for s, g_, c in pairs if not new_lo <= s < new_hi]
-                if t2:
-                    if len(t2) > 1:
-                        fixups.append(
-                            (
-                                lo - lo0 + placed,
-                                [g_ for g_, _ in t2],
-                                [c for _, c in t2],
-                                [int(pc[g_]) for g_, _ in t2],
-                            )
-                        )
-                    for g_, c in t2:
-                        vals.append(E + g_)
-                        cnts.append(c)
-                        pc[g_] += c
-                        placed += c
-                        claim_events.append((g_, kind, c))
-                # tier 3: new claims in slot order, each filled to capacity
-                if new_hi > new_lo:
-                    for s, g_, c in pairs:
-                        if new_lo <= s < new_hi:
-                            vals.append(E + g_)
-                            cnts.append(c)
-                            pc[g_] += c
-                            placed += c
-                            claim_events.append((g_, kind, c))
-                # leftovers failed with a uniform reason
-                left = count - placed
-                if left > 0:
-                    vals.append(
-                        ops_solver.NO_ROOM
-                        if int(status[j]) == ops_solver.NO_ROOM
-                        else -1
-                    )
-                    cnts.append(left)
-            stream = np.repeat(
-                np.asarray(vals, dtype=np.int64),
-                np.asarray(cnts, dtype=np.int64),
-            )
-            # tier-2 interleave fixups: rewrite the slot-grouped span in
-            # fewest-pods-first (level, slot) order — same keys as the
-            # sequential replay
-            for pos, slots, counts, p0s in fixups:
-                c2 = np.asarray(counts, dtype=np.int64)
-                n2 = int(c2.sum())
-                p0 = np.asarray(p0s, dtype=np.int64)
-                t2a = np.asarray(slots, dtype=np.int64)
-                ar = np.arange(n2, dtype=np.int64)
-                cum0 = np.cumsum(c2) - c2
-                levels = ar - np.repeat(cum0 - p0, c2)
-                slots_rep = np.repeat(t2a, c2)
-                order = np.argsort(levels * NC1 + slots_rep, kind="stable")
-                stream[pos : pos + n2] = E + slots_rep[order]
-
-            # ---- apply: claims ensured in ascending-slot order (== the
-            # device's contiguous open order, so hostnames match the
-            # sequential replay), pods grouped by slot in stream order
-            cmask = stream >= E
-            if cmask.any():
-                ci = np.flatnonzero(cmask)
-                cs = stream[ci] - E
-                o = np.argsort(cs, kind="stable")
-                cs_sorted = cs[o]
-                ci_list = (ci[o] + lo0).tolist()
-                bounds = np.flatnonzero(np.diff(cs_sorted)) + 1
-                starts = np.concatenate(([0], bounds))
-                ends = np.concatenate((bounds, [len(cs_sorted)]))
-                for a, b in zip(starts.tolist(), ends.tolist()):
-                    s = int(cs_sorted[a])
-                    claim = ensure_claim(s)
-                    batch = [pods_sorted[i] for i in ci_list[a:b]]
-                    claim.pods.extend(batch)
-                    for p in batch:
-                        assignments[p.metadata.uid] = s
-            for s, kind, c in claim_events:
-                claim = slot_to_claim[s]
-                pk = kind_ports(kind)
-                if pk:
-                    claim.host_ports.extend(pk * c)
-                ck = claim_kinds[s]
-                ck[kind] = ck.get(kind, 0) + c
-            # ---- apply: existing nodes (index order per segment)
-            emask = (stream >= 0) & (stream < E)
-            if emask.any():
-                ei = np.flatnonzero(emask)
-                es = stream[ei]
-                o = np.argsort(es, kind="stable")
-                es_sorted = es[o]
-                ei_sorted = ei[o]
-                bounds = np.flatnonzero(np.diff(es_sorted)) + 1
-                starts = np.concatenate(([0], bounds))
-                ends = np.concatenate((bounds, [len(es_sorted)]))
-                ei_list = (ei_sorted + lo0).tolist()
-                for a, b in zip(starts.tolist(), ends.tolist()):
-                    node = self.existing_nodes[int(es_sorted[a])]
-                    batch = [pods_sorted[i] for i in ei_list[a:b]]
-                    node.pods.extend(batch)
-                    for p in batch:
-                        existing_assignments[p.metadata.uid] = node.name
-            for kind, e_idx, ce in exist_merges:
-                req_d = kind_total(kind)
-                pk = kind_ports(kind)
-                for e, c in zip(e_idx, ce):
-                    node = self.existing_nodes[e]
-                    node.used = _merge_scaled(node.used, req_d, c)
-                    if pk:
-                        node.host_ports.extend(pk * c)
-                    nk = node_kinds.setdefault(e, {})
-                    nk[kind] = nk.get(kind, 0) + c
-            # ---- apply: leftovers, in stream (= segment) order
-            nmask = stream < 0
-            if nmask.any():
-                for i in np.flatnonzero(nmask).tolist():
-                    reason = (
-                        NO_ROOM_REASON
-                        if stream[i] == ops_solver.NO_ROOM
-                        else NO_CLAIM_REASON
-                    )
-                    unschedulable.append((pods_sorted[lo0 + i], reason))
+            # shared with ResidentSession delta rounds (_decode_fill_segments)
+            _decode_fill_segments(fill_ctx, segs, f)
 
         def decode_gang_output(segs, f) -> None:
             """Gang-grouped claim expansion: slice host j takes the
@@ -2456,7 +2551,10 @@ class TPUScheduler:
             elif out[0] == "gang":
                 decode_gang_output(out[1], out[2])
             elif out[0] == "kscan":
-                _, segs, assign = out
+                _, segs, assign, grid_reused = out
+                n_inc = int(np.asarray(grid_reused).sum())
+                kscan_grid_stats[0] += n_inc
+                kscan_grid_stats[1] += len(segs) - n_inc
                 for j, (lo, hi, _kind) in enumerate(segs):
                     apply_assignments(
                         lo, np.asarray(assign[j][: hi - lo], dtype=np.int64)
@@ -2470,7 +2568,7 @@ class TPUScheduler:
             if spec[0] == "pods":
                 return (o[0], o[1], o[2], next(it_f)), False
             if spec[0] == "kscan":
-                return (o[0], o[1], next(it_f)), False
+                return (o[0], o[1], next(it_f), next(it_f)), False
             if spec[0] == "gang":
                 return (
                     o[0],
@@ -2731,6 +2829,15 @@ class TPUScheduler:
             from karpenter_tpu.utils.metrics import SCAN_WINDOW_SPILLS
 
             SCAN_WINDOW_SPILLS.inc(n_spills)
+        if kscan_grid_stats[0] or kscan_grid_stats[1]:
+            from karpenter_tpu.utils.metrics import KSCAN_GRID_UPDATES
+
+            if kscan_grid_stats[0]:
+                KSCAN_GRID_UPDATES.inc(kscan_grid_stats[0], mode="incremental")
+            if kscan_grid_stats[1]:
+                KSCAN_GRID_UPDATES.inc(kscan_grid_stats[1], mode="full")
+            self._scan_stats["kscan_grid_incremental"] = kscan_grid_stats[0]
+            self._scan_stats["kscan_grid_full"] = kscan_grid_stats[1]
 
         # ---- finalization from device state --------------------------------
         def fold_narrowing(reqs: Requirements, mask_r, inf_r, def_r, what: str):
@@ -2848,10 +2955,877 @@ class TPUScheduler:
                 if vols and node is not None and node.volume_usage is not None:
                     node.volume_usage.add(uid, vols)
 
-        return SchedulingResult(
+        result = SchedulingResult(
             claims=claims,
             unschedulable=unschedulable,
             assignments=assignments,
             existing=self.existing_nodes,
             existing_assignments=existing_assignments,
         )
+        if self._capture:
+            # resident-session adoption material: the post-solve device
+            # state plus the decode bookkeeping delta rounds extend. The
+            # relaxation/NO_ROOM loops overwrite this per round, so the
+            # capture always matches the RETURNED result.
+            self._captured = dict(
+                state=state,
+                enc=enc,
+                pods_sorted=list(pods_sorted),
+                claims=claims,
+                slot_to_claim=slot_to_claim,
+                claim_kinds=claim_kinds,
+                claim_pod_counts=claim_pod_counts,
+                assignments=assignments,
+                existing_assignments=existing_assignments,
+                unschedulable=unschedulable,
+                node_kinds=node_kinds,
+                n_open=self._last_n_open,
+                compact_rmin=self._last_compact_rmin,
+            )
+        return result
+
+
+# ---------------------------------------------------------------------------
+# Resident incremental solver (ISSUE 7): schedule deltas, not snapshots
+# ---------------------------------------------------------------------------
+
+
+def resident_enabled() -> bool:
+    """KTPU_RESIDENT gate (default on; =0 restores the snapshot path —
+    every round is a plain TPUScheduler.solve, bit-for-bit)."""
+    import os
+
+    return os.environ.get("KTPU_RESIDENT", "1") not in ("0", "false")
+
+
+class _DeltaUnsafe(RuntimeError):
+    """A delta round failed a soundness gate BEFORE any state mutation;
+    the session falls back to a full re-solve for this round."""
+
+    def __init__(self, mode: str, reason: str):
+        super().__init__(reason)
+        self.mode = mode
+        self.reason = reason
+
+
+class ResidentSession:
+    """Keeps SolverState resident on device across solve() calls and feeds
+    only the DELTA (arrived / departed pods) through the pipeline — the
+    ROADMAP's "turn a batch solver into a service" refactor. Wraps a
+    TPUScheduler; drop-in for it at the Provisioner/RPC seam (unknown
+    attributes delegate to the wrapped scheduler).
+
+    Invariant: whenever a round stays on the delta path, the cumulative
+    result is BIT-identical to a cold full re-solve of the current pod set
+    in session (arrival) order — enforced by conservative host-side gates,
+    each of which falls back to a full re-solve when it cannot PROVE
+    identity:
+
+      * arrivals append only when the cold FFD sort of the union keeps
+        every resident pod in place (stable-lexsort prefix check over the
+        shared (size, kind-rank) keys) — then the scan-prefix property of
+        the chunked solve makes the append exact;
+      * arrivals must not undercut the eviction floor (the elementwise-max
+        r_min any boundary compaction used): a smaller arrival could have
+        fit a claim the base solve froze;
+      * departures retract only when they form an exact suffix of "pure"
+        rounds (rounds whose pods landed exclusively on claims those
+        rounds opened) — then ops_solver.retract_tail's suffix undo is an
+        exact rollback to the state the surviving prefix produced;
+      * the session only goes resident at all for the fill-regime
+        constraint family (topology-free, no gangs, no enforced minValues,
+        no reservations, no finite budgets, no DRA/volume machinery) with
+        a clean base solve (no unschedulable pods, no relaxation);
+      * any cluster-shape change — vocab/pads growth, catalog/template
+        rebuild (a new scheduler), existing-node content change — is an
+        epoch invalidation: full re-solve, new resident base.
+
+    Modes (ktpu_resident_rounds_total{mode}): delta / full / invalidated.
+    """
+
+    # the Provisioner materializes bound_pods only for schedulers that ask
+    wants_bound_pods = False
+
+    def __init__(self, sched: TPUScheduler):
+        self.sched = sched
+        self._r: Optional[dict] = None
+        self.last_mode = "full"
+        self.last_reason = "cold"
+        self.rounds_total = {"delta": 0, "full": 0, "invalidated": 0}
+        self.last_timings: dict = {}
+
+    def __getattr__(self, name):
+        return getattr(self.sched, name)
+
+    # -- bookkeeping helpers ----------------------------------------------
+
+    @staticmethod
+    def _existing_sig(nodes) -> tuple:
+        return tuple(
+            (
+                n.name,
+                str(n.requirements),
+                tuple(sorted(n.available.items())),
+                tuple(sorted(n.used.items())),
+                repr(n.taints),
+                tuple(n.host_ports),
+                n.volume_usage is not None,
+            )
+            for n in nodes or []
+        )
+
+    def _grows_vocab(self, rep: Pod) -> bool:
+        """Whether encoding this kind would grow the vocab / resource axis
+        (a session epoch change — the resident problem tensors predate
+        it). Mirrors ProblemEncoder.observe_pod without mutating."""
+        enc = self.sched.encoder
+        v = enc.vocab
+        for rq in self.sched._pod_reqs(rep).values():
+            if rq.key in enc.skip_keys:
+                continue
+            kid = v.key_to_id.get(rq.key)
+            if kid is None:
+                return True
+            vt = v.value_to_id[kid]
+            if any(val not in vt for val in rq.values):
+                return True
+        return any(
+            name not in enc._resource_ids for name in rep.total_requests()
+        )
+
+    def _kind_reqs(self, k: int) -> Requirements:
+        r = self._r
+        out = r["kind_reqs_c"].get(k)
+        if out is None:
+            out = r["kind_reqs_c"][k] = self.sched._pod_reqs(r["kind_reps"][k])
+        return out
+
+    def _kind_total(self, k: int) -> dict:
+        r = self._r
+        out = r["kind_total_c"].get(k)
+        if out is None:
+            out = r["kind_total_c"][k] = r["kind_reps"][k].total_requests()
+        return out
+
+    def _kind_ports(self, k: int) -> list:
+        r = self._r
+        out = r["kind_ports_c"].get(k)
+        if out is None:
+            from karpenter_tpu.scheduling import hostports as hpmod
+
+            out = r["kind_ports_c"][k] = [
+                hpmod.port_key(h) for h in r["kind_reps"][k].spec.host_ports
+            ]
+        return out
+
+    # -- the TPUScheduler surface -----------------------------------------
+
+    def solve(
+        self,
+        pods,
+        existing_nodes=None,
+        budgets=None,
+        topology=None,
+        topology_factory=None,
+        volume_reqs=None,
+        reserved_mode=None,
+        reserved_in_use=None,
+        dra_problem=None,
+        pod_volumes=None,
+        deadline=None,
+        now=None,
+        bound_pods=None,
+        chunk_sink=None,
+    ) -> SchedulingResult:
+        import time as _time
+
+        pods = list(pods)
+        kwargs = dict(
+            budgets=budgets,
+            topology=topology,
+            topology_factory=topology_factory,
+            volume_reqs=volume_reqs,
+            reserved_mode=reserved_mode,
+            reserved_in_use=reserved_in_use,
+            dra_problem=dra_problem,
+            pod_volumes=pod_volumes,
+            deadline=deadline,
+            now=now,
+            bound_pods=bound_pods,
+            chunk_sink=chunk_sink,
+        )
+        if not resident_enabled():
+            # snapshot path, untouched (acceptance: KTPU_RESIDENT=0)
+            self._r = None
+            return self.sched.solve(pods, existing_nodes, **kwargs)
+        # chunk_sink stays supported: it is output plumbing (SolveStream),
+        # not a constraint — full rounds stream through it; delta rounds
+        # produce no chunks, so the final frame carries everything
+        supported = not (
+            budgets
+            or volume_reqs
+            or reserved_in_use
+            or dra_problem is not None
+            or pod_volumes
+            or (
+                reserved_mode is not None
+                and reserved_mode != self.sched.reserved_mode
+            )
+        )
+        t0 = _time.perf_counter()
+        try:
+            if not supported:
+                raise _DeltaUnsafe("full", "unsupported_args")
+            plan = self._classify(
+                pods, existing_nodes, topology, topology_factory, bound_pods
+            )
+            result = self._solve_delta(plan, deadline=deadline, now=now)
+            mode, reason = "delta", "delta"
+        except _DeltaUnsafe as gate:
+            mode, reason = gate.mode, gate.reason
+            result = self._solve_full(
+                pods, existing_nodes, kwargs, capture=supported
+            )
+        self.last_mode, self.last_reason = mode, reason
+        self.rounds_total[mode] += 1
+        from karpenter_tpu.utils.metrics import RESIDENT_ROUNDS
+
+        RESIDENT_ROUNDS.inc(mode=mode)
+        # host-fallback solves (e.g. DRA) never reach _solve_once, so the
+        # wrapped scheduler may not have timings yet
+        self.last_timings = dict(getattr(self.sched, "last_timings", {}) or {})
+        self.last_timings["resident"] = {
+            "mode": mode,
+            "reason": reason,
+            "wall_s": _time.perf_counter() - t0,
+        }
+        return result
+
+    # -- full path ---------------------------------------------------------
+
+    def _solve_full(self, pods, existing_nodes, kwargs, capture: bool):
+        self._r = None
+        if not capture:
+            return self.sched.solve(pods, existing_nodes, **kwargs)
+        self.sched._capture = True
+        self.sched._captured = None
+        try:
+            result = self.sched.solve(pods, existing_nodes, **kwargs)
+        finally:
+            cap = self.sched._captured
+            self.sched._captured = None
+            self.sched._capture = False
+        self._adopt(cap, existing_nodes, result)
+        return result
+
+    def _adopt(self, cap, input_existing, result) -> None:
+        """Go resident on a clean captured full solve, when the problem
+        sits inside the delta-safe constraint family."""
+        if cap is None or result.unschedulable or result.relaxations:
+            return
+        enc = cap["enc"]
+        if enc["P"] <= 0 or cap["n_open"] is None:
+            return
+        if enc["topo_kids"] or enc["vg_groups"] or enc["hg_groups"]:
+            return
+        topo = getattr(self.sched, "topology", None)
+        if topo is not None and (topo.groups or topo.inverse_groups):
+            return
+        if bool(np.asarray(enc["gang_kind"]).any()) or enc.get("pre_unsched"):
+            return
+        if not bool(np.all(enc["batchable"])):
+            return
+        if self.sched._res_active or self.sched._mv_active:
+            return
+        if any(v for v in self.sched.budgets.values()):
+            return
+        if not self.sched.encode_cache_enabled:
+            return
+        pods_sorted = cap["pods_sorted"]
+        if len({p.uid for p in pods_sorted}) != len(pods_sorted):
+            return
+        from karpenter_tpu.controllers.provisioning.host_scheduler import (
+            pod_ffd_key,
+        )
+
+        sizes = np.empty(len(pods_sorted), dtype=np.float64)
+        for i, p in enumerate(pods_sorted):
+            sizes[i] = pod_ffd_key(p)[1]
+        reps = enc["reps"]
+        self._r = dict(
+            state=cap["state"],
+            enc=enc,
+            n_claims=enc["n_claims"],
+            order=[p.uid for p in pods_sorted],
+            pod_by_uid={p.uid: p for p in pods_sorted},
+            # session kid numbering == union first-appearance rank (the
+            # sorted-order invariant makes the two coincide); ids are
+            # never reused, so relative rank order survives retractions
+            ranks=np.asarray(enc["kind_of"][: enc["P"]], dtype=np.int64).copy(),
+            sizes=sizes,
+            kind_sig_to_kid={
+                self.sched._kind_sig(rep): k for k, rep in enumerate(reps)
+            },
+            kind_reps={k: rep for k, rep in enumerate(reps)},
+            next_kid=len(reps),
+            kind_reqs_c={},
+            kind_total_c={},
+            kind_ports_c={},
+            claims=cap["claims"],
+            slot_to_claim=cap["slot_to_claim"],
+            claim_kinds=cap["claim_kinds"],
+            claim_pod_counts=cap["claim_pod_counts"],
+            assignments=cap["assignments"],
+            existing_assignments=cap["existing_assignments"],
+            node_kinds=cap["node_kinds"],
+            existing_nodes=result.existing,
+            exist_pristine=[n.clone() for n in (input_existing or [])],
+            exist_sig=self._existing_sig(input_existing),
+            hostname_seq=len(cap["claims"]),
+            rounds=[
+                dict(
+                    uids={p.uid for p in pods_sorted},
+                    start_idx=0,
+                    n_open_start=0,
+                    pure=True,
+                    new_kids=list(range(len(reps))),
+                )
+            ],
+            n_open=int(cap["n_open"]),
+            compact_rmin=cap["compact_rmin"],
+            proto_cache={},
+            its_cache={},
+            vocab_sig=self.sched._sig(),
+        )
+
+    # -- classification ----------------------------------------------------
+
+    def _classify(
+        self, pods, existing_nodes, topology, topology_factory, bound_pods
+    ) -> dict:
+        r = self._r
+        if r is None:
+            raise _DeltaUnsafe("full", "cold")
+        if self.sched._sig() != r["vocab_sig"]:
+            raise _DeltaUnsafe("invalidated", "vocab_changed")
+        if self._existing_sig(existing_nodes) != r["exist_sig"]:
+            raise _DeltaUnsafe("invalidated", "existing_changed")
+        pod_by_uid = r["pod_by_uid"]
+        uid_list = [p.metadata.uid for p in pods]
+        uids = set(uid_list)
+        if len(uids) != len(pods):
+            raise _DeltaUnsafe("full", "duplicate_uids")
+        # resident pods must be content-identical to their recorded selves
+        # (a mutated spec under a reused uid is a different problem); pod
+        # specs are immutable post-construction, so the SAME object needs
+        # no re-check — only a replacement object pays the sig comparison
+        arrivals: list[Pod] = []
+        for p, uid in zip(pods, uid_list):
+            old = pod_by_uid.get(uid)
+            if old is None:
+                arrivals.append(p)
+            elif old is not p and (
+                self.sched._kind_sig(p) != self.sched._kind_sig(old)
+            ):
+                raise _DeltaUnsafe("invalidated", "pod_mutated")
+        departed = set(pod_by_uid) - uids
+        if not arrivals and not departed:
+            # an unchanged pod set still re-solves identically; cheap path
+            raise _DeltaUnsafe("full", "no_delta")
+        # ---- departures: exact suffix of pure rounds ----------------------
+        retract_k = 0
+        if departed:
+            acc: set = set()
+            rounds = r["rounds"]
+            while acc != departed:
+                retract_k += 1
+                if retract_k >= len(rounds):
+                    # the base round would have to unwind: full re-solve
+                    # (the "retract-triggers-full-resolve" edge)
+                    raise _DeltaUnsafe("full", "retract_base")
+                rec = rounds[-retract_k]
+                if not rec["pure"]:
+                    raise _DeltaUnsafe("full", "retract_impure")
+                acc |= rec["uids"]
+                if not acc <= departed:
+                    raise _DeltaUnsafe("full", "retract_unaligned")
+        # ---- arrivals: constraint family + ordering -----------------------
+        plan_kinds: list = []  # (sig, kid, rep, is_new)
+        if arrivals:
+            from karpenter_tpu.controllers.provisioning.topology import (
+                pods_declare_topology,
+            )
+            from karpenter_tpu.gang import is_gang_pod
+
+            if pods_declare_topology(arrivals):
+                raise _DeltaUnsafe("full", "topology")
+            if any(
+                entry[0].spec.pod_anti_affinity for entry in bound_pods or ()
+            ):
+                raise _DeltaUnsafe("full", "topology")
+            if topology is not None and (
+                topology.groups or topology.inverse_groups
+            ):
+                raise _DeltaUnsafe("full", "topology")
+            if topology_factory is not None:
+                t = topology_factory(list(arrivals))
+                if t.groups or t.inverse_groups:
+                    raise _DeltaUnsafe("full", "topology")
+            for p in arrivals:
+                if is_gang_pod(p):
+                    raise _DeltaUnsafe("full", "gang")
+                sp = p.spec
+                if (
+                    sp.host_ports
+                    or sp.pvc_names
+                    or sp.resource_claims
+                    or sp.node_name
+                ):
+                    raise _DeltaUnsafe("full", "pod_features")
+            # kinds whose last pods leave with the retracted suffix GHOST:
+            # a re-arriving ghost must take a FRESH id, or its stale
+            # (too-small) rank would sort it ahead of kinds that first
+            # appear earlier in the new union order
+            surviving_kids = None
+            if retract_k:
+                cut_idx = r["rounds"][-retract_k]["start_idx"]
+                surviving_kids = set(r["ranks"][:cut_idx].tolist())
+            seen: dict = {}
+            next_kid = r["next_kid"]
+            for p in arrivals:
+                sig = self.sched._kind_sig(p)
+                if sig in seen:
+                    continue
+                kid = r["kind_sig_to_kid"].get(sig)
+                if kid is not None and (
+                    surviving_kids is not None and kid not in surviving_kids
+                ):
+                    kid = None  # ghosting with the suffix: register fresh
+                if kid is None:
+                    if self._grows_vocab(p):
+                        raise _DeltaUnsafe("invalidated", "vocab_growth")
+                    seen[sig] = (next_kid, p, True)
+                    next_kid += 1
+                else:
+                    seen[sig] = (kid, r["kind_reps"][kid], False)
+            plan_kinds = [
+                (sig, kid, rep, new) for sig, (kid, rep, new) in seen.items()
+            ]
+        return dict(
+            arrivals=arrivals,
+            departed=departed,
+            retract_k=retract_k,
+            plan_kinds=plan_kinds,
+        )
+
+    # -- delta path --------------------------------------------------------
+
+    def _solve_delta(self, plan, deadline=None, now=None) -> SchedulingResult:
+        import time as _time
+
+        r = self._r
+        sched = self.sched
+        arrivals = plan["arrivals"]
+        retract_k = plan["retract_k"]
+
+        # ---- validate + encode the arrival delta BEFORE mutating anything
+        delta = None
+        if arrivals:
+            kid_of_sig = {sig: kid for sig, kid, _rep, _new in plan_sorted(plan)}
+            local_reps = [rep for _sig, _kid, rep, _new in plan_sorted(plan)]
+            local_kids = [kid for _sig, kid, _rep, _new in plan_sorted(plan)]
+            local_of_kid = {kid: i for i, kid in enumerate(local_kids)}
+            bundles, rep_req_sets = sched._kind_bundles(local_reps)
+            # eviction floor: an arrival below any compaction's r_min could
+            # have fit a claim the resident state froze
+            rmin = r["compact_rmin"]
+            if rmin is not None:
+                for b in bundles:
+                    if not bool(np.all(b["requests"] >= rmin)):
+                        raise _DeltaUnsafe("full", "below_eviction_floor")
+            from karpenter_tpu.controllers.provisioning.host_scheduler import (
+                pod_ffd_key,
+            )
+
+            nA = len(arrivals)
+            a_ranks = np.empty(nA, dtype=np.int64)
+            a_sizes = np.empty(nA, dtype=np.float64)
+            for i, p in enumerate(arrivals):
+                a_ranks[i] = kid_of_sig[sched._kind_sig(p)]
+                a_sizes[i] = pod_ffd_key(p)[1]
+            # survivors = session order minus departed (a sorted sequence
+            # stays sorted under deletion); prefix check: the cold stable
+            # lexsort of the union must keep every survivor in place
+            if retract_k:
+                cut_idx = r["rounds"][-retract_k]["start_idx"]
+            else:
+                cut_idx = len(r["order"])
+            s_ranks = r["ranks"][:cut_idx]
+            s_sizes = r["sizes"][:cut_idx]
+            n_surv = len(s_ranks)
+            order = np.lexsort(
+                (
+                    np.concatenate([s_ranks, a_ranks]),
+                    -np.concatenate([s_sizes, a_sizes]),
+                )
+            )
+            if not bool((order[:n_surv] == np.arange(n_surv)).all()):
+                raise _DeltaUnsafe("full", "ffd_reorder")
+            a_order = (order[n_surv:] - n_surv).astype(np.int64)
+            arrivals_sorted = [arrivals[i] for i in a_order]
+            kids_sorted = a_ranks[a_order]
+            sizes_sorted = a_sizes[a_order]
+            # segments: runs of identical kinds (contiguous by stable sort)
+            seg_list: list = []
+            lo = 0
+            for i in range(1, nA + 1):
+                if i == nA or kids_sorted[i] != kids_sorted[lo]:
+                    seg_list.append((lo, i, int(kids_sorted[lo])))
+                    lo = i
+            delta = dict(
+                arrivals_sorted=arrivals_sorted,
+                kids_sorted=kids_sorted,
+                sizes_sorted=sizes_sorted,
+                seg_list=seg_list,
+                bundles=bundles,
+                rep_req_sets=rep_req_sets,
+                local_reps=local_reps,
+                local_of_kid=local_of_kid,
+            )
+
+        t0 = _time.perf_counter()
+        # ---- 1. retract departed suffix rounds (device + host rollback)
+        if retract_k:
+            self._retract(retract_k)
+        # ---- 2. append arrivals through the fill pipeline
+        t_encode = _time.perf_counter()
+        if delta is not None:
+            self._append(delta)
+        t_end = _time.perf_counter()
+        sched.last_timings = {
+            "encode_s": t_encode - t0,
+            "device_s": t_end - t_encode,
+            "decode_s": 0.0,
+        }
+        from karpenter_tpu.utils.metrics import RESIDENT_DELTA_PODS
+
+        RESIDENT_DELTA_PODS.observe(len(arrivals) + len(plan["departed"]))
+        return SchedulingResult(
+            claims=list(r["claims"]),
+            unschedulable=[],
+            assignments=dict(r["assignments"]),
+            existing=r["existing_nodes"],
+            existing_assignments=dict(r["existing_assignments"]),
+        )
+
+    def _retract(self, k: int) -> None:
+        """Suffix undo of the last k (pure) rounds: one retract_tail
+        dispatch plus the mirrored host-bookkeeping rollback."""
+        r = self._r
+        target = r["rounds"][-k]
+        cut = int(target["n_open_start"])
+        r["state"] = ops_solver.retract_tail(r["state"], jnp.int32(cut))
+        claims = r["claims"]
+        while claims and claims[-1].slot >= cut:
+            c = claims.pop()
+            r["slot_to_claim"].pop(c.slot, None)
+            r["claim_kinds"].pop(c.slot, None)
+            r["claim_pod_counts"][c.slot] = 0
+            for p in c.pods:
+                r["assignments"].pop(p.uid, None)
+        start = target["start_idx"]
+        for uid in r["order"][start:]:
+            r["pod_by_uid"].pop(uid, None)
+        r["order"] = r["order"][:start]
+        r["ranks"] = r["ranks"][:start]
+        r["sizes"] = r["sizes"][:start]
+        # drop kind registrations no surviving pod uses, WITHOUT reusing
+        # their ids (monotone ids keep rank order == first-appearance
+        # order even when a retracted kind later re-arrives)
+        surviving = set(r["ranks"].tolist())
+        for rec in r["rounds"][-k:]:
+            for kid in rec["new_kids"]:
+                if kid not in surviving:
+                    rep = r["kind_reps"].pop(kid, None)
+                    if rep is not None:
+                        r["kind_sig_to_kid"].pop(self.sched._kind_sig(rep), None)
+                    r["kind_reqs_c"].pop(kid, None)
+                    r["kind_total_c"].pop(kid, None)
+                    r["kind_ports_c"].pop(kid, None)
+        del r["rounds"][-k:]
+        r["hostname_seq"] = len(claims)
+        r["n_open"] = cut
+
+    def _append(self, delta: dict) -> None:
+        """Encode ONLY the arrival kinds (cache-assembled rows), run ONE
+        fill dispatch against the resident state, and extend the session
+        bookkeeping through the shared fill decode."""
+        from types import SimpleNamespace
+
+        from karpenter_tpu.ops import topology as topo_ops_mod
+        from karpenter_tpu.ops.kernels import fetch_tree
+
+        r = self._r
+        sched = self.sched
+        enc = r["enc"]
+        state = r["state"]
+        n_claims = r["n_claims"]
+        E = enc["E"]
+        arrivals_sorted = delta["arrivals_sorted"]
+        seg_list = delta["seg_list"]
+        bundles = delta["bundles"]
+        local_of_kid = delta["local_of_kid"]
+
+        # register arrival kinds up front — the decode's kind memos index
+        # them; a later abort (delta_leftover) drops the whole resident,
+        # registry included, so early registration cannot leak
+        new_kids: list = []
+        for kid, i_local in delta["local_of_kid"].items():
+            if kid not in r["kind_reps"]:
+                rep = delta["local_reps"][i_local]
+                r["kind_reps"][kid] = rep
+                r["kind_sig_to_kid"][sched._kind_sig(rep)] = kid
+                new_kids.append(kid)
+        r["next_kid"] = max(r["next_kid"], max(r["kind_reps"]) + 1)
+
+        reqs_k, strict_k, requests_k, it_allow_k, tol_k = sched._stack_bundles(
+            bundles
+        )
+        exist_ok_k = sched._exist_ok_rows(
+            delta["local_reps"], delta["rep_req_sets"], r["exist_pristine"], E
+        )
+        # arrival kinds carry no host ports / CSI volumes (gated), so the
+        # packed bitsets are inert rows at the resident lane widths
+        M = len(bundles)
+        ports_k = np.zeros((M, int(state.claim_ports.shape[1])), dtype=np.uint32)
+        vols_k = np.zeros((M, int(state.exist_vols.shape[1])), dtype=np.uint32)
+        pod_topo_k, _pod_topo_host = topo_ops_mod.encode_pod_topology(
+            Topology(), [], [], delta["local_reps"], strict_k
+        )
+        B = len(seg_list)
+        B_pad = sched._pad_cache.pad(
+            "fill_segments", B, step=(8 if B <= 32 else 32)
+        )
+        kind_ids = np.zeros(B_pad, dtype=np.int64)
+        counts = np.zeros(B_pad, dtype=np.int32)
+        for j, (lo, hi, kid) in enumerate(seg_list):
+            kind_ids[j] = local_of_kid[kid]
+            counts[j] = hi - lo
+        xs = _gather_fill_xs(
+            reqs_k,
+            jnp.asarray(requests_k, dtype=jnp.float32),
+            jnp.asarray(tol_k),
+            jnp.asarray(it_allow_k),
+            jnp.asarray(exist_ok_k),
+            jnp.asarray(ports_k),
+            jnp.asarray(ports_k),
+            jnp.asarray(vols_k),
+            pod_topo_k,
+            jnp.asarray(kind_ids),
+            jnp.asarray(counts),
+        )
+        state, ys = ops_solver.solve_fill(
+            state,
+            xs,
+            enc["exist_tensors"],
+            sched.it_tensors,
+            enc["template_tensors"],
+            sched.well_known,
+            enc["topo_tensors"],
+            zone_kid=enc["zone_kid"],
+            ct_kid=enc["ct_kid"],
+            n_claims=n_claims,
+        )
+        (
+            fill_c,
+            fill_e,
+            open_start,
+            n_opened,
+            tmpl_arr,
+            leftover,
+            status,
+            slot_map,
+            n_open_new,
+        ) = fetch_tree(
+            [
+                ys.fill_c,
+                ys.fill_e,
+                ys.open_start,
+                ys.n_opened,
+                ys.tmpl,
+                ys.leftover,
+                ys.status,
+                state.slot_of,
+                state.n_open,
+            ]
+        )
+        if int(np.asarray(leftover)[:B].sum()) > 0:
+            # an arrival failed (NO_ROOM, window spill, or genuinely
+            # unschedulable): the cold path owns relaxation/escalation.
+            # State was mutated, but the full re-solve rebuilds from
+            # scratch, so dropping the resident is safe.
+            self._r = None
+            raise _DeltaUnsafe("full", "delta_leftover")
+        slot_map_np = np.asarray(slot_map, dtype=np.int64)
+        fill_c = np.asarray(fill_c)[:B]
+        fill_e = np.asarray(fill_e)[:B]
+        open_start = np.asarray(open_start)
+        n_opened = np.asarray(n_opened)
+        tmpl_arr = np.asarray(tmpl_arr)
+        claim_template_map: dict[int, int] = {}
+        for j in range(B):
+            for w in range(int(open_start[j]), int(open_start[j]) + int(n_opened[j])):
+                claim_template_map[int(slot_map_np[w])] = int(tmpl_arr[j])
+
+        def ensure_claim(slot: int) -> SimClaim:
+            claim = r["slot_to_claim"].get(slot)
+            if claim is None:
+                tmpl = sched.templates[claim_template_map[slot]]
+                r["hostname_seq"] += 1
+                hostname = hostname_placeholder(r["hostname_seq"])
+                requirements = tmpl.requirements.copy()
+                requirements.add(
+                    Requirement.new(l.LABEL_HOSTNAME, Operator.IN, hostname)
+                )
+                claim = SimClaim(
+                    template=tmpl,
+                    requirements=requirements,
+                    used={},
+                    instance_types=[],
+                    pods=[],
+                    slot=slot,
+                    hostname=hostname,
+                )
+                r["slot_to_claim"][slot] = claim
+                r["claims"].append(claim)
+                r["claim_kinds"][slot] = {}
+            return claim
+
+        round_unsched: list = []
+        ctx = SimpleNamespace(
+            E=E,
+            NC1=np.int64(n_claims + 1),
+            existing_nodes=r["existing_nodes"],
+            pods_sorted=arrivals_sorted,
+            ensure_claim=ensure_claim,
+            slot_to_claim=r["slot_to_claim"],
+            claim_kinds=r["claim_kinds"],
+            claim_pod_counts=r["claim_pod_counts"],
+            assignments=r["assignments"],
+            existing_assignments=r["existing_assignments"],
+            unschedulable=round_unsched,
+            node_kinds=r["node_kinds"],
+            kind_ports=self._kind_ports,
+            kind_total=self._kind_total,
+        )
+        f = {
+            "fill_c": fill_c,
+            "fill_e": fill_e,
+            "open_start": open_start,
+            "n_opened": n_opened,
+            "status": np.asarray(status),
+            "slot_map": slot_map_np,
+        }
+        _decode_fill_segments(ctx, seg_list, f)
+        assert not round_unsched, "leftover check missed a failure"
+        # existing-node requirement intersections for kinds that landed
+        # tier-1 this round (idempotent adds, like the cold finalization)
+        if fill_e.any():
+            for j, (lo, hi, kid) in enumerate(seg_list):
+                for e in np.flatnonzero(fill_e[j]).tolist():
+                    r["existing_nodes"][e].requirements.add(
+                        *self._kind_reqs(kid).values()
+                    )
+        # ---- refresh the touched claims' device-carried columns ----------
+        js, ss = np.nonzero(fill_c)
+        pre_n_open = r["n_open"]
+        rows = sorted(
+            {int(s) for s in ss}
+            | {
+                w
+                for j in range(B)
+                for w in range(
+                    int(open_start[j]), int(open_start[j]) + int(n_opened[j])
+                )
+            }
+        )
+        if rows:
+            rows_np = np.asarray(rows, dtype=np.int64)
+            u_rows, i_rows = fetch_tree(
+                [state.used[rows_np], state.its[rows_np]]
+            )
+            self._finalize_touched(
+                [int(slot_map_np[w]) for w in rows],
+                np.asarray(u_rows),
+                np.asarray(i_rows),
+            )
+        # ---- commit session bookkeeping ----------------------------------
+        pure = not bool(fill_e.any()) and all(
+            int(slot_map_np[s]) >= pre_n_open for s in ss
+        )
+        start_idx = len(r["order"])
+        r["order"].extend(p.uid for p in arrivals_sorted)
+        r["pod_by_uid"].update({p.uid: p for p in arrivals_sorted})
+        r["ranks"] = np.concatenate([r["ranks"], delta["kids_sorted"]])
+        r["sizes"] = np.concatenate([r["sizes"], delta["sizes_sorted"]])
+        r["rounds"].append(
+            dict(
+                uids={p.uid for p in arrivals_sorted},
+                start_idx=start_idx,
+                n_open_start=pre_n_open,
+                pure=pure,
+                new_kids=new_kids,
+            )
+        )
+        r["n_open"] = int(n_open_new)
+        r["state"] = state
+
+    def _finalize_touched(self, touched_slots, used_rows, its_rows) -> None:
+        """Rebuild used / viable instance types / requirements for claims
+        the delta touched, from the device carry — the cold finalization's
+        memoized per-(template, kind-set) pattern, minus the topology
+        narrowing fold (sessions are topology-free)."""
+        from karpenter_tpu.controllers.provisioning.host_scheduler import (
+            finalize_reserved,
+        )
+
+        r = self._r
+        rids = self.sched.encoder._resource_ids
+        for slot, urow, irow in zip(touched_slots, used_rows, its_rows):
+            claim = r["slot_to_claim"][slot]
+            kinds = r["claim_kinds"][slot]
+            ksig = tuple(sorted(kinds))
+            tid = id(claim.template)
+            memo = r["proto_cache"].get((tid, ksig))
+            if memo is None:
+                proto = claim.template.requirements.copy()
+                names = set(claim.template.daemon_requests)
+                for k in ksig:
+                    proto.add(*self._kind_reqs(k).values())
+                    names.update(self._kind_total(k))
+                names = sorted(names)
+                ridx = np.array([rids[n] for n in names], dtype=np.int64)
+                memo = r["proto_cache"][(tid, ksig)] = (proto, names, ridx)
+            proto, names, ridx = memo
+            reqs = proto.copy()
+            reqs.add(
+                Requirement.new(l.LABEL_HOSTNAME, Operator.IN, claim.hostname)
+            )
+            claim.requirements = reqs
+            vec = np.asarray(urow)[ridx]
+            claim.used = dict(zip(names, vec.tolist()))
+            row = np.asarray(irow)
+            ikey = (tid, row.tobytes())
+            sel_list = r["its_cache"].get(ikey)
+            if sel_list is None:
+                t_its, t_cat_idx = self.sched._template_it_index(claim.template)
+                sel = np.flatnonzero(row[t_cat_idx])
+                sel_list = r["its_cache"][ikey] = [t_its[i] for i in sel.tolist()]
+            claim.instance_types = list(sel_list)
+            finalize_reserved(claim)
+
+
+def plan_sorted(plan: dict) -> list:
+    """The plan's kind entries in first-appearance (kid) order — the
+    local tensor axis the delta dispatch gathers from."""
+    return sorted(plan["plan_kinds"], key=lambda t: t[1])

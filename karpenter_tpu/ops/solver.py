@@ -807,6 +807,58 @@ def compact_state(
 
 
 @jax.jit
+def retract_tail(state: SolverState, cut: jnp.ndarray) -> SolverState:
+    """Undo every claim with global id >= `cut`: the resident-session
+    retract kernel (ISSUE 7). Closes the matching window rows (unused
+    rows carry the NB sentinel, so they stay closed), clears the matching
+    frozen-bank rows, stable-compacts survivors to the front, and rolls
+    n_open back to `cut`.
+
+    Soundness contract (enforced HOST-side by ResidentSession before
+    dispatching): the retracted claims form an exact open-order suffix,
+    hold only the departed pods, and the session is free of topology
+    groups, finite budgets, and reservations — so no cross-claim
+    accumulator (vg/hg counts, budget, res_cap) carries their imprint.
+    Under those conditions the post-retract state is exactly the state a
+    cold solve of the surviving pods (in session order) produces, up to
+    the w_hw/spills heuristics, which never influence placement."""
+    NB = state.bank_frozen.shape[0]
+    W = state.open.shape[0]
+    K = state.reqs.mask.shape[1]
+    V = state.reqs.mask.shape[2]
+    cut = jnp.asarray(cut, dtype=jnp.int32)
+    alive = state.open & (state.slot_of < cut)
+    bkeep = jnp.arange(NB, dtype=jnp.int32) < cut
+    perm = jnp.argsort(~alive, stable=True)
+    alive_p = alive[perm]
+    ident = identity_reqs(W, K, V)
+    reqs2 = kernels.select_set(alive_p, kernels.take_set(state.reqs, perm), ident)
+    return state._replace(
+        reqs=reqs2,
+        used=jnp.where(alive_p[:, None], state.used[perm], 0.0),
+        its=jnp.where(alive_p[:, None], state.its[perm], False),
+        template=jnp.where(alive_p, state.template[perm], 0),
+        open=alive_p,
+        pods=jnp.where(alive_p, state.pods[perm], 0),
+        n_open=cut,
+        slot_of=jnp.where(alive_p, state.slot_of[perm], NB),
+        w_open=jnp.sum(alive_p).astype(jnp.int32),
+        claim_ports=jnp.where(
+            alive_p[:, None], state.claim_ports[perm], jnp.uint32(0)
+        ),
+        held=jnp.where(alive_p[:, None], state.held[perm], False),
+        bank_frozen=state.bank_frozen & bkeep,
+        bank_template=jnp.where(bkeep, state.bank_template, 0),
+        bank_its=jnp.where(bkeep[:, None], state.bank_its, False),
+        bank_used=jnp.where(bkeep[:, None], state.bank_used, 0.0),
+        bank_held=jnp.where(bkeep[:, None], state.bank_held, False),
+        bank_tk_mask=jnp.where(bkeep[:, None, None], state.bank_tk_mask, False),
+        bank_tk_inf=jnp.where(bkeep[:, None], state.bank_tk_inf, False),
+        bank_tk_def=jnp.where(bkeep[:, None], state.bank_tk_def, False),
+    )
+
+
+@jax.jit
 def global_template(state: SolverState) -> jnp.ndarray:
     """[NCAP] i32 — the global template column alone (the pipelined
     decode's per-dispatch snapshot; a claim's template is fixed at open,
@@ -2017,7 +2069,8 @@ def _make_kind_step(
     no_wk = jnp.zeros_like(well_known)
     i32 = jnp.int32
 
-    def seg_step(state: SolverState, xs: KindXs):
+    def seg_step(carry, xs: KindXs):
+        state, grid_prev, grid_req, grid_valid = carry
         W = state.open.shape[0]
         count = xs.count
         requests = xs.requests
@@ -2037,7 +2090,25 @@ def _make_kind_step(
         static_n0 = claim_ok & tol & ports_ok_n
         ct_n = comb.mask[:, ct_kid, :]
         zfull_n = comb.mask[:, zone_kid, :]
-        grid_n = _cap_res_grid(state.used, requests, it)  # [W, T, GR]
+        # ---- incremental capacity grid (STATUS Known-gaps lever) ----------
+        # The [W, T, GR] grid depends only on (state.used, requests). When
+        # consecutive segments carry bit-identical request vectors the
+        # previous segment's boundary-adjusted grid (each landed row's
+        # cells already debited by its pod count, fresh rows seeded from
+        # the template grid) IS this segment's grid, so the full-width
+        # divide-and-verify recompute is skipped via lax.cond. The debit
+        # convention (cap' = cap - landed) is exact whenever quantities
+        # are f32-product-exact — the same caveat the batch-placement
+        # multiply-add convention already carries (module comment); the
+        # kind scan already compares grid-at-segment-start against landed
+        # counters within a segment, so this extends an existing
+        # convention across same-request boundaries, not a new one.
+        grid_reused = grid_valid & jnp.all(requests == grid_req)
+        grid_n = jax.lax.cond(
+            grid_reused,
+            lambda: grid_prev,
+            lambda: _cap_res_grid(state.used, requests, it),
+        )  # [W, T, GR]
         capd_n0 = _kscan_capd(
             grid_n, viable0, ct_n, zfull_n, it, key_kid, zone_kid, D
         )
@@ -2421,9 +2492,25 @@ def _make_kind_step(
 
         new_vg = state.vg_counts.at[:, :D].set(carry["cnt"])
 
-        ys = KindYs(assignment=assignment.astype(jnp.int32))
+        # boundary grid update: debit landed rows by their pod counts
+        # (fresh rows re-base on the template grid) instead of recomputing
+        # the full [W, T, GR] divide-and-verify next segment when the
+        # request vector repeats
+        grid_base = jnp.where(
+            opened_here[:, None, None], grid_g[tmpl_n], grid_n
+        )
+        grid_next = jnp.where(
+            landed_n[:, None, None],
+            jnp.maximum(grid_base - pl_n[:, None, None], 0),
+            grid_n,
+        )
+
+        ys = KindYs(
+            assignment=assignment.astype(jnp.int32),
+            grid_reused=grid_reused,
+        )
         return (
-            state._replace(
+            (state._replace(
                 exist_reqs=new_ereqs,
                 exist_used=new_exist_used,
                 reqs=new_reqs,
@@ -2443,7 +2530,7 @@ def _make_kind_step(
                 exist_ports=new_eports,
                 claim_ports=new_ports,
                 exist_vols=new_evols,
-            ),
+            ), grid_next, requests, jnp.bool_(True)),
             ys,
         )
 
@@ -2455,6 +2542,10 @@ class KindYs(NamedTuple):
     (existing < E, claims E+slot) or NO_ROOM / NO_CLAIM."""
 
     assignment: jnp.ndarray  # [MAXC] i32
+    # whether this segment reused the previous segment's boundary-adjusted
+    # capacity grid instead of the full-width recompute (metrics:
+    # ktpu_kscan_grid_updates_total{mode})
+    grid_reused: jnp.ndarray  # [] bool
 
 
 def kernels_select_bool(cond, a, b):
@@ -2483,9 +2574,21 @@ def solve_kind_scan(
 ) -> tuple[SolverState, KindYs]:
     """Scan same-kind batched placement for vocab-key topology kinds over B
     segments, threading the same SolverState as the fill and per-pod scans
-    (the host interleaves all three dispatches freely)."""
+    (the host interleaves all three dispatches freely). The scan carry
+    additionally threads the boundary-adjusted [W, T, GR] capacity grid so
+    same-request segments skip the full-width recompute (grid_valid starts
+    False: the first segment always computes fresh)."""
     step = _make_kind_step(
         exist, it, templates, well_known, topo, zone_kid, ct_kid,
         n_claims, key_kid, n_domains, maxc,
     )
-    return jax.lax.scan(step, state, xs)
+    W = state.open.shape[0]
+    T, GR, R = it.alloc.shape
+    carry0 = (
+        state,
+        jnp.zeros((W, T, GR), dtype=jnp.int32),
+        jnp.zeros((R,), dtype=jnp.float32),
+        jnp.bool_(False),
+    )
+    (state, _grid, _req, _valid), ys = jax.lax.scan(step, carry0, xs)
+    return state, ys

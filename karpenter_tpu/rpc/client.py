@@ -46,6 +46,7 @@ from karpenter_tpu.rpc.retry import (
 )
 from karpenter_tpu.rpc.service import (
     FRAME_CHUNK,
+    FRAME_CHUNK_COL,
     FRAME_FINAL_FULL,
     FRAME_RESET,
     SERVICE_NAME,
@@ -139,7 +140,7 @@ class StreamStitcher:
             self.claims.clear()
             self.exist.clear()
             self.unsched.clear()
-        elif tag == FRAME_CHUNK:
+        elif tag in (FRAME_CHUNK, FRAME_CHUNK_COL):
             round_no = int.from_bytes(frame[1:5], "big")
             if round_no != self.round:
                 self.n_stale += 1
@@ -148,13 +149,24 @@ class StreamStitcher:
                 STREAM_STALE_FRAMES.inc()
                 return False
             self.n_chunks += 1
-            part = pb.SolveResponse.FromString(bytes(frame[5:]))
-            for m in part.claims:
-                self.claims.setdefault(m.slot, []).extend(m.pod_uids)
-            for a in part.existing_assignments:
-                self.exist.append((a.pod_uid, a.node_name))
-            for u in part.unschedulable:
-                self.unsched.append((u.pod_uid, u.reason))
+            if tag == FRAME_CHUNK_COL:
+                # zero-copy chunk tables: int32 column views + one string
+                # blob instead of a per-chunk protobuf parse
+                from karpenter_tpu.rpc.codec import decode_chunk_columnar
+
+                part = decode_chunk_columnar(bytes(frame[5:]))
+                for slot, uids in part["claims"]:
+                    self.claims.setdefault(slot, []).extend(uids)
+                self.exist.extend(part["existing"])
+                self.unsched.extend(part["unsched"])
+            else:
+                part = pb.SolveResponse.FromString(bytes(frame[5:]))
+                for m in part.claims:
+                    self.claims.setdefault(m.slot, []).extend(m.pod_uids)
+                for a in part.existing_assignments:
+                    self.exist.append((a.pod_uid, a.node_name))
+                for u in part.unschedulable:
+                    self.unsched.append((u.pod_uid, u.reason))
         else:  # FINAL_SLIM / FINAL_FULL
             self.final = pb.SolveResponse.FromString(bytes(frame[1:]))
             self.full = tag == FRAME_FINAL_FULL
@@ -254,6 +266,18 @@ class RemoteScheduler:
         self._endpoint = endpoint or "in-process"
         self._breaker = _breaker_for(self._endpoint)
         self._backoff = Backoff(base_s=RETRY_BASE_SECONDS, cap_s=RETRY_CAP_SECONDS)
+        # resident-session affinity (ISSUE 7): one session id per client
+        # scheduler instance, sent as metadata on every Solve so the
+        # server reuses its on-device resident SolverState across rounds.
+        # Stateless downgrade is structural: old servers ignore unknown
+        # metadata, and KTPU_RESIDENT=0 suppresses it entirely.
+        import uuid
+
+        self._session_id = (
+            uuid.uuid4().hex
+            if os.environ.get("KTPU_RESIDENT", "1") not in ("0", "false")
+            else None
+        )
         req = pb.ConfigureRequest(
             templates_json=encode_templates(templates),
             reserved_mode=reserved_mode,
@@ -295,12 +319,15 @@ class RemoteScheduler:
         stitcher = StreamStitcher()
         with TRACER.span("rpc.SolveStream"):
             kwargs: dict = {"timeout": rpc_timeout}
+            md = list(self._session_md())
             ctx = TRACER.context()
             if ctx is not None:
-                kwargs["metadata"] = [
+                md += [
                     ("ktpu-trace-id", ctx[0]),
                     ("ktpu-span-id", ctx[1]),
                 ]
+            if md:
+                kwargs["metadata"] = md
             with SOLVER_RPC_DURATION.time(method="SolveStream"):
                 for frame in self._solve_stream(req, **kwargs):
                     # the mid-stream cut point: an injected UNAVAILABLE
@@ -314,6 +341,17 @@ class RemoteScheduler:
         if stitcher.full:
             return stitcher.final, None
         return stitcher.final, stitcher.tables()
+
+    def _session_md(self) -> list:
+        if self._session_id is None:
+            return []
+        return [("ktpu-session-id", self._session_id)]
+
+    def _unary_solve(self, req, rpc_timeout: float):
+        md = self._session_md()
+        return self._solve(
+            req, timeout=rpc_timeout, metadata=(md or None)
+        )
 
     def _transport_solve(self, req, rpc_timeout: float):
         """One hardened Solve crossing: stream-first with mid-stream
@@ -348,9 +386,9 @@ class RemoteScheduler:
                         # older server without the SolveStream handler:
                         # permanent downgrade to the unary path
                         self._stream_ok = False
-                        out = self._solve(req, timeout=rpc_timeout), None
+                        out = self._unary_solve(req, rpc_timeout), None
                 else:
-                    out = self._solve(req, timeout=rpc_timeout), None
+                    out = self._unary_solve(req, rpc_timeout), None
                 self._breaker.record_success()
                 if stream_failures:
                     STREAM_RECOVERIES.inc(

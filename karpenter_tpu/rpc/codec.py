@@ -238,3 +238,129 @@ def decode_templates(data: bytes) -> list[ClaimTemplate]:
             )
         )
     return out
+
+
+# -- SolveStream columnar chunk tables (ISSUE 7 satellite) -------------------
+#
+# The legacy chunk frame re-encodes each decoded chunk group's per-pod
+# tables as a partial SolveResponse protobuf, which the client walks
+# per-field in Python. The columnar layout flattens the same three tables
+# (claim fragments, existing assignments, unschedulable entries) into
+# little-endian int32 column arrays plus one UTF-8 string blob, so the
+# client rebuilds them from numpy views over the frame buffer — one
+# np.frombuffer per column instead of a protobuf parse + per-message
+# Python loops. KTPU_RPC_COLUMNAR=0 keeps the server on the legacy frame
+# for one release (old clients cannot decode the new tag).
+#
+# Layout (all u32/i32 little-endian):
+#   header: n_claim_groups, n_claim_uids, n_exist, n_unsched, blob_len
+#   i32[n_claim_groups]  claim slot per group
+#   i32[n_claim_groups]  uid count per group
+#   i32[n_claim_uids]    uid byte length (claim uids, group order)
+#   i32[n_exist]         uid byte length      (existing pairs)
+#   i32[n_exist]         node-name byte length
+#   i32[n_unsched]       uid byte length      (unschedulable pairs)
+#   i32[n_unsched]       reason byte length
+#   u8[blob_len]         all strings, concatenated in the order above
+#     (claim uids, then per-pair uid+node, then per-pair uid+reason)
+
+
+def encode_chunk_columnar(delta: dict) -> bytes:
+    import numpy as np
+
+    claims = delta["claims"]
+    exist = delta["existing"]
+    unsched = delta["unsched"]
+    slots = np.asarray([slot for slot, _uids in claims], dtype="<i4")
+    counts = np.asarray([len(uids) for _slot, uids in claims], dtype="<i4")
+    blob_parts: list[bytes] = []
+    claim_uid_lens: list[int] = []
+    for _slot, uids in claims:
+        for u in uids:
+            b = u.encode("utf-8")
+            claim_uid_lens.append(len(b))
+            blob_parts.append(b)
+    exist_lens: list[int] = []
+    node_lens: list[int] = []
+    for uid, node in exist:
+        bu, bn = uid.encode("utf-8"), node.encode("utf-8")
+        exist_lens.append(len(bu))
+        node_lens.append(len(bn))
+        blob_parts.append(bu)
+        blob_parts.append(bn)
+    uns_lens: list[int] = []
+    reason_lens: list[int] = []
+    for uid, reason in unsched:
+        bu, br = uid.encode("utf-8"), reason.encode("utf-8")
+        uns_lens.append(len(bu))
+        reason_lens.append(len(br))
+        blob_parts.append(bu)
+        blob_parts.append(br)
+    blob = b"".join(blob_parts)
+    header = np.asarray(
+        [len(claims), len(claim_uid_lens), len(exist), len(unsched), len(blob)],
+        dtype="<u4",
+    )
+    return b"".join(
+        [
+            header.tobytes(),
+            slots.tobytes(),
+            counts.tobytes(),
+            np.asarray(claim_uid_lens, dtype="<i4").tobytes(),
+            np.asarray(exist_lens, dtype="<i4").tobytes(),
+            np.asarray(node_lens, dtype="<i4").tobytes(),
+            np.asarray(uns_lens, dtype="<i4").tobytes(),
+            np.asarray(reason_lens, dtype="<i4").tobytes(),
+            blob,
+        ]
+    )
+
+
+def decode_chunk_columnar(buf: bytes) -> dict:
+    """Inverse of encode_chunk_columnar: numpy views over the frame buffer
+    rebuild the chunk tables (strings materialize once, from one blob)."""
+    import numpy as np
+
+    buf = memoryview(buf)
+    n_groups, n_uids, n_exist, n_unsched, blob_len = np.frombuffer(
+        buf[:20], dtype="<u4"
+    ).tolist()
+    off = 20
+
+    def col(n: int):
+        nonlocal off
+        out = np.frombuffer(buf[off : off + 4 * n], dtype="<i4")
+        off += 4 * n
+        return out
+
+    slots = col(n_groups)
+    counts = col(n_groups)
+    claim_uid_lens = col(n_uids)
+    exist_lens = col(n_exist)
+    node_lens = col(n_exist)
+    uns_lens = col(n_unsched)
+    reason_lens = col(n_unsched)
+    blob = bytes(buf[off : off + blob_len])
+    pos = 0
+
+    def take(n: int) -> str:
+        nonlocal pos
+        out = blob[pos : pos + n].decode("utf-8")
+        pos += n
+        return out
+
+    claims: list[tuple[int, list[str]]] = []
+    li = 0
+    for g in range(n_groups):
+        c = int(counts[g])
+        claims.append(
+            (int(slots[g]), [take(int(claim_uid_lens[li + j])) for j in range(c)])
+        )
+        li += c
+    existing = [
+        (take(int(exist_lens[i])), take(int(node_lens[i]))) for i in range(n_exist)
+    ]
+    unsched = [
+        (take(int(uns_lens[i])), take(int(reason_lens[i]))) for i in range(n_unsched)
+    ]
+    return {"claims": claims, "existing": existing, "unsched": unsched}

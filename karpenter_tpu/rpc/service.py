@@ -40,6 +40,16 @@ FRAME_CHUNK = b"\x01"  # round + partial per-pod tables from one chunk group
 FRAME_FINAL_SLIM = b"\x02"  # final response MINUS the already-streamed tables
 FRAME_RESET = b"\x03"  # round; a relaxation round/fallback invalidated chunks
 FRAME_FINAL_FULL = b"\x04"  # complete response (nothing was streamed)
+# zero-copy chunk tables (ISSUE 7 satellite): round + flat columnar
+# layout (rpc/codec.encode_chunk_columnar) instead of a per-chunk partial
+# SolveResponse — the client rebuilds the tables from numpy views over
+# the frame buffer. KTPU_RPC_COLUMNAR=0 keeps the server on FRAME_CHUNK
+# for one release (clients always decode both tags).
+FRAME_CHUNK_COL = b"\x05"
+
+
+def columnar_enabled() -> bool:
+    return os.environ.get("KTPU_RPC_COLUMNAR", "1") not in ("0", "false")
 
 
 def _round_bytes(round_no: int) -> bytes:
@@ -79,6 +89,36 @@ class SolverService:
         self._solve_lock = threading.Lock()
         self._scheduler = None
         self._version = 0
+        # server-side resident sessions (ISSUE 7), keyed by the client's
+        # ktpu-session-id metadata: remote Solve reuses the on-device
+        # SolverState across rounds. Stateless downgrade is structural —
+        # no metadata (old client) or KTPU_RESIDENT=0 routes straight to
+        # the scheduler, and a session falls back to a bit-identical full
+        # solve for anything it cannot prove delta-safe.
+        self._sessions: dict = {}
+
+    def _session_for(self, context, sched):
+        from karpenter_tpu.controllers.provisioning.scheduler import (
+            ResidentSession,
+            resident_enabled,
+        )
+
+        if not resident_enabled():
+            return None
+        md = dict(context.invocation_metadata() or ())
+        sid = md.get("ktpu-session-id")
+        if not sid:
+            return None
+        with self._lock:
+            session = self._sessions.get(sid)
+            if session is None or session.sched is not sched:
+                session = ResidentSession(sched)
+                self._sessions[sid] = session
+                while len(self._sessions) > 8:
+                    # bounded registry: evict the oldest session (its next
+                    # round simply re-solves cold and re-adopts)
+                    self._sessions.pop(next(iter(self._sessions)))
+        return session
 
     @staticmethod
     def _server_span(name: str, context):
@@ -119,6 +159,8 @@ class SolverService:
             self._version += 1
             self._scheduler = sched
             version = self._version
+            # resident sessions are bound to a scheduler generation
+            self._sessions.clear()
         return pb.ConfigureResponse(config_version=version)
 
     def Solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
@@ -154,6 +196,8 @@ class SolverService:
         round_no = [0]  # bumps with every EMITTED reset frame
         _DONE = object()
 
+        columnar = columnar_enabled()
+
         def sink(event) -> None:
             kind, delta = event
             if kind == "reset":
@@ -161,6 +205,15 @@ class SolverService:
                     round_no[0] += 1
                     frames.put(FRAME_RESET + _round_bytes(round_no[0]))
                 streamed[0] = False
+            elif columnar:
+                from karpenter_tpu.rpc.codec import encode_chunk_columnar
+
+                streamed[0] = True
+                frames.put(
+                    FRAME_CHUNK_COL
+                    + _round_bytes(round_no[0])
+                    + encode_chunk_columnar(delta)
+                )
             else:
                 streamed[0] = True
                 frames.put(
@@ -172,11 +225,13 @@ class SolverService:
         # the solve runs in a worker so the handler thread can yield chunk
         # frames while the decode is still producing later ones
         args, kwargs = self._solve_args(request, sched)
+        session = self._session_for(context, sched)
+        engine = session if session is not None else sched
 
         def run() -> None:
             try:
                 with self._solve_lock:
-                    result = sched.solve(*args, chunk_sink=sink, **kwargs)
+                    result = engine.solve(*args, chunk_sink=sink, **kwargs)
                 resp = self._result_pb(sched, result)
                 if streamed[0]:
                     # the streamed chunks already carried the per-pod
@@ -272,8 +327,10 @@ class SolverService:
     def _solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
         sched = self._checked_scheduler(request, context)
         args, kwargs = self._solve_args(request, sched)
+        session = self._session_for(context, sched)
+        engine = session if session is not None else sched
         with self._solve_lock:
-            result = sched.solve(*args, **kwargs)
+            result = engine.solve(*args, **kwargs)
         return self._result_pb(sched, result)
 
     @staticmethod

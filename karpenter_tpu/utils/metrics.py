@@ -412,6 +412,32 @@ ENCODE_CACHE_HITS = REGISTRY.counter(
     "Pod-kind encode rows served from the incremental encode cache"
     " instead of re-encoding (KTPU_ENCODE_CACHE)",
 )
+# ---- resident incremental solver (PR 7) ----
+RESIDENT_ROUNDS = REGISTRY.counter(
+    "ktpu_resident_rounds_total",
+    "Resident-session solve rounds by outcome: delta (arrivals/retractions"
+    " applied against the on-device resident SolverState), full (cold"
+    " re-solve — no resident state, unsupported constraint family, or a"
+    " delta the session cannot prove bit-identical), invalidated (the"
+    " cluster-shape epoch changed: catalog/templates/pads/vocab/existing"
+    " nodes)",
+    ("mode",),
+)
+RESIDENT_DELTA_PODS = REGISTRY.histogram(
+    "ktpu_resident_delta_pods",
+    "Pods in each resident delta round (arrivals encoded plus departures"
+    " retracted) — steady-state churn should keep this small relative to"
+    " the resident set",
+    buckets=_COUNT_BUCKETS,
+)
+KSCAN_GRID_UPDATES = REGISTRY.counter(
+    "ktpu_kscan_grid_updates_total",
+    "Kind-scan capacity-grid updates per segment boundary: incremental"
+    " (previous segment's boundary-adjusted [W, T, GR] grid reused —"
+    " same request vector) vs full (the full-width divide-and-verify"
+    " recompute)",
+    ("mode",),
+)
 # ---- gang-aware multi-host slice scheduling (gang/, PR 6) ----
 GANG_PLACEMENTS = REGISTRY.counter(
     "ktpu_gang_placements_total",

@@ -62,11 +62,26 @@ gres = sched.solve(gang_pods)
 assert not gres.unschedulable
 gang_claims = sum(1 for c in gres.claims if c.gang)
 assert gang_claims >= 1, "the gang solve never opened a slice claim"
+# a warm resident delta round (ISSUE 7): the session's append path
+# compiles its own executables (fill dispatch at the delta shapes, the
+# gather preps, retract_tail) — run it in BOTH children so cache-key
+# stability covers the resident/incremental path too
+session = sched.resident_session()
+sres = session.solve(list(pods))
+assert session._r is not None, "session did not go resident"
+delta = [make_pod(f"rd-{i}", cpu=0.5) for i in range(8)]
+dres = session.solve(pods + delta)
+assert session.last_mode == "delta", session.last_reason
+assert not dres.unschedulable
+rres = session.solve(list(pods))  # retract the delta batch
+assert session.last_mode == "delta", session.last_reason
+assert len(rres.claims) == len(sres.claims)
 print(json.dumps({
     "cold_s": cold_s,
     "cache_hits": hits[0],
     "claims": len(result.claims),
     "gang_claims": gang_claims,
+    "delta_claims": len(dres.claims),
     "window": scan.get("window"),
 }))
 """
@@ -130,6 +145,7 @@ def test_restart_skips_cold_compile(tmp_path):
     after = _cache_entries(cache_dir)
     assert second["claims"] == first["claims"]
     assert second["gang_claims"] == first["gang_claims"]
+    assert second["delta_claims"] == first["delta_claims"]
     assert second["window"] == first["window"], (
         "the pinned scan window must size identically across restarts "
         f"({first['window']} vs {second['window']})"

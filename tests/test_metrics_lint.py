@@ -144,6 +144,26 @@ def test_scan_window_and_encode_cache_families_are_registered():
         assert fam.help.strip()
 
 
+def test_resident_solver_families_are_registered():
+    """ISSUE-7 families: resident-session round modes, per-delta pod-count
+    histogram, and the kind-scan capacity-grid update counter, with the
+    documented types and labels."""
+    from karpenter_tpu.utils.metrics import Counter
+
+    fams = {f.name: f for f in _families()}
+    expected = {
+        "ktpu_resident_rounds_total": (Counter, ("mode",)),
+        "ktpu_resident_delta_pods": (Histogram, ()),
+        "ktpu_kscan_grid_updates_total": (Counter, ("mode",)),
+    }
+    for name, (cls, labels) in expected.items():
+        fam = fams.get(name)
+        assert fam is not None, f"{name} not registered"
+        assert isinstance(fam, cls), (name, type(fam).__name__)
+        assert fam.label_names == labels, (name, fam.label_names)
+        assert fam.help.strip()
+
+
 def test_counters_end_in_total_and_histograms_in_seconds_or_pods():
     """Unit-suffix discipline for NEW families (grandfathered names keep
     their reference spellings verbatim)."""

@@ -1,0 +1,374 @@
+"""Differential parity for the resident incremental solver (ISSUE 7).
+
+A ResidentSession keeps SolverState on device across solve() calls and
+feeds only the pod delta through the pipeline: arrivals append via the
+scan-prefix property, suffix departures retract via the retract_tail
+kernel. None of that may move a single pod: every round that stays on the
+delta path must be BIT-identical to a cold full re-solve of the current
+pod set in session (arrival) order AND to the host oracle — across
+windowed/un-windowed resident states and pipeline chunking at K in
+{1, 2, 4}. Rounds the session cannot prove delta-safe (departure of a
+base pod, vocab growth, an arrival below the eviction floor, a failing
+arrival) must fall back to a full re-solve — still bit-identical, just
+counted under a different mode.
+
+Everything here is host-only and sized for tier-1.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.controllers.provisioning import (
+    HostScheduler,
+    TPUScheduler,
+    build_templates,
+)
+from karpenter_tpu.controllers.provisioning.scheduler import ResidentSession
+from karpenter_tpu.controllers.provisioning.topology import (
+    Topology,
+    build_universe_domains,
+)
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import make_pod
+
+from test_solver import assert_same_packing
+
+
+def make_templates(n_types=12):
+    pool = NodePool()
+    pool.metadata.name = "default"
+    return build_templates([(pool, instance_types(n_types))])
+
+
+def kind_pods(name, n, cpu=1.0):
+    out = []
+    for i in range(n):
+        p = make_pod(f"{name}-{i}", cpu=cpu, memory="1Gi")
+        p.metadata.labels = {"app": name}
+        out.append(p)
+    return out
+
+
+def session_scheduler(monkeypatch, window=0, k=1):
+    """A ResidentSession over a TPUScheduler with the active window and
+    pipeline chunking forced (0 / 1 = defaults)."""
+    monkeypatch.setenv("KTPU_RESIDENT", "1")
+    if window:
+        monkeypatch.setenv("KTPU_SCAN_WINDOW", str(window))
+    else:
+        monkeypatch.delenv("KTPU_SCAN_WINDOW", raising=False)
+    if k > 1:
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", str(k))
+        monkeypatch.setenv("KTPU_PIPELINE_MIN_PODS", "0")
+    else:
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+    return TPUScheduler(make_templates(), max_claims=128).resident_session()
+
+
+def cold_solve(pods):
+    """The cold comparator: a FRESH un-warmed device solve of the pods in
+    session (arrival) order, plus the host oracle on the same problem."""
+    device = TPUScheduler(make_templates(), max_claims=128).solve(list(pods))
+    templates = make_templates()
+    topo = Topology.build(list(pods), build_universe_domains(templates, []), [])
+    host = HostScheduler(templates, topology=topo).solve(list(pods))
+    assert_same_packing(host, device)
+    return device
+
+
+def assert_identical(cold, got):
+    """assert_same_packing plus the hostname sequence (claims must reuse
+    the exact placeholder order a cold decode would mint)."""
+    assert_same_packing(cold, got)
+    assert {c.slot: c.hostname for c in cold.claims} == {
+        c.slot: c.hostname for c in got.claims
+    }
+
+
+class TestResidentDifferential:
+    @pytest.mark.parametrize("window", [0, 8])
+    @pytest.mark.parametrize("k", [1, 2, 4])
+    def test_arrivals_only(self, monkeypatch, window, k):
+        session = session_scheduler(monkeypatch, window, k)
+        base = kind_pods("a", 16) + kind_pods("b", 12)
+        union = list(base)
+        r = session.solve(list(union))
+        assert session.last_mode == "full"
+        assert_identical(cold_solve(union), r)
+        for rnd in range(3):
+            union = union + kind_pods(f"d{rnd}", 6)
+            r = session.solve(list(union))
+            assert session.last_mode == "delta", session.last_reason
+            assert_identical(cold_solve(union), r)
+        stats = session.last_timings["resident"]
+        assert stats["mode"] == "delta"
+
+    def test_same_kind_arrival_appends(self, monkeypatch):
+        session = session_scheduler(monkeypatch)
+        base = kind_pods("a", 12) + kind_pods("b", 12)
+        session.solve(list(base))
+        # more pods of the LAST kind tie with its resident pods and sort
+        # after them (stable lexsort) — still an exact append
+        union = base + kind_pods("b", 6)
+        r = session.solve(list(union))
+        assert session.last_mode == "delta", session.last_reason
+        assert_identical(cold_solve(union), r)
+
+    def test_smaller_arrival_without_compaction(self, monkeypatch):
+        # un-windowed small base -> no boundary compaction -> no eviction
+        # floor: a smaller arrival batch still appends (it sorts after
+        # every resident by size)
+        session = session_scheduler(monkeypatch)
+        base = kind_pods("a", 16)
+        session.solve(list(base))
+        union = base + kind_pods("small", 5, cpu=0.5)
+        r = session.solve(list(union))
+        assert session.last_mode == "delta", session.last_reason
+        assert_identical(cold_solve(union), r)
+
+    @pytest.mark.parametrize("window", [0, 8])
+    def test_departures_retract(self, monkeypatch, window):
+        session = session_scheduler(monkeypatch, window)
+        base = kind_pods("a", 16)
+        session.solve(list(base))
+        b1 = kind_pods("d1", 8)
+        session.solve(list(base + b1))
+        assert session.last_mode == "delta", session.last_reason
+        # the most recent round departs wholesale: the retract kernel path
+        r = session.solve(list(base))
+        assert session.last_mode == "delta", session.last_reason
+        assert_identical(cold_solve(base), r)
+
+    def test_multi_round_suffix_retract(self, monkeypatch):
+        session = session_scheduler(monkeypatch)
+        base = kind_pods("a", 16)
+        session.solve(list(base))
+        b1, b2 = kind_pods("d1", 6), kind_pods("d2", 6)
+        session.solve(list(base + b1))
+        session.solve(list(base + b1 + b2))
+        assert session.last_mode == "delta"
+        # undo BOTH delta rounds in one go
+        r = session.solve(list(base))
+        assert session.last_mode == "delta", session.last_reason
+        assert_identical(cold_solve(base), r)
+
+    @pytest.mark.parametrize("k", [1, 2, 4])
+    def test_mixed_round(self, monkeypatch, k):
+        session = session_scheduler(monkeypatch, 0, k)
+        base = kind_pods("a", 16)
+        session.solve(list(base))
+        b1 = kind_pods("d1", 8)
+        session.solve(list(base + b1))
+        # one round departs the latest batch AND lands a fresh one
+        b2 = kind_pods("d2", 5)
+        union = base + b2
+        r = session.solve(list(union))
+        assert session.last_mode == "delta", session.last_reason
+        assert_identical(cold_solve(union), r)
+
+    def test_ghost_kind_rearrival_gets_a_fresh_rank(self, monkeypatch):
+        """Regression: a round that retracts kind B's only batch AND
+        lands a NEW batch of B-content pods (after a fresh kind D in the
+        union order) must not reuse B's stale rank — cold first-appearance
+        order puts D's pods first on equal-size ties."""
+        session = session_scheduler(monkeypatch)
+        base = kind_pods("a", 12)
+        session.solve(list(base))
+        session.solve(list(base + kind_pods("b", 6)))
+        assert session.last_mode == "delta"
+        union = base + kind_pods("d", 5) + kind_pods("b", 5)
+        r = session.solve(list(union))
+        assert session.last_mode == "delta", session.last_reason
+        assert_identical(cold_solve(union), r)
+
+    def test_retract_of_base_pod_triggers_full_resolve(self, monkeypatch):
+        session = session_scheduler(monkeypatch)
+        base = kind_pods("a", 16)
+        session.solve(list(base))
+        b1 = kind_pods("d1", 6)
+        session.solve(list(base + b1))
+        # a departure reaching into the BASE cannot retract: full re-solve
+        union = base[1:] + b1
+        r = session.solve(list(union))
+        assert session.last_mode == "full", session.last_reason
+        assert_identical(cold_solve(union), r)
+        # ... and the session re-adopts: the next arrival is a delta again
+        union2 = union + kind_pods("d2", 4)
+        r2 = session.solve(list(union2))
+        assert session.last_mode == "delta", session.last_reason
+        assert_identical(cold_solve(union2), r2)
+
+    def test_partial_batch_departure_triggers_full_resolve(self, monkeypatch):
+        session = session_scheduler(monkeypatch)
+        base = kind_pods("a", 16)
+        session.solve(list(base))
+        b1 = kind_pods("d1", 8)
+        session.solve(list(base + b1))
+        # half the batch departs: not round-aligned -> full re-solve
+        union = base + b1[:4]
+        r = session.solve(list(union))
+        assert session.last_mode == "full", session.last_reason
+        assert_identical(cold_solve(union), r)
+
+    def test_epoch_invalidation_on_vocab_growth(self, monkeypatch):
+        # the in-session analog of a catalog/template change: an arrival
+        # whose selector introduces a new vocab key — the resident problem
+        # tensors predate it, so the session must invalidate and rebuild
+        session = session_scheduler(monkeypatch)
+        base = kind_pods("a", 16)
+        session.solve(list(base))
+        newcomer = make_pod("sel-0", cpu=1.0, memory="1Gi")
+        newcomer.spec.node_selector = {"example.com/team": "search"}
+        union = base + [newcomer]
+        r = session.solve(list(union))
+        assert session.last_mode == "invalidated", session.last_reason
+        # the full re-solve is still exact (the newcomer fails placement
+        # or places per the catalog — either way identical to cold)
+        cold = TPUScheduler(make_templates(), max_claims=128).solve(list(union))
+        assert cold.assignments == r.assignments
+        assert len(cold.claims) == len(r.claims)
+
+    def test_windowed_eviction_floor_falls_back(self, monkeypatch):
+        # a windowed base large enough to run boundary compaction sets the
+        # eviction floor; an arrival BELOW it could have fit an evicted
+        # claim, so the session must not append it
+        monkeypatch.setenv("KTPU_COMPACT_MIN_PODS", "8")
+        # two fill segments + K=2 pipeline chunks -> a dispatch boundary
+        # with pods remaining, so boundary compaction actually runs
+        session = session_scheduler(monkeypatch, window=8, k=2)
+        base = kind_pods("a", 12) + kind_pods("b", 12)
+        session.solve(list(base))
+        assert session._r is not None and session._r["compact_rmin"] is not None, (
+            "base solve ran no boundary compaction; the floor gate is untested"
+        )
+        union = base + kind_pods("tiny", 4, cpu=0.25)
+        r = session.solve(list(union))
+        assert session.last_mode == "full", session.last_reason
+        assert session.last_reason == "below_eviction_floor"
+        assert_identical(cold_solve(union), r)
+
+    def test_unschedulable_arrival_falls_back(self, monkeypatch):
+        session = session_scheduler(monkeypatch)
+        base = kind_pods("a", 12)
+        session.solve(list(base))
+        whale = make_pod("whale-0", cpu=10000.0, memory="1Gi")
+        union = base + [whale]
+        r = session.solve(list(union))
+        # the failing arrival routes to the full path (relaxation is a
+        # whole-problem loop); identical to cold, including the failure
+        assert session.last_mode == "full", session.last_reason
+        cold = TPUScheduler(make_templates(), max_claims=128).solve(list(union))
+        assert cold.assignments == r.assignments
+        assert [p.uid for p, _ in cold.unschedulable] == [
+            p.uid for p, _ in r.unschedulable
+        ]
+        # a failing pod parks the session (cold relaxation would re-shed
+        # every round); once it departs, residency resumes
+        assert session._r is None
+
+    def test_resident_disabled_restores_snapshot_path(self, monkeypatch):
+        monkeypatch.setenv("KTPU_RESIDENT", "0")
+        session = TPUScheduler(make_templates(), max_claims=128).resident_session()
+        base = kind_pods("a", 12)
+        session.solve(list(base))
+        assert session._r is None
+        union = base + kind_pods("d1", 4)
+        r = session.solve(list(union))
+        assert session._r is None  # never goes resident
+        assert_identical(cold_solve(union), r)
+
+    def test_existing_node_change_invalidates(self, monkeypatch):
+        from karpenter_tpu.controllers.provisioning.host_scheduler import (
+            ExistingSimNode,
+        )
+        from karpenter_tpu.models import labels as l
+        from karpenter_tpu.scheduling import Requirement, Requirements
+
+        def node(name, cpu=8.0):
+            return ExistingSimNode(
+                name=name,
+                index=0,
+                requirements=Requirements(
+                    Requirement.new(l.LABEL_HOSTNAME, "In", name)
+                ),
+                available={"cpu": cpu, "memory": 8 * 2**30, "pods": 100.0},
+            )
+
+        session = session_scheduler(monkeypatch)
+        base = kind_pods("a", 12)
+        session.solve(list(base), [node("n-1")])
+        union = base + kind_pods("d1", 4)
+        # same node content -> delta; changed content -> invalidated
+        r = session.solve(list(union), [node("n-1")])
+        assert session.last_mode == "delta", session.last_reason
+        cold_sched = TPUScheduler(make_templates(), max_claims=128)
+        cold = cold_sched.solve(list(union), [node("n-1")])
+        assert cold.assignments == r.assignments
+        assert cold.existing_assignments == r.existing_assignments
+        union2 = union + kind_pods("d2", 4)
+        session.solve(list(union2), [node("n-1", cpu=4.0)])
+        assert session.last_mode == "invalidated", session.last_reason
+
+
+class TestResidentMetrics:
+    def test_round_modes_are_counted(self, monkeypatch):
+        from karpenter_tpu.utils.metrics import (
+            RESIDENT_DELTA_PODS,
+            RESIDENT_ROUNDS,
+        )
+
+        d0 = RESIDENT_ROUNDS.get(mode="delta")
+        f0 = RESIDENT_ROUNDS.get(mode="full")
+        i0 = RESIDENT_ROUNDS.get(mode="invalidated")
+        h0 = RESIDENT_DELTA_PODS.observations() if hasattr(
+            RESIDENT_DELTA_PODS, "observations"
+        ) else None
+        session = session_scheduler(monkeypatch)
+        base = kind_pods("a", 12)
+        session.solve(list(base))  # full
+        union = base + kind_pods("d1", 4)
+        session.solve(list(union))  # delta
+        newcomer = make_pod("sel-0", cpu=1.0, memory="1Gi")
+        newcomer.spec.node_selector = {"example.com/team": "search"}
+        session.solve(list(union + [newcomer]))  # invalidated
+        assert RESIDENT_ROUNDS.get(mode="full") == f0 + 1
+        assert RESIDENT_ROUNDS.get(mode="delta") == d0 + 1
+        assert RESIDENT_ROUNDS.get(mode="invalidated") == i0 + 1
+        del h0
+
+
+class TestKscanIncrementalGrid:
+    def test_same_request_segments_reuse_the_grid(self):
+        """Consecutive kind-scan segments with identical request vectors
+        skip the full-width [W, T, GR] recompute (the STATUS Known-gaps
+        lever) — pinned against the host oracle and counted."""
+        from karpenter_tpu.models import labels as l
+        from karpenter_tpu.models.pod import TopologySpreadConstraint
+        from karpenter_tpu.utils.metrics import KSCAN_GRID_UPDATES
+
+        import bench
+
+        pods = []
+        for k in range(3):
+            for i in range(8):
+                p = make_pod(f"z{k}-{i}", cpu=1.0, memory="1Gi")
+                p.metadata.labels = {"spread": "zonal", "shard": f"s{k}"}
+                p.spec.topology_spread_constraints = [
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=l.LABEL_TOPOLOGY_ZONE,
+                        label_selector={"spread": "zonal"},
+                    )
+                ]
+                pods.append(p)
+        inc0 = KSCAN_GRID_UPDATES.get(mode="incremental")
+        templates = make_templates(24)
+        sched = TPUScheduler(templates, max_claims=64)
+        result = sched.solve(list(pods))
+        host, _ = bench.host_solve(templates, pods)
+        assert_same_packing(host, result)
+        scan = sched.last_timings.get("scan") or {}
+        # 3 same-request segments -> at least one boundary reuse
+        assert scan.get("kscan_grid_incremental", 0) >= 1, scan
+        assert KSCAN_GRID_UPDATES.get(mode="incremental") > inc0

@@ -347,6 +347,84 @@ class TestStreamStitcher:
         assert s.stats()["chunks"] == 0
 
 
+class TestColumnarChunkFrames:
+    """ISSUE-7 satellite: the zero-copy columnar chunk layout must stitch
+    into EXACTLY the tables the legacy protobuf frames produce, respect
+    the same round tagging, and round-trip every table shape; the legacy
+    decode stays available behind KTPU_RPC_COLUMNAR=0."""
+
+    @staticmethod
+    def _col_chunk(round_no, delta):
+        from karpenter_tpu.rpc.codec import encode_chunk_columnar
+        from karpenter_tpu.rpc.service import FRAME_CHUNK_COL, _round_bytes
+
+        return FRAME_CHUNK_COL + _round_bytes(round_no) + encode_chunk_columnar(delta)
+
+    def test_codec_roundtrip(self):
+        from karpenter_tpu.rpc.codec import (
+            decode_chunk_columnar,
+            encode_chunk_columnar,
+        )
+
+        delta = {
+            "claims": [(3, ["u-1", "u-2"]), (9, ["u-3"]), (12, [])],
+            "existing": [("u-4", "node-a"), ("u-5", "node-b")],
+            "unsched": [("u-6", "no room at all"), ("u-7", "taints")],
+        }
+        assert decode_chunk_columnar(encode_chunk_columnar(delta)) == delta
+        empty = {"claims": [], "existing": [], "unsched": []}
+        assert decode_chunk_columnar(encode_chunk_columnar(empty)) == empty
+        # non-ascii uids must survive the UTF-8 blob
+        uni = {"claims": [(0, ["pöd-ü"])], "existing": [], "unsched": []}
+        assert decode_chunk_columnar(encode_chunk_columnar(uni)) == uni
+
+    def test_columnar_stitches_identically_to_legacy(self):
+        from karpenter_tpu.rpc.client import StreamStitcher
+
+        deltas = [
+            {"claims": [(0, ["a"])], "existing": [("e1", "n1")], "unsched": []},
+            {"claims": [(0, ["b"]), (1, ["c"])], "existing": [],
+             "unsched": [("u1", "NoFit")]},
+        ]
+        legacy = StreamStitcher()
+        for d in deltas:
+            legacy.feed(
+                TestStreamStitcher._chunk(
+                    0, claims=d["claims"], exist=d["existing"], unsched=d["unsched"]
+                )
+            )
+        legacy.feed(TestStreamStitcher._final())
+        col = StreamStitcher()
+        for d in deltas:
+            col.feed(self._col_chunk(0, d))
+        col.feed(TestStreamStitcher._final())
+        assert col.tables() == legacy.tables()
+        assert col.n_chunks == legacy.n_chunks == 2
+
+    def test_stale_columnar_chunk_is_dropped(self):
+        from karpenter_tpu.rpc.client import StreamStitcher
+
+        s = StreamStitcher()
+        s.feed(self._col_chunk(0, {"claims": [(0, ["old"])], "existing": [],
+                                   "unsched": []}))
+        s.feed(TestStreamStitcher._reset(1))
+        s.feed(self._col_chunk(0, {"claims": [(0, ["stale"])], "existing": [],
+                                   "unsched": []}))
+        s.feed(self._col_chunk(1, {"claims": [(0, ["new"])], "existing": [],
+                                   "unsched": []}))
+        s.feed(TestStreamStitcher._final())
+        assert s.tables()["claims"] == {0: ["new"]}
+        assert s.n_stale == 1
+
+    def test_server_emission_respects_opt_out(self, monkeypatch):
+        from karpenter_tpu.rpc.service import columnar_enabled
+
+        monkeypatch.delenv("KTPU_RPC_COLUMNAR", raising=False)
+        assert columnar_enabled()
+        monkeypatch.setenv("KTPU_RPC_COLUMNAR", "0")
+        assert not columnar_enabled()
+
+
 class TestPipelineThroughSocket:
     def test_kwok_provisioning_e2e(self, solver_server):
         """The full pipeline — batcher, provisioner, lifecycle, binding —
