@@ -137,7 +137,7 @@ def host_solve(templates, pods):
     return result, time.perf_counter() - t0
 
 
-def run_stage(pods, n_types, max_claims, warm_runs=2, host_parity=False):
+def run_stage(pods, n_types, max_claims, warm_runs=2, host_parity=False, mesh=None):
     from karpenter_tpu.controllers.provisioning import TPUScheduler
     from karpenter_tpu.envelope.sampler import measured
 
@@ -146,7 +146,9 @@ def run_stage(pods, n_types, max_claims, warm_runs=2, host_parity=False):
     envelope = {}
     with measured(envelope, stage=f"stage_{len(pods)}x{n_types}"):
         templates = make_templates(n_types)
-        sched = TPUScheduler(templates, pod_pad=len(pods), max_claims=max_claims)
+        sched = TPUScheduler(
+            templates, pod_pad=len(pods), max_claims=max_claims, mesh=mesh
+        )
         t0 = time.perf_counter()
         result = sched.solve(pods)  # cold: compile + run
         cold_s = time.perf_counter() - t0
@@ -184,6 +186,11 @@ def run_stage(pods, n_types, max_claims, warm_runs=2, host_parity=False):
         # claims-axis occupancy: window size vs live high-water, frozen
         # bank, spills, compactions (bench --report-scan prints these)
         out["scan"] = timings["scan"]
+    if timings.get("shard"):
+        # per-shard record: mesh extents, dp merge/commit counters,
+        # per-group pod counts, replicated-bytes estimate (ISSUE 8;
+        # bench --report-shard prints these)
+        out["shard"] = timings["shard"]
     if timings.get("padding"):
         out["padding"] = timings["padding"]
     if host_parity:
@@ -464,6 +471,74 @@ def run_restart_stage(n_pods, n_types, max_claims, on_tpu=True):
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def run_shard_stage(n_pods=8192, n_types=200, max_claims=2048):
+    """Default-bench per-shard stage (ISSUE 8): a subprocess forces an
+    8-virtual-device CPU mesh (XLA_FLAGS) + the KTPU_MESH=2x4 override so
+    the (dp × it) shard path runs — and its last_timings["shard"] record
+    lands in the bench JSON — even on hosts without an accelerator. The
+    child also pins the meshed solve node-count/price-identical to the
+    single-device solve (the cheap in-bench parity tripwire; the full
+    bit-parity suites are tests/test_shard.py + tests/test_mesh_parity.py).
+    """
+    import os
+    import subprocess
+    import sys
+
+    child = (
+        "import json, os, time, sys; sys.path.insert(0, '.');\n"
+        "flags = os.environ.get('XLA_FLAGS', '')\n"
+        "if 'xla_force_host_platform_device_count' not in flags:\n"
+        "    os.environ['XLA_FLAGS'] = (flags + ' --xla_force_host_platform_device_count=8').strip()\n"
+        "os.environ['KTPU_MESH'] = '2x4'\n"
+        "os.environ['KTPU_PIPELINE_MIN_PODS'] = '1024'\n"
+        "from karpenter_tpu.utils.accel import force_cpu; force_cpu()\n"
+        "from bench import selector_pods, make_templates\n"
+        "from karpenter_tpu.controllers.provisioning import TPUScheduler\n"
+        "from karpenter_tpu.parallel import make_mesh\n"
+        f"pods = selector_pods({n_pods})\n"
+        f"single = TPUScheduler(make_templates({n_types}), pod_pad={n_pods}, max_claims={max_claims}).solve(pods)\n"
+        f"sched = TPUScheduler(make_templates({n_types}), pod_pad={n_pods}, max_claims={max_claims}, mesh=make_mesh())\n"
+        "sched.solve(pods)  # cold (compile)\n"
+        "t0 = time.perf_counter(); r = sched.solve(pods)\n"
+        "wall = time.perf_counter() - t0\n"
+        "assert r.assignments == single.assignments, 'meshed != single-device'\n"
+        "print(json.dumps({'wall_s': round(wall, 4),\n"
+        "                  'pods_per_sec': round(len(pods) / wall, 1),\n"
+        "                  'nodes': r.node_count,\n"
+        "                  'parity_vs_single_device': True,\n"
+        "                  'shard': sched.last_timings.get('shard')}))\n"
+    )
+    env = dict(os.environ)
+    env.pop("KTPU_SCAN_WINDOW", None)
+    out = subprocess.run(
+        [sys.executable, "-c", child],
+        capture_output=True,
+        text=True,
+        timeout=900,
+        env=env,
+    )
+    if out.returncode != 0:
+        return f"failed: {out.stderr[-300:]}"
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    rec["pods"] = n_pods
+    rec["types"] = n_types
+    return rec
+
+
+def run_1m_stage(on_tpu: bool, mesh=None) -> dict:
+    """northstar_1000000x1000 (ISSUE 8): the 1M-pod × 1000-type scale
+    probe the ROADMAP names — the (dp × it) mesh makes it a per-shard
+    problem (pipelined fill chunk groups solve one-per-dp-row; committed
+    claims become frozen decode-only rows other shards constrain against).
+    TPU-gated — the un-accelerated 1M scan takes tens of minutes on CPU —
+    but KTPU_BENCH_1M=1 forces it for offline runs. warm_runs=1: one
+    cold + one steady-state solve is already ~minutes of device time at
+    this scale."""
+    return run_stage(
+        selector_pods(1_000_000), 1000, 65536, warm_runs=1, mesh=mesh
+    )
+
+
 def run_rpc_stage(pods, n_types, local_wall_s):
     """The control/solver gRPC split's overhead: the same warm solve
     through an in-process server on loopback (SURVEY §2.9; rpc/)."""
@@ -582,6 +657,22 @@ def _print_padding_report(detail: dict) -> None:
             )
 
 
+def _print_shard_report(detail: dict) -> None:
+    """--report-shard: per-stage mesh extents + dp merge outcomes +
+    replicated-bytes estimate. The JSON line carries the same numbers
+    under each stage's "shard" key."""
+    for stage, st in sorted(detail.items()):
+        sh = st.get("shard") if isinstance(st, dict) else None
+        if not sh:
+            continue
+        print(
+            f"shard {stage:>28s}: mesh={sh['dp']}x{sh['it']} "
+            f"rounds={sh['merge_rounds']} committed={sh['groups_committed']} "
+            f"replayed={sh['groups_replayed']} "
+            f"replicated_kb={sh['replicated_bytes'] / 1024:.1f}"
+        )
+
+
 def _print_scan_report(detail: dict) -> None:
     """--report-scan: claims-axis occupancy per stage — the active window
     vs the live high-water, frozen-bank size, spill and compaction counts.
@@ -615,6 +706,14 @@ def main() -> None:
         help="print per-stage claims-axis occupancy (active window vs live "
         "high-water, frozen bank, spills, compactions; the same numbers "
         "land under each stage's 'scan' key in the final JSON line)",
+    )
+    parser.add_argument(
+        "--report-shard",
+        action="store_true",
+        help="print per-stage mesh-shard records (dp×it extents, merge "
+        "rounds, committed/replayed chunk groups, replicated-bytes "
+        "estimate; the same numbers land under each stage's 'shard' key "
+        "in the final JSON line)",
     )
     parser.add_argument(
         "--steady",
@@ -763,6 +862,34 @@ def main() -> None:
     else:
         detail["northstar_100000x1000"] = "skipped on CPU fallback"
 
+    # stage 3.1: per-shard record — the (dp × it) mesh path in a child
+    # with 8 virtual CPU devices, so the default bench always carries a
+    # "shard" stage JSON (ISSUE 8)
+    try:
+        detail["shard_8192x200"] = run_shard_stage()
+    except Exception as e:  # noqa: BLE001
+        detail["shard_8192x200"] = f"failed: {repr(e)[:300]}"
+
+    # stage 3.2: the 1M × 1000 north star as a per-shard problem
+    # (ISSUE 8). TPU-gated: the un-accelerated 1M scan takes tens of
+    # minutes; KTPU_BENCH_1M=1 forces it for offline CPU runs.
+    import os as _os
+
+    if on_tpu or _os.environ.get("KTPU_BENCH_1M") == "1":
+        try:
+            import jax as _jax
+
+            from karpenter_tpu.parallel import make_mesh as _make_mesh
+
+            mesh_1m = _make_mesh() if _jax.device_count() > 1 else None
+            detail["northstar_1000000x1000"] = run_1m_stage(on_tpu, mesh=mesh_1m)
+        except Exception as e:  # noqa: BLE001
+            detail["northstar_1000000x1000"] = f"failed: {repr(e)[:300]}"
+    else:
+        detail["northstar_1000000x1000"] = (
+            "skipped (TPU-gated; KTPU_BENCH_1M=1 forces on CPU)"
+        )
+
     # stage 3.5: gang-storm — all-or-nothing slice scheduling throughput
     # (gangs-scheduled/sec + atomic spill accounting, ISSUE 6)
     try:
@@ -825,6 +952,8 @@ def main() -> None:
         _print_padding_report(detail)
     if args.report_scan:
         _print_scan_report(detail)
+    if args.report_shard:
+        _print_shard_report(detail)
 
     print(
         json.dumps(

@@ -149,7 +149,14 @@ def _gather_kind_xs(
 
 
 _gather_pod_chunk = jax.jit(_gather_pod_chunk)
-_gather_fill_xs = jax.jit(_gather_fill_xs)
+# the raw (un-jitted) gather also feeds the dp-batched variant below
+_gather_fill_xs_raw = _gather_fill_xs
+_gather_fill_xs = jax.jit(_gather_fill_xs_raw)
+# batched over [DP] rows of (kind ids, counts): one dispatch gathers every
+# dp row's chunk-group FillXs (leading axis = the mesh's dp axis)
+_gather_fill_xs_dp = jax.jit(
+    jax.vmap(_gather_fill_xs_raw, in_axes=(None,) * 9 + (0, 0))
+)
 _gather_kind_xs = jax.jit(_gather_kind_xs)
 
 
@@ -588,6 +595,12 @@ class TPUScheduler:
         # when device compute per chunk can hide it).
         self.pipeline_chunks = int(os.environ.get("KTPU_PIPELINE_CHUNKS", "4"))
         self.pipeline_min_pods = int(os.environ.get("KTPU_PIPELINE_MIN_PODS", "4096"))
+        # dp-sharded speculative fill (ISSUE 8): on a mesh with dp > 1,
+        # pipelined fill chunk groups solve one-per-dp-row in a single
+        # batched dispatch and merge exact-or-replay; bit-parity with the
+        # single-device solve is structural (see ops/solver.py dp section)
+        self.shard_dp = os.environ.get("KTPU_SHARD_DP", "1") not in ("0", "false")
+        self._shard_stats: Optional[dict] = None
         # per-chunk streaming sink (gRPC SolveStream); None in-process
         self._chunk_sink = None
         # resident-session capture: when a ResidentSession wraps this
@@ -713,9 +726,17 @@ class TPUScheduler:
                     if vid is not None:
                         mv_it_values[t_idx, j, vid] = True
         self._mv_active = any(mv_lists)
+        # shard the per-type template columns AT device_put time (instead
+        # of replicating and re-constraining inside the kernels): the
+        # [G, T] membership mask and the [T, J, V] minValues slab follow
+        # the catalog's "it" sharding from birth
+        from karpenter_tpu.ops.encode import place_sharded
+
+        tmpl_its = place_sharded(its, self.mesh, None, "it")
+        mv_slab = place_sharded(mv_it_values, self.mesh, "it")
         self.template_tensors = ops_solver.Templates(
             reqs=tmpl_reqs,
-            its=jnp.asarray(its),
+            its=tmpl_its,
             daemon_requests=jnp.asarray(daemon),
             valid=jnp.ones(G, dtype=bool),
             # per-solve budgets are patched in by solve()
@@ -723,7 +744,7 @@ class TPUScheduler:
             nodes_budget=jnp.full(G, np.inf, dtype=jnp.float32),
             mv_key=jnp.asarray(mv_key),
             mv_min=jnp.asarray(mv_min),
-            mv_it_values=jnp.asarray(mv_it_values),
+            mv_it_values=mv_slab,
         )
         wk = enc.vocab.well_known_mask()
         self.well_known = jnp.asarray(
@@ -1038,6 +1059,7 @@ class TPUScheduler:
         self._t_solve_start = _time.perf_counter()
         self._adaptive_claims = True
         self._scan_stats = None
+        self._shard_stats = None
         self._last_compact_rmin = None
         pad_real0 = dict(self._pad_cache.real)
         pad_padded0 = dict(self._pad_cache.padded)
@@ -1095,6 +1117,8 @@ class TPUScheduler:
             self.last_timings["scan"] = self._scan_stats
         if self._pipeline_stats is not None:
             self.last_timings["pipeline"] = self._pipeline_stats
+        if self._shard_stats is not None:
+            self.last_timings["shard"] = self._shard_stats
         return out
 
     def whatif_batch(
@@ -1849,12 +1873,19 @@ class TPUScheduler:
                     kscan_key[u] = kid_
         kind_records = hgr_np.any(axis=1)  # decode must commit topo counts
 
+        # the [U, T] per-kind allow mask is the one encode output whose
+        # trailing axis is the catalog: place it SHARDED over the mesh's
+        # "it" axis at device_put time (replicate-then-constrain would
+        # materialize the full copy per device first)
+        from karpenter_tpu.ops.encode import place_sharded
+
+        it_allow_dev = place_sharded(np.asarray(it_allow_k), self.mesh, None, "it")
         return pods_sorted, dict(
             reqs_k=reqs_k,
             strict_k=strict_reqs_k,
             requests_k=jnp.asarray(requests_k, dtype=jnp.float32),
             tol_k=jnp.asarray(tol_k),
-            it_allow_k=jnp.asarray(it_allow_k),
+            it_allow_k=it_allow_dev,
             exist_ok_k=jnp.asarray(exist_ok_k),
             ports_k=jnp.asarray(pod_ports_k),
             conf_k=jnp.asarray(pod_port_conf_k),
@@ -1948,6 +1979,37 @@ class TPUScheduler:
             res_active=self._res_active,
             res_strict=self.reserved_mode == "strict",
         )
+        # per-shard observability (last_timings["shard"], bench
+        # --report-shard): mesh extents + a replicated-bytes estimate over
+        # the per-kind encode tensors that still broadcast to every device
+        # (the sharded ones — catalog, [.., T] masks, window/bank columns —
+        # are excluded by construction); the dp merge loop fills in the
+        # round/commit counters
+        if self.mesh is not None:
+            ms = dict(self.mesh.shape)
+            rep_bytes = 0
+            for leaf in jax.tree_util.tree_leaves(
+                [
+                    enc["reqs_k"], enc["requests_k"], enc["tol_k"],
+                    enc["exist_ok_k"], enc["ports_k"], enc["conf_k"],
+                    enc["vols_k"],
+                ]
+            ):
+                rep_bytes += int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+            self._shard_stats = {
+                "dp": int(ms.get("dp", 1)),
+                "it": int(ms.get("it", 1)),
+                "merge_rounds": 0,
+                "groups_committed": 0,
+                "groups_replayed": 0,
+                "group_pods": [],
+                "replicated_bytes": int(rep_bytes),
+            }
+            from karpenter_tpu.utils.metrics import SHARD_REPLICATED_BYTES
+
+            SHARD_REPLICATED_BYTES.set(float(rep_bytes))
+        else:
+            self._shard_stats = None
         state = ops_solver.initial_state(
             exist_tensors, self.it_tensors, template_tensors, topo_tensors,
             n_claims, int(enc["ports_k"].shape[1]), self._res_cap0,
@@ -2039,6 +2101,77 @@ class TPUScheduler:
             self._n_compactions += 1
             return st
 
+        def _dispatch_fill(st, segs):
+            """One sequential kind-level fill dispatch (shared by the
+            plain path and the dp merge loop's replay rung)."""
+            B = len(segs)
+            # bucketed padding: multiple-of-8 up to 32, multiple-of-32
+            # above (every padded row is a full fill step); the
+            # PadBucketCache reuses a previously-compiled bucket when
+            # one covers the request within the pow2 ceiling, so
+            # steady-state shapes converge instead of recompiling
+            B_pad = self._pad_cache.pad(
+                "fill_segments", B, step=(8 if B <= 32 else 32)
+            )
+            kind_ids = np.zeros(B_pad, dtype=np.int64)
+            counts = np.zeros(B_pad, dtype=np.int32)
+            for j, (lo, hi, k) in enumerate(segs):
+                kind_ids[j] = k
+                counts[j] = hi - lo
+            xs = _gather_fill_xs(
+                enc["reqs_k"], enc["requests_k"], enc["tol_k"],
+                enc["it_allow_k"], enc["exist_ok_k"], enc["ports_k"],
+                enc["conf_k"], enc["vols_k"], enc["pod_topo_k"],
+                jnp.asarray(kind_ids), jnp.asarray(counts),
+            )
+            return ops_solver.solve_fill(
+                st, xs, exist_tensors, self.it_tensors, template_tensors,
+                self.well_known, topo_tensors,
+                zone_kid=enc["zone_kid"], ct_kid=enc["ct_kid"],
+                n_claims=n_claims,
+            )
+
+        # ---- dp-sharded speculative fill (ISSUE 8) -----------------------
+        # On a mesh whose dp axis has extent > 1, CONSECUTIVE pipelined
+        # fill chunk groups become one "fill_dp" item: each merge round
+        # batches up to DP groups into a single vmapped dispatch against
+        # the committed state (one group per dp row) and commits them in
+        # order — graft when provably independent, sequential replay
+        # otherwise (see ops/solver.py dp section). Eligibility mirrors
+        # the merge kernel's no-shared-mutable-state contract: no real
+        # existing nodes and a topology-free problem (the fill routing
+        # itself already guarantees infinite budgets, no reservations and
+        # no enforced minValues for batchable kinds).
+        dp_n = 1
+        if self.mesh is not None:
+            dp_n = int(dict(self.mesh.shape).get("dp", 1))
+        dp_eligible = bool(
+            K_pipe
+            and dp_n > 1
+            and self.shard_dp
+            and not self.existing_nodes
+            and not enc["topo_kids"]
+            and not enc["vg_groups"]
+            and not enc["hg_groups"]
+        )
+        if dp_eligible:
+            merged_runs: list = []
+            i = 0
+            while i < len(runs):
+                if runs[i][0][0] == "fill":
+                    j = i
+                    groups = []
+                    while j < len(runs) and runs[j][0][0] == "fill":
+                        groups.append(runs[j][1])
+                        j += 1
+                    if len(groups) >= 2:
+                        merged_runs.append((("fill_dp",), groups))
+                        i = j
+                        continue
+                merged_runs.append(runs[i])
+                i += 1
+            runs = merged_runs
+
         outputs: list[tuple] = []
         tmpl_snaps: list = []  # post-dispatch GLOBAL template snapshot per
         # output: the pipelined decode opens claims before the final state
@@ -2079,32 +2212,7 @@ class TPUScheduler:
                     remaining[k_] -= hi_ - lo_
                 state = _maybe_compact(state)
             elif mode[0] == "fill":
-                B = len(segs)
-                # bucketed padding: multiple-of-8 up to 32, multiple-of-32
-                # above (every padded row is a full fill step); the
-                # PadBucketCache reuses a previously-compiled bucket when
-                # one covers the request within the pow2 ceiling, so
-                # steady-state shapes converge instead of recompiling
-                B_pad = self._pad_cache.pad(
-                    "fill_segments", B, step=(8 if B <= 32 else 32)
-                )
-                kind_ids = np.zeros(B_pad, dtype=np.int64)
-                counts = np.zeros(B_pad, dtype=np.int32)
-                for j, (lo, hi, k) in enumerate(segs):
-                    kind_ids[j] = k
-                    counts[j] = hi - lo
-                xs = _gather_fill_xs(
-                    enc["reqs_k"], enc["requests_k"], enc["tol_k"],
-                    enc["it_allow_k"], enc["exist_ok_k"], enc["ports_k"],
-                    enc["conf_k"], enc["vols_k"], enc["pod_topo_k"],
-                    jnp.asarray(kind_ids), jnp.asarray(counts),
-                )
-                state, ys = ops_solver.solve_fill(
-                    state, xs, exist_tensors, self.it_tensors, template_tensors,
-                    self.well_known, topo_tensors,
-                    zone_kid=enc["zone_kid"], ct_kid=enc["ct_kid"],
-                    n_claims=n_claims,
-                )
+                state, ys = _dispatch_fill(state, segs)
                 # fill grids address WINDOW rows; the decode maps them to
                 # global claim ids via this dispatch's slot_of snapshot
                 outputs.append(("fill", segs, ys, state.slot_of))
@@ -2112,6 +2220,14 @@ class TPUScheduler:
                 for lo_, hi_, k_ in segs:
                     remaining[k_] -= hi_ - lo_
                 state = _maybe_compact(state)
+            elif mode[0] == "fill_dp":
+                # `segs` is a LIST of chunk groups here; the dp merge loop
+                # appends one ("fill", ...) output per group, exactly like
+                # the sequential branch would have
+                state = self._run_fill_dp(
+                    enc, state, segs, outputs, tmpl_snaps, remaining,
+                    _maybe_compact, _dispatch_fill,
+                )
             elif mode[0] == "kscan":
                 # exact B: a padded segment would run the full-width
                 # precompute for nothing (the inner loop already has a
@@ -2175,6 +2291,119 @@ class TPUScheduler:
                     segments=len(segs),
                 )
         return state, outputs, tmpl_snaps
+
+    def _run_fill_dp(
+        self, enc, state, groups, outputs, tmpl_snaps, remaining,
+        maybe_compact, dispatch_fill,
+    ):
+        """Speculative dp-row execution of consecutive pipelined fill
+        chunk groups (ops/solver.py dp section has the exactness proof):
+        each merge round batches up to DP groups into ONE vmapped dispatch
+        against the committed state, then commits groups in order — graft
+        (merge_shard_fill, committed claims acting as decode-only rows the
+        group constrained against but never rescanned) when the commit
+        conditions provably hold, sequential replay otherwise. Either way
+        the committed state and outputs are bit-identical to the
+        sequential loop's."""
+        from karpenter_tpu.ops.kernels import fetch_tree
+        from karpenter_tpu.utils.metrics import SHARD_MERGE_ROUNDS
+
+        dp_n = int(dict(self.mesh.shape).get("dp", 1))
+        W = int(state.open.shape[0])
+        n_claims = enc["n_claims"]
+        requests_np = np.asarray(enc["requests_k"], dtype=np.float32)
+        stats = self._shard_stats
+        gi = 0
+        while gi < len(groups):
+            round_groups = groups[gi : gi + dp_n]
+            gi += len(round_groups)
+            # committed-state scalars at the round base (host copies feed
+            # the per-group commit checks; the spec rows solved from HERE)
+            b_n_open, b_w_open, b_spills = (
+                int(x)
+                for x in fetch_tree([state.n_open, state.w_open, state.spills])
+            )
+            B_max = max(len(s) for s in round_groups)
+            B_pad = self._pad_cache.pad(
+                "fill_segments_dp", B_max, step=(8 if B_max <= 32 else 32)
+            )
+            # a short round pads to DP rows with count-0 groups (no-ops),
+            # so the vmapped executable is reused across rounds
+            kid_b = np.zeros((dp_n, B_pad), dtype=np.int64)
+            cnt_b = np.zeros((dp_n, B_pad), dtype=np.int32)
+            for r, segs in enumerate(round_groups):
+                for j, (lo, hi, k) in enumerate(segs):
+                    kid_b[r, j] = k
+                    cnt_b[r, j] = hi - lo
+            xs_b = _gather_fill_xs_dp(
+                enc["reqs_k"], enc["requests_k"], enc["tol_k"],
+                enc["it_allow_k"], enc["exist_ok_k"], enc["ports_k"],
+                enc["conf_k"], enc["vols_k"], enc["pod_topo_k"],
+                jnp.asarray(kid_b), jnp.asarray(cnt_b),
+            )
+            spec_states, spec_ys = ops_solver.solve_fill_dp(
+                state, xs_b, enc["exist_tensors"], self.it_tensors,
+                enc["template_tensors"], self.well_known, enc["topo_tensors"],
+                zone_kid=enc["zone_kid"], ct_kid=enc["ct_kid"],
+                n_claims=n_claims,
+            )
+            # serialize the round's collective computations: the merge
+            # loop syncs on tiny scalars per group anyway, and >1
+            # collective-bearing computation in flight deadlocks the
+            # virtual-device CPU backend's rendezvous (fetch_tree has the
+            # matching guard)
+            jax.block_until_ready((spec_states, spec_ys))
+            if stats is not None:
+                stats["merge_rounds"] += 1
+            for r, segs in enumerate(round_groups):
+                kset = sorted({k for _lo, _hi, k in segs})
+                r_min_g = requests_np[kset].min(axis=0)
+                spec_r, ys_r = ops_solver.take_dp_row(
+                    (spec_states, spec_ys), jnp.int32(r)
+                )
+                jax.block_until_ready(ys_r.fill_c)
+                dead, touched, left = ops_solver.dp_commit_probe(
+                    state, self.it_tensors, jnp.asarray(r_min_g),
+                    ys_r.fill_c, ys_r.leftover, jnp.int32(b_w_open),
+                )
+                dead_v, touch_v, left, c_w, c_n, s_n, s_w, s_sp = fetch_tree(
+                    [
+                        dead, touched, left, state.w_open, state.n_open,
+                        spec_r.n_open, spec_r.w_open, spec_r.spills,
+                    ]
+                )
+                opened = int(s_n) - b_n_open
+                k_rows = int(s_w) - b_w_open
+                commit = (
+                    bool(dead_v)
+                    and not bool(touch_v)
+                    and int(left) == 0
+                    and int(s_sp) == b_spills
+                    and int(c_w) + k_rows <= W
+                    and int(c_n) + opened <= n_claims
+                )
+                if commit:
+                    state, shifted = ops_solver.merge_shard_fill(
+                        state, spec_r, jnp.int32(b_n_open), jnp.int32(b_w_open)
+                    )
+                    jax.block_until_ready(state)  # same one-at-a-time rule
+                    outputs.append(("fill", segs, ys_r, shifted))
+                    SHARD_MERGE_ROUNDS.inc(outcome="committed")
+                else:
+                    state, ys_seq = dispatch_fill(state, segs)
+                    outputs.append(("fill", segs, ys_seq, state.slot_of))
+                    SHARD_MERGE_ROUNDS.inc(outcome="replayed")
+                if stats is not None:
+                    stats["group_pods"].append(
+                        int(sum(hi - lo for lo, hi, _k in segs))
+                    )
+                    key = "groups_committed" if commit else "groups_replayed"
+                    stats[key] += 1
+                tmpl_snaps.append(ops_solver.global_template(state))
+                for lo_, hi_, k_ in segs:
+                    remaining[k_] -= hi_ - lo_
+                state = maybe_compact(state)
+        return state
 
     def _pipeline_target(self, enc: dict) -> int:
         """Chunk-group count for the software pipeline; 0 disables (small
@@ -3494,13 +3723,20 @@ class ResidentSession:
             )
 
         t0 = _time.perf_counter()
-        # ---- 1. retract departed suffix rounds (device + host rollback)
-        if retract_k:
-            self._retract(retract_k)
-        # ---- 2. append arrivals through the fill pipeline
-        t_encode = _time.perf_counter()
-        if delta is not None:
-            self._append(delta)
+        # delta dispatches run under the scheduler's mesh (when it has
+        # one) exactly like full solves do in _run_solve: the resident
+        # state's sharded window/bank columns stay sharded across rounds
+        # instead of re-replicating at the first un-meshed dispatch
+        from contextlib import nullcontext
+
+        with sched.mesh if sched.mesh is not None else nullcontext():
+            # ---- 1. retract departed suffix rounds (device + host rollback)
+            if retract_k:
+                self._retract(retract_k)
+            # ---- 2. append arrivals through the fill pipeline
+            t_encode = _time.perf_counter()
+            if delta is not None:
+                self._append(delta)
         t_end = _time.perf_counter()
         sched.last_timings = {
             "encode_s": t_encode - t0,

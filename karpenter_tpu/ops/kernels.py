@@ -349,6 +349,60 @@ def _pack_wire(arrs):
 _PACK_CACHE: dict = {}
 _PACK_CACHE_LIMIT = 512
 
+_REPL_CACHE: dict = {}
+
+
+def _canonicalize_for_wire(arrs):
+    """GSPMD workaround: the jitted wire packer (packbits + uint8 bitcast
+    over a concat of every leaf) miscompiles on this jax/XLA version when
+    ANY input is partitioned over a mesh — fetched integers come back
+    scaled by the shard count and bools bit-shift (see the fetch_tree
+    regression in tests/test_shard.py). Re-lay every non-fully-replicated
+    leaf as replicated on its mesh with ONE cached jitted identity
+    dispatch (a plain parameter all-gather the partitioner handles), so
+    the packer always compiles over replicated data. Leaves with exotic
+    non-NamedSharding layouts fall back to a host fetch and skip the
+    packer entirely."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    idx = [
+        i
+        for i, a in enumerate(arrs)
+        if not getattr(a.sharding, "is_fully_replicated", True)
+    ]
+    if not idx:
+        return arrs
+    out = list(arrs)
+    named = [i for i in idx if isinstance(arrs[i].sharding, NamedSharding)]
+    for i in idx:
+        if i not in named:
+            out[i] = np.asarray(arrs[i])  # exotic layout: host fetch
+    if named:
+        mesh = arrs[named[0]].sharding.mesh
+        sub = [arrs[i] for i in named]
+        sig = (mesh, tuple((a.shape, str(a.dtype)) for a in sub))
+        rep = _REPL_CACHE.get(sig)
+        if rep is None:
+            if len(_REPL_CACHE) >= _PACK_CACHE_LIMIT:
+                _REPL_CACHE.clear()
+            rep = _REPL_CACHE[sig] = jax.jit(
+                lambda xs: xs,
+                out_shardings=NamedSharding(mesh, PartitionSpec()),
+            )
+        # drain in-flight producers before enqueueing the all-gather: on
+        # the virtual-device CPU backend, two collective-bearing
+        # computations in flight can deadlock at their rendezvous (seen
+        # as a fetch_tree hang in the dp merge loop); one-at-a-time is
+        # also what the fetch semantics already imply — this call IS the
+        # sync point
+        jax.block_until_ready(sub)
+        fixed = rep(sub)
+        for j, i in enumerate(named):
+            out[i] = fixed[j]
+    return out
+
 
 def fetch_tree(tree):
     """Batched device->host transfer of an arbitrary pytree.
@@ -369,7 +423,16 @@ def fetch_tree(tree):
     dev_idx = [i for i, x in enumerate(leaves) if isinstance(x, jax.Array)]
     out = list(leaves)
     if dev_idx:
-        arrs = [leaves[i] for i in dev_idx]
+        arrs = _canonicalize_for_wire([leaves[i] for i in dev_idx])
+        # exotic-layout leaves came back as host arrays already
+        pairs = list(zip(dev_idx, arrs))
+        for i, a in pairs:
+            if not isinstance(a, jax.Array):
+                out[i] = a
+        dev_idx = [i for i, a in pairs if isinstance(a, jax.Array)]
+        if not dev_idx:
+            return jax.tree.unflatten(treedef, out)
+        arrs = [a for _i, a in pairs if isinstance(a, jax.Array)]
         sig = tuple((a.shape, str(a.dtype)) for a in arrs)
         packer = _PACK_CACHE.get(sig)
         if packer is None:

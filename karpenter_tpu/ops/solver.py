@@ -43,6 +43,40 @@ from karpenter_tpu.ops import topology as topo_ops
 from karpenter_tpu.ops.encode import INT_MAX, INT_MIN, InstanceTypeTensors, PodTensors, ReqSetTensors
 from karpenter_tpu.ops.topology import PodTopology, TopologyTensors
 
+
+def _ambient_mesh():
+    """The device mesh entered via `with mesh:` at trace time, or None."""
+    from jax.interpreters import pxla
+
+    m = pxla.thread_resources.env.physical_mesh
+    return None if m.empty else m
+
+
+def shard_hint(x, *axes):
+    """with_sharding_constraint against the AMBIENT mesh; a no-op outside
+    one, so the single-device executables are untouched.
+
+    Axis names absent from the mesh (or with extent 1) degrade to None,
+    and trailing unnamed dims replicate. The ambient mesh is part of the
+    jit cache key (the resource env), so annotated kernels retrace — once
+    — when first called under a mesh; GSPMD then keeps the hot [W, T]
+    viability masks, bank [NCAP, T] columns and kscan [W, T, GR] grid
+    partitioned across (dp × it) instead of replicating them per device."""
+    mesh = _ambient_mesh()
+    if mesh is None:
+        return x
+    shape = dict(mesh.shape)
+    names = [a if (a in shape and shape[a] > 1) else None for a in axes]
+    if not any(n is not None for n in names):
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    names += [None] * (x.ndim - len(names))
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, PartitionSpec(*names))
+    )
+
+
 # assignment sentinels
 NO_CLAIM = -1  # no compatible existing node, in-flight claim, or template
 NO_ROOM = -2  # a template was feasible but the claim-slot capacity is full
@@ -407,7 +441,9 @@ def _make_step(
                 ok &= kernels.per_key_ok_at(it.reqs, comb_t, k)
             return ok
 
-        it_compat = jax.lax.cond(any_fallback, _full_compat, _fast_compat)
+        it_compat = shard_hint(
+            jax.lax.cond(any_fallback, _full_compat, _fast_compat), "dp", "it"
+        )
         total = state.used + pod_requests[None, :]
         fits_off = _fits_and_offering(total, comb_t, it, zone_kid, ct_kid)
         new_its = state.its & it_compat & fits_off & it_allow[None, :]
@@ -692,7 +728,7 @@ def initial_state(
         exist_used=jnp.zeros((E, R), dtype=jnp.float32),
         reqs=identity_reqs(W, K, V),
         used=jnp.zeros((W, R), dtype=jnp.float32),
-        its=jnp.zeros((W, T), dtype=bool),
+        its=shard_hint(jnp.zeros((W, T), dtype=bool), "dp", "it"),
         template=jnp.zeros(W, dtype=jnp.int32),
         open=jnp.zeros(W, dtype=bool),
         pods=jnp.zeros(W, dtype=jnp.int32),
@@ -703,7 +739,7 @@ def initial_state(
         spills=jnp.int32(0),
         bank_frozen=jnp.zeros(NB, dtype=bool),
         bank_template=jnp.zeros(NB, dtype=jnp.int32),
-        bank_its=jnp.zeros((NB, T), dtype=bool),
+        bank_its=shard_hint(jnp.zeros((NB, T), dtype=bool), "dp", "it"),
         bank_used=jnp.zeros((NB, R), dtype=jnp.float32),
         bank_held=jnp.zeros((NB, RID), dtype=bool),
         bank_tk_mask=jnp.zeros((NB, TK, V), dtype=bool),
@@ -1368,8 +1404,13 @@ def _make_fill_step(
     zone_kid: int,
     ct_kid: int,
     n_claims: int,
+    annotate: bool = True,
 ):
     NCAP = n_claims
+    # annotate=False inside the dp-batched speculative dispatch: there the
+    # leading vmap axis IS the "dp" mesh axis, so hinting W over dp again
+    # would fight the batch partitioning
+    _hint = shard_hint if annotate else (lambda x, *a: x)
     E = exist.avail.shape[0]
     G = templates.its.shape[0]
     no_wk = jnp.zeros_like(well_known)
@@ -1445,9 +1486,9 @@ def _make_fill_step(
         comb = kernels.intersect_sets(state.reqs, pod_b)
         claim_ok = kernels.compatible_elemwise(state.reqs, pod_b, well_known)
         it_compat = kernels.intersects(it.reqs, comb).T  # [W, T]
-        off_n = _off_for(comb, W)
+        off_n = _hint(_off_for(comb, W), "dp", "it")
         allow_t = xs.it_allow[None, :]
-        viable = state.its & it_compat & allow_t
+        viable = _hint(state.its & it_compat & allow_t, "dp", "it")
         cap_res_n = _claim_fill_caps(state.used, viable, requests, it, off_n)
         cap_topo_n = _hg_slot_caps(
             topo,
@@ -1472,7 +1513,9 @@ def _make_fill_step(
             _fits_off_counted(state.used, jnp.broadcast_to(fill_c2[:, None, None], off_n.shape), requests, it, off_n),
             axis=-1,
         )  # [N, T]
-        its2 = jnp.where(landed_n[:, None], viable & fits_final, state.its)
+        its2 = _hint(
+            jnp.where(landed_n[:, None], viable & fits_final, state.its), "dp", "it"
+        )
         reqs2 = kernels.select_set(landed_n, comb, state.reqs)
         pods2 = state.pods + fill_c2
         ports2 = jnp.where(
@@ -1647,6 +1690,225 @@ def solve_fill(
         exist, it, templates, well_known, topo, zone_kid, ct_kid, n_claims
     )
     return jax.lax.scan(step, state, xs)
+
+
+# ---------------------------------------------------------------------------
+# dp-sharded speculative fill (ISSUE 8): independent chunk groups solve
+# concurrently across the mesh's dp rows, merged exact-or-replay
+# ---------------------------------------------------------------------------
+#
+# The pipelined fill splits a big solve into ~K chunk groups of whole kind
+# segments. Sequentially, group g's dispatch sees the claims groups 0..g-1
+# opened; the ONLY couplings between fill groups on the dp-eligible
+# problem class (no real existing nodes, topology-free, and — implied by
+# the fill routing itself — infinite budgets, no reservations, no
+# enforced minValues) are (a) water-fills into earlier groups' still-open
+# claims and (b) the global claim-id counter. So:
+#
+#   * every dp row solves ITS group against the SAME base state in one
+#     batched vmapped dispatch (rows sharded over the mesh's dp axis —
+#     each row's scan is row-local, no cross-row collectives);
+#   * the host merges groups in order. A group commits WITHOUT re-solving
+#     iff every live open claim in the committed state is capacity-dead
+#     w.r.t. the group's elementwise-min request (window_live_dead — the
+#     frozen-bank eviction rule as a predicate): then no pod of the group
+#     could have landed on ANY pre-existing claim (fits is total-based and
+#     monotone in the request), so the speculative solve from the base
+#     equals the sequential solve from the committed state row-for-row, up
+#     to the claim-id offset. merge_shard_fill grafts the group's fresh
+#     rows onto the committed window with ids shifted by that offset —
+#     committed claims effectively became decode-only rows the group
+#     constrained against but never rescanned, exactly the bank's
+#     contract.
+#   * any failed check (live non-dead claims, leftovers, window spill, or
+#     window/claim-axis overflow at the graft) REPLAYS the group as a
+#     normal sequential dispatch — so the dp path is bit-identical to the
+#     single-device solve by construction, never by luck.
+
+
+class ShardFillState(NamedTuple):
+    """The window-row slice + counters of one speculative per-shard fill
+    solve. Bank, existing-node, budget, topology and reservation state are
+    unchanged by construction on the dp-eligible problem class, so they
+    never cross the merge (and the dp dispatch never materializes DP
+    copies of the [NCAP, T] bank)."""
+
+    reqs: ReqSetTensors  # [W, K, V]
+    used: jnp.ndarray  # [W, R]
+    its: jnp.ndarray  # [W, T]
+    template: jnp.ndarray  # [W]
+    open: jnp.ndarray  # [W]
+    pods: jnp.ndarray  # [W]
+    slot_of: jnp.ndarray  # [W]
+    claim_ports: jnp.ndarray  # [W, NPp]
+    held: jnp.ndarray  # [W, RID]
+    n_open: jnp.ndarray  # [] i32
+    w_open: jnp.ndarray  # [] i32
+    spills: jnp.ndarray  # [] i32
+
+
+@functools.partial(jax.jit, static_argnames=_FILL_STATIC)
+def solve_fill_dp(
+    state: SolverState,
+    xs_b: FillXs,  # leading [DP] group axis on every tensor
+    exist: ExistingNodes,
+    it: InstanceTypeTensors,
+    templates: Templates,
+    well_known: jnp.ndarray,
+    topo: TopologyTensors,
+    zone_kid: int,
+    ct_kid: int,
+    n_claims: int,
+) -> tuple[ShardFillState, FillYs]:
+    """Speculative dp fan-out: one batched dispatch runs every dp row's
+    chunk group against the same base state (vmap over the leading group
+    axis, inputs sharded over the mesh's dp rows). Returns per-row slim
+    states + fill grids; the host commits rows in order via
+    merge_shard_fill or replays them (scheduler._run_solve_inner)."""
+
+    def one(xs: FillXs):
+        step = _make_fill_step(
+            exist, it, templates, well_known, topo, zone_kid, ct_kid,
+            n_claims, annotate=False,
+        )
+        st, ys = jax.lax.scan(step, state, xs)
+        return (
+            ShardFillState(
+                reqs=st.reqs, used=st.used, its=st.its, template=st.template,
+                open=st.open, pods=st.pods, slot_of=st.slot_of,
+                claim_ports=st.claim_ports, held=st.held, n_open=st.n_open,
+                w_open=st.w_open, spills=st.spills,
+            ),
+            ys,
+        )
+
+    # group-axis hints: every row's tensors live on its dp row; it_allow
+    # additionally keeps its catalog axis on "it" (it was gathered from the
+    # it-sharded per-kind allow mask — re-replicating it would force a full
+    # rematerialization)
+    allow = xs_b.it_allow
+    xs_b = jax.tree_util.tree_map(
+        lambda a: a if a is allow else shard_hint(a, "dp"), xs_b
+    )
+    xs_b = xs_b._replace(it_allow=shard_hint(allow, "dp", None, "it"))
+    return jax.vmap(one)(xs_b)
+
+
+@jax.jit
+def window_live_dead(state: SolverState, it: InstanceTypeTensors, r_min: jnp.ndarray):
+    """[] bool — TRUE when every live open window claim is capacity-dead
+    w.r.t. r_min (used + r_min fits no viable (type, group) cell —
+    compact_state's eviction rule as a read-only predicate). Every pod of
+    a chunk group requests >= the group's elementwise-min r_min, and the
+    total-based fits rule is monotone in the request, so TRUE proves a
+    fill of that group cannot touch any existing open claim: the dp
+    merge's commit condition."""
+    total = state.used + r_min[None, :]
+    t = total[:, None, None, :]
+    fit = jnp.all((t <= it.alloc[None]) | (t == 0.0), axis=-1)
+    alive_cap = jnp.any(
+        fit & it.group_valid[None] & state.its[:, :, None], axis=(1, 2)
+    )
+    return ~jnp.any(state.open & alive_cap)
+
+
+@jax.jit
+def fill_touched_below(fill_c: jnp.ndarray, w_lo: jnp.ndarray):
+    """[] bool — did any fill land on a window row < w_lo? The dp commit's
+    second condition: a speculative group must not have filled any row
+    that pre-existed its base (those rows may since have been filled by a
+    REPLAYED earlier group — deadness at commit time does not imply
+    deadness at speculation time, so a base-row fill invalidates the
+    speculation even when window_live_dead now holds)."""
+    W = fill_c.shape[-1]
+    rows = jnp.arange(W, dtype=jnp.int32)
+    return jnp.any((fill_c > 0) & (rows < w_lo)[None, :])
+
+
+@jax.jit
+def take_dp_row(tree, r: jnp.ndarray):
+    """Slice dp row r out of a batched spec-result pytree as ONE compiled
+    program (eagerly slicing ~24 sharded leaves enqueues that many tiny
+    multi-device programs — the merge loop keeps collective-bearing
+    computations strictly one-at-a-time, see _run_fill_dp)."""
+    return jax.tree_util.tree_map(lambda a: a[r], tree)
+
+
+@jax.jit
+def dp_commit_probe(
+    committed: SolverState,
+    it: InstanceTypeTensors,
+    r_min: jnp.ndarray,
+    fill_c: jnp.ndarray,
+    leftover: jnp.ndarray,
+    base_w_open: jnp.ndarray,
+):
+    """The per-group commit checks as ONE program: (all committed live
+    claims dead for the group, spec touched a pre-base row, total
+    leftover). Padded segments carry count=0 and thus leftover=0, so the
+    full-axis sum equals the live-segment sum."""
+    return (
+        window_live_dead(committed, it, r_min),
+        fill_touched_below(fill_c, base_w_open),
+        jnp.sum(leftover),
+    )
+
+
+@jax.jit
+def merge_shard_fill(
+    committed: SolverState,
+    spec: ShardFillState,
+    base_n_open: jnp.ndarray,
+    base_w_open: jnp.ndarray,
+) -> tuple[SolverState, jnp.ndarray]:
+    """Graft a committed speculative group onto the committed state: the
+    spec rows [base_w_open, spec.w_open) — fresh opens append contiguously
+    within one dispatch — land at committed.w_open.. with global ids
+    shifted by (committed.n_open - base_n_open). Exact under the commit
+    conditions (window_live_dead for the group, zero leftovers/spills, no
+    window or claim-axis overflow), which the caller checks BEFORE
+    dispatching this. Returns (merged, shifted_slot_map): the spec
+    dispatch's window->global map re-based into committed ids, i.e. the
+    decode's slot snapshot for the group's fill grids."""
+    W = committed.open.shape[0]
+    NB = committed.bank_frozen.shape[0]
+    base_n_open = jnp.asarray(base_n_open, dtype=jnp.int32)
+    base_w_open = jnp.asarray(base_w_open, dtype=jnp.int32)
+    k = spec.w_open - base_w_open
+    delta = committed.n_open - base_n_open
+    idx = jnp.arange(W, dtype=jnp.int32)
+    pos = idx - committed.w_open
+    grab = (pos >= 0) & (pos < k)
+    src = jnp.clip(base_w_open + pos, 0, W - 1)
+    shifted = jnp.where(
+        (spec.slot_of >= base_n_open) & (spec.slot_of < NB),
+        spec.slot_of + delta,
+        spec.slot_of,
+    )
+
+    def take(cf, sf):
+        g = grab.reshape(grab.shape + (1,) * (cf.ndim - 1))
+        return jnp.where(g, sf[src], cf)
+
+    reqs = kernels.select_set(
+        grab, kernels.take_set(spec.reqs, src), committed.reqs
+    )
+    w_open = committed.w_open + k
+    merged = committed._replace(
+        reqs=reqs,
+        used=take(committed.used, spec.used),
+        its=take(committed.its, spec.its),
+        template=take(committed.template, spec.template),
+        open=committed.open | grab,
+        pods=take(committed.pods, spec.pods),
+        slot_of=jnp.where(grab, shifted[src], committed.slot_of),
+        claim_ports=take(committed.claim_ports, spec.claim_ports),
+        held=take(committed.held, spec.held),
+        n_open=committed.n_open + (spec.n_open - base_n_open),
+        w_open=w_open,
+        w_hw=jnp.maximum(committed.w_hw, w_open),
+    )
+    return merged, shifted
 
 
 # ---------------------------------------------------------------------------
@@ -2084,7 +2346,7 @@ def _make_kind_step(
         comb = kernels.intersect_sets(state.reqs, pod_b)
         claim_ok = kernels.compatible_elemwise(state.reqs, pod_b, well_known)
         it_compat = kernels.intersects(it.reqs, comb).T  # [W, T]
-        viable0 = state.its & it_compat & xs.it_allow[None, :]
+        viable0 = shard_hint(state.its & it_compat & xs.it_allow[None, :], "dp", "it")
         tol = xs.tmpl_ok[state.template]
         ports_ok_n = ~kernels.packed_conflict(xs.port_conf[None, :], state.claim_ports)
         static_n0 = claim_ok & tol & ports_ok_n
@@ -2104,10 +2366,14 @@ def _make_kind_step(
         # counters within a segment, so this extends an existing
         # convention across same-request boundaries, not a new one.
         grid_reused = grid_valid & jnp.all(requests == grid_req)
-        grid_n = jax.lax.cond(
-            grid_reused,
-            lambda: grid_prev,
-            lambda: _cap_res_grid(state.used, requests, it),
+        grid_n = shard_hint(
+            jax.lax.cond(
+                grid_reused,
+                lambda: grid_prev,
+                lambda: _cap_res_grid(state.used, requests, it),
+            ),
+            "dp",
+            "it",
         )  # [W, T, GR]
         capd_n0 = _kscan_capd(
             grid_n, viable0, ct_n, zfull_n, it, key_kid, zone_kid, D
@@ -2586,7 +2852,7 @@ def solve_kind_scan(
     T, GR, R = it.alloc.shape
     carry0 = (
         state,
-        jnp.zeros((W, T, GR), dtype=jnp.int32),
+        shard_hint(jnp.zeros((W, T, GR), dtype=jnp.int32), "dp", "it"),
         jnp.zeros((R,), dtype=jnp.float32),
         jnp.bool_(False),
     )

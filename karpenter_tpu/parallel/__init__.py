@@ -7,17 +7,24 @@ tensors over a jax.sharding.Mesh and letting XLA insert ICI collectives:
   "it" axis   instance-type (tensor-parallel) sharding of the catalog —
               the [claims × instance-types] triple mask is computed on
               shards and any-reduced (psum) across devices
-  "dp" axis   batch-of-problems data parallelism — consolidation what-ifs
-              and bucketed scheduling batches are independent problems
-              vmapped over the leading axis
+  "dp" axis   claims/pods data parallelism — the hot [W, T] viability
+              masks, bank [NCAP, T] columns and kscan [W, T, GR] grid
+              shard their claims axis over dp rows (ops.solver.shard_hint
+              annotations), and the pipelined fill's chunk groups solve
+              SPECULATIVELY one-per-dp-row in a single batched dispatch,
+              merged exact-or-replay against the frozen-bank contract
+              (ops.solver.solve_fill_dp / merge_shard_fill)
 
-DCN enters only for multi-slice scale-out; a single solve call never
-crosses it.
+The split honors the KTPU_MESH="<dp>x<it>" env override (validated
+against jax.device_count()), else auto-factorizes. DCN enters only for
+multi-slice scale-out; a single solve call never crosses it.
 """
 
 from karpenter_tpu.parallel.mesh import (  # noqa: F401
+    factorize_mesh,
     make_mesh,
     pad_axis_to,
+    parse_mesh_override,
     shard_instance_types,
     sharded_solve,
 )

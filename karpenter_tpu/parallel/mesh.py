@@ -15,24 +15,67 @@ from karpenter_tpu.ops import solver as ops_solver
 from karpenter_tpu.ops.encode import InstanceTypeTensors, ReqSetTensors
 
 
-def make_mesh(n_devices: Optional[int] = None, axis_names: tuple[str, str] = ("dp", "it")) -> Mesh:
-    """A 2D (dp × it) mesh over the available devices.
-
-    Factorizes n into the most square (dp, it) split with it >= dp, so the
-    instance-type axis (the bigger tensor dimension) gets the larger share.
-    """
-    devices = jax.devices()
-    n = n_devices or len(devices)
-    if len(devices) < n:
-        raise ValueError(f"need {n} devices, have {len(devices)}")
-    devices = devices[:n]
+def factorize_mesh(n: int) -> tuple[int, int]:
+    """The most square (dp, it) split of n with it >= dp, so the
+    instance-type axis (the bigger tensor dimension) gets the larger
+    share."""
     dp = 1
     for cand in range(int(math.isqrt(n)), 0, -1):
         if n % cand == 0:
             dp = cand
             break
-    it = n // dp
-    return Mesh(np.array(devices).reshape(dp, it), axis_names)
+    return dp, n // dp
+
+
+def parse_mesh_override(spec: str) -> tuple[int, int]:
+    """Parse a KTPU_MESH override of the form "<dp>x<it>" (e.g. "2x4").
+    Raises ValueError with a message naming the knob on malformed input."""
+    parts = spec.lower().split("x")
+    try:
+        if len(parts) != 2:
+            raise ValueError(spec)
+        dp, it = int(parts[0]), int(parts[1])
+        if dp < 1 or it < 1:
+            raise ValueError(spec)
+    except ValueError:
+        raise ValueError(
+            f"KTPU_MESH={spec!r} is not a valid mesh spec; expected "
+            '"<dp>x<it>" with positive integers, e.g. "2x4"'
+        ) from None
+    return dp, it
+
+
+def make_mesh(n_devices: Optional[int] = None, axis_names: tuple[str, str] = ("dp", "it")) -> Mesh:
+    """A 2D (dp × it) mesh over the available devices.
+
+    The split comes from the KTPU_MESH env override ("<dp>x<it>", e.g.
+    "2x4" — validated against jax.device_count()) when set, else from the
+    most square auto-factorization of n_devices (factorize_mesh).
+    """
+    import os
+
+    devices = jax.devices()
+    override = os.environ.get("KTPU_MESH", "").strip()
+    if override:
+        dp, it = parse_mesh_override(override)
+        n = dp * it
+        if n_devices is not None and n_devices != n:
+            raise ValueError(
+                f"KTPU_MESH={override!r} asks for {dp}x{it}={n} devices but "
+                f"the caller requested {n_devices}; drop one of the two"
+            )
+        if len(devices) < n:
+            raise ValueError(
+                f"KTPU_MESH={override!r} asks for {dp}x{it}={n} devices, "
+                f"have {len(devices)} (jax.device_count()); use a split "
+                f"whose product is <= the device count"
+            )
+    else:
+        n = n_devices or len(devices)
+        if len(devices) < n:
+            raise ValueError(f"need {n} devices, have {len(devices)}")
+        dp, it = factorize_mesh(n)
+    return Mesh(np.array(devices[:n]).reshape(dp, it), axis_names)
 
 
 def pad_axis_to(x: jnp.ndarray, axis: int, size: int, fill=0):

@@ -242,15 +242,17 @@ def decode_templates(data: bytes) -> list[ClaimTemplate]:
 
 # -- SolveStream columnar chunk tables (ISSUE 7 satellite) -------------------
 #
-# The legacy chunk frame re-encodes each decoded chunk group's per-pod
-# tables as a partial SolveResponse protobuf, which the client walks
+# The legacy chunk frame re-encoded each decoded chunk group's per-pod
+# tables as a partial SolveResponse protobuf, which the client walked
 # per-field in Python. The columnar layout flattens the same three tables
 # (claim fragments, existing assignments, unschedulable entries) into
 # little-endian int32 column arrays plus one UTF-8 string blob, so the
 # client rebuilds them from numpy views over the frame buffer — one
 # np.frombuffer per column instead of a protobuf parse + per-message
-# Python loops. KTPU_RPC_COLUMNAR=0 keeps the server on the legacy frame
-# for one release (old clients cannot decode the new tag).
+# Python loops. The server is columnar-only since the frame soaked a
+# release (ISSUE 8 satellite: the KTPU_RPC_COLUMNAR=0 branch and its
+# protobuf re-encode are gone); the CLIENT still decodes the legacy
+# FRAME_CHUNK tag so a downgraded server interops.
 #
 # Layout (all u32/i32 little-endian):
 #   header: n_claim_groups, n_claim_uids, n_exist, n_unsched, blob_len

@@ -36,6 +36,9 @@ SERVICE_NAME = "karpenter_tpu.solver.v1.Solver"
 # client's stitching state machine robust to stale frames: a chunk whose
 # round predates the last reset is discarded, never stitched (the
 # mid-stream-recovery hazard — see rpc/client.StreamStitcher).
+# LEGACY chunk tag: the server stopped emitting these after the columnar
+# frame soaked a release (ISSUE 8 satellite), but the tag stays reserved
+# and the CLIENT still decodes it so a downgraded/old server interops
 FRAME_CHUNK = b"\x01"  # round + partial per-pod tables from one chunk group
 FRAME_FINAL_SLIM = b"\x02"  # final response MINUS the already-streamed tables
 FRAME_RESET = b"\x03"  # round; a relaxation round/fallback invalidated chunks
@@ -43,38 +46,14 @@ FRAME_FINAL_FULL = b"\x04"  # complete response (nothing was streamed)
 # zero-copy chunk tables (ISSUE 7 satellite): round + flat columnar
 # layout (rpc/codec.encode_chunk_columnar) instead of a per-chunk partial
 # SolveResponse — the client rebuilds the tables from numpy views over
-# the frame buffer. KTPU_RPC_COLUMNAR=0 keeps the server on FRAME_CHUNK
-# for one release (clients always decode both tags).
+# the frame buffer. The server is columnar-ONLY (the KTPU_RPC_COLUMNAR=0
+# opt-out and its protobuf re-encode path were deleted once the frame
+# soaked a release).
 FRAME_CHUNK_COL = b"\x05"
-
-
-def columnar_enabled() -> bool:
-    return os.environ.get("KTPU_RPC_COLUMNAR", "1") not in ("0", "false")
 
 
 def _round_bytes(round_no: int) -> bytes:
     return round_no.to_bytes(4, "big")
-
-
-def _chunk_to_pb(delta: dict) -> pb.SolveResponse:
-    """One decoded chunk group's per-pod table deltas as a (partial)
-    SolveResponse: claim fragments carry only (slot, pod_uids) — order
-    preserved, the client appends per slot; existing assignments and
-    unschedulable entries ride their repeated fields. The assignments map
-    is NOT used (proto maps drop insertion order, and claim pod order is
-    parity-relevant)."""
-    resp = pb.SolveResponse()
-    for slot, uids in delta["claims"]:
-        m = resp.claims.add()
-        m.slot = slot
-        m.pod_uids.extend(uids)
-    for uid, node_name in delta["existing"]:
-        a = resp.existing_assignments.add()
-        a.pod_uid, a.node_name = uid, node_name
-    for uid, reason in delta["unsched"]:
-        u = resp.unschedulable.add()
-        u.pod_uid, u.reason = uid, reason
-    return resp
 
 
 class SolverService:
@@ -196,8 +175,6 @@ class SolverService:
         round_no = [0]  # bumps with every EMITTED reset frame
         _DONE = object()
 
-        columnar = columnar_enabled()
-
         def sink(event) -> None:
             kind, delta = event
             if kind == "reset":
@@ -205,7 +182,7 @@ class SolverService:
                     round_no[0] += 1
                     frames.put(FRAME_RESET + _round_bytes(round_no[0]))
                 streamed[0] = False
-            elif columnar:
+            else:
                 from karpenter_tpu.rpc.codec import encode_chunk_columnar
 
                 streamed[0] = True
@@ -213,13 +190,6 @@ class SolverService:
                     FRAME_CHUNK_COL
                     + _round_bytes(round_no[0])
                     + encode_chunk_columnar(delta)
-                )
-            else:
-                streamed[0] = True
-                frames.put(
-                    FRAME_CHUNK
-                    + _round_bytes(round_no[0])
-                    + _chunk_to_pb(delta).SerializeToString()
                 )
 
         # the solve runs in a worker so the handler thread can yield chunk

@@ -459,3 +459,20 @@ GANG_WAIT_DURATION = REGISTRY.histogram(
     " arrived (observed when the gang completes; KTPU_GANG_WAIT_SECONDS"
     " bounds the wait between timeout reports)",
 )
+# ---- dp-sharded mesh solve (PR 8) ----
+SHARD_MERGE_ROUNDS = REGISTRY.counter(
+    "ktpu_shard_merge_rounds_total",
+    "dp-shard fill chunk-group merge outcomes: committed (the speculative"
+    " per-shard solve was provably independent of the committed claims —"
+    " window_live_dead held, zero leftovers/spills, no window or"
+    " claim-axis overflow — and grafted exactly) vs replayed (a commit"
+    " check failed and the group re-dispatched sequentially; bit-parity"
+    " holds either way)",
+    ("outcome",),
+)
+SHARD_REPLICATED_BYTES = REGISTRY.gauge(
+    "ktpu_shard_replicated_bytes",
+    "Estimated bytes of per-kind encode tensors still replicated to every"
+    " mesh device in the last meshed solve (the catalog, [.., T] masks and"
+    " window/bank columns shard over (dp × it) and are excluded)",
+)

@@ -1,0 +1,162 @@
+"""Sharded-vs-unsharded bit-parity in a FRESH backend (ISSUE 8 satellite).
+
+One subprocess (pattern: tests/test_compile_cache.py restart child) forces
+XLA_FLAGS=--xla_force_host_platform_device_count=8 + the KTPU_MESH=2x4
+env override, then pins small fill / kscan / perpod solves on the
+(dp × it) mesh bit-identical to the single-device solve AND the host
+oracle, windowed and un-windowed. The in-process dp-merge differential
+suite lives in tests/test_shard.py; this twin proves the same parity
+holds under a cold backend with the mesh built purely from env knobs
+(the deployment configuration the solver server uses).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_CHILD = r"""
+import os, json
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["KTPU_PIPELINE_CHUNKS"] = "3"
+os.environ["KTPU_PIPELINE_MIN_PODS"] = "32"
+from karpenter_tpu.utils.accel import force_cpu
+force_cpu()
+
+import numpy as np
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.controllers.provisioning import TPUScheduler, build_templates
+from karpenter_tpu.controllers.provisioning.host_scheduler import HostScheduler
+from karpenter_tpu.controllers.provisioning.topology import Topology, build_universe_domains
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import PodAffinityTerm, TopologySpreadConstraint, make_pod
+from karpenter_tpu.parallel import make_mesh
+
+N_TYPES = 24  # >= 12 so every kind (incl. the 2-cpu saturating ones) schedules
+
+def make_templates():
+    pool = NodePool(); pool.metadata.name = "default"
+    return build_templates([(pool, instance_types(N_TYPES))])
+
+def fill_pods():
+    # mixed-size kinds (dp replay rung) + saturating kinds (dp graft rung)
+    pods = []
+    for i in range(96):
+        k = i // 16
+        pods.append(make_pod(f"f-{i}", cpu=[0.25, 0.5, 1.0][k % 3],
+                             memory=f"{[0.5, 1.0][k % 2]}Gi"))
+    for i in range(96):
+        p = make_pod(f"g-{i}", cpu=2.0, memory="1Gi")
+        p.metadata.labels = {"grp": str(i // 24)}
+        pods.append(p)
+    return pods
+
+def kscan_pods():
+    pods = fill_pods()[:64]
+    for i in range(48):
+        p = make_pod(f"z-{i}", cpu=0.5, memory="0.5Gi")
+        p.metadata.labels = {"spread": "z"}
+        p.spec.topology_spread_constraints = [TopologySpreadConstraint(
+            max_skew=1, topology_key=l.LABEL_TOPOLOGY_ZONE,
+            label_selector={"spread": "z"})]
+        pods.append(p)
+    return pods
+
+def perpod_pods():
+    pods = fill_pods()[:64]
+    for i in range(24):
+        p = make_pod(f"h-{i}", cpu=0.5, memory="0.5Gi")
+        p.metadata.labels = {"app": "web"}
+        p.spec.pod_anti_affinity = [PodAffinityTerm(
+            topology_key=l.LABEL_HOSTNAME, label_selector={"app": "web"})]
+        pods.append(p)
+    return pods
+
+def host_solve(pods):
+    templates = make_templates()
+    topo = Topology.build(list(pods), build_universe_domains(templates, []), [])
+    return HostScheduler(templates, topology=topo).solve(list(pods))
+
+def identical(a, b):
+    if a.assignments != b.assignments: return "assignments"
+    if a.existing_assignments != b.existing_assignments: return "existing"
+    if len(a.claims) != len(b.claims): return "n_claims"
+    if [(p.uid, r) for p, r in a.unschedulable] != [(p.uid, r) for p, r in b.unschedulable]:
+        return "unschedulable"
+    for x, y in zip(a.claims, b.claims):
+        if x.hostname != y.hostname: return "hostname"
+        if [it.name for it in x.instance_types] != [it.name for it in y.instance_types]:
+            return "instance_types"
+        if x.used != y.used: return "used"
+        if str(x.requirements) != str(y.requirements): return "requirements"
+    return ""
+
+def matches_host(host, dev):
+    if len(host.claims) != len(dev.claims): return "n_claims"
+    if host.assignments != dev.assignments: return "assignments"
+    for slot, hc in {c.slot: c for c in host.claims}.items():
+        tc = {c.slot: c for c in dev.claims}[slot]
+        if [p.uid for p in hc.pods] != [p.uid for p in tc.pods]: return "pods"
+        if {it.name for it in hc.instance_types} != {it.name for it in tc.instance_types}:
+            return "instance_types"
+        for k, v in hc.used.items():
+            if abs(tc.used.get(k, 0.0) - v) > 1e-9: return "used"
+    return ""
+
+mesh = make_mesh()  # KTPU_MESH=2x4 from env
+out = {"mesh": dict((k, int(v)) for k, v in mesh.shape.items())}
+cases = [("fill", fill_pods()), ("kscan", kscan_pods()), ("perpod", perpod_pods())]
+for name, pods in cases:
+    for window in (0, 48):
+        if window:
+            os.environ["KTPU_SCAN_WINDOW"] = str(window)
+        else:
+            os.environ.pop("KTPU_SCAN_WINDOW", None)
+        meshed_sched = TPUScheduler(make_templates(), mesh=mesh)
+        meshed = meshed_sched.solve(list(pods))
+        single = TPUScheduler(make_templates()).solve(list(pods))
+        rec = {
+            "diff": identical(meshed, single),
+            "host_diff": matches_host(host_solve(pods), meshed),
+            "claims": len(meshed.claims),
+        }
+        shard = (meshed_sched.last_timings or {}).get("shard") or {}
+        rec["merge_rounds"] = shard.get("merge_rounds", 0)
+        rec["committed"] = shard.get("groups_committed", 0)
+        rec["replayed"] = shard.get("groups_replayed", 0)
+        out[f"{name}_w{window}"] = rec
+print(json.dumps(out))
+"""
+
+
+def test_sharded_solves_bit_identical_in_fresh_backend(tmp_path):
+    env = dict(os.environ)
+    env["KTPU_MESH"] = "2x4"
+    env.pop("KTPU_SCAN_WINDOW", None)
+    out = subprocess.run(
+        [sys.executable, "-c", _CHILD],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=560,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stderr[-3000:]
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    # the env override shaped the mesh
+    assert res.pop("mesh") == {"dp": 2, "it": 4}
+    for case, rec in res.items():
+        assert rec["diff"] == "", f"{case}: meshed != single-device ({rec['diff']})"
+        assert rec["host_diff"] == "", f"{case}: meshed != host oracle ({rec['host_diff']})"
+        assert rec["claims"] >= 1, case
+    # the fill cases must actually exercise the dp merge loop, and the
+    # saturating kinds must commit at least one speculative graft
+    assert res["fill_w0"]["merge_rounds"] >= 1
+    assert res["fill_w0"]["committed"] >= 1, res["fill_w0"]
+    assert res["fill_w48"]["merge_rounds"] >= 1
+    # topology cases are dp-ineligible by design (shared count state)
+    assert res["kscan_w0"]["merge_rounds"] == 0
+    assert res["perpod_w0"]["merge_rounds"] == 0

@@ -164,6 +164,25 @@ def test_resident_solver_families_are_registered():
         assert fam.help.strip()
 
 
+def test_shard_families_are_registered():
+    """ISSUE-8 families: dp-shard merge outcomes and the replicated-bytes
+    estimate, with the documented types and labels (bench --report-shard
+    and last_timings['shard'] carry the same numbers)."""
+    from karpenter_tpu.utils.metrics import Counter, Gauge
+
+    fams = {f.name: f for f in _families()}
+    expected = {
+        "ktpu_shard_merge_rounds_total": (Counter, ("outcome",)),
+        "ktpu_shard_replicated_bytes": (Gauge, ()),
+    }
+    for name, (cls, labels) in expected.items():
+        fam = fams.get(name)
+        assert fam is not None, f"{name} not registered"
+        assert isinstance(fam, cls), (name, type(fam).__name__)
+        assert fam.label_names == labels, (name, fam.label_names)
+        assert fam.help.strip()
+
+
 def test_counters_end_in_total_and_histograms_in_seconds_or_pods():
     """Unit-suffix discipline for NEW families (grandfathered names keep
     their reference spellings verbatim)."""

@@ -27,6 +27,47 @@ def test_mesh_too_few_devices():
         make_mesh(16)
 
 
+def test_factorize_mesh():
+    from karpenter_tpu.parallel import factorize_mesh
+
+    assert factorize_mesh(8) == (2, 4)
+    assert factorize_mesh(4) == (2, 2)
+    assert factorize_mesh(16) == (4, 4)
+    assert factorize_mesh(6) == (2, 3)
+    assert factorize_mesh(7) == (1, 7)
+    assert factorize_mesh(1) == (1, 1)
+
+
+def test_parse_mesh_override():
+    from karpenter_tpu.parallel import parse_mesh_override
+
+    assert parse_mesh_override("2x4") == (2, 4)
+    assert parse_mesh_override("8X1") == (8, 1)
+    for bad in ("", "2x", "x4", "2x4x2", "axb", "0x4", "-1x4", "2.5x2"):
+        with pytest.raises(ValueError, match="KTPU_MESH"):
+            parse_mesh_override(bad)
+
+
+def test_mesh_env_override(monkeypatch):
+    monkeypatch.setenv("KTPU_MESH", "4x2")
+    mesh = make_mesh()
+    assert dict(mesh.shape) == {"dp": 4, "it": 2}
+    # n_devices consistent with the override is fine
+    assert dict(make_mesh(8).shape) == {"dp": 4, "it": 2}
+
+
+def test_mesh_env_override_validation(monkeypatch):
+    monkeypatch.setenv("KTPU_MESH", "3x3")
+    with pytest.raises(ValueError, match="have 8"):
+        make_mesh()
+    monkeypatch.setenv("KTPU_MESH", "2x2")
+    with pytest.raises(ValueError, match="caller requested 8"):
+        make_mesh(8)
+    monkeypatch.setenv("KTPU_MESH", "nope")
+    with pytest.raises(ValueError, match="not a valid mesh spec"):
+        make_mesh()
+
+
 def test_sharded_solve_matches_unsharded():
     import __graft_entry__ as ge
 

@@ -350,8 +350,9 @@ class TestStreamStitcher:
 class TestColumnarChunkFrames:
     """ISSUE-7 satellite: the zero-copy columnar chunk layout must stitch
     into EXACTLY the tables the legacy protobuf frames produce, respect
-    the same round tagging, and round-trip every table shape; the legacy
-    decode stays available behind KTPU_RPC_COLUMNAR=0."""
+    the same round tagging, and round-trip every table shape. Since
+    ISSUE 8 the server emits ONLY columnar frames; the CLIENT keeps
+    decoding the legacy tag for old-server downgrade."""
 
     @staticmethod
     def _col_chunk(round_no, delta):
@@ -416,13 +417,26 @@ class TestColumnarChunkFrames:
         assert s.tables()["claims"] == {0: ["new"]}
         assert s.n_stale == 1
 
-    def test_server_emission_respects_opt_out(self, monkeypatch):
-        from karpenter_tpu.rpc.service import columnar_enabled
+    def test_server_is_columnar_only(self, monkeypatch):
+        """ISSUE-8 satellite: the legacy-frame server branch is GONE —
+        the opt-out knob and the protobuf chunk re-encode no longer
+        exist, while the client keeps decoding the legacy tag (the
+        downgrade direction an old server needs)."""
+        import karpenter_tpu.rpc.service as service
+        from karpenter_tpu.rpc.client import StreamStitcher
 
-        monkeypatch.delenv("KTPU_RPC_COLUMNAR", raising=False)
-        assert columnar_enabled()
-        monkeypatch.setenv("KTPU_RPC_COLUMNAR", "0")
-        assert not columnar_enabled()
+        monkeypatch.setenv("KTPU_RPC_COLUMNAR", "0")  # must be inert now
+        assert not hasattr(service, "columnar_enabled")
+        assert not hasattr(service, "_chunk_to_pb")
+        # legacy frames synthesized by an old server still stitch
+        s = StreamStitcher()
+        s.feed(
+            TestStreamStitcher._chunk(
+                0, claims=[(0, ["legacy-uid"])], exist=[], unsched=[]
+            )
+        )
+        s.feed(TestStreamStitcher._final())
+        assert s.tables()["claims"] == {0: ["legacy-uid"]}
 
 
 class TestPipelineThroughSocket:

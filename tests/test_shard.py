@@ -1,0 +1,287 @@
+"""dp-sharded solve over the (dp × it) mesh (ISSUE 8).
+
+The mesh's dp axis does real work two ways: (a) explicit shard_hint
+annotations keep the hot [W, T] viability masks, bank [NCAP, T] columns
+and kscan [W, T, GR] grid partitioned over (dp × it) instead of
+replicated, and (b) the pipelined fill's chunk groups solve
+SPECULATIVELY one-per-dp-row in a single batched dispatch
+(ops_solver.solve_fill_dp), merged exact-or-replay: a group grafts
+without re-solving only when every live committed claim is provably
+capacity-dead for it (window_live_dead — the frozen-bank eviction rule),
+else it replays sequentially. Either way the result must be BIT-identical
+to the single-device solve and the host oracle — these tests pin that,
+plus the fetch_tree regression the sharded outputs exposed.
+
+Everything here runs in-process on the 8-virtual-device CPU mesh the
+whole suite already forces (tests/conftest.py); the subprocess twin with
+a fresh backend + KTPU_MESH override lives in tests/test_mesh_parity.py.
+"""
+
+import numpy as np
+
+import bench
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.controllers.provisioning import TPUScheduler, build_templates
+from karpenter_tpu.models import labels as l
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import TopologySpreadConstraint, make_pod
+from karpenter_tpu.parallel import make_mesh
+
+from test_solver import assert_same_packing
+
+
+def make_templates(n_types=24):
+    pool = NodePool()
+    pool.metadata.name = "default"
+    return build_templates([(pool, instance_types(n_types))])
+
+
+def mixed_kind_pods(n=256, kinds=8, prefix="m"):
+    """Distinct-size kinds: later (smaller) kinds still fit earlier
+    kinds' part-full claims, so the dp commit check FAILS and groups
+    replay — the adversarial case for the merge."""
+    pods = []
+    per = n // kinds
+    for i in range(n):
+        k = i // per
+        pods.append(
+            make_pod(
+                f"{prefix}-{i}",
+                cpu=[0.25, 0.5, 1.0][k % 3],
+                memory=f"{[0.5, 1.0][k % 2]}Gi",
+            )
+        )
+    return pods
+
+
+def saturating_kind_pods(n=256, kinds=8, prefix="s"):
+    """Identical-size kinds big enough that every claim fills to capacity
+    — committed claims go capacity-dead immediately, so speculative
+    groups GRAFT without replaying."""
+    pods = []
+    per = n // kinds
+    for i in range(n):
+        p = make_pod(f"{prefix}-{i}", cpu=2.0, memory="1Gi")
+        p.metadata.labels = {"grp": str(i // per)}
+        pods.append(p)
+    return pods
+
+
+def dp_scheduler(monkeypatch, *, window=0, chunks=4, enabled=True, n_types=24):
+    """A meshed TPUScheduler with the pipeline forced on so the dp path
+    engages at test sizes."""
+    monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", str(chunks))
+    monkeypatch.setenv("KTPU_PIPELINE_MIN_PODS", "32")
+    if window:
+        monkeypatch.setenv("KTPU_SCAN_WINDOW", str(window))
+    else:
+        monkeypatch.delenv("KTPU_SCAN_WINDOW", raising=False)
+    if not enabled:
+        monkeypatch.setenv("KTPU_SHARD_DP", "0")
+    else:
+        monkeypatch.delenv("KTPU_SHARD_DP", raising=False)
+    return TPUScheduler(make_templates(n_types), mesh=make_mesh(8))
+
+
+def assert_bit_identical(meshed, single):
+    assert meshed.assignments == single.assignments
+    assert meshed.existing_assignments == single.existing_assignments
+    assert len(meshed.claims) == len(single.claims)
+    assert [(p.uid, r) for p, r in meshed.unschedulable] == [
+        (p.uid, r) for p, r in single.unschedulable
+    ]
+    for a, b in zip(meshed.claims, single.claims):
+        assert a.slot == b.slot
+        assert a.hostname == b.hostname
+        assert [it.name for it in a.instance_types] == [
+            it.name for it in b.instance_types
+        ]
+        assert a.used == b.used
+        assert str(a.requirements) == str(b.requirements)
+
+
+class TestDpFillParity:
+    def test_replay_path_bit_identical(self, monkeypatch):
+        """Mixed-size kinds couple chunk groups through tier-2 water
+        fills: the commit check must fail and the replay rung must keep
+        the solve bit-identical to single-device AND the host oracle."""
+        pods = mixed_kind_pods(256)
+        sched = dp_scheduler(monkeypatch)
+        meshed = sched.solve(pods)
+        shard = sched.last_timings["shard"]
+        assert shard["merge_rounds"] >= 1
+        assert shard["groups_replayed"] >= 1
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        single = TPUScheduler(make_templates()).solve(pods)
+        assert_bit_identical(meshed, single)
+        href, _ = bench.host_solve(make_templates(), pods)
+        assert_same_packing(href, meshed)
+
+    def test_graft_path_bit_identical(self, monkeypatch):
+        """Saturating kinds leave every committed claim capacity-dead, so
+        speculative groups graft WITHOUT replaying — and stay
+        bit-identical (the commit conditions are a proof, not a
+        heuristic)."""
+        pods = saturating_kind_pods(256)
+        sched = dp_scheduler(monkeypatch)
+        meshed = sched.solve(pods)
+        shard = sched.last_timings["shard"]
+        assert shard["groups_committed"] >= 2, shard
+        assert shard["groups_replayed"] == 0, shard
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        single = TPUScheduler(make_templates()).solve(pods)
+        assert_bit_identical(meshed, single)
+        href, _ = bench.host_solve(make_templates(), pods)
+        assert_same_packing(href, meshed)
+
+    def test_windowed_dp_bit_identical(self, monkeypatch):
+        """The dp merge under a small active window: graft appends must
+        respect window occupancy (overflow falls back to replay + the
+        existing spill escalation) and stay bit-identical."""
+        pods = mixed_kind_pods(256, prefix="w")
+        sched = dp_scheduler(monkeypatch, window=48)
+        meshed = sched.solve(pods)
+        assert sched.last_timings["shard"]["merge_rounds"] >= 1
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        monkeypatch.setenv("KTPU_SCAN_WINDOW", "48")
+        single = TPUScheduler(make_templates()).solve(pods)
+        assert_bit_identical(meshed, single)
+
+    def test_windowed_graft_bit_identical(self, monkeypatch):
+        pods = saturating_kind_pods(256, prefix="wg")
+        sched = dp_scheduler(monkeypatch, window=64)
+        meshed = sched.solve(pods)
+        assert sched.last_timings["shard"]["groups_committed"] >= 1
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        monkeypatch.setenv("KTPU_SCAN_WINDOW", "64")
+        single = TPUScheduler(make_templates()).solve(pods)
+        assert_bit_identical(meshed, single)
+
+    def test_topology_problem_ineligible_but_identical(self, monkeypatch):
+        """Topology interaction disqualifies the speculative path (shared
+        vg/hg count state crosses groups); the meshed solve must still be
+        bit-identical through the annotated fill/kscan/perpod kernels."""
+        pods = mixed_kind_pods(128, prefix="t")
+        for i in range(32):
+            p = make_pod(f"tz-{i}", cpu=0.5, memory="0.5Gi")
+            p.metadata.labels = {"spread": "z"}
+            p.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=l.LABEL_TOPOLOGY_ZONE,
+                    label_selector={"spread": "z"},
+                )
+            ]
+            pods.append(p)
+        sched = dp_scheduler(monkeypatch)
+        meshed = sched.solve(pods)
+        assert sched.last_timings["shard"]["merge_rounds"] == 0
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        single = TPUScheduler(make_templates()).solve(pods)
+        assert_bit_identical(meshed, single)
+
+    def test_shard_dp_opt_out(self, monkeypatch):
+        """KTPU_SHARD_DP=0 keeps the meshed solve on the sequential
+        pipeline (zero merge rounds) with identical results."""
+        pods = saturating_kind_pods(128, kinds=4, prefix="o")
+        sched = dp_scheduler(monkeypatch, enabled=False)
+        meshed = sched.solve(pods)
+        assert sched.last_timings["shard"]["merge_rounds"] == 0
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        single = TPUScheduler(make_templates()).solve(pods)
+        assert_bit_identical(meshed, single)
+
+
+class TestShardObservability:
+    def test_last_timings_shard_record(self, monkeypatch):
+        """Every meshed solve records the mesh extents, merge/commit
+        counters, per-group pod counts and the replicated-bytes estimate;
+        un-meshed solves record nothing."""
+        pods = saturating_kind_pods(128, kinds=4, prefix="obs")
+        sched = dp_scheduler(monkeypatch)
+        sched.solve(pods)
+        shard = sched.last_timings["shard"]
+        assert shard["dp"] == 2 and shard["it"] == 4
+        assert shard["merge_rounds"] >= 1
+        assert shard["groups_committed"] + shard["groups_replayed"] == len(
+            shard["group_pods"]
+        )
+        assert sum(shard["group_pods"]) == len(pods)
+        assert shard["replicated_bytes"] > 0
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        plain = TPUScheduler(make_templates())
+        plain.solve(pods)
+        assert "shard" not in plain.last_timings
+
+    def test_merge_round_metrics(self, monkeypatch):
+        from karpenter_tpu.utils.metrics import (
+            SHARD_MERGE_ROUNDS,
+            SHARD_REPLICATED_BYTES,
+        )
+
+        c0 = SHARD_MERGE_ROUNDS.get(outcome="committed")
+        r0 = SHARD_MERGE_ROUNDS.get(outcome="replayed")
+        sched = dp_scheduler(monkeypatch)
+        sched.solve(saturating_kind_pods(128, kinds=4, prefix="met"))
+        shard = sched.last_timings["shard"]
+        assert (
+            SHARD_MERGE_ROUNDS.get(outcome="committed") - c0
+            == shard["groups_committed"]
+        )
+        assert (
+            SHARD_MERGE_ROUNDS.get(outcome="replayed") - r0
+            == shard["groups_replayed"]
+        )
+        assert SHARD_REPLICATED_BYTES.get() == shard["replicated_bytes"]
+
+
+class TestFetchTreeSharded:
+    def test_wire_pack_of_partitioned_arrays(self):
+        """Regression: the jitted wire packer miscompiles under GSPMD
+        when any input is partitioned (ints came back scaled by the shard
+        count, bools bit-shifted). fetch_tree must canonicalize to
+        replicated before packing."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from karpenter_tpu.ops.kernels import fetch_tree
+
+        mesh = make_mesh(8)
+        bools = np.random.default_rng(0).random((512, 24)) > 0.5
+        ints = np.arange(512, dtype=np.int32)
+
+        @jax.jit
+        def f(b, i):
+            b = jax.lax.with_sharding_constraint(
+                b, NamedSharding(mesh, P("dp", "it"))
+            )
+            return b, i * 1
+
+        with mesh:
+            b_s, i_s = f(jnp.asarray(bools), jnp.asarray(ints))
+        got_b, got_i = fetch_tree([b_s, i_s])
+        np.testing.assert_array_equal(np.asarray(got_b), bools)
+        np.testing.assert_array_equal(np.asarray(got_i), ints)
+
+    def test_uneven_shard_axes(self):
+        """Uneven (non-divisible) shard extents must round-trip too."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from karpenter_tpu.ops.kernels import fetch_tree
+
+        mesh = make_mesh(8)
+        vals = np.arange(77 * 13, dtype=np.int32).reshape(77, 13)
+
+        @jax.jit
+        def f(x):
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P("dp", "it"))
+            )
+
+        with mesh:
+            x = f(jnp.asarray(vals))
+        (got,) = fetch_tree([x])
+        np.testing.assert_array_equal(np.asarray(got), vals)
