@@ -642,6 +642,96 @@ def run_chaos_stage(on_tpu: bool) -> dict:
     return out
 
 
+def run_guard_stage(on_tpu: bool) -> dict:
+    """--guard (ISSUE 10): the guardrails cost model, in two halves.
+
+    1. Disabled steady state (audit rate 0, the production default): a
+       solve crosses a handful of ``should_audit`` gates; budget 1000
+       crossings and demand they cost < 1% of a measured clean solve —
+       the same discipline as the fault-point and tracer overhead gates.
+       Hard-asserted.
+    2. Paid path (rate 1.0): one resident delta round under a forced
+       shadow audit. The exact twin is a cold full re-solve, so its cost
+       is REPORTED (twin_s vs the audited round's wall), not gated —
+       operators pick a production KTPU_GUARD_AUDIT_RATE from these two
+       numbers.
+    """
+    import os
+
+    from karpenter_tpu import guard
+    from karpenter_tpu.controllers.provisioning import TPUScheduler
+    from karpenter_tpu.guard import config as guard_config
+    from karpenter_tpu.models.pod import make_pod
+
+    def kind_batch(name, n):
+        out = []
+        for i in range(n):
+            p = make_pod(f"{name}-{i}", cpu=1.0, memory="1Gi")
+            p.metadata.labels = {"app": name}
+            out.append(p)
+        return out
+
+    n_pods, n_types, max_claims = (
+        (16384, 400, 8192) if on_tpu else (2048, 100, 1024)
+    )
+    kind_size = 256
+    base = []
+    for k in range(max(n_pods // kind_size, 1)):
+        base.extend(kind_batch(f"base-{k}", kind_size))
+    os.environ.pop("KTPU_GUARD_AUDIT_RATE", None)
+    guard.QUARANTINE.reset()
+    guard.reset_log()
+    sched = TPUScheduler(make_templates(n_types), max_claims=max_claims)
+    sched.solve(list(base))  # cold compile
+    t0 = time.perf_counter()
+    baseline = sched.solve(list(base))
+    clean_wall = time.perf_counter() - t0
+    assert not baseline.unschedulable
+
+    # 1. the disabled gate: rate 0 short-circuits before any RNG draw
+    n_calls = 100_000
+    t0 = time.perf_counter()
+    for _ in range(n_calls):
+        guard_config.should_audit("resident")
+    per_call_s = (time.perf_counter() - t0) / n_calls
+    overhead_frac = (per_call_s * 1000) / clean_wall
+    assert overhead_frac < 0.01, (
+        f"disabled should_audit gates cost {100 * overhead_frac:.2f}% of a solve"
+    )
+
+    # 2. the paid path: a resident session takes one delta round with the
+    # audit forced on; the twin cost comes out of last_timings
+    session = sched.resident_session()
+    session.solve(list(base))
+    assert session.last_mode == "full"
+    os.environ["KTPU_GUARD_AUDIT_RATE"] = "1.0"
+    try:
+        delta = kind_batch("delta-audited", 64)
+        t0 = time.perf_counter()
+        result = session.solve(list(base + delta))
+        audited_wall = time.perf_counter() - t0
+    finally:
+        os.environ.pop("KTPU_GUARD_AUDIT_RATE", None)
+    assert not result.unschedulable
+    stats = session.last_timings["resident"]
+    assert stats["mode"] == "delta", stats["reason"]
+    assert stats["audit"]["verdict"] == "pass", stats["audit"]
+    verdicts: dict = {}
+    for rec in guard.AUDIT_LOG:
+        key = f"{rec['path']}:{rec['verdict']}"
+        verdicts[key] = verdicts.get(key, 0) + 1
+    return {
+        "pods": n_pods,
+        "types": n_types,
+        "clean_wall_s": round(clean_wall, 4),
+        "disabled_gate_ns": round(per_call_s * 1e9, 1),
+        "disabled_overhead_frac_of_solve": round(overhead_frac, 6),
+        "audited_round_wall_s": round(audited_wall, 4),
+        "audit_twin_s": round(stats["audit"]["twin_s"], 4),
+        "audit_verdicts": verdicts,
+    }
+
+
 def _print_padding_report(detail: dict) -> None:
     """--report-padding: per-solve padded-vs-real element waste, one line
     per (stage, axis). The JSON line still carries the same numbers under
@@ -742,6 +832,13 @@ def main() -> None:
         "fault plan and assert the wall gate still holds + the fault "
         "points' disabled-path overhead is < 1% of a solve",
     )
+    parser.add_argument(
+        "--guard",
+        action="store_true",
+        help="guardrails mode (ISSUE 10): assert the disabled-audit gates "
+        "cost < 1% of a solve, then run one resident delta round at "
+        "KTPU_GUARD_AUDIT_RATE=1.0 and report the shadow twin's cost",
+    )
     args = parser.parse_args()
 
     from karpenter_tpu.utils.accel import force_cpu_if_unavailable
@@ -783,6 +880,18 @@ def main() -> None:
                     "metric": "chaos_smoke",
                     "platform": platform,
                     "detail": run_chaos_stage(on_tpu),
+                }
+            )
+        )
+        return
+
+    if args.guard:
+        print(
+            json.dumps(
+                {
+                    "metric": "guard_smoke",
+                    "platform": platform,
+                    "detail": run_guard_stage(on_tpu),
                 }
             )
         )

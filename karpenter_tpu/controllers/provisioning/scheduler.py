@@ -33,6 +33,13 @@ from karpenter_tpu.controllers.provisioning.host_scheduler import (
     normalize_volume_reqs,
 )
 from karpenter_tpu.controllers.provisioning.nodeclaimtemplate import ClaimTemplate
+from karpenter_tpu.guard import (
+    QUARANTINE,
+    DispatchStallError,
+    run_guarded,
+)
+from karpenter_tpu.guard import audit as guard_audit
+from karpenter_tpu.guard import config as guard_config
 from karpenter_tpu.controllers.provisioning.topology import Topology, build_universe_domains
 from karpenter_tpu.models import labels as l
 from karpenter_tpu.models.pod import Pod
@@ -908,6 +915,9 @@ class TPUScheduler:
                 return host_solve("volume_undefined_key")
 
         base_existing = list(existing_nodes or [])
+        # problem context for guard divergence bundles: the shadow audits
+        # fire deep inside the dispatch where pods are already encoded
+        self._guard_problem = (list(pods), base_existing)
         # NO_ROOM escalation is per-solve: the next batch re-sizes from the
         # last observed need instead of inheriting a one-off doubling
         self._n_claims_override = None
@@ -986,6 +996,12 @@ class TPUScheduler:
             # divergence re-solves the whole problem on the exact oracle
             # and records the event instead of failing provisioning
             return host_solve("divergence")
+        except DispatchStallError:
+            # the watchdog declared the backend stalled (the collective-
+            # rendezvous deadlock class): the stuck worker is leaked and
+            # the stacks are already dumped — this solve completes on the
+            # host oracle instead of hanging the provisioner
+            return host_solve("watchdog_stall")
         except Exception as err:  # noqa: BLE001 — the degradation ladder
             # device dispatch / decode blowing up (an XLA abort, a device
             # gone bad, an injected solver.dispatch fault) must not fail
@@ -1302,7 +1318,9 @@ class TPUScheduler:
             self._vocab_sig, k_pad, v_pad, self._T_pad, len(self.templates)
         )
         cache = None
-        if self.encode_cache_enabled:
+        # a quarantined encode cache is bypassed outright: every kind
+        # re-encodes from requirement objects (the exact path) until TTL
+        if self.encode_cache_enabled and not QUARANTINE.active("encode_cache"):
             if self._encode_cache_key != epoch:
                 self._encode_cache = {}
                 self._encode_cache_key = epoch
@@ -1320,37 +1338,58 @@ class TPUScheduler:
         miss = [u for u in range(U) if bundles[u] is None]
         rep_req_sets: list = [None] * U
         if miss:
-            from karpenter_tpu.ops.encode import encode_requirements_np
-
-            row_memo: dict = {}
-            miss_reqs = [self._pod_reqs(reps[u]) for u in miss]
+            miss_bundles, miss_reqs = self._encode_kind_rows(
+                [reps[u] for u in miss]
+            )
             for j, u in enumerate(miss):
                 rep_req_sets[u] = miss_reqs[j]
-            m_enc = encode_requirements_np(
-                self.encoder.vocab, miss_reqs, k_pad, v_pad,
-                self.encoder.skip_keys, row_memo=row_memo,
+                bundles[u] = miss_bundles[j]
+                if cache is not None:
+                    cache[rep_sigs[u]] = miss_bundles[j]
+        if n_hits:
+            from karpenter_tpu.utils.metrics import ENCODE_CACHE_HITS
+
+            ENCODE_CACHE_HITS.inc(n_hits)
+            if guard_config.should_audit("encode_cache"):
+                hit_idx = [u for u in range(U) if u not in set(miss)]
+                bundles = self._audit_encode_cache(reps, bundles, hit_idx)
+        return bundles, rep_req_sets
+
+    def _encode_kind_rows(self, reps_sub: list) -> tuple[list, list]:
+        """Encode per-kind bundle rows from requirement objects (the
+        encode-cache miss path, shared with the cache's shadow audit).
+        Returns (bundles, req_sets) aligned with ``reps_sub``."""
+        from karpenter_tpu.ops.encode import encode_requirements_np
+
+        k_pad, v_pad = self._pads()
+        row_memo: dict = {}
+        sub_reqs = [self._pod_reqs(p) for p in reps_sub]
+        m_enc = encode_requirements_np(
+            self.encoder.vocab, sub_reqs, k_pad, v_pad,
+            self.encoder.skip_keys, row_memo=row_memo,
+        )
+        m_strict = encode_requirements_np(
+            self.encoder.vocab,
+            [
+                Requirements.from_pod(p, include_preferred=False)
+                for p in reps_sub
+            ],
+            k_pad, v_pad, self.encoder.skip_keys, row_memo=row_memo,
+        )
+        m_allow = self.encoder.it_allow_mask(sub_reqs, self.catalog)
+        if m_allow.shape[1] != self._T_pad:  # sharded catalog padding
+            m_allow = np.pad(
+                m_allow,
+                ((0, 0), (0, self._T_pad - m_allow.shape[1])),
+                constant_values=False,
             )
-            m_strict = encode_requirements_np(
-                self.encoder.vocab,
-                [
-                    Requirements.from_pod(reps[u], include_preferred=False)
-                    for u in miss
-                ],
-                k_pad, v_pad, self.encoder.skip_keys, row_memo=row_memo,
-            )
-            m_allow = self.encoder.it_allow_mask(miss_reqs, self.catalog)
-            if m_allow.shape[1] != self._T_pad:  # sharded catalog padding
-                m_allow = np.pad(
-                    m_allow,
-                    ((0, 0), (0, self._T_pad - m_allow.shape[1])),
-                    constant_values=False,
-                )
-            for j, u in enumerate(miss):
-                p = reps[u]
-                # hostname selectors can never match a not-yet-named node
-                if not self.encoder.hostname_allows(miss_reqs[j], None):
-                    m_allow[j, :] = False
-                bundle = dict(
+        bundles = []
+        for j, p in enumerate(reps_sub):
+            # hostname selectors can never match a not-yet-named node
+            if not self.encoder.hostname_allows(sub_reqs[j], None):
+                m_allow[j, :] = False
+            bundles.append(
+                dict(
                     reqs=tuple(a[j] for a in m_enc),
                     strict=tuple(a[j] for a in m_strict),
                     requests=self.encoder.resources_vector(p.total_requests()),
@@ -1363,14 +1402,57 @@ class TPUScheduler:
                         dtype=bool,
                     ),
                 )
-                bundles[u] = bundle
-                if cache is not None:
-                    cache[rep_sigs[u]] = bundle
-        if n_hits:
-            from karpenter_tpu.utils.metrics import ENCODE_CACHE_HITS
+            )
+        return bundles, sub_reqs
 
-            ENCODE_CACHE_HITS.inc(n_hits)
-        return bundles, rep_req_sets
+    @staticmethod
+    def _encode_rows_equal(a: dict, b: dict) -> bool:
+        for i in range(6):
+            if not np.array_equal(np.asarray(a["reqs"][i]), np.asarray(b["reqs"][i])):
+                return False
+            if not np.array_equal(
+                np.asarray(a["strict"][i]), np.asarray(b["strict"][i])
+            ):
+                return False
+        return (
+            np.array_equal(np.asarray(a["requests"]), np.asarray(b["requests"]))
+            and np.array_equal(np.asarray(a["it_allow"]), np.asarray(b["it_allow"]))
+            and np.array_equal(np.asarray(a["tol"]), np.asarray(b["tol"]))
+        )
+
+    def _audit_encode_cache(self, reps: list, bundles: list, hit_idx: list):
+        """Shadow audit of encode-cache hits: re-encode the hit kinds from
+        their requirement objects (the exact twin) and compare every row
+        bit-exact. On divergence the fresh rows are the ones used."""
+        if not hit_idx:
+            return bundles
+        fresh, _ = self._encode_kind_rows([reps[u] for u in hit_idx])
+        lying = guard_config.lying("encode_cache")
+        bad = []
+        for j, u in enumerate(hit_idx):
+            cmp = bundles[u]
+            if lying:  # seeded lying-fast-path fixture
+                cmp = dict(cmp, requests=np.asarray(cmp["requests"]) + 1.0)
+            if not self._encode_rows_equal(cmp, fresh[j]):
+                bad.append(u)
+        if not bad:
+            guard_audit.record_audit("encode_cache", "pass")
+            return bundles
+        pods_by_uid, rounds, existing = self._guard_problem_ctx()
+        guard_audit.handle_divergence(
+            "encode_cache",
+            f"{len(bad)} cached encode row(s) != fresh re-encode",
+            self,
+            pods_by_uid,
+            rounds,
+            existing,
+            detail={"hits_audited": len(hit_idx), "divergent_rows": len(bad)},
+        )
+        self._encode_cache = {}  # drop the poisoned rows, not just bypass
+        out = list(bundles)
+        for j, u in enumerate(hit_idx):
+            out[u] = fresh[j]
+        return out
 
     @staticmethod
     def _stack_bundles(bundles: list):
@@ -1941,22 +2023,29 @@ class TPUScheduler:
 
         from karpenter_tpu.faultinject import FAULT
 
-        # the chaos seam for the degradation ladder: an injected error
-        # here is indistinguishable from the device dying mid-solve
-        FAULT.point("solver.dispatch", pods=int(enc["P"]))
-        profile_dir = os.environ.get("KTPU_PROFILE_DIR")
-        ctx = (
-            jax.profiler.trace(profile_dir)
-            if profile_dir
-            else jax.profiler.TraceAnnotation("ktpu_solve")
-        )
-        with ctx:
-            if self.mesh is not None:
-                # GSPMD propagates the catalog's "it" sharding through the
-                # same jitted kernels; collectives ride ICI (SURVEY §2.9)
-                with self.mesh:
-                    return self._run_solve_inner(enc)
-            return self._run_solve_inner(enc)
+        def _dispatch():
+            # the chaos seam for the degradation ladder: an injected error
+            # here is indistinguishable from the device dying mid-solve
+            FAULT.point("solver.dispatch", pods=int(enc["P"]))
+            profile_dir = os.environ.get("KTPU_PROFILE_DIR")
+            ctx = (
+                jax.profiler.trace(profile_dir)
+                if profile_dir
+                else jax.profiler.TraceAnnotation("ktpu_solve")
+            )
+            with ctx:
+                if self.mesh is not None:
+                    # GSPMD propagates the catalog's "it" sharding through
+                    # the same jitted kernels; collectives ride ICI
+                    # (SURVEY §2.9)
+                    with self.mesh:
+                        return self._run_solve_inner(enc)
+                return self._run_solve_inner(enc)
+
+        # KTPU_WATCHDOG_S bounds the whole dispatch sequence (including
+        # every merge-loop block_until_ready — the rendezvous-deadlock
+        # class); a stall raises DispatchStallError into the ladder
+        return run_guarded(_dispatch, section="dispatch")
 
     def _run_solve_inner(self, enc: dict):
         exist_tensors = enc["exist_tensors"]
@@ -2149,6 +2238,9 @@ class TPUScheduler:
             K_pipe
             and dp_n > 1
             and self.shard_dp
+            # a quarantined speculative path runs every group sequentially
+            # (the exact twin) until the breaker's TTL expires
+            and not QUARANTINE.active("speculative")
             and not self.existing_nodes
             and not enc["topo_kids"]
             and not enc["vg_groups"]
@@ -2247,14 +2339,25 @@ class TPUScheduler:
                     enc["pod_topo_k"], jnp.asarray(kind_ids),
                     jnp.asarray(counts),
                 )
-                state, ys = ops_solver.solve_kind_scan(
-                    state, xs, exist_tensors, self.it_tensors, template_tensors,
+                grid_inc = not QUARANTINE.active("grid")
+                kscan_args = (
+                    xs, exist_tensors, self.it_tensors, template_tensors,
                     self.well_known, topo_tensors,
+                )
+                kscan_kw = dict(
                     zone_kid=enc["zone_kid"], ct_kid=enc["ct_kid"],
                     n_claims=n_claims, key_kid=mode[1],
                     n_domains=len(self.encoder.vocab.values[mode[1]]),
                     maxc=maxc,
                 )
+                state_in = state
+                state, ys = ops_solver.solve_kind_scan(
+                    state, *kscan_args, grid_incremental=grid_inc, **kscan_kw
+                )
+                if grid_inc and guard_config.should_audit("grid"):
+                    state, ys = self._audit_kscan_grid(
+                        state_in, state, ys, kscan_args, kscan_kw
+                    )
                 outputs.append(("kscan", segs, ys))
                 tmpl_snaps.append(ops_solver.global_template(state))
                 for lo_, hi_, k_ in segs:
@@ -2305,6 +2408,7 @@ class TPUScheduler:
         conditions provably hold, sequential replay otherwise. Either way
         the committed state and outputs are bit-identical to the
         sequential loop's."""
+        from karpenter_tpu.faultinject import FAULT
         from karpenter_tpu.ops.kernels import fetch_tree
         from karpenter_tpu.utils.metrics import SHARD_MERGE_ROUNDS
 
@@ -2383,11 +2487,34 @@ class TPUScheduler:
                     and int(c_n) + opened <= n_claims
                 )
                 if commit:
+                    # chaos seam: cut a speculative merge exactly at the
+                    # commit decision (an injected error here degrades the
+                    # whole solve via the ladder, never a half-graft)
+                    FAULT.point(
+                        "solver.merge.commit",
+                        segments=len(segs),
+                        opened=opened,
+                    )
+                    audit = guard_config.should_audit("speculative")
+                    seq_twin = None
+                    if audit:
+                        # exact twin FIRST, from the same pre-merge
+                        # committed state (one collective computation in
+                        # flight at a time — the CPU-backend rendezvous
+                        # rule the surrounding loop already follows)
+                        seq_twin = dispatch_fill(state, segs)
+                        jax.block_until_ready(seq_twin[0])
                     state, shifted = ops_solver.merge_shard_fill(
                         state, spec_r, jnp.int32(b_n_open), jnp.int32(b_w_open)
                     )
                     jax.block_until_ready(state)  # same one-at-a-time rule
-                    outputs.append(("fill", segs, ys_r, shifted))
+                    if audit:
+                        state, commit_out = self._audit_shard_merge(
+                            state, shifted, ys_r, segs, seq_twin
+                        )
+                        outputs.append(commit_out)
+                    else:
+                        outputs.append(("fill", segs, ys_r, shifted))
                     SHARD_MERGE_ROUNDS.inc(outcome="committed")
                 else:
                     state, ys_seq = dispatch_fill(state, segs)
@@ -2404,6 +2531,91 @@ class TPUScheduler:
                     remaining[k_] -= hi_ - lo_
                 state = maybe_compact(state)
         return state
+
+    @staticmethod
+    def _guard_trees_equal(a, b) -> bool:
+        """Bit-exact pytree comparison (one batched device fetch)."""
+        from karpenter_tpu.ops.kernels import fetch_tree
+
+        la = jax.tree_util.tree_leaves(a)
+        lb = jax.tree_util.tree_leaves(b)
+        if len(la) != len(lb):
+            return False
+        vals = fetch_tree(la + lb)
+        n = len(la)
+        for x, y in zip(vals[:n], vals[n:]):
+            x, y = np.asarray(x), np.asarray(y)
+            if x.shape != y.shape or x.dtype != y.dtype:
+                return False
+            if np.issubdtype(x.dtype, np.floating):
+                if not np.array_equal(x, y, equal_nan=True):
+                    return False
+            elif not np.array_equal(x, y):
+                return False
+        return True
+
+    def _guard_problem_ctx(self):
+        """(pods_by_uid, rounds, existing) for a divergence bundle: the
+        solve currently in flight (stashed by solve()) as one round."""
+        pods, existing = getattr(self, "_guard_problem", None) or ([], [])
+        pods_by_uid = {p.uid: p for p in pods}
+        return pods_by_uid, [list(pods_by_uid)], existing
+
+    def _audit_kscan_grid(self, state_in, state_fast, ys_fast, args, kw):
+        """Shadow audit of the incremental kscan capacity grid: re-run the
+        SAME segments from the SAME entry state with every boundary forced
+        onto the full-width divide-and-verify recompute, and compare the
+        exit state + assignments bit-exact. On divergence the exact twin's
+        results are the ones this solve keeps."""
+        state_ex, ys_ex = ops_solver.solve_kind_scan(
+            state_in, *args, grid_incremental=False, **kw
+        )
+        jax.block_until_ready(state_ex)
+        fast_cmp = state_fast
+        if guard_config.lying("grid"):  # seeded lying-fast-path fixture
+            fast_cmp = state_fast._replace(n_open=state_fast.n_open + 1)
+        # ys.grid_reused legitimately differs (the twin never reuses);
+        # the exactness contract is over state + assignments
+        if self._guard_trees_equal(
+            (fast_cmp, ys_fast.assignment), (state_ex, ys_ex.assignment)
+        ):
+            guard_audit.record_audit("grid", "pass")
+            return state_fast, ys_fast
+        pods_by_uid, rounds, existing = self._guard_problem_ctx()
+        guard_audit.handle_divergence(
+            "grid",
+            "incremental grid reuse != full recompute",
+            self,
+            pods_by_uid,
+            rounds,
+            existing,
+            detail={"segments": int(ys_fast.assignment.shape[0])},
+        )
+        return state_ex, ys_ex
+
+    def _audit_shard_merge(self, state_fast, shifted, ys_r, segs, seq_twin):
+        """Shadow audit of a committed dp-speculative merge group: the
+        sequential replay (run from the identical pre-merge state) is the
+        exact twin; the merged state must match it bit-for-bit. On
+        divergence the sequential results replace the graft."""
+        state_seq, ys_seq = seq_twin
+        fast_cmp = state_fast
+        if guard_config.lying("speculative"):
+            fast_cmp = state_fast._replace(n_open=state_fast.n_open + 1)
+        if self._guard_trees_equal(fast_cmp, state_seq):
+            guard_audit.record_audit("speculative", "pass")
+            return state_fast, ("fill", segs, ys_r, shifted)
+        pods_by_uid, rounds, existing = self._guard_problem_ctx()
+        guard_audit.handle_divergence(
+            "speculative",
+            "merged shard state != sequential replay",
+            self,
+            pods_by_uid,
+            rounds,
+            existing,
+            detail={"segments": len(segs)},
+        )
+        return state_seq, ("fill", segs, ys_seq, state_seq.slot_of)
 
     def _pipeline_target(self, enc: dict) -> int:
         """Chunk-group count for the software pipeline; 0 disables (small
@@ -3282,6 +3494,8 @@ class ResidentSession:
         self.last_reason = "cold"
         self.rounds_total = {"delta": 0, "full": 0, "invalidated": 0}
         self.last_timings: dict = {}
+        # verdict of the last round's shadow audit (None = not sampled)
+        self.last_audit: Optional[dict] = None
 
     def __getattr__(self, name):
         return getattr(self.sched, name)
@@ -3402,14 +3616,27 @@ class ResidentSession:
             )
         )
         t0 = _time.perf_counter()
+        self.last_audit = None
         try:
             if not supported:
                 raise _DeltaUnsafe("full", "unsupported_args")
+            if QUARANTINE.active("resident"):
+                # a tripped resident breaker routes every round onto
+                # snapshot solves (the exact twin) until TTL expiry
+                raise _DeltaUnsafe("full", "quarantined")
             plan = self._classify(
                 pods, existing_nodes, topology, topology_factory, bound_pods
             )
             result = self._solve_delta(plan, deadline=deadline, now=now)
             mode, reason = "delta", "delta"
+            if guard_config.lying("resident") and result.assignments:
+                # seeded lying-fast-path fixture: GENUINELY corrupt the
+                # delta result — only a shadow audit stands between this
+                # and the caller (which is the property under test)
+                uid = min(result.assignments)
+                result.assignments[uid] = result.assignments[uid] + 1
+            if guard_config.should_audit("resident"):
+                result, mode, reason = self._audit_delta(result)
         except _DeltaUnsafe as gate:
             mode, reason = gate.mode, gate.reason
             result = self._solve_full(
@@ -3427,8 +3654,84 @@ class ResidentSession:
             "mode": mode,
             "reason": reason,
             "wall_s": _time.perf_counter() - t0,
+            "audit": self.last_audit,
         }
         return result
+
+    # -- guard: shadow audit + state fingerprint ---------------------------
+
+    def _audit_delta(self, fast_result) -> tuple:
+        """Shadow audit of a delta round: re-derive the session's current
+        pod set via the exact twin (a cold full re-solve from the pristine
+        inputs, the same oracle the tier-1 parity suite uses) and compare
+        canonical result signatures. A divergence drops the resident
+        state, quarantines the path, and returns the exact result."""
+        import time as _time
+
+        r = self._r
+        pods = [r["pod_by_uid"][u] for u in r["order"]]
+        exist = [n.clone() for n in r["exist_pristine"]]
+        t0 = _time.perf_counter()
+        cold = self.sched.solve(pods, exist)
+        audit_s = _time.perf_counter() - t0
+        if guard_audit.result_signature(fast_result) == guard_audit.result_signature(
+            cold
+        ):
+            guard_audit.record_audit("resident", "pass")
+            self.last_audit = {"verdict": "pass", "twin_s": audit_s}
+            return fast_result, "delta", "delta"
+        # bundle the solve sequence that reproduces this: the resident
+        # base (everything before the divergent round) then the union
+        last = r["rounds"][-1]
+        base_uids = list(r["order"][: last["start_idx"]])
+        all_uids = list(r["order"])
+        bundle_rounds = [base_uids, all_uids] if base_uids else [all_uids]
+        bundle_path = guard_audit.handle_divergence(
+            "resident",
+            "delta round result != cold full re-solve",
+            self.sched,
+            dict(r["pod_by_uid"]),
+            bundle_rounds,
+            r["exist_pristine"],
+            detail={"rounds_resident": len(r["rounds"])},
+        )
+        self.last_audit = {
+            "verdict": "divergence",
+            "twin_s": audit_s,
+            "bundle": bundle_path,
+        }
+        self._r = None  # the fast state lied; drop it, serve the exact twin
+        return cold, "full", "guard_divergence"
+
+    @staticmethod
+    def _round_sig(uids, n_open_start: int) -> bytes:
+        """Content signature of one committed round (fingerprint chain
+        link): the pods it bound and the claim watermark it started from."""
+        import hashlib
+
+        h = hashlib.blake2s(digest_size=8)
+        h.update(str(int(n_open_start)).encode())
+        for u in sorted(uids):
+            h.update(b"\x00")
+            h.update(str(u).encode())
+        return h.digest()
+
+    @property
+    def fingerprint(self) -> str:
+        """Running hash over committed round signatures; '' when there is
+        no resident state. Echoed through RPC session metadata so a
+        server-side registry eviction / restart mid-session is detected as
+        a typed SESSION_LOST instead of silently solving against a fresh
+        (empty) session."""
+        r = self._r
+        if r is None:
+            return ""
+        import hashlib
+
+        h = hashlib.blake2s(digest_size=8)
+        for rec in r["rounds"]:
+            h.update(rec["sig"])
+        return h.hexdigest()
 
     # -- full path ---------------------------------------------------------
 
@@ -3518,6 +3821,7 @@ class ResidentSession:
                     n_open_start=0,
                     pure=True,
                     new_kids=list(range(len(reps))),
+                    sig=self._round_sig((p.uid for p in pods_sorted), 0),
                 )
             ],
             n_open=int(cap["n_open"]),
@@ -3729,14 +4033,42 @@ class ResidentSession:
         # instead of re-replicating at the first un-meshed dispatch
         from contextlib import nullcontext
 
+        from karpenter_tpu.faultinject import FAULT
+
+        t_encode = t0
         with sched.mesh if sched.mesh is not None else nullcontext():
-            # ---- 1. retract departed suffix rounds (device + host rollback)
-            if retract_k:
-                self._retract(retract_k)
-            # ---- 2. append arrivals through the fill pipeline
-            t_encode = _time.perf_counter()
-            if delta is not None:
-                self._append(delta)
+            # validate-then-commit: everything above was pure validation;
+            # from here the resident state mutates. ANY failure mid-apply
+            # (injected via solver.resident.apply or real) must leave the
+            # session invalidated-not-poisoned — the half-applied dict is
+            # dropped and the round falls back to a full re-solve.
+            try:
+                # chaos seam before any mutation
+                FAULT.point(
+                    "solver.resident.apply", stage="begin",
+                    arrivals=len(arrivals), retracts=retract_k,
+                )
+                # ---- 1. retract departed suffix rounds (device + host
+                # rollback)
+                if retract_k:
+                    self._retract(retract_k)
+                # mid-apply chaos seam: the retract has already mutated
+                # device + host state when this fires
+                FAULT.point(
+                    "solver.resident.apply", stage="mid",
+                    arrivals=len(arrivals), retracts=retract_k,
+                )
+                # ---- 2. append arrivals through the fill pipeline
+                t_encode = _time.perf_counter()
+                if delta is not None:
+                    self._append(delta)
+            except _DeltaUnsafe:
+                raise  # _append's own gates already picked their mode
+            except Exception as err:
+                self._r = None
+                raise _DeltaUnsafe(
+                    "invalidated", f"apply_error:{type(err).__name__}"
+                )
         t_end = _time.perf_counter()
         sched.last_timings = {
             "encode_s": t_encode - t0,
@@ -4011,6 +4343,9 @@ class ResidentSession:
                 n_open_start=pre_n_open,
                 pure=pure,
                 new_kids=new_kids,
+                sig=self._round_sig(
+                    (p.uid for p in arrivals_sorted), pre_n_open
+                ),
             )
         )
         r["n_open"] = int(n_open_new)

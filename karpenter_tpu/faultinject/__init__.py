@@ -22,6 +22,17 @@ Registered fault points (grep ``FAULT.point`` for the live list):
 ``api.create``         ObjectStore.create (apiserver POST analog)
 ``api.patch``          ObjectStore.update (apiserver PATCH analog)
 ``api.delete``         ObjectStore.delete (apiserver DELETE analog)
+``solver.resident.apply``  resident delta apply, ``stage`` = ``begin``
+                       (before the retract pass) or ``mid`` (between
+                       retract and append — a fault here proves the
+                       transactional invalidate path; ctx carries
+                       ``arrivals``/``retracts``)
+``solver.merge.commit``  dp-speculative shard merge, just before the
+                       commit decision (ctx: ``segments``/``opened``)
+``rpc.session.evict``  server-side resident-session registry lookup; a
+                       FIRING rule here forcibly evicts the session (the
+                       raised error is swallowed), so the client's next
+                       Solve observes a typed SESSION_LOST
 =====================  ====================================================
 """
 

@@ -1,0 +1,65 @@
+"""Guardrails: trust-but-verify over every fast path with an exactness proof.
+
+The last three subsystems stacked exactness-critical fast paths —
+resident delta rounds, the dp-speculative shard merge, the incremental
+kscan capacity grid, the encode-row cache — whose bit-parity proofs run
+in CI, not production. This package is the production half of those
+proofs (the consistency-controller idea from the reference, applied to
+the solver):
+
+- **Shadow audits** (``audit``, ``config.should_audit``): with
+  probability ``KTPU_GUARD_AUDIT_RATE`` a fast-path crossing is
+  re-derived via its exact twin and compared bit-exact; a divergence
+  writes a self-contained repro bundle (``bundle``), emits
+  ``ktpu_guard_audits_total{verdict="divergence"}`` + a Warning event,
+  and trips the path's breaker.
+- **Quarantine** (``quarantine.QUARANTINE``): a tripped path routes
+  every subsequent solve onto its exact twin until TTL expiry
+  (``KTPU_GUARD_TTL_S``) or restart.
+- **Dispatch watchdog** (``watchdog.run_guarded``): a deadline around
+  device dispatch that converts a stalled backend (the PR 8 rendezvous
+  deadlock class) into a host-fallback solve instead of a hang.
+- **Replay** (``python -m karpenter_tpu.guard.replay <bundle>``):
+  deterministically re-runs a divergence bundle; exits nonzero when the
+  divergence reproduces.
+"""
+
+from karpenter_tpu.guard.audit import (
+    AUDIT_LOG,
+    divergences,
+    handle_divergence,
+    record_audit,
+    reset_log,
+    result_signature,
+)
+from karpenter_tpu.guard.config import (
+    PATHS,
+    audit_rate,
+    guard_dir,
+    lying,
+    set_event_recorder,
+    should_audit,
+    watchdog_s,
+)
+from karpenter_tpu.guard.quarantine import QUARANTINE, Quarantine
+from karpenter_tpu.guard.watchdog import DispatchStallError, run_guarded
+
+__all__ = [
+    "AUDIT_LOG",
+    "DispatchStallError",
+    "PATHS",
+    "QUARANTINE",
+    "Quarantine",
+    "audit_rate",
+    "divergences",
+    "guard_dir",
+    "handle_divergence",
+    "lying",
+    "record_audit",
+    "reset_log",
+    "result_signature",
+    "run_guarded",
+    "set_event_recorder",
+    "should_audit",
+    "watchdog_s",
+]

@@ -1,0 +1,131 @@
+"""Shadow-audit verdicts: compare, count, and escalate divergences.
+
+The audit itself lives at each fast-path call site (the scheduler knows
+how to run its own exact twin); this module owns what every site shares —
+the canonical result signature the resident audit compares, the verdict
+bookkeeping (``ktpu_guard_audits_total``, an in-process audit log the
+replay harness reads), and the divergence escalation: repro bundle to
+``KTPU_GUARD_DIR``, a Warning event on the recorder (when the operator
+wired one in), and the per-path quarantine trip.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from karpenter_tpu.guard import bundle as bundle_mod
+from karpenter_tpu.guard import config
+from karpenter_tpu.guard.quarantine import QUARANTINE
+from karpenter_tpu.utils.logging import get_logger
+from karpenter_tpu.utils.metrics import GUARD_AUDITS
+
+_LOG_LOCK = threading.Lock()
+#: every audit verdict this process, newest last: {path, verdict, reason}
+AUDIT_LOG: list = []
+
+
+def reset_log() -> None:
+    with _LOG_LOCK:
+        AUDIT_LOG.clear()
+
+
+def divergences(path: Optional[str] = None) -> list:
+    with _LOG_LOCK:
+        return [
+            rec
+            for rec in AUDIT_LOG
+            if rec["verdict"] == "divergence" and (path is None or rec["path"] == path)
+        ]
+
+
+def result_signature(result) -> tuple:
+    """Canonical, comparison-stable form of a SchedulingResult.
+
+    Bit-exactness is the contract the fast paths prove, so nothing is
+    rounded: two results are equal iff every claim (slot, hostname,
+    template, instance-type set, pod order, resource usage), every
+    assignment, every existing-node binding, and every unschedulable
+    verdict match exactly.
+    """
+    claims = tuple(
+        sorted(
+            (
+                int(c.slot),
+                c.hostname,
+                c.template.nodepool_name,
+                tuple(sorted(it.name for it in c.instance_types)),
+                tuple(p.uid for p in c.pods),
+                tuple(sorted((k, float(v)) for k, v in c.used.items())),
+            )
+            for c in result.claims
+        )
+    )
+    existing = tuple(
+        sorted(
+            (n.name, tuple(sorted(p.uid for p in n.pods)))
+            for n in result.existing
+        )
+    )
+    return (
+        claims,
+        tuple(sorted((u, int(s)) for u, s in result.assignments.items())),
+        tuple(sorted(result.existing_assignments.items())),
+        existing,
+        tuple(sorted((p.uid, r) for p, r in result.unschedulable)),
+    )
+
+
+def record_audit(path: str, verdict: str, reason: str = "") -> None:
+    GUARD_AUDITS.inc(path=path, verdict=verdict)
+    with _LOG_LOCK:
+        AUDIT_LOG.append({"path": path, "verdict": verdict, "reason": reason})
+
+
+def handle_divergence(
+    path: str,
+    reason: str,
+    sched,
+    pods_by_uid: dict,
+    rounds: list,
+    existing_nodes=(),
+    detail: Optional[dict] = None,
+) -> Optional[str]:
+    """A fast path disagreed with its exact twin: count it, capsule it,
+    quarantine it. Returns the bundle file path (None when KTPU_GUARD_DIR
+    is unset or the write fails — escalation still happens)."""
+    record_audit(path, "divergence", reason)
+    log = get_logger().with_values(controller="guard")
+    bundle_path = None
+    gdir = config.guard_dir()
+    if gdir:
+        try:
+            doc = bundle_mod.make_bundle(
+                path, reason, sched, pods_by_uid, rounds, existing_nodes, detail
+            )
+            bundle_path = bundle_mod.write_bundle(doc, gdir)
+        except Exception as err:  # never let bundle IO mask the divergence
+            log.error("guard: repro bundle write failed", path=path, error=str(err))
+    log.error(
+        "guard: shadow audit DIVERGENCE — fast path disagrees with its "
+        "exact twin; quarantining",
+        path=path,
+        reason=reason,
+        bundle=bundle_path or "",
+    )
+    recorder = config.event_recorder()
+    if recorder is not None:
+        from karpenter_tpu.utils.events import Event
+
+        recorder.publish(
+            Event(
+                "Solver",
+                path,
+                "Warning",
+                "GuardDivergence",
+                f"shadow audit divergence on fast path {path!r}: {reason}"
+                + (f" (bundle: {bundle_path})" if bundle_path else ""),
+            )
+        )
+    QUARANTINE.trip(path, reason=reason)
+    return bundle_path

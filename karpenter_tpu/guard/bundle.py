@@ -1,0 +1,140 @@
+"""Self-contained divergence repro bundles.
+
+When a shadow audit catches a fast path disagreeing with its exact twin,
+the most valuable artifact is not the log line — it is a deterministic
+reproduction. A bundle is one JSON file holding the encoded problem
+(templates via the RPC codec, pods/existing nodes as base64 protobuf),
+the solve sequence that reached the divergent round, and the env/backend
+signature (jax version, platform, device kind, every ``KTPU_*`` knob and
+``XLA_FLAGS``) — everything ``python -m karpenter_tpu.guard.replay``
+needs to re-run the round on a like-for-like backend and exit nonzero if
+the divergence reproduces. The PR 8 GSPMD wire-packer miscompile is the
+motivating case: a wrong-numbers bug that only manifests under one
+backend signature wants exactly this capsule.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import time
+from typing import Optional
+
+
+def backend_signature() -> dict:
+    """The environment fingerprint a divergence must be replayed under."""
+    import jax
+    import numpy as np
+
+    try:
+        dev = jax.devices()[0]
+        device_kind = getattr(dev, "device_kind", "")
+        n_devices = jax.device_count()
+        platform = dev.platform
+    except Exception:
+        device_kind, n_devices, platform = "", 0, "unknown"
+    return {
+        "jax": jax.__version__,
+        "numpy": np.__version__,
+        "platform": platform,
+        "device_kind": device_kind,
+        "device_count": n_devices,
+    }
+
+
+def _env_snapshot() -> dict:
+    keep = {k: v for k, v in os.environ.items() if k.startswith("KTPU_")}
+    if os.environ.get("XLA_FLAGS"):
+        keep["XLA_FLAGS"] = os.environ["XLA_FLAGS"]
+    if os.environ.get("JAX_PLATFORMS"):
+        keep["JAX_PLATFORMS"] = os.environ["JAX_PLATFORMS"]
+    return keep
+
+
+def make_bundle(
+    path: str,
+    reason: str,
+    sched,
+    pods_by_uid: dict,
+    rounds: list,
+    existing_nodes=(),
+    detail: Optional[dict] = None,
+) -> dict:
+    """Assemble a bundle document.
+
+    ``rounds`` is the solve sequence as lists of pod uids — replay feeds
+    each list (resolved against ``pods_by_uid``) through one solve; the
+    LAST round is the one whose fast path diverged.
+    """
+    from karpenter_tpu.rpc.codec import encode_templates
+    from karpenter_tpu.rpc.convert import existing_to_pb, pod_to_pb
+
+    pods_b64 = {
+        uid: base64.b64encode(pod_to_pb(p).SerializeToString()).decode()
+        for uid, p in pods_by_uid.items()
+    }
+    existing_b64 = [
+        base64.b64encode(existing_to_pb(n).SerializeToString()).decode()
+        for n in existing_nodes
+    ]
+    return {
+        "version": 1,
+        "path": path,
+        "reason": reason,
+        "created_unix": time.time(),
+        "backend": backend_signature(),
+        "env": _env_snapshot(),
+        "scheduler": {
+            "max_claims": int(sched.max_claims),
+            "pod_pad": int(sched.pod_pad) if sched.pod_pad else None,
+        },
+        "templates_b64": base64.b64encode(encode_templates(sched.templates)).decode(),
+        "pods": pods_b64,
+        "existing": existing_b64,
+        "rounds": [list(r) for r in rounds],
+        "detail": detail or {},
+    }
+
+
+def write_bundle(doc: dict, guard_dir: str) -> str:
+    os.makedirs(guard_dir, exist_ok=True)
+    stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime(doc["created_unix"]))
+    fname = f"divergence-{doc['path']}-{stamp}-{os.getpid()}.json"
+    out = os.path.join(guard_dir, fname)
+    tmp = out + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, sort_keys=True, indent=1)
+    os.replace(tmp, out)  # readers never see a torn bundle
+    return out
+
+
+def load_bundle(path: str) -> dict:
+    with open(path) as fh:
+        doc = json.load(fh)
+    if doc.get("version") != 1:
+        raise ValueError(f"unsupported bundle version {doc.get('version')!r}")
+    for key in ("path", "templates_b64", "pods", "rounds"):
+        if key not in doc:
+            raise ValueError(f"bundle missing {key!r}")
+    return doc
+
+
+def materialize(doc: dict):
+    """bundle -> (templates, pods_by_uid, existing_nodes, rounds)."""
+    from karpenter_tpu.rpc import solver_pb2 as pb
+    from karpenter_tpu.rpc.codec import decode_templates
+    from karpenter_tpu.rpc.convert import existing_from_pb, pod_from_pb
+
+    templates = decode_templates(base64.b64decode(doc["templates_b64"]))
+    pods_by_uid = {}
+    for uid, raw in doc["pods"].items():
+        m = pb.Pod()
+        m.ParseFromString(base64.b64decode(raw))
+        pods_by_uid[uid] = pod_from_pb(m)
+    existing = []
+    for i, raw in enumerate(doc.get("existing", [])):
+        m = pb.ExistingNode()
+        m.ParseFromString(base64.b64decode(raw))
+        existing.append(existing_from_pb(m, i))
+    return templates, pods_by_uid, existing, [list(r) for r in doc["rounds"]]

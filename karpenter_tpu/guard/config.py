@@ -1,0 +1,126 @@
+"""Guardrail configuration: env knobs and the audit sampling decision.
+
+Everything is read per-call (not cached at import) so tests and the
+replay harness can flip knobs with ``monkeypatch.setenv`` / a plain
+``os.environ`` update without re-importing the world:
+
+``KTPU_GUARD_AUDIT_RATE``  probability in [0, 1] that a fast-path
+                           crossing is shadow-audited against its exact
+                           twin (default 0 — guard disabled; the hot
+                           path pays one env read per crossing)
+``KTPU_GUARD_DIR``         where divergence repro bundles are written;
+                           unset means no bundle files (the metric,
+                           event, and quarantine still fire)
+``KTPU_GUARD_TTL_S``       quarantine TTL in seconds (default 300)
+``KTPU_GUARD_SEED``        seeds the sampling RNG (deterministic audit
+                           schedules for the chaos suite)
+``KTPU_GUARD_LIE``         comma list of fast paths made to lie
+                           (test-only: the seeded lying-fast-path
+                           fixture that proves audits catch divergence)
+``KTPU_WATCHDOG_S``        dispatch watchdog deadline in seconds
+                           (default 0 — disabled, direct call)
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+from typing import Optional
+
+ENV_AUDIT_RATE = "KTPU_GUARD_AUDIT_RATE"
+ENV_GUARD_DIR = "KTPU_GUARD_DIR"
+ENV_GUARD_TTL = "KTPU_GUARD_TTL_S"
+ENV_GUARD_SEED = "KTPU_GUARD_SEED"
+ENV_GUARD_LIE = "KTPU_GUARD_LIE"
+ENV_WATCHDOG = "KTPU_WATCHDOG_S"
+
+#: the four guarded fast paths (quarantine keys / audit metric labels)
+PATHS = ("resident", "speculative", "grid", "encode_cache")
+
+_LOCK = threading.Lock()
+_RNG: Optional[random.Random] = None
+_RNG_SEED: Optional[str] = None
+
+
+def _float_env(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def audit_rate() -> float:
+    """Sampling probability, clamped to [0, 1]."""
+    return min(1.0, max(0.0, _float_env(ENV_AUDIT_RATE, 0.0)))
+
+
+def quarantine_ttl_s() -> float:
+    return max(0.0, _float_env(ENV_GUARD_TTL, 300.0))
+
+
+def watchdog_s() -> float:
+    return max(0.0, _float_env(ENV_WATCHDOG, 0.0))
+
+
+def guard_dir() -> Optional[str]:
+    return os.environ.get(ENV_GUARD_DIR) or None
+
+
+def lie_paths() -> frozenset:
+    raw = os.environ.get(ENV_GUARD_LIE, "")
+    return frozenset(p.strip() for p in raw.split(",") if p.strip())
+
+
+def lying(path: str) -> bool:
+    """Test-only: is this fast path configured to return wrong answers?"""
+    return path in lie_paths()
+
+
+def _rng() -> random.Random:
+    # re-seed when KTPU_GUARD_SEED changes so a monkeypatched seed takes
+    # effect mid-process (the chaos suite relies on this)
+    global _RNG, _RNG_SEED
+    seed = os.environ.get(ENV_GUARD_SEED, "")
+    if _RNG is None or seed != _RNG_SEED:
+        _RNG = random.Random(int(seed) if seed else 0)
+        _RNG_SEED = seed
+    return _RNG
+
+
+def should_audit(path: str) -> bool:
+    """One sampling decision per fast-path crossing.
+
+    Disabled (rate 0, the default) this is a dict lookup and a float
+    compare — the cost the bench ``--guard`` stage gates under 1% of a
+    solve. A quarantined path is never audited: it is already routed
+    onto its exact twin, there is nothing to shadow.
+    """
+    rate = audit_rate()
+    if rate <= 0.0:
+        return False
+    from karpenter_tpu.guard.quarantine import QUARANTINE
+
+    if QUARANTINE.active(path):
+        return False
+    if rate >= 1.0:
+        return True
+    with _LOCK:
+        return _rng().random() < rate
+
+
+# optional K8s event sink: the operator wires its Recorder in; solves
+# running standalone (bench, tests) leave it None and only get metrics
+_EVENT_RECORDER = None
+
+
+def set_event_recorder(recorder) -> None:
+    global _EVENT_RECORDER
+    _EVENT_RECORDER = recorder
+
+
+def event_recorder():
+    return _EVENT_RECORDER

@@ -1,0 +1,88 @@
+"""Per-fast-path circuit breakers.
+
+A shadow-audit divergence trips the breaker for that one path; every
+subsequent solve routes onto the exact twin (resident -> snapshot
+solves, speculative -> sequential replay, grid -> full recompute,
+encode_cache -> bypass) until the TTL expires or the process restarts.
+The breaker is deliberately dumb — no half-open probing: the only way a
+quarantined path earns trust back is time (operators watching
+``ktpu_guard_quarantined`` can also clear it by restarting with a fix).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from karpenter_tpu.guard import config
+from karpenter_tpu.utils.logging import get_logger
+from karpenter_tpu.utils.metrics import GUARD_QUARANTINED
+
+
+def _log():
+    return get_logger().with_values(controller="guard")
+
+
+class Quarantine:
+    def __init__(self, now: Callable[[], float] = time.monotonic):
+        self._now = now
+        self._lock = threading.Lock()
+        self._until: Dict[str, float] = {}
+        self._reason: Dict[str, str] = {}
+
+    def trip(self, path: str, reason: str = "", ttl_s: Optional[float] = None) -> None:
+        ttl = config.quarantine_ttl_s() if ttl_s is None else ttl_s
+        with self._lock:
+            self._until[path] = self._now() + ttl
+            self._reason[path] = reason
+        GUARD_QUARANTINED.set(1, path=path)
+        _log().warn(
+            "guard: quarantined fast path; routing onto the exact twin",
+            path=path,
+            ttl_s=ttl,
+            reason=reason or "audit divergence",
+        )
+
+    def active(self, path: str) -> bool:
+        with self._lock:
+            until = self._until.get(path)
+            if until is None:
+                return False
+            if self._now() >= until:
+                self._until.pop(path, None)
+                self._reason.pop(path, None)
+                expired = True
+            else:
+                return True
+        if expired:
+            GUARD_QUARANTINED.set(0, path=path)
+            _log().info("guard: quarantine expired", path=path)
+        return False
+
+    def reason(self, path: str) -> str:
+        with self._lock:
+            return self._reason.get(path, "")
+
+    def clear(self, path: str) -> None:
+        with self._lock:
+            self._until.pop(path, None)
+            self._reason.pop(path, None)
+        GUARD_QUARANTINED.set(0, path=path)
+
+    def reset(self) -> None:
+        with self._lock:
+            paths = list(self._until)
+            self._until.clear()
+            self._reason.clear()
+        for p in paths:
+            GUARD_QUARANTINED.set(0, path=p)
+
+    def snapshot(self) -> Dict[str, float]:
+        """path -> seconds remaining (for diagnostics / bench JSON)."""
+        now = self._now()
+        with self._lock:
+            return {p: max(0.0, t - now) for p, t in self._until.items()}
+
+
+QUARANTINE = Quarantine()

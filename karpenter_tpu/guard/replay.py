@@ -1,0 +1,120 @@
+"""Deterministic divergence-bundle replay.
+
+``python -m karpenter_tpu.guard.replay <bundle.json>`` rebuilds the
+scheduler from the bundle's encoded problem, restores the recorded
+``KTPU_*`` knobs (including a recorded lying-path fixture — that is how
+the seeded CI check proves the loop closes), forces the audit rate to
+1.0, and re-runs the recorded solve sequence. Exit status:
+
+- **1** — the divergence REPRODUCED (the audit fired again); the bundle
+  is a live bug capsule on this backend.
+- **0** — every audit passed; either the bug is fixed or it does not
+  manifest under this backend signature (the recorded one is printed so
+  the operator can tell which).
+- **2** — the bundle is unreadable/inconsistent (replay never ran).
+
+Replay is read-only: it never writes new bundles (``KTPU_GUARD_DIR`` is
+cleared) and quarantine state is process-local, so a reproduced
+divergence cannot cascade.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+# env keys replay refuses to import from the bundle: platform selection
+# must stay the operator's choice (replaying a TPU bundle on a CPU dev
+# box is the common triage flow — the backend mismatch is REPORTED, not
+# silently forced)
+_SKIP_ENV = ("JAX_PLATFORMS", "XLA_FLAGS", "KTPU_GUARD_DIR")
+
+
+def _restore_env(doc: dict) -> None:
+    for key, value in doc.get("env", {}).items():
+        if key in _SKIP_ENV:
+            continue
+        os.environ[key] = value
+    os.environ["KTPU_GUARD_AUDIT_RATE"] = "1.0"
+    os.environ.pop("KTPU_GUARD_DIR", None)
+
+
+def replay(bundle_path: str) -> int:
+    from karpenter_tpu.guard import bundle as bundle_mod
+
+    try:
+        doc = bundle_mod.load_bundle(bundle_path)
+    except Exception as err:
+        print(f"guard.replay: unreadable bundle: {err}", file=sys.stderr)
+        return 2
+
+    _restore_env(doc)
+
+    # import AFTER the env restore so knob-sensitive module state (scan
+    # window, caches, shard_dp) initializes the way the divergent run had it
+    from karpenter_tpu import guard
+    from karpenter_tpu.controllers.provisioning import TPUScheduler
+
+    try:
+        templates, pods_by_uid, existing, rounds = bundle_mod.materialize(doc)
+    except Exception as err:
+        print(f"guard.replay: bundle did not materialize: {err}", file=sys.stderr)
+        return 2
+
+    sched_cfg = doc.get("scheduler", {})
+    sched = TPUScheduler(
+        templates,
+        max_claims=sched_cfg.get("max_claims"),
+        pod_pad=sched_cfg.get("pod_pad"),
+    )
+    path = doc["path"]
+    guard.reset_log()
+    guard.QUARANTINE.reset()
+
+    session = sched.resident_session() if path == "resident" else None
+    for i, uids in enumerate(rounds):
+        missing = [u for u in uids if u not in pods_by_uid]
+        if missing:
+            print(f"guard.replay: round {i} references unknown pods {missing[:4]}",
+                  file=sys.stderr)
+            return 2
+        pods = [pods_by_uid[u] for u in uids]
+        exist = [n.clone() for n in existing]
+        # quarantine trips on a reproduced divergence mid-sequence; clear
+        # it so every remaining round still exercises the fast path
+        guard.QUARANTINE.reset()
+        if session is not None:
+            session.solve(pods, exist)
+        else:
+            sched.solve(pods, exist)
+
+    reproduced = guard.divergences(path)
+    here = bundle_mod.backend_signature()
+    summary = {
+        "bundle": bundle_path,
+        "path": path,
+        "reason": doc.get("reason", ""),
+        "rounds": len(rounds),
+        "audits": len(guard.AUDIT_LOG),
+        "divergences": len(reproduced),
+        "recorded_backend": doc.get("backend", {}),
+        "replay_backend": here,
+        "backend_match": doc.get("backend", {}) == here,
+        "reproduced": bool(reproduced),
+    }
+    print(json.dumps(summary, indent=1, sort_keys=True))
+    return 1 if reproduced else 0
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print("usage: python -m karpenter_tpu.guard.replay <bundle.json>",
+              file=sys.stderr)
+        return 2
+    return replay(argv[0])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,113 @@
+"""Dispatch watchdog: a deadline around device work that can hang.
+
+PR 8 surfaced the failure class this exists for: a CPU-backend
+collective rendezvous that never completes leaves ``block_until_ready``
+blocked in C++ forever — no Python exception, no signal delivery into
+the runtime, a provisioner wedged mid-solve. The watchdog runs the
+dispatch on a worker thread and bounds the wait; on deadline it dumps
+every thread's stack (the post-mortem the hang would otherwise eat),
+counts the stall, and raises ``DispatchStallError`` so the scheduler's
+degradation ladder fails the solve over to the host path instead of
+hanging.
+
+The stuck worker CANNOT be killed — Python has no way to interrupt a
+thread blocked in native code — so it is leaked as a daemon thread. That
+is the deliberate trade: a leaked thread per stall (rare, counted,
+logged) versus a controller that never provisions again. Default
+``KTPU_WATCHDOG_S=0`` disables the wrapper entirely (direct call, zero
+threads, zero overhead).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import sys
+import threading
+import traceback
+from typing import Callable, TypeVar
+
+from karpenter_tpu.guard import config
+from karpenter_tpu.utils.logging import get_logger
+from karpenter_tpu.utils.metrics import WATCHDOG_STALLS
+
+T = TypeVar("T")
+
+
+class DispatchStallError(RuntimeError):
+    """The device dispatch blew its watchdog deadline (stalled backend)."""
+
+    def __init__(self, section: str, deadline_s: float):
+        super().__init__(
+            f"device dispatch stalled: section {section!r} did not complete "
+            f"within KTPU_WATCHDOG_S={deadline_s:g}s"
+        )
+        self.section = section
+        self.deadline_s = deadline_s
+
+
+def dump_all_stacks() -> str:
+    """All-thread stack dump (the trace the hang would otherwise eat)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    chunks = []
+    for ident, frame in sys._current_frames().items():
+        name = names.get(ident, "?")
+        stack = "".join(traceback.format_stack(frame))
+        chunks.append(f"--- thread {name} ({ident}) ---\n{stack}")
+    return "\n".join(chunks)
+
+
+def run_guarded(fn: Callable[[], T], section: str) -> T:
+    """Run ``fn`` under the dispatch watchdog.
+
+    Disabled (the default) this is a direct call. Enabled, ``fn`` runs on
+    a worker thread carrying the caller's contextvars (tracing spans and
+    fault plans stay attached) and the caller joins with a deadline.
+    """
+    deadline = config.watchdog_s()
+    if deadline <= 0.0:
+        return fn()
+
+    result: list = []
+    failure: list = []
+    ctx = contextvars.copy_context()
+
+    def _work():
+        try:
+            result.append(ctx.run(fn))
+        except BaseException as err:  # noqa: BLE001 — re-raised on the caller
+            failure.append(err)
+
+    worker = threading.Thread(
+        target=_work, name=f"ktpu-watchdog-{section}", daemon=True
+    )
+    worker.start()
+    worker.join(deadline)
+    if worker.is_alive():
+        WATCHDOG_STALLS.inc(section=section)
+        stacks = dump_all_stacks()
+        log = get_logger().with_values(controller="guard")
+        log.error(
+            "watchdog: dispatch stalled; leaking the stuck worker and "
+            "failing the solve into the host-fallback ladder",
+            section=section,
+            deadline_s=deadline,
+            stacks=stacks,
+        )
+        _record_stall_span(section, deadline)
+        raise DispatchStallError(section, deadline)
+    if failure:
+        raise failure[0]
+    return result[0]
+
+
+def _record_stall_span(section: str, deadline_s: float) -> None:
+    """Stamp the stall onto the live trace ring (no-op when tracing is
+    off or there is no open parent span)."""
+    try:
+        from karpenter_tpu.tracing import TRACER
+
+        TRACER.record_span(
+            "guard.watchdog.stall", deadline_s, section=section, stalled=True
+        )
+    except Exception:
+        pass

@@ -2324,6 +2324,7 @@ def _make_kind_step(
     key_kid: int,
     D: int,
     maxc: int,
+    grid_incremental: bool = True,
 ):
     NCAP = n_claims
     E = exist.avail.shape[0]
@@ -2366,6 +2367,10 @@ def _make_kind_step(
         # counters within a segment, so this extends an existing
         # convention across same-request boundaries, not a new one.
         grid_reused = grid_valid & jnp.all(requests == grid_req)
+        if not grid_incremental:
+            # guard quarantine / shadow-audit exact twin: force the
+            # full-width divide-and-verify recompute at every boundary
+            grid_reused = jnp.bool_(False)
         grid_n = shard_hint(
             jax.lax.cond(
                 grid_reused,
@@ -2819,7 +2824,10 @@ def kernels_select_bool(cond, a, b):
     return jnp.where(cond[:, None], a, b)
 
 
-_KSCAN_STATIC = ("zone_kid", "ct_kid", "n_claims", "key_kid", "n_domains", "maxc")
+_KSCAN_STATIC = (
+    "zone_kid", "ct_kid", "n_claims", "key_kid", "n_domains", "maxc",
+    "grid_incremental",
+)
 
 
 @functools.partial(jax.jit, static_argnames=_KSCAN_STATIC)
@@ -2837,6 +2845,7 @@ def solve_kind_scan(
     key_kid: int,
     n_domains: int,
     maxc: int,
+    grid_incremental: bool = True,
 ) -> tuple[SolverState, KindYs]:
     """Scan same-kind batched placement for vocab-key topology kinds over B
     segments, threading the same SolverState as the fill and per-pod scans
@@ -2846,7 +2855,7 @@ def solve_kind_scan(
     False: the first segment always computes fresh)."""
     step = _make_kind_step(
         exist, it, templates, well_known, topo, zone_kid, ct_kid,
-        n_claims, key_kid, n_domains, maxc,
+        n_claims, key_kid, n_domains, maxc, grid_incremental,
     )
     W = state.open.shape[0]
     T, GR, R = it.alloc.shape

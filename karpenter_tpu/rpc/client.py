@@ -240,6 +240,10 @@ class RemoteScheduler:
                         md += [("ktpu-trace-id", ctx[0]), ("ktpu-span-id", ctx[1])]
                         kwargs["metadata"] = md
                     with SOLVER_RPC_DURATION.time(method=method):
+                        if kwargs.pop("with_call", False):
+                            # (response, call) — the caller wants trailing
+                            # metadata (the server's session fingerprint)
+                            return stub.with_call(request, **kwargs)
                         return stub(request, **kwargs)
 
             return call
@@ -278,6 +282,13 @@ class RemoteScheduler:
             if os.environ.get("KTPU_RESIDENT", "1") not in ("0", "false")
             else None
         )
+        # resident-state fingerprint (guard/, ISSUE 10): the server echoes
+        # a hash of its session's applied-round chain in trailing metadata;
+        # we send it back on the next Solve. A mismatch (server restart,
+        # LRU eviction) surfaces as a typed SESSION_LOST instead of a
+        # silently-wrong delta base. Empty until the first echo, so old
+        # servers (no trailer) never trigger the loss path.
+        self._session_fpr = ""
         req = pb.ConfigureRequest(
             templates_json=encode_templates(templates),
             reserved_mode=reserved_mode,
@@ -329,12 +340,18 @@ class RemoteScheduler:
             if md:
                 kwargs["metadata"] = md
             with SOLVER_RPC_DURATION.time(method="SolveStream"):
-                for frame in self._solve_stream(req, **kwargs):
+                call = self._solve_stream(req, **kwargs)
+                for frame in call:
                     # the mid-stream cut point: an injected UNAVAILABLE
                     # here simulates the transport dying at chunk <index>
                     FAULT.point("rpc.stream.chunk", index=stitcher.n_chunks)
                     if stitcher.feed(frame):
                         break
+                if stitcher.final is not None and self._session_id is not None:
+                    # the final frame is the handler's last yield, so the
+                    # RPC terminates immediately after — this blocks only
+                    # for that turnaround
+                    self._store_session_fpr(call.trailing_metadata())
         if stitcher.final is None:
             raise RuntimeError("SolveStream ended without a final frame")
         self.last_stream = stitcher.stats()
@@ -345,13 +362,28 @@ class RemoteScheduler:
     def _session_md(self) -> list:
         if self._session_id is None:
             return []
-        return [("ktpu-session-id", self._session_id)]
+        md = [("ktpu-session-id", self._session_id)]
+        if self._session_fpr:
+            md.append(("ktpu-session-fpr", self._session_fpr))
+        return md
+
+    def _store_session_fpr(self, trailing) -> None:
+        """Record the server's resident-state fingerprint from trailing
+        metadata. Absent key (old server, stateless solve) leaves the
+        stored value untouched."""
+        for key, value in trailing or ():
+            if key == "ktpu-session-fpr":
+                self._session_fpr = value
+                return
 
     def _unary_solve(self, req, rpc_timeout: float):
         md = self._session_md()
-        return self._solve(
-            req, timeout=rpc_timeout, metadata=(md or None)
+        resp, call = self._solve(
+            req, timeout=rpc_timeout, metadata=(md or None), with_call=True
         )
+        if self._session_id is not None:
+            self._store_session_fpr(call.trailing_metadata())
+        return resp
 
     def _transport_solve(self, req, rpc_timeout: float):
         """One hardened Solve crossing: stream-first with mid-stream
@@ -476,14 +508,32 @@ class RemoteScheduler:
         ) + SOLVE_COMPILE_SLACK_SECONDS
         t_encode = time.perf_counter()
         stream_acc = None
-        for attempt in range(RECONFIGURE_RETRIES + 1):
+        session_lost_retried = False
+        attempt = 0
+        while True:
             try:
                 resp, stream_acc = self._transport_solve(req, rpc_timeout)
                 break
             except grpc.RpcError as err:
                 if (
+                    err.code() == grpc.StatusCode.NOT_FOUND
+                    and "SESSION_LOST" in (err.details() or "")
+                    and not session_lost_retried
+                ):
+                    # the server evicted or restarted our resident session
+                    # (fingerprint mismatch / registry miss). The request
+                    # is a full snapshot already, so recovery is ONE clean
+                    # re-solve: forget the stale fingerprint and resend.
+                    # Counted, not raised — the caller never sees it.
+                    from karpenter_tpu.utils.metrics import RESIDENT_ROUNDS
+
+                    session_lost_retried = True
+                    self._session_fpr = ""
+                    RESIDENT_ROUNDS.inc(mode="invalidated")
+                    continue
+                if (
                     err.code() != grpc.StatusCode.FAILED_PRECONDITION
-                    or attempt == RECONFIGURE_RETRIES
+                    or attempt >= RECONFIGURE_RETRIES
                 ):
                     raise
                 # the solver restarted (or another client's Configure
@@ -498,6 +548,7 @@ class RemoteScheduler:
                     remaining = max(deadline - now_fn(), 0.0)
                     req.timeout_seconds = remaining
                     rpc_timeout = remaining + SOLVE_COMPILE_SLACK_SECONDS
+                attempt += 1
         t_rpc = time.perf_counter()
         pods_by_uid = {p.uid: p for p in pods}
         if stream_acc is not None:

@@ -88,16 +88,60 @@ class SolverService:
         sid = md.get("ktpu-session-id")
         if not sid:
             return None
+        # the fingerprint of the resident state the CLIENT believes this
+        # session holds (echoed back to it after every solve); empty when
+        # the client has no resident expectation (first round / after a
+        # SESSION_LOST re-snapshot)
+        client_fpr = md.get("ktpu-session-fpr", "")
+        from karpenter_tpu.faultinject import FAULT
+
         with self._lock:
+            try:
+                # chaos seam: force a registry eviction mid-session (the
+                # injected error is the *signal*, not a failure — the
+                # eviction itself is the fault being simulated)
+                FAULT.point("rpc.session.evict", session=sid)
+            except Exception:
+                self._sessions.pop(sid, None)
             session = self._sessions.get(sid)
-            if session is None or session.sched is not sched:
+            lost = session is None or session.sched is not sched
+            if not lost and client_fpr and session.fingerprint != client_fpr:
+                # same registry slot but a different state chain (the
+                # registry restarted or the slot was recycled): the
+                # resident state the client is deltaing against is gone
+                self._sessions.pop(sid, None)
+                lost = True
+            if lost and client_fpr:
+                # typed loss: the client maps this to ONE silent snapshot
+                # re-solve. NOT_FOUND is deliberately non-transient (the
+                # retry loop must not storm) and distinct from
+                # FAILED_PRECONDITION (which drives re-Configure).
+                context.abort(
+                    grpc.StatusCode.NOT_FOUND,
+                    f"SESSION_LOST: resident session {sid!r} evicted or "
+                    "restarted; re-snapshot",
+                )
+            if lost:
                 session = ResidentSession(sched)
                 self._sessions[sid] = session
                 while len(self._sessions) > 8:
                     # bounded registry: evict the oldest session (its next
-                    # round simply re-solves cold and re-adopts)
+                    # round surfaces as SESSION_LOST and re-snapshots)
                     self._sessions.pop(next(iter(self._sessions)))
         return session
+
+    @staticmethod
+    def _echo_session_fpr(context, session) -> None:
+        """Trailing metadata: the fingerprint of the resident state this
+        solve left behind, the client's proof-of-continuity token."""
+        if session is None:
+            return
+        try:
+            context.set_trailing_metadata(
+                (("ktpu-session-fpr", session.fingerprint),)
+            )
+        except Exception:
+            pass  # context already terminated (deadline); nothing to echo
 
     @staticmethod
     def _server_span(name: str, context):
@@ -229,6 +273,7 @@ class SolverService:
         while True:
             item = frames.get()
             if item is _DONE:
+                self._echo_session_fpr(context, session)
                 return
             if isinstance(item, BaseException):
                 raise item
@@ -301,6 +346,7 @@ class SolverService:
         engine = session if session is not None else sched
         with self._solve_lock:
             result = engine.solve(*args, **kwargs)
+        self._echo_session_fpr(context, session)
         return self._result_pb(sched, result)
 
     @staticmethod

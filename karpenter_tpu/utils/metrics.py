@@ -476,3 +476,30 @@ SHARD_REPLICATED_BYTES = REGISTRY.gauge(
     " mesh device in the last meshed solve (the catalog, [.., T] masks and"
     " window/bank columns shard over (dp × it) and are excluded)",
 )
+# ---- guardrails (guard/, PR 10) ----
+GUARD_AUDITS = REGISTRY.counter(
+    "ktpu_guard_audits_total",
+    "Shadow audits of exactness-critical fast paths: with probability"
+    " KTPU_GUARD_AUDIT_RATE a resident delta round / committed dp-shard"
+    " merge group / incremental kscan grid reuse / encode-cache hit is"
+    " re-derived via its exact twin and compared bit-exact; verdict is"
+    " pass or divergence (a divergence writes a repro bundle to"
+    " KTPU_GUARD_DIR and quarantines the path)",
+    ("path", "verdict"),
+)
+GUARD_QUARANTINED = REGISTRY.gauge(
+    "ktpu_guard_quarantined",
+    "1 while a fast path is quarantined after a shadow-audit divergence"
+    " (resident -> snapshot solves, speculative -> sequential replay,"
+    " grid -> full recompute, encode_cache -> bypass); clears on TTL"
+    " expiry (KTPU_GUARD_TTL_S) or restart",
+    ("path",),
+)
+WATCHDOG_STALLS = REGISTRY.counter(
+    "ktpu_watchdog_stalls_total",
+    "Device dispatches the watchdog declared stalled (no completion"
+    " within KTPU_WATCHDOG_S — the collective-rendezvous deadlock class);"
+    " each stall dumps all-thread stacks and fails the solve into the"
+    " host-fallback ladder instead of hanging",
+    ("section",),
+)

@@ -73,6 +73,11 @@ delta = [make_pod(f"rd-{i}", cpu=0.5) for i in range(8)]
 dres = session.solve(pods + delta)
 assert session.last_mode == "delta", session.last_reason
 assert not dres.unschedulable
+# the delta round ran under KTPU_GUARD_AUDIT_RATE=1.0 (ISSUE 10): the
+# shadow audit's cold-twin solve compiled through the SAME cache, and
+# it must agree with the delta result bit-exactly
+audit = session.last_timings["resident"]["audit"]
+assert audit is not None and audit["verdict"] == "pass", audit
 rres = session.solve(list(pods))  # retract the delta batch
 assert session.last_mode == "delta", session.last_reason
 assert len(rres.claims) == len(sres.claims)
@@ -82,6 +87,7 @@ print(json.dumps({
     "claims": len(result.claims),
     "gang_claims": gang_claims,
     "delta_claims": len(dres.claims),
+    "audit_verdict": audit["verdict"],
     "window": scan.get("window"),
 }))
 """
@@ -94,6 +100,10 @@ def _run_child(cache_dir: str) -> dict:
     # executables (cache keys include W via the carry shapes); without the
     # pin, determinism would hinge on the adaptive sizing heuristics
     env["KTPU_SCAN_WINDOW"] = "32"
+    # force the shadow audit on (ISSUE 10): the child's delta round is
+    # audited against its cold twin, so guardrail executables join the
+    # cache-key stability contract
+    env["KTPU_GUARD_AUDIT_RATE"] = "1.0"
     out = subprocess.run(
         [sys.executable, "-c", _CHILD],
         capture_output=True,

@@ -1,0 +1,469 @@
+"""Guardrails (guard/, ISSUE 10): shadow audits over the exactness-critical
+fast paths, fast-path quarantine, transactional resident state, the
+dispatch watchdog, and the SESSION_LOST re-snapshot protocol.
+
+The acceptance properties under test:
+
+- a mid-apply exception leaves the resident session INVALIDATED, not
+  poisoned — the same round re-solves full and is bit-identical to cold;
+- a tripped quarantine routes the path onto its exact twin until cleared;
+- a lying fast path (seeded via ``KTPU_GUARD_LIE``) is CAUGHT by the
+  shadow audit: the caller gets the exact result, the path quarantines,
+  the repro bundle loads and replays to a nonzero exit;
+- a stalled device dispatch converts into a host-fallback solve instead
+  of a hang;
+- a server-side resident-session eviction surfaces as one typed
+  SESSION_LOST and exactly one silent client re-snapshot.
+
+Everything is CPU-sized for tier-1; the replay subprocess is the one
+deliberately slow piece (it is the satellite's CLI contract).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from karpenter_tpu import guard
+from karpenter_tpu.controllers.provisioning import TPUScheduler
+from karpenter_tpu.faultinject import active_plan
+from karpenter_tpu.guard import bundle as guard_bundle
+
+from test_resident import (
+    assert_identical,
+    cold_solve,
+    kind_pods,
+    make_templates,
+    session_scheduler,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_guard_state(monkeypatch):
+    """Every test starts and ends with no quarantine, an empty audit log,
+    and the guard knobs unset (rate defaults to 0 — audits off)."""
+    for var in (
+        "KTPU_GUARD_AUDIT_RATE",
+        "KTPU_GUARD_DIR",
+        "KTPU_GUARD_LIE",
+        "KTPU_GUARD_TTL_S",
+        "KTPU_WATCHDOG_S",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    guard.QUARANTINE.reset()
+    guard.reset_log()
+    yield
+    guard.QUARANTINE.reset()
+    guard.reset_log()
+
+
+class TestTransactionalResident:
+    def test_mid_apply_fault_invalidates_not_poisons(self, monkeypatch):
+        """An exception between the retract and append passes (the
+        worst spot: state half-mutated) must drop the resident state and
+        re-solve full — bit-identical to cold — and the NEXT round is a
+        healthy delta again."""
+        session = session_scheduler(monkeypatch)
+        base = kind_pods("a", 12) + kind_pods("b", 8)
+        session.solve(list(base))
+        assert session.last_mode == "full"
+        union = base + kind_pods("c", 6)
+        plan = {
+            "rules": [
+                {
+                    "point": "solver.resident.apply",
+                    "error": "runtime",
+                    "times": 1,
+                    "match": {"stage": "mid"},
+                }
+            ]
+        }
+        with active_plan(plan):
+            r = session.solve(list(union))
+        assert session.last_mode == "invalidated", session.last_reason
+        assert session.last_reason.startswith("apply_error:")
+        assert_identical(cold_solve(union), r)
+        # the session re-snapshotted during the full solve: next arrival
+        # rides the delta path again, still exact
+        union2 = union + kind_pods("d", 4)
+        r2 = session.solve(list(union2))
+        assert session.last_mode == "delta", session.last_reason
+        assert_identical(cold_solve(union2), r2)
+
+    def test_fingerprint_chains_rounds(self, monkeypatch):
+        session = session_scheduler(monkeypatch)
+        assert session.fingerprint == ""
+        base = kind_pods("a", 10)
+        session.solve(list(base))
+        f1 = session.fingerprint
+        assert f1
+        session.solve(list(base + kind_pods("b", 5)))
+        f2 = session.fingerprint
+        assert f2 and f2 != f1
+
+
+class TestQuarantine:
+    def test_resident_quarantine_routes_to_full(self, monkeypatch):
+        session = session_scheduler(monkeypatch)
+        base = kind_pods("a", 10)
+        session.solve(list(base))
+        guard.QUARANTINE.trip("resident", reason="test")
+        union = base + kind_pods("b", 5)
+        r = session.solve(list(union))
+        assert session.last_mode == "full"
+        assert session.last_reason == "quarantined"
+        assert_identical(cold_solve(union), r)
+        guard.QUARANTINE.clear("resident")
+        union2 = union + kind_pods("c", 4)
+        r2 = session.solve(list(union2))
+        assert session.last_mode == "delta", session.last_reason
+        assert_identical(cold_solve(union2), r2)
+
+    def test_encode_cache_quarantine_bypasses_cache(self):
+        from karpenter_tpu.utils.metrics import ENCODE_CACHE_HITS
+
+        sched = TPUScheduler(make_templates(), max_claims=128)
+        pods = kind_pods("a", 8) + kind_pods("b", 8)
+        sched.solve(list(pods))
+        before = ENCODE_CACHE_HITS.get()
+        sched.solve(list(pods))
+        assert ENCODE_CACHE_HITS.get() > before  # warm: rows reused
+        guard.QUARANTINE.trip("encode_cache", reason="test")
+        frozen = ENCODE_CACHE_HITS.get()
+        r = sched.solve(list(pods))
+        assert ENCODE_CACHE_HITS.get() == frozen  # bypassed outright
+        assert not r.unschedulable
+
+    def test_ttl_expiry_clears(self):
+        clock = [0.0]
+        q = guard.Quarantine(now=lambda: clock[0])
+        q.trip("grid", reason="test", ttl_s=10.0)
+        assert q.active("grid")
+        clock[0] = 10.5
+        assert not q.active("grid")
+
+
+class TestLyingFastPaths:
+    def test_lying_resident_is_caught_bundled_and_replayable(
+        self, monkeypatch, tmp_path
+    ):
+        """The seeded lying-fast-path fixture: KTPU_GUARD_LIE=resident
+        GENUINELY corrupts the delta result, so only the shadow audit
+        stands between the lie and the caller. The audit must catch it,
+        serve the exact twin, quarantine the path, write a bundle that
+        loads, and the replay CLI must exit nonzero on it."""
+        monkeypatch.setenv("KTPU_GUARD_AUDIT_RATE", "1.0")
+        monkeypatch.setenv("KTPU_GUARD_LIE", "resident")
+        monkeypatch.setenv("KTPU_GUARD_DIR", str(tmp_path))
+        session = session_scheduler(monkeypatch)
+        base = kind_pods("a", 10) + kind_pods("b", 6)
+        session.solve(list(base))  # full round: no delta, no lie yet
+        union = base + kind_pods("c", 5)
+        r = session.solve(list(union))
+        # the caller saw the exact twin, not the lie
+        assert session.last_mode == "full"
+        assert session.last_reason == "guard_divergence"
+        assert_identical(cold_solve(union), r)
+        assert guard.divergences("resident")
+        assert guard.QUARANTINE.active("resident")
+        audit = session.last_timings["resident"]["audit"]
+        assert audit["verdict"] == "divergence"
+        bundle_path = audit["bundle"]
+        assert bundle_path and os.path.exists(bundle_path)
+        doc = guard_bundle.load_bundle(bundle_path)
+        assert doc["path"] == "resident"
+        templates, pods_by_uid, existing, rounds = guard_bundle.materialize(doc)
+        assert templates and pods_by_uid and rounds
+        assert all(u in pods_by_uid for rnd in rounds for u in rnd)
+        # subsequent rounds stay exact while quarantined (full path)
+        union2 = union + kind_pods("d", 4)
+        r2 = session.solve(list(union2))
+        assert session.last_mode == "full"
+        assert session.last_reason == "quarantined"
+        assert_identical(cold_solve(union2), r2)
+        # the replay CLI reproduces the divergence (the bundle recorded
+        # KTPU_GUARD_LIE, so the lying path re-arms in the child) and
+        # exits nonzero
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, "-m", "karpenter_tpu.guard.replay", bundle_path],
+            capture_output=True,
+            text=True,
+            timeout=420,
+            env=env,
+        )
+        assert proc.returncode == 1, proc.stderr + proc.stdout
+        summary = json.loads(proc.stdout)
+        assert summary["reproduced"] is True
+        assert summary["path"] == "resident"
+
+    def test_lying_encode_cache_is_caught_and_dropped(self, monkeypatch):
+        """A poisoned cache row is detected on the hit path; the caller
+        gets freshly-encoded rows, the cache is dropped, the path
+        quarantines — and the solve is still exact."""
+        monkeypatch.setenv("KTPU_GUARD_AUDIT_RATE", "1.0")
+        monkeypatch.setenv("KTPU_GUARD_LIE", "encode_cache")
+        sched = TPUScheduler(make_templates(), max_claims=128)
+        pods = kind_pods("a", 8) + kind_pods("b", 8)
+        r1 = sched.solve(list(pods))
+        r2 = sched.solve(list(pods))  # hit path -> audit fires -> lie caught
+        assert guard.divergences("encode_cache")
+        assert guard.QUARANTINE.active("encode_cache")
+        assert r2.assignments == r1.assignments
+        assert len(r2.claims) == len(r1.claims)
+
+
+class TestGridAudit:
+    def _zonal_pods(self):
+        """Three same-request kind-scan segments: the incremental [W, T,
+        GR] grid reuse fires at the segment boundaries (the fast path the
+        audit shadows)."""
+        from karpenter_tpu.models import labels as l
+        from karpenter_tpu.models.pod import TopologySpreadConstraint
+        from karpenter_tpu.models.pod import make_pod
+
+        pods = []
+        for k in range(3):
+            for i in range(8):
+                p = make_pod(f"z{k}-{i}", cpu=1.0, memory="1Gi")
+                p.metadata.labels = {"spread": "zonal", "shard": f"s{k}"}
+                p.spec.topology_spread_constraints = [
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=l.LABEL_TOPOLOGY_ZONE,
+                        label_selector={"spread": "zonal"},
+                    )
+                ]
+                pods.append(p)
+        return pods
+
+    def test_grid_audit_passes_against_full_recompute(self, monkeypatch):
+        import bench
+
+        monkeypatch.setenv("KTPU_GUARD_AUDIT_RATE", "1.0")
+        pods = self._zonal_pods()
+        templates = make_templates(24)
+        sched = TPUScheduler(templates, max_claims=64)
+        result = sched.solve(list(pods))
+        host, _ = bench.host_solve(templates, pods)
+        from test_solver import assert_same_packing
+
+        assert_same_packing(host, result)
+        assert any(
+            rec["path"] == "grid" and rec["verdict"] == "pass"
+            for rec in guard.AUDIT_LOG
+        ), guard.AUDIT_LOG
+
+    def test_lying_grid_is_caught_and_quarantined(self, monkeypatch):
+        import bench
+
+        monkeypatch.setenv("KTPU_GUARD_AUDIT_RATE", "1.0")
+        monkeypatch.setenv("KTPU_GUARD_LIE", "grid")
+        pods = self._zonal_pods()
+        templates = make_templates(24)
+        sched = TPUScheduler(templates, max_claims=64)
+        result = sched.solve(list(pods))
+        assert guard.divergences("grid")
+        assert guard.QUARANTINE.active("grid")
+        # the audit served the exact (full-recompute) twin
+        host, _ = bench.host_solve(templates, pods)
+        from test_solver import assert_same_packing
+
+        assert_same_packing(host, result)
+        # while quarantined the solve routes onto the full recompute and
+        # stays exact
+        result2 = sched.solve(list(pods))
+        assert_same_packing(host, result2)
+
+
+class TestSpeculativeAudit:
+    def test_committed_merge_audit_passes(self, monkeypatch):
+        """A rate-1.0 audit over the dp-speculative path: every committed
+        merge round is re-derived via the sequential dispatch twin and
+        must agree — and the solve stays bit-identical to single-device."""
+        from test_shard import (
+            dp_scheduler,
+            make_templates as shard_templates,
+            saturating_kind_pods,
+        )
+
+        monkeypatch.setenv("KTPU_GUARD_AUDIT_RATE", "1.0")
+        pods = saturating_kind_pods(256)
+        sched = dp_scheduler(monkeypatch)
+        meshed = sched.solve(pods)
+        assert sched.last_timings["shard"]["groups_committed"] >= 2
+        assert any(
+            rec["path"] == "speculative" and rec["verdict"] == "pass"
+            for rec in guard.AUDIT_LOG
+        ), guard.AUDIT_LOG
+        assert not guard.divergences()
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        single = TPUScheduler(shard_templates()).solve(pods)
+        assert_identical(single, meshed)
+
+    def test_lying_speculative_is_caught(self, monkeypatch):
+        """The lying fixture corrupts the merged state the audit compares:
+        the sequential twin wins, the path quarantines, and the caller
+        still gets the single-device answer."""
+        from test_shard import (
+            dp_scheduler,
+            make_templates as shard_templates,
+            saturating_kind_pods,
+        )
+
+        monkeypatch.setenv("KTPU_GUARD_AUDIT_RATE", "1.0")
+        monkeypatch.setenv("KTPU_GUARD_LIE", "speculative")
+        pods = saturating_kind_pods(256)
+        sched = dp_scheduler(monkeypatch)
+        meshed = sched.solve(pods)
+        assert guard.divergences("speculative")
+        assert guard.QUARANTINE.active("speculative")
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        single = TPUScheduler(shard_templates()).solve(pods)
+        assert_identical(single, meshed)
+        # a quarantined speculative path runs the sequential pipeline —
+        # still exact
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "4")
+        monkeypatch.delenv("KTPU_GUARD_LIE", raising=False)
+        sched2 = dp_scheduler(monkeypatch)
+        r2 = sched2.solve(pods)
+        assert_identical(single, r2)
+        shard = sched2.last_timings.get("shard") or {}
+        assert shard.get("merge_rounds", 0) == 0, shard
+
+
+class TestWatchdog:
+    def test_stalled_dispatch_falls_back_to_host(self, monkeypatch):
+        """A latency fault at solver.dispatch (the stand-in for a hung
+        collective rendezvous) must trip the deadline thread and convert
+        the solve into a host fallback — a RESULT, not a hang."""
+        from karpenter_tpu.utils.metrics import SOLVER_FALLBACK, WATCHDOG_STALLS
+
+        monkeypatch.setenv("KTPU_WATCHDOG_S", "0.3")
+        sched = TPUScheduler(make_templates(), max_claims=128)
+        pods = kind_pods("a", 8)
+        stalls0 = WATCHDOG_STALLS.get(section="dispatch")
+        fb0 = SOLVER_FALLBACK.get(reason="watchdog_stall")
+        plan = {
+            "rules": [
+                {
+                    "point": "solver.dispatch",
+                    "mode": "latency",
+                    "delay_s": 2.0,
+                    "times": 1,
+                }
+            ]
+        }
+        with active_plan(plan):
+            r = sched.solve(list(pods))
+        assert WATCHDOG_STALLS.get(section="dispatch") == stalls0 + 1
+        assert SOLVER_FALLBACK.get(reason="watchdog_stall") == fb0 + 1
+        assert not r.unschedulable
+        assert set(r.assignments) == {p.uid for p in pods}
+
+    def test_disabled_watchdog_is_a_direct_call(self):
+        from karpenter_tpu.guard.watchdog import run_guarded
+
+        # deadline <= 0: no worker thread, the callable runs inline
+        assert run_guarded(lambda: 41 + 1, section="test") == 42
+
+
+class TestSessionLost:
+    def test_forced_eviction_is_one_silent_resnapshot(self):
+        """An injected rpc.session.evict (server restart / registry LRU
+        stand-in) makes the NEXT Solve observe a typed SESSION_LOST; the
+        client recovers with exactly ONE silent snapshot re-solve counted
+        under ktpu_resident_rounds_total{mode="invalidated"}."""
+        from karpenter_tpu.rpc import RemoteScheduler, serve
+        from karpenter_tpu.utils.metrics import RESIDENT_ROUNDS
+
+        # the same config the resident differential suite uses: the adopt
+        # gate accepts it, so the server goes resident and fingerprints
+        templates = make_templates()
+        server, addr = serve("127.0.0.1:0")
+        try:
+            remote = RemoteScheduler(addr, templates, max_claims=128)
+            base = kind_pods("a", 10)
+            remote.solve(list(base))
+            # the server echoed its fingerprint in trailing metadata
+            assert remote._session_fpr
+            union = base + kind_pods("b", 5)
+            remote.solve(list(union))
+            fpr_before = remote._session_fpr
+            assert fpr_before
+            inv0 = RESIDENT_ROUNDS.get(mode="invalidated")
+            union2 = union + kind_pods("c", 4)
+            plan = {
+                "rules": [
+                    {"point": "rpc.session.evict", "error": "runtime", "times": 1}
+                ]
+            }
+            with active_plan(plan):
+                # the in-process server shares the global injector: its
+                # registry lookup fires the rule and force-evicts
+                r = remote.solve(list(union2))
+            assert RESIDENT_ROUNDS.get(mode="invalidated") == inv0 + 1
+            local = TPUScheduler(templates, max_claims=128).solve(list(union2))
+            assert r.assignments == local.assignments
+            assert len(r.claims) == len(local.claims)
+            # the retry re-snapshotted: a fresh fingerprint came back
+            assert remote._session_fpr
+            assert remote._session_fpr != fpr_before
+        finally:
+            server.stop(0)
+
+
+class TestAuditPlumbing:
+    def test_should_audit_rate_gate(self, monkeypatch):
+        monkeypatch.setenv("KTPU_GUARD_AUDIT_RATE", "0")
+        assert not guard.should_audit("resident")
+        monkeypatch.setenv("KTPU_GUARD_AUDIT_RATE", "1.0")
+        assert guard.should_audit("resident")
+        guard.QUARANTINE.trip("resident", reason="test")
+        # a quarantined path runs its exact twin ANYWAY: auditing it
+        # would re-derive the same computation twice for nothing
+        assert not guard.should_audit("resident")
+
+    def test_passing_audit_counts_and_keeps_delta(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("KTPU_GUARD_AUDIT_RATE", "1.0")
+        monkeypatch.setenv("KTPU_GUARD_DIR", str(tmp_path))
+        session = session_scheduler(monkeypatch)
+        base = kind_pods("a", 10)
+        session.solve(list(base))
+        union = base + kind_pods("b", 5)
+        r = session.solve(list(union))
+        assert session.last_mode == "delta", session.last_reason
+        audit = session.last_timings["resident"]["audit"]
+        assert audit["verdict"] == "pass"
+        assert audit["twin_s"] >= 0
+        assert not guard.divergences()
+        assert not os.listdir(tmp_path)  # no bundle on a passing audit
+        assert_identical(cold_solve(union), r)
+
+
+def test_pod_roundtrip_through_bundle():
+    """bundle.make_bundle/materialize preserves the solve inputs."""
+    sched = TPUScheduler(make_templates(), max_claims=128)
+    pods = kind_pods("a", 4)
+    doc = guard_bundle.make_bundle(
+        "resident",
+        "unit-test",
+        sched,
+        {p.uid: p for p in pods},
+        [[p.uid for p in pods]],
+        [],
+        detail={"k": 1},
+    )
+    templates, pods_by_uid, existing, rounds = guard_bundle.materialize(doc)
+    assert sorted(pods_by_uid) == sorted(p.uid for p in pods)
+    assert rounds == [[p.uid for p in pods]]
+    assert existing == []
+    assert len(templates) == len(sched.templates)
+    r_orig = TPUScheduler(make_templates(), max_claims=128).solve(list(pods))
+    r_rt = TPUScheduler(templates, max_claims=128).solve(
+        [pods_by_uid[u] for u in rounds[0]]
+    )
+    assert r_rt.assignments == r_orig.assignments

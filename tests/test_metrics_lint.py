@@ -183,6 +183,25 @@ def test_shard_families_are_registered():
         assert fam.help.strip()
 
 
+def test_guard_families_are_registered():
+    """ISSUE-10 families: shadow-audit verdicts, the per-path quarantine
+    breaker state, and watchdog-detected dispatch stalls."""
+    from karpenter_tpu.utils.metrics import Counter, Gauge
+
+    fams = {f.name: f for f in _families()}
+    expected = {
+        "ktpu_guard_audits_total": (Counter, ("path", "verdict")),
+        "ktpu_guard_quarantined": (Gauge, ("path",)),
+        "ktpu_watchdog_stalls_total": (Counter, ("section",)),
+    }
+    for name, (cls, labels) in expected.items():
+        fam = fams.get(name)
+        assert fam is not None, f"{name} not registered"
+        assert isinstance(fam, cls), (name, type(fam).__name__)
+        assert fam.label_names == labels, (name, fam.label_names)
+        assert fam.help.strip()
+
+
 def test_counters_end_in_total_and_histograms_in_seconds_or_pods():
     """Unit-suffix discipline for NEW families (grandfathered names keep
     their reference spellings verbatim)."""
