@@ -140,7 +140,9 @@ def host_solve(templates, pods):
 def run_stage(pods, n_types, max_claims, warm_runs=2, host_parity=False, mesh=None):
     from karpenter_tpu.controllers.provisioning import TPUScheduler
     from karpenter_tpu.envelope.sampler import measured
+    from karpenter_tpu.obs import ledger as obs_ledger
 
+    ledger_seq0 = obs_ledger.LEDGER.seq()
     # host resource envelope over the whole stage (cold solve included):
     # fills host_rss_mb (P95 of the RSS series) + cpu_s + avg_cores
     envelope = {}
@@ -193,6 +195,8 @@ def run_stage(pods, n_types, max_claims, warm_runs=2, host_parity=False, mesh=No
         out["shard"] = timings["shard"]
     if timings.get("padding"):
         out["padding"] = timings["padding"]
+    # the stage's flight-recorder digest (bench --report-rounds prints it)
+    out["rounds"] = _ledger_rounds_summary(ledger_seq0)
     if host_parity:
         # density on the record: the north star is throughput AT Go-FFD
         # packing density, so the oracle's nodes/price sit next to the
@@ -252,11 +256,14 @@ def run_steady_stage(
             out.append(p)
         return out
 
+    from karpenter_tpu.obs import ledger as obs_ledger
+
     rng = np.random.default_rng(seed)
     kind_size = 256
     base = []
     for k in range(max(resident_pods // kind_size, 1)):
         base.extend(kind_batch(f"base-{k}", kind_size))
+    ledger_seq0 = obs_ledger.LEDGER.seq()
     envelope = {}
     with measured(envelope, stage=f"steady_{resident_pods}x{delta_pods}"):
         templates = make_templates(100)
@@ -330,6 +337,10 @@ def run_steady_stage(
         "gate_min_speedup_x": STEADY_MIN_SPEEDUP_X,
         "speedup_x": speedup,
         "gate_ok": speedup >= STEADY_MIN_SPEEDUP_X,
+        # "rounds" above is the trace length; the ledger digest of the
+        # same rounds (mode mix + per-phase p50/p95) rides along under
+        # its own key (bench --report-rounds prints it)
+        "ledger_rounds": _ledger_rounds_summary(ledger_seq0),
         **envelope,
     }
 
@@ -699,6 +710,29 @@ def run_guard_stage(on_tpu: bool) -> dict:
         f"disabled should_audit gates cost {100 * overhead_frac:.2f}% of a solve"
     )
 
+    # 1b. the always-on flight recorder (ISSUE 12): recording a round is
+    # dict assembly + a deque append, no I/O with spill unset. Same
+    # discipline as the gates above: budget 1000 records and demand they
+    # cost < 1% of a solve (in reality one solve = ONE record, so the
+    # production margin is ~1000x wider than the assertion).
+    from karpenter_tpu.obs import ledger as obs_ledger
+
+    os.environ.pop(obs_ledger.ENV_DIR, None)
+    probe_ledger = obs_ledger.RoundLedger()
+    rec_template = {
+        "mode": "delta", "reason": "delta", "outcome": "ok", "pods": 64,
+        "wall_s": 0.01, "fallback": None, "sig": "00" * 8, "fpr": "11" * 8,
+    }
+    t0 = time.perf_counter()
+    for _ in range(n_calls):
+        probe_ledger.record(dict(rec_template))
+    ledger_per_call_s = (time.perf_counter() - t0) / n_calls
+    ledger_overhead_frac = (ledger_per_call_s * 1000) / clean_wall
+    assert ledger_overhead_frac < 0.01, (
+        f"round-ledger records cost {100 * ledger_overhead_frac:.2f}% of a "
+        "solve per 1000 — too hot for an always-on flight recorder"
+    )
+
     # 2. the paid path: a resident session takes one delta round with the
     # audit forced on; the twin cost comes out of last_timings
     session = sched.resident_session()
@@ -726,10 +760,67 @@ def run_guard_stage(on_tpu: bool) -> dict:
         "clean_wall_s": round(clean_wall, 4),
         "disabled_gate_ns": round(per_call_s * 1e9, 1),
         "disabled_overhead_frac_of_solve": round(overhead_frac, 6),
+        "ledger_record_ns": round(ledger_per_call_s * 1e9, 1),
+        "ledger_overhead_frac_of_solve": round(ledger_overhead_frac, 6),
         "audited_round_wall_s": round(audited_wall, 4),
         "audit_twin_s": round(stats["audit"]["twin_s"], 4),
         "audit_verdicts": verdicts,
     }
+
+
+def _ledger_percentile(vals: list, q: float) -> float:
+    s = sorted(vals)
+    if not s:
+        return 0.0
+    idx = min(int(round(q * (len(s) - 1))), len(s) - 1)
+    return s[idx]
+
+
+def _ledger_rounds_summary(seq0: int) -> dict:
+    """Summarize the round-ledger records a stage produced (everything
+    past ``seq0``): counts by mode + p50/p95 of the per-phase seconds.
+    The flight recorder is always on, so this is a free by-product of
+    the solves the stage already ran (ISSUE 12)."""
+    from karpenter_tpu.obs import ledger as obs_ledger
+
+    recs = [r for r in obs_ledger.LEDGER.since(seq0) if r.get("source") == "local"]
+    out: dict = {
+        "n": len(recs),
+        "modes": {},
+    }
+    for r in recs:
+        m = r.get("mode", "?")
+        out["modes"][m] = out["modes"].get(m, 0) + 1
+    for key in ("wall_s", "encode_s", "device_s", "decode_s"):
+        vals = [r[key] for r in recs if isinstance(r.get(key), (int, float))]
+        if vals:
+            out[key] = {
+                "p50": round(_ledger_percentile(vals, 0.50), 4),
+                "p95": round(_ledger_percentile(vals, 0.95), 4),
+            }
+    return out
+
+
+def _print_rounds_report(detail: dict) -> None:
+    """--report-rounds: the per-stage round-ledger digest — how many
+    rounds the stage recorded, their mode mix, and p50/p95 per phase.
+    The JSON line carries the same numbers under each stage's "rounds"
+    key."""
+    for stage, st in sorted(detail.items()):
+        if not isinstance(st, dict):
+            continue
+        rd = st.get("rounds")
+        if not isinstance(rd, dict):  # --steady: "rounds" is the trace length
+            rd = st.get("ledger_rounds")
+        if not isinstance(rd, dict) or "modes" not in rd:
+            continue
+        modes = ",".join(f"{m}={n}" for m, n in sorted(rd["modes"].items()))
+        phases = " ".join(
+            f"{key[:-2]}=p50:{rd[key]['p50']:.4f}/p95:{rd[key]['p95']:.4f}"
+            for key in ("wall_s", "encode_s", "device_s", "decode_s")
+            if key in rd
+        )
+        print(f"rounds {stage:>28s}: n={rd['n']:<4d} [{modes}] {phases}")
 
 
 def _print_padding_report(detail: dict) -> None:
@@ -804,6 +895,13 @@ def main() -> None:
         "rounds, committed/replayed chunk groups, replicated-bytes "
         "estimate; the same numbers land under each stage's 'shard' key "
         "in the final JSON line)",
+    )
+    parser.add_argument(
+        "--report-rounds",
+        action="store_true",
+        help="print the per-stage round-ledger digest (round count, mode "
+        "mix, p50/p95 wall/encode/device/decode seconds; the same numbers "
+        "land under each stage's 'rounds' key in the final JSON line)",
     )
     parser.add_argument(
         "--steady",
@@ -1063,6 +1161,8 @@ def main() -> None:
         _print_scan_report(detail)
     if args.report_shard:
         _print_shard_report(detail)
+    if args.report_rounds:
+        _print_rounds_report(detail)
 
     print(
         json.dumps(

@@ -526,6 +526,13 @@ class TPUScheduler:
     """One scheduler instance per template/catalog set; reusable across
     solve() batches (the vocab may grow between calls)."""
 
+    # round-ledger plumbing: a ResidentSession suppresses the wrapped
+    # scheduler's per-solve records (its internal full solves and audit
+    # twins are sub-steps of ONE session round, which it records itself);
+    # host_solve stamps the fallback reason for the round's record
+    _ledger_suppress = False
+    _last_fallback: Optional[str] = None
+
     def __init__(
         self,
         templates: list[ClaimTemplate],
@@ -820,6 +827,42 @@ class TPUScheduler:
         self,
         pods: Sequence[Pod],
         existing_nodes: Optional[list[ExistingSimNode]] = None,
+        *args,
+        **kwargs,
+    ) -> SchedulingResult:
+        """``_solve_impl`` plus one round-ledger record (obs/ledger.py):
+        every solve — device, host fallback, or a raised error — leaves a
+        flight-recorder entry unless a ResidentSession is recording the
+        enclosing round itself (``_ledger_suppress``)."""
+        if self._ledger_suppress:
+            return self._solve_impl(pods, existing_nodes, *args, **kwargs)
+        import time as _time
+
+        from karpenter_tpu.obs import ledger as obs_ledger
+
+        self._last_fallback = None
+        n_pods = len(pods) if hasattr(pods, "__len__") else 0
+        t0 = _time.perf_counter()
+        try:
+            result = self._solve_impl(pods, existing_nodes, *args, **kwargs)
+        except BaseException as err:
+            obs_ledger.record_solve(
+                self,
+                pods=n_pods,
+                wall_s=_time.perf_counter() - t0,
+                reason=type(err).__name__,
+                outcome="error",
+            )
+            raise
+        obs_ledger.record_solve(
+            self, pods=n_pods, wall_s=_time.perf_counter() - t0
+        )
+        return result
+
+    def _solve_impl(
+        self,
+        pods: Sequence[Pod],
+        existing_nodes: Optional[list[ExistingSimNode]] = None,
         budgets: Optional[dict[str, dict[str, float]]] = None,
         topology: Optional[Topology] = None,
         topology_factory=None,
@@ -861,6 +904,7 @@ class TPUScheduler:
 
             # a host-oracle result has no device state to go resident on
             self._captured = None
+            self._last_fallback = reason  # round-ledger: why we degraded
             if chunk_sink is not None:
                 # any streamed chunks came from an abandoned device round;
                 # the consumer must discard them before the full result
@@ -996,12 +1040,14 @@ class TPUScheduler:
             # divergence re-solves the whole problem on the exact oracle
             # and records the event instead of failing provisioning
             return host_solve("divergence")
-        except DispatchStallError:
-            # the watchdog declared the backend stalled (the collective-
-            # rendezvous deadlock class): the stuck worker is leaked and
+        except DispatchStallError as err:
+            # the watchdog declared a solve section stalled — the device
+            # dispatch (the collective-rendezvous deadlock class) or a
+            # runaway host encode/decode: the stuck worker is leaked and
             # the stacks are already dumped — this solve completes on the
-            # host oracle instead of hanging the provisioner
-            return host_solve("watchdog_stall")
+            # host oracle instead of hanging the provisioner, under a
+            # per-section degradation rung
+            return host_solve(f"watchdog_{err.section}")
         except Exception as err:  # noqa: BLE001 — the degradation ladder
             # device dispatch / decode blowing up (an XLA abort, a device
             # gone bad, an injected solver.dispatch fault) must not fail
@@ -1081,7 +1127,12 @@ class TPUScheduler:
         pad_padded0 = dict(self._pad_cache.padded)
         try:
             with TRACER.span("solve.encode", pods=len(pods)):
-                pods_sorted, enc = self._encode(pods, existing_nodes, budgets, topology)
+                # host encode under its own watchdog section (STATUS
+                # known gap: encode/decode stalls were not deadlined)
+                pods_sorted, enc = run_guarded(
+                    lambda: self._encode(pods, existing_nodes, budgets, topology),
+                    section="encode",
+                )
         finally:
             self._adaptive_claims = False
         _t_encode_done = _time.perf_counter()
@@ -1094,7 +1145,10 @@ class TPUScheduler:
         self._t_fetch_done = None
         self._pipeline_stats = None
         with TRACER.span("solve.decode") as _dsp:
-            out = self._decode(pods_sorted, state, outputs, enc, tmpl_snaps)
+            out = run_guarded(
+                lambda: self._decode(pods_sorted, state, outputs, enc, tmpl_snaps),
+                section="decode",
+            )
             _dsp.set(claims=len(out.claims), unschedulable=len(out.unschedulable))
         _t_end = _time.perf_counter()
         # phase timings for profiling/bench (VERDICT: expose the device vs
@@ -3617,6 +3671,11 @@ class ResidentSession:
         )
         t0 = _time.perf_counter()
         self.last_audit = None
+        # the session records ONE ledger entry for the whole round; the
+        # wrapped scheduler's internal solves (full path, audit twin) are
+        # sub-steps, not rounds
+        self.sched._ledger_suppress = True
+        self.sched._last_fallback = None
         try:
             if not supported:
                 raise _DeltaUnsafe("full", "unsupported_args")
@@ -3642,6 +3701,8 @@ class ResidentSession:
             result = self._solve_full(
                 pods, existing_nodes, kwargs, capture=supported
             )
+        finally:
+            self.sched._ledger_suppress = False
         self.last_mode, self.last_reason = mode, reason
         self.rounds_total[mode] += 1
         from karpenter_tpu.utils.metrics import RESIDENT_ROUNDS
@@ -3656,6 +3717,11 @@ class ResidentSession:
             "wall_s": _time.perf_counter() - t0,
             "audit": self.last_audit,
         }
+        from karpenter_tpu.obs import ledger as obs_ledger
+
+        obs_ledger.record_session_round(
+            self, pods=len(pods), wall_s=_time.perf_counter() - t0
+        )
         return result
 
     # -- guard: shadow audit + state fingerprint ---------------------------

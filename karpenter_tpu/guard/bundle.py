@@ -97,16 +97,23 @@ def make_bundle(
     }
 
 
-def write_bundle(doc: dict, guard_dir: str) -> str:
-    os.makedirs(guard_dir, exist_ok=True)
-    stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime(doc["created_unix"]))
-    fname = f"divergence-{doc['path']}-{stamp}-{os.getpid()}.json"
-    out = os.path.join(guard_dir, fname)
+def write_doc(doc: dict, dirpath: str, fname: str) -> str:
+    """Atomic JSON document write (tmp + rename), shared by divergence
+    bundles and the round ledger's problem capsules / materializations —
+    readers never see a torn file."""
+    os.makedirs(dirpath, exist_ok=True)
+    out = os.path.join(dirpath, fname)
     tmp = out + ".tmp"
     with open(tmp, "w") as fh:
         json.dump(doc, fh, sort_keys=True, indent=1)
-    os.replace(tmp, out)  # readers never see a torn bundle
+    os.replace(tmp, out)
     return out
+
+
+def write_bundle(doc: dict, guard_dir: str) -> str:
+    stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime(doc["created_unix"]))
+    fname = f"divergence-{doc['path']}-{stamp}-{os.getpid()}.json"
+    return write_doc(doc, guard_dir, fname)
 
 
 def load_bundle(path: str) -> dict:

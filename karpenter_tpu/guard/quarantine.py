@@ -17,7 +17,7 @@ from typing import Callable, Dict, Optional
 
 from karpenter_tpu.guard import config
 from karpenter_tpu.utils.logging import get_logger
-from karpenter_tpu.utils.metrics import GUARD_QUARANTINED
+from karpenter_tpu.utils.metrics import GUARD_QUARANTINE_TTL, GUARD_QUARANTINED
 
 
 def _log():
@@ -30,13 +30,18 @@ class Quarantine:
         self._lock = threading.Lock()
         self._until: Dict[str, float] = {}
         self._reason: Dict[str, str] = {}
+        # all-time trip count per path (survives expiry/clear: the whole
+        # point is counting how often a path keeps lying)
+        self._trips: Dict[str, int] = {}
 
     def trip(self, path: str, reason: str = "", ttl_s: Optional[float] = None) -> None:
         ttl = config.quarantine_ttl_s() if ttl_s is None else ttl_s
         with self._lock:
             self._until[path] = self._now() + ttl
             self._reason[path] = reason
+            self._trips[path] = self._trips.get(path, 0) + 1
         GUARD_QUARANTINED.set(1, path=path)
+        GUARD_QUARANTINE_TTL.set(ttl, path=path)
         _log().warn(
             "guard: quarantined fast path; routing onto the exact twin",
             path=path,
@@ -57,6 +62,7 @@ class Quarantine:
                 return True
         if expired:
             GUARD_QUARANTINED.set(0, path=path)
+            GUARD_QUARANTINE_TTL.set(0, path=path)
             _log().info("guard: quarantine expired", path=path)
         return False
 
@@ -69,20 +75,40 @@ class Quarantine:
             self._until.pop(path, None)
             self._reason.pop(path, None)
         GUARD_QUARANTINED.set(0, path=path)
+        GUARD_QUARANTINE_TTL.set(0, path=path)
 
     def reset(self) -> None:
         with self._lock:
             paths = list(self._until)
             self._until.clear()
             self._reason.clear()
+            self._trips.clear()
         for p in paths:
             GUARD_QUARANTINED.set(0, path=p)
+            GUARD_QUARANTINE_TTL.set(0, path=p)
 
     def snapshot(self) -> Dict[str, float]:
         """path -> seconds remaining (for diagnostics / bench JSON)."""
         now = self._now()
         with self._lock:
             return {p: max(0.0, t - now) for p, t in self._until.items()}
+
+    def state(self) -> Dict[str, dict]:
+        """Full inspectable state for /debug/quarantine: every path that
+        has ever tripped, with TTL remaining (0 when expired/cleared),
+        the tripping reason, and the all-time trip count."""
+        now = self._now()
+        with self._lock:
+            paths = set(self._trips) | set(self._until)
+            return {
+                p: {
+                    "ttl_s": round(max(0.0, self._until.get(p, now) - now), 3),
+                    "active": self._until.get(p, now) > now,
+                    "reason": self._reason.get(p, ""),
+                    "trips": self._trips.get(p, 0),
+                }
+                for p in sorted(paths)
+            }
 
 
 QUARANTINE = Quarantine()

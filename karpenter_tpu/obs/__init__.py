@@ -1,0 +1,19 @@
+"""Observability (ISSUE 12): round ledger + compile observatory.
+
+- ``obs.ledger``: the always-on flight recorder — one compact record per
+  solve round (mode, reason, round-sig/fingerprint chain, per-stage
+  timings, guard verdicts, fallback reasons, attributed compiles) in a
+  bounded ring, optionally spilled as JSONL under ``KTPU_LEDGER_DIR``
+  with replayable problem capsules; ``python -m karpenter_tpu.obs.ledger``
+  reconstructs incident timelines and materializes any recorded round
+  into a ``guard.replay``-compatible bundle.
+- ``obs.observatory``: JIT retrace telemetry — compiles attributed to
+  named kernels, retrace-storm detection (``KTPU_RETRACE_WARN``),
+  per-executable cost analysis, and on-demand ``jax.profiler`` capture
+  behind ``/debug/profile``.
+"""
+
+from karpenter_tpu.obs.ledger import LEDGER, RoundLedger
+from karpenter_tpu.obs.observatory import named_kernel
+
+__all__ = ["LEDGER", "RoundLedger", "named_kernel"]

@@ -1,0 +1,461 @@
+"""Round ledger: an always-on flight recorder for solve rounds.
+
+The guardrails PR gave every resident session a blake2s committed-round
+fingerprint chain — "the exact transcript needed for replay" — but until
+now nothing recorded it: when a round was slow, quarantined, or
+divergent, the evidence was gone unless a sampled audit happened to
+fire. The ledger keeps one COMPACT record per solve round in a bounded
+in-memory ring (``KTPU_LEDGER_RING``, default 256), optionally spilled
+as JSONL under ``KTPU_LEDGER_DIR`` with size-capped rotation:
+
+- the session round-sig and fingerprint (the replay-transcript chain),
+- mode (``delta|full|invalidated|quarantined``) and its gate reason,
+- per-stage ``last_timings`` (padding/scan/pipeline/shard/kscan) plus
+  wall/encode/device/decode seconds,
+- the shadow-audit verdict, host-fallback reason, and any compiles the
+  observatory attributed to the round (kernel, seconds, flops/bytes).
+
+When spill is enabled, a resident round additionally writes a *problem
+capsule* — a full guard-bundle document (templates/pods/existing as the
+RPC codec encodes them, plus the backend/env signature) whose ``rounds``
+field is the session transcript up to that round. ``python -m
+karpenter_tpu.obs.ledger materialize <seq>`` resolves a record to its
+capsule and emits a bundle that ``python -m karpenter_tpu.guard.replay``
+re-runs bit-exactly (exit 0 = the recorded round reproduces clean).
+
+Cost model: recording is dict assembly plus one lock-guarded deque
+append — no encoding, no I/O unless spill is opted in. ``bench.py
+--guard`` pins the in-memory record cost below 1% of a solve.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from karpenter_tpu.utils.metrics import LEDGER_ROUNDS
+
+ENV_DIR = "KTPU_LEDGER_DIR"
+ENV_RING = "KTPU_LEDGER_RING"
+DEFAULT_RING = 256
+
+# JSONL spill rotation: rounds.jsonl rolls to .1/.2/.3 at the size cap
+SPILL_FILE = "rounds.jsonl"
+SPILL_MAX_BYTES = 4 * 2**20
+SPILL_KEEP = 3
+
+# the stage keys of TPUScheduler.last_timings worth keeping per record
+_STAGE_KEYS = ("padding", "scan", "pipeline", "shard", "kscan")
+
+
+def ring_size() -> int:
+    try:
+        n = int(os.environ.get(ENV_RING, DEFAULT_RING))
+    except ValueError:
+        return DEFAULT_RING
+    return max(n, 1)
+
+
+def spill_dir() -> Optional[str]:
+    return os.environ.get(ENV_DIR) or None
+
+
+class RoundLedger:
+    """Bounded ring of per-round records + optional JSONL spill."""
+
+    def __init__(self, now=time.time):
+        self._now = now
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=ring_size())
+        self._seq = itertools.count(1)
+        # capsule-sig -> filename already written (spill dedup)
+        self._capsules: dict = {}
+
+    # -- recording ---------------------------------------------------------
+
+    def record(self, rec: dict) -> dict:
+        """Stamp seq/t onto ``rec``, append it to the ring, spill it when
+        KTPU_LEDGER_DIR is set, and count it. Returns the stamped record
+        (the caller's dict, mutated)."""
+        rec["seq"] = next(self._seq)
+        rec["t"] = self._now()
+        rec.setdefault("source", "local")
+        with self._lock:
+            if self._ring.maxlen != ring_size():
+                self._ring = deque(self._ring, maxlen=ring_size())
+            self._ring.append(rec)
+        LEDGER_ROUNDS.inc(source=rec["source"])
+        d = spill_dir()
+        if d:
+            self._spill(rec, d)
+        return rec
+
+    def _spill(self, rec: dict, d: str) -> None:
+        try:
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(d, SPILL_FILE)
+            line = json.dumps(rec, sort_keys=True) + "\n"
+            try:
+                if os.path.getsize(path) + len(line) > SPILL_MAX_BYTES:
+                    self._rotate(path)
+            except OSError:
+                pass  # no file yet
+            with open(path, "a") as fh:
+                fh.write(line)
+        except OSError:
+            pass  # the flight recorder must never take down a solve
+
+    @staticmethod
+    def _rotate(path: str) -> None:
+        for i in range(SPILL_KEEP, 1, -1):
+            src = f"{path}.{i - 1}"
+            if os.path.exists(src):
+                os.replace(src, f"{path}.{i}")
+        if os.path.exists(path):
+            os.replace(path, f"{path}.1")
+
+    # -- capsules ----------------------------------------------------------
+
+    def save_capsule(self, doc: dict, sig: str) -> Optional[str]:
+        """Write a guard-bundle-format problem capsule once per distinct
+        signature; returns the filename (relative to the spill dir) or
+        None when spill is disabled / the write failed."""
+        d = spill_dir()
+        if not d:
+            return None
+        with self._lock:
+            cached = self._capsules.get(sig)
+        if cached is not None:
+            return cached
+        from karpenter_tpu.guard import bundle as guard_bundle
+
+        fname = f"capsule-{sig}.json"
+        try:
+            guard_bundle.write_doc(doc, d, fname)
+        except OSError:
+            return None
+        with self._lock:
+            self._capsules[sig] = fname
+        return fname
+
+    # -- readout -----------------------------------------------------------
+
+    def records(self, n: Optional[int] = None) -> list:
+        with self._lock:
+            out = list(self._ring)
+        return out if n is None else out[-n:]
+
+    def last(self) -> Optional[dict]:
+        with self._lock:
+            return self._ring[-1] if self._ring else None
+
+    def seq(self) -> int:
+        """The last assigned sequence number (0 before any record)."""
+        with self._lock:
+            return self._ring[-1]["seq"] if self._ring else 0
+
+    def since(self, seq: int) -> list:
+        return [r for r in self.records() if r["seq"] > seq]
+
+    def reset(self) -> None:
+        """Drop all recorded state (tests; never called in production)."""
+        with self._lock:
+            self._ring.clear()
+            self._capsules.clear()
+
+
+LEDGER = RoundLedger()
+
+
+# ---------------------------------------------------------------------------
+# record assembly (the scheduler-side choke points call these)
+# ---------------------------------------------------------------------------
+
+
+def _stage_detail(timings: dict) -> dict:
+    return {k: timings[k] for k in _STAGE_KEYS if k in timings}
+
+
+def _drain_compiles() -> list:
+    from karpenter_tpu.obs import observatory
+
+    return observatory.drain_notes()
+
+
+def record_solve(sched, *, pods: int, wall_s: float, mode: str = "full",
+                 reason: str = "snapshot", outcome: str = "ok") -> dict:
+    """One record for a plain (non-resident) TPUScheduler.solve round."""
+    timings = dict(getattr(sched, "last_timings", None) or {})
+    fallback = getattr(sched, "_last_fallback", None)
+    rec = {
+        "source": "local",
+        "mode": mode,
+        "reason": fallback or reason,
+        "outcome": outcome,
+        "pods": pods,
+        "wall_s": round(wall_s, 6),
+        "fallback": fallback,
+        "sig": None,
+        "fpr": None,
+    }
+    if fallback is None and outcome == "ok":
+        for k in ("encode_s", "device_s", "decode_s"):
+            if k in timings:
+                rec[k] = round(timings[k], 6)
+        stages = _stage_detail(timings)
+        if stages:
+            rec["stages"] = stages
+    compiles = _drain_compiles()
+    if compiles:
+        rec["compiles"] = compiles
+    return LEDGER.record(rec)
+
+
+def record_session_round(session, *, pods: int, wall_s: float) -> dict:
+    """One record for a ResidentSession round: mode/reason/audit from the
+    session, the round-sig + fingerprint chain link, and (when spill is
+    on) a replayable problem capsule reference."""
+    mode, reason = session.last_mode, session.last_reason
+    if reason == "quarantined":
+        mode = "quarantined"
+    timings = dict(getattr(session, "last_timings", None) or {})
+    rec = {
+        "source": "local",
+        "mode": mode,
+        "reason": reason,
+        "outcome": "ok",
+        "pods": pods,
+        "wall_s": round(wall_s, 6),
+        "fallback": getattr(session.sched, "_last_fallback", None),
+        "sig": None,
+        "fpr": session.fingerprint or None,
+    }
+    for k in ("encode_s", "device_s", "decode_s"):
+        if k in timings:
+            rec[k] = round(timings[k], 6)
+    stages = _stage_detail(timings)
+    if stages:
+        rec["stages"] = stages
+    audit = getattr(session, "last_audit", None)
+    if audit is not None:
+        rec["guard"] = {
+            "verdict": audit.get("verdict"),
+            "twin_s": audit.get("twin_s"),
+            "bundle": audit.get("bundle"),
+        }
+    r = getattr(session, "_r", None)
+    if r is not None and r.get("rounds"):
+        last = r["rounds"][-1]
+        rec["sig"] = last["sig"].hex()
+        base_uids = [str(u) for u in r["order"][: last["start_idx"]]]
+        all_uids = [str(u) for u in r["order"]]
+        transcript = [base_uids, all_uids] if base_uids else [all_uids]
+        rec["transcript"] = transcript
+        rec["capsule"] = _maybe_capsule(session, transcript)
+    compiles = _drain_compiles()
+    if compiles:
+        rec["compiles"] = compiles
+    return LEDGER.record(rec)
+
+
+def _maybe_capsule(session, transcript: list) -> Optional[str]:
+    """Write the round's problem capsule (a full guard-bundle doc whose
+    rounds field is the session transcript) when spill is enabled."""
+    if not spill_dir():
+        return None
+    r = session._r
+    h = hashlib.blake2s(digest_size=8)
+    for uids in transcript:
+        h.update(b"\x01")
+        for u in sorted(uids):
+            h.update(str(u).encode())
+            h.update(b"\x00")
+    h.update(repr(r["exist_sig"]).encode())
+    sig = h.hexdigest()
+    with LEDGER._lock:
+        cached = LEDGER._capsules.get(sig)
+    if cached is not None:
+        return cached
+    from karpenter_tpu.guard import bundle as guard_bundle
+
+    try:
+        doc = guard_bundle.make_bundle(
+            "resident",
+            "round-ledger problem capsule",
+            session.sched,
+            dict(r["pod_by_uid"]),
+            transcript,
+            existing_nodes=r["exist_pristine"],
+            detail={"fingerprint": session.fingerprint},
+        )
+    except Exception:
+        return None  # capsule is best-effort diagnostics
+    return LEDGER.save_capsule(doc, sig)
+
+
+# ---------------------------------------------------------------------------
+# wire form (SolveStream trailing metadata) + remote ingestion
+# ---------------------------------------------------------------------------
+
+# gRPC trailing metadata has a small default size cap; the wire record
+# keeps scalars + the sig chain and drops bulky per-stage detail
+_WIRE_KEYS = (
+    "mode", "reason", "outcome", "pods", "wall_s", "encode_s", "device_s",
+    "decode_s", "fallback", "sig", "fpr", "guard",
+)
+_WIRE_BUDGET = 6000
+
+
+def wire_record(rec: dict) -> str:
+    """Compact ascii-JSON form of a record for trailing metadata."""
+    out = {k: rec[k] for k in _WIRE_KEYS if rec.get(k) is not None}
+    if "stages" in rec:
+        body = json.dumps(rec["stages"], sort_keys=True)
+        if len(body) < _WIRE_BUDGET:
+            out["stages"] = rec["stages"]
+    return json.dumps(out, sort_keys=True, ensure_ascii=True)
+
+
+def ingest_remote(raw: str) -> Optional[dict]:
+    """Record a wire-form round received from the solver service."""
+    try:
+        rec = json.loads(raw)
+    except (ValueError, TypeError):
+        return None
+    if not isinstance(rec, dict):
+        return None
+    rec["source"] = "remote"
+    return LEDGER.record(rec)
+
+
+# ---------------------------------------------------------------------------
+# CLI: incident timeline + round -> bundle materialization
+# ---------------------------------------------------------------------------
+
+
+def load_spilled(d: str) -> list:
+    """All spilled records (rotated files included), oldest first."""
+    out: list = []
+    paths = [os.path.join(d, f"{SPILL_FILE}.{i}") for i in range(SPILL_KEEP, 0, -1)]
+    paths.append(os.path.join(d, SPILL_FILE))
+    for path in paths:
+        try:
+            with open(path) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue  # torn tail line mid-write
+        except OSError:
+            continue
+    return out
+
+
+def timeline_line(rec: dict) -> str:
+    stamp = time.strftime("%H:%M:%S", time.gmtime(rec.get("t", 0)))
+    flags = []
+    if rec.get("fallback"):
+        flags.append(f"fallback={rec['fallback']}")
+    guard = rec.get("guard") or {}
+    if guard.get("verdict"):
+        flags.append(f"audit={guard['verdict']}")
+    for c in rec.get("compiles", ()):
+        flags.append(f"compile={c.get('kernel')}:{c.get('seconds', 0):.2f}s")
+    if rec.get("capsule"):
+        flags.append(f"capsule={rec['capsule']}")
+    return (
+        f"#{rec.get('seq', '?'):>5} {stamp} {rec.get('source', '?'):>6} "
+        f"{rec.get('mode', '?'):>11} {str(rec.get('reason', '')):<20} "
+        f"pods={rec.get('pods', 0):<6} wall={rec.get('wall_s', 0.0):8.4f}s "
+        f"sig={rec.get('sig') or '-':<16}"
+        + ("  " + " ".join(flags) if flags else "")
+    )
+
+
+def materialize_record(rec: dict, d: str) -> dict:
+    """Ledger record -> guard-bundle document, via its problem capsule."""
+    capsule = rec.get("capsule")
+    if not capsule:
+        raise ValueError(
+            f"round #{rec.get('seq')} has no capsule (non-resident round, "
+            "or KTPU_LEDGER_DIR was unset when it was recorded)"
+        )
+    from karpenter_tpu.guard import bundle as guard_bundle
+
+    doc = guard_bundle.load_bundle(os.path.join(d, capsule))
+    doc["reason"] = (
+        f"round-ledger materialization: seq={rec.get('seq')} "
+        f"mode={rec.get('mode')} sig={rec.get('sig')}"
+    )
+    if rec.get("transcript"):
+        doc["rounds"] = [list(r) for r in rec["transcript"]]
+    doc.setdefault("detail", {})["ledger_seq"] = rec.get("seq")
+    return doc
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m karpenter_tpu.obs.ledger",
+        description="round-ledger incident timeline + repro materialization",
+    )
+    parser.add_argument(
+        "--dir", default=None,
+        help=f"ledger spill directory (default: ${ENV_DIR})",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    tl = sub.add_parser("timeline", help="reconstruct the incident timeline")
+    tl.add_argument("-n", type=int, default=None, help="last N rounds only")
+    mat = sub.add_parser(
+        "materialize",
+        help="emit a guard-replay bundle for one recorded round",
+    )
+    mat.add_argument("seq", type=int, help="ledger sequence number")
+    mat.add_argument(
+        "--out", default=None,
+        help="output bundle path (default: ledger-round-<seq>.json in --dir)",
+    )
+    args = parser.parse_args(argv)
+
+    d = args.dir or spill_dir()
+    if not d:
+        parser.error(f"no ledger directory: pass --dir or set ${ENV_DIR}")
+    records = load_spilled(d)
+    if args.cmd == "timeline":
+        window = records if args.n is None else records[-args.n:]
+        for rec in window:
+            print(timeline_line(rec))
+        if not window:
+            print(f"(no spilled rounds under {d})")
+        return 0
+    by_seq = {r.get("seq"): r for r in records}
+    rec = by_seq.get(args.seq)
+    if rec is None:
+        print(f"round #{args.seq} not found under {d}")
+        return 2
+    try:
+        doc = materialize_record(rec, d)
+    except (ValueError, OSError) as err:
+        print(str(err))
+        return 2
+    from karpenter_tpu.guard import bundle as guard_bundle
+
+    out = args.out or os.path.join(d, f"ledger-round-{args.seq}.json")
+    guard_bundle.write_doc(doc, os.path.dirname(out) or ".", os.path.basename(out))
+    print(out)
+    print(f"replay with: python -m karpenter_tpu.guard.replay {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

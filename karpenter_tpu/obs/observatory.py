@@ -1,0 +1,293 @@
+"""Compile observatory: attribute XLA compiles to named solver kernels.
+
+The mesh is part of jit's cache key (ops/solver.py shard_hint), pad
+buckets feed static shapes, and the encode epoch rebuilds catalogs — all
+retrace hazards the process previously could not see. The observatory
+makes every compile attributable and every retrace storm loud:
+
+- ``named_kernel("solve_fill")`` wraps a jitted entry point; while the
+  observatory is enabled, calls set a contextvar naming the kernel for
+  the dynamic extent of the call (attribute access delegates to the
+  wrapped function, so ``.lower`` / cache introspection keep working).
+- ``jax.monitoring`` event-duration listeners observe
+  ``/jax/core/compile/backend_compile_duration`` and credit the compile
+  to the current kernel: ``ktpu_jit_compiles_total{kernel}`` +
+  ``ktpu_jit_compile_seconds``.
+- a wrap around ``jax._src.compiler.backend_compile`` captures the
+  LoadedExecutable long enough to read ``cost_analysis()`` (flops /
+  bytes accessed) once per compile; the next ledger record folds the
+  note in.
+- a retrace-storm detector fires once per kernel when its compile count
+  exceeds ``KTPU_RETRACE_WARN`` (default 3): Warning event through the
+  guard event recorder, a log line, and
+  ``ktpu_jit_retrace_storms_total{kernel}``.
+
+Everything is gated on an enabled flag (``--enable-profiling`` /
+``enable()``): disabled, a named-kernel call is one attribute check and
+the listener returns immediately — jax offers no per-listener
+unregistration, so the hooks install once and stay.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import threading
+import time
+from typing import Optional
+
+from karpenter_tpu.utils.metrics import (
+    JIT_COMPILE_SECONDS,
+    JIT_COMPILES,
+    JIT_RETRACE_STORMS,
+)
+
+ENV_RETRACE_WARN = "KTPU_RETRACE_WARN"
+DEFAULT_RETRACE_WARN = 3
+
+# the jax.monitoring event that marks one backend (XLA) compile
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_KERNEL: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "ktpu_obs_kernel", default="anonymous"
+)
+
+_MAX_NOTES = 64  # pending compile notes between ledger records
+
+
+class _State:
+    def __init__(self):
+        self.enabled = False
+        self.installed = False
+        self.lock = threading.Lock()
+        self.compiles: dict = {}  # kernel -> count
+        self.seconds: dict = {}  # kernel -> cumulative compile seconds
+        self.cost: dict = {}  # kernel -> last cost_analysis summary
+        self.stormed: set = set()  # kernels already reported this storm
+        self.notes: list = []  # pending per-compile notes for the ledger
+        self.pending_cost: dict = {}  # kernel -> cost awaiting its event
+
+
+_STATE = _State()
+
+
+def retrace_warn() -> int:
+    try:
+        return int(os.environ.get(ENV_RETRACE_WARN, DEFAULT_RETRACE_WARN))
+    except ValueError:
+        return DEFAULT_RETRACE_WARN
+
+
+class _NamedKernel:
+    """Jit-entry-point wrapper: names the kernel for compile attribution
+    while enabled; transparent passthrough (including attribute access)
+    otherwise."""
+
+    def __init__(self, name: str, fn):
+        self._name = name
+        self._fn = fn
+        self.__name__ = getattr(fn, "__name__", name)
+        self.__doc__ = getattr(fn, "__doc__", None)
+
+    def __call__(self, *args, **kwargs):
+        if not _STATE.enabled:
+            return self._fn(*args, **kwargs)
+        token = _KERNEL.set(self._name)
+        try:
+            return self._fn(*args, **kwargs)
+        finally:
+            _KERNEL.reset(token)
+
+    def __getattr__(self, item):
+        return getattr(self._fn, item)
+
+
+def named_kernel(name: str):
+    def deco(fn):
+        return _NamedKernel(name, fn)
+
+    return deco
+
+
+# -- hooks ------------------------------------------------------------------
+
+
+def _on_event_duration(event: str, duration: float, **kwargs) -> None:
+    if not _STATE.enabled or event != _COMPILE_EVENT:
+        return
+    kernel = _KERNEL.get()
+    JIT_COMPILES.inc(kernel=kernel)
+    JIT_COMPILE_SECONDS.observe(duration)
+    note = {"kernel": kernel, "seconds": round(duration, 4)}
+    storm: Optional[int] = None
+    with _STATE.lock:
+        n = _STATE.compiles.get(kernel, 0) + 1
+        _STATE.compiles[kernel] = n
+        _STATE.seconds[kernel] = _STATE.seconds.get(kernel, 0.0) + duration
+        cost = _STATE.pending_cost.pop(kernel, None)
+        if cost is not None:
+            _STATE.cost[kernel] = cost
+            note.update(cost)
+        if len(_STATE.notes) < _MAX_NOTES:
+            _STATE.notes.append(note)
+        if n > retrace_warn() and kernel not in _STATE.stormed:
+            _STATE.stormed.add(kernel)
+            storm = n
+    if storm is not None:
+        _report_storm(kernel, storm)
+
+
+def _report_storm(kernel: str, count: int) -> None:
+    JIT_RETRACE_STORMS.inc(kernel=kernel)
+    msg = (
+        f"retrace storm: kernel {kernel!r} compiled {count} times "
+        f"(> KTPU_RETRACE_WARN={retrace_warn()}); a mesh flip, pad-bucket "
+        "churn, or an unstable static argument is thrashing jit's cache"
+    )
+    from karpenter_tpu.utils.logging import get_logger
+
+    get_logger().with_values(controller="obs").warn(
+        "observatory: " + msg, kernel=kernel, compiles=count
+    )
+    from karpenter_tpu.guard import config as guard_config
+
+    recorder = guard_config.event_recorder()
+    if recorder is not None:
+        try:
+            from karpenter_tpu.utils.events import Event
+
+            recorder.publish(
+                Event("Solver", kernel, "Warning", "RetraceStorm", msg)
+            )
+        except Exception:
+            pass  # eventing is best-effort
+
+
+def _wrap_backend_compile() -> None:
+    """Intercept ``jax._src.compiler.backend_compile`` (the module-global
+    ``compile_or_get_cached`` calls) to read one ``cost_analysis()`` per
+    fresh executable. Version drift in the signature or the analysis
+    surface degrades to counts-only, never to a failed compile."""
+    try:
+        from jax._src import compiler as _jc
+    except Exception:
+        return
+    orig = getattr(_jc, "backend_compile", None)
+    if orig is None or getattr(orig, "_ktpu_obs", False):
+        return
+
+    def wrapped(*args, **kwargs):
+        exe = orig(*args, **kwargs)
+        if _STATE.enabled:
+            try:
+                cost = exe.cost_analysis()
+                if isinstance(cost, (list, tuple)):
+                    cost = cost[0] if cost else {}
+                summary = {}
+                if "flops" in cost:
+                    summary["flops"] = float(cost["flops"])
+                if "bytes accessed" in cost:
+                    summary["bytes"] = float(cost["bytes accessed"])
+                if summary:
+                    with _STATE.lock:
+                        _STATE.pending_cost[_KERNEL.get()] = summary
+            except Exception:
+                pass
+        return exe
+
+    wrapped._ktpu_obs = True
+    _jc.backend_compile = wrapped
+
+
+def enable() -> None:
+    """Install the hooks (once) and start attributing compiles."""
+    if not _STATE.installed:
+        try:
+            import jax.monitoring as _jm
+
+            _jm.register_event_duration_secs_listener(_on_event_duration)
+        except Exception:
+            pass  # no monitoring API: cost wrap still counts nothing,
+            # but enable() must never break the operator
+        _wrap_backend_compile()
+        _STATE.installed = True
+    _STATE.enabled = True
+
+
+def disable() -> None:
+    _STATE.enabled = False
+
+
+def enabled() -> bool:
+    return _STATE.enabled
+
+
+def reset() -> None:
+    """Drop attribution state (tests)."""
+    with _STATE.lock:
+        _STATE.compiles.clear()
+        _STATE.seconds.clear()
+        _STATE.cost.clear()
+        _STATE.stormed.clear()
+        _STATE.notes.clear()
+        _STATE.pending_cost.clear()
+
+
+def snapshot() -> dict:
+    """Per-kernel compile counts / cumulative seconds / last cost."""
+    with _STATE.lock:
+        return {
+            k: {
+                "compiles": n,
+                "seconds": round(_STATE.seconds.get(k, 0.0), 4),
+                **({"cost": _STATE.cost[k]} if k in _STATE.cost else {}),
+            }
+            for k, n in sorted(_STATE.compiles.items())
+        }
+
+
+def drain_notes() -> list:
+    """Pop the compile notes accumulated since the last ledger record."""
+    if not _STATE.enabled:
+        return []
+    with _STATE.lock:
+        notes, _STATE.notes = _STATE.notes, []
+    return notes
+
+
+# -- on-demand device profiling (/debug/profile?seconds=) -------------------
+
+_PROFILE_LOCK = threading.Lock()
+_PROFILE_MAX_SECONDS = 30.0
+
+
+def capture_device_profile(seconds: float, out_dir: Optional[str] = None) -> dict:
+    """Capture a ``jax.profiler`` device trace for ``seconds`` (clamped
+    to 30s) into ``out_dir`` (default: a per-pid directory under the
+    ledger spill dir or the system tmpdir) and report the files written.
+    One capture at a time; a concurrent request fails fast."""
+    import tempfile
+
+    import jax
+
+    secs = min(max(float(seconds), 0.05), _PROFILE_MAX_SECONDS)
+    if out_dir is None:
+        from karpenter_tpu.obs import ledger as obs_ledger
+
+        base = obs_ledger.spill_dir() or tempfile.gettempdir()
+        out_dir = os.path.join(
+            base, f"ktpu-profile-{os.getpid()}-{int(time.time())}"
+        )
+    if not _PROFILE_LOCK.acquire(blocking=False):
+        raise RuntimeError("a profile capture is already running")
+    try:
+        jax.profiler.start_trace(out_dir)
+        time.sleep(secs)
+        jax.profiler.stop_trace()
+    finally:
+        _PROFILE_LOCK.release()
+    files = []
+    for root, _, names in os.walk(out_dir):
+        for name in names:
+            files.append(os.path.relpath(os.path.join(root, name), out_dir))
+    return {"seconds": secs, "dir": out_dir, "files": sorted(files)}

@@ -71,6 +71,12 @@ class Operator:
             from karpenter_tpu.tracing.tracer import TRACER
 
             TRACER.enable()
+            # ... and the compile observatory: jit compiles attributed to
+            # named kernels, retrace-storm detection, cost analysis into
+            # the round ledger (/debug/rounds)
+            from karpenter_tpu.obs import observatory
+
+            observatory.enable()
         if options.leader_elect:
             import uuid
 

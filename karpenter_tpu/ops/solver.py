@@ -38,6 +38,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from karpenter_tpu.obs.observatory import named_kernel
 from karpenter_tpu.ops import kernels
 from karpenter_tpu.ops import topology as topo_ops
 from karpenter_tpu.ops.encode import INT_MAX, INT_MIN, InstanceTypeTensors, PodTensors, ReqSetTensors
@@ -787,6 +788,7 @@ def _bank_rows(state: SolverState, idx: jnp.ndarray, topo_kids: tuple):
     return out
 
 
+@named_kernel("compact_state")
 @functools.partial(jax.jit, static_argnames=("n_claims", "topo_kids"))
 def compact_state(
     state: SolverState,
@@ -842,6 +844,7 @@ def compact_state(
     )
 
 
+@named_kernel("retract_tail")
 @jax.jit
 def retract_tail(state: SolverState, cut: jnp.ndarray) -> SolverState:
     """Undo every claim with global id >= `cut`: the resident-session
@@ -894,6 +897,7 @@ def retract_tail(state: SolverState, cut: jnp.ndarray) -> SolverState:
     )
 
 
+@named_kernel("global_template")
 @jax.jit
 def global_template(state: SolverState) -> jnp.ndarray:
     """[NCAP] i32 — the global template column alone (the pipelined
@@ -902,6 +906,7 @@ def global_template(state: SolverState) -> jnp.ndarray:
     return state.bank_template.at[state.slot_of].set(state.template, mode="drop")
 
 
+@named_kernel("global_claims")
 @functools.partial(jax.jit, static_argnames=("topo_kids",))
 def global_claims(state: SolverState, topo_kids: tuple = ()) -> dict:
     """Merge the hot window over the frozen bank into global-slot-indexed
@@ -963,6 +968,7 @@ _STATIC = (
 )
 
 
+@named_kernel("solve")
 @functools.partial(jax.jit, static_argnames=_STATIC)
 def solve(
     pods: PodTensors,
@@ -1006,6 +1012,7 @@ def solve(
     return SolveResult(assignment=assignment, claims=state)
 
 
+@named_kernel("solve_from")
 @functools.partial(jax.jit, static_argnames=_STATIC)
 def solve_from(
     state: SolverState,
@@ -1058,6 +1065,7 @@ def solve_from(
     return SolveResult(assignment=assignment, claims=state)
 
 
+@named_kernel("solve_whatif")
 @functools.partial(jax.jit, static_argnames=_STATIC)
 def solve_whatif(
     scen_pod_idx: jnp.ndarray,  # [S, L] i32 — this scenario's pods (indices into the union)
@@ -1670,6 +1678,7 @@ def _make_fill_step(
 _FILL_STATIC = ("zone_kid", "ct_kid", "n_claims")
 
 
+@named_kernel("solve_fill")
 @functools.partial(jax.jit, static_argnames=_FILL_STATIC)
 def solve_fill(
     state: SolverState,
@@ -1747,6 +1756,7 @@ class ShardFillState(NamedTuple):
     spills: jnp.ndarray  # [] i32
 
 
+@named_kernel("solve_fill_dp")
 @functools.partial(jax.jit, static_argnames=_FILL_STATIC)
 def solve_fill_dp(
     state: SolverState,
@@ -2097,6 +2107,7 @@ def _make_gang_step(
 _GANG_STATIC = ("zone_kid", "ct_kid", "n_claims", "maxg")
 
 
+@named_kernel("solve_gang")
 @functools.partial(jax.jit, static_argnames=_GANG_STATIC)
 def solve_gang(
     state: SolverState,
@@ -2830,6 +2841,7 @@ _KSCAN_STATIC = (
 )
 
 
+@named_kernel("solve_kind_scan")
 @functools.partial(jax.jit, static_argnames=_KSCAN_STATIC)
 def solve_kind_scan(
     state: SolverState,

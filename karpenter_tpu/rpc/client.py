@@ -347,11 +347,12 @@ class RemoteScheduler:
                     FAULT.point("rpc.stream.chunk", index=stitcher.n_chunks)
                     if stitcher.feed(frame):
                         break
-                if stitcher.final is not None and self._session_id is not None:
+                if stitcher.final is not None:
                     # the final frame is the handler's last yield, so the
                     # RPC terminates immediately after — this blocks only
-                    # for that turnaround
-                    self._store_session_fpr(call.trailing_metadata())
+                    # for that turnaround. Session fingerprint AND the
+                    # round-ledger record both ride the trailer.
+                    self._absorb_trailing(call.trailing_metadata())
         if stitcher.final is None:
             raise RuntimeError("SolveStream ended without a final frame")
         self.last_stream = stitcher.stats()
@@ -367,22 +368,27 @@ class RemoteScheduler:
             md.append(("ktpu-session-fpr", self._session_fpr))
         return md
 
-    def _store_session_fpr(self, trailing) -> None:
-        """Record the server's resident-state fingerprint from trailing
-        metadata. Absent key (old server, stateless solve) leaves the
-        stored value untouched."""
+    def _absorb_trailing(self, trailing) -> None:
+        """Absorb trailing metadata: the server's resident-state
+        fingerprint (absent key — old server, stateless solve — leaves
+        the stored value untouched) and the solve's round-ledger record,
+        which lands in the client-side flight recorder with
+        source="remote" so an incident timeline covers remote rounds
+        too."""
         for key, value in trailing or ():
-            if key == "ktpu-session-fpr":
+            if key == "ktpu-session-fpr" and self._session_id is not None:
                 self._session_fpr = value
-                return
+            elif key == "ktpu-round-ledger":
+                from karpenter_tpu.obs import ledger as obs_ledger
+
+                obs_ledger.ingest_remote(value)
 
     def _unary_solve(self, req, rpc_timeout: float):
         md = self._session_md()
         resp, call = self._solve(
             req, timeout=rpc_timeout, metadata=(md or None), with_call=True
         )
-        if self._session_id is not None:
-            self._store_session_fpr(call.trailing_metadata())
+        self._absorb_trailing(call.trailing_metadata())
         return resp
 
     def _transport_solve(self, req, rpc_timeout: float):

@@ -131,15 +131,32 @@ class SolverService:
         return session
 
     @staticmethod
-    def _echo_session_fpr(context, session) -> None:
+    def _echo_session_fpr(context, session, ledger_seq0: Optional[int] = None) -> None:
         """Trailing metadata: the fingerprint of the resident state this
-        solve left behind, the client's proof-of-continuity token."""
-        if session is None:
+        solve left behind (the client's proof-of-continuity token), plus
+        the solve's round-ledger record (``ktpu-round-ledger``, compact
+        JSON) so remote rounds land in the CLIENT's flight recorder too.
+        ``set_trailing_metadata`` replaces rather than merges, so both
+        keys ride one call."""
+        md = []
+        if session is not None:
+            md.append(("ktpu-session-fpr", session.fingerprint))
+        if ledger_seq0 is not None:
+            from karpenter_tpu.obs import ledger as obs_ledger
+
+            rounds = obs_ledger.LEDGER.since(ledger_seq0)
+            # the LAST local record since the solve started is this
+            # round's (relaxation sub-rounds record separately; remote
+            # ingestions are filtered out)
+            local = [r for r in rounds if r.get("source") == "local"]
+            if local:
+                md.append(
+                    ("ktpu-round-ledger", obs_ledger.wire_record(local[-1]))
+                )
+        if not md:
             return
         try:
-            context.set_trailing_metadata(
-                (("ktpu-session-fpr", session.fingerprint),)
-            )
+            context.set_trailing_metadata(tuple(md))
         except Exception:
             pass  # context already terminated (deadline); nothing to echo
 
@@ -241,6 +258,9 @@ class SolverService:
         args, kwargs = self._solve_args(request, sched)
         session = self._session_for(context, sched)
         engine = session if session is not None else sched
+        from karpenter_tpu.obs import ledger as obs_ledger
+
+        ledger_seq0 = obs_ledger.LEDGER.seq()
 
         def run() -> None:
             try:
@@ -273,7 +293,7 @@ class SolverService:
         while True:
             item = frames.get()
             if item is _DONE:
-                self._echo_session_fpr(context, session)
+                self._echo_session_fpr(context, session, ledger_seq0)
                 return
             if isinstance(item, BaseException):
                 raise item
@@ -344,9 +364,12 @@ class SolverService:
         args, kwargs = self._solve_args(request, sched)
         session = self._session_for(context, sched)
         engine = session if session is not None else sched
+        from karpenter_tpu.obs import ledger as obs_ledger
+
+        ledger_seq0 = obs_ledger.LEDGER.seq()
         with self._solve_lock:
             result = engine.solve(*args, **kwargs)
-        self._echo_session_fpr(context, session)
+        self._echo_session_fpr(context, session, ledger_seq0)
         return self._result_pb(sched, result)
 
     @staticmethod

@@ -131,7 +131,18 @@ class _SpanCtx:
 
 
 class Tracer:
-    def __init__(self, max_traces: int = MAX_TRACES):
+    def __init__(
+        self,
+        max_traces: int = MAX_TRACES,
+        clock=time.perf_counter,
+        wall=time.time,
+    ):
+        # injectable clocks (tests drive span ordering deterministically
+        # instead of assuming wall-clock monotonic interleaving): `clock`
+        # feeds duration math (perf_counter), `wall` feeds the epoch
+        # correlation stamps
+        self._clock = clock
+        self._wall = wall
         # KTPU_TRACE_DIR is the opt-in for JSONL export AND implicitly
         # enables tracing (an exporter with nothing to export is useless)
         self.enabled = bool(os.environ.get("KTPU_TRACE_DIR"))
@@ -186,8 +197,8 @@ class Tracer:
             span_id=self._new_id(),
             parent_id=parent_id,
             name=name,
-            start=time.perf_counter(),
-            wall_start=time.time(),
+            start=self._clock(),
+            wall_start=self._wall(),
             attrs=attrs,
         )
         with self._lock:
@@ -208,8 +219,8 @@ class Tracer:
             span_id=self._new_id(),
             parent_id=parent_span_id or None,
             name=name,
-            start=time.perf_counter(),
-            wall_start=time.time(),
+            start=self._clock(),
+            wall_start=self._wall(),
             attrs=attrs,
         )
         with self._lock:
@@ -226,7 +237,7 @@ class Tracer:
         parent = self._ctx.get()
         if parent is None:
             return
-        end = time.perf_counter()
+        end = self._clock()
         sp = Span(
             trace_id=parent.trace_id,
             span_id=self._new_id(),
@@ -234,7 +245,7 @@ class Tracer:
             name=name,
             start=end - max(duration_s, 0.0),
             end=end,
-            wall_start=time.time() - max(duration_s, 0.0),
+            wall_start=self._wall() - max(duration_s, 0.0),
             attrs=attrs,
         )
         with self._lock:
@@ -271,7 +282,7 @@ class Tracer:
     # -- completion / readout ----------------------------------------------
 
     def _end(self, sp: Span, token) -> None:
-        sp.end = time.perf_counter()
+        sp.end = self._clock()
         self._ctx.reset(token)
         trace = None
         with self._lock:

@@ -497,9 +497,47 @@ GUARD_QUARANTINED = REGISTRY.gauge(
 )
 WATCHDOG_STALLS = REGISTRY.counter(
     "ktpu_watchdog_stalls_total",
-    "Device dispatches the watchdog declared stalled (no completion"
-    " within KTPU_WATCHDOG_S — the collective-rendezvous deadlock class);"
-    " each stall dumps all-thread stacks and fails the solve into the"
-    " host-fallback ladder instead of hanging",
+    "Solve sections the watchdog declared stalled (no completion within"
+    " KTPU_WATCHDOG_S — the collective-rendezvous deadlock class for the"
+    " device dispatch, runaway host work for encode/decode); each stall"
+    " dumps all-thread stacks and fails the solve into the host-fallback"
+    " ladder instead of hanging, under its own fallback reason"
+    " (watchdog_dispatch / watchdog_encode / watchdog_decode)",
     ("section",),
+)
+# ---- observability: round ledger + compile observatory (obs/, PR 12) ----
+GUARD_QUARANTINE_TTL = REGISTRY.gauge(
+    "ktpu_guard_quarantine_ttl_seconds",
+    "Seconds remaining on a fast path's quarantine TTL (0 when the path"
+    " is not quarantined); the fleet-wide inspectable form of the"
+    " per-process breaker, alongside /debug/quarantine",
+    ("path",),
+)
+LEDGER_ROUNDS = REGISTRY.counter(
+    "ktpu_ledger_rounds_total",
+    "Solve rounds recorded by the round ledger (obs/ledger.py), by"
+    " source: local (this process solved it) vs remote (the record rode"
+    " SolveStream trailing metadata back from the solver service)",
+    ("source",),
+)
+JIT_COMPILES = REGISTRY.counter(
+    "ktpu_jit_compiles_total",
+    "XLA compiles attributed to named solver kernels by the compile"
+    " observatory (obs/observatory.py); 'anonymous' is a compile that"
+    " fired outside any named kernel's dynamic extent",
+    ("kernel",),
+)
+JIT_COMPILE_SECONDS = REGISTRY.histogram(
+    "ktpu_jit_compile_seconds",
+    "Backend (XLA) compile durations observed via jax.monitoring —"
+    " every bucket hit after warmup is a retrace paying cold-start"
+    " latency on the hot path",
+)
+JIT_RETRACE_STORMS = REGISTRY.counter(
+    "ktpu_jit_retrace_storms_total",
+    "Named kernels that recompiled more than KTPU_RETRACE_WARN times"
+    " (post-warmup retrace storm: a mesh flip, PadBucketCache churn, or"
+    " an unstable static argument is thrashing jit's cache key);"
+    " incremented once per kernel per storm detection",
+    ("kernel",),
 )

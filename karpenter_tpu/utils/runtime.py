@@ -198,6 +198,73 @@ class _Handler(BaseHTTPRequestHandler):
                     "series": [],
                 }
             self._send(200, json.dumps(body), ctype="application/json")
+        elif path == "/debug/rounds":
+            if not self.config.enable_profiling:
+                self._send(404, "profiling disabled")
+                return
+            # the round ledger's in-memory ring (obs/ledger.py) — one
+            # compact record per solve round — plus the compile
+            # observatory's per-kernel attribution
+            from urllib.parse import parse_qs, urlparse
+
+            from karpenter_tpu.obs import ledger as obs_ledger
+            from karpenter_tpu.obs import observatory
+
+            qs = parse_qs(urlparse(self.path).query)
+            n = None
+            if qs.get("n"):
+                try:
+                    n = max(int(qs["n"][0]), 1)
+                except ValueError:
+                    pass
+            self._send(
+                200,
+                json.dumps(
+                    {
+                        "rounds": obs_ledger.LEDGER.records(n),
+                        "observatory": observatory.snapshot(),
+                    }
+                ),
+                ctype="application/json",
+            )
+        elif path == "/debug/quarantine":
+            if not self.config.enable_profiling:
+                self._send(404, "profiling disabled")
+                return
+            # per-path circuit-breaker state (guard/quarantine.py): TTL
+            # remaining, tripping reason, all-time trip count — the
+            # inspectable form of the per-process breaker
+            from karpenter_tpu.guard import QUARANTINE
+
+            self._send(
+                200, json.dumps(QUARANTINE.state()), ctype="application/json"
+            )
+        elif path == "/debug/profile":
+            if not self.config.enable_profiling:
+                self._send(404, "profiling disabled")
+                return
+            # on-demand device profiling: a jax.profiler trace capture of
+            # ?seconds= (clamped to 30s, one capture at a time) written
+            # to disk; the response reports where the trace landed
+            from urllib.parse import parse_qs, urlparse
+
+            from karpenter_tpu.obs import observatory
+
+            try:
+                seconds = float(
+                    parse_qs(urlparse(self.path).query).get("seconds", ["1"])[0]
+                )
+            except ValueError:
+                seconds = 1.0
+            try:
+                body = observatory.capture_device_profile(seconds)
+            except RuntimeError as err:
+                self._send(409, str(err))
+                return
+            except Exception as err:  # noqa: BLE001 — capture is best-effort
+                self._send(500, f"profile capture failed: {err}")
+                return
+            self._send(200, json.dumps(body), ctype="application/json")
         elif path == "/debug/pprof/profile":
             if not self.config.enable_profiling:
                 self._send(404, "profiling disabled")

@@ -346,7 +346,7 @@ class TestWatchdog:
         sched = TPUScheduler(make_templates(), max_claims=128)
         pods = kind_pods("a", 8)
         stalls0 = WATCHDOG_STALLS.get(section="dispatch")
-        fb0 = SOLVER_FALLBACK.get(reason="watchdog_stall")
+        fb0 = SOLVER_FALLBACK.get(reason="watchdog_dispatch")
         plan = {
             "rules": [
                 {
@@ -360,7 +360,7 @@ class TestWatchdog:
         with active_plan(plan):
             r = sched.solve(list(pods))
         assert WATCHDOG_STALLS.get(section="dispatch") == stalls0 + 1
-        assert SOLVER_FALLBACK.get(reason="watchdog_stall") == fb0 + 1
+        assert SOLVER_FALLBACK.get(reason="watchdog_dispatch") == fb0 + 1
         assert not r.unschedulable
         assert set(r.assignments) == {p.uid for p in pods}
 

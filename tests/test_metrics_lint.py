@@ -202,6 +202,27 @@ def test_guard_families_are_registered():
         assert fam.help.strip()
 
 
+def test_observability_families_are_registered():
+    """ISSUE-12 families: round-ledger appends, jit compile attribution +
+    retrace storms, and the quarantine TTL gauge behind /debug/quarantine."""
+    from karpenter_tpu.utils.metrics import Counter, Gauge
+
+    fams = {f.name: f for f in _families()}
+    expected = {
+        "ktpu_guard_quarantine_ttl_seconds": (Gauge, ("path",)),
+        "ktpu_ledger_rounds_total": (Counter, ("source",)),
+        "ktpu_jit_compiles_total": (Counter, ("kernel",)),
+        "ktpu_jit_compile_seconds": (Histogram, ()),
+        "ktpu_jit_retrace_storms_total": (Counter, ("kernel",)),
+    }
+    for name, (cls, labels) in expected.items():
+        fam = fams.get(name)
+        assert fam is not None, f"{name} not registered"
+        assert isinstance(fam, cls), (name, type(fam).__name__)
+        assert fam.label_names == labels, (name, fam.label_names)
+        assert fam.help.strip()
+
+
 def test_counters_end_in_total_and_histograms_in_seconds_or_pods():
     """Unit-suffix discipline for NEW families (grandfathered names keep
     their reference spellings verbatim)."""

@@ -1,0 +1,459 @@
+"""Observability (obs/, ISSUE 12): the round ledger flight recorder and
+the compile observatory.
+
+The acceptance properties under test:
+
+- the in-memory ring is BOUNDED by KTPU_LEDGER_RING and the JSONL spill
+  rotates at the size cap (never more than SPILL_KEEP rotated files);
+- every resident round lands in the ledger with its mode and round-sig,
+  and the sig chain stays continuous across full/delta/quarantined
+  rounds;
+- a remote solve ingests the server's round record over a REAL socket
+  (trailing metadata), tagged source="remote";
+- a recorded delta round materializes — via the CLI — into a guard
+  bundle that ``python -m karpenter_tpu.guard.replay`` re-runs to exit 0
+  (bit-identical replay);
+- a forced retrace storm is DETECTED: per-kernel compile attribution
+  grows, the storm counter fires once, and a Warning event is published;
+- the watchdog covers encode and decode with their own stall sections
+  and per-section fallback reasons;
+- quarantine trips are countable and inspectable (/debug/quarantine,
+  TTL gauge);
+- recording is cheap enough to stay always-on (<100us per record, far
+  under the 1% bench gate).
+
+Everything is CPU-sized for tier-1; the replay subprocess is the one
+deliberately slow piece (it is the materialize CLI's contract).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from karpenter_tpu import guard
+from karpenter_tpu.controllers.provisioning import TPUScheduler
+from karpenter_tpu.obs import ledger as obs_ledger
+from karpenter_tpu.obs import observatory
+
+from test_resident import kind_pods, make_templates, session_scheduler
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state(monkeypatch):
+    """Every test starts and ends with an empty ledger, the observatory
+    disabled, no quarantine, and the obs knobs unset."""
+    for var in (
+        "KTPU_LEDGER_DIR",
+        "KTPU_LEDGER_RING",
+        "KTPU_RETRACE_WARN",
+        "KTPU_WATCHDOG_S",
+        "KTPU_GUARD_AUDIT_RATE",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    obs_ledger.LEDGER.reset()
+    observatory.disable()
+    observatory.reset()
+    guard.QUARANTINE.reset()
+    yield
+    obs_ledger.LEDGER.reset()
+    observatory.disable()
+    observatory.reset()
+    guard.QUARANTINE.reset()
+
+
+class TestRing:
+    def test_ring_is_bounded_by_env(self, monkeypatch):
+        monkeypatch.setenv(obs_ledger.ENV_RING, "8")
+        led = obs_ledger.RoundLedger()
+        for i in range(20):
+            led.record({"mode": "full", "i": i})
+        recs = led.records()
+        assert len(recs) == 8
+        # oldest records aged out; sequence numbering stays continuous
+        assert [r["i"] for r in recs] == list(range(12, 20))
+        assert [r["seq"] for r in recs] == list(range(13, 21))
+        assert led.seq() == 20
+
+    def test_records_n_and_since(self):
+        led = obs_ledger.RoundLedger()
+        for i in range(5):
+            led.record({"i": i})
+        assert [r["i"] for r in led.records(2)] == [3, 4]
+        assert [r["i"] for r in led.since(3)] == [3, 4]
+        assert led.last()["i"] == 4
+
+    def test_record_overhead_stays_flight_recorder_cheap(self):
+        """The always-on cost: one dict stamp + deque append. Bench gates
+        this against a real solve (<1%); here we pin the absolute cost so
+        a regression is visible without the bench."""
+        led = obs_ledger.RoundLedger()
+        n = 20_000
+        t0 = time.perf_counter()
+        for i in range(n):
+            led.record(
+                {"mode": "delta", "reason": "delta", "pods": 64, "wall_s": 0.01}
+            )
+        per_record = (time.perf_counter() - t0) / n
+        assert per_record < 100e-6, f"{per_record * 1e6:.1f}us per record"
+
+
+class TestSpill:
+    def test_jsonl_spill_and_rotation(self, monkeypatch, tmp_path):
+        monkeypatch.setenv(obs_ledger.ENV_DIR, str(tmp_path))
+        # a tiny cap so a handful of records forces several rotations
+        monkeypatch.setattr(obs_ledger, "SPILL_MAX_BYTES", 512)
+        led = obs_ledger.RoundLedger()
+        for i in range(40):
+            led.record({"mode": "full", "reason": "snapshot", "pad": "x" * 64})
+        names = sorted(os.listdir(tmp_path))
+        assert obs_ledger.SPILL_FILE in names
+        assert f"{obs_ledger.SPILL_FILE}.1" in names
+        # rotation is capped: never more than SPILL_KEEP rotated files
+        assert not any(
+            n.startswith(obs_ledger.SPILL_FILE + ".")
+            and int(n.rsplit(".", 1)[1]) > obs_ledger.SPILL_KEEP
+            for n in names
+        )
+        spilled = obs_ledger.load_spilled(str(tmp_path))
+        assert spilled, "rotated spill must load"
+        # oldest-first and torn-tail tolerant
+        seqs = [r["seq"] for r in spilled]
+        assert seqs == sorted(seqs)
+        with open(tmp_path / obs_ledger.SPILL_FILE, "a") as fh:
+            fh.write('{"torn": ')
+        assert len(obs_ledger.load_spilled(str(tmp_path))) == len(spilled)
+
+    def test_timeline_cli(self, monkeypatch, tmp_path, capsys):
+        monkeypatch.setenv(obs_ledger.ENV_DIR, str(tmp_path))
+        led = obs_ledger.RoundLedger()
+        led.record(
+            {"mode": "delta", "reason": "delta", "pods": 12, "wall_s": 0.25,
+             "sig": "ab" * 8, "fallback": None}
+        )
+        assert obs_ledger.main(["--dir", str(tmp_path), "timeline"]) == 0
+        out = capsys.readouterr().out
+        assert "delta" in out and "ab" * 8 in out and "pods=12" in out
+
+
+class TestResidentRounds:
+    def test_modes_and_sig_chain_across_rounds(self, monkeypatch):
+        """full -> delta -> delta -> (trip) quarantined: every round lands
+        in the ledger with its mode, a fresh round-sig, and a transcript
+        whose base prefix matches the previous round's pod set."""
+        session = session_scheduler(monkeypatch)
+        base = kind_pods("a", 10)
+        session.solve(list(base))
+        r1 = obs_ledger.LEDGER.last()
+        assert r1["mode"] == "full" and r1["source"] == "local"
+        assert r1["sig"] and r1["fpr"]
+        assert r1["pods"] == 10
+
+        union = base + kind_pods("b", 4)
+        session.solve(list(union))
+        r2 = obs_ledger.LEDGER.last()
+        assert r2["mode"] == "delta" and r2["seq"] == r1["seq"] + 1
+        assert r2["sig"] and r2["sig"] != r1["sig"]
+        # the transcript replays the chain: base prefix then the union
+        assert r2["transcript"][0] == [str(p.uid) for p in base]
+        assert r2["transcript"][-1] == [str(p.uid) for p in union]
+
+        union2 = union + kind_pods("c", 3)
+        session.solve(list(union2))
+        r3 = obs_ledger.LEDGER.last()
+        assert r3["mode"] == "delta" and r3["sig"] not in (r1["sig"], r2["sig"])
+
+        guard.QUARANTINE.trip("resident", reason="test", ttl_s=60.0)
+        session.solve(list(union2 + kind_pods("d", 2)))
+        r4 = obs_ledger.LEDGER.last()
+        assert r4["mode"] == "quarantined" and r4["reason"] == "quarantined"
+
+    def test_plain_solve_records_one_round(self, monkeypatch):
+        sched = TPUScheduler(make_templates(), max_claims=128)
+        pods = kind_pods("a", 6)
+        seq0 = obs_ledger.LEDGER.seq()
+        sched.solve(list(pods))
+        recs = obs_ledger.LEDGER.since(seq0)
+        assert len(recs) == 1
+        rec = recs[0]
+        assert rec["mode"] == "full" and rec["outcome"] == "ok"
+        assert rec["pods"] == 6 and rec["wall_s"] > 0
+        assert rec["fallback"] is None
+        assert "device_s" in rec and "stages" in rec
+
+    def test_session_round_is_one_record_not_three(self, monkeypatch):
+        """The suppression contract: a resident round's internal full
+        solves (snapshot, audit twins) must NOT each add a record — one
+        round, one ledger entry."""
+        monkeypatch.setenv("KTPU_GUARD_AUDIT_RATE", "1.0")
+        session = session_scheduler(monkeypatch)
+        base = kind_pods("a", 8)
+        session.solve(list(base))
+        seq0 = obs_ledger.LEDGER.seq()
+        session.solve(list(base + kind_pods("b", 3)))
+        recs = obs_ledger.LEDGER.since(seq0)
+        assert len(recs) == 1
+        assert recs[0]["mode"] == "delta"
+        # the sampled shadow audit's verdict rode along
+        assert recs[0]["guard"]["verdict"] == "pass"
+
+
+class TestRemoteIngestion:
+    def test_remote_round_arrives_over_a_real_socket(self):
+        """The solver service echoes its round record in trailing
+        metadata; the client ingests it tagged source="remote" — the
+        operator-side ledger sees server rounds without scraping."""
+        from karpenter_tpu.rpc import RemoteScheduler, serve
+
+        templates = make_templates()
+        server, addr = serve("127.0.0.1:0")
+        try:
+            remote = RemoteScheduler(addr, templates, max_claims=128)
+            base = kind_pods("a", 8)
+            remote.solve(list(base))
+            remotes = [
+                r for r in obs_ledger.LEDGER.records() if r["source"] == "remote"
+            ]
+            assert remotes, "no remote round ingested from trailing metadata"
+            assert remotes[-1]["mode"] in ("full", "delta")
+            assert remotes[-1]["pods"] == 8
+            seen = len(remotes)
+            remote.solve(list(base + kind_pods("b", 4)))
+            remotes = [
+                r for r in obs_ledger.LEDGER.records() if r["source"] == "remote"
+            ]
+            assert len(remotes) > seen
+            # the resident server round carries its sig chain link
+            assert remotes[-1]["sig"]
+        finally:
+            server.stop(0)
+
+
+class TestMaterializeReplay:
+    def test_ledger_round_materializes_and_replays_clean(
+        self, monkeypatch, tmp_path
+    ):
+        """The incident workflow end to end: record a delta round with
+        spill on, materialize it through the CLI, and guard.replay must
+        re-run the transcript bit-identically (exit 0)."""
+        monkeypatch.setenv(obs_ledger.ENV_DIR, str(tmp_path))
+        session = session_scheduler(monkeypatch)
+        base = kind_pods("a", 10)
+        session.solve(list(base))
+        session.solve(list(base + kind_pods("b", 5)))
+        rec = obs_ledger.LEDGER.last()
+        assert rec["mode"] == "delta"
+        assert rec["capsule"], "spill-enabled delta round must write a capsule"
+        assert (tmp_path / rec["capsule"]).exists()
+
+        out = tmp_path / "repro.json"
+        code = obs_ledger.main(
+            ["--dir", str(tmp_path), "materialize", str(rec["seq"]),
+             "--out", str(out)]
+        )
+        assert code == 0
+        doc = json.loads(out.read_text())
+        assert doc["path"] == "resident"
+        assert doc["rounds"] == rec["transcript"]
+        assert doc["detail"]["ledger_seq"] == rec["seq"]
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, "-m", "karpenter_tpu.guard.replay", str(out)],
+            capture_output=True,
+            text=True,
+            timeout=420,
+            env=env,
+        )
+        assert proc.returncode == 0, proc.stderr + proc.stdout
+
+    def test_materialize_without_capsule_is_a_clear_error(self, tmp_path):
+        rec = {"seq": 7, "mode": "full"}
+        with pytest.raises(ValueError, match="no capsule"):
+            obs_ledger.materialize_record(rec, str(tmp_path))
+
+
+class TestObservatory:
+    def test_forced_retrace_storm_is_detected(self, monkeypatch):
+        """A kernel recompiled past KTPU_RETRACE_WARN (growing shapes —
+        the classic pad-bucket churn) must grow its per-kernel compile
+        attribution, fire the storm counter ONCE, and publish a Warning
+        event through the guard recorder."""
+        import jax
+        import jax.numpy as jnp
+
+        from karpenter_tpu.guard import config as guard_config
+        from karpenter_tpu.utils.events import Recorder
+        from karpenter_tpu.utils.metrics import JIT_COMPILES, JIT_RETRACE_STORMS
+
+        monkeypatch.setenv(observatory.ENV_RETRACE_WARN, "2")
+        recorder = Recorder()
+        old = guard_config.event_recorder()
+        guard_config.set_event_recorder(recorder)
+        try:
+            observatory.enable()
+
+            @observatory.named_kernel("obs_test_kernel")
+            @jax.jit
+            def bump(x):
+                return x + 1
+
+            c0 = JIT_COMPILES.get(kernel="obs_test_kernel")
+            s0 = JIT_RETRACE_STORMS.get(kernel="obs_test_kernel")
+            for n in range(1, 5):  # four shapes -> four traces
+                bump(jnp.zeros((n,), jnp.float32)).block_until_ready()
+            assert JIT_COMPILES.get(kernel="obs_test_kernel") == c0 + 4
+            snap = observatory.snapshot()
+            assert snap["obs_test_kernel"]["compiles"] == 4
+            assert snap["obs_test_kernel"]["seconds"] > 0
+            # the storm fired exactly once, not once per extra compile
+            assert JIT_RETRACE_STORMS.get(kernel="obs_test_kernel") == s0 + 1
+            # other kernels (e.g. anonymous jnp.zeros traces) may storm
+            # too; the contract is ONE event for the named kernel
+            storms = [
+                e
+                for e in recorder.events
+                if e.reason == "RetraceStorm" and e.name == "obs_test_kernel"
+            ]
+            assert len(storms) == 1
+            assert storms[0].type == "Warning"
+            assert "obs_test_kernel" in storms[0].message
+        finally:
+            guard_config.set_event_recorder(old)
+
+    def test_disabled_observatory_attributes_nothing(self):
+        import jax
+        import jax.numpy as jnp
+
+        @observatory.named_kernel("obs_dark_kernel")
+        @jax.jit
+        def bump(x):
+            return x + 1
+
+        bump(jnp.zeros((3,), jnp.float32)).block_until_ready()
+        assert "obs_dark_kernel" not in observatory.snapshot()
+        assert observatory.drain_notes() == []
+
+    def test_compile_notes_fold_into_the_ledger(self, monkeypatch):
+        """A solve that compiles while the observatory is on carries the
+        per-kernel compile notes in its ledger record."""
+        observatory.enable()
+        sched = TPUScheduler(make_templates(), max_claims=128)
+        sched.solve(list(kind_pods("a", 6)))
+        rec = obs_ledger.LEDGER.last()
+        compiles = rec.get("compiles") or []
+        assert compiles, "fresh-scheduler solve must record compile notes"
+        assert {"kernel", "seconds"} <= set(compiles[0])
+        kernels = {c["kernel"] for c in compiles}
+        assert kernels & {"solve", "solve_fill", "global_template", "anonymous"}
+
+
+class TestWatchdogSections:
+    def _stalled(self, monkeypatch, method, section):
+        from karpenter_tpu.utils.metrics import SOLVER_FALLBACK, WATCHDOG_STALLS
+
+        monkeypatch.setenv("KTPU_WATCHDOG_S", "0.3")
+        orig = getattr(TPUScheduler, method)
+
+        def slow(self, *args, **kwargs):
+            time.sleep(1.2)
+            return orig(self, *args, **kwargs)
+
+        monkeypatch.setattr(TPUScheduler, method, slow)
+        sched = TPUScheduler(make_templates(), max_claims=128)
+        pods = kind_pods("a", 8)
+        stalls0 = WATCHDOG_STALLS.get(section=section)
+        fb0 = SOLVER_FALLBACK.get(reason=f"watchdog_{section}")
+        r = sched.solve(list(pods))
+        assert WATCHDOG_STALLS.get(section=section) == stalls0 + 1
+        assert SOLVER_FALLBACK.get(reason=f"watchdog_{section}") == fb0 + 1
+        assert not r.unschedulable
+        assert set(r.assignments) == {p.uid for p in pods}
+        # the ledger recorded the degradation rung
+        rec = obs_ledger.LEDGER.last()
+        assert rec["fallback"] == f"watchdog_{section}"
+        assert rec["reason"] == f"watchdog_{section}"
+
+    def test_stalled_encode_falls_back_per_section(self, monkeypatch):
+        self._stalled(monkeypatch, "_encode", "encode")
+
+    def test_stalled_decode_falls_back_per_section(self, monkeypatch):
+        self._stalled(monkeypatch, "_decode", "decode")
+
+
+class TestQuarantineInspection:
+    def test_trips_ttl_and_state(self):
+        from karpenter_tpu.utils.metrics import GUARD_QUARANTINE_TTL
+
+        guard.QUARANTINE.trip("resident", reason="audit divergence", ttl_s=60.0)
+        guard.QUARANTINE.trip("grid", reason="test", ttl_s=30.0)
+        assert GUARD_QUARANTINE_TTL.get(path="resident") == 60.0
+        st = guard.QUARANTINE.state()
+        assert st["resident"]["active"] and st["resident"]["trips"] == 1
+        assert st["resident"]["reason"] == "audit divergence"
+        assert 0 < st["resident"]["ttl_s"] <= 60.0
+        guard.QUARANTINE.clear("grid")
+        assert GUARD_QUARANTINE_TTL.get(path="grid") == 0
+        st = guard.QUARANTINE.state()
+        # the all-time trip count survives the clear
+        assert not st["grid"]["active"] and st["grid"]["trips"] == 1
+        guard.QUARANTINE.trip("grid", reason="again", ttl_s=30.0)
+        assert guard.QUARANTINE.state()["grid"]["trips"] == 2
+
+
+class TestDebugEndpoints:
+    def _get(self, port, path, timeout=10):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout
+        ) as resp:
+            return resp.status, resp.read().decode()
+
+    def test_rounds_quarantine_profile_endpoints(self, monkeypatch, tmp_path):
+        from karpenter_tpu.utils.runtime import HealthConfig, serve_health
+
+        monkeypatch.setenv(obs_ledger.ENV_DIR, str(tmp_path))
+        obs_ledger.LEDGER.record(
+            {"mode": "full", "reason": "snapshot", "pods": 3, "wall_s": 0.1}
+        )
+        guard.QUARANTINE.trip("resident", reason="test", ttl_s=60.0)
+        server, port = serve_health(HealthConfig(enable_profiling=True))
+        try:
+            status, body = self._get(port, "/debug/rounds?n=1")
+            assert status == 200
+            payload = json.loads(body)
+            assert payload["rounds"][-1]["mode"] == "full"
+            assert "observatory" in payload
+
+            status, body = self._get(port, "/debug/quarantine")
+            assert status == 200
+            assert json.loads(body)["resident"]["active"]
+
+            # late in a long-lived process the trace serialization walks
+            # every compiled module, so the capture can take far longer
+            # than the 0.05s window — give the request a wide deadline
+            status, body = self._get(
+                port, "/debug/profile?seconds=0.05", timeout=180
+            )
+            assert status == 200
+            payload = json.loads(body)
+            assert payload["dir"].startswith(str(tmp_path))
+            assert payload["files"], "profile capture wrote no files"
+        finally:
+            server.shutdown()
+
+    def test_endpoints_are_404_without_profiling(self):
+        from karpenter_tpu.utils.runtime import HealthConfig, serve_health
+
+        server, port = serve_health(HealthConfig(enable_profiling=False))
+        try:
+            for path in ("/debug/rounds", "/debug/quarantine", "/debug/profile"):
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    self._get(port, path)
+                assert err.value.code == 404
+        finally:
+            server.shutdown()

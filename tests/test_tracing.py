@@ -262,6 +262,50 @@ class TestProvisioningTrace:
         assert TRACER.traces() == []
 
 
+class TestInjectedClock:
+    """The tracer's clocks are injectable (ISSUE 12 deflake satellite):
+    span ordering and durations are asserted against a deterministic
+    tick counter, not the wall clock."""
+
+    def test_span_ordering_and_durations_under_injected_clock(self):
+        ticks = iter(float(i) for i in range(100))
+        walls = iter(float(1000 + i) for i in range(100))
+        t = Tracer(clock=lambda: next(ticks), wall=lambda: next(walls))
+        t.enable()
+        with t.span("root") as root:
+            with t.span("child"):
+                pass
+        trace = t.trace(root.trace_id)
+        assert trace is not None
+        by = {s["name"]: s for s in trace["spans"]}
+        # clock reads: root start=0 (+wall), child start=1, child end=2,
+        # root end=3 — ordering and durations are exact, no sleeps, no
+        # wall-clock interleaving assumptions
+        assert by["root"]["start"] == 0.0
+        assert by["child"]["start"] == 1.0
+        assert by["child"]["duration_s"] == 1.0
+        assert by["root"]["duration_s"] == 3.0
+        assert by["child"]["start"] > by["root"]["start"]
+        assert (
+            by["child"]["start"] + by["child"]["duration_s"]
+            <= by["root"]["start"] + by["root"]["duration_s"]
+        )
+        assert by["root"]["wall_start"] == 1000.0
+
+    def test_record_span_uses_injected_clock(self):
+        ticks = iter(float(i) for i in range(100))
+        walls = iter(float(1000 + i) for i in range(100))
+        t = Tracer(clock=lambda: next(ticks), wall=lambda: next(walls))
+        t.enable()
+        with t.span("root") as root:
+            t.record_span("waited", 0.5)
+        trace = t.trace(root.trace_id)
+        by = {s["name"]: s for s in trace["spans"]}
+        # record_span ends at the injected now (tick 1) and backdates
+        assert by["waited"]["start"] == 0.5
+        assert by["waited"]["duration_s"] == 0.5
+
+
 class TestRemoteSolveStitching:
     """Acceptance: a remote Solve yields a single stitched trace — the
     server-side spans carry the client's trace id."""
@@ -277,8 +321,21 @@ class TestRemoteSolveStitching:
                 result = remote.solve([make_pod(f"p-{i}", cpu=0.5) for i in range(12)])
             remote.close()
             assert not result.unschedulable
-            trace = tracer.trace(root.trace_id)
-            by = spans_by_name(trace)
+            # the server handler's spans flush on ITS thread: in-process
+            # the refcounted trace can complete after the client exits
+            # its root span, so an immediate read may miss the server
+            # fragment. Poll (bounded) until the fragment lands instead
+            # of assuming wall-clock ordering across threads.
+            import time as _time
+
+            deadline = _time.monotonic() + 5.0
+            by = {}
+            while _time.monotonic() < deadline:
+                trace = tracer.trace(root.trace_id)
+                by = spans_by_name(trace) if trace else {}
+                if any(name.startswith("rpc.server.") for name in by):
+                    break
+                _time.sleep(0.01)
             # solves prefer the streaming SolveStream crossing (unary
             # Solve remains the downgrade path on older servers)
             method = "SolveStream" if "rpc.SolveStream" in by else "Solve"
