@@ -164,7 +164,13 @@ _gather_fill_xs = jax.jit(_gather_fill_xs_raw)
 _gather_fill_xs_dp = jax.jit(
     jax.vmap(_gather_fill_xs_raw, in_axes=(None,) * 9 + (0, 0))
 )
-_gather_kind_xs = jax.jit(_gather_kind_xs)
+_gather_kind_xs_raw = _gather_kind_xs
+_gather_kind_xs = jax.jit(_gather_kind_xs_raw)
+# batched over [DP] rows of (kind ids, counts): one dispatch gathers every
+# dp row's chunk-group KindXs for the speculative kscan fan-out
+_gather_kind_xs_dp = jax.jit(
+    jax.vmap(_gather_kind_xs_raw, in_axes=(None,) * 10 + (0, 0))
+)
 
 
 def _slim_outputs(specs: tuple, flat) -> tuple[list, list]:
@@ -614,6 +620,12 @@ class TPUScheduler:
         # batched dispatch and merge exact-or-replay; bit-parity with the
         # single-device solve is structural (see ops/solver.py dp section)
         self.shard_dp = os.environ.get("KTPU_SHARD_DP", "1") not in ("0", "false")
+        # dp-sharded speculative kscan (ISSUE 13): zonal-spread kinds join
+        # the fan-out under the per-domain deadness predicate; KTPU_SHARD_KSCAN=0
+        # opts kscan runs (only) back onto the sequential scan
+        self.shard_kscan = os.environ.get("KTPU_SHARD_KSCAN", "1") not in (
+            "0", "false"
+        )
         self._shard_stats: Optional[dict] = None
         # per-chunk streaming sink (gRPC SolveStream); None in-process
         self._chunk_sink = None
@@ -2147,6 +2159,19 @@ class TPUScheduler:
                 "groups_replayed": 0,
                 "group_pods": [],
                 "replicated_bytes": int(rep_bytes),
+                # one packed verdict word per merge round is the loop's
+                # ONLY host sync (ISSUE 13): fetches == rounds, bytes =
+                # uint32 lanes on the wire, sync_blocked_s = wall spent
+                # waiting on commit decisions (merge_wall_s - blocked =
+                # dispatch/decode overlap restored)
+                "verdict_fetches": 0,
+                "verdict_bytes": 0,
+                "sync_blocked_s": 0.0,
+                "merge_wall_s": 0.0,
+                "families": {
+                    "fill": {"committed": 0, "replayed": 0},
+                    "kscan": {"committed": 0, "replayed": 0},
+                },
             }
             from karpenter_tpu.utils.metrics import SHARD_REPLICATED_BYTES
 
@@ -2274,6 +2299,49 @@ class TPUScheduler:
                 n_claims=n_claims,
             )
 
+        def _dispatch_kscan(st, segs, key, grid_audit=True):
+            """One sequential kind-scan dispatch for vocab key `key`
+            (shared by the plain path and the dp merge loop's replay and
+            audit-twin rungs; the twin disables the nested grid audit —
+            the speculative audit already compares full states).
+            Exact B: a padded segment would run the full-width precompute
+            for nothing (the inner loop already has a dynamic trip
+            count); runs are small, so the executable variants stay few."""
+            B = len(segs)
+            kind_ids = np.zeros(B, dtype=np.int64)
+            counts = np.zeros(B, dtype=np.int32)
+            for j, (lo, hi, k) in enumerate(segs):
+                kind_ids[j] = k
+                counts[j] = hi - lo
+            maxc = self._pad_cache.pad("kscan_cap", int(counts.max()), step=64)
+            xs = _gather_kind_xs(
+                enc["reqs_k"], enc["strict_k"], enc["requests_k"],
+                enc["tol_k"], enc["it_allow_k"], enc["exist_ok_k"],
+                enc["ports_k"], enc["conf_k"], enc["vols_k"],
+                enc["pod_topo_k"], jnp.asarray(kind_ids),
+                jnp.asarray(counts),
+            )
+            grid_inc = not QUARANTINE.active("grid")
+            kscan_args = (
+                xs, exist_tensors, self.it_tensors, template_tensors,
+                self.well_known, topo_tensors,
+            )
+            kscan_kw = dict(
+                zone_kid=enc["zone_kid"], ct_kid=enc["ct_kid"],
+                n_claims=n_claims, key_kid=key,
+                n_domains=len(self.encoder.vocab.values[key]),
+                maxc=maxc,
+            )
+            st_in = st
+            st, ys = ops_solver.solve_kind_scan(
+                st, *kscan_args, grid_incremental=grid_inc, **kscan_kw
+            )
+            if grid_audit and grid_inc and guard_config.should_audit("grid"):
+                st, ys = self._audit_kscan_grid(
+                    st_in, st, ys, kscan_args, kscan_kw
+                )
+            return st, ys
+
         # ---- dp-sharded speculative fill (ISSUE 8) -----------------------
         # On a mesh whose dp axis has extent > 1, CONSECUTIVE pipelined
         # fill chunk groups become one "fill_dp" item: each merge round
@@ -2317,6 +2385,48 @@ class TPUScheduler:
                 merged_runs.append(runs[i])
                 i += 1
             runs = merged_runs
+        # ---- dp-sharded speculative kscan (ISSUE 13 rung 2) --------------
+        # kscan runs join the fan-out under the per-domain grid deadness
+        # predicate + vg/hg record-vs-apply disjointness (ops/solver.py
+        # kscan dp section) — unlike fill, topology state is ALLOWED here
+        # because the verdict proves count independence per round and the
+        # merge re-bases recorded deltas. Runs split into chunk groups of
+        # whole segments by the same pod target the fill pipeline uses.
+        kscan_dp_eligible = bool(
+            K_pipe
+            and dp_n > 1
+            and self.shard_dp
+            and self.shard_kscan
+            and not QUARANTINE.active("speculative")
+            and not self.existing_nodes
+        )
+        if kscan_dp_eligible:
+            split_k: list = []
+            for mode, segs in runs:
+                if mode[0] != "kscan" or len(segs) <= 1:
+                    split_k.append((mode, segs))
+                    continue
+                # the chunk-group target is sized to THIS run, not the
+                # whole problem — kscan runs are often a small slice of
+                # a mostly-fill solve and would otherwise never split
+                run_pods = sum(hi - lo for lo, hi, _k in segs)
+                target = max(-(-run_pods // K_pipe), 1)
+                kgroups: list = []
+                cur: list = []
+                cur_pods = 0
+                for seg in segs:
+                    cur.append(seg)
+                    cur_pods += seg[1] - seg[0]
+                    if cur_pods >= target:
+                        kgroups.append(cur)
+                        cur, cur_pods = [], 0
+                if cur:
+                    kgroups.append(cur)
+                if len(kgroups) >= 2:
+                    split_k.append((("kscan_dp", mode[1]), kgroups))
+                else:
+                    split_k.append((mode, segs))
+            runs = split_k
 
         outputs: list[tuple] = []
         tmpl_snaps: list = []  # post-dispatch GLOBAL template snapshot per
@@ -2375,48 +2485,20 @@ class TPUScheduler:
                     _maybe_compact, _dispatch_fill,
                 )
             elif mode[0] == "kscan":
-                # exact B: a padded segment would run the full-width
-                # precompute for nothing (the inner loop already has a
-                # dynamic trip count); runs are small, so the executable
-                # variants stay few
-                B = len(segs)
-                kind_ids = np.zeros(B, dtype=np.int64)
-                counts = np.zeros(B, dtype=np.int32)
-                for j, (lo, hi, k) in enumerate(segs):
-                    kind_ids[j] = k
-                    counts[j] = hi - lo
-                maxc = self._pad_cache.pad("kscan_cap", int(counts.max()), step=64)
-                xs = _gather_kind_xs(
-                    enc["reqs_k"], enc["strict_k"], enc["requests_k"],
-                    enc["tol_k"], enc["it_allow_k"], enc["exist_ok_k"],
-                    enc["ports_k"], enc["conf_k"], enc["vols_k"],
-                    enc["pod_topo_k"], jnp.asarray(kind_ids),
-                    jnp.asarray(counts),
-                )
-                grid_inc = not QUARANTINE.active("grid")
-                kscan_args = (
-                    xs, exist_tensors, self.it_tensors, template_tensors,
-                    self.well_known, topo_tensors,
-                )
-                kscan_kw = dict(
-                    zone_kid=enc["zone_kid"], ct_kid=enc["ct_kid"],
-                    n_claims=n_claims, key_kid=mode[1],
-                    n_domains=len(self.encoder.vocab.values[mode[1]]),
-                    maxc=maxc,
-                )
-                state_in = state
-                state, ys = ops_solver.solve_kind_scan(
-                    state, *kscan_args, grid_incremental=grid_inc, **kscan_kw
-                )
-                if grid_inc and guard_config.should_audit("grid"):
-                    state, ys = self._audit_kscan_grid(
-                        state_in, state, ys, kscan_args, kscan_kw
-                    )
+                state, ys = _dispatch_kscan(state, segs, mode[1])
                 outputs.append(("kscan", segs, ys))
                 tmpl_snaps.append(ops_solver.global_template(state))
                 for lo_, hi_, k_ in segs:
                     remaining[k_] -= hi_ - lo_
                 state = _maybe_compact(state)
+            elif mode[0] == "kscan_dp":
+                # `segs` is a LIST of chunk groups; the dp merge loop
+                # appends one ("kscan", ...) output per group, exactly
+                # like the sequential branch would have
+                state = self._run_kscan_dp(
+                    enc, state, mode[1], segs, outputs, tmpl_snaps,
+                    remaining, _maybe_compact, _dispatch_kscan,
+                )
             else:
                 lo, hi = segs[0][0], segs[-1][1]
                 for clo in range(lo, hi, chunk):
@@ -2462,25 +2544,30 @@ class TPUScheduler:
         conditions provably hold, sequential replay otherwise. Either way
         the committed state and outputs are bit-identical to the
         sequential loop's."""
+        import time as _time
+
         from karpenter_tpu.faultinject import FAULT
-        from karpenter_tpu.ops.kernels import fetch_tree
-        from karpenter_tpu.utils.metrics import SHARD_MERGE_ROUNDS
+        from karpenter_tpu.ops.kernels import fetch_tree, leading_ones
+        from karpenter_tpu.utils.metrics import (
+            SHARD_MERGE_ROUNDS, SHARD_VERDICT_BYTES,
+        )
 
         dp_n = int(dict(self.mesh.shape).get("dp", 1))
-        W = int(state.open.shape[0])
         n_claims = enc["n_claims"]
-        requests_np = np.asarray(enc["requests_k"], dtype=np.float32)
         stats = self._shard_stats
+        t_loop0 = _time.perf_counter()
         gi = 0
         while gi < len(groups):
             round_groups = groups[gi : gi + dp_n]
-            gi += len(round_groups)
-            # committed-state scalars at the round base (host copies feed
-            # the per-group commit checks; the spec rows solved from HERE)
-            b_n_open, b_w_open, b_spills = (
-                int(x)
-                for x in fetch_tree([state.n_open, state.w_open, state.spills])
-            )
+            # drain whatever is still in flight (mode-loop tail on round
+            # one) BEFORE the round's collective-bearing dispatch: the
+            # one-collective-in-flight rule must hold at dispatch time.
+            # A wait, not a transfer — the round still fetches exactly
+            # one verdict word from the host's point of view.
+            jax.block_until_ready(state)
+            # the round base stays a device-scalar reference — the merge
+            # takes base.n_open/base.w_open on device, no host fetch
+            base = state
             B_max = max(len(s) for s in round_groups)
             B_pad = self._pad_cache.pad(
                 "fill_segments_dp", B_max, step=(8 if B_max <= 32 else 32)
@@ -2499,92 +2586,240 @@ class TPUScheduler:
                 enc["conf_k"], enc["vols_k"], enc["pod_topo_k"],
                 jnp.asarray(kid_b), jnp.asarray(cnt_b),
             )
-            spec_states, spec_ys = ops_solver.solve_fill_dp(
+            spec_states, spec_ys, verdict = ops_solver.solve_fill_dp(
                 state, xs_b, enc["exist_tensors"], self.it_tensors,
                 enc["template_tensors"], self.well_known, enc["topo_tensors"],
                 zone_kid=enc["zone_kid"], ct_kid=enc["ct_kid"],
                 n_claims=n_claims,
             )
-            # serialize the round's collective computations: the merge
-            # loop syncs on tiny scalars per group anyway, and >1
+            # serialize the round's collective computations: >1
             # collective-bearing computation in flight deadlocks the
             # virtual-device CPU backend's rendezvous (fetch_tree has the
             # matching guard)
-            jax.block_until_ready((spec_states, spec_ys))
+            jax.block_until_ready((spec_states, spec_ys, verdict))
+            # the round's SINGLE synchronization point: one packed word
+            # carrying every group's commit verdict (prefix-ANDed on
+            # device, so leading ones == the committable prefix)
+            t_sync = _time.perf_counter()
+            (vw,) = fetch_tree([verdict])
+            vw = np.asarray(vw)
+            n_commit = leading_ones(vw, len(round_groups))
             if stats is not None:
                 stats["merge_rounds"] += 1
-            for r, segs in enumerate(round_groups):
-                kset = sorted({k for _lo, _hi, k in segs})
-                r_min_g = requests_np[kset].min(axis=0)
+                stats["verdict_fetches"] += 1
+                stats["verdict_bytes"] += int(vw.nbytes)
+                stats["sync_blocked_s"] += _time.perf_counter() - t_sync
+            SHARD_VERDICT_BYTES.inc(int(vw.nbytes))
+            for r in range(n_commit):
+                segs = round_groups[r]
                 spec_r, ys_r = ops_solver.take_dp_row(
                     (spec_states, spec_ys), jnp.int32(r)
                 )
                 jax.block_until_ready(ys_r.fill_c)
-                dead, touched, left = ops_solver.dp_commit_probe(
-                    state, self.it_tensors, jnp.asarray(r_min_g),
-                    ys_r.fill_c, ys_r.leftover, jnp.int32(b_w_open),
+                # chaos seam: cut a speculative merge exactly at the
+                # commit decision (an injected error here degrades the
+                # whole solve via the ladder, never a half-graft)
+                FAULT.point(
+                    "solver.merge.commit", segments=len(segs), family="fill"
                 )
-                dead_v, touch_v, left, c_w, c_n, s_n, s_w, s_sp = fetch_tree(
-                    [
-                        dead, touched, left, state.w_open, state.n_open,
-                        spec_r.n_open, spec_r.w_open, spec_r.spills,
-                    ]
+                audit = guard_config.should_audit("speculative")
+                seq_twin = None
+                if audit:
+                    # exact twin FIRST, from the same pre-merge committed
+                    # state (one collective computation in flight at a
+                    # time — the CPU-backend rendezvous rule the
+                    # surrounding loop already follows)
+                    seq_twin = dispatch_fill(state, segs)
+                    jax.block_until_ready(seq_twin[0])
+                state, shifted = ops_solver.merge_shard_fill(
+                    state, spec_r, base.n_open, base.w_open
                 )
-                opened = int(s_n) - b_n_open
-                k_rows = int(s_w) - b_w_open
-                commit = (
-                    bool(dead_v)
-                    and not bool(touch_v)
-                    and int(left) == 0
-                    and int(s_sp) == b_spills
-                    and int(c_w) + k_rows <= W
-                    and int(c_n) + opened <= n_claims
-                )
-                if commit:
-                    # chaos seam: cut a speculative merge exactly at the
-                    # commit decision (an injected error here degrades the
-                    # whole solve via the ladder, never a half-graft)
-                    FAULT.point(
-                        "solver.merge.commit",
-                        segments=len(segs),
-                        opened=opened,
+                jax.block_until_ready(state)  # same one-at-a-time rule
+                if audit:
+                    state, commit_out = self._audit_shard_merge(
+                        state, segs, seq_twin,
+                        ("fill", segs, ys_r, shifted),
+                        lambda ss, yy, sg=segs: ("fill", sg, yy, ss.slot_of),
+                        family="fill",
                     )
-                    audit = guard_config.should_audit("speculative")
-                    seq_twin = None
-                    if audit:
-                        # exact twin FIRST, from the same pre-merge
-                        # committed state (one collective computation in
-                        # flight at a time — the CPU-backend rendezvous
-                        # rule the surrounding loop already follows)
-                        seq_twin = dispatch_fill(state, segs)
-                        jax.block_until_ready(seq_twin[0])
-                    state, shifted = ops_solver.merge_shard_fill(
-                        state, spec_r, jnp.int32(b_n_open), jnp.int32(b_w_open)
-                    )
-                    jax.block_until_ready(state)  # same one-at-a-time rule
-                    if audit:
-                        state, commit_out = self._audit_shard_merge(
-                            state, shifted, ys_r, segs, seq_twin
-                        )
-                        outputs.append(commit_out)
-                    else:
-                        outputs.append(("fill", segs, ys_r, shifted))
-                    SHARD_MERGE_ROUNDS.inc(outcome="committed")
+                    outputs.append(commit_out)
                 else:
-                    state, ys_seq = dispatch_fill(state, segs)
-                    outputs.append(("fill", segs, ys_seq, state.slot_of))
-                    SHARD_MERGE_ROUNDS.inc(outcome="replayed")
-                if stats is not None:
-                    stats["group_pods"].append(
-                        int(sum(hi - lo for lo, hi, _k in segs))
-                    )
-                    key = "groups_committed" if commit else "groups_replayed"
-                    stats[key] += 1
+                    outputs.append(("fill", segs, ys_r, shifted))
+                SHARD_MERGE_ROUNDS.inc(outcome="committed", family="fill")
+                self._shard_account(segs, True, "fill")
                 tmpl_snaps.append(ops_solver.global_template(state))
                 for lo_, hi_, k_ in segs:
                     remaining[k_] -= hi_ - lo_
                 state = maybe_compact(state)
+                # snapshot + compact drained before the next dispatch
+                jax.block_until_ready((state, tmpl_snaps[-1]))
+            if n_commit < len(round_groups):
+                # replay exactly ONE refused group (its xs rows were
+                # already gathered per-group by dispatch_fill — O(group)
+                # host work, not O(DP)); the remaining groups re-enter as
+                # a FRESH speculative round from the updated state, so a
+                # single refusal doesn't serialize the whole tail
+                segs = round_groups[n_commit]
+                state, ys_seq = dispatch_fill(state, segs)
+                jax.block_until_ready(state)  # one-at-a-time rule
+                outputs.append(("fill", segs, ys_seq, state.slot_of))
+                SHARD_MERGE_ROUNDS.inc(outcome="replayed", family="fill")
+                self._shard_account(segs, False, "fill")
+                tmpl_snaps.append(ops_solver.global_template(state))
+                for lo_, hi_, k_ in segs:
+                    remaining[k_] -= hi_ - lo_
+                state = maybe_compact(state)
+                # snapshot + compact drained before the next dispatch
+                jax.block_until_ready((state, tmpl_snaps[-1]))
+                gi += n_commit + 1
+            else:
+                gi += n_commit
+        if stats is not None:
+            stats["merge_wall_s"] += _time.perf_counter() - t_loop0
         return state
+
+    def _run_kscan_dp(
+        self, enc, state, key, groups, outputs, tmpl_snaps, remaining,
+        maybe_compact, dispatch_kscan,
+    ):
+        """Speculative dp-row execution of kscan (zonal-spread) chunk
+        groups: same one-verdict-word-per-round merge loop as
+        _run_fill_dp, with the kscan deadness predicate (per-domain
+        capacity grid) and vg/hg record-vs-apply disjointness folded into
+        the on-device verdict. Commit grafts window fields plus the
+        recorded topology deltas (merge_shard_kscan); refusal replays the
+        one refused group sequentially — either way bit-identical to the
+        sequential loop (ops/solver.py kscan dp section has the
+        exactness argument)."""
+        import time as _time
+
+        from karpenter_tpu.faultinject import FAULT
+        from karpenter_tpu.ops.kernels import fetch_tree, leading_ones
+        from karpenter_tpu.utils.metrics import (
+            SHARD_MERGE_ROUNDS, SHARD_VERDICT_BYTES,
+        )
+
+        dp_n = int(dict(self.mesh.shape).get("dp", 1))
+        n_claims = enc["n_claims"]
+        stats = self._shard_stats
+        t_loop0 = _time.perf_counter()
+        gi = 0
+        while gi < len(groups):
+            round_groups = groups[gi : gi + dp_n]
+            # same rule as _run_fill_dp: drain in-flight work before the
+            # round's collective-bearing dispatch (a wait, not a fetch)
+            jax.block_until_ready(state)
+            base = state
+            B_max = max(len(s) for s in round_groups)
+            B_pad = self._pad_cache.pad("kscan_segments_dp", B_max, step=8)
+            kid_b = np.zeros((dp_n, B_pad), dtype=np.int64)
+            cnt_b = np.zeros((dp_n, B_pad), dtype=np.int32)
+            for r, segs in enumerate(round_groups):
+                for j, (lo, hi, k) in enumerate(segs):
+                    kid_b[r, j] = k
+                    cnt_b[r, j] = hi - lo
+            maxc = self._pad_cache.pad("kscan_cap", int(cnt_b.max()), step=64)
+            xs_b = _gather_kind_xs_dp(
+                enc["reqs_k"], enc["strict_k"], enc["requests_k"],
+                enc["tol_k"], enc["it_allow_k"], enc["exist_ok_k"],
+                enc["ports_k"], enc["conf_k"], enc["vols_k"],
+                enc["pod_topo_k"], jnp.asarray(kid_b), jnp.asarray(cnt_b),
+            )
+            grid_inc = not QUARANTINE.active("grid")
+            spec_states, spec_ys, verdict = ops_solver.solve_kscan_dp(
+                state, xs_b, enc["exist_tensors"], self.it_tensors,
+                enc["template_tensors"], self.well_known, enc["topo_tensors"],
+                zone_kid=enc["zone_kid"], ct_kid=enc["ct_kid"],
+                n_claims=n_claims, key_kid=key,
+                n_domains=len(self.encoder.vocab.values[key]), maxc=maxc,
+                grid_incremental=grid_inc,
+            )
+            jax.block_until_ready((spec_states, spec_ys, verdict))
+            t_sync = _time.perf_counter()
+            (vw,) = fetch_tree([verdict])
+            vw = np.asarray(vw)
+            n_commit = leading_ones(vw, len(round_groups))
+            if stats is not None:
+                stats["merge_rounds"] += 1
+                stats["verdict_fetches"] += 1
+                stats["verdict_bytes"] += int(vw.nbytes)
+                stats["sync_blocked_s"] += _time.perf_counter() - t_sync
+            SHARD_VERDICT_BYTES.inc(int(vw.nbytes))
+            for r in range(n_commit):
+                segs = round_groups[r]
+                spec_r, ys_r = ops_solver.take_dp_row(
+                    (spec_states, spec_ys), jnp.int32(r)
+                )
+                jax.block_until_ready(ys_r.assignment)
+                FAULT.point(
+                    "solver.merge.commit", segments=len(segs), family="kscan"
+                )
+                audit = guard_config.should_audit("speculative")
+                seq_twin = None
+                if audit:
+                    # twin runs the boundary-exact (non-incremental) grid:
+                    # the speculative row reset its grid at the group
+                    # boundary, so a grid-incremental twin would diverge
+                    # on observability only; the merge contract is over
+                    # state + assignments
+                    seq_twin = dispatch_kscan(
+                        state, segs, key, grid_audit=False
+                    )
+                    jax.block_until_ready(seq_twin[0])
+                state, _shifted, assign = ops_solver.merge_shard_kscan(
+                    state, spec_r, ys_r.assignment, base.n_open,
+                    base.w_open, base.vg_counts, base.hg_counts,
+                )
+                jax.block_until_ready(state)
+                ys_out = ys_r._replace(assignment=assign)
+                if audit:
+                    state, commit_out = self._audit_shard_merge(
+                        state, segs, seq_twin,
+                        ("kscan", segs, ys_out),
+                        lambda ss, yy, sg=segs: ("kscan", sg, yy),
+                        family="kscan",
+                    )
+                    outputs.append(commit_out)
+                else:
+                    outputs.append(("kscan", segs, ys_out))
+                SHARD_MERGE_ROUNDS.inc(outcome="committed", family="kscan")
+                self._shard_account(segs, True, "kscan")
+                tmpl_snaps.append(ops_solver.global_template(state))
+                for lo_, hi_, k_ in segs:
+                    remaining[k_] -= hi_ - lo_
+                state = maybe_compact(state)
+                # snapshot + compact drained before the next dispatch
+                jax.block_until_ready((state, tmpl_snaps[-1]))
+            if n_commit < len(round_groups):
+                segs = round_groups[n_commit]
+                state, ys_seq = dispatch_kscan(state, segs, key)
+                jax.block_until_ready(state)  # one-at-a-time rule
+                outputs.append(("kscan", segs, ys_seq))
+                SHARD_MERGE_ROUNDS.inc(outcome="replayed", family="kscan")
+                self._shard_account(segs, False, "kscan")
+                tmpl_snaps.append(ops_solver.global_template(state))
+                for lo_, hi_, k_ in segs:
+                    remaining[k_] -= hi_ - lo_
+                state = maybe_compact(state)
+                # snapshot + compact drained before the next dispatch
+                jax.block_until_ready((state, tmpl_snaps[-1]))
+                gi += n_commit + 1
+            else:
+                gi += n_commit
+        if stats is not None:
+            stats["merge_wall_s"] += _time.perf_counter() - t_loop0
+        return state
+
+    def _shard_account(self, segs, committed: bool, family: str):
+        stats = self._shard_stats
+        if stats is None:
+            return
+        stats["group_pods"].append(
+            int(sum(hi - lo for lo, hi, _k in segs))
+        )
+        stats["groups_committed" if committed else "groups_replayed"] += 1
+        fam = stats["families"][family]
+        fam["committed" if committed else "replayed"] += 1
 
     @staticmethod
     def _guard_trees_equal(a, b) -> bool:
@@ -2647,18 +2882,23 @@ class TPUScheduler:
         )
         return state_ex, ys_ex
 
-    def _audit_shard_merge(self, state_fast, shifted, ys_r, segs, seq_twin):
-        """Shadow audit of a committed dp-speculative merge group: the
-        sequential replay (run from the identical pre-merge state) is the
-        exact twin; the merged state must match it bit-for-bit. On
-        divergence the sequential results replace the graft."""
+    def _audit_shard_merge(
+        self, state_fast, segs, seq_twin, commit_out, seq_out_fn,
+        family: str = "fill",
+    ):
+        """Shadow audit of a committed dp-speculative merge group (fill
+        or kscan family): the sequential replay (run from the identical
+        pre-merge state) is the exact twin; the merged state must match
+        it bit-for-bit. On divergence the sequential results replace the
+        graft — `seq_out_fn(state_seq, ys_seq)` builds the replacement
+        output tuple."""
         state_seq, ys_seq = seq_twin
         fast_cmp = state_fast
         if guard_config.lying("speculative"):
             fast_cmp = state_fast._replace(n_open=state_fast.n_open + 1)
         if self._guard_trees_equal(fast_cmp, state_seq):
             guard_audit.record_audit("speculative", "pass")
-            return state_fast, ("fill", segs, ys_r, shifted)
+            return state_fast, commit_out
         pods_by_uid, rounds, existing = self._guard_problem_ctx()
         guard_audit.handle_divergence(
             "speculative",
@@ -2667,9 +2907,9 @@ class TPUScheduler:
             pods_by_uid,
             rounds,
             existing,
-            detail={"segments": len(segs)},
+            detail={"segments": len(segs), "family": family},
         )
-        return state_seq, ("fill", segs, ys_seq, state_seq.slot_of)
+        return state_seq, seq_out_fn(state_seq, ys_seq)
 
     def _pipeline_target(self, enc: dict) -> int:
         """Chunk-group count for the software pipeline; 0 disables (small

@@ -285,6 +285,33 @@ def unpack_bool(packed: jnp.ndarray, n: int) -> jnp.ndarray:
     return flat[..., :n].astype(bool)
 
 
+def unpack_bool_np(packed, n: int) -> "np.ndarray":
+    """Host-side twin of unpack_bool: [L] uint32 -> [n] bool."""
+    import numpy as np
+
+    packed = np.asarray(packed, dtype=np.uint32)
+    shifts = np.arange(PACK_LANE, dtype=np.uint32)
+    bits = (packed[..., :, None] >> shifts) & np.uint32(1)
+    flat = bits.reshape(packed.shape[:-1] + (packed.shape[-1] * PACK_LANE,))
+    return flat[..., :n].astype(bool)
+
+
+def leading_ones(packed, n: int) -> int:
+    """Host-side decode of a dp commit-verdict word: the number of
+    LEADING set bits among the first n columns of a pack_bool-packed [L]
+    uint32 word. The device already prefix-ANDs the per-row verdicts, so
+    this is exactly 'how many groups commit'; mixed trailing bits after
+    the first zero (which a well-formed word never carries) are ignored
+    — decode stops at the first clear bit either way."""
+    bits = unpack_bool_np(packed, n)
+    k = 0
+    for b in bits.reshape(-1)[:n]:
+        if not b:
+            break
+        k += 1
+    return k
+
+
 def packed_conflict(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """[...] bool — any(a & b) over the packed trailing axis (the fused
     test half of every port-conflict / volume-overlap check)."""

@@ -1769,12 +1769,17 @@ def solve_fill_dp(
     zone_kid: int,
     ct_kid: int,
     n_claims: int,
-) -> tuple[ShardFillState, FillYs]:
+) -> tuple[ShardFillState, FillYs, jnp.ndarray]:
     """Speculative dp fan-out: one batched dispatch runs every dp row's
     chunk group against the same base state (vmap over the leading group
     axis, inputs sharded over the mesh's dp rows). Returns per-row slim
-    states + fill grids; the host commits rows in order via
-    merge_shard_fill or replays them (scheduler._run_solve_inner)."""
+    states + fill grids + ONE packed commit-verdict word (`_dp_verdict_word`
+    — every commit check evaluated on device, prefix-ANDed over rows and
+    bit-packed via kernels.pack_bool), so the host merge loop fetches a
+    single uint32 lane per round instead of per-group scalar probes. The
+    host commits the verdict's leading-ones prefix in order via
+    merge_shard_fill and replays the first refused group
+    (scheduler._run_fill_dp)."""
 
     def one(xs: FillXs):
         step = _make_fill_step(
@@ -1801,7 +1806,94 @@ def solve_fill_dp(
         lambda a: a if a is allow else shard_hint(a, "dp"), xs_b
     )
     xs_b = xs_b._replace(it_allow=shard_hint(allow, "dp", None, "it"))
-    return jax.vmap(one)(xs_b)
+    spec, ys = jax.vmap(one)(xs_b)
+    verdict = _dp_verdict_word(
+        state, spec, xs_b, n_claims,
+        lambda u, iv, om, rm: _rows_dead(u, iv, om, it, rm),
+        touched=jax.vmap(lambda fc: fill_touched_below(fc, state.w_open))(
+            ys.fill_c
+        ),
+        extra_ok=jnp.sum(ys.leftover, axis=1) == 0,
+    )
+    return spec, ys, verdict
+
+
+def _rows_dead(used, its, open_mask, it, r_min):
+    """[] bool — TRUE when every live open row in (used, its, open_mask)
+    is capacity-dead w.r.t. r_min: used + r_min fits no viable
+    (type, group) cell — compact_state's eviction rule as a read-only
+    predicate over an explicit row slice."""
+    total = used + r_min[None, :]
+    t = total[:, None, None, :]
+    fit = jnp.all((t <= it.alloc[None]) | (t == 0.0), axis=-1)
+    alive_cap = jnp.any(
+        fit & it.group_valid[None] & its[:, :, None], axis=(1, 2)
+    )
+    return ~jnp.any(open_mask & alive_cap)
+
+
+def _dp_group_r_min(count, requests):
+    """[DP, R] — each dp row's elementwise-min request over its live
+    (count > 0) segments. All-padding rows go +inf: inf totals fit no
+    cell (and 0*inf NaNs compare false in the grid's verify step), so a
+    padded row is trivially dead — its commit is then decided by the fit
+    checks alone, which a no-op group passes with k == opened == 0."""
+    return jnp.min(
+        jnp.where((count > 0)[:, :, None], requests, jnp.inf), axis=1
+    )
+
+
+def _dp_verdict_word(state, spec, xs_b, n_claims, rows_dead, touched, extra_ok):
+    """[lanes] uint32 — the packed per-round commit verdict, every check
+    on device (ISSUE 13 rung 1: no per-group scalar probes). Row r's bit
+    is set iff r and every row before it pass ALL commit conditions:
+
+      * every live open claim of the BASE state is capacity-dead for
+        r's elementwise-min request (rows_dead — the family-specific
+        deadness predicate), and so is every claim OPENED by each
+        earlier row q < r (the cross check: those rows are exactly what
+        the sequential solve would have committed before r);
+      * r touched no pre-base window row (touched) and passes the
+        family extra (fill: zero leftovers);
+      * r's spill counter is unchanged, and the cumulative window/
+        claim-axis graft offsets stay in bounds (conservative under
+        mid-prefix compaction, which only shrinks w_open).
+
+    The prefix-AND means the host reads leading ones = groups to commit
+    in order; the first zero bit replays sequentially (exact-or-replay,
+    bit-parity by construction)."""
+    DP = spec.w_open.shape[0]
+    W = state.open.shape[0]
+    rows = jnp.arange(W, dtype=jnp.int32)
+    r_min = _dp_group_r_min(xs_b.count, xs_b.requests)
+    opened_rows = (
+        (rows[None, :] >= state.w_open)
+        & (rows[None, :] < spec.w_open[:, None])
+        & spec.open
+    )  # [DP, W] — each row's freshly opened claims
+
+    def dead_for(rm):
+        base = rows_dead(state.used, state.its, state.open, rm)
+        cross = jax.vmap(lambda u, iv, om: rows_dead(u, iv, om, rm))(
+            spec.used, spec.its, opened_rows
+        )
+        return base, cross
+
+    # sequential map over the (tiny) dp extent keeps the [W, T, GR]-sized
+    # deadness intermediates at one r at a time instead of DP^2 of them
+    dead_base, cross = jax.lax.map(dead_for, r_min)  # [DP], [DP(r), DP(q)]
+    qi = jnp.arange(DP, dtype=jnp.int32)
+    cross_ok = jnp.all(cross | (qi[None, :] >= qi[:, None]), axis=1)
+    spill_ok = spec.spills == state.spills
+    k = spec.w_open - state.w_open
+    opened_n = spec.n_open - state.n_open
+    fit_w = state.w_open + jnp.cumsum(k) <= W
+    fit_n = state.n_open + jnp.cumsum(opened_n) <= jnp.int32(n_claims)
+    ok = (
+        dead_base & cross_ok & ~touched & extra_ok & spill_ok & fit_w & fit_n
+    )
+    prefix = jnp.cumsum((~ok).astype(jnp.int32)) == 0
+    return kernels.pack_bool(prefix)
 
 
 @jax.jit
@@ -1812,14 +1904,9 @@ def window_live_dead(state: SolverState, it: InstanceTypeTensors, r_min: jnp.nda
     a chunk group requests >= the group's elementwise-min r_min, and the
     total-based fits rule is monotone in the request, so TRUE proves a
     fill of that group cannot touch any existing open claim: the dp
-    merge's commit condition."""
-    total = state.used + r_min[None, :]
-    t = total[:, None, None, :]
-    fit = jnp.all((t <= it.alloc[None]) | (t == 0.0), axis=-1)
-    alive_cap = jnp.any(
-        fit & it.group_valid[None] & state.its[:, :, None], axis=(1, 2)
-    )
-    return ~jnp.any(state.open & alive_cap)
+    merge's commit condition, evaluated on device inside solve_fill_dp's
+    verdict word (kept as a standalone jit for the differential tests)."""
+    return _rows_dead(state.used, state.its, state.open, it, r_min)
 
 
 @jax.jit
@@ -1844,42 +1931,13 @@ def take_dp_row(tree, r: jnp.ndarray):
     return jax.tree_util.tree_map(lambda a: a[r], tree)
 
 
-@jax.jit
-def dp_commit_probe(
-    committed: SolverState,
-    it: InstanceTypeTensors,
-    r_min: jnp.ndarray,
-    fill_c: jnp.ndarray,
-    leftover: jnp.ndarray,
-    base_w_open: jnp.ndarray,
-):
-    """The per-group commit checks as ONE program: (all committed live
-    claims dead for the group, spec touched a pre-base row, total
-    leftover). Padded segments carry count=0 and thus leftover=0, so the
-    full-axis sum equals the live-segment sum."""
-    return (
-        window_live_dead(committed, it, r_min),
-        fill_touched_below(fill_c, base_w_open),
-        jnp.sum(leftover),
-    )
-
-
-@jax.jit
-def merge_shard_fill(
-    committed: SolverState,
-    spec: ShardFillState,
-    base_n_open: jnp.ndarray,
-    base_w_open: jnp.ndarray,
-) -> tuple[SolverState, jnp.ndarray]:
-    """Graft a committed speculative group onto the committed state: the
-    spec rows [base_w_open, spec.w_open) — fresh opens append contiguously
-    within one dispatch — land at committed.w_open.. with global ids
-    shifted by (committed.n_open - base_n_open). Exact under the commit
-    conditions (window_live_dead for the group, zero leftovers/spills, no
-    window or claim-axis overflow), which the caller checks BEFORE
-    dispatching this. Returns (merged, shifted_slot_map): the spec
-    dispatch's window->global map re-based into committed ids, i.e. the
-    decode's slot snapshot for the group's fill grids."""
+def _graft_window_fields(committed, spec, base_n_open, base_w_open):
+    """The window graft shared by every speculative family: spec rows
+    [base_w_open, spec.w_open) — fresh opens append contiguously within
+    one dispatch — land at committed.w_open.. with global ids shifted by
+    delta = (committed.n_open - base_n_open). Returns the SolverState
+    field updates plus (shifted_slot_map, delta); families layer their
+    extra state (kscan: vg/hg counts, assignment ids) on top."""
     W = committed.open.shape[0]
     NB = committed.bank_frozen.shape[0]
     base_n_open = jnp.asarray(base_n_open, dtype=jnp.int32)
@@ -1904,7 +1962,7 @@ def merge_shard_fill(
         grab, kernels.take_set(spec.reqs, src), committed.reqs
     )
     w_open = committed.w_open + k
-    merged = committed._replace(
+    fields = dict(
         reqs=reqs,
         used=take(committed.used, spec.used),
         its=take(committed.its, spec.its),
@@ -1918,7 +1976,27 @@ def merge_shard_fill(
         w_open=w_open,
         w_hw=jnp.maximum(committed.w_hw, w_open),
     )
-    return merged, shifted
+    return fields, shifted, delta
+
+
+@jax.jit
+def merge_shard_fill(
+    committed: SolverState,
+    spec: ShardFillState,
+    base_n_open: jnp.ndarray,
+    base_w_open: jnp.ndarray,
+) -> tuple[SolverState, jnp.ndarray]:
+    """Graft a committed speculative fill group onto the committed state.
+    Exact under the commit conditions (window_live_dead for the group,
+    zero leftovers/spills, no window or claim-axis overflow), which the
+    verdict word proves BEFORE the host dispatches this. Returns
+    (merged, shifted_slot_map): the spec dispatch's window->global map
+    re-based into committed ids, i.e. the decode's slot snapshot for the
+    group's fill grids."""
+    fields, shifted, _ = _graft_window_fields(
+        committed, spec, base_n_open, base_w_open
+    )
+    return committed._replace(**fields), shifted
 
 
 # ---------------------------------------------------------------------------
@@ -2336,12 +2414,14 @@ def _make_kind_step(
     D: int,
     maxc: int,
     grid_incremental: bool = True,
+    annotate: bool = True,
 ):
     NCAP = n_claims
     E = exist.avail.shape[0]
     G = templates.its.shape[0]
     no_wk = jnp.zeros_like(well_known)
     i32 = jnp.int32
+    _hint = shard_hint if annotate else (lambda x, *a: x)
 
     def seg_step(carry, xs: KindXs):
         state, grid_prev, grid_req, grid_valid = carry
@@ -2358,7 +2438,7 @@ def _make_kind_step(
         comb = kernels.intersect_sets(state.reqs, pod_b)
         claim_ok = kernels.compatible_elemwise(state.reqs, pod_b, well_known)
         it_compat = kernels.intersects(it.reqs, comb).T  # [W, T]
-        viable0 = shard_hint(state.its & it_compat & xs.it_allow[None, :], "dp", "it")
+        viable0 = _hint(state.its & it_compat & xs.it_allow[None, :], "dp", "it")
         tol = xs.tmpl_ok[state.template]
         ports_ok_n = ~kernels.packed_conflict(xs.port_conf[None, :], state.claim_ports)
         static_n0 = claim_ok & tol & ports_ok_n
@@ -2382,7 +2462,7 @@ def _make_kind_step(
             # guard quarantine / shadow-audit exact twin: force the
             # full-width divide-and-verify recompute at every boundary
             grid_reused = jnp.bool_(False)
-        grid_n = shard_hint(
+        grid_n = _hint(
             jax.lax.cond(
                 grid_reused,
                 lambda: grid_prev,
@@ -2879,3 +2959,206 @@ def solve_kind_scan(
     )
     (state, _grid, _req, _valid), ys = jax.lax.scan(step, carry0, xs)
     return state, ys
+
+
+# ---------------------------------------------------------------------------
+# dp-sharded speculative kscan (ISSUE 13 rung 2): zonal-spread kinds join
+# the speculative dp fan-out under a per-domain deadness predicate
+# ---------------------------------------------------------------------------
+#
+# The kscan engine's only tier-2 gate on a pre-existing claim row is its
+# per-domain capacity ceiling: lim = max over admitted (type, group)
+# cells of the incremental [W, T, GR] grid, per domain of the kind's
+# vocab key (_kscan_capd). The grid count is monotone DECREASING in both
+# the request vector and the row's used vector, and _kscan_capd's max
+# only shrinks under tighter viability/offering masks — so evaluating
+# capd with the GROUP's elementwise-min request over SUPERSET masks
+# (the row's raw its viability, all-true capacity-type and zone masks)
+# upper-bounds every real candidate evaluation any pod of the group
+# would see. All-domain capd == 0 under that bound proves the row can
+# accept no pod of the group: the kscan deadness predicate, playing
+# exactly window_live_dead's role for segment-scan groups.
+#
+# Exactness of the graft additionally needs the groups' topology count
+# state to be independent: row r may only commit when no earlier row q
+# RECORDS into a vocab-key or hostname group that r APPLIES (gated by
+# vg_valid/hg_valid) — the count reads r's evaluation depends on are
+# then bitwise-unchanged by q's commit. Recorded deltas still merge:
+# vg counts add (deltas are order-free sums), hg counts shift their
+# fresh-claim columns by the claim-id delta — the same id isomorphism
+# the window graft applies to slot_of. Anything else (existing nodes,
+# reservations, budgets) is excluded by the kscan routing preconditions
+# plus the dp eligibility gate (scheduler._run_solve_inner).
+
+
+class ShardKscanState(NamedTuple):
+    """The window-row slice + counters + topology counts of one
+    speculative per-shard kscan solve. Bank, existing-node, budget and
+    reservation state are unchanged by construction on the dp-eligible
+    kscan class, so they never cross the merge."""
+
+    reqs: ReqSetTensors  # [W, K, V]
+    used: jnp.ndarray  # [W, R]
+    its: jnp.ndarray  # [W, T]
+    template: jnp.ndarray  # [W]
+    open: jnp.ndarray  # [W]
+    pods: jnp.ndarray  # [W]
+    slot_of: jnp.ndarray  # [W]
+    claim_ports: jnp.ndarray  # [W, NPp]
+    held: jnp.ndarray  # [W, RID]
+    n_open: jnp.ndarray  # [] i32
+    w_open: jnp.ndarray  # [] i32
+    spills: jnp.ndarray  # [] i32
+    vg_counts: jnp.ndarray  # [NGv, V]
+    hg_counts: jnp.ndarray  # [NGh, E + NCAP + 1]
+
+
+def _kscan_rows_dead(used, its, open_mask, it, r_min, key_kid, zone_kid, D):
+    """[] bool — TRUE when every live open row is per-domain capacity-dead
+    w.r.t. r_min: the incremental-grid count at (used, r_min) yields
+    capd == 0 in EVERY domain of the kind's vocab key over superset
+    viability/offering masks. Monotone in the request, so TRUE for a
+    group's elementwise-min request proves no pod of the group passes the
+    kscan tier-2 fits gate (lim > placed needs lim >= 1) on that row."""
+    W = used.shape[0]
+    Z = it.zc_avail.shape[2]
+    C = it.zc_avail.shape[3]
+    grid = _cap_res_grid(used, r_min, it)
+    capd = _kscan_capd(
+        grid,
+        its,
+        jnp.ones((W, C), dtype=bool),
+        jnp.ones((W, Z), dtype=bool),
+        it,
+        key_kid,
+        zone_kid,
+        D,
+    )
+    return ~jnp.any(open_mask & jnp.any(capd > 0, axis=-1))
+
+
+@named_kernel("solve_kscan_dp")
+@functools.partial(jax.jit, static_argnames=_KSCAN_STATIC)
+def solve_kscan_dp(
+    state: SolverState,
+    xs_b: KindXs,  # leading [DP] group axis on every tensor
+    exist: ExistingNodes,
+    it: InstanceTypeTensors,
+    templates: Templates,
+    well_known: jnp.ndarray,
+    topo: TopologyTensors,
+    zone_kid: int,
+    ct_kid: int,
+    n_claims: int,
+    key_kid: int,
+    n_domains: int,
+    maxc: int,
+    grid_incremental: bool = True,
+) -> tuple[ShardKscanState, KindYs, jnp.ndarray]:
+    """Speculative dp fan-out for vocab-key (kscan) kinds: every dp row
+    scans ITS chunk group of segments against the same base state, with
+    the same packed commit-verdict contract as solve_fill_dp — deadness
+    here is the per-domain grid predicate (_kscan_rows_dead) plus vg/hg
+    record-vs-apply disjointness between rows. The grid carry starts
+    fresh per row (grid_valid False), so chunked groups trade some
+    cross-boundary grid reuse for the dp fan-out."""
+    step = _make_kind_step(
+        exist, it, templates, well_known, topo, zone_kid, ct_kid,
+        n_claims, key_kid, n_domains, maxc, grid_incremental,
+        annotate=False,
+    )
+    W = state.open.shape[0]
+    T, GR, R = it.alloc.shape
+
+    def one(xs: KindXs):
+        carry0 = (
+            state,
+            jnp.zeros((W, T, GR), dtype=jnp.int32),
+            jnp.zeros((R,), dtype=jnp.float32),
+            jnp.bool_(False),
+        )
+        (st, _grid, _req, _valid), ys = jax.lax.scan(step, carry0, xs)
+        return (
+            ShardKscanState(
+                reqs=st.reqs, used=st.used, its=st.its, template=st.template,
+                open=st.open, pods=st.pods, slot_of=st.slot_of,
+                claim_ports=st.claim_ports, held=st.held, n_open=st.n_open,
+                w_open=st.w_open, spills=st.spills, vg_counts=st.vg_counts,
+                hg_counts=st.hg_counts,
+            ),
+            ys,
+        )
+
+    allow = xs_b.it_allow
+    xs_b = jax.tree_util.tree_map(
+        lambda a: a if a is allow else shard_hint(a, "dp"), xs_b
+    )
+    xs_b = xs_b._replace(it_allow=shard_hint(allow, "dp", None, "it"))
+    spec, ys = jax.vmap(one)(xs_b)
+
+    W_rows = jnp.arange(W, dtype=jnp.int32)
+    touched = jnp.any(
+        (spec.pods > state.pods[None, :]) & (W_rows < state.w_open)[None, :],
+        axis=-1,
+    )
+    # record-vs-apply disjointness over each row's LIVE segments: q < r
+    # recording into a group r applies would change counts r's evaluation
+    # read — never commit r past such a q
+    live = (xs_b.count > 0)[:, :, None]
+    app_v = jnp.any(live & xs_b.vg_applies, axis=1) & topo.vg_valid[None]
+    rec_v = jnp.any(live & xs_b.vg_records, axis=1) & topo.vg_valid[None]
+    app_h = jnp.any(live & xs_b.hg_applies, axis=1) & topo.hg_valid[None]
+    rec_h = jnp.any(live & xs_b.hg_records, axis=1) & topo.hg_valid[None]
+    conflict = (
+        jnp.any(rec_v[:, None, :] & app_v[None, :, :], axis=-1)
+        | jnp.any(rec_h[:, None, :] & app_h[None, :, :], axis=-1)
+    )  # [q, r]
+    DP = spec.w_open.shape[0]
+    qi = jnp.arange(DP, dtype=jnp.int32)
+    topo_ok = jnp.all(~conflict | (qi[:, None] >= qi[None, :]), axis=0)
+    verdict = _dp_verdict_word(
+        state, spec, xs_b, n_claims,
+        lambda u, iv, om, rm: _kscan_rows_dead(
+            u, iv, om, it, rm, key_kid, zone_kid, n_domains
+        ),
+        touched=touched,
+        extra_ok=topo_ok,
+    )
+    return spec, ys, verdict
+
+
+@jax.jit
+def merge_shard_kscan(
+    committed: SolverState,
+    spec: ShardKscanState,
+    assignment: jnp.ndarray,  # [B, MAXC] i32 — the row's KindYs slots
+    base_n_open: jnp.ndarray,
+    base_w_open: jnp.ndarray,
+    base_vg: jnp.ndarray,  # [NGv, V] — round-base vg_counts
+    base_hg: jnp.ndarray,  # [NGh, S] — round-base hg_counts
+) -> tuple[SolverState, jnp.ndarray, jnp.ndarray]:
+    """Graft a committed speculative kscan group: the shared window graft
+    plus the topology count merge — vg deltas add (order-free sums over
+    disjoint-by-verdict groups), hg deltas shift their fresh-claim
+    columns by the claim-id delta before adding, and the group's
+    assignment slots >= E + base_n_open re-base by the same delta.
+    Returns (merged, shifted_slot_map, shifted_assignment)."""
+    fields, shifted, delta = _graft_window_fields(
+        committed, spec, base_n_open, base_w_open
+    )
+    E = committed.exist_used.shape[0]
+    S = committed.hg_counts.shape[1]
+    base_n = jnp.asarray(base_n_open, dtype=jnp.int32)
+    vg = committed.vg_counts + (spec.vg_counts - base_vg)
+    cols = jnp.arange(S, dtype=jnp.int32)
+    src_c = jnp.clip(cols - delta, 0, S - 1)
+    dh = spec.hg_counts - base_hg
+    in_rng = (cols - delta >= E + base_n) & (cols - delta < E + spec.n_open)
+    hg = committed.hg_counts + jnp.where(
+        in_rng[None, :], jnp.take(dh, src_c, axis=1), 0
+    )
+    assign = jnp.where(
+        assignment >= E + base_n, assignment + delta, assignment
+    )
+    merged = committed._replace(vg_counts=vg, hg_counts=hg, **fields)
+    return merged, shifted, assign

@@ -462,13 +462,20 @@ GANG_WAIT_DURATION = REGISTRY.histogram(
 # ---- dp-sharded mesh solve (PR 8) ----
 SHARD_MERGE_ROUNDS = REGISTRY.counter(
     "ktpu_shard_merge_rounds_total",
-    "dp-shard fill chunk-group merge outcomes: committed (the speculative"
-    " per-shard solve was provably independent of the committed claims —"
-    " window_live_dead held, zero leftovers/spills, no window or"
-    " claim-axis overflow — and grafted exactly) vs replayed (a commit"
-    " check failed and the group re-dispatched sequentially; bit-parity"
-    " holds either way)",
-    ("outcome",),
+    "dp-shard chunk-group merge outcomes by solver family (fill | kscan):"
+    " committed (the on-device verdict proved the speculative per-shard"
+    " solve independent of the committed claims — deadness held, zero"
+    " leftovers/spills, no window or claim-axis overflow, and for kscan"
+    " no topology record/apply overlap — and it grafted exactly) vs"
+    " replayed (a verdict bit was unset and the group re-dispatched"
+    " sequentially; bit-parity holds either way)",
+    ("outcome", "family"),
+)
+SHARD_VERDICT_BYTES = REGISTRY.counter(
+    "ktpu_shard_verdict_bytes_total",
+    "Bytes fetched from device for packed per-round commit-verdict words"
+    " (one small transfer per speculative merge round — the round's single"
+    " host synchronization point)",
 )
 SHARD_REPLICATED_BYTES = REGISTRY.gauge(
     "ktpu_shard_replicated_bytes",

@@ -334,6 +334,36 @@ class TestSpeculativeAudit:
         shard = sched2.last_timings.get("shard") or {}
         assert shard.get("merge_rounds", 0) == 0, shard
 
+    def test_lying_kscan_speculative_is_caught(self, monkeypatch):
+        """Same contract for the kscan family (ISSUE 13): the sequential
+        twin runs BEFORE the speculative merge, catches the corrupted
+        graft, and quarantine routes subsequent kscan speculation back to
+        the sequential pipeline."""
+        from test_shard import (
+            dp_scheduler,
+            make_templates as shard_templates,
+            zonal_kind_pods,
+        )
+
+        monkeypatch.setenv("KTPU_GUARD_AUDIT_RATE", "1.0")
+        monkeypatch.setenv("KTPU_GUARD_LIE", "speculative")
+        pods = zonal_kind_pods(192, kinds=4, prefix="gz")
+        sched = dp_scheduler(monkeypatch)
+        meshed = sched.solve(pods)
+        assert guard.divergences("speculative")
+        assert guard.QUARANTINE.active("speculative")
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        single = TPUScheduler(shard_templates()).solve(pods)
+        assert_identical(single, meshed)
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "4")
+        monkeypatch.delenv("KTPU_GUARD_LIE", raising=False)
+        sched2 = dp_scheduler(monkeypatch)
+        r2 = sched2.solve(pods)
+        assert_identical(single, r2)
+        fam = (sched2.last_timings.get("shard") or {}).get("families") or {}
+        assert fam.get("kscan", {}).get("committed", 0) == 0, fam
+        assert (sched2.last_timings["shard"]).get("merge_rounds", 0) == 0
+
 
 class TestWatchdog:
     def test_stalled_dispatch_falls_back_to_host(self, monkeypatch):

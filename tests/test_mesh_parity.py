@@ -65,6 +65,21 @@ def kscan_pods():
         pods.append(p)
     return pods
 
+def kscan_dp_pods():
+    # >=2 zonal kinds with DISJOINT spread selectors + saturating sizes:
+    # the kscan dp-speculative path (ISSUE 13) splits the run into chunk
+    # groups and the per-domain deadness verdict lets them commit
+    pods = []
+    for i in range(192):
+        k = i // 48
+        p = make_pod(f"zd-{i}", cpu=2.0, memory="1Gi")
+        p.metadata.labels = {"grp": str(k), "spread": f"z{k}"}
+        p.spec.topology_spread_constraints = [TopologySpreadConstraint(
+            max_skew=1, topology_key=l.LABEL_TOPOLOGY_ZONE,
+            label_selector={"spread": f"z{k}"})]
+        pods.append(p)
+    return pods
+
 def perpod_pods():
     pods = fill_pods()[:64]
     for i in range(24):
@@ -108,9 +123,13 @@ def matches_host(host, dev):
 
 mesh = make_mesh()  # KTPU_MESH=2x4 from env
 out = {"mesh": dict((k, int(v)) for k, v in mesh.shape.items())}
-cases = [("fill", fill_pods()), ("kscan", kscan_pods()), ("perpod", perpod_pods())]
+cases = [("fill", fill_pods()), ("kscan", kscan_pods()),
+         ("kscan_dp", kscan_dp_pods()), ("perpod", perpod_pods())]
 for name, pods in cases:
-    for window in (0, 48):
+    # kscan_dp runs un-windowed only: the windowed kscan-dp rung is pinned
+    # in-process by tests/test_shard.py, and every extra (case, window)
+    # pair recompiles the whole dp executable set in this cold child
+    for window in ((0,) if name == "kscan_dp" else (0, 48)):
         if window:
             os.environ["KTPU_SCAN_WINDOW"] = str(window)
         else:
@@ -157,6 +176,11 @@ def test_sharded_solves_bit_identical_in_fresh_backend(tmp_path):
     assert res["fill_w0"]["merge_rounds"] >= 1
     assert res["fill_w0"]["committed"] >= 1, res["fill_w0"]
     assert res["fill_w48"]["merge_rounds"] >= 1
-    # topology cases are dp-ineligible by design (shared count state)
+    # disjoint-selector zonal kinds take the kscan dp-speculative path
+    # and commit speculative grafts (ISSUE 13)
+    assert res["kscan_dp_w0"]["merge_rounds"] >= 1
+    assert res["kscan_dp_w0"]["committed"] >= 1, res["kscan_dp_w0"]
+    # a single-kind kscan run has nothing to split into speculative
+    # groups, and per-pod (hostname anti-affinity) kinds stay sequential
     assert res["kscan_w0"]["merge_rounds"] == 0
     assert res["perpod_w0"]["merge_rounds"] == 0

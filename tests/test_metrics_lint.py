@@ -172,8 +172,9 @@ def test_shard_families_are_registered():
 
     fams = {f.name: f for f in _families()}
     expected = {
-        "ktpu_shard_merge_rounds_total": (Counter, ("outcome",)),
+        "ktpu_shard_merge_rounds_total": (Counter, ("outcome", "family")),
         "ktpu_shard_replicated_bytes": (Gauge, ()),
+        "ktpu_shard_verdict_bytes_total": (Counter, ()),
     }
     for name, (cls, labels) in expected.items():
         fam = fams.get(name)

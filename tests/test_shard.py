@@ -5,12 +5,16 @@ annotations keep the hot [W, T] viability masks, bank [NCAP, T] columns
 and kscan [W, T, GR] grid partitioned over (dp × it) instead of
 replicated, and (b) the pipelined fill's chunk groups solve
 SPECULATIVELY one-per-dp-row in a single batched dispatch
-(ops_solver.solve_fill_dp), merged exact-or-replay: a group grafts
-without re-solving only when every live committed claim is provably
-capacity-dead for it (window_live_dead — the frozen-bank eviction rule),
-else it replays sequentially. Either way the result must be BIT-identical
-to the single-device solve and the host oracle — these tests pin that,
-plus the fetch_tree regression the sharded outputs exposed.
+(ops_solver.solve_fill_dp / solve_kscan_dp), merged exact-or-replay: a
+group grafts without re-solving only when every live committed claim is
+provably capacity-dead for it (the frozen-bank eviction rule; for kscan
+kinds a per-domain predicate over the [W, T, GR] grid plus topology
+record/apply disjointness — ISSUE 13), else it replays sequentially.
+The commit decision itself is computed ON DEVICE and fetched as one
+packed verdict word per merge round. Either way the result must be
+BIT-identical to the single-device solve and the host oracle — these
+tests pin that, plus the fetch_tree regression the sharded outputs
+exposed.
 
 Everything here runs in-process on the 8-virtual-device CPU mesh the
 whole suite already forces (tests/conftest.py); the subprocess twin with
@@ -18,6 +22,7 @@ a fresh backend + KTPU_MESH override lives in tests/test_mesh_parity.py.
 """
 
 import numpy as np
+import pytest
 
 import bench
 from karpenter_tpu.cloudprovider.fake import instance_types
@@ -63,6 +68,39 @@ def saturating_kind_pods(n=256, kinds=8, prefix="s"):
     for i in range(n):
         p = make_pod(f"{prefix}-{i}", cpu=2.0, memory="1Gi")
         p.metadata.labels = {"grp": str(i // per)}
+        pods.append(p)
+    return pods
+
+
+def zonal_kind_pods(n=192, kinds=4, prefix="z", shared=False, mixed=False):
+    """Kscan-shaped pods: every kind carries a zone-spread constraint so
+    the solve takes the kscan path. Disjoint selectors (default) keep the
+    kinds' topology state independent, so speculative kscan groups can
+    commit; `shared=True` makes every kind record into the selector every
+    other kind applies — the record/apply conflict bit refuses all but the
+    round's first group. `mixed=True` sizes kinds unevenly so committed
+    claims stay alive for later kinds (the deadness bit refuses)."""
+    pods = []
+    per = n // kinds
+    for i in range(n):
+        k = i // per
+        sel = "z" if shared else f"z{k}"
+        if mixed:
+            p = make_pod(
+                f"{prefix}-{i}",
+                cpu=[0.25, 0.5, 1.0][k % 3],
+                memory=f"{[0.5, 1.0][k % 2]}Gi",
+            )
+        else:
+            p = make_pod(f"{prefix}-{i}", cpu=2.0, memory="1Gi")
+        p.metadata.labels = {"grp": str(k), "spread": sel}
+        p.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(
+                max_skew=1,
+                topology_key=l.LABEL_TOPOLOGY_ZONE,
+                label_selector={"spread": sel},
+            )
+        ]
         pods.append(p)
     return pods
 
@@ -158,9 +196,10 @@ class TestDpFillParity:
         assert_bit_identical(meshed, single)
 
     def test_topology_problem_ineligible_but_identical(self, monkeypatch):
-        """Topology interaction disqualifies the speculative path (shared
-        vg/hg count state crosses groups); the meshed solve must still be
-        bit-identical through the annotated fill/kscan/perpod kernels."""
+        """Topology interaction disqualifies the speculative FILL path,
+        and a single-kind kscan run has nothing to split into groups —
+        the meshed solve must still be bit-identical through the
+        annotated fill/kscan/perpod kernels."""
         pods = mixed_kind_pods(128, prefix="t")
         for i in range(32):
             p = make_pod(f"tz-{i}", cpu=0.5, memory="0.5Gi")
@@ -192,6 +231,132 @@ class TestDpFillParity:
         assert_bit_identical(meshed, single)
 
 
+class TestDpKscanParity:
+    """Speculative dp groups over kscan (zonal-spread) kinds: the
+    per-domain capacity-grid deadness predicate plus the topology
+    record/apply disjointness bit decide commits on device; refusals
+    replay sequentially. Every rung must stay bit-identical to the
+    single-device solve and the host oracle."""
+
+    @pytest.mark.parametrize("chunks", [1, 2, 4])
+    def test_kscan_graft_bit_identical(self, monkeypatch, chunks):
+        """Disjoint selectors + saturating sizes: committed claims go
+        capacity-dead in every domain and no kind records into a selector
+        another kind applies, so kscan groups GRAFT."""
+        from karpenter_tpu.utils.metrics import SHARD_MERGE_ROUNDS
+
+        k0 = SHARD_MERGE_ROUNDS.get(outcome="committed", family="kscan")
+        pods = zonal_kind_pods(192, kinds=4, prefix=f"kg{chunks}")
+        sched = dp_scheduler(monkeypatch, chunks=chunks)
+        meshed = sched.solve(pods)
+        if chunks > 1:
+            shard = sched.last_timings["shard"]
+            fam = shard["families"]["kscan"]
+            assert fam["committed"] >= 1, shard
+            assert fam["replayed"] == 0, shard
+            assert shard["verdict_fetches"] == shard["merge_rounds"]
+            assert shard["verdict_bytes"] >= 4 * shard["verdict_fetches"]
+            assert (
+                SHARD_MERGE_ROUNDS.get(outcome="committed", family="kscan")
+                - k0
+                == fam["committed"]
+            )
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        single = TPUScheduler(make_templates()).solve(pods)
+        assert_bit_identical(meshed, single)
+        href, _ = bench.host_solve(make_templates(), pods)
+        assert_same_packing(href, meshed)
+
+    def test_kscan_replay_bit_identical(self, monkeypatch):
+        """Mixed sizes keep earlier kinds' claims alive for later kinds —
+        the deadness verdict bit refuses and groups REPLAY, still
+        bit-identical."""
+        pods = zonal_kind_pods(192, kinds=4, prefix="kr", mixed=True)
+        sched = dp_scheduler(monkeypatch)
+        meshed = sched.solve(pods)
+        shard = sched.last_timings["shard"]
+        assert shard["families"]["kscan"]["replayed"] >= 1, shard
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        single = TPUScheduler(make_templates()).solve(pods)
+        assert_bit_identical(meshed, single)
+        href, _ = bench.host_solve(make_templates(), pods)
+        assert_same_packing(href, meshed)
+
+    def test_kscan_shared_selector_conflict_replays(self, monkeypatch):
+        """Every kind recording into the one selector every other kind
+        applies: the record/apply conflict bit refuses all but each
+        round's first group — commits AND replays, bit-identical."""
+        pods = zonal_kind_pods(192, kinds=4, prefix="ks", shared=True)
+        sched = dp_scheduler(monkeypatch)
+        meshed = sched.solve(pods)
+        fam = sched.last_timings["shard"]["families"]["kscan"]
+        assert fam["replayed"] >= 1, fam
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        single = TPUScheduler(make_templates()).solve(pods)
+        assert_bit_identical(meshed, single)
+
+    def test_kscan_windowed_bit_identical(self, monkeypatch):
+        """Kscan dp merge under a small active window — graft appends
+        respect window occupancy exactly as the fill family does."""
+        pods = zonal_kind_pods(192, kinds=4, prefix="kw")
+        sched = dp_scheduler(monkeypatch, window=48)
+        meshed = sched.solve(pods)
+        assert sched.last_timings["shard"]["merge_rounds"] >= 1
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        monkeypatch.setenv("KTPU_SCAN_WINDOW", "48")
+        single = TPUScheduler(make_templates()).solve(pods)
+        assert_bit_identical(meshed, single)
+
+    def test_kscan_opt_out(self, monkeypatch):
+        """KTPU_SHARD_KSCAN=0 keeps kscan runs sequential (fill
+        speculation untouched) with identical results."""
+        pods = zonal_kind_pods(192, kinds=4, prefix="ko")
+        monkeypatch.setenv("KTPU_SHARD_KSCAN", "0")
+        sched = dp_scheduler(monkeypatch)
+        meshed = sched.solve(pods)
+        fam = sched.last_timings["shard"]["families"]["kscan"]
+        assert fam["committed"] == 0 and fam["replayed"] == 0
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        single = TPUScheduler(make_templates()).solve(pods)
+        assert_bit_identical(meshed, single)
+
+
+class TestVerdictDecode:
+    """Packed commit-verdict word wire-format regression: pack_bool_np is
+    the layout oracle; leading_ones is the host decode the merge loop
+    trusts for 'how many groups commit'."""
+
+    @pytest.mark.parametrize("n", [1, 2, 4, 8, 37])
+    def test_leading_ones_patterns(self, n):
+        from karpenter_tpu.ops.kernels import leading_ones, pack_bool_np
+
+        assert leading_ones(pack_bool_np(np.ones(n, bool)), n) == n
+        assert leading_ones(pack_bool_np(np.zeros(n, bool)), n) == 0
+        for k in range(n + 1):
+            bits = np.zeros(n, bool)
+            bits[:k] = True
+            assert leading_ones(pack_bool_np(bits), n) == k
+        if n >= 3:
+            # a well-formed word is prefix-ANDed on device, but the
+            # decode must not rely on that: set bits after the first
+            # clear one are ignored
+            bits = np.ones(n, bool)
+            bits[1] = False
+            assert leading_ones(pack_bool_np(bits), n) == 1
+
+    def test_device_host_pack_parity(self):
+        import jax.numpy as jnp
+
+        from karpenter_tpu.ops.kernels import pack_bool, pack_bool_np
+
+        rng = np.random.default_rng(7)
+        for n in (1, 8, 33, 64):
+            bits = rng.random(n) > 0.5
+            np.testing.assert_array_equal(
+                np.asarray(pack_bool(jnp.asarray(bits))), pack_bool_np(bits)
+            )
+
+
 class TestShardObservability:
     def test_last_timings_shard_record(self, monkeypatch):
         """Every meshed solve records the mesh extents, merge/commit
@@ -208,6 +373,20 @@ class TestShardObservability:
         )
         assert sum(shard["group_pods"]) == len(pods)
         assert shard["replicated_bytes"] > 0
+        # ONE verdict fetch per merge round — the round's single host
+        # synchronization (ISSUE 13 tentpole)
+        assert shard["verdict_fetches"] == shard["merge_rounds"]
+        assert shard["verdict_bytes"] >= 4 * shard["verdict_fetches"]
+        assert shard["sync_blocked_s"] >= 0.0
+        assert shard["merge_wall_s"] >= shard["sync_blocked_s"]
+        fams = shard["families"]
+        assert (
+            fams["fill"]["committed"]
+            + fams["fill"]["replayed"]
+            + fams["kscan"]["committed"]
+            + fams["kscan"]["replayed"]
+            == shard["groups_committed"] + shard["groups_replayed"]
+        )
         monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
         plain = TPUScheduler(make_templates())
         plain.solve(pods)
@@ -217,21 +396,23 @@ class TestShardObservability:
         from karpenter_tpu.utils.metrics import (
             SHARD_MERGE_ROUNDS,
             SHARD_REPLICATED_BYTES,
+            SHARD_VERDICT_BYTES,
         )
 
-        c0 = SHARD_MERGE_ROUNDS.get(outcome="committed")
-        r0 = SHARD_MERGE_ROUNDS.get(outcome="replayed")
+        def totals(outcome):
+            return sum(
+                SHARD_MERGE_ROUNDS.get(outcome=outcome, family=f)
+                for f in ("fill", "kscan")
+            )
+
+        c0, r0 = totals("committed"), totals("replayed")
+        v0 = SHARD_VERDICT_BYTES.get()
         sched = dp_scheduler(monkeypatch)
         sched.solve(saturating_kind_pods(128, kinds=4, prefix="met"))
         shard = sched.last_timings["shard"]
-        assert (
-            SHARD_MERGE_ROUNDS.get(outcome="committed") - c0
-            == shard["groups_committed"]
-        )
-        assert (
-            SHARD_MERGE_ROUNDS.get(outcome="replayed") - r0
-            == shard["groups_replayed"]
-        )
+        assert totals("committed") - c0 == shard["groups_committed"]
+        assert totals("replayed") - r0 == shard["groups_replayed"]
+        assert SHARD_VERDICT_BYTES.get() - v0 == shard["verdict_bytes"]
         assert SHARD_REPLICATED_BYTES.get() == shard["replicated_bytes"]
 
 
