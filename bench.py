@@ -77,6 +77,99 @@ def zonal_pods(n, kinds=4, prefix="zb"):
     return pods
 
 
+def hostname_pods(n, kinds=4, prefix="hb"):
+    """Topology-BEARING fill pods for the shard bench stage (ISSUE 14):
+    hostname-spread kinds with DISJOINT selectors keep the fill route but
+    carry hg state, so the topo_fill speculation family engages; the
+    saturating size lets groups commit."""
+    from karpenter_tpu.models import labels as l
+    from karpenter_tpu.models.pod import TopologySpreadConstraint, make_pod
+
+    pods = []
+    per = max(n // kinds, 1)
+    for i in range(n):
+        k = min(i // per, kinds - 1)
+        p = make_pod(f"{prefix}-{i}", cpu=2.0, memory="1Gi")
+        p.metadata.labels = {"grp": str(k), "hspread": f"h{k}"}
+        p.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(
+                max_skew=1,
+                topology_key=l.LABEL_HOSTNAME,
+                label_selector={"hspread": f"h{k}"},
+            )
+        ]
+        pods.append(p)
+    return pods
+
+
+def perpod_pods(n, kinds=4, prefix="pb"):
+    """Per-pod-routed pods for the shard bench stage (ISSUE 14): TWO
+    distinct vg keys per kind (zone + capacity-type spread) defeat the
+    single-key kscan check, so the run takes the per-pod scan and the
+    solve_perpod_dp speculation family engages."""
+    from karpenter_tpu.models import labels as l
+    from karpenter_tpu.models.pod import TopologySpreadConstraint, make_pod
+
+    pods = []
+    per = max(n // kinds, 1)
+    for i in range(n):
+        k = min(i // per, kinds - 1)
+        p = make_pod(f"{prefix}-{i}", cpu=2.0, memory="1Gi")
+        p.metadata.labels = {"grp": str(k), "spread": f"p{k}"}
+        p.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(
+                max_skew=1,
+                topology_key=l.LABEL_TOPOLOGY_ZONE,
+                label_selector={"spread": f"p{k}"},
+            ),
+            TopologySpreadConstraint(
+                max_skew=1,
+                topology_key=l.CAPACITY_TYPE_LABEL_KEY,
+                label_selector={"spread": f"p{k}"},
+            ),
+        ]
+        pods.append(p)
+    return pods
+
+
+def existing_sim_nodes(n=2, cpu_avail=4.0):
+    """Part-full existing nodes for the shard bench stage (ISSUE 14): the
+    dp rows racing to debit them exercise the disjoint-touch verdict bit
+    of the `existing` speculation family."""
+    from karpenter_tpu.controllers.provisioning.host_scheduler import (
+        ExistingSimNode,
+    )
+    from karpenter_tpu.models import labels as l
+    from karpenter_tpu.scheduling import Requirements
+    from karpenter_tpu.utils import resources as res
+
+    nodes = []
+    for i in range(n):
+        name = f"exist-{i}"
+        labels = {
+            l.LABEL_TOPOLOGY_ZONE: "test-zone-1",
+            l.LABEL_INSTANCE_TYPE: "s-4x-amd64",
+            l.CAPACITY_TYPE_LABEL_KEY: l.CAPACITY_TYPE_ON_DEMAND,
+            l.LABEL_ARCH: l.ARCH_AMD64,
+            l.LABEL_OS: "linux",
+            l.LABEL_HOSTNAME: name,
+            l.NODEPOOL_LABEL_KEY: "default",
+        }
+        nodes.append(
+            ExistingSimNode(
+                name=name,
+                index=i,
+                requirements=Requirements.from_labels(labels),
+                available={
+                    res.CPU: cpu_avail,
+                    res.MEMORY: float(8 * 2**30),
+                    res.PODS: 50.0,
+                },
+            )
+        )
+    return nodes
+
+
 def mixed_pods(n):
     """The reference benchmark's makeDiversePods: equal fifths of generic,
     TSC-zone, TSC-hostname, zone pod-affinity, hostname pod-anti-affinity
@@ -550,11 +643,51 @@ def run_shard_stage(n_pods=8192, n_types=200, max_claims=2048):
         "kscan_rounds = sum(SHARD_MERGE_ROUNDS.get(outcome=o, family='kscan')\n"
         "                   for o in ('committed', 'replayed'))\n"
         "assert kscan_rounds > 0, 'kscan family never took the dp path'\n"
+        "# ISSUE 14 twins: the three previously sequential-only stateful\n"
+        "# families (existing-node debits, topology-bearing fill, per-pod\n"
+        "# runs) must each speculate AND commit at least one dp round\n"
+        "from bench import existing_sim_nodes, hostname_pods, perpod_pods\n"
+        "from karpenter_tpu.models.pod import make_pod\n"
+        "hpods = hostname_pods(512, kinds=8)\n"
+        f"hsingle = TPUScheduler(make_templates({n_types}), pod_pad=512).solve(hpods)\n"
+        f"hsched = TPUScheduler(make_templates({n_types}), pod_pad=512, mesh=make_mesh())\n"
+        "hr = hsched.solve(hpods)\n"
+        "assert hr.assignments == hsingle.assignments, 'topo_fill meshed != single-device'\n"
+        "epods = []\n"
+        "for i in range(512):\n"
+        "    p = make_pod(f'eb-{i}', cpu=2.0, memory='1Gi')\n"
+        "    p.metadata.labels = {'grp': str(i // 64)}\n"
+        "    epods.append(p)\n"
+        f"esingle = TPUScheduler(make_templates({n_types}), pod_pad=512).solve(list(epods), existing_sim_nodes())\n"
+        f"esched = TPUScheduler(make_templates({n_types}), pod_pad=512, mesh=make_mesh())\n"
+        "er = esched.solve(list(epods), existing_sim_nodes())\n"
+        "assert er.assignments == esingle.assignments, 'existing meshed != single-device'\n"
+        "assert er.existing_assignments == esingle.existing_assignments, 'existing debits diverged'\n"
+        "os.environ['KTPU_SOLVE_CHUNK'] = '128'  # 512 pods -> 4 per-pod chunks\n"
+        "ppods = perpod_pods(512, kinds=8)\n"
+        f"psingle = TPUScheduler(make_templates({n_types}), pod_pad=512).solve(ppods)\n"
+        f"psched = TPUScheduler(make_templates({n_types}), pod_pad=512, mesh=make_mesh())\n"
+        "pr = psched.solve(ppods)\n"
+        "os.environ.pop('KTPU_SOLVE_CHUNK', None)\n"
+        "assert pr.assignments == psingle.assignments, 'perpod meshed != single-device'\n"
+        "fam_committed = {}\n"
+        "for fam in ('fill', 'existing', 'topo_fill', 'kscan', 'perpod'):\n"
+        "    fam_committed[fam] = SHARD_MERGE_ROUNDS.get(outcome='committed', family=fam)\n"
+        "for fam in ('existing', 'topo_fill', 'perpod'):\n"
+        "    assert fam_committed[fam] > 0, f'{fam} family never committed a dp merge round'\n"
+        "# per-family routing coverage across every meshed solve above —\n"
+        "# the measured speculation coverage --report-shard prints\n"
+        "from karpenter_tpu.utils.metrics import SHARD_FAMILY_ELIGIBLE\n"
+        "coverage = {f: {'dp': int(SHARD_FAMILY_ELIGIBLE.get(family=f, path='dp')),\n"
+        "                'sequential': int(SHARD_FAMILY_ELIGIBLE.get(family=f, path='sequential'))}\n"
+        "            for f in ('fill', 'existing', 'topo_fill', 'kscan', 'perpod')}\n"
         "print(json.dumps({'wall_s': round(wall, 4),\n"
         "                  'pods_per_sec': round(len(pods) / wall, 1),\n"
         "                  'nodes': r.node_count,\n"
         "                  'parity_vs_single_device': True,\n"
         "                  'kscan_merge_rounds_total': kscan_rounds,\n"
+        "                  'family_committed': fam_committed,\n"
+        "                  'coverage': coverage,\n"
         "                  'shard': sched.last_timings.get('shard'),\n"
         "                  'shard_kscan': zsched.last_timings.get('shard')}))\n"
     )
@@ -909,6 +1042,24 @@ def _print_shard_report(detail: dict) -> None:
                 f"sync_blocked={blocked * 1000:.1f}ms "
                 f"overlapped={overlapped * 1000:.1f}ms"
             )
+        # per-family speculation coverage (ISSUE 14): what fraction of
+        # each family's chunk groups entered a dp fan-out round vs stayed
+        # on the ordered scan — the stage-aggregated counters when the
+        # child reports them, else this solve's own routing ledger
+        cov = st.get("coverage") if isinstance(st, dict) else None
+        cov = cov or sh.get("coverage")
+        if cov:
+            parts = []
+            for f, v in sorted(cov.items()):
+                total = v["dp"] + v["sequential"]
+                if not total:
+                    continue
+                parts.append(f"{f}={v['dp']}/{total} ({100.0 * v['dp'] / total:.0f}%)")
+            if parts:
+                print(
+                    f"      {'':>28s}  dp coverage (groups dp/total): "
+                    + " ".join(parts)
+                )
 
 
 def _print_scan_report(detail: dict) -> None:

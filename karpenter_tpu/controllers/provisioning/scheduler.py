@@ -155,7 +155,13 @@ def _gather_kind_xs(
     )
 
 
-_gather_pod_chunk = jax.jit(_gather_pod_chunk)
+_gather_pod_chunk_raw = _gather_pod_chunk
+_gather_pod_chunk = jax.jit(_gather_pod_chunk_raw)
+# batched over [DP] rows of (kind ids, valid counts): one dispatch gathers
+# every dp row's per-pod chunk for the speculative perpod fan-out
+_gather_pod_chunk_dp = jax.jit(
+    jax.vmap(_gather_pod_chunk_raw, in_axes=(None,) * 10 + (0, 0))
+)
 # the raw (un-jitted) gather also feeds the dp-batched variant below
 _gather_fill_xs_raw = _gather_fill_xs
 _gather_fill_xs = jax.jit(_gather_fill_xs_raw)
@@ -171,6 +177,12 @@ _gather_kind_xs = jax.jit(_gather_kind_xs_raw)
 _gather_kind_xs_dp = jax.jit(
     jax.vmap(_gather_kind_xs_raw, in_axes=(None,) * 10 + (0, 0))
 )
+
+# speculative dp families (metrics labels + shard stats keys): the three
+# fill-shaped labels split by what shared state the verdict had to prove
+# disjoint — plain capacity (fill), existing-node debits (existing),
+# hostname-group counts (topo_fill) — plus the kscan and per-pod engines
+_SHARD_FAMILIES = ("fill", "existing", "topo_fill", "kscan", "perpod")
 
 
 def _slim_outputs(specs: tuple, flat) -> tuple[list, list]:
@@ -626,6 +638,16 @@ class TPUScheduler:
         self.shard_kscan = os.environ.get("KTPU_SHARD_KSCAN", "1") not in (
             "0", "false"
         )
+        # dp speculation for the stateful families (ISSUE 14):
+        # KTPU_SHARD_EXISTING=0 re-imposes the `no real existing nodes`
+        # eligibility gate on every dp family; KTPU_SHARD_PERPOD=0 opts
+        # per-pod chunk runs (only) back onto the sequential scan
+        self.shard_existing = os.environ.get(
+            "KTPU_SHARD_EXISTING", "1"
+        ) not in ("0", "false")
+        self.shard_perpod = os.environ.get("KTPU_SHARD_PERPOD", "1") not in (
+            "0", "false"
+        )
         self._shard_stats: Optional[dict] = None
         # per-chunk streaming sink (gRPC SolveStream); None in-process
         self._chunk_sink = None
@@ -845,9 +867,17 @@ class TPUScheduler:
         """``_solve_impl`` plus one round-ledger record (obs/ledger.py):
         every solve — device, host fallback, or a raised error — leaves a
         flight-recorder entry unless a ResidentSession is recording the
-        enclosing round itself (``_ledger_suppress``)."""
+        enclosing round itself (``_ledger_suppress``).
+
+        The whole round runs under the observatory's fallback attribution
+        scope: encode/dispatch/decode helpers jitted outside a
+        named_kernel entry point (chunk gathers, fetch preps) attribute
+        their compiles to `solve_round` instead of `anonymous`."""
+        from karpenter_tpu.obs.observatory import kernel_scope
+
         if self._ledger_suppress:
-            return self._solve_impl(pods, existing_nodes, *args, **kwargs)
+            with kernel_scope("solve_round"):
+                return self._solve_impl(pods, existing_nodes, *args, **kwargs)
         import time as _time
 
         from karpenter_tpu.obs import ledger as obs_ledger
@@ -856,7 +886,10 @@ class TPUScheduler:
         n_pods = len(pods) if hasattr(pods, "__len__") else 0
         t0 = _time.perf_counter()
         try:
-            result = self._solve_impl(pods, existing_nodes, *args, **kwargs)
+            with kernel_scope("solve_round"):
+                result = self._solve_impl(
+                    pods, existing_nodes, *args, **kwargs
+                )
         except BaseException as err:
             obs_ledger.record_solve(
                 self,
@@ -2020,6 +2053,9 @@ class TPUScheduler:
                 if len(self.encoder.vocab.values[kid_]) <= ops_solver.KSCAN_D:
                     kscan_key[u] = kid_
         kind_records = hgr_np.any(axis=1)  # decode must commit topo counts
+        # per-kind hostname-topology interaction: labels the topo_fill
+        # speculation family in the shard coverage report (ISSUE 14)
+        kind_hg = (hga_np | hgr_np).any(axis=1)
 
         # the [U, T] per-kind allow mask is the one encode output whose
         # trailing axis is the catalog: place it SHARDED over the mesh's
@@ -2047,6 +2083,7 @@ class TPUScheduler:
             gang_key_of_kind=gang_key_of_kind,
             pre_unsched=pre_unsched,
             kind_records=kind_records,
+            kind_hg=kind_hg,
             reps=reps,
             exist_tensors=exist_tensors,
             template_tensors=template_tensors,
@@ -2169,8 +2206,15 @@ class TPUScheduler:
                 "sync_blocked_s": 0.0,
                 "merge_wall_s": 0.0,
                 "families": {
-                    "fill": {"committed": 0, "replayed": 0},
-                    "kscan": {"committed": 0, "replayed": 0},
+                    f: {"committed": 0, "replayed": 0}
+                    for f in _SHARD_FAMILIES
+                },
+                # per-family chunk-group routing coverage (bench
+                # --report-shard): dp = the group entered a speculative
+                # fan-out round (commit OR replay), sequential = it never
+                # left the plain ordered scan
+                "coverage": {
+                    f: {"dp": 0, "sequential": 0} for f in _SHARD_FAMILIES
                 },
             }
             from karpenter_tpu.utils.metrics import SHARD_REPLICATED_BYTES
@@ -2348,11 +2392,14 @@ class TPUScheduler:
         # batches up to DP groups into a single vmapped dispatch against
         # the committed state (one group per dp row) and commits them in
         # order — graft when provably independent, sequential replay
-        # otherwise (see ops/solver.py dp section). Eligibility mirrors
-        # the merge kernel's no-shared-mutable-state contract: no real
-        # existing nodes and a topology-free problem (the fill routing
+        # otherwise (see ops/solver.py dp section). Formerly the gate
+        # required `no real existing nodes` and a topology-free problem;
+        # ISSUE 14 folded both couplings into the verdict word as per-row
+        # deltas with on-device disjointness proofs (existing-node debit
+        # bit, hg record-vs-apply bit), so only the KTPU_SHARD_EXISTING
+        # opt-out re-imposes the existing-node gate. The fill routing
         # itself already guarantees infinite budgets, no reservations and
-        # no enforced minValues for batchable kinds).
+        # no enforced minValues for batchable kinds.
         dp_n = 1
         if self.mesh is not None:
             dp_n = int(dict(self.mesh.shape).get("dp", 1))
@@ -2363,10 +2410,7 @@ class TPUScheduler:
             # a quarantined speculative path runs every group sequentially
             # (the exact twin) until the breaker's TTL expires
             and not QUARANTINE.active("speculative")
-            and not self.existing_nodes
-            and not enc["topo_kids"]
-            and not enc["vg_groups"]
-            and not enc["hg_groups"]
+            and (self.shard_existing or not self.existing_nodes)
         )
         if dp_eligible:
             merged_runs: list = []
@@ -2398,7 +2442,7 @@ class TPUScheduler:
             and self.shard_dp
             and self.shard_kscan
             and not QUARANTINE.active("speculative")
-            and not self.existing_nodes
+            and (self.shard_existing or not self.existing_nodes)
         )
         if kscan_dp_eligible:
             split_k: list = []
@@ -2427,6 +2471,24 @@ class TPUScheduler:
                 else:
                     split_k.append((mode, segs))
             runs = split_k
+        # ---- dp-sharded speculative per-pod runs (ISSUE 14c) -------------
+        # The per-pod engine mutates exactly the ShardKscanState slice on
+        # the fill-routable constraint family (no enforced minValues, no
+        # reservations, infinite budgets — budget adds are identity at
+        # +inf), so consecutive solve_chunk chunks speculate one-per-dp-row
+        # under the same verdict contract (solve_perpod_dp) and merge
+        # through merge_shard_kscan. KTPU_SHARD_PERPOD=0 opts out.
+        perpod_dp_eligible = bool(
+            K_pipe
+            and dp_n > 1
+            and self.shard_dp
+            and self.shard_perpod
+            and not QUARANTINE.active("speculative")
+            and (self.shard_existing or not self.existing_nodes)
+            and not common["mv_active"]
+            and not common["res_active"]
+            and not any(v for v in self.budgets.values())
+        )
 
         outputs: list[tuple] = []
         tmpl_snaps: list = []  # post-dispatch GLOBAL template snapshot per
@@ -2468,6 +2530,7 @@ class TPUScheduler:
                     remaining[k_] -= hi_ - lo_
                 state = _maybe_compact(state)
             elif mode[0] == "fill":
+                self._shard_eligible(self._fill_family(enc, segs), "sequential")
                 state, ys = _dispatch_fill(state, segs)
                 # fill grids address WINDOW rows; the decode maps them to
                 # global claim ids via this dispatch's slot_of snapshot
@@ -2485,6 +2548,7 @@ class TPUScheduler:
                     _maybe_compact, _dispatch_fill,
                 )
             elif mode[0] == "kscan":
+                self._shard_eligible("kscan", "sequential")
                 state, ys = _dispatch_kscan(state, segs, mode[1])
                 outputs.append(("kscan", segs, ys))
                 tmpl_snaps.append(ops_solver.global_template(state))
@@ -2501,26 +2565,41 @@ class TPUScheduler:
                 )
             else:
                 lo, hi = segs[0][0], segs[-1][1]
-                for clo in range(lo, hi, chunk):
-                    L = min(chunk, hi - clo)
-                    # multiple-of-8 bucket instead of pow2: a 1100-pod
-                    # remainder chunk pads to 1104 rows, not 2048
-                    L_pad = self._pad_cache.pad("perpod_pods", L, step=8)
-                    kidx = np.zeros(L_pad, dtype=np.int64)
-                    kidx[:L] = kind_of[clo : clo + L]
-                    pt, tol, it_allow, exist_ok, ports, conf, vols, ptopo = (
-                        self._materialize_pods(enc, kidx, L)
+                chunks = [
+                    (clo, min(clo + chunk, hi))
+                    for clo in range(lo, hi, chunk)
+                ]
+                if perpod_dp_eligible and len(chunks) >= 2:
+                    # `chunks` is a LIST of (lo, hi) pod chunks; the dp
+                    # merge loop appends one ("pods", ...) output per
+                    # chunk, exactly like the sequential loop below would
+                    state = self._run_perpod_dp(
+                        enc, state, chunks, common, outputs, tmpl_snaps,
+                        remaining, _maybe_compact,
                     )
-                    res = ops_solver.solve_from(
-                        state, pt, tol, it_allow, exist_ok, ports, conf, vols,
-                        exist_tensors, self.it_tensors, template_tensors,
-                        self.well_known, topo_tensors, ptopo, **common,
-                    )
-                    state = res.claims
-                    outputs.append(("pods", clo, clo + L, res.assignment))
-                    tmpl_snaps.append(ops_solver.global_template(state))
-                    np.subtract.at(remaining, kind_of[clo : clo + L], 1)
-                    state = _maybe_compact(state)
+                else:
+                    for clo, chi in chunks:
+                        L = chi - clo
+                        self._shard_eligible("perpod", "sequential")
+                        # multiple-of-8 bucket instead of pow2: a 1100-pod
+                        # remainder chunk pads to 1104 rows, not 2048
+                        L_pad = self._pad_cache.pad("perpod_pods", L, step=8)
+                        kidx = np.zeros(L_pad, dtype=np.int64)
+                        kidx[:L] = kind_of[clo:chi]
+                        pt, tol, it_allow, exist_ok, ports, conf, vols, ptopo = (
+                            self._materialize_pods(enc, kidx, L)
+                        )
+                        res = ops_solver.solve_from(
+                            state, pt, tol, it_allow, exist_ok, ports, conf,
+                            vols, exist_tensors, self.it_tensors,
+                            template_tensors, self.well_known, topo_tensors,
+                            ptopo, **common,
+                        )
+                        state = res.claims
+                        outputs.append(("pods", clo, chi, res.assignment))
+                        tmpl_snaps.append(ops_solver.global_template(state))
+                        np.subtract.at(remaining, kind_of[clo:chi], 1)
+                        state = _maybe_compact(state)
             if _trace_on:
                 # per-mode child spans: dispatch cost only — the device
                 # runs async, so the wait shows up under solve.wire
@@ -2612,6 +2691,7 @@ class TPUScheduler:
             SHARD_VERDICT_BYTES.inc(int(vw.nbytes))
             for r in range(n_commit):
                 segs = round_groups[r]
+                family = self._fill_family(enc, segs)
                 spec_r, ys_r = ops_solver.take_dp_row(
                     (spec_states, spec_ys), jnp.int32(r)
                 )
@@ -2620,7 +2700,7 @@ class TPUScheduler:
                 # commit decision (an injected error here degrades the
                 # whole solve via the ladder, never a half-graft)
                 FAULT.point(
-                    "solver.merge.commit", segments=len(segs), family="fill"
+                    "solver.merge.commit", segments=len(segs), family=family
                 )
                 audit = guard_config.should_audit("speculative")
                 seq_twin = None
@@ -2632,7 +2712,7 @@ class TPUScheduler:
                     seq_twin = dispatch_fill(state, segs)
                     jax.block_until_ready(seq_twin[0])
                 state, shifted = ops_solver.merge_shard_fill(
-                    state, spec_r, base.n_open, base.w_open
+                    state, spec_r, base
                 )
                 jax.block_until_ready(state)  # same one-at-a-time rule
                 if audit:
@@ -2640,13 +2720,13 @@ class TPUScheduler:
                         state, segs, seq_twin,
                         ("fill", segs, ys_r, shifted),
                         lambda ss, yy, sg=segs: ("fill", sg, yy, ss.slot_of),
-                        family="fill",
+                        family=family,
                     )
                     outputs.append(commit_out)
                 else:
                     outputs.append(("fill", segs, ys_r, shifted))
-                SHARD_MERGE_ROUNDS.inc(outcome="committed", family="fill")
-                self._shard_account(segs, True, "fill")
+                SHARD_MERGE_ROUNDS.inc(outcome="committed", family=family)
+                self._shard_account(segs, True, family)
                 tmpl_snaps.append(ops_solver.global_template(state))
                 for lo_, hi_, k_ in segs:
                     remaining[k_] -= hi_ - lo_
@@ -2660,11 +2740,12 @@ class TPUScheduler:
                 # a FRESH speculative round from the updated state, so a
                 # single refusal doesn't serialize the whole tail
                 segs = round_groups[n_commit]
+                family = self._fill_family(enc, segs)
                 state, ys_seq = dispatch_fill(state, segs)
                 jax.block_until_ready(state)  # one-at-a-time rule
                 outputs.append(("fill", segs, ys_seq, state.slot_of))
-                SHARD_MERGE_ROUNDS.inc(outcome="replayed", family="fill")
-                self._shard_account(segs, False, "fill")
+                SHARD_MERGE_ROUNDS.inc(outcome="replayed", family=family)
+                self._shard_account(segs, False, family)
                 tmpl_snaps.append(ops_solver.global_template(state))
                 for lo_, hi_, k_ in segs:
                     remaining[k_] -= hi_ - lo_
@@ -2767,8 +2848,7 @@ class TPUScheduler:
                     )
                     jax.block_until_ready(seq_twin[0])
                 state, _shifted, assign = ops_solver.merge_shard_kscan(
-                    state, spec_r, ys_r.assignment, base.n_open,
-                    base.w_open, base.vg_counts, base.hg_counts,
+                    state, spec_r, ys_r.assignment, base
                 )
                 jax.block_until_ready(state)
                 ys_out = ys_r._replace(assignment=assign)
@@ -2810,7 +2890,183 @@ class TPUScheduler:
             stats["merge_wall_s"] += _time.perf_counter() - t_loop0
         return state
 
+    def _run_perpod_dp(
+        self, enc, state, chunks, common, outputs, tmpl_snaps, remaining,
+        maybe_compact,
+    ):
+        """Speculative dp-row execution of consecutive per-pod chunks
+        (ISSUE 14c): same one-verdict-word-per-round merge loop as
+        _run_fill_dp/_run_kscan_dp, with the per-pod engine's chunk scan
+        vmapped one chunk per dp row (solve_perpod_dp) and commits grafted
+        through merge_shard_kscan (window fields + vg/hg deltas +
+        existing-node debits). Refusal replays the one refused chunk via
+        the plain solve_from — either way bit-identical to the sequential
+        chunk loop."""
+        import time as _time
+
+        from karpenter_tpu.faultinject import FAULT
+        from karpenter_tpu.ops.kernels import fetch_tree, leading_ones
+        from karpenter_tpu.utils.metrics import (
+            SHARD_MERGE_ROUNDS, SHARD_VERDICT_BYTES,
+        )
+
+        kind_of = enc["kind_of"]
+        dp_n = int(dict(self.mesh.shape).get("dp", 1))
+        stats = self._shard_stats
+        t_loop0 = _time.perf_counter()
+
+        def dispatch_seq(st, clo, chi):
+            """One sequential per-pod chunk dispatch (the replay and
+            audit-twin rung — the mode loop's plain body)."""
+            L = chi - clo
+            L_pad = self._pad_cache.pad("perpod_pods", L, step=8)
+            kidx = np.zeros(L_pad, dtype=np.int64)
+            kidx[:L] = kind_of[clo:chi]
+            pt, tol, it_allow, exist_ok, ports, conf, vols, ptopo = (
+                self._materialize_pods(enc, kidx, L)
+            )
+            res = ops_solver.solve_from(
+                state if st is None else st, pt, tol, it_allow, exist_ok,
+                ports, conf, vols, enc["exist_tensors"], self.it_tensors,
+                enc["template_tensors"], self.well_known,
+                enc["topo_tensors"], ptopo, **common,
+            )
+            return res.claims, res.assignment
+
+        gi = 0
+        while gi < len(chunks):
+            round_chunks = chunks[gi : gi + dp_n]
+            # same rule as _run_fill_dp: drain in-flight work before the
+            # round's collective-bearing dispatch (a wait, not a fetch)
+            jax.block_until_ready(state)
+            base = state
+            L_max = max(chi - clo for clo, chi in round_chunks)
+            # a short round pads to DP rows with zero valid pods (padding
+            # rows go r_min = +inf and are trivially dead no-ops)
+            L_pad = self._pad_cache.pad("perpod_pods_dp", L_max, step=8)
+            kidx_b = np.zeros((dp_n, L_pad), dtype=np.int64)
+            nval_b = np.zeros((dp_n,), dtype=np.int32)
+            for r, (clo, chi) in enumerate(round_chunks):
+                L = chi - clo
+                kidx_b[r, :L] = kind_of[clo:chi]
+                nval_b[r] = L
+            pt, tol, it_allow, exist_ok, ports, conf, vols, ptopo = (
+                _gather_pod_chunk_dp(
+                    enc["reqs_k"], enc["strict_k"], enc["requests_k"],
+                    enc["tol_k"], enc["it_allow_k"], enc["exist_ok_k"],
+                    enc["ports_k"], enc["conf_k"], enc["vols_k"],
+                    enc["pod_topo_k"], jnp.asarray(kidx_b),
+                    jnp.asarray(nval_b),
+                )
+            )
+            spec_states, spec_assign, verdict = ops_solver.solve_perpod_dp(
+                state, pt, tol, it_allow, exist_ok, ports, conf, vols,
+                enc["exist_tensors"], self.it_tensors,
+                enc["template_tensors"], self.well_known,
+                enc["topo_tensors"], ptopo, **common,
+            )
+            jax.block_until_ready((spec_states, spec_assign, verdict))
+            t_sync = _time.perf_counter()
+            (vw,) = fetch_tree([verdict])
+            vw = np.asarray(vw)
+            n_commit = leading_ones(vw, len(round_chunks))
+            if stats is not None:
+                stats["merge_rounds"] += 1
+                stats["verdict_fetches"] += 1
+                stats["verdict_bytes"] += int(vw.nbytes)
+                stats["sync_blocked_s"] += _time.perf_counter() - t_sync
+            SHARD_VERDICT_BYTES.inc(int(vw.nbytes))
+            for r in range(n_commit):
+                clo, chi = round_chunks[r]
+                segs = [(clo, chi, -1)]
+                spec_r, assign_r = ops_solver.take_dp_row(
+                    (spec_states, spec_assign), jnp.int32(r)
+                )
+                jax.block_until_ready(assign_r)
+                FAULT.point(
+                    "solver.merge.commit", segments=1, family="perpod"
+                )
+                audit = guard_config.should_audit("speculative")
+                seq_twin = None
+                if audit:
+                    # exact twin FIRST, from the same pre-merge committed
+                    # state (one collective computation in flight at a
+                    # time)
+                    seq_twin = dispatch_seq(state, clo, chi)
+                    jax.block_until_ready(seq_twin[0])
+                state, _shifted, assign = ops_solver.merge_shard_kscan(
+                    state, spec_r, assign_r, base
+                )
+                jax.block_until_ready(state)  # same one-at-a-time rule
+                if audit:
+                    state, commit_out = self._audit_shard_merge(
+                        state, segs, seq_twin,
+                        ("pods", clo, chi, assign),
+                        lambda ss, yy, c=clo, h=chi: ("pods", c, h, yy),
+                        family="perpod",
+                    )
+                    outputs.append(commit_out)
+                else:
+                    outputs.append(("pods", clo, chi, assign))
+                SHARD_MERGE_ROUNDS.inc(outcome="committed", family="perpod")
+                self._shard_account(segs, True, "perpod")
+                tmpl_snaps.append(ops_solver.global_template(state))
+                np.subtract.at(remaining, kind_of[clo:chi], 1)
+                state = maybe_compact(state)
+                # snapshot + compact drained before the next dispatch
+                jax.block_until_ready((state, tmpl_snaps[-1]))
+            if n_commit < len(round_chunks):
+                clo, chi = round_chunks[n_commit]
+                state, assign_seq = dispatch_seq(state, clo, chi)
+                jax.block_until_ready(state)  # one-at-a-time rule
+                outputs.append(("pods", clo, chi, assign_seq))
+                SHARD_MERGE_ROUNDS.inc(outcome="replayed", family="perpod")
+                self._shard_account([(clo, chi, -1)], False, "perpod")
+                tmpl_snaps.append(ops_solver.global_template(state))
+                np.subtract.at(remaining, kind_of[clo:chi], 1)
+                state = maybe_compact(state)
+                # snapshot + compact drained before the next dispatch
+                jax.block_until_ready((state, tmpl_snaps[-1]))
+                gi += n_commit + 1
+            else:
+                gi += n_commit
+        if stats is not None:
+            stats["merge_wall_s"] += _time.perf_counter() - t_loop0
+        return state
+
+    def _fill_family(self, enc, segs) -> str:
+        """Speculation-family label of a fill-shaped chunk group:
+        `existing` when the solve carries real existing nodes (the debit
+        bit is then what proves commits safe), else `topo_fill` when any
+        of the group's kinds interacts with a hostname group, else plain
+        `fill`."""
+        if self.existing_nodes:
+            return "existing"
+        kind_hg = enc.get("kind_hg")
+        if kind_hg is not None and any(
+            bool(kind_hg[k]) for _lo, _hi, k in segs
+        ):
+            return "topo_fill"
+        return "fill"
+
+    def _shard_eligible(self, family: str, path: str):
+        """Per-chunk-group routing accounting: `path` is "dp" when the
+        group entered a speculative fan-out round (commit or replay),
+        "sequential" when it stayed on the plain ordered scan. Feeds the
+        ktpu_shard_family_eligible_total counter and the bench
+        --report-shard coverage fractions."""
+        from karpenter_tpu.utils.metrics import SHARD_FAMILY_ELIGIBLE
+
+        SHARD_FAMILY_ELIGIBLE.inc(family=family, path=path)
+        stats = self._shard_stats
+        if stats is not None:
+            cov = stats.setdefault("coverage", {}).setdefault(
+                family, {"dp": 0, "sequential": 0}
+            )
+            cov[path] += 1
+
     def _shard_account(self, segs, committed: bool, family: str):
+        self._shard_eligible(family, "dp")
         stats = self._shard_stats
         if stats is None:
             return

@@ -312,6 +312,17 @@ def leading_ones(packed, n: int) -> int:
     return k
 
 
+def pairwise_commit_ok(conflict: jnp.ndarray) -> jnp.ndarray:
+    """[DP] bool from a [q, r] conflict matrix — row r passes iff no
+    EARLIER row q < r conflicts with it. The shared triangular reduction
+    behind every dp-speculative disjointness bit (vg/hg record-vs-apply,
+    existing-node touch-vs-viable): conflicts at q >= r are ignored
+    because the sequential replay order only ever commits prefixes."""
+    n = conflict.shape[0]
+    qi = jnp.arange(n, dtype=jnp.int32)
+    return jnp.all(~conflict | (qi[:, None] >= qi[None, :]), axis=0)
+
+
 def packed_conflict(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """[...] bool — any(a & b) over the packed trailing axis (the fused
     test half of every port-conflict / volume-overlap check)."""

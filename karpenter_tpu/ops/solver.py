@@ -282,12 +282,16 @@ def _make_step(
     res_vid: int,
     res_active: bool,
     res_strict: bool,
+    annotate: bool = True,
 ):
     """Build the per-pod scan step closure shared by solve/solve_from.
     The claims axis it scans is the ACTIVE WINDOW (W = the carry's hot
     claims axis, read off the state shapes at trace time); n_claims stays
     the GLOBAL claim-space cap (hostname slots, bank width)."""
     NCAP = n_claims
+    # annotate=False inside the dp-batched speculative dispatch (see
+    # _make_fill_step): the leading vmap axis IS the "dp" mesh axis there
+    _hint = shard_hint if annotate else (lambda x, *a: x)
     K = it.reqs.mask.shape[1]
     E = exist.avail.shape[0]
     G = templates.its.shape[0]
@@ -442,7 +446,7 @@ def _make_step(
                 ok &= kernels.per_key_ok_at(it.reqs, comb_t, k)
             return ok
 
-        it_compat = shard_hint(
+        it_compat = _hint(
             jax.lax.cond(any_fallback, _full_compat, _fast_compat), "dp", "it"
         )
         total = state.used + pod_requests[None, :]
@@ -1708,11 +1712,14 @@ def solve_fill(
 #
 # The pipelined fill splits a big solve into ~K chunk groups of whole kind
 # segments. Sequentially, group g's dispatch sees the claims groups 0..g-1
-# opened; the ONLY couplings between fill groups on the dp-eligible
-# problem class (no real existing nodes, topology-free, and — implied by
-# the fill routing itself — infinite budgets, no reservations, no
-# enforced minValues) are (a) water-fills into earlier groups' still-open
-# claims and (b) the global claim-id counter. So:
+# opened; the couplings between fill groups (infinite budgets, no
+# reservations, no enforced minValues are implied by the fill routing
+# itself) are (a) water-fills into earlier groups' still-open claims,
+# (b) the global claim-id counter, (c) existing-node capacity debits and
+# (d) hostname-group counts. (a)/(b) are handled by the deadness + graft
+# machinery below; (c)/(d) (ISSUE 14) by per-row deltas whose
+# disjointness the verdict proves on device (_exist_conflict_ok, the hg
+# record-vs-apply bit) and which merge order-free. So:
 #
 #   * every dp row solves ITS group against the SAME base state in one
 #     batched vmapped dispatch (rows sharded over the mesh's dp axis —
@@ -1736,11 +1743,14 @@ def solve_fill(
 
 
 class ShardFillState(NamedTuple):
-    """The window-row slice + counters of one speculative per-shard fill
-    solve. Bank, existing-node, budget, topology and reservation state are
-    unchanged by construction on the dp-eligible problem class, so they
-    never cross the merge (and the dp dispatch never materializes DP
-    copies of the [NCAP, T] bank)."""
+    """The window-row slice + counters + existing-node debit state +
+    hostname-group counts of one speculative per-shard fill solve. Bank,
+    budget, vg-topology and reservation state are unchanged by
+    construction on the fill-routable problem class, so they never cross
+    the merge (and the dp dispatch never materializes DP copies of the
+    [NCAP, T] bank). Existing-node fields and hg counts DO mutate under
+    real existing nodes / topology-bearing kinds (ISSUE 14); the verdict's
+    disjointness bits prove the per-row deltas merge order-free."""
 
     reqs: ReqSetTensors  # [W, K, V]
     used: jnp.ndarray  # [W, R]
@@ -1754,6 +1764,11 @@ class ShardFillState(NamedTuple):
     n_open: jnp.ndarray  # [] i32
     w_open: jnp.ndarray  # [] i32
     spills: jnp.ndarray  # [] i32
+    exist_reqs: ReqSetTensors  # [E, K, V]
+    exist_used: jnp.ndarray  # [E, R]
+    exist_ports: jnp.ndarray  # [E, NPp]
+    exist_vols: jnp.ndarray  # [E, NVp]
+    hg_counts: jnp.ndarray  # [NGh, E + NCAP + 1]
 
 
 @named_kernel("solve_fill_dp")
@@ -1793,6 +1808,9 @@ def solve_fill_dp(
                 open=st.open, pods=st.pods, slot_of=st.slot_of,
                 claim_ports=st.claim_ports, held=st.held, n_open=st.n_open,
                 w_open=st.w_open, spills=st.spills,
+                exist_reqs=st.exist_reqs, exist_used=st.exist_used,
+                exist_ports=st.exist_ports, exist_vols=st.exist_vols,
+                hg_counts=st.hg_counts,
             ),
             ys,
         )
@@ -1807,13 +1825,28 @@ def solve_fill_dp(
     )
     xs_b = xs_b._replace(it_allow=shard_hint(allow, "dp", None, "it"))
     spec, ys = jax.vmap(one)(xs_b)
+    r_min = _dp_group_r_min(xs_b.count, xs_b.requests)
+    live = (xs_b.count > 0)[:, :, None]
+    # hostname-group disjointness (topology-bearing fill, ISSUE 14): the
+    # fill's only topology reads are hg caps gated on the segment's
+    # applies mask, so record-vs-apply disjointness between rows keeps
+    # every gated count read bitwise-unchanged by earlier commits —
+    # exactly solve_kscan_dp's rule, minus the vg half (batchable kinds
+    # carry no vg interactions by construction)
+    app_h = jnp.any(live & xs_b.hg_applies, axis=1) & topo.hg_valid[None]
+    rec_h = jnp.any(live & xs_b.hg_records, axis=1) & topo.hg_valid[None]
+    hg_ok = kernels.pairwise_commit_ok(
+        jnp.any(rec_h[:, None, :] & app_h[None, :, :], axis=-1)
+    )
+    exist_ok_rows = jnp.any(live & xs_b.exist_ok, axis=1)
+    exist_bit = _exist_conflict_ok(state, spec, exist, exist_ok_rows, r_min)
     verdict = _dp_verdict_word(
-        state, spec, xs_b, n_claims,
+        state, spec, r_min, n_claims,
         lambda u, iv, om, rm: _rows_dead(u, iv, om, it, rm),
         touched=jax.vmap(lambda fc: fill_touched_below(fc, state.w_open))(
             ys.fill_c
         ),
-        extra_ok=jnp.sum(ys.leftover, axis=1) == 0,
+        extra_ok=(jnp.sum(ys.leftover, axis=1) == 0) & hg_ok & exist_bit,
     )
     return spec, ys, verdict
 
@@ -1843,7 +1876,52 @@ def _dp_group_r_min(count, requests):
     )
 
 
-def _dp_verdict_word(state, spec, xs_b, n_claims, rows_dead, touched, extra_ok):
+def _exist_touched(spec, base):
+    """[E] bool — existing nodes whose state a speculative row mutated,
+    detected as ANY field delta vs the round base (used debits, narrowed
+    requirements, port claims, volume attaches). Zero-delta landings are
+    genuinely commutative — a pod that changes no existing-node field
+    cannot change any later row's evaluation of that node — so the delta
+    mask is exactly the set of nodes whose merge order matters."""
+
+    def diff(a, b):
+        return jnp.any(
+            a != b, axis=tuple(range(1, a.ndim))
+        )
+
+    d = diff(spec.exist_used, base.exist_used)
+    d |= diff(spec.exist_ports, base.exist_ports)
+    d |= diff(spec.exist_vols, base.exist_vols)
+    for f in ("mask", "inf", "excl", "gte", "lte", "defined"):
+        d |= diff(getattr(spec.exist_reqs, f), getattr(base.exist_reqs, f))
+    return d
+
+
+def _exist_conflict_ok(state, spec, exist, exist_ok_rows, r_min):
+    """[DP] bool — the existing-node debit disjointness bit (ISSUE 14a).
+    Row r commits past row q only when no node q TOUCHED (field delta vs
+    the round base) is VIABLE for r. Viability is the conservative
+    superset `valid & static-exist_ok & capacity(base used, r_min) > 0`:
+    _count_cap_seq's total-based pass rule is monotone decreasing in both
+    the request (every pod of r requests >= r_min) and the used vector
+    (post-commit used >= base used), and the remaining per-node gates
+    (compat, ports, volumes, hg caps) only narrow — so a node non-viable
+    at the base yields capacity 0 / infeasible in BOTH the speculative
+    and the sequential world, making r's per-node evaluation bitwise
+    identical on every node it could possibly use. Disjoint touch sets
+    then merge order-free as whole-field grafts (_graft_exist_fields)."""
+    touched = jax.vmap(_exist_touched, in_axes=(0, None))(spec, state)
+    cap = jax.vmap(
+        lambda rm: _count_cap_seq(state.exist_used, rm[None, :], exist.avail)
+    )(r_min)  # [DP, E]
+    viable = exist.valid[None, :] & exist_ok_rows & (cap > 0)
+    conflict = jnp.any(
+        touched[:, None, :] & viable[None, :, :], axis=-1
+    )  # [q, r]
+    return kernels.pairwise_commit_ok(conflict)
+
+
+def _dp_verdict_word(state, spec, r_min, n_claims, rows_dead, touched, extra_ok):
     """[lanes] uint32 — the packed per-round commit verdict, every check
     on device (ISSUE 13 rung 1: no per-group scalar probes). Row r's bit
     is set iff r and every row before it pass ALL commit conditions:
@@ -1854,7 +1932,11 @@ def _dp_verdict_word(state, spec, xs_b, n_claims, rows_dead, touched, extra_ok):
         earlier row q < r (the cross check: those rows are exactly what
         the sequential solve would have committed before r);
       * r touched no pre-base window row (touched) and passes the
-        family extra (fill: zero leftovers);
+        family extra (fill: zero leftovers; all families: the vg/hg
+        record-vs-apply and existing-node debit disjointness bits);
+        r_min is the caller's [DP, R] per-row elementwise-min request
+        (_dp_group_r_min for segment scans, a valid-masked min for the
+        per-pod family);
       * r's spill counter is unchanged, and the cumulative window/
         claim-axis graft offsets stay in bounds (conservative under
         mid-prefix compaction, which only shrinks w_open).
@@ -1865,7 +1947,6 @@ def _dp_verdict_word(state, spec, xs_b, n_claims, rows_dead, touched, extra_ok):
     DP = spec.w_open.shape[0]
     W = state.open.shape[0]
     rows = jnp.arange(W, dtype=jnp.int32)
-    r_min = _dp_group_r_min(xs_b.count, xs_b.requests)
     opened_rows = (
         (rows[None, :] >= state.w_open)
         & (rows[None, :] < spec.w_open[:, None])
@@ -1979,24 +2060,74 @@ def _graft_window_fields(committed, spec, base_n_open, base_w_open):
     return fields, shifted, delta
 
 
+def _graft_exist_fields(committed, spec, base):
+    """Existing-node debit merge: whole-field graft of every node the
+    spec row touched (field delta vs the ROUND base). The verdict's
+    debit-disjointness bit proves touch sets are pairwise disjoint across
+    the committed prefix and untouched by the base-viability of later
+    rows, so per-node where-grafts compose order-free and equal the
+    sequential replay bit-for-bit."""
+    touched = _exist_touched(spec, base)
+    return dict(
+        exist_reqs=kernels.select_set(
+            touched, spec.exist_reqs, committed.exist_reqs
+        ),
+        exist_used=jnp.where(
+            touched[:, None], spec.exist_used, committed.exist_used
+        ),
+        exist_ports=jnp.where(
+            touched[:, None], spec.exist_ports, committed.exist_ports
+        ),
+        exist_vols=jnp.where(
+            touched[:, None], spec.exist_vols, committed.exist_vols
+        ),
+    )
+
+
+def _merge_hg_delta(committed, spec_hg, base, delta, spec_n_open):
+    """Hostname-group count merge shared by every speculative family:
+    existing-node columns [0, E) add their deltas in place (those slots
+    are global, no id shift), fresh-claim columns shift by the claim-id
+    delta before adding — the same id isomorphism the window graft
+    applies to slot_of. Committed rows' recorded groups are pairwise
+    disjoint from later rows' applied groups (verdict), so the adds are
+    order-free."""
+    E = committed.exist_used.shape[0]
+    S = committed.hg_counts.shape[1]
+    base_n = jnp.asarray(base.n_open, dtype=jnp.int32)
+    cols = jnp.arange(S, dtype=jnp.int32)
+    src_c = jnp.clip(cols - delta, 0, S - 1)
+    dh = spec_hg - base.hg_counts
+    in_rng = (cols - delta >= E + base_n) & (cols - delta < E + spec_n_open)
+    return (
+        committed.hg_counts
+        + jnp.where(in_rng[None, :], jnp.take(dh, src_c, axis=1), 0)
+        + jnp.where((cols < E)[None, :], dh, 0)
+    )
+
+
 @jax.jit
 def merge_shard_fill(
     committed: SolverState,
     spec: ShardFillState,
-    base_n_open: jnp.ndarray,
-    base_w_open: jnp.ndarray,
+    base: SolverState,
 ) -> tuple[SolverState, jnp.ndarray]:
     """Graft a committed speculative fill group onto the committed state.
     Exact under the commit conditions (window_live_dead for the group,
-    zero leftovers/spills, no window or claim-axis overflow), which the
-    verdict word proves BEFORE the host dispatches this. Returns
+    zero leftovers/spills, hg record-vs-apply + existing-node debit
+    disjointness, no window or claim-axis overflow), which the verdict
+    word proves BEFORE the host dispatches this. `base` is the ROUND
+    base state every row of the dispatch speculated from — the reference
+    the exist/hg deltas are taken against. Returns
     (merged, shifted_slot_map): the spec dispatch's window->global map
     re-based into committed ids, i.e. the decode's slot snapshot for the
     group's fill grids."""
-    fields, shifted, _ = _graft_window_fields(
-        committed, spec, base_n_open, base_w_open
+    fields, shifted, delta = _graft_window_fields(
+        committed, spec, base.n_open, base.w_open
     )
-    return committed._replace(**fields), shifted
+    exist_fields = _graft_exist_fields(committed, spec, base)
+    hg = _merge_hg_delta(committed, spec.hg_counts, base, delta, spec.n_open)
+    return committed._replace(hg_counts=hg, **exist_fields, **fields), shifted
 
 
 # ---------------------------------------------------------------------------
@@ -2992,10 +3123,11 @@ def solve_kind_scan(
 
 
 class ShardKscanState(NamedTuple):
-    """The window-row slice + counters + topology counts of one
-    speculative per-shard kscan solve. Bank, existing-node, budget and
-    reservation state are unchanged by construction on the dp-eligible
-    kscan class, so they never cross the merge."""
+    """The window-row slice + counters + topology counts + existing-node
+    debit state of one speculative per-shard kscan OR per-pod solve
+    (solve_perpod_dp reuses this slice and merge_shard_kscan wholesale).
+    Bank, budget and reservation state are unchanged by construction on
+    the dp-routable classes, so they never cross the merge."""
 
     reqs: ReqSetTensors  # [W, K, V]
     used: jnp.ndarray  # [W, R]
@@ -3011,6 +3143,23 @@ class ShardKscanState(NamedTuple):
     spills: jnp.ndarray  # [] i32
     vg_counts: jnp.ndarray  # [NGv, V]
     hg_counts: jnp.ndarray  # [NGh, E + NCAP + 1]
+    exist_reqs: ReqSetTensors  # [E, K, V]
+    exist_used: jnp.ndarray  # [E, R]
+    exist_ports: jnp.ndarray  # [E, NPp]
+    exist_vols: jnp.ndarray  # [E, NVp]
+
+
+def _shard_kscan_slice(st: SolverState) -> ShardKscanState:
+    """The spec-state slice shared by solve_kscan_dp and solve_perpod_dp."""
+    return ShardKscanState(
+        reqs=st.reqs, used=st.used, its=st.its, template=st.template,
+        open=st.open, pods=st.pods, slot_of=st.slot_of,
+        claim_ports=st.claim_ports, held=st.held, n_open=st.n_open,
+        w_open=st.w_open, spills=st.spills, vg_counts=st.vg_counts,
+        hg_counts=st.hg_counts, exist_reqs=st.exist_reqs,
+        exist_used=st.exist_used, exist_ports=st.exist_ports,
+        exist_vols=st.exist_vols,
+    )
 
 
 def _kscan_rows_dead(used, its, open_mask, it, r_min, key_kid, zone_kid, D):
@@ -3078,16 +3227,7 @@ def solve_kscan_dp(
             jnp.bool_(False),
         )
         (st, _grid, _req, _valid), ys = jax.lax.scan(step, carry0, xs)
-        return (
-            ShardKscanState(
-                reqs=st.reqs, used=st.used, its=st.its, template=st.template,
-                open=st.open, pods=st.pods, slot_of=st.slot_of,
-                claim_ports=st.claim_ports, held=st.held, n_open=st.n_open,
-                w_open=st.w_open, spills=st.spills, vg_counts=st.vg_counts,
-                hg_counts=st.hg_counts,
-            ),
-            ys,
-        )
+        return _shard_kscan_slice(st), ys
 
     allow = xs_b.it_allow
     xs_b = jax.tree_util.tree_map(
@@ -3113,16 +3253,17 @@ def solve_kscan_dp(
         jnp.any(rec_v[:, None, :] & app_v[None, :, :], axis=-1)
         | jnp.any(rec_h[:, None, :] & app_h[None, :, :], axis=-1)
     )  # [q, r]
-    DP = spec.w_open.shape[0]
-    qi = jnp.arange(DP, dtype=jnp.int32)
-    topo_ok = jnp.all(~conflict | (qi[:, None] >= qi[None, :]), axis=0)
+    topo_ok = kernels.pairwise_commit_ok(conflict)
+    r_min = _dp_group_r_min(xs_b.count, xs_b.requests)
+    exist_ok_rows = jnp.any(live & xs_b.exist_ok, axis=1)
+    exist_bit = _exist_conflict_ok(state, spec, exist, exist_ok_rows, r_min)
     verdict = _dp_verdict_word(
-        state, spec, xs_b, n_claims,
+        state, spec, r_min, n_claims,
         lambda u, iv, om, rm: _kscan_rows_dead(
             u, iv, om, it, rm, key_kid, zone_kid, n_domains
         ),
         touched=touched,
-        extra_ok=topo_ok,
+        extra_ok=topo_ok & exist_bit,
     )
     return spec, ys, verdict
 
@@ -3131,34 +3272,140 @@ def solve_kscan_dp(
 def merge_shard_kscan(
     committed: SolverState,
     spec: ShardKscanState,
-    assignment: jnp.ndarray,  # [B, MAXC] i32 — the row's KindYs slots
-    base_n_open: jnp.ndarray,
-    base_w_open: jnp.ndarray,
-    base_vg: jnp.ndarray,  # [NGv, V] — round-base vg_counts
-    base_hg: jnp.ndarray,  # [NGh, S] — round-base hg_counts
+    assignment: jnp.ndarray,  # [B, MAXC] / [L] i32 — the row's slots
+    base: SolverState,  # the ROUND base every row speculated from
 ) -> tuple[SolverState, jnp.ndarray, jnp.ndarray]:
-    """Graft a committed speculative kscan group: the shared window graft
-    plus the topology count merge — vg deltas add (order-free sums over
-    disjoint-by-verdict groups), hg deltas shift their fresh-claim
-    columns by the claim-id delta before adding, and the group's
-    assignment slots >= E + base_n_open re-base by the same delta.
-    Returns (merged, shifted_slot_map, shifted_assignment)."""
+    """Graft a committed speculative kscan (or per-pod) group: the shared
+    window graft plus the topology count merge — vg deltas add
+    (order-free sums over disjoint-by-verdict groups), hg deltas add in
+    place on the existing-node columns [0, E) and shift their
+    fresh-claim columns by the claim-id delta before adding
+    (_merge_hg_delta) — plus the existing-node debit graft
+    (_graft_exist_fields, whole-field per touched node). The group's
+    assignment slots >= E + base.n_open re-base by the claim-id delta;
+    existing-node assignments (< E) and the NO_ROOM/NO_CLAIM sentinels
+    (< 0) pass through. Returns (merged, shifted_slot_map,
+    shifted_assignment)."""
     fields, shifted, delta = _graft_window_fields(
-        committed, spec, base_n_open, base_w_open
+        committed, spec, base.n_open, base.w_open
     )
     E = committed.exist_used.shape[0]
-    S = committed.hg_counts.shape[1]
-    base_n = jnp.asarray(base_n_open, dtype=jnp.int32)
-    vg = committed.vg_counts + (spec.vg_counts - base_vg)
-    cols = jnp.arange(S, dtype=jnp.int32)
-    src_c = jnp.clip(cols - delta, 0, S - 1)
-    dh = spec.hg_counts - base_hg
-    in_rng = (cols - delta >= E + base_n) & (cols - delta < E + spec.n_open)
-    hg = committed.hg_counts + jnp.where(
-        in_rng[None, :], jnp.take(dh, src_c, axis=1), 0
-    )
+    base_n = jnp.asarray(base.n_open, dtype=jnp.int32)
+    vg = committed.vg_counts + (spec.vg_counts - base.vg_counts)
+    hg = _merge_hg_delta(committed, spec.hg_counts, base, delta, spec.n_open)
+    exist_fields = _graft_exist_fields(committed, spec, base)
     assign = jnp.where(
         assignment >= E + base_n, assignment + delta, assignment
     )
-    merged = committed._replace(vg_counts=vg, hg_counts=hg, **fields)
+    merged = committed._replace(
+        vg_counts=vg, hg_counts=hg, **exist_fields, **fields
+    )
     return merged, shifted, assign
+
+
+# ---------------------------------------------------------------------------
+# dp-sharded speculative per-pod scan (ISSUE 14c): hostname-anti-affinity
+# and every other per-pod-routed kind joins the speculative dp fan-out
+# ---------------------------------------------------------------------------
+#
+# The per-pod engine is the most general dispatch, but on the
+# perpod-dp-routable class (no enforced minValues, no reservations,
+# infinite budgets — the same host gates that route kinds to the fill)
+# its step mutates exactly the ShardKscanState slice: window rows,
+# counters, vg/hg counts and existing-node fields (the budget adds are
+# identity at +inf, bank and reservation fields pass through untouched).
+# So one chunk of the per-pod scan per dp row speculates against the
+# round base under the SAME commit conditions as the kscan family —
+# window deadness for the chunk's valid-min request, pods-touched,
+# vg/hg record-vs-apply disjointness, existing-node debit disjointness —
+# and commits through merge_shard_kscan unchanged (hostname-group deltas
+# shift their fresh columns, add in place on [0, E)).
+
+
+@named_kernel("solve_perpod_dp")
+@functools.partial(jax.jit, static_argnames=_STATIC)
+def solve_perpod_dp(
+    state: SolverState,
+    pods: PodTensors,  # leading [DP] chunk axis on every tensor
+    pod_tmpl_ok: jnp.ndarray,  # [DP, L, G]
+    pod_it_allow: jnp.ndarray,  # [DP, L, T]
+    pod_exist_ok: jnp.ndarray,  # [DP, L, E]
+    pod_ports: jnp.ndarray,  # [DP, L, NP]
+    pod_port_conf: jnp.ndarray,  # [DP, L, NP]
+    pod_vols: jnp.ndarray,  # [DP, L, NV]
+    exist: ExistingNodes,
+    it: InstanceTypeTensors,
+    templates: Templates,
+    well_known: jnp.ndarray,
+    topo: TopologyTensors,
+    pod_topo: PodTopology,  # leading [DP] on every tensor
+    zone_kid: int,
+    ct_kid: int,
+    n_claims: int,
+    mv_active: bool = False,
+    topo_kids: tuple = (),
+    rid_kid: int = -1,
+    res_vid: int = -1,
+    res_active: bool = False,
+    res_strict: bool = False,
+    window: int = 0,  # unused here: the carry's shapes define the window
+) -> tuple[ShardKscanState, jnp.ndarray, jnp.ndarray]:
+    """Speculative dp fan-out for per-pod chunks: every dp row scans ITS
+    pod chunk against the same base state with the per-pod step
+    (annotate=False — the leading vmap axis is the dp mesh axis), under
+    the packed commit-verdict contract shared with solve_fill_dp /
+    solve_kscan_dp. Padding rows (valid all-false) go r_min = +inf and
+    are trivially dead, so short rounds commit as no-ops. Returns
+    (per-row ShardKscanState, per-row assignment, verdict word)."""
+    step = _make_step(
+        exist, it, templates, well_known, topo, zone_kid, ct_kid, n_claims,
+        mv_active, topo_kids, rid_kid, res_vid, res_active, res_strict,
+        annotate=False,
+    )
+
+    def one(xs):
+        st, assignment = jax.lax.scan(step, state, xs)
+        return _shard_kscan_slice(st), assignment
+
+    xs_b = _xs(
+        pods, pod_tmpl_ok, pod_it_allow, pod_exist_ok, pod_ports,
+        pod_port_conf, pod_topo, pod_vols,
+    )
+    # the [DP, L, T] allow mask keeps its catalog axis on "it" (the same
+    # split solve_fill_dp/solve_kscan_dp use) so GSPMD doesn't fully
+    # rematerialize the tensor flipping between placements
+    allow = pod_it_allow
+    xs_b = jax.tree_util.tree_map(
+        lambda a: a if a is allow else shard_hint(a, "dp"), xs_b
+    )
+    xs_b = xs_b[:3] + (shard_hint(allow, "dp", None, "it"),) + xs_b[4:]
+    spec, assignment = jax.vmap(one)(xs_b)
+
+    W = state.open.shape[0]
+    W_rows = jnp.arange(W, dtype=jnp.int32)
+    touched = jnp.any(
+        (spec.pods > state.pods[None, :]) & (W_rows < state.w_open)[None, :],
+        axis=-1,
+    )
+    valid = pods.valid[:, :, None]  # [DP, L, 1]
+    r_min = jnp.min(
+        jnp.where(valid, pods.requests, jnp.inf), axis=1
+    )  # [DP, R]
+    app_v = jnp.any(valid & pod_topo.vg_applies, axis=1) & topo.vg_valid[None]
+    rec_v = jnp.any(valid & pod_topo.vg_records, axis=1) & topo.vg_valid[None]
+    app_h = jnp.any(valid & pod_topo.hg_applies, axis=1) & topo.hg_valid[None]
+    rec_h = jnp.any(valid & pod_topo.hg_records, axis=1) & topo.hg_valid[None]
+    conflict = (
+        jnp.any(rec_v[:, None, :] & app_v[None, :, :], axis=-1)
+        | jnp.any(rec_h[:, None, :] & app_h[None, :, :], axis=-1)
+    )  # [q, r]
+    topo_ok = kernels.pairwise_commit_ok(conflict)
+    exist_ok_rows = jnp.any(valid & pod_exist_ok, axis=1)
+    exist_bit = _exist_conflict_ok(state, spec, exist, exist_ok_rows, r_min)
+    verdict = _dp_verdict_word(
+        state, spec, r_min, n_claims,
+        lambda u, iv, om, rm: _rows_dead(u, iv, om, it, rm),
+        touched=touched,
+        extra_ok=topo_ok & exist_bit,
+    )
+    return spec, assignment, verdict

@@ -462,14 +462,25 @@ GANG_WAIT_DURATION = REGISTRY.histogram(
 # ---- dp-sharded mesh solve (PR 8) ----
 SHARD_MERGE_ROUNDS = REGISTRY.counter(
     "ktpu_shard_merge_rounds_total",
-    "dp-shard chunk-group merge outcomes by solver family (fill | kscan):"
-    " committed (the on-device verdict proved the speculative per-shard"
-    " solve independent of the committed claims — deadness held, zero"
-    " leftovers/spills, no window or claim-axis overflow, and for kscan"
-    " no topology record/apply overlap — and it grafted exactly) vs"
-    " replayed (a verdict bit was unset and the group re-dispatched"
+    "dp-shard chunk-group merge outcomes by solver family (fill |"
+    " existing | topo_fill | kscan | perpod): committed (the on-device"
+    " verdict proved the speculative per-shard solve independent of the"
+    " committed claims — deadness held, zero leftovers/spills, no window"
+    " or claim-axis overflow, no topology record/apply overlap, and"
+    " disjoint existing-node debit touch sets — and it grafted exactly)"
+    " vs replayed (a verdict bit was unset and the group re-dispatched"
     " sequentially; bit-parity holds either way)",
     ("outcome", "family"),
+)
+SHARD_FAMILY_ELIGIBLE = REGISTRY.counter(
+    "ktpu_shard_family_eligible_total",
+    "Chunk groups routed per solver family (fill | existing | topo_fill |"
+    " kscan | perpod): path=dp when the group entered a speculative merge"
+    " round (committed or replayed — either way it rode the fan-out),"
+    " path=sequential when eligibility gating (mesh size, env opt-outs,"
+    " quarantine, movement/reservation/budget activity) kept it on the"
+    " ordered scan; the ratio is the measured speculation coverage",
+    ("family", "path"),
 )
 SHARD_VERDICT_BYTES = REGISTRY.counter(
     "ktpu_shard_verdict_bytes_total",

@@ -2,9 +2,9 @@
 
 One subprocess (pattern: tests/test_compile_cache.py restart child) forces
 XLA_FLAGS=--xla_force_host_platform_device_count=8 + the KTPU_MESH=2x4
-env override, then pins small fill / kscan / perpod solves on the
-(dp × it) mesh bit-identical to the single-device solve AND the host
-oracle, windowed and un-windowed. The in-process dp-merge differential
+env override, then pins small fill / kscan / topology-bearing /
+existing-node / per-pod solves on the (dp × it) mesh bit-identical to the
+single-device solve AND the host oracle, windowed and un-windowed. The in-process dp-merge differential
 suite lives in tests/test_shard.py; this twin proves the same parity
 holds under a cold backend with the mesh built purely from env knobs
 (the deployment configuration the solver server uses).
@@ -14,6 +14,8 @@ import json
 import os
 import subprocess
 import sys
+
+import pytest
 
 _CHILD = r"""
 import os, json
@@ -32,7 +34,7 @@ from karpenter_tpu.controllers.provisioning.host_scheduler import HostScheduler
 from karpenter_tpu.controllers.provisioning.topology import Topology, build_universe_domains
 from karpenter_tpu.models import labels as l
 from karpenter_tpu.models.nodepool import NodePool
-from karpenter_tpu.models.pod import PodAffinityTerm, TopologySpreadConstraint, make_pod
+from karpenter_tpu.models.pod import TopologySpreadConstraint, make_pod
 from karpenter_tpu.parallel import make_mesh
 
 N_TYPES = 24  # >= 12 so every kind (incl. the 2-cpu saturating ones) schedules
@@ -80,18 +82,80 @@ def kscan_dp_pods():
         pods.append(p)
     return pods
 
-def perpod_pods():
-    pods = fill_pods()[:64]
-    for i in range(24):
-        p = make_pod(f"h-{i}", cpu=0.5, memory="0.5Gi")
-        p.metadata.labels = {"app": "web"}
-        p.spec.pod_anti_affinity = [PodAffinityTerm(
-            topology_key=l.LABEL_HOSTNAME, label_selector={"app": "web"})]
+def topo_pods():
+    # hostname-spread kinds with DISJOINT selectors: hg interaction but no
+    # vg interaction keeps them batchable (the fill route), so they ride
+    # the topo_fill speculation family; saturating sizes let groups commit
+    pods = []
+    for i in range(96):
+        k = i // 24
+        p = make_pod(f"t-{i}", cpu=2.0, memory="1Gi")
+        p.metadata.labels = {"grp": str(k), "hspread": f"h{k}"}
+        p.spec.topology_spread_constraints = [TopologySpreadConstraint(
+            max_skew=1, topology_key=l.LABEL_HOSTNAME,
+            label_selector={"hspread": f"h{k}"})]
         pods.append(p)
     return pods
 
-def host_solve(pods):
+def existing_pods():
+    # saturating kinds solved AGAINST real existing nodes: the dp rows
+    # carry per-existing-node debit deltas and the disjoint-touch verdict
+    # bit lets later rounds commit once the nodes fill (ISSUE 14)
+    pods = []
+    for i in range(96):
+        p = make_pod(f"e-{i}", cpu=2.0, memory="1Gi")
+        p.metadata.labels = {"grp": str(i // 24)}
+        pods.append(p)
+    return pods
+
+def make_existing_nodes():
+    from karpenter_tpu.controllers.provisioning.host_scheduler import ExistingSimNode
+    from karpenter_tpu.scheduling import Requirements
+    from karpenter_tpu.utils import resources as res
+    nodes = []
+    for i in range(2):
+        name = f"exist-{i}"
+        labels = {
+            l.LABEL_TOPOLOGY_ZONE: "test-zone-1",
+            l.LABEL_INSTANCE_TYPE: "s-4x-amd64",
+            l.CAPACITY_TYPE_LABEL_KEY: l.CAPACITY_TYPE_ON_DEMAND,
+            l.LABEL_ARCH: l.ARCH_AMD64,
+            l.LABEL_OS: "linux",
+            l.LABEL_HOSTNAME: name,
+            l.NODEPOOL_LABEL_KEY: "default",
+        }
+        nodes.append(ExistingSimNode(
+            name=name, index=i,
+            requirements=Requirements.from_labels(labels),
+            available={res.CPU: 4.0, res.MEMORY: float(8 * 2**30),
+                       res.PODS: 50.0},
+        ))
+    return nodes
+
+def perpod_pods():
+    # TWO distinct vg keys per kind (zone + capacity-type spread) defeat
+    # the single-key kscan check, so the run takes the per-pod scan —
+    # solve_perpod_dp speculates one 64-pod chunk per dp row (ISSUE 14)
+    pods = []
+    for i in range(128):
+        k = i // 64
+        p = make_pod(f"pp-{i}", cpu=2.0, memory="1Gi")
+        p.metadata.labels = {"grp": str(k), "spread": f"p{k}"}
+        p.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(
+                max_skew=1, topology_key=l.LABEL_TOPOLOGY_ZONE,
+                label_selector={"spread": f"p{k}"}),
+            TopologySpreadConstraint(
+                max_skew=1, topology_key=l.CAPACITY_TYPE_LABEL_KEY,
+                label_selector={"spread": f"p{k}"}),
+        ]
+        pods.append(p)
+    return pods
+
+def host_solve(pods, existing=None):
     templates = make_templates()
+    if existing:
+        return HostScheduler(templates, existing_nodes=existing).solve(list(pods))
     topo = Topology.build(list(pods), build_universe_domains(templates, []), [])
     return HostScheduler(templates, topology=topo).solve(list(pods))
 
@@ -112,6 +176,7 @@ def identical(a, b):
 def matches_host(host, dev):
     if len(host.claims) != len(dev.claims): return "n_claims"
     if host.assignments != dev.assignments: return "assignments"
+    if host.existing_assignments != dev.existing_assignments: return "existing"
     for slot, hc in {c.slot: c for c in host.claims}.items():
         tc = {c.slot: c for c in dev.claims}[slot]
         if [p.uid for p in hc.pods] != [p.uid for p in tc.pods]: return "pods"
@@ -123,37 +188,56 @@ def matches_host(host, dev):
 
 mesh = make_mesh()  # KTPU_MESH=2x4 from env
 out = {"mesh": dict((k, int(v)) for k, v in mesh.shape.items())}
-cases = [("fill", fill_pods()), ("kscan", kscan_pods()),
-         ("kscan_dp", kscan_dp_pods()), ("perpod", perpod_pods())]
-for name, pods in cases:
-    # kscan_dp runs un-windowed only: the windowed kscan-dp rung is pinned
-    # in-process by tests/test_shard.py, and every extra (case, window)
-    # pair recompiles the whole dp executable set in this cold child
-    for window in ((0,) if name == "kscan_dp" else (0, 48)):
+cases = [("fill", fill_pods(), None), ("kscan", kscan_pods(), None),
+         ("kscan_dp", kscan_dp_pods(), None), ("topo", topo_pods(), None),
+         ("existing", existing_pods(), make_existing_nodes),
+         ("perpod", perpod_pods(), None)]
+only = os.environ.get("KTPU_PARITY_CASES")
+if only:
+    keep = set(only.split(","))
+    cases = [c for c in cases if c[0] in keep]
+for name, pods, exist_fn in cases:
+    # the ISSUE-13/14 dp cases run un-windowed only: the windowed rungs
+    # are pinned in-process by tests/test_shard.py, and every extra
+    # (case, window) pair recompiles the whole dp executable set in this
+    # cold child
+    windows = (0, 48) if name in ("fill", "kscan") else (0,)
+    # the per-pod family splits on KTPU_SOLVE_CHUNK (read at scheduler
+    # construction): 64 gives 128 pods -> 2 speculative dp rows
+    if name == "perpod":
+        os.environ["KTPU_SOLVE_CHUNK"] = "64"
+    else:
+        os.environ.pop("KTPU_SOLVE_CHUNK", None)
+    for window in windows:
         if window:
             os.environ["KTPU_SCAN_WINDOW"] = str(window)
         else:
             os.environ.pop("KTPU_SCAN_WINDOW", None)
         meshed_sched = TPUScheduler(make_templates(), mesh=mesh)
-        meshed = meshed_sched.solve(list(pods))
-        single = TPUScheduler(make_templates()).solve(list(pods))
+        meshed = meshed_sched.solve(list(pods), exist_fn() if exist_fn else [])
+        single = TPUScheduler(make_templates()).solve(
+            list(pods), exist_fn() if exist_fn else [])
         rec = {
             "diff": identical(meshed, single),
-            "host_diff": matches_host(host_solve(pods), meshed),
+            "host_diff": matches_host(
+                host_solve(pods, exist_fn() if exist_fn else None), meshed),
             "claims": len(meshed.claims),
         }
         shard = (meshed_sched.last_timings or {}).get("shard") or {}
         rec["merge_rounds"] = shard.get("merge_rounds", 0)
         rec["committed"] = shard.get("groups_committed", 0)
         rec["replayed"] = shard.get("groups_replayed", 0)
+        rec["families"] = {
+            f: s["committed"] for f, s in shard.get("families", {}).items()}
         out[f"{name}_w{window}"] = rec
 print(json.dumps(out))
 """
 
 
-def test_sharded_solves_bit_identical_in_fresh_backend(tmp_path):
+def _run_child(case_names):
     env = dict(os.environ)
     env["KTPU_MESH"] = "2x4"
+    env["KTPU_PARITY_CASES"] = ",".join(case_names)
     env.pop("KTPU_SCAN_WINDOW", None)
     out = subprocess.run(
         [sys.executable, "-c", _CHILD],
@@ -171,6 +255,11 @@ def test_sharded_solves_bit_identical_in_fresh_backend(tmp_path):
         assert rec["diff"] == "", f"{case}: meshed != single-device ({rec['diff']})"
         assert rec["host_diff"] == "", f"{case}: meshed != host oracle ({rec['host_diff']})"
         assert rec["claims"] >= 1, case
+    return res
+
+
+def test_sharded_solves_bit_identical_in_fresh_backend(tmp_path):
+    res = _run_child(["fill", "kscan", "kscan_dp"])
     # the fill cases must actually exercise the dp merge loop, and the
     # saturating kinds must commit at least one speculative graft
     assert res["fill_w0"]["merge_rounds"] >= 1
@@ -180,7 +269,18 @@ def test_sharded_solves_bit_identical_in_fresh_backend(tmp_path):
     # and commit speculative grafts (ISSUE 13)
     assert res["kscan_dp_w0"]["merge_rounds"] >= 1
     assert res["kscan_dp_w0"]["committed"] >= 1, res["kscan_dp_w0"]
-    # a single-kind kscan run has nothing to split into speculative
-    # groups, and per-pod (hostname anti-affinity) kinds stay sequential
+    # a single-kind kscan run has nothing to split into speculative groups
     assert res["kscan_w0"]["merge_rounds"] == 0
-    assert res["perpod_w0"]["merge_rounds"] == 0
+
+
+@pytest.mark.slow
+def test_stateful_families_bit_identical_in_fresh_backend(tmp_path):
+    """The three ISSUE 14 families in a cold backend: hostname-spread
+    (topology-BEARING) fill, real existing nodes (per-node debit deltas,
+    parity incl. existing_assignments vs the HostScheduler oracle) and
+    the per-pod dp fan-out — each commits at least one speculative
+    round."""
+    res = _run_child(["topo", "existing", "perpod"])
+    assert res["topo_w0"]["families"].get("topo_fill", 0) >= 1, res["topo_w0"]
+    assert res["existing_w0"]["families"].get("existing", 0) >= 1, res["existing_w0"]
+    assert res["perpod_w0"]["families"].get("perpod", 0) >= 1, res["perpod_w0"]

@@ -175,6 +175,7 @@ def test_shard_families_are_registered():
         "ktpu_shard_merge_rounds_total": (Counter, ("outcome", "family")),
         "ktpu_shard_replicated_bytes": (Gauge, ()),
         "ktpu_shard_verdict_bytes_total": (Counter, ()),
+        "ktpu_shard_family_eligible_total": (Counter, ("family", "path")),
     }
     for name, (cls, labels) in expected.items():
         fam = fams.get(name)
@@ -182,6 +183,12 @@ def test_shard_families_are_registered():
         assert isinstance(fam, cls), (name, type(fam).__name__)
         assert fam.label_names == labels, (name, fam.label_names)
         assert fam.help.strip()
+    # ISSUE 14 widened the speculation family vocabulary; the help text
+    # must document the full label set so dashboards don't guess
+    merge_help = fams["ktpu_shard_merge_rounds_total"].help
+    for fam_name in ("fill", "existing", "topo_fill", "kscan", "perpod"):
+        assert fam_name in merge_help, fam_name
+        assert fam_name in fams["ktpu_shard_family_eligible_total"].help
 
 
 def test_guard_families_are_registered():

@@ -339,6 +339,52 @@ class TestObservatory:
         assert "obs_dark_kernel" not in observatory.snapshot()
         assert observatory.drain_notes() == []
 
+    def test_kernel_scope_names_anonymous_compiles(self):
+        """Compiles triggered by host helpers jitted OUTSIDE a
+        named_kernel entry point used to land in the `anonymous` bucket
+        (ISSUE 14 satellite): inside a kernel_scope they inherit the
+        scope's name, while a nested named_kernel still wins."""
+        import jax
+        import jax.numpy as jnp
+
+        from karpenter_tpu.utils.metrics import JIT_COMPILES
+
+        observatory.enable()
+
+        @jax.jit
+        def helper(x):
+            return x * 2
+
+        @observatory.named_kernel("obs_scoped_kernel")
+        @jax.jit
+        def named(x):
+            return x + 1
+
+        s0 = JIT_COMPILES.get(kernel="obs_scope_round")
+        n0 = JIT_COMPILES.get(kernel="obs_scoped_kernel")
+        with observatory.kernel_scope("obs_scope_round"):
+            helper(jnp.zeros((5,), jnp.float32)).block_until_ready()
+            named(jnp.zeros((5,), jnp.float32)).block_until_ready()
+        # the helper's compile (plus any anonymous array-building traces
+        # inside the block) lands under the scope's name...
+        assert JIT_COMPILES.get(kernel="obs_scope_round") >= s0 + 1
+        # ...while the named kernel keeps exactly its own compile
+        assert JIT_COMPILES.get(kernel="obs_scoped_kernel") == n0 + 1
+        snap = observatory.snapshot()
+        assert snap["obs_scope_round"]["compiles"] >= 1
+        assert snap["obs_scoped_kernel"]["compiles"] == 1
+
+    def test_solve_round_scope_claims_helper_compiles(self):
+        """A fresh scheduler's solve compiles helper executables (chunk
+        gathers, fetch preps) outside any named_kernel; the solve-round
+        scope must claim them so nothing attributes to `anonymous`."""
+        observatory.enable()
+        sched = TPUScheduler(make_templates(), max_claims=128)
+        sched.solve(list(kind_pods("scope", 6)))
+        snap = observatory.snapshot()
+        assert "solve_round" in snap, sorted(snap)
+        assert "anonymous" not in snap, sorted(snap)
+
     def test_compile_notes_fold_into_the_ledger(self, monkeypatch):
         """A solve that compiles while the observatory is on carries the
         per-kernel compile notes in its ledger record."""
@@ -350,7 +396,10 @@ class TestObservatory:
         assert compiles, "fresh-scheduler solve must record compile notes"
         assert {"kernel", "seconds"} <= set(compiles[0])
         kernels = {c["kernel"] for c in compiles}
-        assert kernels & {"solve", "solve_fill", "global_template", "anonymous"}
+        # helper compiles attribute to the round scope now, not anonymous
+        assert kernels & {
+            "solve", "solve_fill", "global_template", "solve_round"
+        }
 
 
 class TestWatchdogSections:

@@ -105,6 +105,80 @@ def zonal_kind_pods(n=192, kinds=4, prefix="z", shared=False, mixed=False):
     return pods
 
 
+def existing_factory(n=2, cpu_avail=4.0):
+    """Real existing nodes — the ISSUE 14 debit-delta family."""
+    from test_solver import make_existing
+
+    return [make_existing(f"exist-{i}", i, cpu_avail=cpu_avail) for i in range(n)]
+
+
+def hostname_spread_pods(n=192, kinds=4, prefix="hs", mixed=False):
+    """Topology-BEARING fill: hostname-spread kinds have hg interaction
+    but zero vg interaction, so they stay batchable (the fill route) and
+    ride the topo_fill speculation family. Disjoint per-kind selectors
+    keep the hg record/apply sets independent so groups can commit."""
+    pods = []
+    per = n // kinds
+    for i in range(n):
+        k = i // per
+        if mixed:
+            p = make_pod(
+                f"{prefix}-{i}",
+                cpu=[0.25, 0.5, 1.0][k % 3],
+                memory=f"{[0.5, 1.0][k % 2]}Gi",
+            )
+        else:
+            p = make_pod(f"{prefix}-{i}", cpu=2.0, memory="1Gi")
+        p.metadata.labels = {"grp": str(k), "hspread": f"h{k}"}
+        p.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(
+                max_skew=1,
+                topology_key=l.LABEL_HOSTNAME,
+                label_selector={"hspread": f"h{k}"},
+            )
+        ]
+        pods.append(p)
+    return pods
+
+
+def perpod_kind_pods(n=256, kinds=4, prefix="pp", shared=False, mixed=False):
+    """Per-pod-routed kinds: TWO distinct vg keys per kind (zone +
+    capacity-type spread) defeat the single-key kscan check, so the run
+    takes the per-pod scan — the solve_perpod_dp family. Disjoint
+    selectors (default) let consecutive chunks commit; `shared=True`
+    makes every chunk record into the selector every other chunk applies
+    (the vg conflict bit refuses); `mixed=True` keeps committed claims
+    alive for later chunks (the deadness bit refuses)."""
+    pods = []
+    per = n // kinds
+    for i in range(n):
+        k = i // per
+        sel = "p" if shared else f"p{k}"
+        if mixed:
+            p = make_pod(
+                f"{prefix}-{i}",
+                cpu=[0.25, 0.5, 1.0][k % 3],
+                memory=f"{[0.5, 1.0][k % 2]}Gi",
+            )
+        else:
+            p = make_pod(f"{prefix}-{i}", cpu=2.0, memory="1Gi")
+        p.metadata.labels = {"grp": str(k), "spread": sel}
+        p.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(
+                max_skew=1,
+                topology_key=l.LABEL_TOPOLOGY_ZONE,
+                label_selector={"spread": sel},
+            ),
+            TopologySpreadConstraint(
+                max_skew=1,
+                topology_key=l.CAPACITY_TYPE_LABEL_KEY,
+                label_selector={"spread": sel},
+            ),
+        ]
+        pods.append(p)
+    return pods
+
+
 def dp_scheduler(monkeypatch, *, window=0, chunks=4, enabled=True, n_types=24):
     """A meshed TPUScheduler with the pipeline forced on so the dp path
     engages at test sizes."""
@@ -195,11 +269,14 @@ class TestDpFillParity:
         single = TPUScheduler(make_templates()).solve(pods)
         assert_bit_identical(meshed, single)
 
-    def test_topology_problem_ineligible_but_identical(self, monkeypatch):
-        """Topology interaction disqualifies the speculative FILL path,
-        and a single-kind kscan run has nothing to split into groups —
-        the meshed solve must still be bit-identical through the
-        annotated fill/kscan/perpod kernels."""
+    def test_topology_problem_speculates_and_stays_identical(
+        self, monkeypatch
+    ):
+        """A topology-bearing problem used to disqualify the speculative
+        FILL path wholesale; ISSUE 14 dropped that gate (the verdict's
+        hg record-vs-apply bit carries the coupling), so the topology-free
+        fill groups speculate even though zonal kinds share the solve —
+        still bit-identical."""
         pods = mixed_kind_pods(128, prefix="t")
         for i in range(32):
             p = make_pod(f"tz-{i}", cpu=0.5, memory="0.5Gi")
@@ -214,7 +291,12 @@ class TestDpFillParity:
             pods.append(p)
         sched = dp_scheduler(monkeypatch)
         meshed = sched.solve(pods)
-        assert sched.last_timings["shard"]["merge_rounds"] == 0
+        shard = sched.last_timings["shard"]
+        assert shard["merge_rounds"] >= 1, shard
+        # the plain kinds carry no hostname-topology, so they keep the
+        # plain `fill` family label
+        fams = shard["families"]
+        assert fams["fill"]["committed"] + fams["fill"]["replayed"] >= 1
         monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
         single = TPUScheduler(make_templates()).solve(pods)
         assert_bit_identical(meshed, single)
@@ -321,6 +403,336 @@ class TestDpKscanParity:
         assert_bit_identical(meshed, single)
 
 
+class TestDpExistingParity:
+    """Speculative dp groups over solves WITH real existing nodes
+    (ISSUE 14a): every row carries per-existing-node capacity-debit
+    deltas and the verdict's disjoint-touch bit decides commits on
+    device. Rows that both debit the same existing node refuse; rows
+    touching disjoint node sets (or none) graft order-free through
+    merge_shard_fill — always bit-identical to the single-device solve
+    carrying the same existing nodes."""
+
+    @pytest.mark.parametrize(
+        "chunks",
+        [
+            pytest.param(1, marks=pytest.mark.slow),
+            pytest.param(2, marks=pytest.mark.slow),
+            4,
+        ],
+    )
+    def test_existing_commit_bit_identical(self, monkeypatch, chunks):
+        pods = saturating_kind_pods(256, prefix=f"ex{chunks}")
+        sched = dp_scheduler(monkeypatch, chunks=chunks)
+        meshed = sched.solve(pods, existing_factory())
+        if chunks > 1:
+            shard = sched.last_timings["shard"]
+            fam = shard["families"]["existing"]
+            # early rows racing for the same existing node replay; once
+            # the nodes saturate the debit bit proves disjointness and
+            # groups commit
+            assert fam["committed"] >= 1, shard
+            assert shard["coverage"]["existing"]["dp"] == (
+                fam["committed"] + fam["replayed"]
+            )
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        single = TPUScheduler(make_templates()).solve(pods, existing_factory())
+        assert_bit_identical(meshed, single)
+
+    @pytest.mark.slow
+    def test_existing_contention_replays_bit_identical(self, monkeypatch):
+        """Small pods that all fit the existing nodes: every row debits
+        the same nodes, the disjoint-touch bit refuses, groups replay —
+        still bit-identical (including existing_assignments)."""
+        pods = mixed_kind_pods(256, prefix="exr")
+        sched = dp_scheduler(monkeypatch)
+        meshed = sched.solve(pods, existing_factory(cpu_avail=8.0))
+        fam = sched.last_timings["shard"]["families"]["existing"]
+        assert fam["replayed"] >= 1, fam
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        single = TPUScheduler(make_templates()).solve(
+            pods, existing_factory(cpu_avail=8.0)
+        )
+        assert_bit_identical(meshed, single)
+        assert meshed.existing_assignments == single.existing_assignments
+
+    @pytest.mark.slow
+    def test_existing_windowed_bit_identical(self, monkeypatch):
+        pods = saturating_kind_pods(256, prefix="exw")
+        sched = dp_scheduler(monkeypatch, window=48)
+        meshed = sched.solve(pods, existing_factory())
+        assert sched.last_timings["shard"]["merge_rounds"] >= 1
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        monkeypatch.setenv("KTPU_SCAN_WINDOW", "48")
+        single = TPUScheduler(make_templates()).solve(pods, existing_factory())
+        assert_bit_identical(meshed, single)
+
+    def test_existing_opt_out(self, monkeypatch):
+        """KTPU_SHARD_EXISTING=0 re-imposes the old `no real existing
+        nodes` gate: zero merge rounds, coverage records the sequential
+        routing, results identical."""
+        monkeypatch.setenv("KTPU_SHARD_EXISTING", "0")
+        pods = saturating_kind_pods(128, kinds=4, prefix="exo")
+        sched = dp_scheduler(monkeypatch)
+        meshed = sched.solve(pods, existing_factory())
+        shard = sched.last_timings["shard"]
+        assert shard["merge_rounds"] == 0, shard
+        assert shard["coverage"]["existing"]["sequential"] >= 1, shard
+        assert shard["coverage"]["existing"]["dp"] == 0, shard
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        single = TPUScheduler(make_templates()).solve(pods, existing_factory())
+        assert_bit_identical(meshed, single)
+
+
+class TestDpTopoFillParity:
+    """Speculative dp groups over topology-BEARING fill (ISSUE 14b):
+    hostname-spread / anti-affinity kinds stay on the fill route and the
+    verdict's hg record-vs-apply disjointness bit (the mechanism
+    solve_kscan_dp already used for vg) decides commits on device."""
+
+    @pytest.mark.parametrize(
+        "chunks",
+        [
+            pytest.param(1, marks=pytest.mark.slow),
+            pytest.param(2, marks=pytest.mark.slow),
+            4,
+        ],
+    )
+    def test_hostname_spread_commit_bit_identical(self, monkeypatch, chunks):
+        pods = hostname_spread_pods(192, kinds=4, prefix=f"ts{chunks}")
+        sched = dp_scheduler(monkeypatch, chunks=chunks)
+        meshed = sched.solve(pods)
+        if chunks > 1:
+            shard = sched.last_timings["shard"]
+            fam = shard["families"]["topo_fill"]
+            assert fam["committed"] >= 1, shard
+            assert fam["replayed"] == 0, shard
+            assert shard["coverage"]["topo_fill"]["dp"] == (
+                fam["committed"] + fam["replayed"]
+            )
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        single = TPUScheduler(make_templates()).solve(pods)
+        assert_bit_identical(meshed, single)
+        href, _ = bench.host_solve(make_templates(), pods)
+        assert_same_packing(href, meshed)
+
+    @pytest.mark.slow
+    def test_shared_hg_selector_conflict_replays(self, monkeypatch):
+        """Self-anti-affinity kinds sharing chunk groups: rows recording
+        into hostname groups other rows apply refuse on the hg bit and
+        replay — commits AND replays, bit-identical."""
+        from karpenter_tpu.models.pod import PodAffinityTerm
+
+        pods = []
+        for i in range(96):
+            k = i // 24
+            p = make_pod(f"ta-{i}", cpu=0.5, memory="0.5Gi")
+            p.metadata.labels = {"app": f"db{k}"}
+            p.spec.pod_anti_affinity = [
+                PodAffinityTerm(
+                    topology_key=l.LABEL_HOSTNAME,
+                    label_selector={"app": f"db{k}"},
+                )
+            ]
+            pods.append(p)
+        sched = dp_scheduler(monkeypatch)
+        meshed = sched.solve(pods)
+        fam = sched.last_timings["shard"]["families"]["topo_fill"]
+        assert fam["replayed"] >= 1, fam
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        single = TPUScheduler(make_templates()).solve(pods)
+        assert_bit_identical(meshed, single)
+
+    @pytest.mark.slow
+    def test_topo_fill_windowed_bit_identical(self, monkeypatch):
+        pods = hostname_spread_pods(192, kinds=4, prefix="tw")
+        sched = dp_scheduler(monkeypatch, window=48)
+        meshed = sched.solve(pods)
+        assert sched.last_timings["shard"]["merge_rounds"] >= 1
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        monkeypatch.setenv("KTPU_SCAN_WINDOW", "48")
+        single = TPUScheduler(make_templates()).solve(pods)
+        assert_bit_identical(meshed, single)
+
+    def test_topo_fill_opt_out(self, monkeypatch):
+        """KTPU_SHARD_DP=0 keeps topology-bearing fill sequential with
+        identical results (the family's opt-out is the dp master knob)."""
+        pods = hostname_spread_pods(128, kinds=4, prefix="to")
+        sched = dp_scheduler(monkeypatch, enabled=False)
+        meshed = sched.solve(pods)
+        shard = sched.last_timings["shard"]
+        assert shard["merge_rounds"] == 0
+        assert shard["coverage"]["topo_fill"]["sequential"] >= 1, shard
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        single = TPUScheduler(make_templates()).solve(pods)
+        assert_bit_identical(meshed, single)
+
+
+class TestDpPerpodParity:
+    """Speculative dp rows over consecutive per-pod chunks (ISSUE 14c):
+    solve_perpod_dp vmaps the per-pod scan one chunk per dp row and
+    merge_shard_kscan grafts committed rows (window fields + vg/hg
+    deltas + existing-node debits). The chunk count is
+    ceil(pods / KTPU_SOLVE_CHUNK), so the parametrized chunk sizes below
+    give {1, 2, 4} chunks over 256 pods."""
+
+    @pytest.mark.parametrize(
+        "solve_chunk",
+        [
+            pytest.param(256, marks=pytest.mark.slow),
+            pytest.param(128, marks=pytest.mark.slow),
+            64,
+        ],
+    )
+    def test_perpod_commit_bit_identical(self, monkeypatch, solve_chunk):
+        monkeypatch.setenv("KTPU_SOLVE_CHUNK", str(solve_chunk))
+        n_chunks = 256 // solve_chunk
+        pods = perpod_kind_pods(256, prefix=f"pp{n_chunks}")
+        sched = dp_scheduler(monkeypatch)
+        meshed = sched.solve(pods)
+        shard = sched.last_timings["shard"]
+        fam = shard["families"]["perpod"]
+        if n_chunks > 1:
+            assert fam["committed"] >= 1, shard
+            assert fam["replayed"] == 0, shard
+            assert shard["coverage"]["perpod"]["dp"] == (
+                fam["committed"] + fam["replayed"]
+            )
+        else:
+            # a single chunk has nothing to speculate against
+            assert fam["committed"] + fam["replayed"] == 0, shard
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        single = TPUScheduler(make_templates()).solve(pods)
+        assert_bit_identical(meshed, single)
+        href, _ = bench.host_solve(make_templates(), pods)
+        assert_same_packing(href, meshed)
+
+    @pytest.mark.slow
+    def test_perpod_shared_selector_conflict_replays(self, monkeypatch):
+        """One shared spread selector across every chunk: each chunk
+        records into the vg groups every other chunk applies — the
+        conflict bit refuses all but each round's first row."""
+        monkeypatch.setenv("KTPU_SOLVE_CHUNK", "64")
+        pods = perpod_kind_pods(256, prefix="pps", shared=True)
+        sched = dp_scheduler(monkeypatch)
+        meshed = sched.solve(pods)
+        fam = sched.last_timings["shard"]["families"]["perpod"]
+        assert fam["replayed"] >= 1, fam
+        assert fam["committed"] >= 1, fam
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        single = TPUScheduler(make_templates()).solve(pods)
+        assert_bit_identical(meshed, single)
+
+    @pytest.mark.slow
+    def test_perpod_mixed_sizes_replay_bit_identical(self, monkeypatch):
+        monkeypatch.setenv("KTPU_SOLVE_CHUNK", "64")
+        pods = perpod_kind_pods(256, prefix="ppm", mixed=True)
+        sched = dp_scheduler(monkeypatch)
+        meshed = sched.solve(pods)
+        fam = sched.last_timings["shard"]["families"]["perpod"]
+        assert fam["replayed"] >= 1, fam
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        single = TPUScheduler(make_templates()).solve(pods)
+        assert_bit_identical(meshed, single)
+
+    @pytest.mark.slow
+    def test_perpod_windowed_bit_identical(self, monkeypatch):
+        monkeypatch.setenv("KTPU_SOLVE_CHUNK", "64")
+        pods = perpod_kind_pods(256, prefix="ppw")
+        sched = dp_scheduler(monkeypatch, window=48)
+        meshed = sched.solve(pods)
+        assert sched.last_timings["shard"]["merge_rounds"] >= 1
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        monkeypatch.setenv("KTPU_SCAN_WINDOW", "48")
+        single = TPUScheduler(make_templates()).solve(pods)
+        assert_bit_identical(meshed, single)
+
+    def test_perpod_opt_out(self, monkeypatch):
+        """KTPU_SHARD_PERPOD=0 opts per-pod runs (only) back onto the
+        sequential scan — zero perpod dp rounds, coverage records the
+        sequential routing, identical results."""
+        monkeypatch.setenv("KTPU_SOLVE_CHUNK", "64")
+        monkeypatch.setenv("KTPU_SHARD_PERPOD", "0")
+        pods = perpod_kind_pods(256, prefix="ppo")
+        sched = dp_scheduler(monkeypatch)
+        meshed = sched.solve(pods)
+        shard = sched.last_timings["shard"]
+        fam = shard["families"]["perpod"]
+        assert fam["committed"] + fam["replayed"] == 0, shard
+        assert shard["coverage"]["perpod"]["sequential"] >= 1, shard
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        single = TPUScheduler(make_templates()).solve(pods)
+        assert_bit_identical(meshed, single)
+
+
+class TestNewFamilyQuarantine:
+    """KTPU_GUARD_LIE=speculative against each ISSUE 14 family: the
+    shadow audit catches the corrupted graft, quarantines the
+    speculative path, and the NEXT meshed solve routes that family back
+    to the sequential scan (coverage proves it) — exact either way."""
+
+    @pytest.fixture(autouse=True)
+    def _clean_guard_state(self, monkeypatch):
+        from karpenter_tpu import guard
+
+        for var in ("KTPU_GUARD_AUDIT_RATE", "KTPU_GUARD_LIE"):
+            monkeypatch.delenv(var, raising=False)
+        guard.QUARANTINE.reset()
+        guard.reset_log()
+        yield
+        guard.QUARANTINE.reset()
+        guard.reset_log()
+
+    def _lie_and_recover(self, monkeypatch, family, pods, existing=None):
+        from karpenter_tpu import guard
+
+        monkeypatch.setenv("KTPU_GUARD_AUDIT_RATE", "1.0")
+        monkeypatch.setenv("KTPU_GUARD_LIE", "speculative")
+        sched = dp_scheduler(monkeypatch)
+        meshed = sched.solve(list(pods), list(existing or []))
+        assert guard.divergences("speculative")
+        assert guard.QUARANTINE.active("speculative")
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        single = TPUScheduler(make_templates()).solve(
+            list(pods), list(existing or [])
+        )
+        assert_bit_identical(meshed, single)
+        # quarantined: the family rides the sequential scan, still exact
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "4")
+        monkeypatch.delenv("KTPU_GUARD_LIE", raising=False)
+        sched2 = dp_scheduler(monkeypatch)
+        r2 = sched2.solve(list(pods), list(existing or []))
+        assert_bit_identical(meshed, r2)
+        shard = sched2.last_timings["shard"]
+        assert shard["merge_rounds"] == 0, shard
+        fam = shard["families"][family]
+        assert fam["committed"] + fam["replayed"] == 0, shard
+        assert shard["coverage"][family]["sequential"] >= 1, shard
+
+    @pytest.mark.slow
+    def test_lying_existing_family_quarantines(self, monkeypatch):
+        self._lie_and_recover(
+            monkeypatch,
+            "existing",
+            saturating_kind_pods(128, kinds=4, prefix="qe"),
+            existing=existing_factory(),
+        )
+
+    @pytest.mark.slow
+    def test_lying_topo_fill_family_quarantines(self, monkeypatch):
+        self._lie_and_recover(
+            monkeypatch,
+            "topo_fill",
+            hostname_spread_pods(128, kinds=4, prefix="qt"),
+        )
+
+    @pytest.mark.slow
+    def test_lying_perpod_family_quarantines(self, monkeypatch):
+        monkeypatch.setenv("KTPU_SOLVE_CHUNK", "64")
+        self._lie_and_recover(
+            monkeypatch, "perpod", perpod_kind_pods(128, kinds=4, prefix="qp")
+        )
+
+
 class TestVerdictDecode:
     """Packed commit-verdict word wire-format regression: pack_bool_np is
     the layout oracle; leading_ones is the host decode the merge loop
@@ -380,13 +792,16 @@ class TestShardObservability:
         assert shard["sync_blocked_s"] >= 0.0
         assert shard["merge_wall_s"] >= shard["sync_blocked_s"]
         fams = shard["families"]
-        assert (
-            fams["fill"]["committed"]
-            + fams["fill"]["replayed"]
-            + fams["kscan"]["committed"]
-            + fams["kscan"]["replayed"]
-            == shard["groups_committed"] + shard["groups_replayed"]
-        )
+        assert sum(
+            fams[f]["committed"] + fams[f]["replayed"] for f in fams
+        ) == shard["groups_committed"] + shard["groups_replayed"]
+        # the coverage ledger's dp column IS the speculation ledger:
+        # every group that entered a merge round (committed or replayed)
+        # was counted eligible-for-dp exactly once
+        for f, fam in fams.items():
+            assert shard["coverage"][f]["dp"] == (
+                fam["committed"] + fam["replayed"]
+            ), (f, shard)
         monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
         plain = TPUScheduler(make_templates())
         plain.solve(pods)
@@ -402,7 +817,7 @@ class TestShardObservability:
         def totals(outcome):
             return sum(
                 SHARD_MERGE_ROUNDS.get(outcome=outcome, family=f)
-                for f in ("fill", "kscan")
+                for f in ("fill", "existing", "topo_fill", "kscan", "perpod")
             )
 
         c0, r0 = totals("committed"), totals("replayed")
