@@ -43,6 +43,7 @@ from karpenter_tpu.guard import config as guard_config
 from karpenter_tpu.controllers.provisioning.topology import Topology, build_universe_domains
 from karpenter_tpu.models import labels as l
 from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.obs import waterfall as _wfl
 from karpenter_tpu.ops import solver as ops_solver
 from karpenter_tpu.ops import topology as topo_ops
 from karpenter_tpu.ops.encode import PadBucketCache, ProblemEncoder, encode_requirements
@@ -1023,18 +1024,22 @@ class TPUScheduler:
             # opens another node) — double the slot capacity and re-solve
             # from scratch until every pod had a real chance at a slot.
             while True:
-                if topology_factory is not None:
-                    topo = topology_factory(current)
-                elif topology is not None:
-                    topo = _copy.deepcopy(topology)
-                else:
-                    topo = None
-                from karpenter_tpu.tracing.tracer import TRACER
+                # one waterfall per solve attempt: a NO_ROOM escalation
+                # retry is a fresh round and gets fresh attribution
+                with _wfl.round_waterfall():
+                    with _wfl.span("topology"):
+                        if topology_factory is not None:
+                            topo = topology_factory(current)
+                        elif topology is not None:
+                            topo = _copy.deepcopy(topology)
+                        else:
+                            topo = None
+                    from karpenter_tpu.tracing.tracer import TRACER
 
-                with TRACER.span("solve.round", pods=len(current)):
-                    result = self._solve_once(
-                        current, [n.clone() for n in base_existing], budgets, topo
-                    )
+                    with TRACER.span("solve.round", pods=len(current)):
+                        result = self._solve_once(
+                            current, [n.clone() for n in base_existing], budgets, topo
+                        )
                 cap = _next_pow2(max(len(current), 1))
                 used = self._last_n_claims or self.max_claims or cap
                 leftover = sum(
@@ -1171,7 +1176,7 @@ class TPUScheduler:
         pad_real0 = dict(self._pad_cache.real)
         pad_padded0 = dict(self._pad_cache.padded)
         try:
-            with TRACER.span("solve.encode", pods=len(pods)):
+            with TRACER.span("solve.encode", pods=len(pods)), _wfl.span("encode"):
                 # host encode under its own watchdog section (STATUS
                 # known gap: encode/decode stalls were not deadlined)
                 pods_sorted, enc = run_guarded(
@@ -1181,7 +1186,9 @@ class TPUScheduler:
         finally:
             self._adaptive_claims = False
         _t_encode_done = _time.perf_counter()
-        with TRACER.span("solve.dispatch", n_claims=enc["n_claims"]):
+        with TRACER.span(
+            "solve.dispatch", n_claims=enc["n_claims"]
+        ), _wfl.span("dispatch"):
             state, outputs, tmpl_snaps = self._run_solve(enc)
         # device sync points: the single-fetch path pays exactly one wire
         # round trip (over a tunneled TPU each costs ~70ms); the pipelined
@@ -1189,7 +1196,7 @@ class TPUScheduler:
         # but the drain hidden behind in-flight device compute
         self._t_fetch_done = None
         self._pipeline_stats = None
-        with TRACER.span("solve.decode") as _dsp:
+        with TRACER.span("solve.decode") as _dsp, _wfl.span("decode"):
             out = run_guarded(
                 lambda: self._decode(pods_sorted, state, outputs, enc, tmpl_snaps),
                 section="decode",
@@ -1233,8 +1240,45 @@ class TPUScheduler:
         if self._pipeline_stats is not None:
             self.last_timings["pipeline"] = self._pipeline_stats
         if self._shard_stats is not None:
+            self._finalize_shard_stats(self._shard_stats)
             self.last_timings["shard"] = self._shard_stats
+        wf = _wfl.current()
+        if wf is not None:
+            self.last_timings["waterfall"] = self._finalize_waterfall(wf)
         return out
+
+    def _finalize_waterfall(self, wf) -> dict:
+        """Reconcile the round waterfall and observe each segment
+        self-time into ktpu_round_segment_seconds."""
+        from karpenter_tpu.utils.metrics import ROUND_SEGMENT_SECONDS
+
+        rec = wf.finalize()
+        for seg, s in rec["segments"].items():
+            ROUND_SEGMENT_SECONDS.observe(s, segment=seg)
+        return rec
+
+    def _finalize_shard_stats(self, stats: dict) -> None:
+        """Roll the dp-row accounting of one meshed solve into the
+        ktpu_shard_dp_utilization gauge and per-family speculation
+        efficiency (committed-pod-seconds / dispatched-pod-seconds)."""
+        from karpenter_tpu.utils.metrics import SHARD_DP_UTILIZATION
+
+        tot = stats.get("dp_rows_total", 0)
+        if tot:
+            for k in ("committed", "replayed", "idle"):
+                SHARD_DP_UTILIZATION.set(
+                    stats.get(f"dp_rows_{k}", 0) / tot, state=k
+                )
+        eff = {}
+        for fam, fs in (stats.get("families") or {}).items():
+            disp = fs.get("dispatched_pod_s", 0.0)
+            if disp > 0:
+                fs["efficiency"] = round(
+                    fs.get("committed_pod_s", 0.0) / disp, 4
+                )
+                eff[fam] = fs["efficiency"]
+        if eff:
+            stats["speculation_efficiency"] = eff
 
     def whatif_batch(
         self,
@@ -2203,10 +2247,29 @@ class TPUScheduler:
                 # dispatch/decode overlap restored)
                 "verdict_fetches": 0,
                 "verdict_bytes": 0,
+                # sync_blocked_s stays the sum (compat); the waterfall
+                # needs the two phases split: verdict-word fetches vs
+                # block_until_ready drains (the one-collective-in-flight
+                # rule plus graft/replay completion waits)
                 "sync_blocked_s": 0.0,
+                "sync_verdict_s": 0.0,
+                "sync_drain_s": 0.0,
                 "merge_wall_s": 0.0,
+                # dp-row utilization: every row of every merge round is
+                # committed (grafted useful work), replayed (refused,
+                # re-ran sequentially), or idle (dispatch padding)
+                "dp_rows_total": 0,
+                "dp_rows_committed": 0,
+                "dp_rows_replayed": 0,
+                "dp_rows_idle": 0,
                 "families": {
-                    f: {"committed": 0, "replayed": 0}
+                    f: {
+                        "committed": 0, "replayed": 0,
+                        # speculation efficiency numerator/denominator:
+                        # pod-seconds weighted by each round's dispatch+
+                        # drain wall (committed / dispatched -> ratio)
+                        "committed_pod_s": 0.0, "dispatched_pod_s": 0.0,
+                    }
                     for f in _SHARD_FAMILIES
                 },
                 # per-family chunk-group routing coverage (bench
@@ -2495,6 +2558,7 @@ class TPUScheduler:
         # output: the pipelined decode opens claims before the final state
         # lands, and a slot's template is fixed the moment the claim opens
         for mode, segs in runs:
+            _wsp = _wfl.open_span(f"dispatch.{mode[0]}")
             if _trace_on:
                 import time as _time
 
@@ -2608,7 +2672,45 @@ class TPUScheduler:
                     _time.perf_counter() - _t_run0,
                     segments=len(segs),
                 )
+            _wfl.close_span(_wsp)
         return state, outputs, tmpl_snaps
+
+    def _dp_wait(self, x, label: str) -> float:
+        """jax.block_until_ready with the blocked wall attributed: the
+        drain side of the merge loops' sync split (sync_drain_s — the
+        compat sync_blocked_s key keeps the verdict+drain sum) and a
+        waterfall leaf under `label`."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        jax.block_until_ready(x)
+        dt = _time.perf_counter() - t0
+        stats = self._shard_stats
+        if stats is not None:
+            stats["sync_drain_s"] += dt
+            stats["sync_blocked_s"] += dt
+        _wfl.add_current(label, dt)
+        return dt
+
+    def _dp_round_account(self, round_groups, n_commit, dp_n, disp_s, fam_of):
+        """Per merge round dp-row utilization (committed / replayed /
+        padded-idle) and speculation pod-seconds: every dispatched group
+        rode the fan-out for `disp_s` wall, so its pods contribute
+        disp_s*pods to the family's dispatched denominator, and only the
+        committed prefix also reaches the numerator."""
+        stats = self._shard_stats
+        if stats is None:
+            return
+        stats["dp_rows_total"] += dp_n
+        stats["dp_rows_committed"] += n_commit
+        stats["dp_rows_replayed"] += len(round_groups) - n_commit
+        stats["dp_rows_idle"] += dp_n - len(round_groups)
+        for r, segs in enumerate(round_groups):
+            fs = stats["families"][fam_of(segs)]
+            pods = sum(hi - lo for lo, hi, *_k in segs)
+            fs["dispatched_pod_s"] += disp_s * pods
+            if r < n_commit:
+                fs["committed_pod_s"] += disp_s * pods
 
     def _run_fill_dp(
         self, enc, state, groups, outputs, tmpl_snaps, remaining,
@@ -2643,7 +2745,7 @@ class TPUScheduler:
             # one-collective-in-flight rule must hold at dispatch time.
             # A wait, not a transfer — the round still fetches exactly
             # one verdict word from the host's point of view.
-            jax.block_until_ready(state)
+            self._dp_wait(state, "fill_dp.drain")
             # the round base stays a device-scalar reference — the merge
             # takes base.n_open/base.w_open on device, no host fetch
             base = state
@@ -2665,6 +2767,7 @@ class TPUScheduler:
                 enc["conf_k"], enc["vols_k"], enc["pod_topo_k"],
                 jnp.asarray(kid_b), jnp.asarray(cnt_b),
             )
+            t_disp0 = _time.perf_counter()
             spec_states, spec_ys, verdict = ops_solver.solve_fill_dp(
                 state, xs_b, enc["exist_tensors"], self.it_tensors,
                 enc["template_tensors"], self.well_known, enc["topo_tensors"],
@@ -2675,27 +2778,34 @@ class TPUScheduler:
             # collective-bearing computation in flight deadlocks the
             # virtual-device CPU backend's rendezvous (fetch_tree has the
             # matching guard)
-            jax.block_until_ready((spec_states, spec_ys, verdict))
+            self._dp_wait((spec_states, spec_ys, verdict), "fill_dp.device")
+            disp_s = _time.perf_counter() - t_disp0
             # the round's SINGLE synchronization point: one packed word
             # carrying every group's commit verdict (prefix-ANDed on
             # device, so leading ones == the committable prefix)
             t_sync = _time.perf_counter()
-            (vw,) = fetch_tree([verdict])
+            (vw,) = fetch_tree([verdict], wf_label="fill_dp.sync_verdict")
             vw = np.asarray(vw)
             n_commit = leading_ones(vw, len(round_groups))
             if stats is not None:
+                dt_sync = _time.perf_counter() - t_sync
                 stats["merge_rounds"] += 1
                 stats["verdict_fetches"] += 1
                 stats["verdict_bytes"] += int(vw.nbytes)
-                stats["sync_blocked_s"] += _time.perf_counter() - t_sync
+                stats["sync_verdict_s"] += dt_sync
+                stats["sync_blocked_s"] += dt_sync
             SHARD_VERDICT_BYTES.inc(int(vw.nbytes))
+            self._dp_round_account(
+                round_groups, n_commit, dp_n, disp_s,
+                lambda segs: self._fill_family(enc, segs),
+            )
             for r in range(n_commit):
                 segs = round_groups[r]
                 family = self._fill_family(enc, segs)
                 spec_r, ys_r = ops_solver.take_dp_row(
                     (spec_states, spec_ys), jnp.int32(r)
                 )
-                jax.block_until_ready(ys_r.fill_c)
+                self._dp_wait(ys_r.fill_c, "fill_dp.graft")
                 # chaos seam: cut a speculative merge exactly at the
                 # commit decision (an injected error here degrades the
                 # whole solve via the ladder, never a half-graft)
@@ -2710,11 +2820,11 @@ class TPUScheduler:
                     # time — the CPU-backend rendezvous rule the
                     # surrounding loop already follows)
                     seq_twin = dispatch_fill(state, segs)
-                    jax.block_until_ready(seq_twin[0])
+                    self._dp_wait(seq_twin[0], "fill_dp.audit")
                 state, shifted = ops_solver.merge_shard_fill(
                     state, spec_r, base
                 )
-                jax.block_until_ready(state)  # same one-at-a-time rule
+                self._dp_wait(state, "fill_dp.graft")  # one-at-a-time rule
                 if audit:
                     state, commit_out = self._audit_shard_merge(
                         state, segs, seq_twin,
@@ -2732,7 +2842,7 @@ class TPUScheduler:
                     remaining[k_] -= hi_ - lo_
                 state = maybe_compact(state)
                 # snapshot + compact drained before the next dispatch
-                jax.block_until_ready((state, tmpl_snaps[-1]))
+                self._dp_wait((state, tmpl_snaps[-1]), "fill_dp.graft")
             if n_commit < len(round_groups):
                 # replay exactly ONE refused group (its xs rows were
                 # already gathered per-group by dispatch_fill — O(group)
@@ -2742,7 +2852,7 @@ class TPUScheduler:
                 segs = round_groups[n_commit]
                 family = self._fill_family(enc, segs)
                 state, ys_seq = dispatch_fill(state, segs)
-                jax.block_until_ready(state)  # one-at-a-time rule
+                self._dp_wait(state, "fill_dp.replay")  # one-at-a-time rule
                 outputs.append(("fill", segs, ys_seq, state.slot_of))
                 SHARD_MERGE_ROUNDS.inc(outcome="replayed", family=family)
                 self._shard_account(segs, False, family)
@@ -2751,7 +2861,7 @@ class TPUScheduler:
                     remaining[k_] -= hi_ - lo_
                 state = maybe_compact(state)
                 # snapshot + compact drained before the next dispatch
-                jax.block_until_ready((state, tmpl_snaps[-1]))
+                self._dp_wait((state, tmpl_snaps[-1]), "fill_dp.replay")
                 gi += n_commit + 1
             else:
                 gi += n_commit
@@ -2789,7 +2899,7 @@ class TPUScheduler:
             round_groups = groups[gi : gi + dp_n]
             # same rule as _run_fill_dp: drain in-flight work before the
             # round's collective-bearing dispatch (a wait, not a fetch)
-            jax.block_until_ready(state)
+            self._dp_wait(state, "kscan_dp.drain")
             base = state
             B_max = max(len(s) for s in round_groups)
             B_pad = self._pad_cache.pad("kscan_segments_dp", B_max, step=8)
@@ -2807,6 +2917,7 @@ class TPUScheduler:
                 enc["pod_topo_k"], jnp.asarray(kid_b), jnp.asarray(cnt_b),
             )
             grid_inc = not QUARANTINE.active("grid")
+            t_disp0 = _time.perf_counter()
             spec_states, spec_ys, verdict = ops_solver.solve_kscan_dp(
                 state, xs_b, enc["exist_tensors"], self.it_tensors,
                 enc["template_tensors"], self.well_known, enc["topo_tensors"],
@@ -2815,23 +2926,29 @@ class TPUScheduler:
                 n_domains=len(self.encoder.vocab.values[key]), maxc=maxc,
                 grid_incremental=grid_inc,
             )
-            jax.block_until_ready((spec_states, spec_ys, verdict))
+            self._dp_wait((spec_states, spec_ys, verdict), "kscan_dp.device")
+            disp_s = _time.perf_counter() - t_disp0
             t_sync = _time.perf_counter()
-            (vw,) = fetch_tree([verdict])
+            (vw,) = fetch_tree([verdict], wf_label="kscan_dp.sync_verdict")
             vw = np.asarray(vw)
             n_commit = leading_ones(vw, len(round_groups))
             if stats is not None:
+                dt_sync = _time.perf_counter() - t_sync
                 stats["merge_rounds"] += 1
                 stats["verdict_fetches"] += 1
                 stats["verdict_bytes"] += int(vw.nbytes)
-                stats["sync_blocked_s"] += _time.perf_counter() - t_sync
+                stats["sync_verdict_s"] += dt_sync
+                stats["sync_blocked_s"] += dt_sync
             SHARD_VERDICT_BYTES.inc(int(vw.nbytes))
+            self._dp_round_account(
+                round_groups, n_commit, dp_n, disp_s, lambda _segs: "kscan"
+            )
             for r in range(n_commit):
                 segs = round_groups[r]
                 spec_r, ys_r = ops_solver.take_dp_row(
                     (spec_states, spec_ys), jnp.int32(r)
                 )
-                jax.block_until_ready(ys_r.assignment)
+                self._dp_wait(ys_r.assignment, "kscan_dp.graft")
                 FAULT.point(
                     "solver.merge.commit", segments=len(segs), family="kscan"
                 )
@@ -2846,11 +2963,11 @@ class TPUScheduler:
                     seq_twin = dispatch_kscan(
                         state, segs, key, grid_audit=False
                     )
-                    jax.block_until_ready(seq_twin[0])
+                    self._dp_wait(seq_twin[0], "kscan_dp.audit")
                 state, _shifted, assign = ops_solver.merge_shard_kscan(
                     state, spec_r, ys_r.assignment, base
                 )
-                jax.block_until_ready(state)
+                self._dp_wait(state, "kscan_dp.graft")
                 ys_out = ys_r._replace(assignment=assign)
                 if audit:
                     state, commit_out = self._audit_shard_merge(
@@ -2869,11 +2986,11 @@ class TPUScheduler:
                     remaining[k_] -= hi_ - lo_
                 state = maybe_compact(state)
                 # snapshot + compact drained before the next dispatch
-                jax.block_until_ready((state, tmpl_snaps[-1]))
+                self._dp_wait((state, tmpl_snaps[-1]), "kscan_dp.graft")
             if n_commit < len(round_groups):
                 segs = round_groups[n_commit]
                 state, ys_seq = dispatch_kscan(state, segs, key)
-                jax.block_until_ready(state)  # one-at-a-time rule
+                self._dp_wait(state, "kscan_dp.replay")  # one-at-a-time rule
                 outputs.append(("kscan", segs, ys_seq))
                 SHARD_MERGE_ROUNDS.inc(outcome="replayed", family="kscan")
                 self._shard_account(segs, False, "kscan")
@@ -2882,7 +2999,7 @@ class TPUScheduler:
                     remaining[k_] -= hi_ - lo_
                 state = maybe_compact(state)
                 # snapshot + compact drained before the next dispatch
-                jax.block_until_ready((state, tmpl_snaps[-1]))
+                self._dp_wait((state, tmpl_snaps[-1]), "kscan_dp.replay")
                 gi += n_commit + 1
             else:
                 gi += n_commit
@@ -2938,7 +3055,7 @@ class TPUScheduler:
             round_chunks = chunks[gi : gi + dp_n]
             # same rule as _run_fill_dp: drain in-flight work before the
             # round's collective-bearing dispatch (a wait, not a fetch)
-            jax.block_until_ready(state)
+            self._dp_wait(state, "perpod_dp.drain")
             base = state
             L_max = max(chi - clo for clo, chi in round_chunks)
             # a short round pads to DP rows with zero valid pods (padding
@@ -2959,30 +3076,38 @@ class TPUScheduler:
                     jnp.asarray(nval_b),
                 )
             )
+            t_disp0 = _time.perf_counter()
             spec_states, spec_assign, verdict = ops_solver.solve_perpod_dp(
                 state, pt, tol, it_allow, exist_ok, ports, conf, vols,
                 enc["exist_tensors"], self.it_tensors,
                 enc["template_tensors"], self.well_known,
                 enc["topo_tensors"], ptopo, **common,
             )
-            jax.block_until_ready((spec_states, spec_assign, verdict))
+            self._dp_wait((spec_states, spec_assign, verdict), "perpod_dp.device")
+            disp_s = _time.perf_counter() - t_disp0
             t_sync = _time.perf_counter()
-            (vw,) = fetch_tree([verdict])
+            (vw,) = fetch_tree([verdict], wf_label="perpod_dp.sync_verdict")
             vw = np.asarray(vw)
             n_commit = leading_ones(vw, len(round_chunks))
             if stats is not None:
+                dt_sync = _time.perf_counter() - t_sync
                 stats["merge_rounds"] += 1
                 stats["verdict_fetches"] += 1
                 stats["verdict_bytes"] += int(vw.nbytes)
-                stats["sync_blocked_s"] += _time.perf_counter() - t_sync
+                stats["sync_verdict_s"] += dt_sync
+                stats["sync_blocked_s"] += dt_sync
             SHARD_VERDICT_BYTES.inc(int(vw.nbytes))
+            self._dp_round_account(
+                [[(clo, chi, -1)] for clo, chi in round_chunks],
+                n_commit, dp_n, disp_s, lambda _segs: "perpod",
+            )
             for r in range(n_commit):
                 clo, chi = round_chunks[r]
                 segs = [(clo, chi, -1)]
                 spec_r, assign_r = ops_solver.take_dp_row(
                     (spec_states, spec_assign), jnp.int32(r)
                 )
-                jax.block_until_ready(assign_r)
+                self._dp_wait(assign_r, "perpod_dp.graft")
                 FAULT.point(
                     "solver.merge.commit", segments=1, family="perpod"
                 )
@@ -2993,11 +3118,11 @@ class TPUScheduler:
                     # state (one collective computation in flight at a
                     # time)
                     seq_twin = dispatch_seq(state, clo, chi)
-                    jax.block_until_ready(seq_twin[0])
+                    self._dp_wait(seq_twin[0], "perpod_dp.audit")
                 state, _shifted, assign = ops_solver.merge_shard_kscan(
                     state, spec_r, assign_r, base
                 )
-                jax.block_until_ready(state)  # same one-at-a-time rule
+                self._dp_wait(state, "perpod_dp.graft")  # one-at-a-time rule
                 if audit:
                     state, commit_out = self._audit_shard_merge(
                         state, segs, seq_twin,
@@ -3014,11 +3139,11 @@ class TPUScheduler:
                 np.subtract.at(remaining, kind_of[clo:chi], 1)
                 state = maybe_compact(state)
                 # snapshot + compact drained before the next dispatch
-                jax.block_until_ready((state, tmpl_snaps[-1]))
+                self._dp_wait((state, tmpl_snaps[-1]), "perpod_dp.graft")
             if n_commit < len(round_chunks):
                 clo, chi = round_chunks[n_commit]
                 state, assign_seq = dispatch_seq(state, clo, chi)
-                jax.block_until_ready(state)  # one-at-a-time rule
+                self._dp_wait(state, "perpod_dp.replay")  # one-at-a-time rule
                 outputs.append(("pods", clo, chi, assign_seq))
                 SHARD_MERGE_ROUNDS.inc(outcome="replayed", family="perpod")
                 self._shard_account([(clo, chi, -1)], False, "perpod")
@@ -3026,7 +3151,7 @@ class TPUScheduler:
                 np.subtract.at(remaining, kind_of[clo:chi], 1)
                 state = maybe_compact(state)
                 # snapshot + compact drained before the next dispatch
-                jax.block_until_ready((state, tmpl_snaps[-1]))
+                self._dp_wait((state, tmpl_snaps[-1]), "perpod_dp.replay")
                 gi += n_commit + 1
             else:
                 gi += n_commit
@@ -4207,6 +4332,10 @@ class ResidentSession:
         # host-fallback solves (e.g. DRA) never reach _solve_once, so the
         # wrapped scheduler may not have timings yet
         self.last_timings = dict(getattr(self.sched, "last_timings", {}) or {})
+        if mode == "delta":
+            # a delta round never ran the instrumented full path; don't
+            # carry a stale waterfall from an earlier full round
+            self.last_timings.pop("waterfall", None)
         self.last_timings["resident"] = {
             "mode": mode,
             "reason": reason,

@@ -11,9 +11,19 @@
   named kernels, retrace-storm detection (``KTPU_RETRACE_WARN``),
   per-executable cost analysis, and on-demand ``jax.profiler`` capture
   behind ``/debug/profile``.
+- ``obs.waterfall`` (ISSUE 15): the per-round critical-path waterfall —
+  a reconciled span tree (topology/encode/dispatch/sync/graft/replay/
+  wire/decode + explicit ``other``) stored on each ledger record,
+  rendered by ``python -m karpenter_tpu.obs.ledger timeline --waterfall``;
+  opt-out ``KTPU_WATERFALL=0``.
+- ``obs.bench_diff`` (ISSUE 15): the perf-regression sentinel —
+  ``python -m karpenter_tpu.obs.bench_diff A.json B.json`` diffs two
+  bench stage JSONs segment-by-segment and exits non-zero past
+  ``KTPU_BENCH_DIFF_THRESHOLD``.
 """
 
 from karpenter_tpu.obs.ledger import LEDGER, RoundLedger
 from karpenter_tpu.obs.observatory import named_kernel
+from karpenter_tpu.obs.waterfall import RoundWaterfall
 
-__all__ = ["LEDGER", "RoundLedger", "named_kernel"]
+__all__ = ["LEDGER", "RoundLedger", "RoundWaterfall", "named_kernel"]

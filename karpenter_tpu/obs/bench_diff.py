@@ -1,0 +1,153 @@
+"""Perf-regression sentinel (ISSUE 15): segment-by-segment bench diffs.
+
+``python -m karpenter_tpu.obs.bench_diff A.json B.json`` compares two
+bench stage JSON documents (the files ``bench.py --json-out`` writes, or
+any committed ``BENCH_*.json``) leaf-by-leaf over their TIMING leaves —
+every numeric key ending ``_s``/``_seconds`` plus every waterfall
+``segments`` entry — instead of just end-to-end wall. A leaf regresses
+when B exceeds A by more than the relative threshold AND by more than a
+small absolute floor (sub-5ms jitter on tiny segments must not page
+anyone). Exit status: 0 when nothing regressed (an identical self-diff
+always passes), 1 past the threshold, 2 on unreadable input.
+
+Threshold resolution order: ``--threshold`` flag, then
+``KTPU_BENCH_DIFF_THRESHOLD``, then 0.25 (25%). ``bench.py --baseline``
+runs the same diff in-process against a committed baseline document.
+
+Leaves present in only one document are reported as structural notes,
+never as regressions: a new stage or a renamed segment is a review
+question, not a perf page.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+DEFAULT_THRESHOLD = 0.25
+ENV_THRESHOLD = "KTPU_BENCH_DIFF_THRESHOLD"
+# absolute regression floor: relative noise on microsecond segments is
+# meaningless — a regression must also cost real wall
+MIN_ABS_S = 0.005
+
+
+def threshold_default() -> float:
+    try:
+        return float(os.environ.get(ENV_THRESHOLD, "") or DEFAULT_THRESHOLD)
+    except ValueError:
+        return DEFAULT_THRESHOLD
+
+
+def _timing_leaves(doc, prefix: str = ""):
+    """Yield (path, seconds) for every timing leaf of a bench document:
+    numeric values under keys ending _s/_seconds, and every waterfall
+    segments entry (segment names carry no suffix)."""
+    if isinstance(doc, dict):
+        for k, v in doc.items():
+            path = f"{prefix}.{k}" if prefix else str(k)
+            if isinstance(v, (dict, list)):
+                yield from _timing_leaves(v, path)
+            elif isinstance(v, (int, float)) and not isinstance(v, bool):
+                if str(k).endswith(("_s", "_seconds")) or ".segments." in path:
+                    yield path, float(v)
+    elif isinstance(doc, list):
+        for i, v in enumerate(doc):
+            yield from _timing_leaves(v, f"{prefix}[{i}]")
+
+
+def diff_docs(
+    a: dict, b: dict,
+    threshold: Optional[float] = None,
+    min_abs: float = MIN_ABS_S,
+) -> dict:
+    """Compare every shared timing leaf of two bench documents.
+
+    Returns {"rows": [...], "regressions": [...], "only_a": [...],
+    "only_b": [...]}; a row regresses iff b > a*(1+threshold) and
+    (b - a) > min_abs."""
+    thr = threshold_default() if threshold is None else threshold
+    av = dict(_timing_leaves(a))
+    bv = dict(_timing_leaves(b))
+    rows = []
+    for path in sorted(set(av) & set(bv)):
+        x, y = av[path], bv[path]
+        if x > 0:
+            ratio = y / x
+        else:
+            ratio = float("inf") if y > 0 else 1.0
+        rows.append({
+            "path": path,
+            "a_s": x,
+            "b_s": y,
+            "delta_s": round(y - x, 6),
+            "ratio": round(ratio, 4) if ratio != float("inf") else ratio,
+            "regressed": bool(y > x * (1.0 + thr) and (y - x) > min_abs),
+        })
+    return {
+        "threshold": thr,
+        "min_abs_s": min_abs,
+        "rows": rows,
+        "regressions": [r for r in rows if r["regressed"]],
+        "only_a": sorted(set(av) - set(bv)),
+        "only_b": sorted(set(bv) - set(av)),
+    }
+
+
+def format_report(diff: dict, a_name: str = "A", b_name: str = "B") -> list:
+    """Human-readable report lines for a diff_docs result."""
+    rows = diff["rows"]
+    regs = diff["regressions"]
+    lines = [
+        f"bench_diff: {len(rows)} shared timing leaves, "
+        f"threshold={diff['threshold']:.0%} (+{diff['min_abs_s'] * 1e3:.0f}ms floor)"
+    ]
+    for r in regs:
+        lines.append(
+            f"  REGRESSED {r['path']}: {r['a_s']:.4f}s -> {r['b_s']:.4f}s "
+            f"({r['ratio']:.2f}x, +{r['delta_s']:.4f}s)"
+        )
+    for path in diff["only_a"]:
+        lines.append(f"  note: only in {a_name}: {path}")
+    for path in diff["only_b"]:
+        lines.append(f"  note: only in {b_name}: {path}")
+    if not regs:
+        lines.append("  ok: no segment regressed")
+    return lines
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m karpenter_tpu.obs.bench_diff",
+        description="segment-by-segment bench regression sentinel",
+    )
+    parser.add_argument("a", help="baseline bench JSON")
+    parser.add_argument("b", help="candidate bench JSON")
+    parser.add_argument(
+        "--threshold", type=float, default=None,
+        help=f"relative regression threshold (default ${ENV_THRESHOLD} "
+        f"or {DEFAULT_THRESHOLD})",
+    )
+    parser.add_argument(
+        "--min-abs", type=float, default=MIN_ABS_S,
+        help="absolute regression floor in seconds",
+    )
+    args = parser.parse_args(argv)
+    try:
+        with open(args.a) as fh:
+            doc_a = json.load(fh)
+        with open(args.b) as fh:
+            doc_b = json.load(fh)
+    except (OSError, ValueError) as err:
+        print(f"bench_diff: unreadable input: {err}")
+        return 2
+    diff = diff_docs(doc_a, doc_b, threshold=args.threshold, min_abs=args.min_abs)
+    for line in format_report(diff, args.a, args.b):
+        print(line)
+    return 1 if diff["regressions"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

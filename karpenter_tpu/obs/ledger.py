@@ -211,6 +211,8 @@ def record_solve(sched, *, pods: int, wall_s: float, mode: str = "full",
         stages = _stage_detail(timings)
         if stages:
             rec["stages"] = stages
+        if timings.get("waterfall"):
+            rec["waterfall"] = timings["waterfall"]
     compiles = _drain_compiles()
     if compiles:
         rec["compiles"] = compiles
@@ -242,6 +244,10 @@ def record_session_round(session, *, pods: int, wall_s: float) -> dict:
     stages = _stage_detail(timings)
     if stages:
         rec["stages"] = stages
+    if timings.get("waterfall"):
+        # quarantined / full rounds ran the instrumented full path; delta
+        # rounds already dropped the stale copy session-side
+        rec["waterfall"] = timings["waterfall"]
     audit = getattr(session, "last_audit", None)
     if audit is not None:
         rec["guard"] = {
@@ -319,6 +325,12 @@ def wire_record(rec: dict) -> str:
         body = json.dumps(rec["stages"], sort_keys=True)
         if len(body) < _WIRE_BUDGET:
             out["stages"] = rec["stages"]
+    if "waterfall" in rec:
+        # the bounded columnar waterfall rides whenever the record still
+        # fits the trailing-metadata budget with it aboard
+        trial = dict(out, waterfall=rec["waterfall"])
+        if len(json.dumps(trial, sort_keys=True)) < _WIRE_BUDGET:
+            out = trial
     return json.dumps(out, sort_keys=True, ensure_ascii=True)
 
 
@@ -372,6 +384,9 @@ def timeline_line(rec: dict) -> str:
         flags.append(f"compile={c.get('kernel')}:{c.get('seconds', 0):.2f}s")
     if rec.get("capsule"):
         flags.append(f"capsule={rec['capsule']}")
+    wf = rec.get("waterfall")
+    if wf:
+        flags.append(f"wf_other={wf.get('other_frac', 0.0):.1%}")
     return (
         f"#{rec.get('seq', '?'):>5} {stamp} {rec.get('source', '?'):>6} "
         f"{rec.get('mode', '?'):>11} {str(rec.get('reason', '')):<20} "
@@ -416,6 +431,10 @@ def main(argv: Optional[list] = None) -> int:
     sub = parser.add_subparsers(dest="cmd", required=True)
     tl = sub.add_parser("timeline", help="reconstruct the incident timeline")
     tl.add_argument("-n", type=int, default=None, help="last N rounds only")
+    tl.add_argument(
+        "--waterfall", action="store_true",
+        help="render each round's ASCII critical-path waterfall",
+    )
     mat = sub.add_parser(
         "materialize",
         help="emit a guard-replay bundle for one recorded round",
@@ -435,6 +454,11 @@ def main(argv: Optional[list] = None) -> int:
         window = records if args.n is None else records[-args.n:]
         for rec in window:
             print(timeline_line(rec))
+            if args.waterfall and rec.get("waterfall"):
+                from karpenter_tpu.obs import waterfall as wf_mod
+
+                for line in wf_mod.render(rec["waterfall"]):
+                    print("       " + line)
         if not window:
             print(f"(no spilled rounds under {d})")
         return 0

@@ -442,7 +442,7 @@ def _canonicalize_for_wire(arrs):
     return out
 
 
-def fetch_tree(tree):
+def fetch_tree(tree, wf_label="wire"):
     """Batched device->host transfer of an arbitrary pytree.
 
     Per-array `np.asarray` pays a full host<->device round trip PER LEAF —
@@ -453,7 +453,22 @@ def fetch_tree(tree):
     The packing itself is jit-compiled per leaf-shape signature — done
     eagerly it costs one tunneled dispatch PER OP, and interleaved solves
     fetch hundreds of leaves. Non-array leaves pass through untouched.
+
+    The blocked host time is attributed to the active round waterfall
+    under `wf_label` — callers with a more specific seam (the dp merge
+    loops' verdict sync) relabel it so wire vs sync stay separable.
     """
+    import time as _time
+
+    from karpenter_tpu.obs import waterfall as _waterfall
+
+    t0 = _time.perf_counter()
+    out = _fetch_tree_impl(tree)
+    _waterfall.add_current(wf_label, _time.perf_counter() - t0)
+    return out
+
+
+def _fetch_tree_impl(tree):
     import jax
     import numpy as np
 

@@ -38,11 +38,35 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from karpenter_tpu.obs import waterfall as _waterfall
 from karpenter_tpu.obs.observatory import named_kernel
 from karpenter_tpu.ops import kernels
 from karpenter_tpu.ops import topology as topo_ops
 from karpenter_tpu.ops.encode import INT_MAX, INT_MIN, InstanceTypeTensors, PodTensors, ReqSetTensors
 from karpenter_tpu.ops.topology import PodTopology, TopologyTensors
+
+
+def _wf_timed(name):
+    """Attribute the host-side cost of one dispatch entry point (trace,
+    jit-cache lookup, enqueue — execution itself is async) to the active
+    round waterfall as an `enqueue.<name>` leaf; the device-side wall
+    surfaces later under the drain/wire leaves that observe it. No-op
+    outside a round (one contextvar read)."""
+    import time as _time
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            t0 = _time.perf_counter()
+            out = fn(*args, **kwargs)
+            _waterfall.add_current(
+                f"enqueue.{name}", _time.perf_counter() - t0
+            )
+            return out
+
+        return wrapped
+
+    return deco
 
 
 def _ambient_mesh():
@@ -792,6 +816,7 @@ def _bank_rows(state: SolverState, idx: jnp.ndarray, topo_kids: tuple):
     return out
 
 
+@_wf_timed("compact_state")
 @named_kernel("compact_state")
 @functools.partial(jax.jit, static_argnames=("n_claims", "topo_kids"))
 def compact_state(
@@ -972,6 +997,7 @@ _STATIC = (
 )
 
 
+@_wf_timed("solve")
 @named_kernel("solve")
 @functools.partial(jax.jit, static_argnames=_STATIC)
 def solve(
@@ -1016,6 +1042,7 @@ def solve(
     return SolveResult(assignment=assignment, claims=state)
 
 
+@_wf_timed("solve_from")
 @named_kernel("solve_from")
 @functools.partial(jax.jit, static_argnames=_STATIC)
 def solve_from(
@@ -1682,6 +1709,7 @@ def _make_fill_step(
 _FILL_STATIC = ("zone_kid", "ct_kid", "n_claims")
 
 
+@_wf_timed("solve_fill")
 @named_kernel("solve_fill")
 @functools.partial(jax.jit, static_argnames=_FILL_STATIC)
 def solve_fill(
@@ -1771,6 +1799,7 @@ class ShardFillState(NamedTuple):
     hg_counts: jnp.ndarray  # [NGh, E + NCAP + 1]
 
 
+@_wf_timed("solve_fill_dp")
 @named_kernel("solve_fill_dp")
 @functools.partial(jax.jit, static_argnames=_FILL_STATIC)
 def solve_fill_dp(
@@ -2106,6 +2135,7 @@ def _merge_hg_delta(committed, spec_hg, base, delta, spec_n_open):
     )
 
 
+@_wf_timed("merge_shard_fill")
 @jax.jit
 def merge_shard_fill(
     committed: SolverState,
@@ -2316,6 +2346,7 @@ def _make_gang_step(
 _GANG_STATIC = ("zone_kid", "ct_kid", "n_claims", "maxg")
 
 
+@_wf_timed("solve_gang")
 @named_kernel("solve_gang")
 @functools.partial(jax.jit, static_argnames=_GANG_STATIC)
 def solve_gang(
@@ -3052,6 +3083,7 @@ _KSCAN_STATIC = (
 )
 
 
+@_wf_timed("solve_kind_scan")
 @named_kernel("solve_kind_scan")
 @functools.partial(jax.jit, static_argnames=_KSCAN_STATIC)
 def solve_kind_scan(
@@ -3186,6 +3218,7 @@ def _kscan_rows_dead(used, its, open_mask, it, r_min, key_kid, zone_kid, D):
     return ~jnp.any(open_mask & jnp.any(capd > 0, axis=-1))
 
 
+@_wf_timed("solve_kscan_dp")
 @named_kernel("solve_kscan_dp")
 @functools.partial(jax.jit, static_argnames=_KSCAN_STATIC)
 def solve_kscan_dp(
@@ -3268,6 +3301,7 @@ def solve_kscan_dp(
     return spec, ys, verdict
 
 
+@_wf_timed("merge_shard_kscan")
 @jax.jit
 def merge_shard_kscan(
     committed: SolverState,
@@ -3322,6 +3356,7 @@ def merge_shard_kscan(
 # shift their fresh columns, add in place on [0, E)).
 
 
+@_wf_timed("solve_perpod_dp")
 @named_kernel("solve_perpod_dp")
 @functools.partial(jax.jit, static_argnames=_STATIC)
 def solve_perpod_dp(

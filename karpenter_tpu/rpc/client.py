@@ -341,7 +341,19 @@ class RemoteScheduler:
                 kwargs["metadata"] = md
             with SOLVER_RPC_DURATION.time(method="SolveStream"):
                 call = self._solve_stream(req, **kwargs)
-                for frame in call:
+                # explicit iteration so the time this client spends
+                # BLOCKED on the transport (waiting for the next frame,
+                # vs host-side stitching between frames) is attributed —
+                # it is the remote round's wire segment
+                wire_blocked_s = 0.0
+                frames = iter(call)
+                while True:
+                    t_wire = time.perf_counter()
+                    try:
+                        frame = next(frames)
+                    except StopIteration:
+                        break
+                    wire_blocked_s += time.perf_counter() - t_wire
                     # the mid-stream cut point: an injected UNAVAILABLE
                     # here simulates the transport dying at chunk <index>
                     FAULT.point("rpc.stream.chunk", index=stitcher.n_chunks)
@@ -355,7 +367,11 @@ class RemoteScheduler:
                     self._absorb_trailing(call.trailing_metadata())
         if stitcher.final is None:
             raise RuntimeError("SolveStream ended without a final frame")
+        from karpenter_tpu.obs import waterfall as _wfl
+
+        _wfl.add_current("rpc.wire", wire_blocked_s)
         self.last_stream = stitcher.stats()
+        self.last_stream["wire_blocked_s"] = round(wire_blocked_s, 6)
         if stitcher.full:
             return stitcher.final, None
         return stitcher.final, stitcher.tables()
@@ -483,6 +499,9 @@ class RemoteScheduler:
         bound_pods=None,
     ) -> SchedulingResult:
         t0 = time.perf_counter()
+        # fresh per solve: a unary-downgraded call must not inherit the
+        # previous stream solve's frame stats / wire attribution
+        self.last_stream = {}
         req = pb.SolveRequest(config_version=self._config_version)
         if dra_problem is not None and any(p.spec.resource_claims for p in pods):
             # the DRAProblem is a self-contained snapshot (slices, classes,
@@ -623,6 +642,11 @@ class RemoteScheduler:
             "device_s": t_rpc - t_encode,  # wire + remote solve
             "decode_s": t_end - t_rpc,
         }
+        wire_s = (getattr(self, "last_stream", None) or {}).get("wire_blocked_s")
+        if wire_s is not None:
+            # the transport-blocked share of device_s: frame waits measured
+            # inside _consume_stream (the remote round's wire attribution)
+            self.last_timings["rpc_wire_s"] = wire_s
         return result
 
     def whatif_batch(

@@ -559,3 +559,22 @@ JIT_RETRACE_STORMS = REGISTRY.counter(
     " incremented once per kernel per storm detection",
     ("kernel",),
 )
+# ---- critical-path waterfall + dp utilization (obs/waterfall.py, PR 15) ----
+ROUND_SEGMENT_SECONDS = REGISTRY.histogram(
+    "ktpu_round_segment_seconds",
+    "Per-round critical-path waterfall segment self-times"
+    " (obs/waterfall.py): topology, encode, per-mode dispatch enqueue,"
+    " dp-merge device waits / verdict syncs / grafts / replays, wire,"
+    " decode — plus the reconciled 'other' remainder, which tests pin"
+    " at <=5% of the round wall",
+    ("segment",),
+)
+SHARD_DP_UTILIZATION = REGISTRY.gauge(
+    "ktpu_shard_dp_utilization",
+    "Fraction of speculative dp rows in the last meshed solve by state:"
+    " committed (the row's chunk group grafted — useful work), replayed"
+    " (a verdict bit refused the row and its group re-ran sequentially),"
+    " idle (dispatch padding — fewer ready groups than dp rows); the"
+    " three fractions sum to 1 whenever any merge round ran",
+    ("state",),
+)

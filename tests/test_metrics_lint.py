@@ -231,6 +231,30 @@ def test_observability_families_are_registered():
         assert fam.help.strip()
 
 
+def test_waterfall_families_are_registered():
+    """ISSUE-15 families: the per-round critical-path segment histogram
+    (obs/waterfall.py) and the dp-row utilization gauge, with the
+    documented types and labels. The segment histogram's help must name
+    the reconciled 'other' remainder — it is the instrument's whole
+    point — and the utilization gauge's help must enumerate its states."""
+    from karpenter_tpu.utils.metrics import Gauge
+
+    fams = {f.name: f for f in _families()}
+    expected = {
+        "ktpu_round_segment_seconds": (Histogram, ("segment",)),
+        "ktpu_shard_dp_utilization": (Gauge, ("state",)),
+    }
+    for name, (cls, labels) in expected.items():
+        fam = fams.get(name)
+        assert fam is not None, f"{name} not registered"
+        assert isinstance(fam, cls), (name, type(fam).__name__)
+        assert fam.label_names == labels, (name, fam.label_names)
+        assert fam.help.strip()
+    assert "other" in fams["ktpu_round_segment_seconds"].help
+    for state in ("committed", "replayed", "idle"):
+        assert state in fams["ktpu_shard_dp_utilization"].help, state
+
+
 def test_counters_end_in_total_and_histograms_in_seconds_or_pods():
     """Unit-suffix discipline for NEW families (grandfathered names keep
     their reference spellings verbatim)."""

@@ -172,6 +172,47 @@ class TestResidentRounds:
         r4 = obs_ledger.LEDGER.last()
         assert r4["mode"] == "quarantined" and r4["reason"] == "quarantined"
 
+    def test_quarantined_round_carries_waterfall_and_survives_spill(
+        self, monkeypatch, tmp_path, capsys
+    ):
+        """ISSUE-15 satellite: a quarantined round runs the full
+        instrumented path, so its ledger record must carry the waterfall
+        and per-phase timings — and mode + gate reason must survive the
+        JSONL spill and the timeline CLI's reconstruction."""
+        monkeypatch.setenv(obs_ledger.ENV_DIR, str(tmp_path))
+        session = session_scheduler(monkeypatch)
+        base = kind_pods("a", 10)
+        session.solve(list(base))
+        guard.QUARANTINE.trip("resident", reason="test", ttl_s=60.0)
+        session.solve(list(base + kind_pods("b", 4)))
+        rec = obs_ledger.LEDGER.last()
+        assert rec["mode"] == "quarantined" and rec["reason"] == "quarantined"
+        assert "device_s" in rec, "quarantined rounds keep per-phase timings"
+        wf = rec.get("waterfall")
+        assert wf, "quarantined rounds run the instrumented full path"
+        assert "other" in wf["segments"]
+        # telescoping reconciliation: segments (other included) sum to wall
+        assert abs(sum(wf["segments"].values()) - wf["wall_s"]) < 1e-3
+
+        spilled = [
+            r for r in obs_ledger.load_spilled(str(tmp_path))
+            if r.get("seq") == rec["seq"]
+        ]
+        assert spilled, "quarantined round must spill"
+        srec = spilled[-1]
+        assert srec["mode"] == "quarantined"
+        assert srec["reason"] == "quarantined"
+        assert srec.get("waterfall", {}).get("segments")
+
+        code = obs_ledger.main(
+            ["--dir", str(tmp_path), "timeline", "--waterfall"]
+        )
+        assert code == 0
+        out = capsys.readouterr().out
+        assert "quarantined" in out
+        assert "wf_other=" in out
+        assert "waterfall wall=" in out  # the ASCII render under the line
+
     def test_plain_solve_records_one_round(self, monkeypatch):
         sched = TPUScheduler(make_templates(), max_claims=128)
         pods = kind_pods("a", 6)
