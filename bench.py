@@ -491,6 +491,188 @@ def run_steady_stage(
     }
 
 
+# the fleet chaos gate: p95 per-delta latency of the surviving replicas
+# must stay within this factor of the single-replica steady p95 (the
+# handoff round itself is reported separately as handoff_s)
+FLEET_MAX_P95_RATIO = 2.0
+
+
+def run_fleet_stage(
+    resident_pods=768,
+    delta_pods=24,
+    rounds=10,
+    seed=0,
+    kill_round=4,
+    max_claims=1024,
+):
+    """--fleet (ISSUE 16): multi-replica chaos under Poisson arrivals.
+
+    Two in-process solver replicas share a guardrail bus; a client runs
+    the steady Poisson trace against replica A alone (the latency
+    yardstick), then a second client runs the same trace against the
+    "A,B" routing front while A is killed mid-stream. The killed
+    replica's resident session must hand off to B via the bus's capsule
+    transcript (rebuilt fingerprint == the lost chain, counted in
+    ktpu_fleet_handoffs_total{outcome="adopted"}), zero rounds may be
+    lost, chaos p95 per-delta latency must stay within
+    FLEET_MAX_P95_RATIO of the steady p95, and a quarantine trip on A's
+    breaker must reach B's within one bus pump."""
+    import numpy as np
+
+    from karpenter_tpu.envelope.sampler import measured
+    from karpenter_tpu.fleet import FleetMember, InProcessHub
+    from karpenter_tpu.guard.quarantine import Quarantine
+    from karpenter_tpu.models.pod import make_pod
+    from karpenter_tpu.rpc import client as rpc_client
+    from karpenter_tpu.rpc.client import RemoteScheduler
+    from karpenter_tpu.rpc.service import SolverService, serve
+    from karpenter_tpu.utils.metrics import (
+        FLEET_BUS_MESSAGES,
+        FLEET_HANDOFFS,
+        FLEET_RETARGETS,
+    )
+
+    def kind_batch(name, n):
+        out = []
+        for i in range(n):
+            p = make_pod(f"{name}-{i}", cpu=1.0, memory="1Gi")
+            p.metadata.labels = {"app": name}
+            out.append(p)
+        return out
+
+    rng = np.random.default_rng(seed)
+    kind_size = 256
+    base = []
+    for k in range(max(resident_pods // kind_size, 1)):
+        base.extend(kind_batch(f"base-{k}", kind_size))
+    templates = make_templates(100)
+
+    hub = InProcessHub()
+    # distinct Quarantine instances per replica: both replicas live in
+    # THIS process, where the global breaker is shared — propagation
+    # through the bus would be trivially true without this split
+    qa, qb = Quarantine(), Quarantine()
+    ma = FleetMember(hub, "bench-a", quarantine=qa)
+    mb = FleetMember(hub, "bench-b", quarantine=qb)
+    server_a, addr_a = serve(service=SolverService(fleet=ma))
+    server_b, addr_b = serve(service=SolverService(fleet=mb))
+
+    outcomes = (
+        "adopted", "no_capsule", "fingerprint_mismatch",
+        "replay_failed", "shape_mismatch",
+    )
+    h0 = {o: FLEET_HANDOFFS.get(outcome=o) for o in outcomes}
+    rt0 = FLEET_RETARGETS.get(reason="transport") + FLEET_RETARGETS.get(
+        reason="circuit_open"
+    )
+    # fast failover for the bench: one transport retry, short backoff
+    saved = (
+        rpc_client.TRANSPORT_RETRIES,
+        rpc_client.RETRY_BASE_SECONDS,
+        rpc_client.RETRY_CAP_SECONDS,
+    )
+    rpc_client.TRANSPORT_RETRIES = 1
+    rpc_client.RETRY_BASE_SECONDS = 0.05
+    rpc_client.RETRY_CAP_SECONDS = 0.1
+    envelope = {}
+    try:
+        with measured(envelope, stage=f"fleet_{resident_pods}x{delta_pods}"):
+            # phase 1: single-replica steady trace — the latency yardstick
+            c1 = RemoteScheduler(addr_a, templates, max_claims=max_claims)
+            c1.solve(list(base))
+            live: list[list] = []
+            lat_steady: list[float] = []
+            for rnd in range(rounds):
+                live.append(
+                    kind_batch(f"s{rnd}", max(int(rng.poisson(delta_pods)), 1))
+                )
+                union = base + [p for b in live for p in b]
+                t0 = time.perf_counter()
+                res = c1.solve(list(union))
+                lat_steady.append(time.perf_counter() - t0)
+                assert not res.unschedulable
+            # phase 2: the same trace against the A,B front; A dies
+            # mid-stream and its session must hand off to B
+            c2 = RemoteScheduler(
+                f"{addr_a},{addr_b}", templates, max_claims=max_claims
+            )
+            c2.solve(list(base))
+            live2: list[list] = []
+            lat_chaos: list[float] = []
+            killed, handoff_s, solved = False, None, 0
+            for rnd in range(rounds):
+                if rnd == kill_round:
+                    server_a.stop(0)
+                    killed = True
+                live2.append(
+                    kind_batch(f"c{rnd}", max(int(rng.poisson(delta_pods)), 1))
+                )
+                union = base + [p for b in live2 for p in b]
+                t0 = time.perf_counter()
+                res = c2.solve(list(union))
+                dt = time.perf_counter() - t0
+                assert not res.unschedulable, f"chaos round {rnd} lost pods"
+                solved += 1
+                if killed and handoff_s is None:
+                    handoff_s = dt  # the failover round: retarget + adopt
+                else:
+                    lat_chaos.append(dt)
+            # fleet-wide quarantine: trip A's breaker, B must observe it
+            # within one pump (== one solve round)
+            qa.trip("resident", reason="bench-chaos")
+            mb.pump()
+            quarantine_propagated = qb.active("resident")
+    finally:
+        (
+            rpc_client.TRANSPORT_RETRIES,
+            rpc_client.RETRY_BASE_SECONDS,
+            rpc_client.RETRY_CAP_SECONDS,
+        ) = saved
+        for srv in (server_a, server_b):
+            try:
+                srv.stop(0)
+            except Exception:
+                pass
+        ma.close()
+        mb.close()
+    handoffs = {
+        o: int(FLEET_HANDOFFS.get(outcome=o) - h0[o]) for o in outcomes
+    }
+    assert handoffs["adopted"] >= 1, f"no session adopted: {handoffs}"
+    assert quarantine_propagated, "quarantine trip did not cross the bus"
+    p95_steady = float(np.percentile(np.asarray(lat_steady), 95))
+    p95_chaos = float(np.percentile(np.asarray(lat_chaos), 95))
+    ratio = round(p95_chaos / p95_steady, 2) if p95_steady > 0 else float("inf")
+    return {
+        "resident_pods": len(base),
+        "delta_pods": delta_pods,
+        "rounds": rounds,
+        "seed": seed,
+        "kill_round": kill_round,
+        "rounds_lost": rounds - solved,
+        "p95_steady_s": round(p95_steady, 4),
+        "p95_chaos_s": round(p95_chaos, 4),
+        "handoff_s": round(handoff_s, 4) if handoff_s is not None else None,
+        "p95_ratio": ratio,
+        "gate_max_ratio": FLEET_MAX_P95_RATIO,
+        "gate_ok": ratio <= FLEET_MAX_P95_RATIO,
+        "handoffs": handoffs,
+        "retargets": int(
+            FLEET_RETARGETS.get(reason="transport")
+            + FLEET_RETARGETS.get(reason="circuit_open")
+            - rt0
+        ),
+        "quarantine_propagated": quarantine_propagated,
+        "bus_published": int(
+            sum(
+                FLEET_BUS_MESSAGES.get(topic=t, direction="published")
+                for t in ("quarantine", "audit", "session", "compile")
+            )
+        ),
+        **envelope,
+    }
+
+
 def run_whatif_stage(n_candidates, seq_sample=8):
     """Batched vs sequential consolidation what-ifs (the §2.6 tensorization:
     one vmapped dispatch vs N sequential re-solves)."""
@@ -1243,6 +1425,14 @@ def main() -> None:
         "non-zero",
     )
     parser.add_argument(
+        "--fleet",
+        action="store_true",
+        help="fleet chaos mode (ISSUE 16): two in-process solver replicas "
+        "on a shared guardrail bus; kill replica A mid-stream under a "
+        "seeded Poisson trace and report failover p95 per-delta latency, "
+        "capsule-handoff counts, and quarantine propagation",
+    )
+    parser.add_argument(
         "--guard",
         action="store_true",
         help="guardrails mode (ISSUE 10): assert the disabled-audit gates "
@@ -1290,6 +1480,18 @@ def main() -> None:
                     "metric": "chaos_smoke",
                     "platform": platform,
                     "detail": run_chaos_stage(on_tpu),
+                }
+            )
+        )
+        return
+
+    if args.fleet:
+        print(
+            json.dumps(
+                {
+                    "metric": "fleet_chaos",
+                    "platform": platform,
+                    "detail": run_fleet_stage(seed=args.steady_seed),
                 }
             )
         )

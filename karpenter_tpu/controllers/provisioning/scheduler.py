@@ -4424,6 +4424,31 @@ class ResidentSession:
             h.update(rec["sig"])
         return h.hexdigest()
 
+    @classmethod
+    def replay_chain(cls, sched, pods_by_uid, existing, rounds):
+        """Rebuild a resident session by replaying a cumulative capsule
+        transcript (obs.ledger.session_chain_transcript form: round k's
+        entry is every uid resident after round k, in arrival order).
+
+        Each replayed round re-runs the same gates the original session
+        ran, so a chain whose rounds all stayed resident reproduces the
+        identical round-sig sequence — the caller checks fingerprint
+        equality against the lost session before trusting the rebuild.
+        Returns None when any replayed round comes back unschedulable or a
+        transcript uid has no pod in the capsule (a truncated/foreign
+        capsule cannot be adopted)."""
+        session = cls(sched)
+        for uids in rounds:
+            try:
+                pods = [pods_by_uid[u] for u in uids]
+            except KeyError:
+                return None
+            exist = [n.clone() for n in existing]
+            result = session.solve(pods, exist)
+            if result.unschedulable:
+                return None
+        return session
+
     # -- full path ---------------------------------------------------------
 
     def _solve_full(self, pods, existing_nodes, kwargs, capture: bool):

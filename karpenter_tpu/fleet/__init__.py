@@ -1,0 +1,26 @@
+"""Fleet-scale serving: N solver replicas behind a routing front.
+
+The vertical stack (encode -> device solve -> decode, resident sessions,
+guardrails, the round ledger) is all per-process. This package is the
+horizontal layer over it:
+
+* ``bus`` — a pluggable guardrail bus (in-process hub for tests, an
+  append-only file backend for multi-process fleets) carrying quarantine
+  trips, audit verdicts, session capsules, and compile-cache warmups.
+* ``mobility`` — capsule-based session mobility: a lost resident session
+  is rebuilt on a new replica by replaying the ledger's cumulative
+  transcript; the rebuilt blake2s round-sig chain must equal the lost
+  fingerprint before the replica trusts it.
+* ``member`` — one replica's bus endpoint: wires the guard/obs listener
+  hooks to the bus, pumps remote messages, archives peers' session
+  capsules for adoption.
+* ``admission`` — a bounded per-replica solve queue with per-tenant fair
+  ordering; overload sheds the oldest waiting round onto the host-solve
+  ladder instead of stalling (``ktpu_fleet_shed_total``).
+"""
+
+from karpenter_tpu.fleet.admission import AdmissionQueue
+from karpenter_tpu.fleet.bus import FileBus, InProcessHub
+from karpenter_tpu.fleet.member import FleetMember
+
+__all__ = ["AdmissionQueue", "FileBus", "InProcessHub", "FleetMember"]

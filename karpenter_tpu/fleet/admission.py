@@ -1,0 +1,75 @@
+"""Admission control: a bounded per-replica solve queue.
+
+One solve runs on the device at a time (that serialization already
+exists — the service's solve lock); admission control decides what the
+OTHERS do while they wait. The queue holds at most ``capacity`` waiting
+rounds with per-tenant fair ordering (round-robin across tenants, FIFO
+within one); when a new round arrives over a full queue, the OLDEST
+waiting round is shed — its caller gets the "shed" verdict and re-routes
+onto the existing host-solve ladder instead of stalling, counted in
+``ktpu_fleet_shed_total{reason="queue_full"}``. Shedding the oldest (not
+the newcomer) bounds every round's queue time: a round either reaches
+the device within ~capacity turns or degrades to a host solve, and a
+single tenant flooding the queue cannot starve the others past its
+round-robin share.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class AdmissionQueue:
+    def __init__(self, capacity: int):
+        self.capacity = max(1, int(capacity))
+        self._cond = threading.Condition()
+        self._running = False
+        self._waiting: list = []  # arrival order; entries: {tenant, verdict}
+        self._rr_last: str = ""
+        self.shed_count = 0
+
+    def acquire(self, tenant: str) -> str:
+        """Block until this round may run ("run") or is shed ("shed").
+        A "run" verdict holds the solve slot: the caller MUST release().
+        A "shed" verdict holds nothing — go straight to the host ladder."""
+        with self._cond:
+            if not self._running and not self._waiting:
+                self._running = True
+                return "run"
+            if len(self._waiting) >= self.capacity:
+                oldest = self._waiting.pop(0)
+                oldest["verdict"] = "shed"
+                self.shed_count += 1
+                self._cond.notify_all()
+            entry = {"tenant": tenant, "verdict": None}
+            self._waiting.append(entry)
+            while entry["verdict"] is None:
+                self._cond.wait()
+            return entry["verdict"]
+
+    def release(self) -> None:
+        """Free the solve slot and hand it to the fairest waiter: the
+        first round of the next tenant after the last-served one."""
+        with self._cond:
+            if not self._waiting:
+                self._running = False
+                return
+            tenants = []
+            for e in self._waiting:
+                if e["tenant"] not in tenants:
+                    tenants.append(e["tenant"])
+            if self._rr_last in tenants:
+                pick = tenants[(tenants.index(self._rr_last) + 1) % len(tenants)]
+            else:
+                pick = tenants[0]
+            for i, e in enumerate(self._waiting):
+                if e["tenant"] == pick:
+                    entry = self._waiting.pop(i)
+                    break
+            entry["verdict"] = "run"
+            self._rr_last = pick
+            self._cond.notify_all()
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._waiting)

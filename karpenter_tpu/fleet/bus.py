@@ -1,0 +1,94 @@
+"""Pluggable guardrail bus backends.
+
+A bus is an append-only per-topic log with offset-based consumption:
+
+    publish(topic, msg)            # msg is a JSON-serializable dict
+    fetch(topic, offset) -> (msgs, new_offset)
+
+Consumers own their offsets (each FleetMember remembers where it is per
+topic), so the bus itself is stateless about subscribers — a replica that
+restarts simply re-reads from 0 and skips its own origin ids. Two
+backends:
+
+* ``InProcessHub`` — a dict of lists; the test double and the backend
+  for co-located replicas in one process (bench --fleet).
+* ``FileBus`` — one JSONL file per topic in a shared directory; each
+  publish is a single O_APPEND write (atomic for line-sized payloads on
+  local filesystems), each fetch resumes from a byte offset and only
+  consumes complete lines, so a torn tail line is re-read next pump.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import List, Tuple
+
+TOPICS = ("quarantine", "audit", "session", "compile")
+
+
+class InProcessHub:
+    """Shared-memory bus: every member holds a reference to the same hub."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._topics: dict = {}
+
+    def publish(self, topic: str, msg: dict) -> None:
+        with self._lock:
+            self._topics.setdefault(topic, []).append(dict(msg))
+
+    def fetch(self, topic: str, offset: int) -> Tuple[List[dict], int]:
+        with self._lock:
+            log = self._topics.get(topic, ())
+            msgs = [dict(m) for m in log[offset:]]
+            return msgs, len(log)
+
+
+class FileBus:
+    """Shared-directory bus for multi-process fleets (KTPU_FLEET_BUS=file,
+    KTPU_FLEET_BUS_DIR=<dir>)."""
+
+    def __init__(self, dirpath: str):
+        self._dir = dirpath
+        os.makedirs(dirpath, exist_ok=True)
+
+    def _path(self, topic: str) -> str:
+        # topics are a closed internal vocabulary, but never let a
+        # malformed one escape the bus directory
+        safe = "".join(c for c in topic if c.isalnum() or c in "-_")
+        return os.path.join(self._dir, f"{safe}.jsonl")
+
+    def publish(self, topic: str, msg: dict) -> None:
+        line = json.dumps(msg, sort_keys=True) + "\n"
+        fd = os.open(self._path(topic), os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            os.write(fd, line.encode())
+        finally:
+            os.close(fd)
+
+    def fetch(self, topic: str, offset: int) -> Tuple[List[dict], int]:
+        path = self._path(topic)
+        try:
+            with open(path, "rb") as fh:
+                fh.seek(offset)
+                chunk = fh.read()
+        except FileNotFoundError:
+            return [], offset
+        if not chunk:
+            return [], offset
+        # only complete lines; a partial tail (a concurrent publish in
+        # flight) stays unconsumed until it gains its newline
+        end = chunk.rfind(b"\n")
+        if end < 0:
+            return [], offset
+        msgs = []
+        for raw in chunk[: end + 1].splitlines():
+            if not raw.strip():
+                continue
+            try:
+                msgs.append(json.loads(raw))
+            except ValueError:
+                continue  # skip a corrupt line rather than wedge the pump
+        return msgs, offset + end + 1

@@ -1,0 +1,176 @@
+"""One replica's endpoint on the guardrail bus.
+
+A ``FleetMember`` is the glue between a replica's in-process guardrails
+and the fleet: it subscribes to the local listener hooks (quarantine
+trips, audit verdicts, fresh jit compiles) and republishes them on the
+bus; ``pump()`` drains the bus and applies peers' messages locally —
+a remote quarantine trip trips the local breaker (source="remote", so it
+is not re-published in a loop), peers' session capsules go into an
+archive the RPC service adopts from on SESSION_LOST, and compile
+announcements mark kernel keys warm (``ktpu_fleet_warm_announced_total``
+— a replica sharing a persistent compile cache knows the key is already
+paid for).
+
+The member also archives its OWN published capsules: a single replica
+whose registry evicted a session (chaos ``rpc.session.evict``, LRU
+capacity) can re-adopt from its own archive without any peer.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+from collections import deque
+from typing import Optional
+
+from karpenter_tpu.fleet import bus as bus_mod
+from karpenter_tpu.fleet import mobility
+from karpenter_tpu.utils.metrics import (
+    FLEET_BUS_MESSAGES,
+    FLEET_WARM_ANNOUNCED,
+)
+
+_MAX_ARCHIVE = 64
+_MAX_REMOTE_AUDITS = 256
+
+
+class FleetMember:
+    def __init__(self, bus, replica_id: str = "", quarantine=None):
+        from karpenter_tpu.guard import audit as guard_audit
+        from karpenter_tpu.guard.quarantine import QUARANTINE
+        from karpenter_tpu.obs import observatory
+
+        self.bus = bus
+        self.replica_id = replica_id or f"{os.getpid()}-{uuid.uuid4().hex[:6]}"
+        self._quarantine = QUARANTINE if quarantine is None else quarantine
+        self._lock = threading.Lock()
+        self._offsets = {t: 0 for t in bus_mod.TOPICS}
+        #: sid -> (fingerprint, capsule doc) for adoption, newest wins
+        self._archive: "dict" = {}
+        self._archive_order: deque = deque()
+        #: fingerprints already published per sid (skip unchanged rounds)
+        self._published_fpr: dict = {}
+        self.remote_audits: deque = deque(maxlen=_MAX_REMOTE_AUDITS)
+        self.warm_kernels: set = set()
+        self._closed = False
+        self._quarantine.add_listener(self._on_trip)
+        guard_audit.add_audit_listener(self._on_audit)
+        observatory.add_compile_listener(self._on_compile)
+
+    # -- local guardrails -> bus -------------------------------------------
+
+    def _publish(self, topic: str, msg: dict) -> None:
+        msg = dict(msg, origin=self.replica_id)
+        try:
+            self.bus.publish(topic, msg)
+        except Exception:
+            return  # the bus must never take the solve path down with it
+        FLEET_BUS_MESSAGES.inc(topic=topic, direction="published")
+
+    def _on_trip(self, path: str, reason: str, ttl: float, source: str) -> None:
+        if source != "local":
+            return  # remote trips came FROM the bus; don't echo them back
+        self._publish(
+            "quarantine", {"path": path, "reason": reason, "ttl_s": ttl}
+        )
+
+    def _on_audit(self, path: str, verdict: str, reason: str) -> None:
+        self._publish(
+            "audit", {"path": path, "verdict": verdict, "reason": reason}
+        )
+
+    def _on_compile(self, note: dict) -> None:
+        self._publish("compile", note)
+
+    def publish_session(self, sid: str, session) -> None:
+        """Announce this session's current capsule (skipped when nothing
+        is resident or the chain has not advanced since the last one)."""
+        fpr = session.fingerprint
+        if not fpr or self._published_fpr.get(sid) == fpr:
+            return
+        doc = mobility.export_session(sid, session)
+        if doc is None:
+            return
+        self._published_fpr[sid] = fpr
+        # own archive first: a local eviction can re-adopt without peers
+        self._archive_put(sid, fpr, doc)
+        self._publish("session", {"sid": sid, "fpr": fpr, "doc": doc})
+
+    # -- bus -> local -------------------------------------------------------
+
+    def pump(self) -> int:
+        """Drain every topic and apply peers' messages. Returns how many
+        foreign messages were applied (cheap no-op when the bus is idle —
+        the service calls this once per solve round)."""
+        applied = 0
+        for topic in bus_mod.TOPICS:
+            with self._lock:
+                offset = self._offsets[topic]
+            try:
+                msgs, new_offset = self.bus.fetch(topic, offset)
+            except Exception:
+                continue
+            with self._lock:
+                self._offsets[topic] = new_offset
+            for msg in msgs:
+                if msg.get("origin") == self.replica_id:
+                    continue
+                FLEET_BUS_MESSAGES.inc(topic=topic, direction="received")
+                self._apply(topic, msg)
+                applied += 1
+        return applied
+
+    def _apply(self, topic: str, msg: dict) -> None:
+        origin = msg.get("origin", "?")
+        if topic == "quarantine":
+            path = msg.get("path")
+            if path:
+                reason = msg.get("reason", "")
+                self._quarantine.trip(
+                    path,
+                    reason=f"fleet:{origin}:{reason}" if reason else f"fleet:{origin}",
+                    ttl_s=msg.get("ttl_s"),
+                    source="remote",
+                )
+        elif topic == "audit":
+            self.remote_audits.append(dict(msg))
+        elif topic == "session":
+            sid, fpr, doc = msg.get("sid"), msg.get("fpr"), msg.get("doc")
+            if sid and fpr and isinstance(doc, dict):
+                self._archive_put(sid, fpr, doc)
+        elif topic == "compile":
+            kernel = msg.get("kernel")
+            if kernel:
+                self.warm_kernels.add(kernel)
+                FLEET_WARM_ANNOUNCED.inc(kernel=kernel)
+
+    def _archive_put(self, sid: str, fpr: str, doc: dict) -> None:
+        with self._lock:
+            if sid not in self._archive:
+                self._archive_order.append(sid)
+            self._archive[sid] = (fpr, doc)
+            while len(self._archive_order) > _MAX_ARCHIVE:
+                old = self._archive_order.popleft()
+                self._archive.pop(old, None)
+
+    def capsule_for(self, sid: str, fpr: str) -> Optional[dict]:
+        """The freshest capsule matching this exact fingerprint, after a
+        pump (the peer may have announced it this very round)."""
+        self.pump()
+        with self._lock:
+            got = self._archive.get(sid)
+        if got is None or got[0] != fpr:
+            return None
+        return got[1]
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        from karpenter_tpu.guard import audit as guard_audit
+        from karpenter_tpu.obs import observatory
+
+        self._quarantine.remove_listener(self._on_trip)
+        guard_audit.remove_audit_listener(self._on_audit)
+        observatory.remove_compile_listener(self._on_compile)

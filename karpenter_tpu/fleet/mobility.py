@@ -1,0 +1,82 @@
+"""Capsule-based session mobility.
+
+A resident session is pure function of its transcript: the scheduler
+config plus the cumulative per-round pod lists determine every gate
+decision, every claim, and therefore every blake2s round sig. So a
+session capsule — a guard-bundle doc whose ``rounds`` field is the FULL
+cumulative chain transcript (``obs.ledger.session_chain_transcript``),
+not the ledger's compressed two-round form — is sufficient to rebuild
+the session anywhere: materialize the bundle, replay each round through
+a fresh ``ResidentSession`` on the adopting replica's scheduler, and
+compare the rebuilt fingerprint against the one the client presented.
+
+Exactness argument: capsule transcript => replayed state chain =>
+fingerprint equality. The replay runs the SAME delta gates the original
+rounds ran against the same inputs, so a chain that stayed resident
+reproduces bit-identical round sigs; any divergence (a gate falls
+differently, a round is unschedulable, the capsule was built under a
+different cluster shape) surfaces as a fingerprint mismatch and the
+adopting replica refuses — the client then gets the ordinary
+SESSION_LOST cold re-solve, never a silently different session.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Optional, Tuple
+
+from karpenter_tpu.guard import bundle as guard_bundle
+
+
+def export_session(sid: str, session) -> Optional[dict]:
+    """Session -> portable capsule doc (None when there is no resident
+    state to export — snapshot-mode rounds have nothing to hand off)."""
+    from karpenter_tpu.obs import ledger as obs_ledger
+
+    chain = obs_ledger.session_chain_transcript(session)
+    if not chain:
+        return None
+    r = session._r
+    try:
+        return guard_bundle.make_bundle(
+            "fleet",
+            "session mobility capsule",
+            session.sched,
+            dict(r["pod_by_uid"]),
+            chain,
+            existing_nodes=r["exist_pristine"],
+            detail={"fingerprint": session.fingerprint, "session_id": sid},
+        )
+    except Exception:
+        return None  # export is best-effort; the cold path still works
+
+
+def adopt(sched, doc: dict, expect_fpr: str) -> Tuple[Optional[object], str]:
+    """Rebuild a session from a capsule on this replica's scheduler.
+
+    Returns ``(session, "adopted")`` on success, else ``(None, outcome)``
+    with outcome one of shape_mismatch / replay_failed /
+    fingerprint_mismatch (the ktpu_fleet_handoffs_total vocabulary).
+    """
+    from karpenter_tpu.controllers.provisioning.scheduler import ResidentSession
+    from karpenter_tpu.rpc.codec import encode_templates
+
+    shape = doc.get("scheduler") or {}
+    if (
+        shape.get("max_claims") != int(sched.max_claims)
+        or base64.b64decode(doc.get("templates_b64", ""))
+        != encode_templates(sched.templates)
+    ):
+        # the capsule was cut under a different cluster shape; replaying
+        # it here could not reproduce the chain, don't try
+        return None, "shape_mismatch"
+    try:
+        _, pods_by_uid, existing, rounds = guard_bundle.materialize(doc)
+        session = ResidentSession.replay_chain(sched, pods_by_uid, existing, rounds)
+    except Exception:
+        return None, "replay_failed"
+    if session is None:
+        return None, "replay_failed"
+    if session.fingerprint != expect_fpr:
+        return None, "fingerprint_mismatch"
+    return session, "adopted"

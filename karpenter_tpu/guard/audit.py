@@ -23,6 +23,20 @@ from karpenter_tpu.utils.metrics import GUARD_AUDITS
 _LOG_LOCK = threading.Lock()
 #: every audit verdict this process, newest last: {path, verdict, reason}
 AUDIT_LOG: list = []
+#: verdict fan-out: fleet members subscribe to rebroadcast audit results
+AUDIT_LISTENERS: list = []
+
+
+def add_audit_listener(fn) -> None:
+    with _LOG_LOCK:
+        if fn not in AUDIT_LISTENERS:
+            AUDIT_LISTENERS.append(fn)
+
+
+def remove_audit_listener(fn) -> None:
+    with _LOG_LOCK:
+        if fn in AUDIT_LISTENERS:
+            AUDIT_LISTENERS.remove(fn)
 
 
 def reset_log() -> None:
@@ -80,6 +94,12 @@ def record_audit(path: str, verdict: str, reason: str = "") -> None:
     GUARD_AUDITS.inc(path=path, verdict=verdict)
     with _LOG_LOCK:
         AUDIT_LOG.append({"path": path, "verdict": verdict, "reason": reason})
+        listeners = list(AUDIT_LISTENERS)
+    for fn in listeners:
+        try:
+            fn(path, verdict, reason)
+        except Exception:  # a broken bus must not mask the verdict
+            pass
 
 
 def handle_divergence(

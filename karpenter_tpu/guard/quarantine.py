@@ -33,13 +33,33 @@ class Quarantine:
         # all-time trip count per path (survives expiry/clear: the whole
         # point is counting how often a path keeps lying)
         self._trips: Dict[str, int] = {}
+        # trip fan-out: fleet members subscribe so one replica's
+        # divergence quarantines the path fleet-wide
+        self._listeners: list = []
 
-    def trip(self, path: str, reason: str = "", ttl_s: Optional[float] = None) -> None:
+    def add_listener(self, fn: Callable[[str, str, float, str], None]) -> None:
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
+
+    def trip(
+        self,
+        path: str,
+        reason: str = "",
+        ttl_s: Optional[float] = None,
+        source: str = "local",
+    ) -> None:
         ttl = config.quarantine_ttl_s() if ttl_s is None else ttl_s
         with self._lock:
             self._until[path] = self._now() + ttl
             self._reason[path] = reason
             self._trips[path] = self._trips.get(path, 0) + 1
+            listeners = list(self._listeners)
         GUARD_QUARANTINED.set(1, path=path)
         GUARD_QUARANTINE_TTL.set(ttl, path=path)
         _log().warn(
@@ -47,7 +67,13 @@ class Quarantine:
             path=path,
             ttl_s=ttl,
             reason=reason or "audit divergence",
+            source=source,
         )
+        for fn in listeners:
+            try:
+                fn(path, reason, ttl, source)
+            except Exception:  # a broken bus must not block the breaker
+                pass
 
     def active(self, path: str) -> bool:
         with self._lock:

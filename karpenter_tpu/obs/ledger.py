@@ -270,6 +270,26 @@ def record_session_round(session, *, pods: int, wall_s: float) -> dict:
     return LEDGER.record(rec)
 
 
+def session_chain_transcript(session) -> Optional[list]:
+    """Full cumulative per-round uid lists for *fingerprint-exact* replay.
+
+    The ledger's wire transcript compresses history to two rounds
+    ([base, all]) — enough to reproduce the final packing, but replaying
+    it yields a different round-sig chain for 3+-round sessions. Session
+    mobility needs the chain itself: round k's list is order[:boundary_k]
+    where the boundaries are each later round's start_idx plus the full
+    length, so replaying list-by-list reproduces every per-round arrival
+    set (solve computes arrivals as the set difference against resident
+    uids) and therefore every blake2s round sig — fingerprint equality
+    falls out."""
+    r = getattr(session, "_r", None)
+    if r is None or not r.get("rounds"):
+        return None
+    order = r["order"]
+    bounds = [rec["start_idx"] for rec in r["rounds"][1:]] + [len(order)]
+    return [[str(u) for u in order[:b]] for b in bounds]
+
+
 def _maybe_capsule(session, transcript: list) -> Optional[str]:
     """Write the round's problem capsule (a full guard-bundle doc whose
     rounds field is the session transcript) when spill is enabled."""

@@ -142,6 +142,21 @@ def named_kernel(name: str):
 # -- hooks ------------------------------------------------------------------
 
 
+#: compile fan-out: fleet members subscribe to announce fresh kernel keys
+#: to peers (the cross-process compile-cache warmer)
+_COMPILE_LISTENERS: list = []
+
+
+def add_compile_listener(fn) -> None:
+    if fn not in _COMPILE_LISTENERS:
+        _COMPILE_LISTENERS.append(fn)
+
+
+def remove_compile_listener(fn) -> None:
+    if fn in _COMPILE_LISTENERS:
+        _COMPILE_LISTENERS.remove(fn)
+
+
 def _on_event_duration(event: str, duration: float, **kwargs) -> None:
     if not _STATE.enabled or event != _COMPILE_EVENT:
         return
@@ -163,6 +178,11 @@ def _on_event_duration(event: str, duration: float, **kwargs) -> None:
         if n > retrace_warn() and kernel not in _STATE.stormed:
             _STATE.stormed.add(kernel)
             storm = n
+    for fn in list(_COMPILE_LISTENERS):
+        try:
+            fn(dict(note))
+        except Exception:  # a broken bus must not break compile tracking
+            pass
     if storm is not None:
         _report_storm(kernel, storm)
 

@@ -214,6 +214,54 @@ class RemoteScheduler:
         for t in templates:
             for it in t.instance_types:
                 self._catalog.setdefault(it.name, it)
+        # fleet routing front: a comma-separated endpoint list is a
+        # replica set — the client talks to ONE replica at a time and
+        # retargets (rpc/fleet, ISSUE 16) when its transport gives out,
+        # carrying the session fingerprint so the next replica can adopt
+        # the capsule transcript instead of forcing a cold re-solve
+        self._endpoints = [e.strip() for e in (endpoint or "").split(",") if e.strip()]
+        if not self._endpoints:
+            self._endpoints = [endpoint]
+        self._endpoint_idx = 0
+        self._connect(self._endpoints[0], channel=channel)
+        self.last_stream: dict = {}
+        # resident-session affinity (ISSUE 7): one session id per client
+        # scheduler instance, sent as metadata on every Solve so the
+        # server reuses its on-device resident SolverState across rounds.
+        # Stateless downgrade is structural: old servers ignore unknown
+        # metadata, and KTPU_RESIDENT=0 suppresses it entirely.
+        import uuid
+
+        self._session_id = (
+            uuid.uuid4().hex
+            if os.environ.get("KTPU_RESIDENT", "1") not in ("0", "false")
+            else None
+        )
+        # resident-state fingerprint (guard/, ISSUE 10): the server echoes
+        # a hash of its session's applied-round chain in trailing metadata;
+        # we send it back on the next Solve. A mismatch (server restart,
+        # LRU eviction) surfaces as a typed SESSION_LOST instead of a
+        # silently-wrong delta base. Empty until the first echo, so old
+        # servers (no trailer) never trigger the loss path.
+        self._session_fpr = ""
+        req = pb.ConfigureRequest(
+            templates_json=encode_templates(templates),
+            reserved_mode=reserved_mode,
+            reserved_capacity_enabled=reserved_capacity_enabled,
+            min_values_policy=min_values_policy,
+        )
+        if max_claims is not None:
+            req.max_claims = max_claims
+        if pod_pad is not None:
+            req.pod_pad = pod_pad
+        self._configure_request = req
+        self._reconfigure()
+        self.last_timings: dict = {}
+
+    def _connect(self, endpoint: str, channel: Optional[grpc.Channel] = None) -> None:
+        """(Re)build the channel + stubs against one endpoint. Called at
+        construction and on every fleet retarget — stubs are bound to a
+        channel, so they rebuild together."""
         self._channel = channel or grpc.insecure_channel(endpoint, options=_RPC_OPTIONS)
 
         def timed_stub(method, req_cls, resp_cls):
@@ -264,44 +312,28 @@ class RemoteScheduler:
             response_deserializer=lambda b: b,
         )
         self._stream_ok = os.environ.get("KTPU_RPC_STREAM", "1") != "0"
-        self.last_stream: dict = {}
         # transport hardening: per-target breaker + jittered backoff (the
         # RNG is fresh per scheduler; seed via rpc.retry.Backoff in tests)
         self._endpoint = endpoint or "in-process"
         self._breaker = _breaker_for(self._endpoint)
         self._backoff = Backoff(base_s=RETRY_BASE_SECONDS, cap_s=RETRY_CAP_SECONDS)
-        # resident-session affinity (ISSUE 7): one session id per client
-        # scheduler instance, sent as metadata on every Solve so the
-        # server reuses its on-device resident SolverState across rounds.
-        # Stateless downgrade is structural: old servers ignore unknown
-        # metadata, and KTPU_RESIDENT=0 suppresses it entirely.
-        import uuid
 
-        self._session_id = (
-            uuid.uuid4().hex
-            if os.environ.get("KTPU_RESIDENT", "1") not in ("0", "false")
-            else None
-        )
-        # resident-state fingerprint (guard/, ISSUE 10): the server echoes
-        # a hash of its session's applied-round chain in trailing metadata;
-        # we send it back on the next Solve. A mismatch (server restart,
-        # LRU eviction) surfaces as a typed SESSION_LOST instead of a
-        # silently-wrong delta base. Empty until the first echo, so old
-        # servers (no trailer) never trigger the loss path.
-        self._session_fpr = ""
-        req = pb.ConfigureRequest(
-            templates_json=encode_templates(templates),
-            reserved_mode=reserved_mode,
-            reserved_capacity_enabled=reserved_capacity_enabled,
-            min_values_policy=min_values_policy,
-        )
-        if max_claims is not None:
-            req.max_claims = max_claims
-        if pod_pad is not None:
-            req.pod_pad = pod_pad
-        self._configure_request = req
+    def _retarget(self, reason: str) -> None:
+        """Route to the next replica in the endpoint list. The session id
+        AND fingerprint survive: the new replica either adopts the capsule
+        transcript off the guardrail bus (fingerprint-verified) or answers
+        SESSION_LOST and the ordinary one-shot re-snapshot runs there."""
+        from karpenter_tpu.utils.metrics import FLEET_RETARGETS
+
+        self._endpoint_idx = (self._endpoint_idx + 1) % len(self._endpoints)
+        target = self._endpoints[self._endpoint_idx]
+        try:
+            self._channel.close()
+        except Exception:
+            pass
+        self._connect(target)
+        FLEET_RETARGETS.inc(reason=reason)
         self._reconfigure()
-        self.last_timings: dict = {}
 
     def _reconfigure(self) -> None:
         self._config_version = self._configure(
@@ -535,11 +567,32 @@ class RemoteScheduler:
         stream_acc = None
         session_lost_retried = False
         attempt = 0
+        retargets = 0
         while True:
             try:
                 resp, stream_acc = self._transport_solve(req, rpc_timeout)
                 break
+            except CircuitOpenError:
+                if retargets >= len(self._endpoints) - 1:
+                    raise
+                # this replica is cooling down; try the next one NOW —
+                # the fleet front exists so one dead replica costs a
+                # retarget, not a cooldown-long stall
+                self._retarget("circuit_open")
+                req.config_version = self._config_version
+                retargets += 1
             except grpc.RpcError as err:
+                if (
+                    is_transient_code(err)
+                    and retargets < len(self._endpoints) - 1
+                ):
+                    # transport retries against THIS replica are spent
+                    # (it was killed / unreachable): route the round to
+                    # the next replica, session fingerprint intact
+                    self._retarget("transport")
+                    req.config_version = self._config_version
+                    retargets += 1
+                    continue
                 if (
                     err.code() == grpc.StatusCode.NOT_FOUND
                     and "SESSION_LOST" in (err.details() or "")

@@ -13,6 +13,7 @@ Run standalone:  python -m karpenter_tpu.rpc.service --port 18632
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import time
@@ -56,10 +57,33 @@ def _round_bytes(round_no: int) -> bytes:
     return round_no.to_bytes(4, "big")
 
 
-class SolverService:
-    """RPC method implementations. Holds the Configure'd scheduler."""
+def _session_cap() -> int:
+    """Registry bound (KTPU_SESSION_CAP, default 8, floor 1): each
+    resident session pins device-resident SolverState, so the cap is a
+    memory knob, not a correctness one — an evicted session's next round
+    surfaces as SESSION_LOST (or a fleet handoff) and re-snapshots."""
+    try:
+        return max(1, int(os.environ.get("KTPU_SESSION_CAP", "8")))
+    except ValueError:
+        return 8
 
-    def __init__(self):
+
+class SolverService:
+    """RPC method implementations. Holds the Configure'd scheduler.
+
+    Fleet wiring (fleet/, ISSUE 16): ``fleet`` is a FleetMember whose bus
+    carries quarantine trips, audit verdicts, session capsules, and
+    compile announcements across replicas — pumped once per solve RPC, so
+    a peer's divergence trips the local breaker within one round.
+    ``admission`` is an AdmissionQueue bounding how many rounds may wait
+    on the device; a shed round runs the host-solve ladder instead. Both
+    default from env (KTPU_FLEET_BUS/KTPU_FLEET_BUS_DIR, KTPU_FLEET_QUEUE)
+    and stay None — zero new moving parts — when unconfigured.
+    """
+
+    def __init__(self, fleet=None, admission=None):
+        from collections import OrderedDict
+
         self._lock = threading.Lock()
         # Serializes solves: TPUScheduler.solve mutates instance state
         # (reserved_mode swap, _n_claims_override) and the device is a
@@ -68,19 +92,39 @@ class SolverService:
         self._solve_lock = threading.Lock()
         self._scheduler = None
         self._version = 0
+        self._epoch = ""
         # server-side resident sessions (ISSUE 7), keyed by the client's
         # ktpu-session-id metadata: remote Solve reuses the on-device
         # SolverState across rounds. Stateless downgrade is structural —
         # no metadata (old client) or KTPU_RESIDENT=0 routes straight to
         # the scheduler, and a session falls back to a bit-identical full
-        # solve for anything it cannot prove delta-safe.
-        self._sessions: dict = {}
+        # solve for anything it cannot prove delta-safe. LRU keyed on
+        # last use, bounded by KTPU_SESSION_CAP.
+        self._sessions: OrderedDict = OrderedDict()
+        if fleet is None and os.environ.get("KTPU_FLEET_BUS") == "file":
+            bus_dir = os.environ.get("KTPU_FLEET_BUS_DIR", "")
+            if bus_dir:
+                from karpenter_tpu.fleet import FileBus, FleetMember
+
+                fleet = FleetMember(FileBus(bus_dir))
+        self._fleet = fleet
+        if admission is None:
+            try:
+                depth = int(os.environ.get("KTPU_FLEET_QUEUE", "0"))
+            except ValueError:
+                depth = 0
+            if depth > 0:
+                from karpenter_tpu.fleet import AdmissionQueue
+
+                admission = AdmissionQueue(depth)
+        self._admission = admission
 
     def _session_for(self, context, sched):
         from karpenter_tpu.controllers.provisioning.scheduler import (
             ResidentSession,
             resident_enabled,
         )
+        from karpenter_tpu.utils.metrics import SESSION_EVICTIONS
 
         if not resident_enabled():
             return None
@@ -102,7 +146,8 @@ class SolverService:
                 # eviction itself is the fault being simulated)
                 FAULT.point("rpc.session.evict", session=sid)
             except Exception:
-                self._sessions.pop(sid, None)
+                if self._sessions.pop(sid, None) is not None:
+                    SESSION_EVICTIONS.inc(reason="fault")
             session = self._sessions.get(sid)
             lost = session is None or session.sched is not sched
             if not lost and client_fpr and session.fingerprint != client_fpr:
@@ -110,8 +155,16 @@ class SolverService:
                 # registry restarted or the slot was recycled): the
                 # resident state the client is deltaing against is gone
                 self._sessions.pop(sid, None)
+                SESSION_EVICTIONS.inc(reason="stale_chain")
                 lost = True
-            if lost and client_fpr:
+            if not lost:
+                self._sessions.move_to_end(sid)
+                return session
+        # lost — the registry lock is RELEASED here: adoption replays
+        # whole solve rounds on the device and must not hold it
+        if client_fpr:
+            session = self._adopt_session(sid, client_fpr, sched)
+            if session is None:
                 # typed loss: the client maps this to ONE silent snapshot
                 # re-solve. NOT_FOUND is deliberately non-transient (the
                 # retry loop must not storm) and distinct from
@@ -121,13 +174,42 @@ class SolverService:
                     f"SESSION_LOST: resident session {sid!r} evicted or "
                     "restarted; re-snapshot",
                 )
-            if lost:
-                session = ResidentSession(sched)
-                self._sessions[sid] = session
-                while len(self._sessions) > 8:
-                    # bounded registry: evict the oldest session (its next
-                    # round surfaces as SESSION_LOST and re-snapshots)
-                    self._sessions.pop(next(iter(self._sessions)))
+            return self._install(sid, session)
+        return self._install(sid, ResidentSession(sched))
+
+    def _install(self, sid, session):
+        from karpenter_tpu.utils.metrics import SESSION_EVICTIONS
+
+        with self._lock:
+            self._sessions[sid] = session
+            self._sessions.move_to_end(sid)
+            cap = _session_cap()
+            while len(self._sessions) > cap:
+                # bounded registry: evict the LEAST-RECENTLY-USED session
+                # (its next round surfaces as SESSION_LOST / fleet
+                # handoff and re-snapshots)
+                self._sessions.popitem(last=False)
+                SESSION_EVICTIONS.inc(reason="capacity")
+        return session
+
+    def _adopt_session(self, sid, client_fpr, sched):
+        """Session mobility: rebuild the lost session from the fleet's
+        capsule archive by replaying its transcript chain. Returns the
+        adopted ResidentSession only when the rebuilt fingerprint equals
+        the one the client presented; None falls back to SESSION_LOST."""
+        if self._fleet is None:
+            return None
+        from karpenter_tpu.fleet import mobility
+        from karpenter_tpu.utils.metrics import FLEET_HANDOFFS
+
+        doc = self._fleet.capsule_for(sid, client_fpr)
+        if doc is None:
+            FLEET_HANDOFFS.inc(outcome="no_capsule")
+            return None
+        # the replay drives real device solves — serialize like any round
+        with self._solve_lock:
+            session, outcome = mobility.adopt(sched, doc, client_fpr)
+        FLEET_HANDOFFS.inc(outcome=outcome)
         return session
 
     @staticmethod
@@ -174,12 +256,45 @@ class SolverService:
 
     # -- rpc handlers ------------------------------------------------------
 
+    @staticmethod
+    def _config_epoch(request: pb.ConfigureRequest, mesh_devices: int) -> str:
+        """Cluster-shape epoch: everything a Configure feeds the scheduler
+        constructor. Two Configures with the same epoch build the same
+        scheduler, so the live one (and every resident session bound to
+        it) can survive the reconfigure."""
+        import hashlib
+
+        tj = request.templates_json
+        h = hashlib.blake2s(digest_size=8)
+        h.update(tj if isinstance(tj, bytes) else tj.encode())
+        knobs = "|".join(
+            (
+                str(request.max_claims if request.HasField("max_claims") else None),
+                str(request.pod_pad if request.HasField("pod_pad") else None),
+                request.reserved_mode or "fallback",
+                str(bool(request.reserved_capacity_enabled)),
+                request.min_values_policy or "Strict",
+                str(mesh_devices),
+            )
+        )
+        h.update(knobs.encode())
+        return h.hexdigest()
+
     def Configure(self, request: pb.ConfigureRequest, context) -> pb.ConfigureResponse:
         from karpenter_tpu.controllers.provisioning.scheduler import TPUScheduler
+        from karpenter_tpu.utils.metrics import SESSION_EVICTIONS
 
+        mesh_devices = int(os.environ.get("KTPU_MESH_DEVICES", "0"))
+        epoch = self._config_epoch(request, mesh_devices)
+        with self._lock:
+            if self._scheduler is not None and epoch == self._epoch:
+                # same cluster shape: keep the live scheduler AND its
+                # resident sessions — an unrelated Configure (a second
+                # client arriving, a control-plane restart with identical
+                # templates) must not force SESSION_LOST fleet-wide
+                return pb.ConfigureResponse(config_version=self._version)
         templates = decode_templates(request.templates_json)
         mesh = None
-        mesh_devices = int(os.environ.get("KTPU_MESH_DEVICES", "0"))
         if mesh_devices:
             # the solver process owns the accelerators; its mesh size is a
             # deployment property (env), not a per-client setting
@@ -198,9 +313,14 @@ class SolverService:
         with self._lock:
             self._version += 1
             self._scheduler = sched
+            self._epoch = epoch
             version = self._version
-            # resident sessions are bound to a scheduler generation
+            # resident sessions are bound to a scheduler generation; only
+            # a genuine shape change invalidates them now
+            n = len(self._sessions)
             self._sessions.clear()
+            if n:
+                SESSION_EVICTIONS.inc(n, reason="epoch")
         return pb.ConfigureResponse(config_version=version)
 
     def Solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
@@ -225,7 +345,68 @@ class SolverService:
                 grpc.StatusCode.FAILED_PRECONDITION,
                 f"config_version {request.config_version} != live {version}; re-Configure",
             )
+        if self._fleet is not None:
+            # drain the guardrail bus before solving, so a peer replica's
+            # quarantine trip / session capsule lands within one round
+            self._fleet.pump()
         return sched
+
+    @contextlib.contextmanager
+    def _admitted(self, context):
+        """Admission gate around the device solve. Without an
+        AdmissionQueue this is exactly the old solve lock. With one, the
+        caller blocks in per-tenant fair order for the solve slot; a
+        round shed by overload yields "shed" WITHOUT the lock — it must
+        run the host ladder instead of touching the device."""
+        if self._admission is None:
+            with self._solve_lock:
+                yield "run"
+            return
+        md = dict(context.invocation_metadata() or ())
+        tenant = md.get("ktpu-tenant") or md.get("ktpu-session-id") or "anon"
+        verdict = self._admission.acquire(tenant)
+        if verdict == "shed":
+            from karpenter_tpu.utils.metrics import FLEET_SHED
+
+            FLEET_SHED.inc(reason="queue_full")
+            yield "shed"
+            return
+        try:
+            with self._solve_lock:
+                yield "run"
+        finally:
+            self._admission.release()
+
+    def _host_shed(self, sched, args, kwargs):
+        """A shed round's solve: the existing host-solve ladder (the same
+        engine every DRA/volume fallback already trusts), built from the
+        decoded request — correct, device-free, and slower, which is the
+        deliberate trade against stalling the whole queue."""
+        from karpenter_tpu.controllers.provisioning.host_scheduler import (
+            HostScheduler,
+            normalize_volume_reqs,
+        )
+        from karpenter_tpu.utils.metrics import SOLVER_FALLBACK, SOLVER_HOST_FALLBACKS
+
+        SOLVER_HOST_FALLBACKS.inc(reason="fleet_shed")
+        SOLVER_FALLBACK.inc(reason="fleet_shed")
+        pods, existing, budgets = args
+        pods = list(pods)
+        host = HostScheduler(
+            sched.templates,
+            existing_nodes=[n.clone() for n in (existing or [])],
+            budgets=budgets,
+            topology=kwargs["topology_factory"](pods),
+            volume_reqs=normalize_volume_reqs(kwargs["volume_reqs"]),
+            reserved_mode=kwargs["reserved_mode"] or sched.reserved_mode,
+            reserved_capacity_enabled=sched.reserved_capacity_enabled,
+            min_values_policy=sched.min_values_policy,
+            reserved_in_use=kwargs["reserved_in_use"],
+            dra_problem=kwargs["dra_problem"],
+            pod_volumes=kwargs["pod_volumes"],
+            deadline=kwargs["deadline"],
+        )
+        return host.solve(pods)
 
     def _solve_stream(self, request: pb.SolveRequest, context):
         import queue
@@ -257,6 +438,7 @@ class SolverService:
         # frames while the decode is still producing later ones
         args, kwargs = self._solve_args(request, sched)
         session = self._session_for(context, sched)
+        sid = dict(context.invocation_metadata() or ()).get("ktpu-session-id")
         engine = session if session is not None else sched
         from karpenter_tpu.obs import ledger as obs_ledger
 
@@ -264,8 +446,15 @@ class SolverService:
 
         def run() -> None:
             try:
-                with self._solve_lock:
-                    result = engine.solve(*args, chunk_sink=sink, **kwargs)
+                with self._admitted(context) as verdict:
+                    if verdict == "shed":
+                        result = self._host_shed(sched, args, kwargs)
+                    else:
+                        result = engine.solve(*args, chunk_sink=sink, **kwargs)
+                if self._fleet is not None and session is not None:
+                    # announce the advanced chain so a peer can adopt it
+                    # if this replica dies before the next round
+                    self._fleet.publish_session(sid, session)
                 resp = self._result_pb(sched, result)
                 if streamed[0]:
                     # the streamed chunks already carried the per-pod
@@ -363,12 +552,20 @@ class SolverService:
         sched = self._checked_scheduler(request, context)
         args, kwargs = self._solve_args(request, sched)
         session = self._session_for(context, sched)
+        sid = dict(context.invocation_metadata() or ()).get("ktpu-session-id")
         engine = session if session is not None else sched
         from karpenter_tpu.obs import ledger as obs_ledger
 
         ledger_seq0 = obs_ledger.LEDGER.seq()
-        with self._solve_lock:
-            result = engine.solve(*args, **kwargs)
+        with self._admitted(context) as verdict:
+            if verdict == "shed":
+                result = self._host_shed(sched, args, kwargs)
+            else:
+                result = engine.solve(*args, **kwargs)
+        if self._fleet is not None and session is not None:
+            # announce the advanced chain so a peer can adopt it if this
+            # replica dies before the next round
+            self._fleet.publish_session(sid, session)
         self._echo_session_fpr(context, session, ledger_seq0)
         return self._result_pb(sched, result)
 
@@ -516,11 +713,15 @@ def _handlers(service: SolverService) -> grpc.GenericRpcHandler:
 
 
 def serve(
-    address: str = "127.0.0.1:0", max_workers: int = 4
+    address: str = "127.0.0.1:0",
+    max_workers: int = 4,
+    service: Optional[SolverService] = None,
 ) -> tuple[grpc.Server, str]:
     """Start a solver server; returns (server, bound address). Solves are
     serialized through SolverService._solve_lock, so the worker pool only
-    needs to cover Configure/Health overlap."""
+    needs to cover Configure/Health overlap. ``service`` lets fleet
+    callers (tests, bench --fleet) inject a SolverService wired to a
+    shared bus / admission queue."""
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
         options=[
@@ -529,7 +730,7 @@ def serve(
             ("grpc.max_send_message_length", 256 * 1024 * 1024),
         ],
     )
-    server.add_generic_rpc_handlers((_handlers(SolverService()),))
+    server.add_generic_rpc_handlers((_handlers(service or SolverService()),))
     port = server.add_insecure_port(address)
     # host:port split that survives bracketed IPv6 literals ("[::1]:0")
     if address.startswith("["):

@@ -578,3 +578,58 @@ SHARD_DP_UTILIZATION = REGISTRY.gauge(
     " three fractions sum to 1 whenever any merge round ran",
     ("state",),
 )
+# ---- fleet-scale serving (fleet/, PR 16) ----
+SESSION_EVICTIONS = REGISTRY.counter(
+    "ktpu_rpc_session_evictions_total",
+    "Resident sessions dropped from the solver service registry, by"
+    " reason: capacity (LRU past KTPU_SESSION_CAP), fault (injected"
+    " rpc.session.evict chaos eviction), epoch (a Configure changed the"
+    " cluster shape — templates/max_claims/pads/mesh — so every bound"
+    " session is invalid), stale_chain (registry slot recycled under a"
+    " different state chain than the client's fingerprint)",
+    ("reason",),
+)
+FLEET_SHED = REGISTRY.counter(
+    "ktpu_fleet_shed_total",
+    "Solve rounds shed by fleet admission control, by reason: queue_full"
+    " (the bounded per-replica solve queue hit KTPU_FLEET_QUEUE and the"
+    " oldest waiting round was re-routed onto the host-solve ladder"
+    " instead of stalling the client)",
+    ("reason",),
+)
+FLEET_HANDOFFS = REGISTRY.counter(
+    "ktpu_fleet_handoffs_total",
+    "Session-mobility outcomes when a replica receives a fingerprint for"
+    " resident state it does not hold: adopted (the capsule transcript"
+    " replayed to a bit-equal fingerprint chain — the round proceeds as a"
+    " delta with no client-visible loss), fingerprint_mismatch (the"
+    " rebuilt chain disagreed; fall back to SESSION_LOST), replay_failed"
+    " (transcript replay errored), no_capsule (the bus had no capsule"
+    " for that session/fingerprint), shape_mismatch (capsule was built"
+    " against a different template/config shape)",
+    ("outcome",),
+)
+FLEET_BUS_MESSAGES = REGISTRY.counter(
+    "ktpu_fleet_bus_messages_total",
+    "Guardrail-bus traffic by topic (quarantine | audit | session |"
+    " compile) and direction (published | received); received counts"
+    " exclude a member's own messages",
+    ("topic", "direction"),
+)
+FLEET_RETARGETS = REGISTRY.counter(
+    "ktpu_fleet_retargets_total",
+    "Client endpoint retargets inside the fleet routing front, by"
+    " reason: transport (transient-code retries exhausted against the"
+    " current replica), circuit_open (the per-endpoint breaker is"
+    " cooling down); the session fingerprint survives the retarget so"
+    " the new replica can adopt the capsule transcript",
+    ("reason",),
+)
+FLEET_WARM_ANNOUNCED = REGISTRY.counter(
+    "ktpu_fleet_warm_announced_total",
+    "Freshly compiled kernel keys announced by fleet peers over the"
+    " compile-warmer bus topic, per named kernel — a replica seeing an"
+    " announcement knows the shared persistent compile cache now holds"
+    " that key before it ever pays the compile itself",
+    ("kernel",),
+)

@@ -1,0 +1,421 @@
+"""Fleet-scale serving (fleet/, ISSUE 16).
+
+Three properties under test, each end-to-end where it matters:
+
+- **Session mobility**: two in-process replicas behind one client over
+  real sockets; killing the replica that holds the resident session
+  mid-stream must hand the session off — the survivor rebuilds resident
+  state from the ledger capsule's round transcript and the rebuilt
+  fingerprint equals the lost one's, so the client sees ZERO lost rounds
+  and zero ``invalidated`` re-snapshots, and every post-handoff round
+  stays bit-identical to a cold re-solve + the host oracle.
+- **Shared guardrail bus**: a quarantine trip on replica A routes
+  replica B's next resident round onto the sequential twin within one
+  round; trips never echo back; audit verdicts and compile-cache
+  announcements ride the same bus (in-process hub and the file backend).
+- **Admission control**: the bounded solve queue sheds the OLDEST
+  waiting round to the host-solve ladder (counted under
+  ``ktpu_fleet_shed_total{reason="queue_full"}``) and serves tenants
+  round-robin, FIFO within one tenant.
+
+Everything here is host-only (conftest pins JAX to 8 virtual CPU
+devices) and sized for tier-1.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.controllers.provisioning import TPUScheduler
+from karpenter_tpu.faultinject import active_plan
+from karpenter_tpu.fleet import AdmissionQueue, FileBus, FleetMember, InProcessHub
+from karpenter_tpu.guard import audit as guard_audit
+from karpenter_tpu.guard.quarantine import QUARANTINE, Quarantine
+from karpenter_tpu.rpc import RemoteScheduler, serve
+from karpenter_tpu.rpc import client as rpc_client
+from karpenter_tpu.rpc.service import SolverService
+from karpenter_tpu.utils.metrics import (
+    FLEET_BUS_MESSAGES,
+    FLEET_HANDOFFS,
+    FLEET_RETARGETS,
+    FLEET_SHED,
+    FLEET_WARM_ANNOUNCED,
+    RESIDENT_ROUNDS,
+    SESSION_EVICTIONS,
+)
+
+from test_resident import assert_identical, cold_solve, kind_pods, make_templates
+
+OUTCOMES = (
+    "adopted",
+    "no_capsule",
+    "fingerprint_mismatch",
+    "replay_failed",
+    "shape_mismatch",
+)
+
+
+@pytest.fixture
+def fast_failover(monkeypatch):
+    """One transport retry with millisecond backoff: a killed replica is
+    detected and retargeted in well under a round, as the bench's chaos
+    stage configures it."""
+    monkeypatch.setattr(rpc_client, "TRANSPORT_RETRIES", 1)
+    monkeypatch.setattr(rpc_client, "RETRY_BASE_SECONDS", 0.01)
+    monkeypatch.setattr(rpc_client, "RETRY_CAP_SECONDS", 0.02)
+
+
+def _handoff_counts():
+    return {k: FLEET_HANDOFFS.get(outcome=k) for k in OUTCOMES}
+
+
+class TestGuardrailBus:
+    def test_file_bus_roundtrip_across_instances(self, tmp_path):
+        """The file backend is an append-only per-topic log: a SECOND
+        instance over the same directory (another process, in production)
+        sees everything, offsets resume mid-stream, and a torn tail line
+        is never consumed."""
+        a = FileBus(str(tmp_path))
+        b = FileBus(str(tmp_path))
+        a.publish("quarantine", {"path": "resident", "origin": "a", "n": 1})
+        a.publish("quarantine", {"path": "grid", "origin": "a", "n": 2})
+        a.publish("audit", {"verdict": "pass", "origin": "a"})
+        msgs, off = b.fetch("quarantine", 0)
+        assert [m["n"] for m in msgs] == [1, 2]
+        again, off2 = b.fetch("quarantine", off)
+        assert again == [] and off2 == off
+        a.publish("quarantine", {"path": "resident", "origin": "a", "n": 3})
+        late, _ = b.fetch("quarantine", off)
+        assert [m["n"] for m in late] == [3]
+        msgs, _ = b.fetch("audit", 0)
+        assert msgs == [{"verdict": "pass", "origin": "a"}]
+        # a torn tail (a writer died mid-append) stays unconsumed: the
+        # offset parks before the partial line until it is completed
+        with open(str(tmp_path / "quarantine.jsonl"), "ab") as fh:
+            fh.write(b'{"path": "resi')
+        msgs, off3 = b.fetch("quarantine", 0)
+        assert [m["n"] for m in msgs] == [1, 2, 3]
+        tail, _ = b.fetch("quarantine", off3)
+        assert tail == []
+
+    def test_quarantine_trip_propagates_without_echo(self, tmp_path):
+        """A local trip on A's breaker reaches B over the file bus within
+        one pump, carries the origin in the reason, and is NOT republished
+        by B (remote trips must not loop)."""
+        qa, qb = Quarantine(), Quarantine()
+        ma = FleetMember(FileBus(str(tmp_path)), "rep-a", quarantine=qa)
+        mb = FleetMember(FileBus(str(tmp_path)), "rep-b", quarantine=qb)
+        try:
+            pub0 = FLEET_BUS_MESSAGES.get(topic="quarantine", direction="published")
+            qa.trip("resident", reason="shadow-audit divergence", ttl_s=60.0)
+            assert not qb.active("resident")
+            assert mb.pump() >= 1
+            assert qb.active("resident")
+            assert qb.reason("resident").startswith("fleet:rep-a:")
+            # the remote application must not have been republished: A's
+            # next pump finds nothing foreign, and exactly ONE quarantine
+            # message was ever published
+            assert ma.pump() == 0
+            assert (
+                FLEET_BUS_MESSAGES.get(topic="quarantine", direction="published")
+                == pub0 + 1
+            )
+        finally:
+            ma.close()
+            mb.close()
+
+    def test_audit_verdicts_and_compile_warmth_ride_the_bus(self):
+        hub = InProcessHub()
+        ma = FleetMember(hub, "rep-a", quarantine=Quarantine())
+        mb = FleetMember(hub, "rep-b", quarantine=Quarantine())
+        try:
+            guard_audit.record_audit("resident", "pass", "fleet-test")
+            mb.pump()
+            got = [a for a in mb.remote_audits if a.get("origin") == "rep-a"]
+            assert got and got[-1]["verdict"] == "pass"
+            assert got[-1]["path"] == "resident"
+            # a peer's fresh jit compile marks the kernel key warm here
+            # (the cross-process compile-cache warmer announcement)
+            warm0 = FLEET_WARM_ANNOUNCED.get(kernel="solve_core")
+            hub.publish(
+                "compile", {"kernel": "solve_core", "seconds": 1.2, "origin": "rep-a"}
+            )
+            mb.pump()
+            assert "solve_core" in mb.warm_kernels
+            assert FLEET_WARM_ANNOUNCED.get(kernel="solve_core") == warm0 + 1
+        finally:
+            ma.close()
+            mb.close()
+
+
+class TestSessionRegistry:
+    def test_lru_eviction_honors_recency_and_cap(self, monkeypatch):
+        """KTPU_SESSION_CAP bounds the registry with LRU ordering: a
+        refreshed session survives the insertion that evicts the stale
+        one, the eviction is counted under reason="capacity", and the
+        evicted client recovers with exactly one silent re-snapshot."""
+        monkeypatch.setenv("KTPU_SESSION_CAP", "2")
+        svc = SolverService()
+        server, addr = serve(service=svc)
+        try:
+            templates = make_templates()
+            pods = kind_pods("a", 8)
+            c1 = RemoteScheduler(addr, templates, max_claims=128)
+            c2 = RemoteScheduler(addr, templates, max_claims=128)
+            c3 = RemoteScheduler(addr, templates, max_claims=128)
+            c1.solve(list(pods))
+            c2.solve(list(pods))
+            assert len(svc._sessions) == 2
+            # touching c1 refreshes its LRU slot: c3's arrival evicts c2
+            c1.solve(list(pods) + kind_pods("x", 2))
+            cap0 = SESSION_EVICTIONS.get(reason="capacity")
+            c3.solve(list(pods))
+            assert SESSION_EVICTIONS.get(reason="capacity") == cap0 + 1
+            assert set(svc._sessions) == {c1._session_id, c3._session_id}
+            inv0 = RESIDENT_ROUNDS.get(mode="invalidated")
+            r = c2.solve(list(pods) + kind_pods("y", 3))
+            assert RESIDENT_ROUNDS.get(mode="invalidated") == inv0 + 1
+            assert not r.unschedulable
+        finally:
+            server.stop(0)
+
+    def test_same_shape_configure_preserves_sessions(self):
+        """An unrelated Configure with the IDENTICAL cluster shape shares
+        the config epoch: no version bump, resident sessions survive, the
+        next round is still the delta path. A genuinely different shape
+        is a new epoch and evicts under reason="epoch"."""
+        svc = SolverService()
+        server, addr = serve(service=svc)
+        try:
+            c1 = RemoteScheduler(addr, make_templates(), max_claims=128)
+            union = kind_pods("a", 10)
+            c1.solve(list(union))
+            assert c1._session_fpr
+            v1 = c1._config_version
+            inv0 = RESIDENT_ROUNDS.get(mode="invalidated")
+            d0 = RESIDENT_ROUNDS.get(mode="delta")
+            c2 = RemoteScheduler(addr, make_templates(), max_claims=128)
+            assert c2._config_version == v1  # same epoch: no supersede
+            assert len(svc._sessions) == 1
+            union = union + kind_pods("b", 4)
+            r = c1.solve(list(union))
+            assert RESIDENT_ROUNDS.get(mode="invalidated") == inv0
+            assert RESIDENT_ROUNDS.get(mode="delta") == d0 + 1
+            assert_identical(cold_solve(union), r)
+            # different shape -> new epoch: the registry drains under
+            # reason="epoch" and c1's next round re-snapshots once
+            e0 = SESSION_EVICTIONS.get(reason="epoch")
+            RemoteScheduler(addr, make_templates(n_types=8), max_claims=128)
+            assert SESSION_EVICTIONS.get(reason="epoch") == e0 + 1
+            inv1 = RESIDENT_ROUNDS.get(mode="invalidated")
+            union = union + kind_pods("c", 3)
+            r = c1.solve(list(union))
+            assert RESIDENT_ROUNDS.get(mode="invalidated") == inv1 + 1
+            assert not r.unschedulable
+        finally:
+            server.stop(0)
+
+
+class TestFleetHandoff:
+    def test_kill_a_mid_stream_hands_off_and_quarantine_routes_b(
+        self, fast_failover
+    ):
+        """The tentpole, end to end over real sockets: two replicas share
+        a bus; the client streams a seeded Poisson delta trace at A; A is
+        killed mid-stream. The re-solve must route to B, which rebuilds
+        the resident session from A's last capsule — fingerprint-exact,
+        so the client keeps its session identity: zero rounds lost, zero
+        ``invalidated`` re-snapshots, every round bit-identical to a cold
+        re-solve + host oracle. Then a quarantine trip on A's breaker
+        routes B's next resident round onto the sequential twin."""
+        hub = InProcessHub()
+        qa = Quarantine()
+        ma = FleetMember(hub, "rep-a", quarantine=qa)
+        # B's breaker IS the process-global one, exactly as a real replica
+        # process wires it: the remote trip must route B's solve path
+        mb = FleetMember(hub, "rep-b")
+        svc_a = SolverService(fleet=ma)
+        svc_b = SolverService(fleet=mb)
+        server_a, addr_a = serve(service=svc_a)
+        server_b, addr_b = serve(service=svc_b)
+        killed = False
+        inv0 = RESIDENT_ROUNDS.get(mode="invalidated")
+        h0 = _handoff_counts()
+        rt0 = FLEET_RETARGETS.get(reason="transport")
+        try:
+            remote = RemoteScheduler(
+                f"{addr_a},{addr_b}", make_templates(), max_claims=128
+            )
+            rng = np.random.default_rng(7)
+            union = kind_pods("a", 16) + kind_pods("b", 8)
+            r = remote.solve(list(union))
+            assert not r.unschedulable
+            for rnd in range(6):
+                if rnd == 3:
+                    server_a.stop(0)
+                    killed = True
+                union = union + kind_pods(f"d{rnd}", int(rng.poisson(3.0)) + 1)
+                r = remote.solve(list(union))
+                assert not r.unschedulable  # zero rounds lost across the kill
+            assert_identical(cold_solve(union), r)
+            h1 = _handoff_counts()
+            assert h1["adopted"] == h0["adopted"] + 1
+            for bad in OUTCOMES[1:]:
+                assert h1[bad] == h0[bad], bad
+            # the handoff was INVISIBLE to the client: no SESSION_LOST,
+            # no cold re-snapshot round
+            assert RESIDENT_ROUNDS.get(mode="invalidated") == inv0
+            assert FLEET_RETARGETS.get(reason="transport") >= rt0 + 1
+            # a trip on A's breaker reaches B's via the bus (pumped at the
+            # top of the next solve RPC) and routes that round sequential
+            qa.trip("resident", reason="shadow-audit divergence", ttl_s=120.0)
+            union = union + kind_pods("z", 2)
+            r = remote.solve(list(union))
+            assert QUARANTINE.active("resident")
+            assert QUARANTINE.reason("resident").startswith("fleet:rep-a:")
+            session = next(iter(svc_b._sessions.values()))
+            assert (session.last_mode, session.last_reason) == (
+                "full",
+                "quarantined",
+            )
+            assert_identical(cold_solve(union), r)
+        finally:
+            QUARANTINE.clear("resident")
+            if not killed:
+                server_a.stop(0)
+            server_b.stop(0)
+            ma.close()
+            mb.close()
+
+    def test_fault_evict_readopts_from_own_archive(self):
+        """The chaos point the SESSION_LOST suite injects
+        (rpc.session.evict) stops being client-visible once a fleet
+        member is attached: the registry eviction re-adopts from the
+        member's OWN capsule archive — no SESSION_LOST, no invalidated
+        round (contrast guard.TestSessionLost, where fleet is None)."""
+        member = FleetMember(InProcessHub(), "solo", quarantine=Quarantine())
+        svc = SolverService(fleet=member)
+        server, addr = serve(service=svc)
+        try:
+            remote = RemoteScheduler(addr, make_templates(), max_claims=128)
+            union = kind_pods("a", 12)
+            remote.solve(list(union))
+            union = union + kind_pods("b", 5)
+            remote.solve(list(union))
+            assert remote._session_fpr
+            inv0 = RESIDENT_ROUNDS.get(mode="invalidated")
+            a0 = FLEET_HANDOFFS.get(outcome="adopted")
+            f0 = SESSION_EVICTIONS.get(reason="fault")
+            union = union + kind_pods("c", 4)
+            plan = {
+                "rules": [
+                    {"point": "rpc.session.evict", "error": "runtime", "times": 1}
+                ]
+            }
+            with active_plan(plan):
+                r = remote.solve(list(union))
+            assert SESSION_EVICTIONS.get(reason="fault") == f0 + 1
+            assert FLEET_HANDOFFS.get(outcome="adopted") == a0 + 1
+            assert RESIDENT_ROUNDS.get(mode="invalidated") == inv0
+            assert_identical(cold_solve(union), r)
+        finally:
+            server.stop(0)
+            member.close()
+
+
+class TestAdmission:
+    def test_shed_oldest_and_round_robin_fairness(self):
+        """Pure queue semantics, deterministically sequenced: over a full
+        queue the OLDEST waiter is shed (bounding every round's queue
+        time), and release() serves tenants round-robin, FIFO within one
+        tenant."""
+        q = AdmissionQueue(2)
+        assert q.acquire("main") == "run"  # idle queue: immediate slot
+        verdicts = {}
+        order = []
+        cond = threading.Condition()
+
+        def waiter(name, tenant):
+            v = q.acquire(tenant)
+            with cond:
+                verdicts[name] = v
+                if v == "run":
+                    order.append(tenant)
+                cond.notify_all()
+            if v == "run":
+                q.release()
+
+        threads = []
+        # arrival order b1, c1 fills the queue; b2's arrival sheds b1
+        for name, tenant in [("b1", "b"), ("c1", "c")]:
+            t = threading.Thread(target=waiter, args=(name, tenant))
+            t.start()
+            threads.append(t)
+            deadline = time.monotonic() + 10.0
+            while q.depth() < len(threads) and time.monotonic() < deadline:
+                time.sleep(0.005)
+        t = threading.Thread(target=waiter, args=("b2", "b"))
+        t.start()
+        threads.append(t)
+        with cond:
+            assert cond.wait_for(lambda: "b1" in verdicts, timeout=10.0)
+        assert verdicts["b1"] == "shed"
+        assert q.shed_count == 1
+        q.release()  # hand the held slot down the queue
+        for t in threads:
+            t.join(timeout=10.0)
+            assert not t.is_alive()
+        assert verdicts["c1"] == "run" and verdicts["b2"] == "run"
+        # c ran before b's second round: round-robin across tenants even
+        # though b2 had been waiting no longer than c1
+        assert order == ["c", "b"]
+        assert q.depth() == 0
+
+    def test_overload_sheds_to_host_ladder_over_socket(self):
+        """With the device slot held and a capacity-1 queue, concurrent
+        Solve RPCs shed all but the newest waiter onto the host-solve
+        ladder — counted in ktpu_fleet_shed_total{reason="queue_full"} —
+        and EVERY caller still gets a complete placement."""
+        svc = SolverService(admission=AdmissionQueue(1))
+        server, addr = serve(max_workers=8, service=svc)
+        try:
+            templates = make_templates()
+            pods = kind_pods("a", 10)
+            clients = [
+                RemoteScheduler(addr, make_templates(), max_claims=128)
+                for _ in range(3)
+            ]
+            local = TPUScheduler(templates, max_claims=128).solve(list(pods))
+            shed0 = FLEET_SHED.get(reason="queue_full")
+            assert svc._admission.acquire("test-holder") == "run"
+            results = {}
+
+            def solve(i):
+                results[i] = clients[i].solve(list(pods))
+
+            threads = [
+                threading.Thread(target=solve, args=(i,)) for i in range(3)
+            ]
+            for t in threads:
+                t.start()
+            # each arrival over the full queue sheds the then-oldest
+            # waiter: 3 waiters against capacity 1 -> exactly 2 sheds
+            deadline = time.monotonic() + 30.0
+            while (
+                svc._admission.shed_count < 2 or svc._admission.depth() < 1
+            ) and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert svc._admission.shed_count == 2
+            svc._admission.release()
+            for t in threads:
+                t.join(timeout=60.0)
+                assert not t.is_alive()
+            assert FLEET_SHED.get(reason="queue_full") == shed0 + 2
+            for r in results.values():
+                assert not r.unschedulable
+                assert len(r.claims) == len(local.claims)
+                assert sum(len(c.pods) for c in r.claims) == len(pods)
+        finally:
+            server.stop(0)

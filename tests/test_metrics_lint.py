@@ -255,6 +255,41 @@ def test_waterfall_families_are_registered():
         assert state in fams["ktpu_shard_dp_utilization"].help, state
 
 
+def test_fleet_families_are_registered():
+    """ISSUE-16 families: registry evictions, load shedding, session
+    handoffs, the guardrail bus, client retargeting, and the compile
+    warmth announcements. The handoff counter's help must enumerate its
+    outcome vocabulary — dashboards alert on the non-adopted outcomes —
+    and the bus counter's help must name its topics and directions."""
+    from karpenter_tpu.utils.metrics import Counter
+
+    fams = {f.name: f for f in _families()}
+    expected = {
+        "ktpu_rpc_session_evictions_total": (Counter, ("reason",)),
+        "ktpu_fleet_shed_total": (Counter, ("reason",)),
+        "ktpu_fleet_handoffs_total": (Counter, ("outcome",)),
+        "ktpu_fleet_bus_messages_total": (Counter, ("topic", "direction")),
+        "ktpu_fleet_retargets_total": (Counter, ("reason",)),
+        "ktpu_fleet_warm_announced_total": (Counter, ("kernel",)),
+    }
+    for name, (cls, labels) in expected.items():
+        fam = fams.get(name)
+        assert fam is not None, f"{name} not registered"
+        assert isinstance(fam, cls), (name, type(fam).__name__)
+        assert fam.label_names == labels, (name, fam.label_names)
+        assert fam.help.strip()
+    for outcome in (
+        "adopted",
+        "no_capsule",
+        "fingerprint_mismatch",
+        "replay_failed",
+        "shape_mismatch",
+    ):
+        assert outcome in fams["ktpu_fleet_handoffs_total"].help, outcome
+    for word in ("quarantine", "audit", "session", "compile", "published", "received"):
+        assert word in fams["ktpu_fleet_bus_messages_total"].help, word
+
+
 def test_counters_end_in_total_and_histograms_in_seconds_or_pods():
     """Unit-suffix discipline for NEW families (grandfathered names keep
     their reference spellings verbatim)."""

@@ -248,7 +248,10 @@ class TestSolveParity:
         templates = build_templates([(default_pool(), instance_types(8))])
         first = RemoteScheduler(solver_server, templates)
         stale = first._config_version
-        RemoteScheduler(solver_server, templates)  # supersedes `first`
+        # a DIFFERENT cluster shape supersedes `first` (an identical shape
+        # now shares the config epoch and would NOT invalidate it)
+        other = build_templates([(default_pool(), instance_types(12))])
+        RemoteScheduler(solver_server, other)
         result = first.solve([make_pod("p", cpu=0.5)])
         assert len(result.claims) == 1
         assert first._config_version > stale  # re-Configure happened
