@@ -20,6 +20,7 @@ Prints ONE final JSON line:
 from __future__ import annotations
 
 import json
+import os
 import time
 
 BASELINE_PODS_PER_SEC = 100.0  # reference MinPodsPerSec gate (:58)
@@ -496,6 +497,13 @@ def run_steady_stage(
 # handoff round itself is reported separately as handoff_s)
 FLEET_MAX_P95_RATIO = 2.0
 
+# the tracing-overhead gate (ISSUE 17): steady-state p95 with fleet trace
+# propagation ON must stay within this factor of the same trace with
+# propagation OFF (KTPU_FLEET_TRACE=0). The context is four fields and a
+# metadata entry, so the honest ratio is ~1.0; the gate absorbs p95
+# noise on a 10-round trace
+TRACE_OVERHEAD_MAX_RATIO = 1.5
+
 
 def run_fleet_stage(
     resident_pods=768,
@@ -504,25 +512,39 @@ def run_fleet_stage(
     seed=0,
     kill_round=4,
     max_claims=1024,
+    trace_out=None,
 ):
-    """--fleet (ISSUE 16): multi-replica chaos under Poisson arrivals.
+    """--fleet (ISSUE 16/17): multi-replica chaos under Poisson arrivals.
 
     Two in-process solver replicas share a guardrail bus; a client runs
     the steady Poisson trace against replica A alone (the latency
-    yardstick), then a second client runs the same trace against the
-    "A,B" routing front while A is killed mid-stream. The killed
-    replica's resident session must hand off to B via the bus's capsule
-    transcript (rebuilt fingerprint == the lost chain, counted in
+    yardstick, fleet tracing on), the same trace again with tracing OFF
+    (the overhead gate), then a second client runs it against the "A,B"
+    routing front while A is killed mid-stream. The killed replica's
+    resident session must hand off to B via the bus's capsule transcript
+    (rebuilt fingerprint == the lost chain, counted in
     ktpu_fleet_handoffs_total{outcome="adopted"}), zero rounds may be
     lost, chaos p95 per-delta latency must stay within
     FLEET_MAX_P95_RATIO of the steady p95, and a quarantine trip on A's
-    breaker must reach B's within one bus pump."""
+    breaker must reach B's within one bus pump.
+
+    The observability acceptance (ISSUE 17) rides the same run: the
+    chaos rounds must stitch into fleet traces in which every original
+    round appears exactly once, the handoff's trace id must span both
+    replicas, the stitched trace must export as valid Perfetto JSON
+    (written to ``trace_out`` when given) whose slices reconcile with
+    the waterfall invariant, and the ktpu_slo_* availability burn rate
+    must reflect the injected kill."""
     import numpy as np
 
     from karpenter_tpu.envelope.sampler import measured
     from karpenter_tpu.fleet import FleetMember, InProcessHub
+    from karpenter_tpu.fleet import bus as bus_mod
     from karpenter_tpu.guard.quarantine import Quarantine
     from karpenter_tpu.models.pod import make_pod
+    from karpenter_tpu.obs import fleetobs, traceexport
+    from karpenter_tpu.obs import ledger as obs_ledger
+    from karpenter_tpu.obs.slo import SLO
     from karpenter_tpu.rpc import client as rpc_client
     from karpenter_tpu.rpc.client import RemoteScheduler
     from karpenter_tpu.rpc.service import SolverService, serve
@@ -574,25 +596,47 @@ def run_fleet_stage(
     rpc_client.TRANSPORT_RETRIES = 1
     rpc_client.RETRY_BASE_SECONDS = 0.05
     rpc_client.RETRY_CAP_SECONDS = 0.1
+    def steady_trace(client, prefix, trace_rng):
+        live: list[list] = []
+        lats: list[float] = []
+        for rnd in range(rounds):
+            live.append(
+                kind_batch(
+                    f"{prefix}{rnd}", max(int(trace_rng.poisson(delta_pods)), 1)
+                )
+            )
+            union = base + [p for b in live for p in b]
+            t0 = time.perf_counter()
+            res = client.solve(list(union))
+            lats.append(time.perf_counter() - t0)
+            assert not res.unschedulable
+        return lats
+
     envelope = {}
     try:
         with measured(envelope, stage=f"fleet_{resident_pods}x{delta_pods}"):
             # phase 1: single-replica steady trace — the latency yardstick
+            # (fleet trace propagation on, the default)
             c1 = RemoteScheduler(addr_a, templates, max_claims=max_claims)
             c1.solve(list(base))
-            live: list[list] = []
-            lat_steady: list[float] = []
-            for rnd in range(rounds):
-                live.append(
-                    kind_batch(f"s{rnd}", max(int(rng.poisson(delta_pods)), 1))
-                )
-                union = base + [p for b in live for p in b]
-                t0 = time.perf_counter()
-                res = c1.solve(list(union))
-                lat_steady.append(time.perf_counter() - t0)
-                assert not res.unschedulable
+            lat_steady = steady_trace(c1, "s", np.random.default_rng(seed))
+            # phase 1b: the identical trace with propagation OFF — the
+            # tracing-overhead gate's denominator (same shapes, so the
+            # compile caches are warm for both passes)
+            trace_env0 = os.environ.get("KTPU_FLEET_TRACE")
+            os.environ["KTPU_FLEET_TRACE"] = "0"
+            try:
+                c_off = RemoteScheduler(addr_a, templates, max_claims=max_claims)
+                c_off.solve(list(base))
+                lat_off = steady_trace(c_off, "o", np.random.default_rng(seed))
+            finally:
+                if trace_env0 is None:
+                    os.environ.pop("KTPU_FLEET_TRACE", None)
+                else:
+                    os.environ["KTPU_FLEET_TRACE"] = trace_env0
             # phase 2: the same trace against the A,B front; A dies
             # mid-stream and its session must hand off to B
+            chaos_seq0 = obs_ledger.LEDGER.seq()
             c2 = RemoteScheduler(
                 f"{addr_a},{addr_b}", templates, max_claims=max_claims
             )
@@ -643,6 +687,44 @@ def run_fleet_stage(
     p95_steady = float(np.percentile(np.asarray(lat_steady), 95))
     p95_chaos = float(np.percentile(np.asarray(lat_chaos), 95))
     ratio = round(p95_chaos / p95_steady, 2) if p95_steady > 0 else float("inf")
+    p95_off = float(np.percentile(np.asarray(lat_off), 95))
+    trace_ratio = (
+        round(p95_steady / p95_off, 2) if p95_off > 0 else float("inf")
+    )
+    # -- fleet observatory acceptance (ISSUE 17) ---------------------------
+    # stitch the chaos phase: every original round exactly once, the
+    # handoff trace spanning both replicas, and a valid Perfetto export
+    chaos_recs = [
+        r for r in fleetobs.fleet_records(dirs=[])
+        if (r.get("seq") or 0) > chaos_seq0
+    ]
+    counts = fleetobs.round_counts(chaos_recs)
+    dup = {s: n for s, n in counts.items() if n != 1}
+    assert not dup, f"rounds stitched more than once: {dup}"
+    replays = [r for r in chaos_recs if r.get("replay")]
+    assert replays, "adoption left no replay-marked rounds to stitch"
+    handoff_trace = (replays[0].get("trace") or {}).get("id")
+    stitched = fleetobs.stitch(handoff_trace, chaos_recs)
+    assert stitched is not None and len(stitched["replicas"]) >= 2, (
+        f"handoff trace {handoff_trace} does not span both replicas: "
+        f"{stitched and stitched['replicas']}"
+    )
+    perfetto = traceexport.chrome_trace(chaos_recs)
+    perfetto_problems = traceexport.validate(
+        json.loads(json.dumps(perfetto))
+    )
+    assert not perfetto_problems, f"perfetto export invalid: {perfetto_problems}"
+    if trace_out:
+        with open(trace_out, "w") as fh:
+            json.dump(perfetto, fh, sort_keys=True)
+    slo = SLO.snapshot()
+    avail_5m = slo["burn_rates"]["availability"]["5m"]
+    assert avail_5m["bad"] >= 1, (
+        f"the injected kill left no availability burn: {avail_5m}"
+    )
+    telemetry_frames = int(
+        FLEET_BUS_MESSAGES.get(topic="telemetry", direction="published")
+    )
     return {
         "resident_pods": len(base),
         "delta_pods": delta_pods,
@@ -666,9 +748,32 @@ def run_fleet_stage(
         "bus_published": int(
             sum(
                 FLEET_BUS_MESSAGES.get(topic=t, direction="published")
-                for t in ("quarantine", "audit", "session", "compile")
+                for t in bus_mod.TOPICS
             )
         ),
+        "telemetry_frames": telemetry_frames,
+        # -- tracing-overhead gate (ISSUE 17): steady p95 with fleet trace
+        # propagation on vs off, ratcheted by obs/bench_diff.py
+        "p95_trace_on_s": round(p95_steady, 4),
+        "p95_trace_off_s": round(p95_off, 4),
+        "trace_overhead_ratio": trace_ratio,
+        "trace_gate_max_ratio": TRACE_OVERHEAD_MAX_RATIO,
+        "trace_gate_ok": trace_ratio <= TRACE_OVERHEAD_MAX_RATIO,
+        "trace": {
+            "trace_id": handoff_trace,
+            "replicas": stitched["replicas"],
+            "rounds": len(stitched["rounds"]),
+            "replays": stitched["replays"],
+            "max_hop": stitched["max_hop"],
+            "unique_ok": not dup,
+            "perfetto_events": len(perfetto["traceEvents"]),
+            "perfetto_ok": not perfetto_problems,
+        },
+        "slo": {
+            "target": slo["target"],
+            "burn_rates": slo["burn_rates"],
+            "budget_remaining": slo["budget_remaining"],
+        },
         **envelope,
     }
 
@@ -1433,6 +1538,14 @@ def main() -> None:
         "capsule-handoff counts, and quarantine propagation",
     )
     parser.add_argument(
+        "--trace-out",
+        metavar="PATH",
+        default=None,
+        help="with --fleet: write the stitched chaos-phase Perfetto JSON "
+        "(one track per replica, handoffs as flow arrows) to PATH — "
+        "openable at https://ui.perfetto.dev",
+    )
+    parser.add_argument(
         "--guard",
         action="store_true",
         help="guardrails mode (ISSUE 10): assert the disabled-audit gates "
@@ -1491,7 +1604,9 @@ def main() -> None:
                 {
                     "metric": "fleet_chaos",
                     "platform": platform,
-                    "detail": run_fleet_stage(seed=args.steady_seed),
+                    "detail": run_fleet_stage(
+                        seed=args.steady_seed, trace_out=args.trace_out
+                    ),
                 }
             )
         )

@@ -884,7 +884,16 @@ class TPUScheduler:
         from karpenter_tpu.obs import ledger as obs_ledger
 
         self._last_fallback = None
-        n_pods = len(pods) if hasattr(pods, "__len__") else 0
+        pods = list(pods)
+        n_pods = len(pods)
+        # plain-solve problem capsule (ISSUE 17): only a spill-enabled
+        # ledger pays for the pristine-input copy — the solve may mutate
+        # existing nodes, and the capsule must record what went IN
+        cap_existing = (
+            [n.clone() for n in (existing_nodes or ())]
+            if obs_ledger.spill_dir()
+            else None
+        )
         t0 = _time.perf_counter()
         try:
             with kernel_scope("solve_round"):
@@ -898,10 +907,16 @@ class TPUScheduler:
                 wall_s=_time.perf_counter() - t0,
                 reason=type(err).__name__,
                 outcome="error",
+                pod_list=pods if cap_existing is not None else None,
+                existing_nodes=cap_existing,
             )
             raise
         obs_ledger.record_solve(
-            self, pods=n_pods, wall_s=_time.perf_counter() - t0
+            self,
+            pods=n_pods,
+            wall_s=_time.perf_counter() - t0,
+            pod_list=pods if cap_existing is not None else None,
+            existing_nodes=cap_existing,
         )
         return result
 
@@ -4438,15 +4453,22 @@ class ResidentSession:
         transcript uid has no pod in the capsule (a truncated/foreign
         capsule cannot be adopted)."""
         session = cls(sched)
-        for uids in rounds:
-            try:
-                pods = [pods_by_uid[u] for u in uids]
-            except KeyError:
-                return None
-            exist = [n.clone() for n in existing]
-            result = session.solve(pods, exist)
-            if result.unschedulable:
-                return None
+        # replayed rounds DO record in the ledger (real device work on
+        # this replica) but carry a replay mark: fleet stitching counts
+        # each round id exactly once, at the replica that first ran it
+        session._replaying = True
+        try:
+            for uids in rounds:
+                try:
+                    pods = [pods_by_uid[u] for u in uids]
+                except KeyError:
+                    return None
+                exist = [n.clone() for n in existing]
+                result = session.solve(pods, exist)
+                if result.unschedulable:
+                    return None
+        finally:
+            session._replaying = False
         return session
 
     # -- full path ---------------------------------------------------------

@@ -16,6 +16,16 @@ backends:
   publish is a single O_APPEND write (atomic for line-sized payloads on
   local filesystems), each fetch resumes from a byte offset and only
   consumes complete lines, so a torn tail line is re-read next pump.
+
+``FileBus`` logs are size-capped (``KTPU_BUS_MAX_BYTES``, 0 = unbounded):
+when a topic log would exceed the cap, its oldest complete lines are
+dropped and the surviving tail rewritten behind a one-line header that
+records the logical *base offset* — how many bytes of history were ever
+compacted away.  Offsets handed to ``fetch`` are logical positions in the
+infinite append stream, so a live subscriber's offset keeps meaning the
+same bytes across any number of rotations; only a subscriber parked
+before the base (slower than a whole rotation) loses messages, and it
+resumes cleanly from the base rather than mid-line.
 """
 
 from __future__ import annotations
@@ -25,7 +35,18 @@ import os
 import threading
 from typing import List, Tuple
 
-TOPICS = ("quarantine", "audit", "session", "compile")
+from ..utils import metrics
+
+TOPICS = ("quarantine", "audit", "session", "compile", "telemetry")
+
+_HEADER_MAGIC = b"#"
+
+
+def _env_max_bytes() -> int:
+    try:
+        return max(0, int(os.environ.get("KTPU_BUS_MAX_BYTES", "0") or 0))
+    except ValueError:
+        return 0
 
 
 class InProcessHub:
@@ -50,8 +71,9 @@ class FileBus:
     """Shared-directory bus for multi-process fleets (KTPU_FLEET_BUS=file,
     KTPU_FLEET_BUS_DIR=<dir>)."""
 
-    def __init__(self, dirpath: str):
+    def __init__(self, dirpath: str, max_bytes=None):
         self._dir = dirpath
+        self._max_bytes = _env_max_bytes() if max_bytes is None else max(0, int(max_bytes))
         os.makedirs(dirpath, exist_ok=True)
 
     def _path(self, topic: str) -> str:
@@ -60,19 +82,95 @@ class FileBus:
         safe = "".join(c for c in topic if c.isalnum() or c in "-_")
         return os.path.join(self._dir, f"{safe}.jsonl")
 
-    def publish(self, topic: str, msg: dict) -> None:
-        line = json.dumps(msg, sort_keys=True) + "\n"
-        fd = os.open(self._path(topic), os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    @staticmethod
+    def _split_header(data: bytes) -> Tuple[int, int]:
+        """(base_offset, header_len) of a topic file's raw bytes.
+
+        Pre-compaction files have no header: base 0, header 0.  A reader
+        that predates compaction treats the header line as corrupt JSON
+        and skips it, so mixed-version fleets degrade to at-least-once
+        rather than wedging.
+        """
+        if not data.startswith(_HEADER_MAGIC):
+            return 0, 0
+        nl = data.find(b"\n")
+        if nl < 0:
+            return 0, 0
         try:
-            os.write(fd, line.encode())
+            base = int(json.loads(data[1:nl].decode())["base"])
+        except (ValueError, KeyError, TypeError):
+            return 0, 0
+        return max(0, base), nl + 1
+
+    def publish(self, topic: str, msg: dict) -> None:
+        line = (json.dumps(msg, sort_keys=True) + "\n").encode()
+        path = self._path(topic)
+        if self._max_bytes:
+            self._maybe_compact(topic, path, incoming=len(line))
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            os.write(fd, line)
         finally:
             os.close(fd)
+
+    def _maybe_compact(self, topic: str, path: str, incoming: int) -> None:
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return
+        if size + incoming <= self._max_bytes:
+            return
+        # single-winner compaction: concurrent publishers skip rather
+        # than race the rewrite (their appends land after os.replace at
+        # worst into the pre-compaction inode, same as a torn publish)
+        lock = path + ".lock"
+        try:
+            lock_fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except OSError:
+            return
+        try:
+            with open(path, "rb") as fh:
+                data = fh.read()
+            base, hlen = self._split_header(data)
+            body = data[hlen:]
+            # keep the newest complete lines down to half the cap so
+            # compactions amortize instead of firing on every publish
+            keep_budget = max(incoming, self._max_bytes // 2)
+            cut = 0
+            while len(body) - cut > keep_budget:
+                nl = body.find(b"\n", cut)
+                if nl < 0:
+                    break
+                cut = nl + 1
+            if cut == 0:
+                return
+            new_base = base + cut
+            header = _HEADER_MAGIC + json.dumps({"base": new_base}).encode() + b"\n"
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as fh:
+                fh.write(header + body[cut:])
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+            metrics.FLEET_BUS_ROTATIONS.inc(topic=topic)
+        finally:
+            os.close(lock_fd)
+            try:
+                os.unlink(lock)
+            except OSError:
+                pass
 
     def fetch(self, topic: str, offset: int) -> Tuple[List[dict], int]:
         path = self._path(topic)
         try:
             with open(path, "rb") as fh:
-                fh.seek(offset)
+                head = fh.read(4096)
+                base, hlen = self._split_header(head)
+                if offset < base:
+                    # the prefix this subscriber never consumed was
+                    # compacted away; resume at the oldest surviving line
+                    offset = base
+                fh.seek(hlen + (offset - base))
                 chunk = fh.read()
         except FileNotFoundError:
             return [], offset

@@ -33,6 +33,7 @@ from karpenter_tpu.utils.metrics import (
 
 _MAX_ARCHIVE = 64
 _MAX_REMOTE_AUDITS = 256
+_MAX_REMOTE_ROUNDS = 512
 
 
 class FleetMember:
@@ -52,8 +53,15 @@ class FleetMember:
         #: fingerprints already published per sid (skip unchanged rounds)
         self._published_fpr: dict = {}
         self.remote_audits: deque = deque(maxlen=_MAX_REMOTE_AUDITS)
+        #: peers' compact round records (telemetry frames) for fleetobs
+        self.remote_rounds: deque = deque(maxlen=_MAX_REMOTE_ROUNDS)
         self.warm_kernels: set = set()
         self._closed = False
+        # the fleet observatory reads pumped telemetry frames off live
+        # members (weak registration; a collected member drops out)
+        from karpenter_tpu.obs import fleetobs
+
+        fleetobs.register(self)
         self._quarantine.add_listener(self._on_trip)
         guard_audit.add_audit_listener(self._on_audit)
         observatory.add_compile_listener(self._on_compile)
@@ -62,6 +70,12 @@ class FleetMember:
 
     def _publish(self, topic: str, msg: dict) -> None:
         msg = dict(msg, origin=self.replica_id)
+        if "trace" not in msg:
+            from karpenter_tpu.obs import tracectx
+
+            trace = tracectx.current_dict()
+            if trace is not None:
+                msg["trace"] = trace
         try:
             self.bus.publish(topic, msg)
         except Exception:
@@ -71,6 +85,9 @@ class FleetMember:
     def _on_trip(self, path: str, reason: str, ttl: float, source: str) -> None:
         if source != "local":
             return  # remote trips came FROM the bus; don't echo them back
+        from karpenter_tpu.obs.slo import SLO
+
+        SLO.observe_availability(False, kind="quarantine")
         self._publish(
             "quarantine", {"path": path, "reason": reason, "ttl_s": ttl}
         )
@@ -96,6 +113,17 @@ class FleetMember:
         # own archive first: a local eviction can re-adopt without peers
         self._archive_put(sid, fpr, doc)
         self._publish("session", {"sid": sid, "fpr": fpr, "doc": doc})
+
+    def publish_round(self, rec: dict) -> None:
+        """Announce one solved round as a compact telemetry frame so peers
+        (and fleetobs) see the fleet's timeline without sharing a ledger
+        directory. ``rec`` is a round-ledger record; only its wire-safe
+        keys ride the bus."""
+        from karpenter_tpu.obs import ledger as obs_ledger
+
+        frame = obs_ledger.telemetry_frame(rec)
+        if frame is not None:
+            self._publish("telemetry", frame)
 
     # -- bus -> local -------------------------------------------------------
 
@@ -144,6 +172,12 @@ class FleetMember:
             if kernel:
                 self.warm_kernels.add(kernel)
                 FLEET_WARM_ANNOUNCED.inc(kernel=kernel)
+        elif topic == "telemetry":
+            from karpenter_tpu.obs.slo import SLO
+
+            self.remote_rounds.append(dict(msg))
+            # peers' rounds burn the same fleet-wide SLO budget ours do
+            SLO.observe_record(msg)
 
     def _archive_put(self, sid: str, fpr: str, doc: dict) -> None:
         with self._lock:

@@ -37,6 +37,15 @@ def export_session(sid: str, session) -> Optional[dict]:
     if not chain:
         return None
     r = session._r
+    detail = {"fingerprint": session.fingerprint, "session_id": sid}
+    from karpenter_tpu.obs import tracectx
+
+    trace = tracectx.current_dict()
+    if trace is not None:
+        # the cutting round's fleet trace rides the capsule: an adopting
+        # replica replays under the SAME trace_id (one hop further), so
+        # the handoff stitches across both replicas in /debug/trace/<id>
+        detail["trace"] = trace
     try:
         return guard_bundle.make_bundle(
             "fleet",
@@ -45,7 +54,7 @@ def export_session(sid: str, session) -> Optional[dict]:
             dict(r["pod_by_uid"]),
             chain,
             existing_nodes=r["exist_pristine"],
-            detail={"fingerprint": session.fingerprint, "session_id": sid},
+            detail=detail,
         )
     except Exception:
         return None  # export is best-effort; the cold path still works
@@ -70,9 +79,15 @@ def adopt(sched, doc: dict, expect_fpr: str) -> Tuple[Optional[object], str]:
         # the capsule was cut under a different cluster shape; replaying
         # it here could not reproduce the chain, don't try
         return None, "shape_mismatch"
+    from karpenter_tpu.obs import tracectx
+
+    ctx = tracectx.TraceContext.from_dict((doc.get("detail") or {}).get("trace"))
     try:
-        _, pods_by_uid, existing, rounds = guard_bundle.materialize(doc)
-        session = ResidentSession.replay_chain(sched, pods_by_uid, existing, rounds)
+        with tracectx.activate(ctx.child() if ctx is not None else None):
+            _, pods_by_uid, existing, rounds = guard_bundle.materialize(doc)
+            session = ResidentSession.replay_chain(
+                sched, pods_by_uid, existing, rounds
+            )
     except Exception:
         return None, "replay_failed"
     if session is None:

@@ -20,6 +20,23 @@
   ``python -m karpenter_tpu.obs.bench_diff A.json B.json`` diffs two
   bench stage JSONs segment-by-segment and exits non-zero past
   ``KTPU_BENCH_DIFF_THRESHOLD``.
+- ``obs.tracectx`` (ISSUE 17): the compact fleet trace context
+  (trace_id / origin / tenant / hop) minted per client round, carried as
+  ``ktpu-fleet-trace`` metadata, and stamped onto ledger records,
+  waterfalls, capsules, and bus frames; opt-out ``KTPU_FLEET_TRACE=0``.
+- ``obs.fleetobs`` (ISSUE 17): the fleet observatory — merges ledger
+  rings, spilled JSONL dirs (``KTPU_FLEET_OBS_DIRS``), and bus telemetry
+  frames into one cross-replica timeline behind ``/debug/fleet`` and
+  ``/debug/trace/<id>``.
+- ``obs.traceexport`` (ISSUE 17): Chrome-trace/Perfetto JSON export of
+  any round window or stitched fleet trace (one track per replica,
+  waterfall spans as nested slices, handoffs as flow arrows);
+  ``python -m karpenter_tpu.obs.traceexport`` writes a viewer-ready file.
+- ``obs.slo`` (ISSUE 17): multi-window SLO burn-rate accounting
+  (``ktpu_slo_*``): latency objective from waterfall walls
+  (``KTPU_SLO_LATENCY_S``), availability objective from solve outcomes
+  plus fleet shed/retarget/handoff/quarantine events, against the
+  ``KTPU_SLO_TARGET`` error budget.
 """
 
 from karpenter_tpu.obs.ledger import LEDGER, RoundLedger

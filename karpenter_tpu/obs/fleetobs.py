@@ -1,0 +1,183 @@
+"""Fleet observatory: one cross-replica timeline out of N per-replica ones.
+
+PRs 12/15 gave every replica a flight recorder (ring + JSONL spill) and a
+per-round waterfall; PR 16 made the solver a fleet. This module is the
+aggregation layer the fleet was missing: it merges
+
+* this process's in-memory ledger ring (which, in co-located fleets like
+  ``bench --fleet`` and the tests, all replicas share),
+* any number of spilled ledger directories (``KTPU_FLEET_OBS_DIRS``, a
+  colon-separated list — point it at peers' ``KTPU_LEDGER_DIR``s on a
+  shared filesystem), and
+* telemetry frames pumped off the guardrail bus by live ``FleetMember``s
+  (peers' rounds arrive compact, no shared disk needed),
+
+into one deduplicated, time-ordered record stream. Records are keyed by
+``(replica, seq)`` — each replica's ledger seq is monotone, so the same
+round seen via ring + spill + bus collapses to one entry.
+
+On top of that stream sit the two debug surfaces the runtime serves:
+``/debug/fleet`` (per-replica rollup + SLO burn rates) and
+``/debug/trace/<id>`` (every record stamped with that fleet trace id, in
+order — the round's whole journey across retargets and handoffs,
+adoption replays marked as such). The stitching contract: among
+``source == "local"`` records that are not replays, every round sig
+appears exactly once fleet-wide.
+"""
+
+from __future__ import annotations
+
+import os
+import weakref
+from collections import Counter
+from typing import Iterable, Optional
+
+from karpenter_tpu.obs import ledger as obs_ledger
+from karpenter_tpu.obs.slo import SLO
+
+ENV_OBS_DIRS = "KTPU_FLEET_OBS_DIRS"
+
+#: live FleetMembers whose pumped telemetry frames feed the timeline;
+#: weak so a closed/collected member simply drops out
+MEMBERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register(member) -> None:
+    MEMBERS.add(member)
+
+
+def obs_dirs() -> list:
+    raw = os.environ.get(ENV_OBS_DIRS, "")
+    dirs = [d for d in raw.split(":") if d]
+    own = obs_ledger.spill_dir()
+    if own and own not in dirs:
+        dirs.append(own)
+    return dirs
+
+
+def _key(rec: dict):
+    return (rec.get("replica"), rec.get("seq"), rec.get("t"))
+
+
+def fleet_records(dirs: Optional[Iterable[str]] = None) -> list:
+    """The merged fleet timeline, oldest first.
+
+    Ring records win over spilled/bus copies of the same round (they are
+    the caller's live dicts); everything is deduplicated by
+    ``(replica, seq)`` identity."""
+    seen = set()
+    out = []
+
+    def take(rec) -> None:
+        if not isinstance(rec, dict):
+            return
+        k = _key(rec)
+        if k in seen:
+            return
+        seen.add(k)
+        out.append(rec)
+
+    for rec in obs_ledger.LEDGER.records():
+        take(rec)
+    for member in list(MEMBERS):
+        for rec in list(getattr(member, "remote_rounds", ())):
+            take(rec)
+    for d in dirs if dirs is not None else obs_dirs():
+        for rec in obs_ledger.load_spilled(d):
+            take(rec)
+    out.sort(key=lambda r: (r.get("t") or 0.0, str(r.get("replica")), r.get("seq") or 0))
+    return out
+
+
+def trace_of(rec: dict) -> Optional[str]:
+    trace = rec.get("trace")
+    return trace.get("id") if isinstance(trace, dict) else None
+
+
+def trace_records(trace_id: str, records: Optional[list] = None) -> list:
+    records = fleet_records() if records is None else records
+    return [r for r in records if trace_of(r) == trace_id]
+
+
+def round_counts(records: Iterable[dict]) -> Counter:
+    """How often each round sig appears as ORIGINAL local work — the
+    exactly-once stitching invariant counts these (remote echoes and
+    adoption replays are views of a round, not new rounds)."""
+    counts: Counter = Counter()
+    for rec in records:
+        if rec.get("source") != "local" or rec.get("replay"):
+            continue
+        sig = rec.get("sig")
+        if sig:
+            counts[sig] += 1
+    return counts
+
+
+def stitch(trace_id: str, records: Optional[list] = None) -> Optional[dict]:
+    """Everything the fleet knows about one trace id, time-ordered."""
+    rounds = trace_records(trace_id, records)
+    if not rounds:
+        return None
+    replicas = sorted({str(r.get("replica")) for r in rounds})
+    traces = [r.get("trace") or {} for r in rounds]
+    counts = round_counts(rounds)
+    return {
+        "trace_id": trace_id,
+        "origin": next((t.get("origin") for t in traces if t.get("origin")), ""),
+        "tenant": next((t.get("tenant") for t in traces if t.get("tenant")), ""),
+        "replicas": replicas,
+        "max_hop": max((t.get("hop") or 0 for t in traces), default=0),
+        "rounds": rounds,
+        "replays": sum(1 for r in rounds if r.get("replay")),
+        # a stitched trace is consistent when no original round repeats
+        "consistent": all(n == 1 for n in counts.values()),
+    }
+
+
+def fleet_summary(records: Optional[list] = None) -> dict:
+    """Per-replica rollup + SLO state — the /debug/fleet payload."""
+    records = fleet_records() if records is None else records
+    replicas: dict = {}
+    traces = set()
+    for rec in records:
+        rid = str(rec.get("replica"))
+        row = replicas.setdefault(
+            rid,
+            {"rounds": 0, "replays": 0, "errors": 0, "modes": Counter(),
+             "wall_s_sum": 0.0, "last_t": 0.0},
+        )
+        row["rounds"] += 1
+        row["modes"][str(rec.get("mode"))] += 1
+        if rec.get("replay"):
+            row["replays"] += 1
+        if rec.get("outcome") not in (None, "ok"):
+            row["errors"] += 1
+        row["wall_s_sum"] += rec.get("wall_s") or 0.0
+        row["last_t"] = max(row["last_t"], rec.get("t") or 0.0)
+        tid = trace_of(rec)
+        if tid:
+            traces.add(tid)
+    for row in replicas.values():
+        row["modes"] = dict(row["modes"])
+        row["wall_s_sum"] = round(row["wall_s_sum"], 6)
+    dup = {s: n for s, n in round_counts(records).items() if n != 1}
+    return {
+        "replicas": replicas,
+        "records": len(records),
+        "traces": len(traces),
+        "duplicate_rounds": dup,
+        "slo": SLO.snapshot(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# /debug payloads (utils/runtime.py serves these)
+# ---------------------------------------------------------------------------
+
+
+def debug_fleet() -> dict:
+    return fleet_summary()
+
+
+def debug_trace(trace_id: str) -> Optional[dict]:
+    return stitch(trace_id)

@@ -30,6 +30,8 @@ append — no encoding, no I/O unless spill is opted in. ``bench.py
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import hashlib
 import itertools
 import json
@@ -66,6 +68,29 @@ def spill_dir() -> Optional[str]:
     return os.environ.get(ENV_DIR) or None
 
 
+# which replica's name gets stamped on records: the RPC service scopes
+# each solve to its fleet member's id; outside any scope the process pid
+# stands in (a single-process deployment IS one replica)
+_REPLICA: contextvars.ContextVar = contextvars.ContextVar(
+    "ktpu_ledger_replica", default=""
+)
+
+
+def current_replica() -> str:
+    rid = _REPLICA.get()
+    return rid or os.environ.get("KTPU_REPLICA_ID", "") or f"proc-{os.getpid()}"
+
+
+@contextlib.contextmanager
+def replica_scope(replica_id: str):
+    """Stamp records made inside the scope with this replica id."""
+    token = _REPLICA.set(replica_id or "")
+    try:
+        yield
+    finally:
+        _REPLICA.reset(token)
+
+
 class RoundLedger:
     """Bounded ring of per-round records + optional JSONL spill."""
 
@@ -86,11 +111,25 @@ class RoundLedger:
         rec["seq"] = next(self._seq)
         rec["t"] = self._now()
         rec.setdefault("source", "local")
+        rec.setdefault("replica", current_replica())
+        if "trace" not in rec:
+            from karpenter_tpu.obs import tracectx
+
+            ctx = tracectx.current()
+            if ctx is None:
+                # no fleet round in flight: mint a local one so every
+                # round — in-process solves included — is trace-queryable
+                ctx = tracectx.mint(origin=rec["replica"])
+            if ctx is not None:
+                rec["trace"] = ctx.as_dict()
         with self._lock:
             if self._ring.maxlen != ring_size():
                 self._ring = deque(self._ring, maxlen=ring_size())
             self._ring.append(rec)
         LEDGER_ROUNDS.inc(source=rec["source"])
+        from karpenter_tpu.obs.slo import SLO
+
+        SLO.observe_record(rec)
         d = spill_dir()
         if d:
             self._spill(rec, d)
@@ -189,8 +228,15 @@ def _drain_compiles() -> list:
 
 
 def record_solve(sched, *, pods: int, wall_s: float, mode: str = "full",
-                 reason: str = "snapshot", outcome: str = "ok") -> dict:
-    """One record for a plain (non-resident) TPUScheduler.solve round."""
+                 reason: str = "snapshot", outcome: str = "ok",
+                 pod_list=None, existing_nodes=None) -> dict:
+    """One record for a plain (non-resident) TPUScheduler.solve round.
+
+    When the caller hands over the actual pod objects (and the pristine
+    existing nodes), a spill-enabled ledger writes the same deduped
+    problem capsule resident rounds get — a single-round transcript — so
+    ``materialize`` and ``/debug/trace/<id>`` work for snapshot solves
+    too, not just resident sessions."""
     timings = dict(getattr(sched, "last_timings", None) or {})
     fallback = getattr(sched, "_last_fallback", None)
     rec = {
@@ -213,6 +259,12 @@ def record_solve(sched, *, pods: int, wall_s: float, mode: str = "full",
             rec["stages"] = stages
         if timings.get("waterfall"):
             rec["waterfall"] = timings["waterfall"]
+    if pod_list is not None and spill_dir():
+        transcript = [[str(p.uid) for p in pod_list]]
+        capsule = _plain_capsule(sched, pod_list, existing_nodes or (), transcript)
+        if capsule is not None:
+            rec["transcript"] = transcript
+            rec["capsule"] = capsule
     compiles = _drain_compiles()
     if compiles:
         rec["compiles"] = compiles
@@ -255,6 +307,12 @@ def record_session_round(session, *, pods: int, wall_s: float) -> dict:
             "twin_s": audit.get("twin_s"),
             "bundle": audit.get("bundle"),
         }
+    if getattr(session, "_replaying", False):
+        # an adoption replay re-solves the capsule transcript; its records
+        # are real work on this replica but the rounds themselves already
+        # happened on the origin — mark them so fleet stitching counts
+        # each round id exactly once
+        rec["replay"] = True
     r = getattr(session, "_r", None)
     if r is not None and r.get("rounds"):
         last = r["rounds"][-1]
@@ -325,6 +383,41 @@ def _maybe_capsule(session, transcript: list) -> Optional[str]:
     return LEDGER.save_capsule(doc, sig)
 
 
+def _plain_capsule(sched, pod_list, existing_nodes, transcript: list) -> Optional[str]:
+    """A deduped single-round problem capsule for a snapshot solve."""
+    if not spill_dir():
+        return None
+    h = hashlib.blake2s(digest_size=8)
+    for uids in transcript:
+        h.update(b"\x01")
+        for u in sorted(uids):
+            h.update(str(u).encode())
+            h.update(b"\x00")
+    for n in existing_nodes:
+        h.update(str(getattr(n, "name", n)).encode())
+        h.update(b"\x00")
+    sig = h.hexdigest()
+    with LEDGER._lock:
+        cached = LEDGER._capsules.get(sig)
+    if cached is not None:
+        return cached
+    from karpenter_tpu.guard import bundle as guard_bundle
+
+    try:
+        doc = guard_bundle.make_bundle(
+            "snapshot",
+            "round-ledger problem capsule (plain solve)",
+            sched,
+            {p.uid: p for p in pod_list},
+            transcript,
+            existing_nodes=existing_nodes,
+            detail={},
+        )
+    except Exception:
+        return None  # capsule is best-effort diagnostics
+    return LEDGER.save_capsule(doc, sig)
+
+
 # ---------------------------------------------------------------------------
 # wire form (SolveStream trailing metadata) + remote ingestion
 # ---------------------------------------------------------------------------
@@ -333,7 +426,8 @@ def _maybe_capsule(session, transcript: list) -> Optional[str]:
 # keeps scalars + the sig chain and drops bulky per-stage detail
 _WIRE_KEYS = (
     "mode", "reason", "outcome", "pods", "wall_s", "encode_s", "device_s",
-    "decode_s", "fallback", "sig", "fpr", "guard",
+    "decode_s", "fallback", "sig", "fpr", "guard", "trace", "replica",
+    "replay",
 )
 _WIRE_BUDGET = 6000
 
@@ -364,6 +458,21 @@ def ingest_remote(raw: str) -> Optional[dict]:
         return None
     rec["source"] = "remote"
     return LEDGER.record(rec)
+
+
+def telemetry_frame(rec: dict) -> Optional[dict]:
+    """A compact bus-frame form of a record for the fleet telemetry topic.
+
+    Same wire-safe keys as the trailing-metadata form, plus the stitching
+    identity (seq/t/capsule) — peers fold these into their SLO windows
+    and ``obs/fleetobs.py`` merges them into the cross-replica timeline."""
+    if not isinstance(rec, dict):
+        return None
+    frame = {k: rec[k] for k in _WIRE_KEYS if rec.get(k) is not None}
+    for k in ("seq", "t", "capsule"):
+        if rec.get(k) is not None:
+            frame[k] = rec[k]
+    return frame or None
 
 
 # ---------------------------------------------------------------------------

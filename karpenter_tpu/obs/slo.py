@@ -1,0 +1,208 @@
+"""SLO burn-rate accounting for the solver fleet.
+
+Two objectives, fed from the round ledger and the guardrail bus:
+
+* **latency** — a round is good when its waterfall wall lands under
+  ``KTPU_SLO_LATENCY_S`` (default 1.0 s), bad otherwise.  Every ledger
+  record with a wall contributes, including telemetry frames from fleet
+  peers, so the burn rate is fleet-wide wherever the bus reaches.
+* **availability** — solve outcomes plus the fleet's degradation signals:
+  an ``ok`` round is good; an error/quarantined round, an admission shed,
+  a client retarget (a replica was unreachable), and a failed handoff are
+  bad.  A successful adoption counts good — the whole point of session
+  mobility is that the client never saw the loss.
+
+Burn rate follows the multi-window convention: for each window, the
+bad-event fraction divided by the error budget ``1 - KTPU_SLO_TARGET``
+(default target 0.99, i.e. a 1% budget).  Burn 1.0 spends the budget
+exactly at the objective's edge; paging rules typically fire when both a
+short and a long window burn hot, which is why both are exported as
+``ktpu_slo_burn_rate{objective,window}`` gauges.
+
+Cost model: the tracker sits on the ledger's record path, which pins its
+overhead below 100us/record — so windows keep incremental good/bad
+counters (append + amortized front-eviction, O(1) per event) and gauge
+export is throttled to every ``_EXPORT_EVERY`` events; ``snapshot()``
+always recomputes and re-exports.  The clock is injectable so tests
+drive time by hand.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+from ..utils import metrics
+
+# (label, seconds) — short window catches fast burns, long window catches
+# slow leaks; both must run hot before anyone should be paged.
+WINDOWS = (("5m", 300.0), ("1h", 3600.0))
+
+OBJECTIVES = ("latency", "availability")
+
+_MAX_EVENTS = 8192  # per objective per window; oldest evict first
+_EXPORT_EVERY = 32  # gauge export cadence on the hot record path
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class _Window:
+    """One sliding window's event deque with incremental good/bad counts."""
+
+    __slots__ = ("span", "events", "total", "bad")
+
+    def __init__(self, span: float):
+        self.span = span
+        self.events: deque = deque()
+        self.total = 0
+        self.bad = 0
+
+    def add(self, t: float, good: bool) -> None:
+        self.events.append((t, good))
+        self.total += 1
+        self.bad += 0 if good else 1
+        self.expire(t)
+        while len(self.events) > _MAX_EVENTS:
+            self._evict()
+
+    def expire(self, now: float) -> None:
+        horizon = now - self.span
+        while self.events and self.events[0][0] < horizon:
+            self._evict()
+
+    def _evict(self) -> None:
+        _, good = self.events.popleft()
+        self.total -= 1
+        self.bad -= 0 if good else 1
+
+
+class SLOTracker:
+    """Sliding-window good/bad event accounting with burn-rate export."""
+
+    def __init__(self, *, target=None, latency_s=None, clock=time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._since_export = 0
+        self.reconfigure(target=target, latency_s=latency_s)
+        self._windows = {
+            o: {label: _Window(span) for label, span in WINDOWS}
+            for o in OBJECTIVES
+        }
+
+    def reconfigure(self, *, target=None, latency_s=None) -> None:
+        """(Re)read objectives; env wins only when no explicit value given."""
+        self.target = (
+            target
+            if target is not None
+            else min(0.9999, max(0.5, _env_float("KTPU_SLO_TARGET", 0.99)))
+        )
+        self.latency_s = (
+            latency_s
+            if latency_s is not None
+            else max(1e-6, _env_float("KTPU_SLO_LATENCY_S", 1.0))
+        )
+
+    def reset(self) -> None:
+        with self._lock:
+            for per in self._windows.values():
+                for label, span in WINDOWS:
+                    per[label] = _Window(span)
+            self._since_export = 0
+        self._export()
+
+    # ------------------------------------------------------------- feeds
+    def observe_latency(self, wall_s, *, t=None) -> None:
+        if wall_s is None:
+            return
+        self._observe("latency", float(wall_s) <= self.latency_s, t)
+
+    def observe_availability(self, good: bool, *, kind: str = "round", t=None) -> None:
+        del kind  # reserved for future per-kind breakdowns
+        self._observe("availability", bool(good), t)
+
+    def observe_record(self, rec) -> None:
+        """Fold one round-ledger record (local, remote, or bus frame) in."""
+        if not isinstance(rec, dict):
+            return
+        wall = rec.get("wall_s")
+        if wall is not None:
+            self.observe_latency(wall)
+        outcome = rec.get("outcome")
+        if outcome is not None:
+            bad = outcome != "ok" or rec.get("mode") == "quarantined"
+            self.observe_availability(not bad)
+
+    def _observe(self, objective: str, good: bool, t=None) -> None:
+        now = self._clock() if t is None else t
+        with self._lock:
+            for window in self._windows[objective].values():
+                window.add(now, good)
+            self._since_export += 1
+            due = self._since_export >= _EXPORT_EVERY
+            if due:
+                self._since_export = 0
+        metrics.SLO_EVENTS.inc(
+            objective=objective, outcome="good" if good else "bad"
+        )
+        if due:
+            self._export(now=now)
+
+    # ----------------------------------------------------------- reports
+    def burn_rates(self, *, now=None) -> dict:
+        """{objective: {window: {total, bad, burn_rate}}} over live windows."""
+        now = self._clock() if now is None else now
+        budget = max(1e-9, 1.0 - self.target)
+        out = {}
+        with self._lock:
+            for objective, per in self._windows.items():
+                cells = {}
+                for label, window in per.items():
+                    window.expire(now)
+                    frac = (window.bad / window.total) if window.total else 0.0
+                    cells[label] = {
+                        "total": window.total,
+                        "bad": window.bad,
+                        "burn_rate": round(frac / budget, 4),
+                    }
+                out[objective] = cells
+        return out
+
+    def budget_remaining(self, *, now=None) -> dict:
+        """Fraction of the long-window error budget unspent, per objective."""
+        rates = self.burn_rates(now=now)
+        label = WINDOWS[-1][0]
+        return {
+            objective: round(max(0.0, 1.0 - per[label]["burn_rate"]), 4)
+            for objective, per in rates.items()
+        }
+
+    def snapshot(self, *, now=None) -> dict:
+        now = self._clock() if now is None else now
+        self._export(now=now)
+        return {
+            "target": self.target,
+            "latency_objective_s": self.latency_s,
+            "windows": {label: span for label, span in WINDOWS},
+            "burn_rates": self.burn_rates(now=now),
+            "budget_remaining": self.budget_remaining(now=now),
+        }
+
+    def _export(self, *, now=None) -> None:
+        rates = self.burn_rates(now=now)
+        for objective, per in rates.items():
+            for label, cell in per.items():
+                metrics.SLO_BURN_RATE.set(
+                    cell["burn_rate"], objective=objective, window=label
+                )
+        for objective, remaining in self.budget_remaining(now=now).items():
+            metrics.SLO_BUDGET_REMAINING.set(remaining, objective=objective)
+
+
+SLO = SLOTracker()

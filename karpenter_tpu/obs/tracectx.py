@@ -1,0 +1,120 @@
+"""Compact fleet trace context: one id per solve round, carried everywhere.
+
+The resident tracer (``tracing/tracer.py``) is a heavyweight, opt-in span
+recorder gated on ``KTPU_TRACE_DIR``.  This module is its always-on sibling:
+a four-field context — ``trace_id``, origin replica, tenant, hop count —
+minted once per client round in ``rpc/client.py`` and threaded through the
+wire (``ktpu-fleet-trace`` metadata), the round ledger, the waterfall,
+handoff capsules, and guardrail-bus frames.  Stamping a dict onto records
+that already exist costs nanoseconds; the payoff is that one round's journey
+across retargets, sheds, and handoffs stitches into a single tree that
+``obs/fleetobs.py`` can query by id.
+
+Wire format is a single pipe-joined string (``id|origin|tenant|hop``) so it
+survives gRPC metadata, JSON, and log lines without escaping ceremony.
+``KTPU_FLEET_TRACE=0`` disables minting entirely (the bench overhead gate
+flips this knob to measure the cost of propagation).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import uuid
+from dataclasses import dataclass
+
+METADATA_KEY = "ktpu-fleet-trace"
+
+_ACTIVE: contextvars.ContextVar["TraceContext | None"] = contextvars.ContextVar(
+    "ktpu_fleet_trace", default=None
+)
+
+
+def enabled() -> bool:
+    return os.environ.get("KTPU_FLEET_TRACE", "1") not in ("0", "false", "no")
+
+
+@dataclass
+class TraceContext:
+    """Identity of one round's fleet-wide journey.
+
+    ``hop`` counts wire crossings and retargets: the client mints hop 0,
+    bumps on every retarget, and the serving replica activates at hop+1 —
+    so a round that failed over reads hop>=2 where a clean round reads 1.
+    """
+
+    trace_id: str
+    origin: str
+    tenant: str = ""
+    hop: int = 0
+
+    def to_wire(self) -> str:
+        return "|".join(
+            (self.trace_id, self.origin, self.tenant, str(self.hop))
+        )
+
+    @classmethod
+    def from_wire(cls, raw: str) -> "TraceContext | None":
+        parts = (raw or "").split("|")
+        if len(parts) != 4 or not parts[0]:
+            return None
+        try:
+            hop = int(parts[3])
+        except ValueError:
+            hop = 0
+        return cls(trace_id=parts[0], origin=parts[1], tenant=parts[2], hop=hop)
+
+    def as_dict(self) -> dict:
+        return {
+            "id": self.trace_id,
+            "origin": self.origin,
+            "tenant": self.tenant,
+            "hop": self.hop,
+        }
+
+    @classmethod
+    def from_dict(cls, d) -> "TraceContext | None":
+        if not isinstance(d, dict) or not d.get("id"):
+            return None
+        return cls(
+            trace_id=str(d["id"]),
+            origin=str(d.get("origin", "")),
+            tenant=str(d.get("tenant", "")),
+            hop=int(d.get("hop", 0) or 0),
+        )
+
+    def child(self) -> "TraceContext":
+        """Same trace, one hop further along (wire crossing / adoption)."""
+        return TraceContext(self.trace_id, self.origin, self.tenant, self.hop + 1)
+
+
+def mint(origin: str, tenant: str = "") -> TraceContext | None:
+    """New trace context, or None when propagation is disabled."""
+    if not enabled():
+        return None
+    return TraceContext(
+        trace_id=uuid.uuid4().hex[:16], origin=origin, tenant=tenant, hop=0
+    )
+
+
+def current() -> TraceContext | None:
+    return _ACTIVE.get()
+
+
+@contextlib.contextmanager
+def activate(ctx: TraceContext | None):
+    """Install ``ctx`` as the round's trace for the duration; None no-ops."""
+    if ctx is None:
+        yield None
+        return
+    token = _ACTIVE.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _ACTIVE.reset(token)
+
+
+def current_dict() -> dict | None:
+    ctx = _ACTIVE.get()
+    return ctx.as_dict() if ctx is not None else None

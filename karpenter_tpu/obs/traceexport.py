@@ -1,0 +1,251 @@
+"""Chrome-trace / Perfetto export for ledger rounds and fleet traces.
+
+Any list of round-ledger records — one replica's ring, a spilled incident
+window, or a stitched cross-replica trace out of ``obs/fleetobs.py`` —
+becomes a standard Chrome trace-event JSON document (``{"traceEvents":
+[...]}``) that https://ui.perfetto.dev and chrome://tracing open as-is:
+
+* one track (pid) per replica, named by process-name metadata events;
+* each round is a complete ("X") slice spanning its wall, with its
+  waterfall spans nested inside as child slices (the waterfall records
+  offsets relative to round start, so nesting is exact);
+* handoffs are flow arrows ("s"/"f"): when consecutive records of one
+  trace id sit on different replicas — a retargeted round, an adoption
+  replay — an arrow connects them across tracks;
+* the round slice's args carry the stitching identity (trace id, sig,
+  hop, replay mark) and the waterfall's reconciled segment table, so the
+  exactness invariant (Σ segments + other = wall) can be re-checked on
+  the exported document alone: ``validate()`` does exactly that, and the
+  schema round-trip test runs it on every export.
+
+Timestamps are microseconds relative to the earliest round start in the
+batch (Chrome traces don't need an epoch, and small numbers keep the
+JSON compact).
+"""
+
+from __future__ import annotations
+
+import json
+import zlib
+from typing import Iterable, Optional
+
+_US = 1e6
+
+# validation tolerance: segments/spans are stored rounded to 1e-6 s, so a
+# round with MAX_NAMES segments accumulates at most ~1e-4 s of rounding
+_TOL_S = 1e-3
+
+
+def _pid_map(records: list) -> dict:
+    replicas = sorted({str(r.get("replica")) for r in records})
+    return {rid: i + 1 for i, rid in enumerate(replicas)}
+
+
+def _round_name(rec: dict) -> str:
+    mode = rec.get("mode") or "round"
+    tag = " (replay)" if rec.get("replay") else ""
+    return f"{mode} #{rec.get('seq', '?')}{tag}"
+
+
+def chrome_trace(records: Iterable[dict], *, flows: bool = True) -> dict:
+    """Records -> Chrome trace-event document (one track per replica)."""
+    recs = [
+        r for r in records
+        if isinstance(r, dict) and (r.get("wall_s") or 0) > 0 and r.get("t")
+    ]
+    recs.sort(key=lambda r: r.get("t") or 0.0)
+    pids = _pid_map(recs)
+    events: list = []
+    for rid, pid in pids.items():
+        events.append({
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": f"replica {rid}"},
+        })
+        events.append({
+            "ph": "M", "name": "thread_name", "pid": pid, "tid": 1,
+            "args": {"name": "solve rounds"},
+        })
+    if not recs:
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def start_of(rec: dict) -> float:
+        wf = rec.get("waterfall") or {}
+        wall = wf.get("wall_s") or rec.get("wall_s") or 0.0
+        return (rec.get("t") or 0.0) - wall
+
+    t0 = min(start_of(r) for r in recs)
+    slice_bounds = {}  # record identity -> (pid, ts_us, dur_us)
+    for rec in recs:
+        pid = pids[str(rec.get("replica"))]
+        wf = rec.get("waterfall") or {}
+        wall = wf.get("wall_s") or rec.get("wall_s") or 0.0
+        ts = round((start_of(rec) - t0) * _US, 3)
+        dur = round(wall * _US, 3)
+        trace = rec.get("trace") or {}
+        args = {
+            "trace_id": trace.get("id"),
+            "hop": trace.get("hop"),
+            "tenant": trace.get("tenant"),
+            "replica": rec.get("replica"),
+            "seq": rec.get("seq"),
+            "source": rec.get("source"),
+            "reason": rec.get("reason"),
+            "outcome": rec.get("outcome"),
+            "sig": rec.get("sig"),
+            "replay": bool(rec.get("replay")),
+        }
+        if wf.get("segments"):
+            # the reconciled self-time table: Σ (incl. other) == wall —
+            # validate() re-checks this invariant on the exported doc
+            args["segments"] = wf["segments"]
+            args["wall_s"] = wf.get("wall_s")
+        events.append({
+            "ph": "X", "cat": "round", "name": _round_name(rec),
+            "pid": pid, "tid": 1, "ts": ts, "dur": dur,
+            "args": {k: v for k, v in args.items() if v is not None},
+        })
+        slice_bounds[id(rec)] = (pid, ts, dur)
+        spans = wf.get("spans") or {}
+        names = spans.get("name") or []
+        starts = spans.get("start_s") or []
+        durs = spans.get("dur_s") or []
+        depths = spans.get("depth") or []
+        for name, s, d, depth in zip(names, starts, durs, depths):
+            events.append({
+                "ph": "X", "cat": "span", "name": name,
+                "pid": pid, "tid": 1,
+                "ts": round((start_of(rec) - t0 + s) * _US, 3),
+                "dur": round(d * _US, 3),
+                "args": {"depth": depth},
+            })
+    if flows:
+        events.extend(_flow_events(recs, slice_bounds))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _flow_events(recs: list, slice_bounds: dict) -> list:
+    """Handoff / retarget arrows: consecutive records of one trace id on
+    DIFFERENT replicas get a flow step from the earlier slice to the
+    later one (e.g. origin round -> adoption replay on the peer)."""
+    by_trace: dict = {}
+    for rec in recs:
+        tid = (rec.get("trace") or {}).get("id")
+        if tid:
+            by_trace.setdefault(tid, []).append(rec)
+    out = []
+    for tid, chain in by_trace.items():
+        for a, b in zip(chain, chain[1:]):
+            if a.get("replica") == b.get("replica"):
+                continue
+            flow_id = zlib.crc32(f"{tid}:{b.get('seq')}".encode()) & 0x7FFFFFFF
+            pid_a, ts_a, dur_a = slice_bounds[id(a)]
+            pid_b, ts_b, dur_b = slice_bounds[id(b)]
+            common = {"cat": "flow", "name": "handoff", "id": flow_id}
+            out.append(dict(
+                common, ph="s", pid=pid_a, tid=1,
+                ts=round(ts_a + dur_a, 3),
+            ))
+            out.append(dict(
+                common, ph="f", bp="e", pid=pid_b, tid=1,
+                ts=round(ts_b + max(dur_b, 1.0) / 2, 3),
+            ))
+    return out
+
+
+def validate(doc: dict, *, tol_s: float = _TOL_S) -> list:
+    """Schema + invariant check of an exported document; returns a list
+    of problem strings (empty = the trace is well-formed and every round
+    slice's segment table reconciles: Σ segments + other = wall)."""
+    problems = []
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["traceEvents is not a list"]
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict) or "ph" not in ev:
+            problems.append(f"event {i}: no phase")
+            continue
+        ph = ev["ph"]
+        if ph == "X":
+            missing = [k for k in ("name", "pid", "tid", "ts", "dur") if k not in ev]
+            if missing:
+                problems.append(f"event {i} ({ev.get('name')}): missing {missing}")
+            elif ev["dur"] < 0 or ev["ts"] < 0:
+                problems.append(f"event {i} ({ev.get('name')}): negative time")
+        elif ph in ("s", "f"):
+            if "id" not in ev or "ts" not in ev:
+                problems.append(f"flow event {i}: missing id/ts")
+    # flows must pair up: every start has a finish and vice versa
+    starts = {e["id"] for e in events if e.get("ph") == "s" and "id" in e}
+    ends = {e["id"] for e in events if e.get("ph") == "f" and "id" in e}
+    for orphan in starts ^ ends:
+        problems.append(f"flow {orphan}: unpaired start/finish")
+    # the waterfall exactness invariant, re-checked on the export alone
+    for ev in events:
+        if ev.get("ph") != "X" or ev.get("cat") != "round":
+            continue
+        segments = (ev.get("args") or {}).get("segments")
+        if not segments:
+            continue
+        wall = (ev.get("args") or {}).get("wall_s") or ev["dur"] / _US
+        total = sum(segments.values())
+        if abs(total - wall) > max(tol_s, 0.01 * wall):
+            problems.append(
+                f"round {ev.get('name')}: segments sum {total:.6f}s != "
+                f"wall {wall:.6f}s"
+            )
+    return problems
+
+
+def export_trace(trace_id: str, records: Optional[list] = None) -> Optional[dict]:
+    """Stitch one fleet trace id and export it; None when unknown."""
+    from karpenter_tpu.obs import fleetobs
+
+    stitched = fleetobs.stitch(trace_id, records)
+    if stitched is None:
+        return None
+    return chrome_trace(stitched["rounds"])
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+
+    from karpenter_tpu.obs import fleetobs
+
+    parser = argparse.ArgumentParser(
+        prog="python -m karpenter_tpu.obs.traceexport",
+        description="export ledger rounds / fleet traces as Perfetto JSON",
+    )
+    parser.add_argument(
+        "--dir", action="append", default=None,
+        help="ledger spill directory (repeatable; default: "
+             "$KTPU_FLEET_OBS_DIRS + $KTPU_LEDGER_DIR)",
+    )
+    parser.add_argument("--trace", default=None, help="one fleet trace id only")
+    parser.add_argument("-n", type=int, default=None, help="last N rounds only")
+    parser.add_argument("--out", default="fleet-trace.json", help="output path")
+    args = parser.parse_args(argv)
+
+    records = fleet_records = fleetobs.fleet_records(args.dir)
+    if args.trace:
+        records = fleetobs.trace_records(args.trace, fleet_records)
+        if not records:
+            print(f"trace {args.trace!r} not found")
+            return 2
+    if args.n is not None:
+        records = records[-args.n:]
+    doc = chrome_trace(records)
+    problems = validate(doc)
+    with open(args.out, "w") as fh:
+        json.dump(doc, fh, sort_keys=True)
+    n_rounds = sum(
+        1 for e in doc["traceEvents"]
+        if e.get("ph") == "X" and e.get("cat") == "round"
+    )
+    print(f"{args.out}: {n_rounds} rounds, {len(doc['traceEvents'])} events")
+    for p in problems:
+        print(f"INVARIANT: {p}")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -203,6 +203,13 @@ class RoundWaterfall:
         }
         if self.dropped:
             rec["dropped_spans"] = self.dropped
+        from karpenter_tpu.obs import tracectx
+
+        ctx = tracectx.current()
+        if ctx is not None:
+            # the fleet trace id rides the waterfall too, so an exported
+            # span tree is self-identifying even away from its record
+            rec["trace_id"] = ctx.trace_id
         return rec
 
 

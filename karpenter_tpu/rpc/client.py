@@ -244,6 +244,12 @@ class RemoteScheduler:
         # silently-wrong delta base. Empty until the first echo, so old
         # servers (no trailer) never trigger the loss path.
         self._session_fpr = ""
+        # fleet trace identity (obs/tracectx, ISSUE 17): every solve round
+        # mints one compact context here at the origin; it rides the wire
+        # as ktpu-fleet-trace and stitches the round's journey across
+        # retargets and handoffs into a single queryable tree
+        self._trace_origin = f"client-{os.getpid()}"
+        self._tenant = os.environ.get("KTPU_TENANT", "")
         req = pb.ConfigureRequest(
             templates_json=encode_templates(templates),
             reserved_mode=reserved_mode,
@@ -325,6 +331,15 @@ class RemoteScheduler:
         SESSION_LOST and the ordinary one-shot re-snapshot runs there."""
         from karpenter_tpu.utils.metrics import FLEET_RETARGETS
 
+        from karpenter_tpu.obs import tracectx
+        from karpenter_tpu.obs.slo import SLO
+
+        # a retarget is an availability event (a replica was unreachable)
+        # and one more hop on the round's fleet trace
+        SLO.observe_availability(False, kind="retarget")
+        ctx = tracectx.current()
+        if ctx is not None:
+            ctx.hop += 1
         self._endpoint_idx = (self._endpoint_idx + 1) % len(self._endpoints)
         target = self._endpoints[self._endpoint_idx]
         try:
@@ -409,11 +424,18 @@ class RemoteScheduler:
         return stitcher.final, stitcher.tables()
 
     def _session_md(self) -> list:
-        if self._session_id is None:
-            return []
-        md = [("ktpu-session-id", self._session_id)]
-        if self._session_fpr:
-            md.append(("ktpu-session-fpr", self._session_fpr))
+        md = []
+        if self._session_id is not None:
+            md.append(("ktpu-session-id", self._session_id))
+            if self._session_fpr:
+                md.append(("ktpu-session-fpr", self._session_fpr))
+        if self._tenant:
+            md.append(("ktpu-tenant", self._tenant))
+        from karpenter_tpu.obs import tracectx
+
+        ctx = tracectx.current()
+        if ctx is not None:
+            md.append((tracectx.METADATA_KEY, ctx.to_wire()))
         return md
 
     def _absorb_trailing(self, trailing) -> None:
@@ -514,7 +536,21 @@ class RemoteScheduler:
         for rid, n in (reserved_in_use or {}).items():
             req.reserved_in_use[rid] = n
 
-    def solve(
+    def solve(self, pods: Sequence[Pod], *args, **kwargs) -> SchedulingResult:
+        """One scheduling round. Mints the round's fleet trace context —
+        the same trace_id survives transport retries, retargets, and a
+        session handoff (hop count records each crossing) — then runs the
+        hardened transport round under it."""
+        from karpenter_tpu.obs import tracectx
+
+        ctx = tracectx.mint(
+            origin=self._trace_origin,
+            tenant=self._tenant or (self._session_id or "")[:12],
+        )
+        with tracectx.activate(ctx):
+            return self._solve_round(pods, *args, **kwargs)
+
+    def _solve_round(
         self,
         pods: Sequence[Pod],
         existing_nodes=None,

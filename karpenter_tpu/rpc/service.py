@@ -118,6 +118,37 @@ class SolverService:
 
                 admission = AdmissionQueue(depth)
         self._admission = admission
+        # what gets stamped as "replica" on this service's ledger records:
+        # the fleet member's id when there is one (the name peers see on
+        # the bus), else the env/pid fallback in obs.ledger
+        self._replica_id = getattr(self._fleet, "replica_id", "") or ""
+
+    @contextlib.contextmanager
+    def _obs_scope(self, context):
+        """Observability scope for one RPC: adopt the client's fleet trace
+        context (ktpu-fleet-trace metadata, one hop further along) and
+        stamp this replica's id on every ledger record the solve makes."""
+        from karpenter_tpu.obs import ledger as obs_ledger
+        from karpenter_tpu.obs import tracectx
+
+        md = dict(context.invocation_metadata() or ())
+        ctx = tracectx.TraceContext.from_wire(md.get(tracectx.METADATA_KEY, ""))
+        if ctx is not None:
+            ctx = ctx.child()
+        with tracectx.activate(ctx), obs_ledger.replica_scope(self._replica_id):
+            yield
+
+    def _publish_round(self, ledger_seq0) -> None:
+        """Announce this RPC's local ledger records (adoption replays
+        included) as telemetry frames, so peers and fleetobs can stitch
+        the fleet timeline without sharing a spill directory."""
+        if self._fleet is None:
+            return
+        from karpenter_tpu.obs import ledger as obs_ledger
+
+        for rec in obs_ledger.LEDGER.since(ledger_seq0):
+            if rec.get("source") == "local":
+                self._fleet.publish_round(rec)
 
     def _session_for(self, context, sched):
         from karpenter_tpu.controllers.provisioning.scheduler import (
@@ -202,14 +233,20 @@ class SolverService:
         from karpenter_tpu.fleet import mobility
         from karpenter_tpu.utils.metrics import FLEET_HANDOFFS
 
+        from karpenter_tpu.obs.slo import SLO
+
         doc = self._fleet.capsule_for(sid, client_fpr)
         if doc is None:
             FLEET_HANDOFFS.inc(outcome="no_capsule")
+            SLO.observe_availability(False, kind="handoff")
             return None
         # the replay drives real device solves — serialize like any round
         with self._solve_lock:
             session, outcome = mobility.adopt(sched, doc, client_fpr)
         FLEET_HANDOFFS.inc(outcome=outcome)
+        # an adoption that lands is the availability story working — the
+        # client never saw the dead replica; anything else burns budget
+        SLO.observe_availability(outcome == "adopted", kind="handoff")
         return session
 
     @staticmethod
@@ -324,7 +361,9 @@ class SolverService:
         return pb.ConfigureResponse(config_version=version)
 
     def Solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
-        with self._server_span("rpc.server.Solve", context):
+        with self._server_span("rpc.server.Solve", context), self._obs_scope(
+            context
+        ):
             return self._solve(request, context)
 
     def SolveStream(self, request: pb.SolveRequest, context):
@@ -334,7 +373,9 @@ class SolverService:
         later chunks; the final frame carries the claim-level remainder.
         A reset frame invalidates prior chunks whenever a relaxation round
         (or a host-oracle fallback) restarts the tables."""
-        with self._server_span("rpc.server.SolveStream", context):
+        with self._server_span("rpc.server.SolveStream", context), self._obs_scope(
+            context
+        ):
             yield from self._solve_stream(request, context)
 
     def _checked_scheduler(self, request, context):
@@ -366,9 +407,11 @@ class SolverService:
         tenant = md.get("ktpu-tenant") or md.get("ktpu-session-id") or "anon"
         verdict = self._admission.acquire(tenant)
         if verdict == "shed":
+            from karpenter_tpu.obs.slo import SLO
             from karpenter_tpu.utils.metrics import FLEET_SHED
 
             FLEET_SHED.inc(reason="queue_full")
+            SLO.observe_availability(False, kind="shed")
             yield "shed"
             return
         try:
@@ -437,12 +480,14 @@ class SolverService:
         # the solve runs in a worker so the handler thread can yield chunk
         # frames while the decode is still producing later ones
         args, kwargs = self._solve_args(request, sched)
+        from karpenter_tpu.obs import ledger as obs_ledger
+
+        # before _session_for: an adoption's replay rounds record too,
+        # and the telemetry publish below should carry them to the fleet
+        ledger_seq0 = obs_ledger.LEDGER.seq()
         session = self._session_for(context, sched)
         sid = dict(context.invocation_metadata() or ()).get("ktpu-session-id")
         engine = session if session is not None else sched
-        from karpenter_tpu.obs import ledger as obs_ledger
-
-        ledger_seq0 = obs_ledger.LEDGER.seq()
 
         def run() -> None:
             try:
@@ -483,6 +528,7 @@ class SolverService:
             item = frames.get()
             if item is _DONE:
                 self._echo_session_fpr(context, session, ledger_seq0)
+                self._publish_round(ledger_seq0)
                 return
             if isinstance(item, BaseException):
                 raise item
@@ -551,12 +597,14 @@ class SolverService:
     def _solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
         sched = self._checked_scheduler(request, context)
         args, kwargs = self._solve_args(request, sched)
+        from karpenter_tpu.obs import ledger as obs_ledger
+
+        # before _session_for: an adoption's replay rounds record too,
+        # and the telemetry publish below should carry them to the fleet
+        ledger_seq0 = obs_ledger.LEDGER.seq()
         session = self._session_for(context, sched)
         sid = dict(context.invocation_metadata() or ()).get("ktpu-session-id")
         engine = session if session is not None else sched
-        from karpenter_tpu.obs import ledger as obs_ledger
-
-        ledger_seq0 = obs_ledger.LEDGER.seq()
         with self._admitted(context) as verdict:
             if verdict == "shed":
                 result = self._host_shed(sched, args, kwargs)
@@ -567,6 +615,7 @@ class SolverService:
             # replica dies before the next round
             self._fleet.publish_session(sid, session)
         self._echo_session_fpr(context, session, ledger_seq0)
+        self._publish_round(ledger_seq0)
         return self._result_pb(sched, result)
 
     @staticmethod

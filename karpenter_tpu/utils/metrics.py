@@ -633,3 +633,35 @@ FLEET_WARM_ANNOUNCED = REGISTRY.counter(
     " that key before it ever pays the compile itself",
     ("kernel",),
 )
+FLEET_BUS_ROTATIONS = REGISTRY.counter(
+    "ktpu_fleet_bus_rotations_total",
+    "FileBus topic-log compactions: the append log exceeded"
+    " KTPU_BUS_MAX_BYTES and its oldest complete lines were dropped,"
+    " with the surviving tail rewritten behind a base-offset header so"
+    " live subscribers' fetch offsets keep meaning the same bytes",
+    ("topic",),
+)
+SLO_EVENTS = REGISTRY.counter(
+    "ktpu_slo_events_total",
+    "Service-level objective events by objective (latency |"
+    " availability) and outcome (good | bad); latency events come from"
+    " round waterfall walls vs KTPU_SLO_LATENCY_S, availability events"
+    " from solve outcomes plus fleet shed / retarget / handoff /"
+    " quarantine signals on the guardrail bus",
+    ("objective", "outcome"),
+)
+SLO_BURN_RATE = REGISTRY.gauge(
+    "ktpu_slo_burn_rate",
+    "Multi-window SLO burn rate per objective: the window's bad-event"
+    " fraction divided by the error budget (1 - KTPU_SLO_TARGET); 1.0"
+    " burns the budget exactly at the objective's edge, >1 burns it"
+    " faster — the classic page-on-both-windows signal",
+    ("objective", "window"),
+)
+SLO_BUDGET_REMAINING = REGISTRY.gauge(
+    "ktpu_slo_error_budget_remaining",
+    "Fraction of the long-window error budget still unspent per"
+    " objective (1.0 = no bad events, 0.0 = budget exhausted; clamped"
+    " at zero once overspent)",
+    ("objective",),
+)

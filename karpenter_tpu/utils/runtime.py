@@ -227,6 +227,40 @@ class _Handler(BaseHTTPRequestHandler):
                 ),
                 ctype="application/json",
             )
+        elif path == "/debug/fleet":
+            if not self.config.enable_profiling:
+                self._send(404, "profiling disabled")
+                return
+            # the fleet observatory (obs/fleetobs.py): the cross-replica
+            # timeline rollup — per-replica round counts, stitched trace
+            # count, duplicate-round check, SLO burn rates
+            from karpenter_tpu.obs import fleetobs
+
+            self._send(
+                200, json.dumps(fleetobs.debug_fleet()), ctype="application/json"
+            )
+        elif path.startswith("/debug/trace/"):
+            if not self.config.enable_profiling:
+                self._send(404, "profiling disabled")
+                return
+            # one fleet trace id's whole journey, stitched across every
+            # replica the observatory can see; ?format=perfetto exports
+            # the same rounds as a Chrome-trace document
+            from urllib.parse import parse_qs, urlparse
+
+            from karpenter_tpu.obs import fleetobs, traceexport
+
+            trace_id = path[len("/debug/trace/"):]
+            stitched = fleetobs.debug_trace(trace_id)
+            if stitched is None:
+                self._send(404, f"unknown trace id {trace_id!r}")
+                return
+            qs = parse_qs(urlparse(self.path).query)
+            if qs.get("format", [""])[0] == "perfetto":
+                body = traceexport.chrome_trace(stitched["rounds"])
+            else:
+                body = stitched
+            self._send(200, json.dumps(body), ctype="application/json")
         elif path == "/debug/quarantine":
             if not self.config.enable_profiling:
                 self._send(404, "profiling disabled")

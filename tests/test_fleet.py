@@ -33,6 +33,8 @@ from karpenter_tpu.faultinject import active_plan
 from karpenter_tpu.fleet import AdmissionQueue, FileBus, FleetMember, InProcessHub
 from karpenter_tpu.guard import audit as guard_audit
 from karpenter_tpu.guard.quarantine import QUARANTINE, Quarantine
+from karpenter_tpu.obs import fleetobs
+from karpenter_tpu.obs import ledger as obs_ledger
 from karpenter_tpu.rpc import RemoteScheduler, serve
 from karpenter_tpu.rpc import client as rpc_client
 from karpenter_tpu.rpc.service import SolverService
@@ -244,6 +246,7 @@ class TestFleetHandoff:
         inv0 = RESIDENT_ROUNDS.get(mode="invalidated")
         h0 = _handoff_counts()
         rt0 = FLEET_RETARGETS.get(reason="transport")
+        seq0 = obs_ledger.LEDGER.seq()
         try:
             remote = RemoteScheduler(
                 f"{addr_a},{addr_b}", make_templates(), max_claims=128
@@ -268,6 +271,32 @@ class TestFleetHandoff:
             # no cold re-snapshot round
             assert RESIDENT_ROUNDS.get(mode="invalidated") == inv0
             assert FLEET_RETARGETS.get(reason="transport") >= rt0 + 1
+            # the fleet observatory stitches the kill into ONE coherent
+            # story (ISSUE 17): every round sig appears exactly once
+            # fleet-wide (the adoption replay is marked, not re-counted),
+            # and the handed-off round's trace id shows up on BOTH
+            # replicas — the origin round on rep-a, the replay on rep-b
+            recs = [
+                r for r in fleetobs.fleet_records(dirs=[])
+                if (r.get("seq") or 0) > seq0
+            ]
+            counts = fleetobs.round_counts(recs)
+            dup = {s: n for s, n in counts.items() if n != 1}
+            assert not dup, f"rounds stitched more than once: {dup}"
+            replays = [r for r in recs if r.get("replay")]
+            assert replays, "adoption recorded no replay-marked rounds"
+            assert all(r.get("replica") == "rep-b" for r in replays)
+            handoff_tid = (replays[0].get("trace") or {}).get("id")
+            assert handoff_tid
+            stitched = fleetobs.stitch(handoff_tid, recs)
+            assert stitched is not None and stitched["consistent"]
+            assert {"rep-a", "rep-b"} <= set(stitched["replicas"])
+            # the failed-over round crossed a retarget + a server hop, so
+            # its hop count exceeds a clean round's
+            assert stitched["max_hop"] >= 2
+            # /debug/trace/<id> is the same stitch; its Perfetto form is a
+            # valid document (the schema round-trip lives in test_fleetobs)
+            assert fleetobs.debug_trace(handoff_tid) is not None
             # a trip on A's breaker reaches B's via the bus (pumped at the
             # top of the next solve RPC) and routes that round sequential
             qa.trip("resident", reason="shadow-audit divergence", ttl_s=120.0)

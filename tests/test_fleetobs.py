@@ -1,0 +1,361 @@
+"""Fleet observatory (obs/fleetobs, tracectx, slo, traceexport; ISSUE 17).
+
+What must hold, layer by layer:
+
+- **Trace context**: the four-field context survives its wire form
+  exactly, ``child()`` advances only the hop, and ``KTPU_FLEET_TRACE=0``
+  turns minting into a no-op everywhere downstream.
+- **FileBus compaction**: a size-capped topic log drops its oldest
+  complete lines behind a base-offset header; a live subscriber's held
+  offset keeps meaning the same bytes across rotations, a subscriber
+  parked before the base resumes at the oldest surviving line, and each
+  rotation is counted under ``ktpu_fleet_bus_rotations_total``.
+- **SLO tracker**: burn rate = window bad-fraction / (1 - target), per
+  window, on an injectable clock; events age out of the short window
+  while the long one still remembers them; the gauges export.
+- **Trace export**: the emitted Chrome-trace document survives a JSON
+  round-trip, ``validate()`` passes it, and ``validate()`` CATCHES a
+  round slice whose segment table no longer sums to its wall — the
+  waterfall exactness invariant re-checked on the export alone.
+- **Stitching**: ``round_counts`` counts original local work only
+  (remote echoes and adoption replays are views, not rounds), and
+  snapshot solves get the same deduped problem capsules resident rounds
+  do.
+"""
+
+import json
+
+import pytest
+
+from karpenter_tpu.controllers.provisioning import TPUScheduler
+from karpenter_tpu.fleet.bus import FileBus
+from karpenter_tpu.obs import fleetobs, tracectx, traceexport
+from karpenter_tpu.obs import ledger as obs_ledger
+from karpenter_tpu.obs.slo import SLOTracker
+from karpenter_tpu.utils.metrics import (
+    FLEET_BUS_ROTATIONS,
+    SLO_BURN_RATE,
+)
+
+from test_resident import kind_pods, make_templates
+
+
+class TestTraceContext:
+    def test_wire_round_trip_and_child_hop(self):
+        ctx = tracectx.mint(origin="rep-a", tenant="team-blue")
+        assert ctx is not None and len(ctx.trace_id) == 16 and ctx.hop == 0
+        back = tracectx.TraceContext.from_wire(ctx.to_wire())
+        assert back == ctx
+        kid = ctx.child()
+        assert (kid.trace_id, kid.origin, kid.tenant) == (
+            ctx.trace_id, ctx.origin, ctx.tenant,
+        )
+        assert kid.hop == 1
+        assert tracectx.TraceContext.from_dict(kid.as_dict()) == kid
+
+    def test_malformed_wire_forms_are_none(self):
+        for raw in ("", "a|b", "|origin|tenant|0", "a|b|c|d|e", None):
+            assert tracectx.TraceContext.from_wire(raw) is None
+        # a junk hop degrades to 0 rather than raising mid-RPC
+        assert tracectx.TraceContext.from_wire("id|o|t|junk").hop == 0
+
+    def test_activation_scopes_and_disable_knob(self, monkeypatch):
+        assert tracectx.current() is None
+        ctx = tracectx.mint(origin="rep-a")
+        with tracectx.activate(ctx):
+            assert tracectx.current() is ctx
+            assert tracectx.current_dict() == ctx.as_dict()
+        assert tracectx.current() is None
+        monkeypatch.setenv("KTPU_FLEET_TRACE", "0")
+        assert tracectx.mint(origin="rep-a") is None
+        with tracectx.activate(None) as got:
+            assert got is None and tracectx.current() is None
+
+
+class TestFileBusCompaction:
+    def test_capped_log_compacts_and_live_readers_keep_up(self, tmp_path):
+        """Publish past the cap: the oldest lines go, the rotation is
+        counted, and a subscriber that pumps between publishes (the
+        FleetMember cadence — once per solve round) sees every message
+        exactly once, in order, because its offset is a LOGICAL stream
+        position that survives the rewrites."""
+        bus = FileBus(str(tmp_path), max_bytes=600)
+        rot0 = FLEET_BUS_ROTATIONS.get(topic="session")
+        got, offset = [], 0
+        for n in range(20):
+            bus.publish("session", {"n": n, "pad": "x" * 60})
+            msgs, offset = bus.fetch("session", offset)
+            got.extend(m["n"] for m in msgs)
+        assert got == list(range(20))
+        assert FLEET_BUS_ROTATIONS.get(topic="session") > rot0
+        # a reader parked before the base lost the compacted prefix but
+        # resumes cleanly at the oldest SURVIVING line — never mid-line,
+        # never a duplicate
+        msgs, _ = bus.fetch("session", 0)
+        ns = [m["n"] for m in msgs]
+        assert ns == sorted(set(ns)) and ns[-1] == 19 and ns[0] > 0
+        # the surviving file is actually bounded near the cap
+        assert (tmp_path / "session.jsonl").stat().st_size <= 600 + 100
+
+    def test_header_is_invisible_to_message_consumers(self, tmp_path):
+        bus = FileBus(str(tmp_path), max_bytes=300)
+        for n in range(30):
+            bus.publish("audit", {"n": n, "pad": "y" * 40})
+        raw = (tmp_path / "audit.jsonl").read_bytes()
+        assert raw.startswith(b"#"), "compaction must leave a base header"
+        msgs, _ = bus.fetch("audit", 0)
+        assert msgs and all(isinstance(m["n"], int) for m in msgs)
+
+    def test_env_knob_and_unbounded_default(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KTPU_BUS_MAX_BYTES", "512")
+        assert FileBus(str(tmp_path / "a"))._max_bytes == 512
+        monkeypatch.delenv("KTPU_BUS_MAX_BYTES")
+        big = FileBus(str(tmp_path / "b"))
+        assert big._max_bytes == 0
+        for n in range(50):
+            big.publish("compile", {"n": n, "pad": "z" * 50})
+        msgs, _ = big.fetch("compile", 0)
+        assert [m["n"] for m in msgs] == list(range(50))
+
+
+class TestSLOTracker:
+    def test_burn_rate_is_bad_fraction_over_budget(self):
+        t = [0.0]
+        slo = SLOTracker(target=0.9, latency_s=1.0, clock=lambda: t[0])
+        for i in range(10):
+            slo.observe_availability(i != 0)  # 1 bad in 10, 10% budget
+        rates = slo.burn_rates()
+        cell = rates["availability"]["5m"]
+        assert (cell["total"], cell["bad"]) == (10, 1)
+        assert cell["burn_rate"] == pytest.approx(1.0)
+        assert rates["availability"]["1h"]["burn_rate"] == pytest.approx(1.0)
+        # 2x the budget -> burn 2.0, and the long-window budget is gone
+        slo.observe_availability(False)
+        assert slo.burn_rates()["availability"]["5m"]["burn_rate"] > 1.5
+        assert slo.budget_remaining()["availability"] == 0.0
+
+    def test_short_window_forgets_while_long_remembers(self):
+        t = [0.0]
+        slo = SLOTracker(target=0.99, latency_s=1.0, clock=lambda: t[0])
+        slo.observe_latency(5.0)  # bad
+        t[0] = 200.0
+        for _ in range(3):
+            slo.observe_latency(0.1)
+        t[0] = 400.0  # the bad event is now outside 5m but inside 1h
+        rates = slo.burn_rates()
+        assert rates["latency"]["5m"]["bad"] == 0
+        assert rates["latency"]["1h"]["bad"] == 1
+
+    def test_observe_record_folds_both_objectives(self):
+        t = [0.0]
+        slo = SLOTracker(target=0.99, latency_s=0.5, clock=lambda: t[0])
+        slo.observe_record({"wall_s": 0.1, "outcome": "ok"})
+        slo.observe_record({"wall_s": 2.0, "outcome": "ok"})  # slow but up
+        slo.observe_record({"wall_s": 0.1, "outcome": "error"})
+        slo.observe_record(
+            {"wall_s": 0.1, "outcome": "ok", "mode": "quarantined"}
+        )
+        rates = slo.burn_rates()
+        assert rates["latency"]["5m"]["bad"] == 1
+        assert rates["availability"]["5m"]["bad"] == 2
+        # snapshot re-exports the gauges every time it is asked
+        slo.snapshot()
+        assert SLO_BURN_RATE.get(objective="latency", window="5m") == (
+            rates["latency"]["5m"]["burn_rate"]
+        )
+
+    def test_reconfigure_reads_env_and_reset_clears(self, monkeypatch):
+        monkeypatch.setenv("KTPU_SLO_TARGET", "0.95")
+        monkeypatch.setenv("KTPU_SLO_LATENCY_S", "0.25")
+        slo = SLOTracker(clock=lambda: 0.0)
+        assert (slo.target, slo.latency_s) == (0.95, 0.25)
+        monkeypatch.setenv("KTPU_SLO_TARGET", "2.0")  # clamped to sane
+        slo.reconfigure()
+        assert slo.target <= 0.9999
+        slo.observe_availability(False)
+        slo.reset()
+        assert slo.burn_rates()["availability"]["5m"]["total"] == 0
+
+
+def _rec(replica, seq, t, sig, trace, *, wall=0.02, replay=False,
+         source="local", waterfall=None):
+    rec = {
+        "replica": replica, "seq": seq, "t": t, "sig": sig,
+        "trace": trace, "wall_s": wall, "mode": "delta", "reason": "arrivals",
+        "outcome": "ok", "pods": 8, "source": source,
+    }
+    if replay:
+        rec["replay"] = True
+    if waterfall is not None:
+        rec["waterfall"] = waterfall
+    return rec
+
+
+def _handoff_records():
+    """Three rounds on rep-a, the third handed off: its replay lands on
+    rep-b under the SAME trace id one hop further along."""
+    t1 = {"id": "aaaa000011112222", "origin": "client-1", "tenant": "", "hop": 1}
+    t2 = {"id": "bbbb000011112222", "origin": "client-1", "tenant": "", "hop": 1}
+    wf = {
+        "wall_s": 0.02,
+        "segments": {"encode": 0.005, "device": 0.01, "other": 0.005},
+        "spans": {
+            "name": ["encode", "device"],
+            "start_s": [0.0, 0.005],
+            "dur_s": [0.005, 0.01],
+            "depth": [0, 0],
+        },
+    }
+    return [
+        _rec("rep-a", 1, 100.0, "sig-1", t1, waterfall=wf),
+        _rec("rep-a", 2, 100.1, "sig-2", t1),
+        _rec("rep-a", 3, 100.2, "sig-3", t2),
+        _rec("rep-b", 4, 100.5, "sig-3", dict(t2, hop=3), replay=True),
+        _rec("rep-b", 5, 100.6, "sig-4", dict(t2, hop=3)),
+    ]
+
+
+class TestStitching:
+    def test_round_counts_ignore_replays_and_remote_echoes(self):
+        recs = _handoff_records()
+        recs.append(_rec("client", 9, 100.7, "sig-4", None, source="remote"))
+        counts = fleetobs.round_counts(recs)
+        assert counts == {"sig-1": 1, "sig-2": 1, "sig-3": 1, "sig-4": 1}
+        # a genuine duplicate (the same original round recorded twice)
+        # IS flagged — that is the invariant's whole point
+        recs.append(_rec("rep-b", 10, 100.8, "sig-4", None))
+        assert fleetobs.round_counts(recs)["sig-4"] == 2
+
+    def test_stitch_spans_replicas_and_reports_consistency(self):
+        recs = _handoff_records()
+        stitched = fleetobs.stitch("bbbb000011112222", recs)
+        assert stitched["replicas"] == ["rep-a", "rep-b"]
+        assert stitched["max_hop"] == 3 and stitched["replays"] == 1
+        assert stitched["consistent"]
+        assert len(stitched["rounds"]) == 3
+        assert fleetobs.stitch("nope", recs) is None
+        # the OTHER trace never left rep-a
+        assert fleetobs.stitch("aaaa000011112222", recs)["replicas"] == ["rep-a"]
+
+    def test_fleet_summary_rolls_up_per_replica(self):
+        recs = _handoff_records()
+        summary = fleetobs.fleet_summary(recs)
+        assert summary["records"] == 5 and summary["traces"] == 2
+        assert summary["replicas"]["rep-a"]["rounds"] == 3
+        assert summary["replicas"]["rep-b"]["replays"] == 1
+        assert summary["duplicate_rounds"] == {}
+        assert "burn_rates" in summary["slo"]
+
+    def test_spilled_dirs_merge_and_dedup(self, tmp_path):
+        """A peer's spilled JSONL joins the timeline; a record seen both
+        spilled and in-ring collapses to one entry by (replica, seq, t)."""
+        recs = _handoff_records()
+        with open(tmp_path / "rounds.jsonl", "w") as fh:
+            for r in recs + recs[:2]:  # spill carries duplicates too
+                fh.write(json.dumps(r) + "\n")
+        merged = fleetobs.fleet_records(dirs=[str(tmp_path)])
+        keys = [(r.get("replica"), r.get("seq")) for r in merged]
+        assert len(keys) == len(set(keys))
+        assert ("rep-b", 4) in keys
+
+    def test_telemetry_frame_keeps_wire_keys_only(self):
+        rec = _handoff_records()[0]
+        rec["stages"] = {"scan": 0.001}
+        rec["transcript"] = [["u1", "u2"]]
+        frame = obs_ledger.telemetry_frame(rec)
+        assert frame["sig"] == "sig-1" and frame["seq"] == 1
+        assert frame["trace"]["id"] == "aaaa000011112222"
+        assert "transcript" not in frame and "stages" not in frame
+        assert obs_ledger.telemetry_frame("junk") is None
+
+
+class TestTraceExport:
+    def test_export_round_trips_and_validates(self):
+        doc = traceexport.chrome_trace(_handoff_records())
+        doc = json.loads(json.dumps(doc))  # the schema round-trip
+        assert traceexport.validate(doc) == []
+        events = doc["traceEvents"]
+        procs = [e for e in events if e.get("name") == "process_name"]
+        assert {p["args"]["name"] for p in procs} == {
+            "replica rep-a", "replica rep-b",
+        }
+        rounds = [e for e in events if e.get("cat") == "round"]
+        assert len(rounds) == 5
+        assert any(r["args"].get("replay") for r in rounds)
+        spans = [e for e in events if e.get("cat") == "span"]
+        assert {s["name"] for s in spans} == {"encode", "device"}
+        # the handoff drew exactly one flow arrow, start and finish paired
+        flows = [e for e in events if e.get("cat") == "flow"]
+        assert sorted(e["ph"] for e in flows) == ["f", "s"]
+        assert flows[0]["id"] == flows[1]["id"]
+
+    def test_validate_catches_a_broken_waterfall_invariant(self):
+        doc = traceexport.chrome_trace(_handoff_records())
+        for ev in doc["traceEvents"]:
+            if (ev.get("args") or {}).get("segments"):
+                ev["args"]["segments"]["device"] += 0.5  # sum != wall now
+        problems = traceexport.validate(doc)
+        assert problems and "segments sum" in problems[0]
+
+    def test_validate_catches_unpaired_flows_and_bad_slices(self):
+        doc = traceexport.chrome_trace(_handoff_records())
+        doc["traceEvents"] = [
+            e for e in doc["traceEvents"] if e.get("ph") != "f"
+        ]
+        assert any("unpaired" in p for p in traceexport.validate(doc))
+        assert traceexport.validate({"traceEvents": [{"no": "phase"}]})
+        assert traceexport.validate({"traceEvents": None})
+
+    def test_export_trace_stitches_one_id(self):
+        recs = _handoff_records()
+        doc = traceexport.export_trace("bbbb000011112222", recs)
+        rounds = [
+            e for e in doc["traceEvents"] if e.get("cat") == "round"
+        ]
+        assert len(rounds) == 3
+        assert traceexport.export_trace("nope", recs) is None
+
+
+class TestLedgerTraceStamping:
+    def test_records_mint_a_local_trace_and_replica_stamp(self):
+        seq0 = obs_ledger.LEDGER.seq()
+        sched = TPUScheduler(make_templates(), max_claims=128)
+        sched.solve(list(kind_pods("a", 6)))
+        rec = obs_ledger.LEDGER.since(seq0)[-1]
+        assert rec["replica"] == obs_ledger.current_replica()
+        assert rec["trace"]["id"] and rec["trace"]["hop"] == 0
+        assert rec["trace"]["origin"] == rec["replica"]
+
+    def test_replica_scope_wins_over_process_default(self):
+        with obs_ledger.replica_scope("rep-x"):
+            assert obs_ledger.current_replica() == "rep-x"
+            rec = obs_ledger.LEDGER.record({"mode": "full", "outcome": "ok"})
+        assert rec["replica"] == "rep-x"
+        assert obs_ledger.current_replica().startswith("proc-")
+
+    def test_snapshot_solve_writes_a_deduped_plain_capsule(
+        self, monkeypatch, tmp_path
+    ):
+        """The satellite: non-resident solves get the same problem-capsule
+        treatment resident rounds do — spill-gated, content-addressed,
+        written once for identical problems."""
+        monkeypatch.setenv("KTPU_LEDGER_DIR", str(tmp_path))
+        sched = TPUScheduler(make_templates(), max_claims=128)
+        pods = kind_pods("a", 6)
+        seq0 = obs_ledger.LEDGER.seq()
+        sched.solve(list(pods))
+        rec = obs_ledger.LEDGER.since(seq0)[-1]
+        assert rec["capsule"] and rec["transcript"] == [
+            [str(p.uid) for p in pods]
+        ]
+        capsule_path = tmp_path / rec["capsule"]
+        assert capsule_path.exists()
+        doc = json.loads(capsule_path.read_text())
+        assert doc["path"] == "snapshot"
+        assert len(doc["rounds"]) == 1 and len(doc["pods"]) == 6
+        stamp = capsule_path.stat().st_mtime_ns
+        # the identical problem again: the capsule is NOT rewritten
+        sched.solve(list(pods))
+        rec2 = obs_ledger.LEDGER.since(seq0)[-1]
+        assert rec2["capsule"] == rec["capsule"]
+        assert capsule_path.stat().st_mtime_ns == stamp

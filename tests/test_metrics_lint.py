@@ -290,6 +290,34 @@ def test_fleet_families_are_registered():
         assert word in fams["ktpu_fleet_bus_messages_total"].help, word
 
 
+def test_fleet_observatory_families_are_registered():
+    """ISSUE-17 families: the SLO burn-rate instruments and the FileBus
+    compaction counter. The burn-rate gauge's help must explain the
+    burn-rate convention (1.0 = burning the budget exactly at the
+    objective's edge) and name the error-budget knob; the events
+    counter's help must enumerate both objectives."""
+    from karpenter_tpu.utils.metrics import Counter, Gauge
+
+    fams = {f.name: f for f in _families()}
+    expected = {
+        "ktpu_fleet_bus_rotations_total": (Counter, ("topic",)),
+        "ktpu_slo_events_total": (Counter, ("objective", "outcome")),
+        "ktpu_slo_burn_rate": (Gauge, ("objective", "window")),
+        "ktpu_slo_error_budget_remaining": (Gauge, ("objective",)),
+    }
+    for name, (cls, labels) in expected.items():
+        fam = fams.get(name)
+        assert fam is not None, f"{name} not registered"
+        assert isinstance(fam, cls), (name, type(fam).__name__)
+        assert fam.label_names == labels, (name, fam.label_names)
+        assert fam.help.strip()
+    assert "KTPU_SLO_TARGET" in fams["ktpu_slo_burn_rate"].help
+    assert "1.0" in fams["ktpu_slo_burn_rate"].help
+    for objective in ("latency", "availability"):
+        assert objective in fams["ktpu_slo_events_total"].help, objective
+    assert "KTPU_BUS_MAX_BYTES" in fams["ktpu_fleet_bus_rotations_total"].help
+
+
 def test_counters_end_in_total_and_histograms_in_seconds_or_pods():
     """Unit-suffix discipline for NEW families (grandfathered names keep
     their reference spellings verbatim)."""
