@@ -821,6 +821,83 @@ def run_whatif_stage(n_candidates, seq_sample=8):
     }
 
 
+def run_objective_stage(n_pods=192, n_types=48) -> dict:
+    """Placement objectives (ISSUE 19): ONE mixed-generation multi-pool
+    problem (four family-restricted pools, priciest family holding the
+    lexical weight order) solved under every registered policy, reporting
+    each policy's fleet ``total_price_per_hour`` and solve wall. The
+    per-policy ``solve_s`` leaves ride the normal ``--baseline`` ratchet
+    (obs/bench_diff diffs every ``_s`` leaf); the PRICE gate is enforced
+    right here: ``cost_min`` must never produce a pricier fleet than
+    ``lexical`` on this stage — that is the objective's whole claim."""
+    import os
+
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.controllers.provisioning import (
+        TPUScheduler,
+        build_templates,
+    )
+    from karpenter_tpu.models.nodepool import NodePool
+    from karpenter_tpu.objectives import POLICIES
+    from karpenter_tpu.objectives import oracle as obj_oracle
+
+    def pool_templates():
+        catalog = instance_types(n_types)
+        pools = []
+        # priciest family first: lexical's weight order picks the 1.2x
+        # "m" nodes, so cost_min has a real gap to close (e = 0.6x)
+        for fam in ("m", "s", "c", "e"):
+            p = NodePool()
+            p.metadata.name = f"{fam}-pool"
+            p.spec.template.spec.requirements = [
+                {
+                    "key": "karpenter-tpu.sh/instance-family",
+                    "operator": "In",
+                    "values": [fam],
+                },
+            ]
+            pools.append((p, catalog))
+        return build_templates(pools)
+
+    out: dict = {"pods": n_pods, "types": n_types, "policies": {}}
+    prev = os.environ.get("KTPU_OBJECTIVE")
+    try:
+        for pol in POLICIES:
+            os.environ["KTPU_OBJECTIVE"] = pol
+            sched = TPUScheduler(
+                pool_templates(), pod_pad=n_pods, max_claims=256
+            )
+            t0 = time.perf_counter()
+            result = sched.solve(mixed_pods(n_pods))
+            wall = time.perf_counter() - t0
+            assert not result.unschedulable, (
+                f"{pol}: {len(result.unschedulable)} unschedulable"
+            )
+            out["policies"][pol] = {
+                "solve_s": round(wall, 4),
+                "nodes": len(result.claims),
+                "total_price_per_hour": round(
+                    obj_oracle.total_price_per_hour(result), 5
+                ),
+            }
+    finally:
+        if prev is None:
+            os.environ.pop("KTPU_OBJECTIVE", None)
+        else:
+            os.environ["KTPU_OBJECTIVE"] = prev
+    lex = out["policies"]["lexical"]["total_price_per_hour"]
+    cmin = out["policies"]["cost_min"]["total_price_per_hour"]
+    out["cost_gate"] = {
+        "lexical_price_per_hour": lex,
+        "cost_min_price_per_hour": cmin,
+        "ok": cmin <= lex + 1e-6,
+    }
+    assert out["cost_gate"]["ok"], (
+        f"cost_min produced a PRICIER fleet than lexical: {cmin} > {lex}"
+    )
+    return out
+
+
 def run_gang_storm_stage(on_tpu: bool) -> dict:
     """Gang-storm (ISSUE 6): a training-job burst — all-or-nothing gangs
     mixed with singleton pods, plus one deliberately unplaceable "whale"
@@ -1741,6 +1818,14 @@ def main() -> None:
         )
     except Exception as e:  # noqa: BLE001
         detail["steady_4096x64"] = f"failed: {repr(e)[:300]}"
+
+    # stage 3.9: placement objectives — per-policy fleet price on one
+    # mixed-generation multi-pool problem, with the in-stage hard gate
+    # cost_min <= lexical (ISSUE 19)
+    try:
+        detail["objectives_192x48"] = run_objective_stage()
+    except Exception as e:  # noqa: BLE001
+        detail["objectives_192x48"] = f"failed: {repr(e)[:300]}"
 
     # stage 4: disruption what-ifs — batched vs sequential (§2.6)
     try:

@@ -41,6 +41,11 @@ class Candidate:
     nodepool: NodePool
     instance_type: Optional[InstanceType]
     price: float
+    # False when the catalog had no offering price for the node's
+    # (zone, capacity-type): price is 0.0 for the legacy ratio math, but
+    # cost-ranked objective ordering EXCLUDES the candidate — a missing
+    # price must never read as "cheapest" (ktpu_pricing_missing_total)
+    price_known: bool = True
     reschedulable_pods: list[Pod] = field(default_factory=list)
     disruption_cost: float = 1.0
     # gang key when this node is one host of a multi-host slice: the
@@ -128,7 +133,14 @@ def build_candidates(
         zone = (sn.node or sn.node_claim).metadata.labels.get(l.LABEL_TOPOLOGY_ZONE, "")
         ct = (sn.node or sn.node_claim).metadata.labels.get(l.CAPACITY_TYPE_LABEL_KEY, "")
         price = it.offering_price(zone, ct) if it else None
+        price_known = price is not None
         if price is None:
+            # keep the legacy 0.0 for the savings-ratio math, but COUNT
+            # the gap and mark the candidate so cost-ranked ordering can
+            # exclude it (a silent 0.0 made missing prices the cheapest)
+            from karpenter_tpu.utils.metrics import PRICING_MISSING
+
+            PRICING_MISSING.inc()
             price = 0.0
         reschedulable = [p for p in sn.pods.values() if not p.is_terminal()]
         cost = 1.0 + sum(_pod_eviction_cost(p) for p in reschedulable)
@@ -138,6 +150,7 @@ def build_candidates(
                 nodepool=pool,
                 instance_type=it,
                 price=price,
+                price_known=price_known,
                 reschedulable_pods=reschedulable,
                 disruption_cost=cost,
                 gang_key=gang_key_of_node(sn),

@@ -106,6 +106,60 @@ def _unit_savings_ratio(unit: list[Candidate]) -> float:
     return price / cost if cost else price
 
 
+def _unit_zone(unit: list[Candidate]) -> str:
+    sn = unit[0].state_node
+    obj = sn.node or sn.node_claim
+    if obj is None:
+        return ""
+    return obj.metadata.labels.get(l.LABEL_TOPOLOGY_ZONE, "")
+
+
+def _order_units(units: list[list[Candidate]]) -> list[list[Candidate]]:
+    """Consolidation's half of the placement objective: order atomic
+    units by the SAME scores provisioning optimizes (objectives/), so
+    both controllers pull the fleet toward one consistent objective.
+
+    ``lexical`` reproduces the legacy savings-ratio sort bit-for-bit.
+    ``cost_min`` walks the priciest units first (each successful
+    consolidation frees the most dollars), EXCLUDING unknown-price units
+    from the cost ranking — they trail in legacy order instead of
+    masquerading as free (the candidates.py price_known fix). The other
+    policies mirror their provisioning scores: ``frag_aware`` empties
+    the sparsest nodes first, ``topo_spread`` drains the most crowded
+    zone first, ``gang_slice`` prefers single hosts over whole slices."""
+    from karpenter_tpu import objectives
+
+    policy = objectives.active_policy()
+    if policy == "lexical":
+        return sorted(units, key=_unit_savings_ratio)
+    if policy == "cost_min":
+        known = [u for u in units if all(c.price_known for c in u)]
+        unknown = [u for u in units if not all(c.price_known for c in u)]
+        known.sort(key=lambda u: (-sum(c.price for c in u), _unit_savings_ratio(u)))
+        unknown.sort(key=_unit_savings_ratio)
+        return known + unknown
+    if policy == "frag_aware":
+        return sorted(
+            units,
+            key=lambda u: (
+                sum(len(c.reschedulable_pods) for c in u),
+                _unit_savings_ratio(u),
+            ),
+        )
+    if policy == "topo_spread":
+        crowd: dict[str, int] = {}
+        for u in units:
+            z = _unit_zone(u)
+            crowd[z] = crowd.get(z, 0) + len(u)
+        return sorted(
+            units,
+            key=lambda u: (-crowd.get(_unit_zone(u), 0), _unit_savings_ratio(u)),
+        )
+    if policy == "gang_slice":
+        return sorted(units, key=lambda u: (len(u), _unit_savings_ratio(u)))
+    return sorted(units, key=_unit_savings_ratio)
+
+
 def _consolidatable(c: Candidate, clock, policy_filter: tuple[str, ...]) -> bool:
     """consolidateAfter gating: policy matches and the idle window elapsed
     since the last pod event (nodeclaim.disruption Consolidatable)."""
@@ -388,12 +442,11 @@ class SingleNodeConsolidation(_ConsolidationBase):
     def compute(self, candidates: list[Candidate], budgets: dict[str, int]) -> Command:
         deadline = self.clock.now() + SINGLE_NODE_CONSOLIDATION_TIMEOUT_SECONDS
         # the walk is over atomic units: ordinary nodes one at a time,
-        # gang slices as whole claim groups (all-or-none eviction)
+        # gang slices as whole claim groups (all-or-none eviction);
+        # unit order comes from the active placement objective
         ordered = [
             c
-            for u in sorted(
-                atomic_units(self.eligible(candidates)), key=_unit_savings_ratio
-            )
+            for u in _order_units(atomic_units(self.eligible(candidates)))
             for c in u
         ]
         units = atomic_units(_within_budget(ordered, budgets))
@@ -431,12 +484,11 @@ class MultiNodeConsolidation(_ConsolidationBase):
         deadline = self.clock.now() + MULTI_NODE_CONSOLIDATION_TIMEOUT_SECONDS
         # prefixes are over atomic units so a slice's hosts always enter a
         # prefix together; the batch cap counts NODES, aligned down to a
-        # unit boundary
+        # unit boundary; unit order comes from the active placement
+        # objective (same scores provisioning optimizes)
         ordered = [
             c
-            for u in sorted(
-                atomic_units(self.eligible(candidates)), key=_unit_savings_ratio
-            )
+            for u in _order_units(atomic_units(self.eligible(candidates)))
             for c in u
         ]
         units: list[list[Candidate]] = []

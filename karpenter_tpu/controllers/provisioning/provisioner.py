@@ -653,6 +653,16 @@ class Provisioner:
                     )
         return out
 
+    @staticmethod
+    def _pool_objective(pools) -> Optional[str]:
+        """The highest-weight pool's placement_objective (deterministic:
+        weight desc, name asc — the template try-order's own tie-break);
+        None when no pool sets one, deferring to KTPU_OBJECTIVE."""
+        for p in sorted(pools, key=lambda p: (-p.spec.weight, p.name)):
+            if p.spec.placement_objective:
+                return p.spec.placement_objective
+        return None
+
     def _build_scheduler(self) -> Optional[TPUScheduler]:
         pools = self._ready_pools()
         if not pools:
@@ -697,7 +707,9 @@ class Provisioner:
                 (ds.name, pod_content_sig(ds.as_pod()))
                 for ds in self.store.list(self.store.DAEMONSETS)
             )
-        ) + (("blackout_generation", self.unavailable.generation),)
+        ) + (("blackout_generation", self.unavailable.generation),) + (
+            ("placement_objective", self._pool_objective(pools)),
+        )
         if self._scheduler_cache is not None and self._scheduler_cache[0] == sig:
             return self._scheduler_cache[1]
         templates = self._apply_daemon_overhead(templates)
@@ -721,6 +733,7 @@ class Provisioner:
                 reserved_capacity_enabled=self.reserved_capacity_enabled,
                 min_values_policy=self.min_values_policy,
                 mesh=mesh,
+                objective=self._pool_objective(pools),
             )
             from karpenter_tpu.controllers.provisioning.scheduler import (
                 resident_enabled,

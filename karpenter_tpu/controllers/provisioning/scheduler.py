@@ -561,6 +561,7 @@ class TPUScheduler:
         reserved_capacity_enabled: bool = True,
         min_values_policy: str = "Strict",
         mesh=None,
+        objective: Optional[str] = None,
     ):
         from karpenter_tpu.utils.accel import enable_persistent_compile_cache
 
@@ -649,6 +650,16 @@ class TPUScheduler:
         self.shard_perpod = os.environ.get("KTPU_SHARD_PERPOD", "1") not in (
             "0", "false"
         )
+        # pluggable placement objectives (objectives/): an explicit
+        # NodePool policy (threaded by the provisioner) or KTPU_OBJECTIVE
+        # selects a template-rank policy per solve; non-lexical fill
+        # rounds fan KTPU_OBJECTIVE_K rank variants over the dp axis and
+        # commit the best-scoring row off ONE verdict word per round
+        self.objective = objective
+        self._objective_ranks: dict = {}
+        self._price_t = None
+        self._price_t_np: Optional[np.ndarray] = None
+        self._active_policy: str = "lexical"
         self._shard_stats: Optional[dict] = None
         # per-chunk streaming sink (gRPC SolveStream); None in-process
         self._chunk_sink = None
@@ -810,6 +821,11 @@ class TPUScheduler:
             and res_vid >= 0
             and bool(np.asarray(self.it_tensors.res_ofs).any())
         )
+        # objective rank/price columns derive from the catalog encode —
+        # drop them whenever the vocab (and so the tensors) rebuild
+        self._objective_ranks = {}
+        self._price_t = None
+        self._price_t_np = None
         self._vocab_sig = self._sig()
 
     def _encode_budgets(self) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -1850,6 +1866,17 @@ class TPUScheduler:
         template_tensors = self.template_tensors._replace(
             budget=budget, nodes_budget=nodes_budget
         )
+        # placement objective: resolve per solve (quarantine-aware — a
+        # tripped "objective" guard path reverts to lexical) and ride the
+        # policy's canonical template rank on every engine's tier-3 pick;
+        # lexical materializes NO rank column and stays bit-identical
+        from karpenter_tpu import objectives
+
+        self._active_policy = objectives.active_policy(self.objective)
+        if self._active_policy != "lexical":
+            template_tensors = template_tensors._replace(
+                rank=self._objective_rank(self._active_policy)
+            )
 
         U = len(reps)
         k_pad, v_pad = self._pads()
@@ -2609,23 +2636,44 @@ class TPUScheduler:
                     remaining[k_] -= hi_ - lo_
                 state = _maybe_compact(state)
             elif mode[0] == "fill":
-                self._shard_eligible(self._fill_family(enc, segs), "sequential")
-                state, ys = _dispatch_fill(state, segs)
-                # fill grids address WINDOW rows; the decode maps them to
-                # global claim ids via this dispatch's slot_of snapshot
-                outputs.append(("fill", segs, ys, state.slot_of))
-                tmpl_snaps.append(ops_solver.global_template(state))
-                for lo_, hi_, k_ in segs:
-                    remaining[k_] -= hi_ - lo_
-                state = _maybe_compact(state)
+                if self._active_policy != "lexical":
+                    # K-variant objective dispatch: the group solves once
+                    # per rank variant in ONE vmapped dispatch and the
+                    # best-scoring feasible row commits
+                    state = self._run_fill_objective(
+                        enc, state, [segs], outputs, tmpl_snaps, remaining,
+                        _maybe_compact, _dispatch_fill,
+                    )
+                else:
+                    self._shard_eligible(
+                        self._fill_family(enc, segs), "sequential"
+                    )
+                    state, ys = _dispatch_fill(state, segs)
+                    # fill grids address WINDOW rows; the decode maps them
+                    # to global claim ids via this dispatch's slot_of
+                    # snapshot
+                    outputs.append(("fill", segs, ys, state.slot_of))
+                    tmpl_snaps.append(ops_solver.global_template(state))
+                    for lo_, hi_, k_ in segs:
+                        remaining[k_] -= hi_ - lo_
+                    state = _maybe_compact(state)
             elif mode[0] == "fill_dp":
-                # `segs` is a LIST of chunk groups here; the dp merge loop
-                # appends one ("fill", ...) output per group, exactly like
-                # the sequential branch would have
-                state = self._run_fill_dp(
-                    enc, state, segs, outputs, tmpl_snaps, remaining,
-                    _maybe_compact, _dispatch_fill,
-                )
+                if self._active_policy != "lexical":
+                    # objective variants take the dp rows a non-lexical
+                    # solve would have spent on chunk-group speculation:
+                    # each merge round fans rank variants of ONE group
+                    state = self._run_fill_objective(
+                        enc, state, segs, outputs, tmpl_snaps, remaining,
+                        _maybe_compact, _dispatch_fill,
+                    )
+                else:
+                    # `segs` is a LIST of chunk groups here; the dp merge
+                    # loop appends one ("fill", ...) output per group,
+                    # exactly like the sequential branch would have
+                    state = self._run_fill_dp(
+                        enc, state, segs, outputs, tmpl_snaps, remaining,
+                        _maybe_compact, _dispatch_fill,
+                    )
             elif mode[0] == "kscan":
                 self._shard_eligible("kscan", "sequential")
                 state, ys = _dispatch_kscan(state, segs, mode[1])
@@ -2883,6 +2931,213 @@ class TPUScheduler:
         if stats is not None:
             stats["merge_wall_s"] += _time.perf_counter() - t_loop0
         return state
+
+    def _objective_price_t(self):
+        """[T] f32 per-type min offering price column, cached until the
+        next catalog re-encode (+inf = unpriced, so an unknown price can
+        never look cheap to the cost objective)."""
+        if self._price_t is None:
+            from karpenter_tpu.ops import encode as ops_encode
+
+            self._price_t = ops_encode.type_price_column(self.it_tensors)
+            self._price_t_np = np.asarray(self._price_t)
+        return self._price_t
+
+    def _objective_rank(self, policy: str):
+        """The policy's canonical [G] template rank, device-resident and
+        cached per policy until the next re-encode."""
+        r = self._objective_ranks.get(policy)
+        if r is None:
+            from karpenter_tpu.objectives import scoring as obj_scoring
+
+            r = jnp.asarray(obj_scoring.canonical_rank(policy, self.templates))
+            self._objective_ranks[policy] = r
+        return r
+
+    def _objective_variant_ranks(self, policy: str, kv: int):
+        """[KV, G] rank variants (row 0 = canonical), cached per
+        (policy, kv). KV may clamp below the ask when there are fewer
+        templates than variants."""
+        key = (policy, "variants", kv)
+        r = self._objective_ranks.get(key)
+        if r is None:
+            from karpenter_tpu.objectives import scoring as obj_scoring
+
+            base = obj_scoring.canonical_rank(policy, self.templates)
+            r = jnp.asarray(obj_scoring.variant_ranks(base, kv))
+            self._objective_ranks[key] = r
+        return r
+
+    def _run_fill_objective(
+        self, enc, state, groups, outputs, tmpl_snaps, remaining,
+        maybe_compact, dispatch_fill,
+    ):
+        """K-variant objective execution of fill chunk groups: each merge
+        round solves ONE group under KV objective-perturbed template
+        ranks in a single vmapped dispatch (variants ride the dp axis the
+        way speculative groups do — padded-idle dp rows are free variant
+        capacity) and fetches ONE packed verdict word carrying every
+        variant's feasibility bit plus the argmin-score winner. The
+        winner's state IS the sequential solve of the group under that
+        rank — same base, full-fidelity scan — so no graft/deadness proof
+        is needed; a round with no feasible variant replays the group
+        through the normal sequential dispatch and its escalation ladder
+        (canonical rank, via the template tensors' rank column)."""
+        import time as _time
+
+        from karpenter_tpu import objectives
+        from karpenter_tpu.ops.kernels import fetch_tree
+        from karpenter_tpu.utils.metrics import (
+            OBJECTIVE_ROUNDS, OBJECTIVE_VARIANT_WINS, SHARD_VERDICT_BYTES,
+        )
+
+        policy = self._active_policy
+        obj_id = objectives.objective_id(policy)
+        dp_n = (
+            int(dict(self.mesh.shape).get("dp", 1))
+            if self.mesh is not None
+            else 1
+        )
+        kv = objectives.variant_count(dp_n)
+        ranks = self._objective_variant_ranks(policy, kv)
+        price_t = self._objective_price_t()
+        n_claims = enc["n_claims"]
+        stats = self._shard_stats
+        t_loop0 = _time.perf_counter()
+        for segs in groups:
+            # one collective-bearing computation in flight at a time (the
+            # CPU-backend rendezvous rule every dp loop follows)
+            self._dp_wait(state, "fill_obj.drain")
+            B = len(segs)
+            B_pad = self._pad_cache.pad(
+                "fill_segments", B, step=(8 if B <= 32 else 32)
+            )
+            kind_ids = np.zeros(B_pad, dtype=np.int64)
+            counts = np.zeros(B_pad, dtype=np.int32)
+            for j, (lo, hi, k) in enumerate(segs):
+                kind_ids[j] = k
+                counts[j] = hi - lo
+            xs = _gather_fill_xs(
+                enc["reqs_k"], enc["requests_k"], enc["tol_k"],
+                enc["it_allow_k"], enc["exist_ok_k"], enc["ports_k"],
+                enc["conf_k"], enc["vols_k"], enc["pod_topo_k"],
+                jnp.asarray(kind_ids), jnp.asarray(counts),
+            )
+            base_w_open = state.w_open  # device scalar; only audits fetch
+            spec, ys, word, scores = ops_solver.solve_fill_variants(
+                state, xs, enc["exist_tensors"], self.it_tensors,
+                enc["template_tensors"], self.well_known,
+                enc["topo_tensors"], ranks, price_t,
+                zone_kid=enc["zone_kid"], ct_kid=enc["ct_kid"],
+                n_claims=n_claims, objective=obj_id,
+            )
+            self._dp_wait((spec, ys, word), "fill_obj.device")
+            # the round's SINGLE synchronization point: feasibility bits
+            # in the low lanes, the winner index in the top byte
+            t_sync = _time.perf_counter()
+            (vw,) = fetch_tree([word], wf_label="fill_obj.sync_verdict")
+            vw = np.asarray(vw)
+            vw_int = int(vw.reshape(-1)[0])
+            winner = (vw_int >> 24) & 0xFF
+            feasible_any = bool(vw_int & ((1 << 24) - 1))
+            if stats is not None:
+                dt_sync = _time.perf_counter() - t_sync
+                stats["merge_rounds"] += 1
+                stats["verdict_fetches"] += 1
+                stats["verdict_bytes"] += int(vw.nbytes)
+                stats["sync_verdict_s"] += dt_sync
+                stats["sync_blocked_s"] += dt_sync
+            SHARD_VERDICT_BYTES.inc(int(vw.nbytes))
+            if feasible_any:
+                spec_w, ys_w, score_w = ops_solver.take_dp_row(
+                    (spec, ys, scores), jnp.int32(winner)
+                )
+                self._dp_wait(ys_w.fill_c, "fill_obj.commit")
+                state = state._replace(
+                    reqs=spec_w.reqs, used=spec_w.used, its=spec_w.its,
+                    template=spec_w.template, open=spec_w.open,
+                    pods=spec_w.pods, slot_of=spec_w.slot_of,
+                    claim_ports=spec_w.claim_ports, held=spec_w.held,
+                    n_open=spec_w.n_open, w_open=spec_w.w_open,
+                    spills=spec_w.spills, exist_reqs=spec_w.exist_reqs,
+                    exist_used=spec_w.exist_used,
+                    exist_ports=spec_w.exist_ports,
+                    exist_vols=spec_w.exist_vols,
+                    hg_counts=spec_w.hg_counts,
+                    w_hw=jnp.maximum(state.w_hw, spec_w.w_open),
+                )
+                self._dp_wait(state, "fill_obj.commit")
+                if guard_config.should_audit("objective"):
+                    self._audit_objective_commit(
+                        policy, base_w_open, spec_w, score_w
+                    )
+                outputs.append(("fill", segs, ys_w, state.slot_of))
+                OBJECTIVE_ROUNDS.inc(policy=policy, outcome="committed")
+                OBJECTIVE_VARIANT_WINS.inc(
+                    policy=policy,
+                    variant="canonical" if winner == 0 else "perturbed",
+                )
+            else:
+                # no variant packed the group cleanly: sequential replay
+                # under the canonical rank keeps every escalation path
+                # (window spill, claim-axis growth) intact
+                state, ys_seq = dispatch_fill(state, segs)
+                self._dp_wait(state, "fill_obj.replay")
+                outputs.append(("fill", segs, ys_seq, state.slot_of))
+                OBJECTIVE_ROUNDS.inc(policy=policy, outcome="replayed")
+            tmpl_snaps.append(ops_solver.global_template(state))
+            for lo_, hi_, k_ in segs:
+                remaining[k_] -= hi_ - lo_
+            state = maybe_compact(state)
+            self._dp_wait((state, tmpl_snaps[-1]), "fill_obj.commit")
+        if stats is not None:
+            stats["merge_wall_s"] += _time.perf_counter() - t_loop0
+        return state
+
+    def _audit_objective_commit(self, policy, base_w_open, spec_w, score_w):
+        """Objective-twin shadow audit: re-score the committed winner's
+        opened claims on host (objectives/oracle.py — np.float32 formula
+        twin of the device reduction) and compare against the device-
+        reported score. The rel tolerance covers f32 summation-order
+        drift; a LYING scorer (KTPU_GUARD_LIE=objective) reports +1.0 off
+        and trips quarantine, which routes every later solve back onto
+        the lexical policy for the TTL."""
+        from karpenter_tpu.objectives import oracle as obj_oracle
+        from karpenter_tpu.ops.kernels import fetch_tree
+
+        b_wo, wo, open_m, pods_w, tmpl_w, its_w, fast = fetch_tree(
+            [
+                base_w_open, spec_w.w_open, spec_w.open, spec_w.pods,
+                spec_w.template, spec_w.its, score_w,
+            ],
+            wf_label="fill_obj.audit",
+        )
+        fast_val = float(np.asarray(fast))
+        if guard_config.lying("objective"):  # seeded lying-scorer fixture
+            fast_val += 1.0
+        self._objective_price_t()
+        host_val = obj_oracle.score_opened(
+            policy, int(b_wo), int(wo), np.asarray(open_m),
+            np.asarray(pods_w), np.asarray(tmpl_w), np.asarray(its_w),
+            self._price_t_np, len(self.templates),
+        )
+        if np.isclose(fast_val, host_val, rtol=1e-4, atol=1e-3):
+            guard_audit.record_audit("objective", "pass")
+            return
+        pods_by_uid, rounds, existing = self._guard_problem_ctx()
+        guard_audit.handle_divergence(
+            "objective",
+            "device objective score != host re-score",
+            self,
+            pods_by_uid,
+            rounds,
+            existing,
+            detail={
+                "policy": policy,
+                "device_score": fast_val,
+                "host_score": host_val,
+            },
+        )
 
     def _run_kscan_dp(
         self, enc, state, key, groups, outputs, tmpl_snaps, remaining,

@@ -35,8 +35,10 @@ ENV_GUARD_SEED = "KTPU_GUARD_SEED"
 ENV_GUARD_LIE = "KTPU_GUARD_LIE"
 ENV_WATCHDOG = "KTPU_WATCHDOG_S"
 
-#: the four guarded fast paths (quarantine keys / audit metric labels)
-PATHS = ("resident", "speculative", "grid", "encode_cache")
+#: the guarded fast paths (quarantine keys / audit metric labels);
+#: "objective" quarantines the placement-objective scorer back onto the
+#: lexical policy (objectives/registry.py active_policy)
+PATHS = ("resident", "speculative", "grid", "encode_cache", "objective")
 
 _LOCK = threading.Lock()
 _RNG: Optional[random.Random] = None

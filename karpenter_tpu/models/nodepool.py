@@ -115,6 +115,9 @@ class NodePoolSpec:
     limits: Optional[Limits] = None
     weight: int = 0  # 1-100; higher = tried first (nodepool.go:~Weight)
     replicas: Optional[int] = None  # static capacity pools
+    # batched placement objective for this pool's templates (objectives/
+    # registry POLICIES); None defers to KTPU_OBJECTIVE, then lexical
+    placement_objective: Optional[str] = None
 
 
 @dataclass

@@ -1,0 +1,23 @@
+"""Pluggable batched placement objectives (ISSUE 19).
+
+A placement objective is a template *rank* — a [G] i32 column on
+``ops.solver.Templates`` that tier-3 opens in ascending order — plus a
+*score*, a device-evaluated f32 the K-variant fill dispatch minimizes
+over objective-perturbed rank variants riding the dp axis, and that
+consolidation reuses to order candidates. ``registry`` owns the policy
+table and the env/NodePool selection (quarantine-aware: a tripped
+"objective" guard path falls back to ``lexical``); ``scoring`` builds
+the host-side canonical rank per policy; ``oracle`` is the np.float32
+exact-mirror scorer the objective-twin audit and the differential tests
+pin the device scores against.
+"""
+
+from karpenter_tpu.objectives.registry import (  # noqa: F401
+    ENV_OBJECTIVE,
+    ENV_OBJECTIVE_K,
+    POLICIES,
+    active_policy,
+    objective_id,
+    resolve_policy,
+    variant_count,
+)

@@ -1,0 +1,109 @@
+"""Host-oracle objective scorer: the np.float32 exact mirror.
+
+``score_opened`` recomputes ops.solver._objective_score's reduction from
+the committed winner row's fetched fields — the objective-twin audit
+compares it against the device-reported score (rel tolerance covers
+f32 summation-order drift; a LYING scorer is off by +1.0, far outside
+it). ``score_result`` scores a finished SchedulingResult from catalog
+objects — the differential suite and the bench cost gate pin policy
+outcomes with it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from karpenter_tpu.objectives.scoring import min_available_price
+
+_BIG = np.float32(1e6)
+
+
+def score_opened(
+    policy: str,
+    base_w_open: int,
+    w_open: int,
+    open_mask: np.ndarray,  # [W] bool
+    pods: np.ndarray,  # [W] i32
+    template: np.ndarray,  # [W] i32
+    its: np.ndarray,  # [W, T] bool
+    price_t: np.ndarray,  # [T] f32
+    n_templates: int,
+) -> float:
+    """The round score of the claims one fill dispatch opened — formula
+    twin of ops.solver._objective_score, np.float32 end to end."""
+    W = open_mask.shape[0]
+    rows = np.arange(W)
+    opened = (rows >= base_w_open) & (rows < w_open) & open_mask
+    n_opened = np.float32(w_open - base_w_open)
+    if policy == "cost_min":
+        row_price = np.where(
+            its, price_t[None, :].astype(np.float32), np.float32(np.inf)
+        ).min(axis=1)
+        return float(np.sum(np.where(opened, row_price, 0.0), dtype=np.float32))
+    if policy == "frag_aware":
+        landed = np.sum(np.where(opened, pods, 0), dtype=np.float32)
+        return float(n_opened * _BIG - landed)
+    if policy == "topo_spread":
+        cnt = np.zeros(n_templates, dtype=np.float32)
+        np.add.at(cnt, template[opened], np.float32(1.0))
+        return float(np.sum(cnt * cnt, dtype=np.float32))
+    if policy == "gang_slice":
+        p_max = int(np.max(np.where(opened, pods, 0), initial=0))
+        slack = np.where(opened, p_max - pods, 0).astype(np.float32)
+        return float(np.sum(slack, dtype=np.float32) + n_opened)
+    return 0.0
+
+
+def score_result(policy: str, result) -> float:
+    """Objective score of a finished solve, from decoded claim objects —
+    the same formulas over the FINAL claim set (fresh claims only; the
+    per-round device scores decompose over rounds for cost/frag/gang,
+    and the suite uses this as the cross-engine comparator)."""
+    claims = list(result.claims)
+    n = np.float32(len(claims))
+    if policy == "cost_min":
+        total = np.float32(0.0)
+        for c in claims:
+            total = np.float32(
+                total
+                + np.float32(
+                    min(
+                        (min_available_price(it) for it in c.instance_types),
+                        default=float("inf"),
+                    )
+                )
+            )
+        return float(total)
+    if policy == "frag_aware":
+        landed = np.float32(sum(len(c.pods) for c in claims))
+        return float(n * _BIG - landed)
+    if policy == "topo_spread":
+        occ: dict = {}
+        for c in claims:
+            key = c.template.nodepool_name
+            occ[key] = occ.get(key, 0) + 1
+        return float(np.sum(np.asarray(list(occ.values()), dtype=np.float32) ** 2))
+    if policy == "gang_slice":
+        if not claims:
+            return 0.0
+        p_max = max(len(c.pods) for c in claims)
+        return float(
+            np.float32(sum(p_max - len(c.pods) for c in claims)) + n
+        )
+    return 0.0
+
+
+def total_price_per_hour(result) -> float:
+    """Σ cheapest member price over fresh claims — the bench stage's
+    reported cost under each policy (host_scheduler's total_price uses
+    requirement-aware pricing; this floor-based twin is what cost_min
+    provably minimizes)."""
+    total = 0.0
+    for c in result.claims:
+        p = min(
+            (min_available_price(it) for it in c.instance_types),
+            default=float("inf"),
+        )
+        if np.isfinite(p):
+            total += p
+    return total

@@ -1,0 +1,76 @@
+"""Objective registry: the policy table and the selection rules.
+
+Selection precedence (per solve, nothing cached at import so tests flip
+with monkeypatch.setenv):
+
+  1. an explicit NodePool ``placement_objective`` (threaded through the
+     scheduler's ``objective=`` kwarg by the provisioner),
+  2. ``KTPU_OBJECTIVE``,
+  3. ``lexical`` — the legacy fewest-pods/earliest-slot tie-break with
+     weight-ordered templates, pinned bit-identical to the pre-objective
+     solver (no rank column is materialized at all).
+
+A tripped "objective" quarantine (the objective-twin audit caught a
+lying scorer) routes every policy back onto ``lexical`` for the TTL —
+the scores are untrusted, the structural solve is not.
+
+``KTPU_OBJECTIVE_K`` caps how many objective-perturbed rank variants the
+K-variant fill dispatch fans over the dp axis (0 = size to the mesh's dp
+extent; always clamped to ops.solver.VARIANT_MAX so the verdict word's
+winner byte stays addressable).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+ENV_OBJECTIVE = "KTPU_OBJECTIVE"
+ENV_OBJECTIVE_K = "KTPU_OBJECTIVE_K"
+
+#: policy name -> objective id, in ops.solver OBJ_* order
+POLICIES = ("lexical", "cost_min", "frag_aware", "topo_spread", "gang_slice")
+
+
+def objective_id(policy: str) -> int:
+    """The static jit id ops.solver compiles the score formula under."""
+    return POLICIES.index(policy)
+
+
+def resolve_policy(nodepool_policy: Optional[str] = None) -> str:
+    """NodePool > env > lexical; unknown names fall back to lexical (a
+    typo'd policy must not change packing silently — lexical IS today's
+    behavior)."""
+    for cand in (nodepool_policy, os.environ.get(ENV_OBJECTIVE)):
+        if cand and cand in POLICIES:
+            return cand
+    return "lexical"
+
+
+def active_policy(nodepool_policy: Optional[str] = None) -> str:
+    """The policy actually applied this solve: the resolved policy, or
+    lexical while the "objective" guard path is quarantined."""
+    policy = resolve_policy(nodepool_policy)
+    if policy == "lexical":
+        return policy
+    from karpenter_tpu.guard.quarantine import QUARANTINE
+
+    if QUARANTINE.active("objective"):
+        return "lexical"
+    return policy
+
+
+def variant_count(dp_rows: int) -> int:
+    """How many rank variants to fan out: KTPU_OBJECTIVE_K, defaulting to
+    the dp extent (padded-idle dp rows are free variant capacity), never
+    below 1 nor above the verdict word's addressable VARIANT_MAX."""
+    from karpenter_tpu.ops.solver import VARIANT_MAX
+
+    raw = os.environ.get(ENV_OBJECTIVE_K, "")
+    try:
+        k = int(raw) if raw else 0
+    except ValueError:
+        k = 0
+    if k <= 0:
+        k = max(dp_rows, 1)
+    return max(1, min(k, VARIANT_MAX))

@@ -1,0 +1,119 @@
+"""Host-side objective rank construction.
+
+Each policy turns the template list into a [G] i32 *canonical rank* —
+the order tier-3 tries templates in (``ops.solver._pick_template``).
+Ranks are data, not code: the device kernels stay policy-agnostic and
+the rank column rides ``Templates.rank`` as a plain jit argument, so
+switching policies never recompiles beyond the one-time None->array
+retrace.
+
+The K-variant fill dispatch additionally fans ``variant_ranks`` over the
+dp axis: variant 0 is the canonical rank, variant k promotes the k-th
+best template to the front — a one-move perturbation whose realized
+score (computed on device from the actual packing) can beat the greedy
+canonical order, e.g. when opening one bigger/cheaper-per-pod node
+absorbs a whole chunk group.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from karpenter_tpu.models import labels as l
+
+
+def min_available_price(it) -> float:
+    """Cheapest available offering of one instance type, +inf when the
+    catalog carries no priced available offering (the same "unknown
+    prices never look cheap" rule as disruption's candidates fix)."""
+    prices = [o.price for o in it.offerings if o.available]
+    return float(min(prices)) if prices else float("inf")
+
+
+def template_price(template) -> float:
+    """Cheapest member instance type — the template's price floor."""
+    prices = [min_available_price(it) for it in template.instance_types]
+    return min(prices) if prices else float("inf")
+
+
+def _zone_signature(template) -> tuple:
+    reqs = template.requirements
+    if reqs.has(l.LABEL_TOPOLOGY_ZONE):
+        return tuple(sorted(reqs.get(l.LABEL_TOPOLOGY_ZONE).values))
+    return ()
+
+
+def _frag_size(template) -> float:
+    """Best-fit proxy: the smallest member node's total allocatable —
+    small nodes leave less leftover when a kind doesn't fill them."""
+    sizes = [
+        sum(it.allocatable().values()) for it in template.instance_types
+    ]
+    return min(sizes) if sizes else float("inf")
+
+
+def _gang_capacity(template) -> int:
+    """Per-host slice capacity for a unit pod — the gang oracle's
+    closed-form shape math (slice_capacity / hosts_needed): templates
+    with bigger per-host blocks need fewer hosts per gang."""
+    from karpenter_tpu.gang import oracle
+
+    return oracle.slice_capacity(
+        template.instance_types,
+        template.requirements,
+        dict(template.daemon_requests or {}),
+        {"cpu": 1.0},
+    )
+
+
+def _rank_from_keys(keys: list) -> np.ndarray:
+    order = sorted(range(len(keys)), key=lambda g: (keys[g], g))
+    rank = np.zeros(len(keys), dtype=np.int32)
+    for pos, g in enumerate(order):
+        rank[g] = pos
+    return rank
+
+
+def canonical_rank(policy: str, templates: list) -> np.ndarray:
+    """[G] i32 — the policy's template order (0 = tried first). Every
+    key sorts ascending with the original (weight) index as tie-break,
+    so a policy that cannot distinguish two templates preserves today's
+    order between them."""
+    G = len(templates)
+    if policy == "lexical":
+        return np.arange(G, dtype=np.int32)
+    if policy == "cost_min":
+        keys: list = [template_price(t) for t in templates]
+    elif policy == "frag_aware":
+        keys = [_frag_size(t) for t in templates]
+    elif policy == "topo_spread":
+        # round-robin over distinct zone signatures: the g-th template of
+        # a zone group ranks behind the g-th of every other group, so the
+        # try-order cycles zones instead of draining one
+        occ: dict = {}
+        keys = []
+        for t in templates:
+            sig = _zone_signature(t)
+            keys.append(occ.get(sig, 0))
+            occ[sig] = occ.get(sig, 0) + 1
+    elif policy == "gang_slice":
+        # descending per-host capacity = ascending hosts-per-gang
+        keys = [-_gang_capacity(t) for t in templates]
+    else:
+        raise ValueError(f"unknown placement objective {policy!r}")
+    return _rank_from_keys(keys)
+
+
+def variant_ranks(rank: np.ndarray, kv: int) -> np.ndarray:
+    """[KV, G] i32 — one-move perturbations of the canonical rank: row 0
+    is canonical, row k promotes the template ranked k to the front
+    (rank min-1, everything else untouched). KV clamps to G — there are
+    only G distinct promotions."""
+    G = int(rank.shape[0])
+    kv = max(1, min(kv, G))
+    order = np.argsort(rank, kind="stable")
+    out = np.tile(rank[None, :], (kv, 1)).astype(np.int32)
+    front = np.int32(rank.min() - 1)
+    for k in range(1, kv):
+        out[k, order[k]] = front
+    return out

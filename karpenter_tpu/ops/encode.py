@@ -517,3 +517,24 @@ class ProblemEncoder:
             res_vid = self.vocab.values[ct_kid].index(l.CAPACITY_TYPE_RESERVED)
         rid_names = list(self.vocab.values[rid_kid]) if rid_kid >= 0 else []
         return rid_kid, res_vid, rid_names
+
+
+def type_price_column(itt: InstanceTypeTensors) -> jnp.ndarray:
+    """[T] f32 — each type's min available offering price over every
+    (zone, capacity-type) cell, +inf when the catalog never priced it.
+    The objective kernels' per-claim price floor (a claim's cheapest
+    still-viable type), derived from the already-encoded price_zc slab so
+    it needs no second catalog walk and pads identically."""
+    return jnp.min(itt.price_zc, axis=(1, 2))
+
+
+def template_price_column(tmpl_its, price_t) -> np.ndarray:
+    """[G] f32 — per-template price floor: min type price over the
+    template's statically-compatible member types (+inf when none are
+    priced). Host-side companion of type_price_column for rank
+    construction and the consolidation ordering."""
+    return np.where(
+        np.asarray(tmpl_its, dtype=bool),
+        np.asarray(price_t, dtype=np.float32)[None, :],
+        np.float32(np.inf),
+    ).min(axis=1)

@@ -33,7 +33,7 @@ slot, NO_CLAIM / NO_ROOM sentinels otherwise.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -130,6 +130,27 @@ class Templates(NamedTuple):
     # DEFINES (finite sets only: undefined/complement keys contribute
     # nothing, matching Requirements.Get(k).Values())
     mv_it_values: jnp.ndarray
+    # placement-objective template order (objectives/): tier-3 opens the
+    # FIRST FEASIBLE template in ascending rank instead of ascending
+    # index. None = legacy weight order — identity rank, for which
+    # argmin(where(feas, rank, BIG)) IS argmax(feas) bit-for-bit
+    # (including the all-infeasible case, where both land on index 0)
+    rank: Optional[jnp.ndarray] = None  # [G] i32
+
+
+def _pick_template(tmpl_feas: jnp.ndarray, templates: "Templates") -> jnp.ndarray:
+    """Tier-3 template choice: first feasible in objective-rank order.
+
+    With no rank column (the default `lexical` policy) this is the
+    literal legacy computation — argmax over the feasibility mask, i.e.
+    the lowest-index (highest-weight) feasible template. A rank column
+    reorders the SAME choice via argmin(where(feas, rank, BIG)); for the
+    identity rank the two are bit-identical (both return 0 when nothing
+    is feasible), which is the `lexical` bit-parity argument: the policy
+    mechanism costs nothing and changes nothing unless a rank is set."""
+    if templates.rank is None:
+        return jnp.argmax(tmpl_feas)
+    return jnp.argmin(jnp.where(tmpl_feas, templates.rank, BIG))
 
 
 class ExistingNodes(NamedTuple):
@@ -567,7 +588,7 @@ def _make_step(
                 tmpl_feas &= ~(jnp.any(ofs0, axis=-1) & ~jnp.any(to_res0, axis=-1))
         else:
             to_res0 = jnp.zeros((G, state.held.shape[1]), dtype=bool)
-        g = jnp.argmax(tmpl_feas)
+        g = _pick_template(tmpl_feas, templates)
         any_template = jnp.any(tmpl_feas) & pod_valid & ~found_e & ~found
         can_open = any_template & (state.w_open < W) & (state.n_open < NCAP)
         # a refusal with global capacity left is a WINDOW spill: the host
@@ -1595,7 +1616,7 @@ def _make_fill_step(
             & jnp.any(its0, axis=-1)
             & (state.nodes_budget >= 1.0)
         )
-        g = jnp.argmax(tmpl_feas)
+        g = _pick_template(tmpl_feas, templates)
         any_template = jnp.any(tmpl_feas) & (cap_topo_fresh > 0)
         f_new0 = _claim_fill_caps(
             templates.daemon_requests, its0, requests, it, off_g
@@ -1878,6 +1899,132 @@ def solve_fill_dp(
         extra_ok=(jnp.sum(ys.leftover, axis=1) == 0) & hg_ok & exist_bit,
     )
     return spec, ys, verdict
+
+
+# placement-objective ids (objectives/registry.py POLICIES order); static
+# jit args so each objective's score reduction compiles to a fixed formula
+OBJ_LEXICAL = 0
+OBJ_COST_MIN = 1
+OBJ_FRAG_AWARE = 2
+OBJ_TOPO_SPREAD = 3
+OBJ_GANG_SLICE = 4
+
+# the variant verdict word reserves the top byte for the winner index, so
+# at most 24 rank variants ride one uint32 lane
+VARIANT_MAX = 24
+
+
+def _objective_score(base, st, price_t, objective: int, G: int):
+    """[] f32 — the device half of one objective's realized score over the
+    claims THIS dispatch opened (window rows [base.w_open, st.w_open)).
+    The host oracle (objectives/oracle.py score_opened) mirrors each
+    formula in np.float32 — the objective-twin audit compares the two."""
+    W = st.open.shape[0]
+    rows = jnp.arange(W, dtype=jnp.int32)
+    opened = (rows >= base.w_open) & (rows < st.w_open) & st.open
+    n_opened = (st.w_open - base.w_open).astype(jnp.float32)
+    if objective == OBJ_COST_MIN:
+        # cheapest still-viable instance type per opened claim (price_t is
+        # the catalog's per-type min offering price, +inf when unpriced)
+        row_price = jnp.min(
+            jnp.where(st.its, price_t[None, :], jnp.inf), axis=1
+        )
+        return jnp.sum(jnp.where(opened, row_price, 0.0))
+    if objective == OBJ_FRAG_AWARE:
+        # fewest fresh claims first, then densest packing onto them
+        landed = jnp.sum(jnp.where(opened, st.pods, 0).astype(jnp.float32))
+        return n_opened * jnp.float32(1e6) - landed
+    if objective == OBJ_TOPO_SPREAD:
+        # sum of squared per-template claim counts: minimized when fresh
+        # claims balance across the (zone/offering-bearing) templates
+        cnt = jnp.zeros(G, dtype=jnp.float32).at[st.template].add(
+            opened.astype(jnp.float32)
+        )
+        return jnp.sum(cnt * cnt)
+    if objective == OBJ_GANG_SLICE:
+        # slice-footprint slack vs the fullest block (gang/oracle.py
+        # hosts_needed: uniform full blocks minimize hosts), plus the
+        # block count itself
+        p_max = jnp.max(jnp.where(opened, st.pods, 0))
+        slack = jnp.where(opened, p_max - st.pods, 0).astype(jnp.float32)
+        return jnp.sum(slack) + n_opened
+    return jnp.float32(0.0)
+
+
+_VARIANT_STATIC = ("zone_kid", "ct_kid", "n_claims", "objective")
+
+
+@_wf_timed("solve_fill_variants")
+@named_kernel("solve_fill_variants")
+@functools.partial(jax.jit, static_argnames=_VARIANT_STATIC)
+def solve_fill_variants(
+    state: SolverState,
+    xs: FillXs,
+    exist: ExistingNodes,
+    it: InstanceTypeTensors,
+    templates: Templates,
+    well_known: jnp.ndarray,
+    topo: TopologyTensors,
+    ranks: jnp.ndarray,  # [KV, G] i32 — row 0 = the policy's canonical rank
+    price_t: jnp.ndarray,  # [T] f32 — per-type min offering price (+inf unknown)
+    zone_kid: int,
+    ct_kid: int,
+    n_claims: int,
+    objective: int,
+) -> tuple[ShardFillState, FillYs, jnp.ndarray, jnp.ndarray]:
+    """K objective-perturbed rank variants of ONE chunk group ride the dp
+    axis: every variant solves the SAME group against the SAME base state
+    under its own template rank (vmap over the rank axis, rows sharded
+    over the mesh's dp rows — padded-idle dp rows are free variant
+    capacity), and the realized objective score of each outcome folds
+    into ONE packed verdict word the host fetches per merge round:
+
+      bits [0, KV)   per-variant feasibility — the commit bits (zero
+                     leftovers, no window spill), same semantics as
+                     _dp_verdict_word's fit checks;
+      bits [24, 32)  the argmin-score winner among feasible variants
+                     (ties to the lowest index; variant 0 carries the
+                     policy's canonical rank, so a scoreless tie is the
+                     canonical outcome).
+
+    Unlike the speculative dp fan-out there is no cross-variant merge to
+    prove: exactly one variant commits, and its state IS the sequential
+    solve of this group under that rank — full-fidelity scan from the
+    committed base, nothing speculative. No feasible variant (word low
+    bits all zero) replays the group through the normal sequential
+    dispatch and its escalation ladder."""
+    KV = ranks.shape[0]
+    G = templates.its.shape[0]
+
+    def one(rank_v):
+        step = _make_fill_step(
+            exist, it, templates._replace(rank=rank_v), well_known, topo,
+            zone_kid, ct_kid, n_claims, annotate=False,
+        )
+        st, ys = jax.lax.scan(step, state, xs)
+        score = _objective_score(state, st, price_t, objective, G)
+        return (
+            ShardFillState(
+                reqs=st.reqs, used=st.used, its=st.its, template=st.template,
+                open=st.open, pods=st.pods, slot_of=st.slot_of,
+                claim_ports=st.claim_ports, held=st.held, n_open=st.n_open,
+                w_open=st.w_open, spills=st.spills,
+                exist_reqs=st.exist_reqs, exist_used=st.exist_used,
+                exist_ports=st.exist_ports, exist_vols=st.exist_vols,
+                hg_counts=st.hg_counts,
+            ),
+            ys,
+            score,
+        )
+
+    spec, ys, scores = jax.vmap(one)(shard_hint(ranks, "dp"))
+    feasible = (jnp.sum(ys.leftover, axis=1) == 0) & (
+        spec.spills == state.spills
+    )
+    best = jnp.argmin(jnp.where(feasible, scores, jnp.inf))
+    winner = jnp.where(jnp.any(feasible), best, 0).astype(jnp.uint32)
+    word = kernels.pack_bool(feasible)[0] | (winner << jnp.uint32(24))
+    return spec, ys, word, scores
 
 
 def _rows_dead(used, its, open_mask, it, r_min):
@@ -2271,7 +2418,7 @@ def _make_gang_step(
             & jnp.any(its0, axis=-1)
             & (state.nodes_budget >= 1.0)
         )
-        g = jnp.argmax(tmpl_feas)
+        g = _pick_template(tmpl_feas, templates)
         any_t = jnp.any(tmpl_feas) & (count > 0) & (cap_topo_fresh > 0)
 
         # slice shape: per-host capacity f, hosts want = ceil(size / f)
@@ -2804,7 +2951,7 @@ def _make_kind_step(
             newz_g = newz[E + W :]
             fits_g = jnp.any(newz_g & (capd_g >= 1), axis=-1)
             tmpl_feas = static_g & f_topo[E + W :] & fits_g & hg_ok[E + W :]
-            g = jnp.argmax(tmpl_feas)
+            g = _pick_template(tmpl_feas, templates)
             any_t = jnp.any(tmpl_feas) & valid & ~found_e & ~found
             can_open = any_t & (c["w_open"] < W) & (c["n_open"] < NCAP)
             spilled = any_t & ~can_open & (c["n_open"] < NCAP)

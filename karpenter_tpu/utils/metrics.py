@@ -513,6 +513,31 @@ GUARD_QUARANTINED = REGISTRY.gauge(
     " expiry (KTPU_GUARD_TTL_S) or restart",
     ("path",),
 )
+# ---- placement objectives (objectives/, ISSUE 19) ----
+OBJECTIVE_ROUNDS = REGISTRY.counter(
+    "ktpu_objective_rounds_total",
+    "K-variant objective fill merge rounds by active placement policy and"
+    " outcome: committed (a feasible rank variant won on score and its"
+    " state landed) vs replayed (no variant packed the chunk group"
+    " cleanly, so the group re-ran through the sequential dispatch under"
+    " the policy's canonical rank)",
+    ("policy", "outcome"),
+)
+OBJECTIVE_VARIANT_WINS = REGISTRY.counter(
+    "ktpu_objective_variant_wins_total",
+    "Committed objective rounds split by which rank variant won the"
+    " score: canonical (variant 0, the policy's greedy template order) vs"
+    " perturbed (a one-move promotion beat it — the measured value of"
+    " riding extra variants on the dp axis)",
+    ("policy", "variant"),
+)
+PRICING_MISSING = REGISTRY.counter(
+    "ktpu_pricing_missing_total",
+    "Disruption candidates whose instance type had no offering price for"
+    " their (zone, capacity-type): such candidates are EXCLUDED from"
+    " cost-ranked consolidation ordering instead of silently pricing at"
+    " 0.0 (which made a missing price look like the cheapest node)",
+)
 WATCHDOG_STALLS = REGISTRY.counter(
     "ktpu_watchdog_stalls_total",
     "Solve sections the watchdog declared stalled (no completion within"

@@ -318,6 +318,34 @@ def test_fleet_observatory_families_are_registered():
     assert "KTPU_BUS_MAX_BYTES" in fams["ktpu_fleet_bus_rotations_total"].help
 
 
+def test_objective_families_are_registered():
+    """ISSUE-19 families: K-variant objective round outcomes, the
+    canonical-vs-perturbed winner split, and the missing-price counter
+    behind the consolidation cost-ranking exclusion. The round counter's
+    help must explain both outcomes; the pricing counter's help must say
+    missing prices are EXCLUDED from cost ordering, not priced 0.0."""
+    from karpenter_tpu.utils.metrics import Counter
+
+    fams = {f.name: f for f in _families()}
+    expected = {
+        "ktpu_objective_rounds_total": (Counter, ("policy", "outcome")),
+        "ktpu_objective_variant_wins_total": (Counter, ("policy", "variant")),
+        "ktpu_pricing_missing_total": (Counter, ()),
+    }
+    for name, (cls, labels) in expected.items():
+        fam = fams.get(name)
+        assert fam is not None, f"{name} not registered"
+        assert isinstance(fam, cls), (name, type(fam).__name__)
+        assert fam.label_names == labels, (name, fam.label_names)
+        assert fam.help.strip()
+    for outcome in ("committed", "replayed"):
+        assert outcome in fams["ktpu_objective_rounds_total"].help, outcome
+    for variant in ("canonical", "perturbed"):
+        assert variant in fams["ktpu_objective_variant_wins_total"].help, variant
+    for word in ("EXCLUDED", "0.0"):
+        assert word in fams["ktpu_pricing_missing_total"].help, word
+
+
 def test_counters_end_in_total_and_histograms_in_seconds_or_pods():
     """Unit-suffix discipline for NEW families (grandfathered names keep
     their reference spellings verbatim)."""
