@@ -239,7 +239,23 @@ def make_templates(n_types):
     return build_templates([(pool, instance_types(n_types))])
 
 
-def host_solve(templates, pods):
+def mv_templates(n_types, mv=2):
+    """Templates whose pool carries an instance-type minValues floor —
+    the enforced-minValues class rung 1 (ISSUE 20) admits to perpod-dp."""
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.controllers.provisioning import build_templates
+    from karpenter_tpu.models import labels as l
+    from karpenter_tpu.models.nodepool import NodePool
+
+    pool = NodePool()
+    pool.metadata.name = "default"
+    pool.spec.template.spec.requirements = [
+        {"key": l.LABEL_INSTANCE_TYPE, "operator": "Exists", "minValues": mv}
+    ]
+    return build_templates([(pool, instance_types(n_types))])
+
+
+def host_solve(templates, pods, budgets=None):
     """The Go-FFD oracle on the identical problem: same templates, same
     internally-built topology the device path uses when none is injected
     (scheduler.py _encode: Topology.build over the universe domains)."""
@@ -251,7 +267,9 @@ def host_solve(templates, pods):
 
     topo = Topology.build(pods, build_universe_domains(templates, []), [])
     t0 = time.perf_counter()
-    result = HostScheduler(templates, topology=topo).solve(list(pods))
+    result = HostScheduler(templates, budgets=budgets, topology=topo).solve(
+        list(pods)
+    )
     return result, time.perf_counter() - t0
 
 
@@ -1063,23 +1081,69 @@ def run_shard_stage(n_pods=8192, n_types=200, max_claims=2048):
         "pr = psched.solve(ppods)\n"
         "os.environ.pop('KTPU_SOLVE_CHUNK', None)\n"
         "assert pr.assignments == psingle.assignments, 'perpod meshed != single-device'\n"
+        "# ISSUE 20 rung-1 twin: enforced minValues + finite disruption\n"
+        "# budgets no longer disqualify perpod-dp — debits ride the slice\n"
+        "# as order-free deltas behind the disjointness verdict bit; must\n"
+        "# commit >=1 dp round AND stay identical to the single-device\n"
+        "# solve and the host oracle\n"
+        "from bench import mv_templates, host_solve\n"
+        "os.environ['KTPU_SOLVE_CHUNK'] = '128'\n"
+        "bpods = perpod_pods(512, kinds=8, prefix='bb')\n"
+        "budgets = {'default': {'cpu': 1e6}}\n"
+        "committed0 = SHARD_MERGE_ROUNDS.get(outcome='committed', family='perpod')\n"
+        f"bsingle = TPUScheduler(mv_templates({n_types}), pod_pad=512).solve(bpods, budgets={{'default': dict(budgets['default'])}})\n"
+        f"bsched = TPUScheduler(mv_templates({n_types}), pod_pad=512, mesh=make_mesh())\n"
+        "br = bsched.solve(bpods, budgets={'default': dict(budgets['default'])})\n"
+        "os.environ.pop('KTPU_SOLVE_CHUNK', None)\n"
+        "budget_committed = int(SHARD_MERGE_ROUNDS.get(outcome='committed', family='perpod') - committed0)\n"
+        "assert budget_committed >= 1, 'perpod under mv+budgets never committed a dp round'\n"
+        "assert br.assignments == bsingle.assignments, 'perpod mv+budget meshed != single-device'\n"
+        f"bhost, _ = host_solve(mv_templates({n_types}), bpods, budgets={{'default': dict(budgets['default'])}})\n"
+        "assert br.assignments == bhost.assignments, 'perpod mv+budget meshed != host oracle'\n"
+        "# ISSUE 20 rung-2 twin: gang x zonal-spread stays on device (one\n"
+        "# vg evaluation per rank block inside the gang kernel) with zero\n"
+        "# gang_constraints fallbacks, host-oracle identical; the zonal\n"
+        "# singles in the same solve keep dp-speculating via kscan\n"
+        "from karpenter_tpu.gang import make_gang_pods\n"
+        "from karpenter_tpu.models import labels as l\n"
+        "from karpenter_tpu.models.pod import TopologySpreadConstraint\n"
+        "from karpenter_tpu.utils.metrics import SOLVER_FALLBACK\n"
+        "gfall0 = SOLVER_FALLBACK.get(reason='gang_constraints')\n"
+        "gang = make_gang_pods('bgz', 6, cpu=1.0)\n"
+        "for p in gang:\n"
+        "    p.metadata.labels = dict(p.metadata.labels or {}, spread='bgz')\n"
+        "    p.spec.topology_spread_constraints = [TopologySpreadConstraint(\n"
+        "        max_skew=1, topology_key=l.LABEL_TOPOLOGY_ZONE,\n"
+        "        label_selector={'spread': 'bgz'})]\n"
+        "os.environ['KTPU_PIPELINE_MIN_PODS'] = '64'\n"
+        "gpods = gang + zonal_pods(192, kinds=8, prefix='bgz')\n"
+        f"gsched = TPUScheduler(make_templates({n_types}), pod_pad=256, mesh=make_mesh())\n"
+        "gr = gsched.solve(gpods)\n"
+        "gang_fallbacks = int(SOLVER_FALLBACK.get(reason='gang_constraints') - gfall0)\n"
+        "assert gang_fallbacks == 0, 'gang+zonal raised _GangHostRoute'\n"
+        f"ghost, _ = host_solve(make_templates({n_types}), gpods)\n"
+        "assert gr.assignments == ghost.assignments, 'gang+zonal meshed != host oracle'\n"
         "fam_committed = {}\n"
         "for fam in ('fill', 'existing', 'topo_fill', 'kscan', 'perpod'):\n"
         "    fam_committed[fam] = SHARD_MERGE_ROUNDS.get(outcome='committed', family=fam)\n"
         "for fam in ('existing', 'topo_fill', 'perpod'):\n"
         "    assert fam_committed[fam] > 0, f'{fam} family never committed a dp merge round'\n"
         "# per-family routing coverage across every meshed solve above —\n"
-        "# the measured speculation coverage --report-shard prints\n"
+        "# the measured speculation coverage --report-shard prints.\n"
+        "# sum(), not get(): sequential increments carry a reason label\n"
+        "# naming the failed conjunct, so the exact-key get() misses them\n"
         "from karpenter_tpu.utils.metrics import SHARD_FAMILY_ELIGIBLE\n"
-        "coverage = {f: {'dp': int(SHARD_FAMILY_ELIGIBLE.get(family=f, path='dp')),\n"
-        "                'sequential': int(SHARD_FAMILY_ELIGIBLE.get(family=f, path='sequential'))}\n"
-        "            for f in ('fill', 'existing', 'topo_fill', 'kscan', 'perpod')}\n"
+        "coverage = {f: {'dp': int(SHARD_FAMILY_ELIGIBLE.sum(family=f, path='dp')),\n"
+        "                'sequential': int(SHARD_FAMILY_ELIGIBLE.sum(family=f, path='sequential'))}\n"
+        "            for f in ('fill', 'existing', 'topo_fill', 'kscan', 'perpod', 'gang')}\n"
         "print(json.dumps({'wall_s': round(wall, 4),\n"
         "                  'pods_per_sec': round(len(pods) / wall, 1),\n"
         "                  'nodes': r.node_count,\n"
         "                  'parity_vs_single_device': True,\n"
         "                  'kscan_merge_rounds_total': kscan_rounds,\n"
         "                  'family_committed': fam_committed,\n"
+        "                  'budget_committed_rounds': budget_committed,\n"
+        "                  'gang_fallbacks': gang_fallbacks,\n"
         "                  'coverage': coverage,\n"
         "                  'shard': sched.last_timings.get('shard'),\n"
         "                  'waterfall': _wf_digest(sched.last_timings),\n"
@@ -1100,6 +1164,16 @@ def run_shard_stage(n_pods=8192, n_types=200, max_claims=2048):
     rec = json.loads(out.stdout.strip().splitlines()[-1])
     rec["pods"] = n_pods
     rec["types"] = n_types
+    # per-family dp coverage fraction on the record (ISSUE 20 satellite):
+    # bench_diff ratchets a >=0.05 DECREASE as a regression. Zero-routed
+    # families are skipped, not recorded as 0 — a family the run never
+    # routed has no coverage to regress
+    cov = rec.get("coverage") or {}
+    rec["coverage_fraction"] = {
+        f: round(v["dp"] / (v["dp"] + v["sequential"]), 4)
+        for f, v in cov.items()
+        if v["dp"] + v["sequential"] > 0
+    }
     return rec
 
 
@@ -1508,7 +1582,12 @@ def _print_shard_report(detail: dict) -> None:
             parts = []
             for f, v in sorted(cov.items()):
                 total = v["dp"] + v["sequential"]
-                if not v["dp"]:
+                if not total:
+                    # the run never routed this family at all — an em
+                    # dash, not 0/0 (nan); bench_diff's coverage ratchet
+                    # skips it too (ISSUE 20 satellite)
+                    parts.append(f"{f}=—")
+                elif not v["dp"]:
                     parts.append(f"{f}=dp:0/seq:{v['sequential']}")
                 else:
                     parts.append(

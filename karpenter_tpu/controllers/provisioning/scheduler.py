@@ -59,9 +59,11 @@ class DivergenceError(RuntimeError):
 
 class _GangHostRoute(RuntimeError):
     """A gang solve hit a constraint family the device gang kernel does
-    not cover (finite budgets, reservations, enforced minValues, or a
-    gang kind with topology interaction); the solve degrades to the host
-    oracle, which implements the identical all-or-nothing semantics."""
+    not cover (reservations, enforced minValues, multi-key/wide vg
+    groups, hostname affinity, or a tripped "gang" quarantine — finite
+    budgets and single-key gang topology now run on device, ISSUE 20);
+    the solve degrades to the host oracle, which implements the
+    identical all-or-nothing semantics."""
 
 
 # NO_ROOM is a device-shape artifact with no reference analog: the Go
@@ -974,23 +976,15 @@ class TPUScheduler:
         norm_vol = normalize_volume_reqs(volume_reqs)
         now_fn = now if now is not None else _time.monotonic
         self._chunk_sink = chunk_sink
+        # set by _encode when a solve dispatches the constraint-bearing
+        # gang class on device (gang × topology / finite budgets) — the
+        # guarded "gang" fast path, shadow-audited against the host oracle
+        self._gang_device_class = False
 
-        def host_solve(reason: str) -> SchedulingResult:
-            from karpenter_tpu.tracing.tracer import TRACER
-            from karpenter_tpu.utils.metrics import SOLVER_FALLBACK, SOLVER_HOST_FALLBACKS
-
-            # a host-oracle result has no device state to go resident on
-            self._captured = None
-            self._last_fallback = reason  # round-ledger: why we degraded
-            if chunk_sink is not None:
-                # any streamed chunks came from an abandoned device round;
-                # the consumer must discard them before the full result
-                chunk_sink(("reset", None))
-            SOLVER_HOST_FALLBACKS.inc(reason=reason)
-            SOLVER_FALLBACK.inc(reason=reason)
-            cur = TRACER.current()
-            if cur is not None:
-                cur.set(host_fallback=reason)
+        def host_twin() -> SchedulingResult:
+            # the bare host-oracle solve on the identical problem: the
+            # fallback rungs AND the "gang" shadow audit share it (the
+            # audit must not count as a fallback or reset stream state)
             host = HostScheduler(
                 self.templates,
                 existing_nodes=[n.clone() for n in (existing_nodes or [])],
@@ -1009,6 +1003,24 @@ class TPUScheduler:
                 now=now_fn,
             )
             return host.solve(list(pods))
+
+        def host_solve(reason: str) -> SchedulingResult:
+            from karpenter_tpu.tracing.tracer import TRACER
+            from karpenter_tpu.utils.metrics import SOLVER_FALLBACK, SOLVER_HOST_FALLBACKS
+
+            # a host-oracle result has no device state to go resident on
+            self._captured = None
+            self._last_fallback = reason  # round-ledger: why we degraded
+            if chunk_sink is not None:
+                # any streamed chunks came from an abandoned device round;
+                # the consumer must discard them before the full result
+                chunk_sink(("reset", None))
+            SOLVER_HOST_FALLBACKS.inc(reason=reason)
+            SOLVER_FALLBACK.inc(reason=reason)
+            cur = TRACER.current()
+            if cur is not None:
+                cur.set(host_fallback=reason)
+            return host_twin()
 
         if dra_problem is not None and any(p.spec.resource_claims for p in pods):
             # DRA pods need the device-allocation DFS — deep, data-dependent
@@ -1109,12 +1121,18 @@ class TPUScheduler:
         if reserved_mode is not None:
             self.reserved_mode = reserved_mode
         try:
-            return prefs.run_with_relaxation(list(pods), solve_round, should_stop)
+            result = prefs.run_with_relaxation(list(pods), solve_round, should_stop)
+            if self._gang_device_class and (
+                guard_config.lying("gang") or guard_config.should_audit("gang")
+            ):
+                result = self._audit_gang_solve(result, host_twin)
+            return result
         except _GangHostRoute:
             # gangs + a constraint family the device gang kernel does not
-            # cover (finite budgets, reservations, enforced minValues, or
-            # gang topology interaction): the host oracle implements the
-            # identical all-or-nothing semantics exactly
+            # cover (reservations, enforced minValues, multi-key vg
+            # groups, hostname affinity, or a tripped "gang" quarantine):
+            # the host oracle implements the identical all-or-nothing
+            # semantics exactly
             return host_solve("gang_constraints")
         except DivergenceError:
             # the reference never aborts a Solve — a device/host decode
@@ -2095,26 +2113,66 @@ class TPUScheduler:
                     and not vgr_np[u].any()
                     and not (hga_np[u] & empty_aff).any()
                 )
-        # gang kinds ride the gang-atomic kernel only; its routing
-        # preconditions are the fill kernel's (no enforced minValues, no
-        # reservations, no finite budgets) plus zero topology interaction
-        # — anything else degrades the whole solve to the host oracle,
-        # which implements identical all-or-nothing semantics exactly
+        # gang kinds ride the gang-atomic kernel only. Since ISSUE 20
+        # rung 2 the routed class covers finite budgets (per-block
+        # subtractMax debits), vocab-key topology whose applying/recording
+        # groups unify to ONE narrow key per gang kind (the rank-block
+        # loop runs the kscan _vg_eval narrowing), and hostname-SPREAD
+        # groups (hg_evaluate at each block's fresh slot). Enforced
+        # minValues, reservations, hostname affinity/anti-affinity, and
+        # non-unifiable vg keys still degrade the whole solve to the host
+        # oracle, which implements identical all-or-nothing semantics
+        # exactly. gang_vg_key[u] is the kind's unified key (-1 = no vg
+        # interaction); same-key gang runs dispatch together.
         gang_kind = np.zeros(U, dtype=bool)
         for k in gang_key_of_kind:
             gang_kind[k] = True
+        gang_vg_key = np.full(U, -1, dtype=np.int64)
         if gang_bounds:
-            gk = np.flatnonzero(gang_kind)
-            topo_touch = bool(
-                vga_np[gk].any()
-                or vgr_np[gk].any()
-                or hga_np[gk].any()
-                or hgr_np[gk].any()
+            mv_block = self._mv_active and self.min_values_policy != "BestEffort"
+            vkeys_all = [self.encoder.vocab.key_to_id[g.key] for g in vg]
+            host_why = None
+            if mv_block:
+                host_why = "gang under enforced minValues"
+            elif self._res_active:
+                host_why = "gang under reservations"
+            for u in np.flatnonzero(gang_kind):
+                if host_why:
+                    break
+                js = [
+                    j
+                    for j in range(len(vg))
+                    if vga_np[u, j] or vgr_np[u, j]
+                ]
+                keys = {vkeys_all[j] for j in js}
+                if len(keys) > 1:
+                    host_why = "gang vg groups span multiple vocab keys"
+                elif keys:
+                    kid_ = next(iter(keys))
+                    if len(self.encoder.vocab.values[kid_]) > ops_solver.KSCAN_D:
+                        host_why = "gang vg key wider than KSCAN_D"
+                    else:
+                        gang_vg_key[u] = kid_
+                for j in np.flatnonzero(hga_np[u] | hgr_np[u]):
+                    if hg[j].type is not TopologyType.SPREAD:
+                        host_why = "gang hostname affinity/anti-affinity"
+                        break
+            # the constraint-bearing device class (gang × vg topology /
+            # hostname-spread / finite budgets) is guarded: a tripped
+            # "gang" quarantine routes it back onto the host oracle (its
+            # exact twin) until TTL expiry; the legacy topology-free
+            # infinite-budget class predates the guard and stays
+            new_class = bool(
+                (gang_vg_key >= 0).any()
+                or (hga_np[gang_kind] | hgr_np[gang_kind]).any()
+                or any(v for v in self.budgets.values())
             )
-            if not allow_fill or topo_touch:
-                raise _GangHostRoute(
-                    "gang solve outside the device kernel's constraint family"
-                )
+            if not host_why and new_class:
+                self._gang_device_class = True
+                if QUARANTINE.active("gang"):
+                    host_why = "gang device path quarantined"
+            if host_why:
+                raise _GangHostRoute(host_why)
         batchable[gang_kind] = False
         # vg-topology kinds whose every applying/recording group shares ONE
         # narrow vocab key ride the same-kind batched scan instead of the
@@ -2166,6 +2224,7 @@ class TPUScheduler:
             batchable=batchable,
             kscan_key=kscan_key,
             gang_kind=gang_kind,
+            gang_vg_key=gang_vg_key,
             gang_key_of_kind=gang_key_of_kind,
             pre_unsched=pre_unsched,
             kind_records=kind_records,
@@ -2337,11 +2396,14 @@ class TPUScheduler:
         # kernel argument)
         kscan_key = enc["kscan_key"]
         gang_kind = enc["gang_kind"]
+        gang_vg_key = enc["gang_vg_key"]
 
         def _seg_mode(seg):
             k = seg[2]
             if gang_kind[k]:
-                return ("gang",)
+                # gang runs additionally split per unified vg key (-1 =
+                # no vg interaction) — the key is a static kernel argument
+                return ("gang", int(gang_vg_key[k]))
             if batchable[k]:
                 return ("fill",)
             if kscan_key[k] >= 0:
@@ -2508,15 +2570,29 @@ class TPUScheduler:
         dp_n = 1
         if self.mesh is not None:
             dp_n = int(dict(self.mesh.shape).get("dp", 1))
-        dp_eligible = bool(
-            K_pipe
-            and dp_n > 1
-            and self.shard_dp
+
+        def _dp_block_reason(family_flag: bool, optout: str) -> str:
+            """Name the first failed dp-eligibility conjunct ("" when
+            eligible) — the `reason` label on sequential-path routing
+            increments, so the coverage matrix is self-describing."""
+            if not K_pipe:
+                return "no_pipeline"
+            if dp_n <= 1:
+                return "no_dp_mesh"
+            if not self.shard_dp:
+                return "shard_dp_off"
+            if not family_flag:
+                return optout
             # a quarantined speculative path runs every group sequentially
             # (the exact twin) until the breaker's TTL expires
-            and not QUARANTINE.active("speculative")
-            and (self.shard_existing or not self.existing_nodes)
-        )
+            if QUARANTINE.active("speculative"):
+                return "quarantined"
+            if not (self.shard_existing or not self.existing_nodes):
+                return "existing_optout"
+            return ""
+
+        fill_block_reason = _dp_block_reason(True, "")
+        dp_eligible = not fill_block_reason
         if dp_eligible:
             merged_runs: list = []
             i = 0
@@ -2541,14 +2617,8 @@ class TPUScheduler:
         # because the verdict proves count independence per round and the
         # merge re-bases recorded deltas. Runs split into chunk groups of
         # whole segments by the same pod target the fill pipeline uses.
-        kscan_dp_eligible = bool(
-            K_pipe
-            and dp_n > 1
-            and self.shard_dp
-            and self.shard_kscan
-            and not QUARANTINE.active("speculative")
-            and (self.shard_existing or not self.existing_nodes)
-        )
+        kscan_block_reason = _dp_block_reason(self.shard_kscan, "kscan_optout")
+        kscan_dp_eligible = not kscan_block_reason
         if kscan_dp_eligible:
             split_k: list = []
             for mode, segs in runs:
@@ -2577,23 +2647,16 @@ class TPUScheduler:
                     split_k.append((mode, segs))
             runs = split_k
         # ---- dp-sharded speculative per-pod runs (ISSUE 14c) -------------
-        # The per-pod engine mutates exactly the ShardKscanState slice on
-        # the fill-routable constraint family (no enforced minValues, no
-        # reservations, infinite budgets — budget adds are identity at
-        # +inf), so consecutive solve_chunk chunks speculate one-per-dp-row
-        # under the same verdict contract (solve_perpod_dp) and merge
-        # through merge_shard_kscan. KTPU_SHARD_PERPOD=0 opts out.
-        perpod_dp_eligible = bool(
-            K_pipe
-            and dp_n > 1
-            and self.shard_dp
-            and self.shard_perpod
-            and not QUARANTINE.active("speculative")
-            and (self.shard_existing or not self.existing_nodes)
-            and not common["mv_active"]
-            and not common["res_active"]
-            and not any(v for v in self.budgets.values())
-        )
+        # The per-pod engine mutates exactly the ShardKscanState slice —
+        # including the budget/nodes_budget debits and reservation
+        # capacities, which ride the slice as order-free deltas guarded by
+        # the budget/reservation disjointness verdict bit — so consecutive
+        # solve_chunk chunks speculate one-per-dp-row under the same
+        # verdict contract (solve_perpod_dp) and merge through
+        # merge_shard_kscan even with enforced minValues, reservations, or
+        # finite disruption budgets. KTPU_SHARD_PERPOD=0 opts out.
+        perpod_block_reason = _dp_block_reason(self.shard_perpod, "perpod_optout")
+        perpod_dp_eligible = not perpod_block_reason
 
         outputs: list[tuple] = []
         tmpl_snaps: list = []  # post-dispatch GLOBAL template snapshot per
@@ -2607,7 +2670,12 @@ class TPUScheduler:
                 _t_run0 = _time.perf_counter()
             if mode[0] == "gang":
                 # gang-atomic slice placement: one scan segment per gang,
-                # pods in rank order; padded rows carry count=0 (no-ops)
+                # pods in rank order; padded rows carry count=0 (no-ops).
+                # mode[1] is the run's unified vg key (-1 = no vg
+                # interaction); gang segments stay out of the dp fan-out
+                # (each gang is one sequential all-or-nothing dispatch)
+                gkey = mode[1]
+                self._shard_eligible("gang", "sequential", reason="gang_atomic")
                 B = len(segs)
                 B_pad = self._pad_cache.pad("gang_segments", B, step=8)
                 kind_ids = np.zeros(B_pad, dtype=np.int64)
@@ -2618,17 +2686,25 @@ class TPUScheduler:
                 # hosts-per-slice static bound: a gang of N pods never
                 # opens more than N claims
                 maxg = self._pad_cache.pad("gang_cap", int(counts.max()), step=8)
-                xs = _gather_fill_xs(
-                    enc["reqs_k"], enc["requests_k"], enc["tol_k"],
-                    enc["it_allow_k"], enc["exist_ok_k"], enc["ports_k"],
-                    enc["conf_k"], enc["vols_k"], enc["pod_topo_k"],
-                    jnp.asarray(kind_ids), jnp.asarray(counts),
+                xs = _gather_kind_xs(
+                    enc["reqs_k"], enc["strict_k"], enc["requests_k"],
+                    enc["tol_k"], enc["it_allow_k"], enc["exist_ok_k"],
+                    enc["ports_k"], enc["conf_k"], enc["vols_k"],
+                    enc["pod_topo_k"], jnp.asarray(kind_ids),
+                    jnp.asarray(counts),
                 )
+                gang_kw = dict(key_kid=-1, n_domains=1, tk_idx=-1)
+                if gkey >= 0:
+                    gang_kw = dict(
+                        key_kid=gkey,
+                        n_domains=len(self.encoder.vocab.values[gkey]),
+                        tk_idx=enc["topo_kids"].index(gkey),
+                    )
                 state, ys = ops_solver.solve_gang(
                     state, xs, exist_tensors, self.it_tensors, template_tensors,
                     self.well_known, topo_tensors,
                     zone_kid=enc["zone_kid"], ct_kid=enc["ct_kid"],
-                    n_claims=n_claims, maxg=maxg,
+                    n_claims=n_claims, maxg=maxg, **gang_kw,
                 )
                 outputs.append(("gang", segs, ys))
                 tmpl_snaps.append(ops_solver.global_template(state))
@@ -2646,7 +2722,8 @@ class TPUScheduler:
                     )
                 else:
                     self._shard_eligible(
-                        self._fill_family(enc, segs), "sequential"
+                        self._fill_family(enc, segs), "sequential",
+                        reason=fill_block_reason or "single_group",
                     )
                     state, ys = _dispatch_fill(state, segs)
                     # fill grids address WINDOW rows; the decode maps them
@@ -2675,7 +2752,10 @@ class TPUScheduler:
                         _maybe_compact, _dispatch_fill,
                     )
             elif mode[0] == "kscan":
-                self._shard_eligible("kscan", "sequential")
+                self._shard_eligible(
+                    "kscan", "sequential",
+                    reason=kscan_block_reason or "single_group",
+                )
                 state, ys = _dispatch_kscan(state, segs, mode[1])
                 outputs.append(("kscan", segs, ys))
                 tmpl_snaps.append(ops_solver.global_template(state))
@@ -2707,7 +2787,10 @@ class TPUScheduler:
                 else:
                     for clo, chi in chunks:
                         L = chi - clo
-                        self._shard_eligible("perpod", "sequential")
+                        self._shard_eligible(
+                            "perpod", "sequential",
+                            reason=perpod_block_reason or "single_chunk",
+                        )
                         # multiple-of-8 bucket instead of pow2: a 1100-pod
                         # remainder chunk pads to 1104 rows, not 2048
                         L_pad = self._pad_cache.pad("perpod_pods", L, step=8)
@@ -3444,15 +3527,17 @@ class TPUScheduler:
             return "topo_fill"
         return "fill"
 
-    def _shard_eligible(self, family: str, path: str):
+    def _shard_eligible(self, family: str, path: str, reason: str = ""):
         """Per-chunk-group routing accounting: `path` is "dp" when the
         group entered a speculative fan-out round (commit or replay),
-        "sequential" when it stayed on the plain ordered scan. Feeds the
+        "sequential" when it stayed on the plain ordered scan; `reason`
+        names the first failed eligibility conjunct on sequential
+        increments ("" on the dp path). Feeds the
         ktpu_shard_family_eligible_total counter and the bench
         --report-shard coverage fractions."""
         from karpenter_tpu.utils.metrics import SHARD_FAMILY_ELIGIBLE
 
-        SHARD_FAMILY_ELIGIBLE.inc(family=family, path=path)
+        SHARD_FAMILY_ELIGIBLE.inc(family=family, path=path, reason=reason)
         stats = self._shard_stats
         if stats is not None:
             cov = stats.setdefault("coverage", {}).setdefault(
@@ -3561,6 +3646,36 @@ class TPUScheduler:
             detail={"segments": len(segs), "family": family},
         )
         return state_seq, seq_out_fn(state_seq, ys_seq)
+
+    def _audit_gang_solve(self, result, host_twin):
+        """Shadow audit of the device gang kernel's constraint-bearing
+        class (gang × topology / finite budgets — the rungs that used to
+        raise _GangHostRoute): the host oracle on the identical problem
+        is the exact twin, compared over the full canonical result
+        signature. On divergence the host result is the one returned and
+        the "gang" quarantine routes the class back to the oracle."""
+        if guard_config.lying("gang") and result.assignments:
+            # seeded lying-fast-path fixture: GENUINELY corrupt the device
+            # result — only this shadow audit stands between it and the
+            # caller (the property under test)
+            uid = min(result.assignments)
+            result.assignments[uid] = result.assignments[uid] + 1
+        href = host_twin()
+        if guard_audit.result_signature(result) == guard_audit.result_signature(
+            href
+        ):
+            guard_audit.record_audit("gang", "pass")
+            return result
+        pods_by_uid, rounds, existing = self._guard_problem_ctx()
+        guard_audit.handle_divergence(
+            "gang",
+            "device gang solve != host oracle",
+            self,
+            pods_by_uid,
+            rounds,
+            existing,
+        )
+        return href
 
     def _pipeline_target(self, enc: dict) -> int:
         """Chunk-group count for the software pipeline; 0 disables (small

@@ -45,6 +45,13 @@ def backend_signature() -> dict:
 
 def _env_snapshot() -> dict:
     keep = {k: v for k, v in os.environ.items() if k.startswith("KTPU_")}
+    # the shard family opt-out knobs are snapshotted even when UNSET:
+    # replay must reproduce the dp-vs-sequential routing decision, and
+    # "unset" (dp-eligible, the default) is itself a routing input — a
+    # replay host where one happens to be exported would route the
+    # family differently and never reach the diverging merge
+    for knob in ("KTPU_SHARD_EXISTING", "KTPU_SHARD_PERPOD", "KTPU_SHARD_KSCAN"):
+        keep.setdefault(knob, "")
     if os.environ.get("XLA_FLAGS"):
         keep["XLA_FLAGS"] = os.environ["XLA_FLAGS"]
     if os.environ.get("JAX_PLATFORMS"):

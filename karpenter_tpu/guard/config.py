@@ -37,8 +37,10 @@ ENV_WATCHDOG = "KTPU_WATCHDOG_S"
 
 #: the guarded fast paths (quarantine keys / audit metric labels);
 #: "objective" quarantines the placement-objective scorer back onto the
-#: lexical policy (objectives/registry.py active_policy)
-PATHS = ("resident", "speculative", "grid", "encode_cache", "objective")
+#: lexical policy (objectives/registry.py active_policy); "gang"
+#: quarantines the device gang kernel's constraint-bearing class (gang ×
+#: topology / finite budgets) back onto the host oracle (_GangHostRoute)
+PATHS = ("resident", "speculative", "grid", "encode_cache", "objective", "gang")
 
 _LOCK = threading.Lock()
 _RNG: Optional[random.Random] = None

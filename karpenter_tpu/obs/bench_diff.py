@@ -30,6 +30,12 @@ ENV_THRESHOLD = "KTPU_BENCH_DIFF_THRESHOLD"
 # absolute regression floor: relative noise on microsecond segments is
 # meaningless — a regression must also cost real wall
 MIN_ABS_S = 0.005
+# dp coverage ratchet (ISSUE 20): a per-family coverage fraction under a
+# stage's "coverage_fraction" key that DROPS by at least this much is a
+# regression — a family silently sliding off the dp path costs the
+# speculation win without touching any timing leaf. Families absent
+# from either document (zero-routed runs) are structural notes only.
+COVERAGE_DROP = 0.05
 
 
 def threshold_default() -> float:
@@ -56,6 +62,25 @@ def _timing_leaves(doc, prefix: str = ""):
             yield from _timing_leaves(v, f"{prefix}[{i}]")
 
 
+def _coverage_leaves(doc, prefix: str = ""):
+    """Yield (path, fraction) for every per-family dp coverage fraction —
+    the {family: dp/(dp+sequential)} maps bench stages record under a
+    "coverage_fraction" key (zero-routed families are never written)."""
+    if not isinstance(doc, dict):
+        if isinstance(doc, list):
+            for i, v in enumerate(doc):
+                yield from _coverage_leaves(v, f"{prefix}[{i}]")
+        return
+    for k, v in doc.items():
+        path = f"{prefix}.{k}" if prefix else str(k)
+        if k == "coverage_fraction" and isinstance(v, dict):
+            for fam, frac in v.items():
+                if isinstance(frac, (int, float)) and not isinstance(frac, bool):
+                    yield f"{path}.{fam}", float(frac)
+        elif isinstance(v, (dict, list)):
+            yield from _coverage_leaves(v, path)
+
+
 def diff_docs(
     a: dict, b: dict,
     threshold: Optional[float] = None,
@@ -64,8 +89,10 @@ def diff_docs(
     """Compare every shared timing leaf of two bench documents.
 
     Returns {"rows": [...], "regressions": [...], "only_a": [...],
-    "only_b": [...]}; a row regresses iff b > a*(1+threshold) and
-    (b - a) > min_abs."""
+    "only_b": [...]}; a timing row regresses iff b > a*(1+threshold) and
+    (b - a) > min_abs. Coverage rows (per-family dp coverage fractions)
+    ratchet the other way: a fraction DECREASE >= COVERAGE_DROP
+    regresses — more time is fine, less speculation coverage is not."""
     thr = threshold_default() if threshold is None else threshold
     av = dict(_timing_leaves(a))
     bv = dict(_timing_leaves(b))
@@ -84,13 +111,27 @@ def diff_docs(
             "ratio": round(ratio, 4) if ratio != float("inf") else ratio,
             "regressed": bool(y > x * (1.0 + thr) and (y - x) > min_abs),
         })
+    ca = dict(_coverage_leaves(a))
+    cb = dict(_coverage_leaves(b))
+    coverage_rows = []
+    for path in sorted(set(ca) & set(cb)):
+        x, y = ca[path], cb[path]
+        coverage_rows.append({
+            "path": path,
+            "a_frac": x,
+            "b_frac": y,
+            "delta": round(y - x, 4),
+            "regressed": bool(x - y >= COVERAGE_DROP),
+        })
     return {
         "threshold": thr,
         "min_abs_s": min_abs,
         "rows": rows,
-        "regressions": [r for r in rows if r["regressed"]],
-        "only_a": sorted(set(av) - set(bv)),
-        "only_b": sorted(set(bv) - set(av)),
+        "coverage_rows": coverage_rows,
+        "regressions": [r for r in rows if r["regressed"]]
+        + [r for r in coverage_rows if r["regressed"]],
+        "only_a": sorted(set(av) - set(bv)) + sorted(set(ca) - set(cb)),
+        "only_b": sorted(set(bv) - set(av)) + sorted(set(cb) - set(ca)),
     }
 
 
@@ -103,10 +144,17 @@ def format_report(diff: dict, a_name: str = "A", b_name: str = "B") -> list:
         f"threshold={diff['threshold']:.0%} (+{diff['min_abs_s'] * 1e3:.0f}ms floor)"
     ]
     for r in regs:
-        lines.append(
-            f"  REGRESSED {r['path']}: {r['a_s']:.4f}s -> {r['b_s']:.4f}s "
-            f"({r['ratio']:.2f}x, +{r['delta_s']:.4f}s)"
-        )
+        if "a_frac" in r:
+            lines.append(
+                f"  REGRESSED {r['path']}: dp coverage "
+                f"{r['a_frac']:.2f} -> {r['b_frac']:.2f} "
+                f"({r['delta']:+.2f}; drop >= {COVERAGE_DROP:.2f})"
+            )
+        else:
+            lines.append(
+                f"  REGRESSED {r['path']}: {r['a_s']:.4f}s -> {r['b_s']:.4f}s "
+                f"({r['ratio']:.2f}x, +{r['delta_s']:.4f}s)"
+            )
     for path in diff["only_a"]:
         lines.append(f"  note: only in {a_name}: {path}")
     for path in diff["only_b"]:

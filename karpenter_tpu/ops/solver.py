@@ -2332,12 +2332,19 @@ def merge_shard_fill(
 #
 # Spill semantics reuse the existing recovery ladder: a claims-axis-bound
 # refusal is NO_ROOM (solve_round escalates the axis and re-solves); a
-# refusal no escalation can fix (finite node budget below the slice size)
-# is GANG_SPILL — the host reports every member together and keeps the
-# gang pending. The host routes gang solves here only when the fill
-# preconditions hold AND the gang kind has no topology interaction
-# (vg/hg applies+records all false); anything else degrades to the host
-# oracle, which implements the identical semantics exactly.
+# refusal no escalation can fix (finite node budget below the slice size,
+# or a rank block refused by topology/capacity under narrowing) is
+# GANG_SPILL — the host reports every member together and keeps the gang
+# pending. Since ISSUE 20 rung 2 the routed class covers finite budgets
+# (per-block subtractMax debits over the block's narrowed remaining
+# types), vocab-key topology whose groups unify to ONE key with
+# <= KSCAN_D values (the kscan _vg_eval narrowing runs once per rank
+# block — counts are fixed within a block because the host records after
+# the block's add loop), and hostname-group interaction (hg_evaluate at
+# each block's fresh slot, commits scaled by the block's pod count).
+# Only enforced minValues, reservations, and non-unifiable vg keys still
+# degrade the solve to the host oracle, which implements the identical
+# semantics exactly.
 
 
 class GangYs(NamedTuple):
@@ -2361,18 +2368,35 @@ def _make_gang_step(
     ct_kid: int,
     n_claims: int,
     maxg: int,
+    key_kid: int = -1,
+    D: int = 1,
+    tk_idx: int = -1,
 ):
     NCAP = n_claims
     G = templates.its.shape[0]
+    T = templates.its.shape[1]
     E = exist.avail.shape[0]
     i32 = jnp.int32
+    has_key = key_kid >= 0
 
-    def step(state: SolverState, xs: FillXs):
+    def step(state: SolverState, xs: KindXs):
         count = xs.count
         requests = xs.requests
+        R = requests.shape[0]
         self_conf = kernels.packed_conflict(xs.ports, xs.port_conf)
+        gate = xs.vg_applies & topo.vg_valid
+        recs = xs.vg_records & topo.vg_valid
+        rec_h = xs.hg_records & topo.hg_valid
+        key_touched = jnp.any(gate)
+        is_anti = topo.vg_type == topo_ops.TYPE_ANTI
 
-        # slice template selection — the fill step's tier 3 verbatim
+        # slice template selection — the fill step's tier 3 verbatim. The
+        # host's chosen-template loop never consults topology (counts or
+        # hostname groups): a template whose blocks later fail on topology
+        # spills the gang rather than falling through to the next template
+        # (ISSUE 20 rung 2 matches that exactly, so the pre-rung
+        # cap_topo_fresh clamp — vacuous on the then-routed class — is
+        # gone).
         pod_g = _broadcast_pod(xs.reqs, G)
         comb0 = kernels.intersect_sets(templates.reqs, pod_g)
         tmpl_compat = kernels.compatible_elemwise(templates.reqs, pod_g, well_known)
@@ -2403,14 +2427,6 @@ def _make_gang_step(
         )
         cap_ok = jnp.all(it.cap[None, :, :] <= state.budget[:, None, :], axis=-1)
         its0 = templates.its & it_compat0 & fits_off0 & xs.it_allow[None, :] & cap_ok
-        cap_topo_fresh = _hg_slot_caps(
-            topo,
-            state.hg_counts,
-            jnp.broadcast_to(E + state.n_open, (1,)).astype(i32),
-            xs.hg_applies,
-            xs.hg_records,
-            xs.hg_self,
-        )[0]
         tmpl_feas = (
             templates.valid
             & tmpl_compat
@@ -2419,70 +2435,209 @@ def _make_gang_step(
             & (state.nodes_budget >= 1.0)
         )
         g = _pick_template(tmpl_feas, templates)
-        any_t = jnp.any(tmpl_feas) & (count > 0) & (cap_topo_fresh > 0)
+        any_t = jnp.any(tmpl_feas) & (count > 0)
 
         # slice shape: per-host capacity f, hosts want = ceil(size / f)
         f0 = _claim_fill_caps(templates.daemon_requests, its0, requests, it, off_g)[g]
-        f = jnp.minimum(f0, cap_topo_fresh)
-        f = jnp.where(self_conf, jnp.minimum(f, 1), f)
+        f = jnp.where(self_conf, jnp.minimum(f0, 1), f0)
         f = jnp.where(any_t, jnp.maximum(f, 0), 0)
         want = jnp.where(f > 0, (count + f - 1) // jnp.maximum(f, 1), 0)
         avail_cap = jnp.maximum(NCAP - state.n_open, 0)
         budget_ok = state.nodes_budget[g] >= want.astype(jnp.float32)
         shaped = any_t & (f > 0)
-        can = shaped & (want <= avail_cap) & budget_ok
-        # NO_ROOM = axis-bound (the host escalates n_claims and re-solves);
-        # GANG_SPILL = a constraint no escalation fixes (node budget)
-        status = jnp.where(
-            shaped & ~budget_ok,
-            i32(GANG_SPILL),
-            jnp.where(shaped, i32(NO_ROOM), i32(NO_CLAIM)),
-        )
+        try_place = shaped & (want <= avail_cap) & budget_ok
 
-        # atomic commit: rank block j -> global claim id n_open + j,
-        # written STRAIGHT into the frozen bank (dedicated + full)
         j = jnp.arange(maxg, dtype=i32)
-        active = can & (j < want)
-        gid = jnp.where(active, state.n_open + j, i32(NCAP))
         c_j = jnp.clip(count - j * f, 0, f)  # [MAXG] pods on host j
         used_j = (
             templates.daemon_requests[g][None, :]
             + c_j[:, None].astype(jnp.float32) * requests[None, :]
         )
-        off_j = jnp.broadcast_to(off_g[g][None], (maxg,) + off_g.shape[1:])
-        fits_j = jnp.any(
-            _fits_off_counted(
-                jnp.broadcast_to(
-                    templates.daemon_requests[g][None, :], (maxg, requests.shape[0])
-                ),
-                jnp.broadcast_to(c_j[:, None, None], off_j.shape),
-                requests,
-                it,
-                off_j,
-            ),
-            axis=-1,
-        )  # [MAXG, T]
-        its_j = its0[g][None, :] & fits_j
+        its0_g = its0[g]
 
-        opened = jnp.where(can, want, 0)
-        max_cap = jnp.max(jnp.where(its0[g][:, None], it.cap, -jnp.inf), axis=0)
-        max_cap = jnp.where(jnp.isfinite(max_cap), max_cap, 0.0)
+        # ---- rank-block loop (ISSUE 20 rung 2) ---------------------------
+        # The host places block j on a fresh hostname with counts FIXED
+        # within the block (records land after the block's add loop), then
+        # re-filters the budget-filtered candidate types against the
+        # narrowed requirements at the block's pod count and charges the
+        # budget per block over that remaining set. Any block failure
+        # spills the whole gang (full rollback — the all-or-nothing select
+        # below). One eval per block is exact: narrowing is idempotent and
+        # every pod of a block is content-identical.
+        if has_key:
+            pd = xs.strict_mask[key_kid, :D]
+            eval_candidates = _vg_eval(topo, gate, xs.vg_self, pd, D)
+            admit = _kscan_admit(it, key_kid, D)
+            grid_row = _cap_res_grid(
+                templates.daemon_requests[g][None], requests, it
+            )[0]  # [T, GR]
+            if key_kid == zone_kid:
+                offd = (
+                    jnp.einsum(
+                        "tgzc,c->ztg",
+                        it.zc_avail.astype(jnp.bfloat16),
+                        cmask[g, :C].astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32,
+                    )
+                    > 0
+                )[:D]  # [D, T, GR]
+            else:
+                offd = jnp.broadcast_to(off_g[g][None], (D,) + off_g[g].shape)
+            # [T, D] max pods addable per type IF the block lands in
+            # domain d (same quantifier exchange as _kscan_capd, kept
+            # per-type: the block's remaining set feeds bank_its and the
+            # per-block budget debit)
+            capTd = jnp.max(jnp.where(offd, grid_row[None], 0), axis=-1).T
+            z0 = comb0.mask[g, key_kid, :D]
+            win_zinf = comb0.inf[g, key_kid] & ~key_touched
+        else:
+            off_j = jnp.broadcast_to(off_g[g][None], (maxg,) + off_g.shape[1:])
+            fits_legacy = jnp.any(
+                _fits_off_counted(
+                    jnp.broadcast_to(
+                        templates.daemon_requests[g][None, :], (maxg, R)
+                    ),
+                    jnp.broadcast_to(c_j[:, None, None], off_j.shape),
+                    requests,
+                    it,
+                    off_j,
+                ),
+                axis=-1,
+            )  # [MAXG, T]
+
+        def block(jj, c):
+            active = try_place & (jj < want)
+            cj = jnp.clip(count - jj * f, 0, f)
+            slot = (E + state.n_open + jj).astype(i32)
+            hg_ok = topo_ops.hg_evaluate(
+                topo, c["hgc"], slot[None], xs.hg_applies, xs.hg_self
+            )[0]
+            if has_key:
+                feas, newz = eval_candidates(z0[None], c["cnt"])
+                zj = newz[0]
+                compat_j = jnp.where(
+                    key_touched, jnp.any(zj[None, :] & admit, axis=-1), True
+                )
+                fits_j = jnp.any(zj[None, :] & (capTd >= cj), axis=-1)
+                its_j = its0_g & compat_j & fits_j
+                vg_ok = feas[0]
+            else:
+                zj = jnp.zeros((D,), dtype=bool)
+                its_j = its0_g & fits_legacy[jj]
+                vg_ok = jnp.bool_(True)
+            blk_ok = vg_ok & hg_ok & jnp.any(its_j)
+            commit = active & blk_ok & c["ok"]
+            # records land AFTER the block's add loop: each of the cj
+            # content-identical pods records once against fixed counts
+            if has_key:
+                single = jnp.sum(zj) == 1
+                do = recs & ~win_zinf & (is_anti | single)
+                delta = (do[:, None] & zj[None, :]).astype(i32) * cj
+                cnt2 = jnp.where(commit, c["cnt"] + delta, c["cnt"])
+            else:
+                cnt2 = c["cnt"]
+            hgc2 = jnp.where(
+                commit,
+                c["hgc"].at[:, slot].add(
+                    jnp.where(rec_h, cj, 0).astype(c["hgc"].dtype)
+                ),
+                c["hgc"],
+            )
+            # per-block budget debit over the block's REMAINING types
+            # (subtractMax per opened claim — scheduler.go:791)
+            max_cap_j = jnp.max(
+                jnp.where(its_j[:, None], it.cap, -jnp.inf), axis=0
+            )
+            max_cap_j = jnp.where(jnp.isfinite(max_cap_j), max_cap_j, 0.0)
+            return dict(
+                cnt=cnt2,
+                hgc=hgc2,
+                ok=c["ok"] & (blk_ok | ~active),
+                its_b=c["its_b"].at[jj].set(its_j),
+                z_b=c["z_b"].at[jj].set(zj),
+                debit=jnp.where(commit, c["debit"] + max_cap_j, c["debit"]),
+            )
+
+        carry0 = dict(
+            cnt=state.vg_counts[:, :D],
+            hgc=state.hg_counts,
+            ok=jnp.bool_(True),
+            its_b=jnp.zeros((maxg, T), dtype=bool),
+            z_b=jnp.zeros((maxg, D), dtype=bool),
+            debit=jnp.zeros((R,), dtype=jnp.float32),
+        )
+        carry = jax.lax.fori_loop(0, maxg, block, carry0)
+        placed = try_place & carry["ok"]
+
+        # NO_ROOM = axis-bound (the host escalates n_claims and re-solves);
+        # GANG_SPILL = a constraint no escalation fixes (node budget, or a
+        # rank block refused by topology/capacity under narrowing)
+        status = jnp.where(
+            shaped & ~budget_ok,
+            i32(GANG_SPILL),
+            jnp.where(
+                try_place & ~carry["ok"],
+                i32(GANG_SPILL),
+                jnp.where(shaped, i32(NO_ROOM), i32(NO_CLAIM)),
+            ),
+        )
+
+        # atomic commit: rank block j -> global claim id n_open + j,
+        # written STRAIGHT into the frozen bank (dedicated + full); the
+        # narrowed key row rides the bank_tk columns so decode folds the
+        # block's domain into the claim requirements exactly like a
+        # window-retired kscan claim
+        active_rows = placed & (j < want)
+        gid = jnp.where(active_rows, state.n_open + j, i32(NCAP))
+        opened = jnp.where(placed, want, 0)
         wf = opened.astype(jnp.float32)
+        bank_extra = {}
+        if tk_idx >= 0:
+            base_mask = comb0.mask[g, key_kid]  # [V]
+            V = base_mask.shape[0]
+            tk_rows = jnp.concatenate(
+                [
+                    carry["z_b"],
+                    jnp.broadcast_to(base_mask[D:][None, :], (maxg, V - D)),
+                ],
+                axis=1,
+            )
+            def_bit = comb0.defined[g, key_kid] | key_touched
+            bank_extra = dict(
+                bank_tk_mask=state.bank_tk_mask.at[gid, tk_idx].set(
+                    tk_rows, mode="drop"
+                ),
+                bank_tk_inf=state.bank_tk_inf.at[gid, tk_idx].set(
+                    jnp.broadcast_to(win_zinf, (maxg,)), mode="drop"
+                ),
+                bank_tk_def=state.bank_tk_def.at[gid, tk_idx].set(
+                    jnp.broadcast_to(def_bit, (maxg,)), mode="drop"
+                ),
+            )
         new_state = state._replace(
             bank_frozen=state.bank_frozen.at[gid].set(True, mode="drop"),
             bank_template=state.bank_template.at[gid].set(g.astype(i32), mode="drop"),
-            bank_its=state.bank_its.at[gid].set(its_j, mode="drop"),
+            bank_its=state.bank_its.at[gid].set(carry["its_b"], mode="drop"),
             bank_used=state.bank_used.at[gid].set(used_j, mode="drop"),
             n_open=state.n_open + opened,
-            budget=state.budget.at[g].add(-max_cap * wf),
+            budget=state.budget.at[g].add(
+                -jnp.where(placed, carry["debit"], 0.0)
+            ),
             nodes_budget=state.nodes_budget.at[g].add(-wf),
+            vg_counts=jnp.where(
+                placed,
+                state.vg_counts.at[:, :D].set(carry["cnt"]),
+                state.vg_counts,
+            ),
+            hg_counts=jnp.where(placed, carry["hgc"], state.hg_counts),
+            **bank_extra,
         )
         ys = GangYs(
             open_g=state.n_open,
             n_opened=opened,
             fill=f,
-            tmpl=jnp.where(can, g.astype(i32), i32(-1)),
-            leftover=jnp.where(can, 0, count).astype(i32),
+            tmpl=jnp.where(placed, g.astype(i32), i32(-1)),
+            leftover=jnp.where(placed, 0, count).astype(i32),
             status=status,
         )
         return new_state, ys
@@ -2490,7 +2645,10 @@ def _make_gang_step(
     return step
 
 
-_GANG_STATIC = ("zone_kid", "ct_kid", "n_claims", "maxg")
+_GANG_STATIC = (
+    "zone_kid", "ct_kid", "n_claims", "maxg", "key_kid", "n_domains",
+    "tk_idx",
+)
 
 
 @_wf_timed("solve_gang")
@@ -2498,7 +2656,7 @@ _GANG_STATIC = ("zone_kid", "ct_kid", "n_claims", "maxg")
 @functools.partial(jax.jit, static_argnames=_GANG_STATIC)
 def solve_gang(
     state: SolverState,
-    xs: FillXs,
+    xs: KindXs,
     exist: ExistingNodes,
     it: InstanceTypeTensors,
     templates: Templates,
@@ -2508,13 +2666,24 @@ def solve_gang(
     ct_kid: int,
     n_claims: int,
     maxg: int,
+    key_kid: int = -1,
+    n_domains: int = 1,
+    tk_idx: int = -1,
 ) -> tuple[SolverState, GangYs]:
     """Scan gang-atomic slice placement over B gang segments (one segment
     per gang, pods in rank order), threading the same SolverState as the
     other dispatch kernels. `maxg` statically bounds hosts-per-slice
-    (a gang of N pods never needs more than N hosts)."""
+    (a gang of N pods never needs more than N hosts). `key_kid`/
+    `n_domains` name the ONE narrow vocab key the gang kinds' vg groups
+    share (-1 = no vg interaction — the scheduler host-routes gangs whose
+    keys don't unify), and `tk_idx` is that key's row in the bank's
+    topo_kids columns so committed blocks persist their narrowed domain
+    for decode. Hostname-group (spread) interaction needs no static: the
+    rank-block loop evaluates and commits hg counts at each block's fresh
+    slot, scaled by the block's pod count."""
     step = _make_gang_step(
-        exist, it, templates, well_known, topo, zone_kid, ct_kid, n_claims, maxg
+        exist, it, templates, well_known, topo, zone_kid, ct_kid, n_claims,
+        maxg, key_kid, n_domains, tk_idx,
     )
     return jax.lax.scan(step, state, xs)
 
@@ -2710,6 +2879,76 @@ def _kscan_fits_final(
     return jnp.any(fits & off, axis=-1)
 
 
+def _vg_eval(topo: TopologyTensors, gate, selfs, pd, D: int):
+    """Factory for the kscan/gang vocab-key topology evaluation: returns
+    eval_candidates(zs [C, D], cnt [NGv, D]) -> (feasible [C], newz
+    [C, D]) — vg_evaluate on the compact domain columns (exact: D covers
+    every vocab value of the key). Shared verbatim by _make_kind_step's
+    per-pod inner loop and _make_gang_step's per-rank-block loop (ISSUE
+    20 rung 2) — the body reads only the apply gate, self-selection, and
+    the pod's strict domain mask, so both callers evaluate identical
+    narrowing."""
+    dom = topo.vg_domains[:, :D]
+    rank = topo.vg_rank[:, :D]
+    skew = topo.vg_skew
+    mind = topo.vg_min_domains
+    in_universe = dom & pd[None, :]
+    supported = jnp.sum(in_universe, axis=-1).astype(jnp.int32)
+    self_add = selfs.astype(jnp.int32)
+
+    def eval_candidates(zs, cnt):
+        masked = jnp.where(in_universe, cnt, topo_ops.BIG_I32)
+        minc = jnp.min(masked, axis=-1)
+        minc = jnp.where((mind > 0) & (supported < mind), 0, minc)
+        minc = jnp.where(minc == topo_ops.BIG_I32, 0, minc)
+        eff = cnt + self_add[:, None]
+        ok_skew = (eff - minc[:, None]) <= skew[:, None]
+        opts = dom & pd[None, :] & (cnt > 0)
+        group_empty = ~jnp.any(cnt > 0, axis=-1)
+        no_compat = ~jnp.any(pd[None, :] & (cnt > 0), axis=-1)
+        bootstrap = selfs & (group_empty | no_compat)
+        cnt_zero = cnt == 0
+
+        valid_sp = dom[None] & zs[:, None, :] & ok_skew[None]
+        sp_key = jnp.where(
+            valid_sp, eff[None] * topo_ops.RANK_BASE + rank[None], topo_ops.BIG_I32
+        )
+        sp_mask = topo_ops._onehot_rows(valid_sp, jnp.argmin(sp_key, axis=-1))
+        any_sp = jnp.any(valid_sp, axis=-1)
+
+        opts_c = opts[None] & zs[:, None, :]
+        any_opts = jnp.any(opts_c, axis=-1, keepdims=True)
+        boot_space = (dom & pd[None, :])[None] & zs[:, None, :]
+        boot_idx = jnp.argmin(
+            jnp.where(boot_space, rank[None], topo_ops.BIG_I32), axis=-1
+        )
+        boot_mask = topo_ops._onehot_rows(boot_space, boot_idx)
+        aff_mask = jnp.where(
+            any_opts, opts_c, boot_mask & bootstrap[None, :, None]
+        )
+        any_aff = jnp.any(aff_mask, axis=-1)
+
+        anti_mask = boot_space & cnt_zero[None]
+        any_anti = jnp.any(anti_mask, axis=-1)
+
+        t = topo.vg_type[None, :]
+        narrowed = jnp.where(
+            (t == topo_ops.TYPE_SPREAD)[..., None],
+            sp_mask,
+            jnp.where((t == topo_ops.TYPE_AFFINITY)[..., None], aff_mask, anti_mask),
+        )
+        ok = jnp.where(
+            t == topo_ops.TYPE_SPREAD,
+            any_sp,
+            jnp.where(t == topo_ops.TYPE_AFFINITY, any_aff, any_anti),
+        )
+        feasible = jnp.all(~gate[None, :] | ok, axis=-1)
+        upd = jnp.all(~gate[None, :, None] | narrowed, axis=1)  # [C, D]
+        return feasible, zs & upd
+
+    return eval_candidates
+
+
 def _make_kind_step(
     exist: ExistingNodes,
     it: InstanceTypeTensors,
@@ -2816,70 +3055,13 @@ def _make_kind_step(
         z0_g = comb0.mask[:, key_kid, :D]
         zinf_g = comb0.inf[:, key_kid]
 
-        # vg group geometry for THIS kind (every gated group shares key_kid)
+        # vg group geometry for THIS kind (every gated group shares
+        # key_kid); the evaluation body lives in _vg_eval — shared with
+        # the gang rank-block loop
         gate = xs.vg_applies & topo.vg_valid  # [NGv]
         recs = xs.vg_records & topo.vg_valid
-        selfs = xs.vg_self
-        dom = topo.vg_domains[:, :D]
-        rank = topo.vg_rank[:, :D]
-        skew = topo.vg_skew
-        mind = topo.vg_min_domains
-        in_universe = dom & pd[None, :]
-        supported = jnp.sum(in_universe, axis=-1).astype(i32)
         is_anti = topo.vg_type == topo_ops.TYPE_ANTI
-        self_add = selfs.astype(i32)
-
-        def eval_candidates(zs, cnt):
-            """(feasible [C], newz [C, D]) — vg_evaluate on the compact
-            domain columns (exact: D covers every vocab value of the key)."""
-            masked = jnp.where(in_universe, cnt, topo_ops.BIG_I32)
-            minc = jnp.min(masked, axis=-1)
-            minc = jnp.where((mind > 0) & (supported < mind), 0, minc)
-            minc = jnp.where(minc == topo_ops.BIG_I32, 0, minc)
-            eff = cnt + self_add[:, None]
-            ok_skew = (eff - minc[:, None]) <= skew[:, None]
-            opts = dom & pd[None, :] & (cnt > 0)
-            group_empty = ~jnp.any(cnt > 0, axis=-1)
-            no_compat = ~jnp.any(pd[None, :] & (cnt > 0), axis=-1)
-            bootstrap = selfs & (group_empty | no_compat)
-            cnt_zero = cnt == 0
-
-            valid_sp = dom[None] & zs[:, None, :] & ok_skew[None]
-            sp_key = jnp.where(
-                valid_sp, eff[None] * topo_ops.RANK_BASE + rank[None], topo_ops.BIG_I32
-            )
-            sp_mask = topo_ops._onehot_rows(valid_sp, jnp.argmin(sp_key, axis=-1))
-            any_sp = jnp.any(valid_sp, axis=-1)
-
-            opts_c = opts[None] & zs[:, None, :]
-            any_opts = jnp.any(opts_c, axis=-1, keepdims=True)
-            boot_space = (dom & pd[None, :])[None] & zs[:, None, :]
-            boot_idx = jnp.argmin(
-                jnp.where(boot_space, rank[None], topo_ops.BIG_I32), axis=-1
-            )
-            boot_mask = topo_ops._onehot_rows(boot_space, boot_idx)
-            aff_mask = jnp.where(
-                any_opts, opts_c, boot_mask & bootstrap[None, :, None]
-            )
-            any_aff = jnp.any(aff_mask, axis=-1)
-
-            anti_mask = boot_space & cnt_zero[None]
-            any_anti = jnp.any(anti_mask, axis=-1)
-
-            t = topo.vg_type[None, :]
-            narrowed = jnp.where(
-                (t == topo_ops.TYPE_SPREAD)[..., None],
-                sp_mask,
-                jnp.where((t == topo_ops.TYPE_AFFINITY)[..., None], aff_mask, anti_mask),
-            )
-            ok = jnp.where(
-                t == topo_ops.TYPE_SPREAD,
-                any_sp,
-                jnp.where(t == topo_ops.TYPE_AFFINITY, any_aff, any_anti),
-            )
-            feasible = jnp.all(~gate[None, :] | ok, axis=-1)
-            upd = jnp.all(~gate[None, :, None] | narrowed, axis=1)  # [C, D]
-            return feasible, zs & upd
+        eval_candidates = _vg_eval(topo, gate, xs.vg_self, pd, D)
 
         # carry only what a landing actually mutates; everything else is
         # derivable from (pl_n, n_open) against segment-start state — the
@@ -3305,8 +3487,12 @@ class ShardKscanState(NamedTuple):
     """The window-row slice + counters + topology counts + existing-node
     debit state of one speculative per-shard kscan OR per-pod solve
     (solve_perpod_dp reuses this slice and merge_shard_kscan wholesale).
-    Bank, budget and reservation state are unchanged by construction on
-    the dp-routable classes, so they never cross the merge."""
+    Bank state is unchanged by construction on the dp-routable classes,
+    so it never crosses the merge. Budget and reservation state DO ride
+    the slice (ISSUE 20 rung 1): per-pod rows may debit pool budgets and
+    consume reservation capacity, and the verdict's budget/reservation
+    disjointness bits prove the per-row deltas merge order-free (kscan
+    rows leave them at the base by routing, so their deltas are zero)."""
 
     reqs: ReqSetTensors  # [W, K, V]
     used: jnp.ndarray  # [W, R]
@@ -3326,6 +3512,9 @@ class ShardKscanState(NamedTuple):
     exist_used: jnp.ndarray  # [E, R]
     exist_ports: jnp.ndarray  # [E, NPp]
     exist_vols: jnp.ndarray  # [E, NVp]
+    budget: jnp.ndarray  # [G, R] f32 (+inf = unlimited)
+    nodes_budget: jnp.ndarray  # [G] f32
+    res_cap: jnp.ndarray  # [RID] i32
 
 
 def _shard_kscan_slice(st: SolverState) -> ShardKscanState:
@@ -3337,8 +3526,32 @@ def _shard_kscan_slice(st: SolverState) -> ShardKscanState:
         w_open=st.w_open, spills=st.spills, vg_counts=st.vg_counts,
         hg_counts=st.hg_counts, exist_reqs=st.exist_reqs,
         exist_used=st.exist_used, exist_ports=st.exist_ports,
-        exist_vols=st.exist_vols,
+        exist_vols=st.exist_vols, budget=st.budget,
+        nodes_budget=st.nodes_budget, res_cap=st.res_cap,
     )
+
+
+def _budget_res_conflict(state, spec, apply_tmpl):
+    """[q, r] bool — budget/reservation admission conflicts between dp
+    rows (ISSUE 20 rung 1). Row q TOUCHES template g's budget when any
+    budget or node-count delta vs the round base is nonzero (an infinite
+    budget minus a finite debit stays +inf, so touch is automatically
+    restricted to finite-budget templates); row r APPLIES g's budget when
+    any of its live pods may consider g (`apply_tmpl[r, g]` — the
+    per-pod step reads state.budget/nodes_budget only through templates
+    that pass the pod's tmpl_ok gate). Reservations get one conservative
+    bit: a row with any res_cap delta blocks every later row the moment
+    reservations are active — held-row deltas ride the window graft and
+    the pods-touched bit, so res_cap is the only cross-row register."""
+    touch_b = jnp.any(spec.budget != state.budget[None], axis=-1) | (
+        spec.nodes_budget != state.nodes_budget[None]
+    )  # [DP, G]
+    conflict = jnp.any(
+        touch_b[:, None, :] & apply_tmpl[None, :, :], axis=-1
+    )  # [q, r]
+    touch_res = jnp.any(spec.res_cap != state.res_cap[None], axis=-1)  # [DP]
+    conflict = conflict | touch_res[:, None]
+    return conflict
 
 
 def _kscan_rows_dead(used, its, open_mask, it, r_min, key_kid, zone_kid, D):
@@ -3462,7 +3675,13 @@ def merge_shard_kscan(
     place on the existing-node columns [0, E) and shift their
     fresh-claim columns by the claim-id delta before adding
     (_merge_hg_delta) — plus the existing-node debit graft
-    (_graft_exist_fields, whole-field per touched node). The group's
+    (_graft_exist_fields, whole-field per touched node), plus the
+    budget/reservation debit deltas (ISSUE 20 rung 1): finite budgets
+    add the row's (spec - base) debit, infinite budgets stay +inf (the
+    isfinite guard keeps inf - inf from poisoning the sum), and res_cap
+    adds the plain i32 delta. The verdict's budget/reservation
+    disjointness bits make these sums order-free; kscan-routed rows
+    leave all three at the base so their deltas vanish. The group's
     assignment slots >= E + base.n_open re-base by the claim-id delta;
     existing-node assignments (< E) and the NO_ROOM/NO_CLAIM sentinels
     (< 0) pass through. Returns (merged, shifted_slot_map,
@@ -3475,11 +3694,22 @@ def merge_shard_kscan(
     vg = committed.vg_counts + (spec.vg_counts - base.vg_counts)
     hg = _merge_hg_delta(committed, spec.hg_counts, base, delta, spec.n_open)
     exist_fields = _graft_exist_fields(committed, spec, base)
+    budget = committed.budget + jnp.where(
+        jnp.isfinite(base.budget), spec.budget - base.budget, 0.0
+    )
+    nodes_budget = committed.nodes_budget + jnp.where(
+        jnp.isfinite(base.nodes_budget),
+        spec.nodes_budget - base.nodes_budget,
+        0.0,
+    )
+    res_cap = committed.res_cap + (spec.res_cap - base.res_cap)
     assign = jnp.where(
         assignment >= E + base_n, assignment + delta, assignment
     )
     merged = committed._replace(
-        vg_counts=vg, hg_counts=hg, **exist_fields, **fields
+        vg_counts=vg, hg_counts=hg, budget=budget,
+        nodes_budget=nodes_budget, res_cap=res_cap,
+        **exist_fields, **fields,
     )
     return merged, shifted, assign
 
@@ -3489,18 +3719,22 @@ def merge_shard_kscan(
 # and every other per-pod-routed kind joins the speculative dp fan-out
 # ---------------------------------------------------------------------------
 #
-# The per-pod engine is the most general dispatch, but on the
-# perpod-dp-routable class (no enforced minValues, no reservations,
-# infinite budgets — the same host gates that route kinds to the fill)
-# its step mutates exactly the ShardKscanState slice: window rows,
-# counters, vg/hg counts and existing-node fields (the budget adds are
-# identity at +inf, bank and reservation fields pass through untouched).
-# So one chunk of the per-pod scan per dp row speculates against the
-# round base under the SAME commit conditions as the kscan family —
-# window deadness for the chunk's valid-min request, pods-touched,
-# vg/hg record-vs-apply disjointness, existing-node debit disjointness —
-# and commits through merge_shard_kscan unchanged (hostname-group deltas
-# shift their fresh columns, add in place on [0, E)).
+# The per-pod engine is the most general dispatch. Its step mutates
+# exactly the ShardKscanState slice: window rows, counters, vg/hg
+# counts, existing-node fields, and — since ISSUE 20 rung 1 — the
+# budget/nodes_budget debits and reservation capacity (bank fields
+# still pass through untouched on this class). One chunk of the
+# per-pod scan per dp row speculates against the round base under the
+# SAME commit conditions as the kscan family — window deadness for the
+# chunk's valid-min request, pods-touched, vg/hg record-vs-apply
+# disjointness, existing-node debit disjointness — plus two new bits:
+# budget touch-vs-apply (a row that debits a finite budget blocks later
+# rows whose pods could read it) and a conservative any-res_cap-delta
+# bit. minValues needs no bit at all: mv only TIGHTENS a row's landing
+# options and writes no cross-row state, so deadness stays sound.
+# Commits go through merge_shard_kscan (hostname-group deltas shift
+# their fresh columns, add in place on [0, E); budget/res_cap deltas
+# add under the disjointness proof).
 
 
 @_wf_timed("solve_perpod_dp")
@@ -3584,10 +3818,21 @@ def solve_perpod_dp(
     topo_ok = kernels.pairwise_commit_ok(conflict)
     exist_ok_rows = jnp.any(valid & pod_exist_ok, axis=1)
     exist_bit = _exist_conflict_ok(state, spec, exist, exist_ok_rows, r_min)
+    # ISSUE 20 rung 1: budget touch-vs-apply + reservation disjointness.
+    # A row that debits template g's budget (or node count) may not
+    # commit ahead of a later row whose pods could read g's remaining
+    # budget through their tmpl_ok gate; any reservation-capacity delta
+    # conservatively blocks all later rows (reservations are rare and
+    # res_cap is the only cross-row reservation register — held rows
+    # ride the window graft and the pods-touched bit).
+    apply_tmpl = jnp.any(valid & pod_tmpl_ok, axis=1)  # [DP, G]
+    budget_bit = kernels.pairwise_commit_ok(
+        _budget_res_conflict(state, spec, apply_tmpl)
+    )
     verdict = _dp_verdict_word(
         state, spec, r_min, n_claims,
         lambda u, iv, om, rm: _rows_dead(u, iv, om, it, rm),
         touched=touched,
-        extra_ok=topo_ok & exist_bit,
+        extra_ok=topo_ok & exist_bit & budget_bit,
     )
     return spec, assignment, verdict

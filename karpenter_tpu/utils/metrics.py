@@ -37,6 +37,15 @@ class Counter(_Family):
     def get(self, **labels) -> float:
         return self.values.get(self._key(labels), 0.0)
 
+    def sum(self, **labels) -> float:
+        """Total over every series matching the given label subset (get()
+        is exact-key: an omitted label means \"\", not a wildcard)."""
+        idx = [(self.label_names.index(n), v) for n, v in labels.items()]
+        return sum(
+            v for k, v in self.values.items()
+            if all(k[i] == want for i, want in idx)
+        )
+
 
 class Gauge(_Family):
     def __init__(self, name, help_text, label_names=()):
@@ -475,12 +484,16 @@ SHARD_MERGE_ROUNDS = REGISTRY.counter(
 SHARD_FAMILY_ELIGIBLE = REGISTRY.counter(
     "ktpu_shard_family_eligible_total",
     "Chunk groups routed per solver family (fill | existing | topo_fill |"
-    " kscan | perpod): path=dp when the group entered a speculative merge"
-    " round (committed or replayed — either way it rode the fan-out),"
-    " path=sequential when eligibility gating (mesh size, env opt-outs,"
-    " quarantine, movement/reservation/budget activity) kept it on the"
-    " ordered scan; the ratio is the measured speculation coverage",
-    ("family", "path"),
+    " kscan | perpod | gang): path=dp when the group entered a speculative"
+    " merge round (committed or replayed — either way it rode the fan-out),"
+    " path=sequential when eligibility gating kept it on the ordered scan;"
+    " reason names the first failed conjunct on sequential increments"
+    " (no_pipeline | no_dp_mesh | shard_dp_off | kscan_optout |"
+    " perpod_optout | quarantined | existing_optout | single_group |"
+    " single_chunk | gang_atomic; \"\" on dp) so the coverage matrix is"
+    " self-describing; the dp/sequential ratio is the measured speculation"
+    " coverage",
+    ("family", "path", "reason"),
 )
 SHARD_VERDICT_BYTES = REGISTRY.counter(
     "ktpu_shard_verdict_bytes_total",

@@ -170,10 +170,11 @@ class TestGangParity:
             monkeypatch, pods, window=4, gangs=[("default/train-w", 8)]
         )
 
-    def test_gang_under_budgets_routes_to_host_oracle(self, monkeypatch):
-        """Finite pool budgets are outside the device gang kernel's
-        constraint family: the solve degrades to the host oracle
-        (identical semantics) and records the fallback."""
+    def test_gang_under_budgets_stays_on_device(self, monkeypatch):
+        """Finite pool budgets now ride the device gang kernel (per-block
+        subtractMax debits in the rank-block loop): the solve stays on
+        device — zero gang_constraints fallbacks — and stays bit-identical
+        to the host oracle."""
         budgets = {"default": {"cpu": 100000.0}}
         before = metrics.SOLVER_FALLBACK.get(reason="gang_constraints")
         pods = make_gang_pods("train-b", 4, cpu=1.0) + [
@@ -184,11 +185,13 @@ class TestGangParity:
         result = sched.solve(pods, budgets=budgets)
         assert_same_packing(href, result)
         assert_gang_shape(result, "default/train-b", 4)
-        assert metrics.SOLVER_FALLBACK.get(reason="gang_constraints") > before
+        assert metrics.SOLVER_FALLBACK.get(reason="gang_constraints") == before
 
-    def test_gang_with_topology_routes_to_host_oracle(self, monkeypatch):
+    def test_gang_with_topology_stays_on_device(self, monkeypatch):
         """A gang kind carrying topology interaction (zonal TSC on the
-        members) degrades to the host oracle too."""
+        members) rides the gang-aware kscan (one vg evaluation per rank
+        block) instead of tripping _GangHostRoute — zero fallbacks,
+        bit-identical to the host oracle."""
         from karpenter_tpu.models import labels as l
         from karpenter_tpu.models.pod import TopologySpreadConstraint
 
@@ -207,13 +210,93 @@ class TestGangParity:
         sched = windowed_scheduler(monkeypatch, 0, 0, 16, 128)
         result = sched.solve(pods)
         assert_same_packing(href, result)
-        assert metrics.SOLVER_FALLBACK.get(reason="gang_constraints") > before
+        assert metrics.SOLVER_FALLBACK.get(reason="gang_constraints") == before
 
     def test_non_gang_solves_untouched(self, monkeypatch):
         """The non-gang path must not shift by a single pod: the standard
         mixed workload still matches its oracle (and the gang partition
         code never runs — no gang annotations present)."""
         run_gang_parity(monkeypatch, bench.mixed_pods(48), n_types=24)
+
+
+def _spread_gang(name, size, cpu, topology_key, sel_value):
+    """A gang whose members all carry one topology-spread constraint with
+    a shared selector (the single-key shape the gang-aware kscan admits)."""
+    from karpenter_tpu.models.pod import TopologySpreadConstraint
+
+    pods = make_gang_pods(name, size, cpu=cpu)
+    for p in pods:
+        p.metadata.labels = dict(p.metadata.labels or {}, spread=sel_value)
+        p.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(
+                max_skew=1,
+                topology_key=topology_key,
+                label_selector={"spread": sel_value},
+            )
+        ]
+    return pods
+
+
+class TestGangConstraintParity:
+    """Gang × {zonal-spread, hostname-spread, budget} host-oracle parity
+    across chunks K in {1, 2, 4} — the frozen differential target for the
+    gang-aware kscan (these classes used to raise _GangHostRoute; now the
+    rank-block loop evaluates them on device, bit-identical either way)."""
+
+    def test_gang_zonal_spread_parity(self, monkeypatch):
+        from karpenter_tpu.models import labels as l
+
+        pods = _spread_gang("gz", 6, 1.0, l.LABEL_TOPOLOGY_ZONE, "gz") + [
+            make_pod(f"gzs-{i}", cpu=0.5) for i in range(8)
+        ]
+        run_gang_parity(monkeypatch, pods, gangs=[("default/gz", 6)])
+
+    def test_gang_hostname_spread_parity(self, monkeypatch):
+        from karpenter_tpu.models import labels as l
+
+        pods = _spread_gang("gh", 4, 1.0, l.LABEL_HOSTNAME, "gh") + [
+            make_pod(f"ghs-{i}", cpu=0.5) for i in range(6)
+        ]
+        run_gang_parity(monkeypatch, pods, gangs=[("default/gh", 4)])
+
+    def test_gang_budget_parity(self, monkeypatch):
+        budgets = {"default": {"cpu": 64.0}}
+        pods = make_gang_pods("gb", 4, cpu=1.0) + [
+            make_pod(f"gbs-{i}", cpu=0.5) for i in range(8)
+        ]
+        run_gang_parity(
+            monkeypatch, pods, budgets=budgets, gangs=[("default/gb", 4)]
+        )
+
+    def test_gang_tight_nodes_budget_spills_identically(self, monkeypatch):
+        """A nodes budget too small for the slice (budget.nodes < want is
+        the host's pre-block gang gate — resource budgets only narrow the
+        candidate set up front): both engines must spill the whole gang
+        (all-or-nothing) while the singletons still place."""
+        budgets = {"default": {"nodes": 1.0}}
+        pods = make_gang_pods("gt", 4, cpu=6.0) + [
+            make_pod(f"gts-{i}", cpu=0.25) for i in range(4)
+        ]
+        href, base = run_gang_parity(monkeypatch, pods, budgets=budgets)
+        gang_unsched = {
+            p.metadata.name for p, _ in base.unschedulable
+            if p.metadata.name.startswith("gt-")
+        }
+        assert gang_unsched == {f"gt-{r}" for r in range(4)}
+
+    def test_gang_zonal_under_budget_parity(self, monkeypatch):
+        """Both new constraint classes at once: zonal spread narrows the
+        per-block remaining set, and the budget debit is charged per block
+        over exactly that narrowed set (host _charge_budget semantics)."""
+        from karpenter_tpu.models import labels as l
+
+        budgets = {"default": {"cpu": 48.0}}
+        pods = _spread_gang("gzb", 6, 1.0, l.LABEL_TOPOLOGY_ZONE, "gzb") + [
+            make_pod(f"gzbs-{i}", cpu=0.5) for i in range(6)
+        ]
+        run_gang_parity(
+            monkeypatch, pods, budgets=budgets, gangs=[("default/gzb", 6)]
+        )
 
 
 # -- all-or-nothing semantics -------------------------------------------------

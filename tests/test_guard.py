@@ -497,3 +497,25 @@ def test_pod_roundtrip_through_bundle():
         [pods_by_uid[u] for u in rounds[0]]
     )
     assert r_rt.assignments == r_orig.assignments
+
+
+def test_bundle_env_pins_shard_family_knobs(monkeypatch):
+    """ISSUE 20: the bundle env snapshots the shard family opt-out knobs
+    even when UNSET — "unset" (dp-eligible) is itself a routing input, and
+    a replay host where one happens to be exported would route the family
+    differently and never reach the diverging merge. An empty string
+    restores the default: every knob reads `get(k, "1") not in ("0", ...)`,
+    so "" and unset route identically."""
+    for knob in ("KTPU_SHARD_EXISTING", "KTPU_SHARD_PERPOD", "KTPU_SHARD_KSCAN"):
+        monkeypatch.delenv(knob, raising=False)
+    monkeypatch.setenv("KTPU_SHARD_PERPOD", "0")
+    sched = TPUScheduler(make_templates(), max_claims=128)
+    pods = kind_pods("e", 2)
+    doc = guard_bundle.make_bundle(
+        "speculative", "unit-test", sched, {p.uid: p for p in pods},
+        [[p.uid for p in pods]], [],
+    )
+    env = doc["env"]
+    assert env["KTPU_SHARD_PERPOD"] == "0"  # the set value survives
+    assert env["KTPU_SHARD_EXISTING"] == ""  # unset is pinned, not dropped
+    assert env["KTPU_SHARD_KSCAN"] == ""

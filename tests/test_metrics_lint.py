@@ -175,7 +175,10 @@ def test_shard_families_are_registered():
         "ktpu_shard_merge_rounds_total": (Counter, ("outcome", "family")),
         "ktpu_shard_replicated_bytes": (Gauge, ()),
         "ktpu_shard_verdict_bytes_total": (Counter, ()),
-        "ktpu_shard_family_eligible_total": (Counter, ("family", "path")),
+        "ktpu_shard_family_eligible_total": (
+            Counter,
+            ("family", "path", "reason"),
+        ),
     }
     for name, (cls, labels) in expected.items():
         fam = fams.get(name)
@@ -189,6 +192,15 @@ def test_shard_families_are_registered():
     for fam_name in ("fill", "existing", "topo_fill", "kscan", "perpod"):
         assert fam_name in merge_help, fam_name
         assert fam_name in fams["ktpu_shard_family_eligible_total"].help
+    # ISSUE 20 made the sequential routing self-describing: the help text
+    # must name every reason value the eligibility gates can emit
+    eligible_help = fams["ktpu_shard_family_eligible_total"].help
+    for reason in (
+        "no_pipeline", "no_dp_mesh", "shard_dp_off", "kscan_optout",
+        "perpod_optout", "quarantined", "existing_optout", "single_group",
+        "single_chunk", "gang_atomic",
+    ):
+        assert reason in eligible_help, reason
 
 
 def test_guard_families_are_registered():

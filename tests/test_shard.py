@@ -664,6 +664,186 @@ class TestDpPerpodParity:
         assert_bit_identical(meshed, single)
 
 
+def mv_templates(n_types=24, mv=2):
+    """Templates whose pool carries an instance-type minValues floor —
+    the enforced-minValues constraint class rung 1 admits to perpod-dp."""
+    from test_solver import default_pool
+
+    pool = default_pool(
+        "default",
+        requirements=[
+            {"key": l.LABEL_INSTANCE_TYPE, "operator": "Exists", "minValues": mv}
+        ],
+    )
+    return build_templates([(pool, instance_types(n_types))])
+
+
+def host_oracle(templates, pods, budgets=None):
+    """The host oracle with budgets (bench.host_solve has no budgets
+    parameter), on the same internally-built topology."""
+    from karpenter_tpu.controllers.provisioning.host_scheduler import (
+        HostScheduler,
+    )
+    from karpenter_tpu.controllers.provisioning.topology import (
+        Topology,
+        build_universe_domains,
+    )
+
+    topo = Topology.build(
+        list(pods), build_universe_domains(templates, []), []
+    )
+    return HostScheduler(templates, budgets=budgets, topology=topo).solve(
+        list(pods)
+    )
+
+
+class TestDpBudgetParity:
+    """Rung 1 (ISSUE 20): enforced minValues + finite disruption budgets
+    no longer disqualify perpod-dp. Budget/nodes_budget debits and
+    reservation capacities ride the speculative ShardKscanState slice as
+    order-free deltas; a budget/reservation disjointness verdict bit
+    refuses any row whose debit an earlier row's template application
+    could observe. Chunks {1, 2, 4} over 256 pods, each vs the
+    single-device sequential solve AND the host oracle."""
+
+    @pytest.mark.parametrize(
+        "solve_chunk",
+        [
+            pytest.param(256, marks=pytest.mark.slow),
+            pytest.param(128, marks=pytest.mark.slow),
+            64,
+        ],
+    )
+    def test_perpod_mv_budget_bit_identical(self, monkeypatch, solve_chunk):
+        monkeypatch.setenv("KTPU_SOLVE_CHUNK", str(solve_chunk))
+        n_chunks = 256 // solve_chunk
+        budgets = {"default": {"cpu": 1e6}}
+        pods = perpod_kind_pods(256, prefix=f"bp{n_chunks}")
+        templates = mv_templates()
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "4")
+        monkeypatch.setenv("KTPU_PIPELINE_MIN_PODS", "32")
+        monkeypatch.delenv("KTPU_SCAN_WINDOW", raising=False)
+        monkeypatch.delenv("KTPU_SHARD_DP", raising=False)
+        sched = TPUScheduler(templates, mesh=make_mesh(8))
+        meshed = sched.solve(pods, budgets={"default": dict(budgets["default"])})
+        shard = sched.last_timings["shard"]
+        fam = shard["families"]["perpod"]
+        if n_chunks > 1:
+            # the round's FIRST row always commits (no earlier row to
+            # conflict with); later rows that applied the debited
+            # template refuse on the budget bit and replay — both
+            # outcomes ride the dp path
+            assert fam["committed"] >= 1, shard
+        else:
+            assert fam["committed"] + fam["replayed"] == 0, shard
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        single = TPUScheduler(mv_templates()).solve(
+            pods, budgets={"default": dict(budgets["default"])}
+        )
+        assert_bit_identical(meshed, single)
+        href = host_oracle(
+            mv_templates(), pods, budgets={"default": dict(budgets["default"])}
+        )
+        assert_same_packing(href, meshed)
+
+    def test_perpod_tight_budget_replays_bit_identical(self, monkeypatch):
+        """A budget tight enough that the candidate set narrows as debits
+        land: later chunks' rows must refuse on the budget bit (their
+        speculative base lied about the remaining budget) and replay —
+        still bit-identical both ways."""
+        monkeypatch.setenv("KTPU_SOLVE_CHUNK", "64")
+        budgets = {"default": {"nodes": 6.0}}
+        pods = perpod_kind_pods(256, prefix="bt")
+        templates = make_templates()
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "4")
+        monkeypatch.setenv("KTPU_PIPELINE_MIN_PODS", "32")
+        monkeypatch.delenv("KTPU_SCAN_WINDOW", raising=False)
+        monkeypatch.delenv("KTPU_SHARD_DP", raising=False)
+        sched = TPUScheduler(templates, mesh=make_mesh(8))
+        meshed = sched.solve(pods, budgets={"default": dict(budgets["default"])})
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        single = TPUScheduler(make_templates()).solve(
+            pods, budgets={"default": dict(budgets["default"])}
+        )
+        assert_bit_identical(meshed, single)
+        href = host_oracle(
+            make_templates(), pods, budgets={"default": dict(budgets["default"])}
+        )
+        assert_same_packing(href, meshed)
+
+
+class TestDpGangKscanParity:
+    """Rung 2 (ISSUE 20): a gang carrying zonal-spread topology rides the
+    gang-aware kscan on device (one vg evaluation per rank block inside
+    the gang kernel) while zonal singles in the same solve keep dp-
+    speculating — no _GangHostRoute, zero gang_constraints fallbacks.
+    Chunks {1, 2, 4} vs single-device AND host oracle."""
+
+    @pytest.mark.parametrize(
+        "chunks",
+        [
+            pytest.param(1, marks=pytest.mark.slow),
+            pytest.param(2, marks=pytest.mark.slow),
+            4,
+        ],
+    )
+    def test_gang_zonal_with_kscan_singles_bit_identical(
+        self, monkeypatch, chunks
+    ):
+        from karpenter_tpu.gang import make_gang_pods
+        from karpenter_tpu.utils import metrics
+
+        before = metrics.SOLVER_FALLBACK.get(reason="gang_constraints")
+        gang = make_gang_pods("dgz", 6, cpu=1.0)
+        for p in gang:
+            p.metadata.labels = dict(p.metadata.labels or {}, spread="dgz")
+            p.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=l.LABEL_TOPOLOGY_ZONE,
+                    label_selector={"spread": "dgz"},
+                )
+            ]
+        pods = gang + zonal_kind_pods(192, prefix=f"dgz{chunks}")
+        sched = dp_scheduler(monkeypatch, chunks=chunks)
+        meshed = sched.solve(pods)
+        assert (
+            metrics.SOLVER_FALLBACK.get(reason="gang_constraints") == before
+        ), "gang+zonal must stay on device"
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        single = TPUScheduler(make_templates()).solve(pods)
+        assert_bit_identical(meshed, single)
+        href, _ = bench.host_solve(make_templates(), pods)
+        assert_same_packing(href, meshed)
+
+    def test_gang_budget_meshed_bit_identical(self, monkeypatch):
+        """Gang × finite budgets on the meshed scheduler: the per-block
+        debit (subtractMax over the block-narrowed remaining set) matches
+        the host's _charge_budget exactly."""
+        from karpenter_tpu.gang import make_gang_pods
+        from karpenter_tpu.utils import metrics
+
+        before = metrics.SOLVER_FALLBACK.get(reason="gang_constraints")
+        budgets = {"default": {"cpu": 64.0}}
+        pods = make_gang_pods("dgb", 4, cpu=1.0) + saturating_kind_pods(
+            128, kinds=4, prefix="dgb"
+        )
+        sched = dp_scheduler(monkeypatch)
+        meshed = sched.solve(pods, budgets={"default": dict(budgets["default"])})
+        assert (
+            metrics.SOLVER_FALLBACK.get(reason="gang_constraints") == before
+        ), "gang+budgets must stay on device"
+        monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
+        single = TPUScheduler(make_templates()).solve(
+            pods, budgets={"default": dict(budgets["default"])}
+        )
+        assert_bit_identical(meshed, single)
+        href = host_oracle(
+            make_templates(), pods, budgets={"default": dict(budgets["default"])}
+        )
+        assert_same_packing(href, meshed)
+
+
 class TestNewFamilyQuarantine:
     """KTPU_GUARD_LIE=speculative against each ISSUE 14 family: the
     shadow audit catches the corrupted graft, quarantines the
@@ -682,25 +862,30 @@ class TestNewFamilyQuarantine:
         guard.QUARANTINE.reset()
         guard.reset_log()
 
-    def _lie_and_recover(self, monkeypatch, family, pods, existing=None):
+    def _lie_and_recover(
+        self, monkeypatch, family, pods, existing=None, budgets=None
+    ):
         from karpenter_tpu import guard
+
+        def kw():
+            return dict(budgets={k: dict(v) for k, v in budgets.items()}) if budgets else {}
 
         monkeypatch.setenv("KTPU_GUARD_AUDIT_RATE", "1.0")
         monkeypatch.setenv("KTPU_GUARD_LIE", "speculative")
         sched = dp_scheduler(monkeypatch)
-        meshed = sched.solve(list(pods), list(existing or []))
+        meshed = sched.solve(list(pods), list(existing or []), **kw())
         assert guard.divergences("speculative")
         assert guard.QUARANTINE.active("speculative")
         monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "0")
         single = TPUScheduler(make_templates()).solve(
-            list(pods), list(existing or [])
+            list(pods), list(existing or []), **kw()
         )
         assert_bit_identical(meshed, single)
         # quarantined: the family rides the sequential scan, still exact
         monkeypatch.setenv("KTPU_PIPELINE_CHUNKS", "4")
         monkeypatch.delenv("KTPU_GUARD_LIE", raising=False)
         sched2 = dp_scheduler(monkeypatch)
-        r2 = sched2.solve(list(pods), list(existing or []))
+        r2 = sched2.solve(list(pods), list(existing or []), **kw())
         assert_bit_identical(meshed, r2)
         shard = sched2.last_timings["shard"]
         assert shard["merge_rounds"] == 0, shard
@@ -731,6 +916,59 @@ class TestNewFamilyQuarantine:
         self._lie_and_recover(
             monkeypatch, "perpod", perpod_kind_pods(128, kinds=4, prefix="qp")
         )
+
+    def test_lying_perpod_budget_family_quarantines(self, monkeypatch):
+        """Rung 1 under the lie: the perpod family speculating under
+        finite budgets quarantines back to its sequential twin exactly
+        like the budget-free class."""
+        monkeypatch.setenv("KTPU_SOLVE_CHUNK", "64")
+        self._lie_and_recover(
+            monkeypatch,
+            "perpod",
+            perpod_kind_pods(128, kinds=4, prefix="qb"),
+            budgets={"default": {"cpu": 1e6}},
+        )
+
+    def test_lying_gang_path_quarantines_to_host(self, monkeypatch):
+        """Rung 2 under the lie: KTPU_GUARD_LIE=gang corrupts the device
+        gang solve; the solve-level shadow audit (host oracle twin)
+        catches it, returns the oracle result, and quarantines the "gang"
+        path — the NEXT constraint-bearing gang solve routes through
+        _GangHostRoute to the host oracle, still exact."""
+        from karpenter_tpu import guard
+        from karpenter_tpu.gang import make_gang_pods
+        from karpenter_tpu.utils import metrics
+
+        gang = make_gang_pods("qg", 4, cpu=1.0)
+        for p in gang:
+            p.metadata.labels = dict(p.metadata.labels or {}, spread="qg")
+            p.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=l.LABEL_TOPOLOGY_ZONE,
+                    label_selector={"spread": "qg"},
+                )
+            ]
+        pods = gang + [make_pod(f"qgs-{i}", cpu=0.5) for i in range(6)]
+        href = bench.host_solve(make_templates(), pods)[0]
+        monkeypatch.setenv("KTPU_GUARD_LIE", "gang")
+        sched = TPUScheduler(make_templates())
+        result = sched.solve(list(pods))
+        assert guard.divergences("gang")
+        assert guard.QUARANTINE.active("gang")
+        # the audit returned the host twin's (exact) result
+        assert_same_packing(href, result)
+        # quarantined: the next solve routes via _GangHostRoute to the
+        # host oracle — the fallback counter proves it, parity holds
+        monkeypatch.delenv("KTPU_GUARD_LIE", raising=False)
+        before = metrics.SOLVER_FALLBACK.get(reason="gang_constraints")
+        sched2 = TPUScheduler(make_templates())
+        r2 = sched2.solve(list(pods))
+        assert (
+            metrics.SOLVER_FALLBACK.get(reason="gang_constraints")
+            == before + 1
+        )
+        assert_same_packing(href, r2)
 
 
 class TestVerdictDecode:

@@ -343,6 +343,38 @@ class TestBenchDiff:
         assert "REGRESSED" in out and "segments.dispatch" in out
         assert bench_diff.main([str(a), str(tmp_path / "missing.json")]) == 2
 
+    def _with_coverage(self, fracs):
+        doc = json.loads(json.dumps(self.BASE))
+        doc["detail"]["shard_8192x200"] = {"coverage_fraction": dict(fracs)}
+        return doc
+
+    def test_coverage_drop_is_flagged(self):
+        """ISSUE 20: a per-family dp coverage fraction dropping >= 0.05
+        regresses — a family sliding off the dp path costs the
+        speculation win without moving any timing leaf."""
+        base = self._with_coverage({"perpod": 0.9, "kscan": 1.0})
+        cand = self._with_coverage({"perpod": 0.8, "kscan": 1.0})
+        diff = bench_diff.diff_docs(base, cand)
+        paths = [r["path"] for r in diff["regressions"]]
+        assert paths == ["detail.shard_8192x200.coverage_fraction.perpod"]
+
+    def test_coverage_jitter_and_increase_pass(self):
+        base = self._with_coverage({"perpod": 0.9, "kscan": 0.5})
+        # -0.04 is under the ratchet floor; +0.3 is an improvement
+        cand = self._with_coverage({"perpod": 0.86, "kscan": 0.8})
+        assert not bench_diff.diff_docs(base, cand)["regressions"]
+
+    def test_coverage_zero_routed_family_is_a_note(self):
+        """A family absent from one document (the run never routed it,
+        so no fraction was recorded) is structural, not a regression."""
+        base = self._with_coverage({"perpod": 0.9, "gang": 0.0})
+        cand = self._with_coverage({"perpod": 0.9})
+        diff = bench_diff.diff_docs(base, cand)
+        assert not diff["regressions"]
+        assert (
+            "detail.shard_8192x200.coverage_fraction.gang" in diff["only_a"]
+        )
+
     def test_bench_baseline_flag_wires_the_sentinel(self):
         """bench.py --baseline exists and routes through diff_docs."""
         import bench as bench_mod
